@@ -1,0 +1,34 @@
+"""kubegpu_tpu — a TPU-native device-aware scheduling and runtime-injection framework.
+
+A ground-up, TPU-first rebuild of the capabilities of KnifeeOneOne/KubeGPU
+(a Kubernetes device-aware scheduling and CRI extension framework):
+
+- L0 ``types``     — hierarchical grouped-resource paths, TPU mesh topology,
+                     node/pod bookkeeping, annotation wire formats.
+- L2 ``grpalloc``  — the allocation core: group-constraint fit, ICI-mesh
+                     contiguity scoring, take/return bookkeeping. Pure logic,
+                     no I/O, exhaustively unit-testable (optionally accelerated
+                     by the native C++ core in ``native/``).
+- L1 ``plugins``   — TPU device providers: fake (testing), libtpu/devfs/GKE
+                     discovery; node advertiser; per-container Allocate.
+- L4 ``scheduler`` — the scheduler-extender service: cluster cache,
+                     filter/prioritize/bind HTTP endpoints, gang scheduling,
+                     preemption, restart replay.
+- L3 ``crishim``   — CRI proxy + env/device injection (TPU_VISIBLE_CHIPS and
+                     the JAX multi-host rendezvous contract).
+- ``parallel``     — hands scheduled JAX workloads an ICI-contiguous sub-mesh
+                     as a ``jax.sharding.Mesh``; DP/TP/SP sharding helpers.
+- ``models``/``ops`` — reference JAX workloads (the payloads the samples
+                     schedule): ResNet-50 data-parallel training, etc.
+
+Design deltas vs. the reference (see SURVEY.md §7): ICI mesh coordinates are
+explicit metadata scored by rectangular sub-mesh analysis (a 2D/3D torus cannot
+be expressed as the reference's nested NVLink/PCIe tree); gang scheduling and
+preemption are first-class; state still round-trips through Kubernetes
+annotations so every component is stateless across restarts (SURVEY.md §1).
+
+NOTE on provenance: the reference mount was empty at build time (SURVEY.md §0);
+parity targets come from SURVEY.md's reconstruction and BASELINE.json.
+"""
+
+__version__ = "0.1.0"

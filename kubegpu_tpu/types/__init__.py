@@ -1,0 +1,53 @@
+"""L0 substrate: shared vocabulary for the whole framework.
+
+Mirrors the capability of the reference's ``types/`` package (SURVEY.md §2 #1):
+hierarchical resource locations, node/pod/container info, device + scheduler
+interfaces — re-designed around TPU slice topology (explicit mesh coordinates)
+instead of NVLink/PCIe nesting depth.
+"""
+
+from kubegpu_tpu.types.resource import (
+    ResourcePath,
+    ResourceTree,
+    RES_TPU,
+    RES_TPU_MEM_GIB,
+    LEAF_TPU,
+    DEVICE_GROUP_PREFIX,
+)
+from kubegpu_tpu.types.topology import (
+    Chip,
+    SliceTopology,
+    Submesh,
+    TpuGeneration,
+    enumerate_rectangles,
+    coords_bounding_box,
+    is_contiguous_submesh,
+)
+from kubegpu_tpu.types.info import (
+    ContainerInfo,
+    NodeInfo,
+    PodInfo,
+    TpuRequest,
+)
+from kubegpu_tpu.types import annotations
+
+__all__ = [
+    "ResourcePath",
+    "ResourceTree",
+    "RES_TPU",
+    "RES_TPU_MEM_GIB",
+    "LEAF_TPU",
+    "DEVICE_GROUP_PREFIX",
+    "Chip",
+    "SliceTopology",
+    "Submesh",
+    "TpuGeneration",
+    "enumerate_rectangles",
+    "coords_bounding_box",
+    "is_contiguous_submesh",
+    "ContainerInfo",
+    "NodeInfo",
+    "PodInfo",
+    "TpuRequest",
+    "annotations",
+]

@@ -1,0 +1,138 @@
+"""Annotation keys + wire codecs: ALL framework state rides on k8s objects.
+
+The reference's key architectural contract (SURVEY.md §1): device topology and
+allocations travel through Kubernetes annotations, never a side database —
+the advertiser writes the node's device tree into node annotations, bind
+writes the chosen assignment into pod annotations, the CRI shim reads them at
+container-create.  Every component is therefore stateless across restarts.
+This module is the single source of truth for those keys and formats.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional
+
+from kubegpu_tpu.types.info import Assignment, ContainerInfo, NodeInfo, PodInfo
+from kubegpu_tpu.types.resource import RES_TPU
+from kubegpu_tpu.types.topology import Chip, TpuGeneration
+
+PREFIX = "kubegpu-tpu"
+
+# Node side (written by the advertiser daemon, read by the scheduler cache).
+NODE_TOPOLOGY = f"{PREFIX}/topology"            # JSON: slice fragment owned by host
+# Pod side (written by users / controllers).
+POD_GROUP = f"{PREFIX}/pod-group"               # gang name
+POD_GROUP_SIZE = f"{PREFIX}/pod-group-size"     # gang cardinality
+POD_CONTIGUOUS = f"{PREFIX}/contiguous"         # "true"/"false", default true
+POD_PRIORITY = f"{PREFIX}/priority"             # int, for preemption
+# Pod side (written by the extender at bind, read by the CRI shim).
+POD_ASSIGNMENT = f"{PREFIX}/assignment"         # JSON: Assignment
+# Pod side (written by the extender for gang coordination/observability).
+POD_GROUP_STATUS = f"{PREFIX}/pod-group-status"
+
+
+# ---------------------------------------------------------------------------
+# Node topology annotation
+# ---------------------------------------------------------------------------
+
+def encode_node_topology(node: NodeInfo) -> str:
+    return json.dumps(
+        {
+            "slice_id": node.slice_id,
+            "generation": node.generation.value if node.generation else None,
+            "mesh_shape": list(node.mesh_shape) if node.mesh_shape else None,
+            "wrap": list(node.wrap) if node.wrap else None,
+            "chips": [c.to_dict() for c in node.chips],
+        },
+        sort_keys=True,
+    )
+
+
+def decode_node_topology(name: str, payload: str) -> NodeInfo:
+    d = json.loads(payload)
+    node = NodeInfo(
+        name=name,
+        slice_id=d.get("slice_id"),
+        generation=TpuGeneration(d["generation"]) if d.get("generation") else None,
+        mesh_shape=tuple(d["mesh_shape"]) if d.get("mesh_shape") else None,
+        wrap=tuple(bool(x) for x in d["wrap"]) if d.get("wrap") else None,
+        chips=[Chip.from_dict(c) for c in d.get("chips", [])],
+    )
+    node.rebuild_capacity()
+    return node
+
+
+# ---------------------------------------------------------------------------
+# Pod assignment annotation
+# ---------------------------------------------------------------------------
+
+def encode_assignment(a: Assignment) -> str:
+    return json.dumps(a.to_dict(), sort_keys=True)
+
+
+def decode_assignment(payload: str) -> Assignment:
+    return Assignment.from_dict(json.loads(payload))
+
+
+# ---------------------------------------------------------------------------
+# k8s object -> Info converters (used by extender handlers + CRI shim)
+# ---------------------------------------------------------------------------
+
+def pod_from_k8s(obj: dict) -> PodInfo:
+    """Build a PodInfo from a Kubernetes Pod object (dict form, as received
+    by the scheduler-extender HTTP endpoints)."""
+    meta = obj.get("metadata", {}) or {}
+    spec = obj.get("spec", {}) or {}
+    ann: Dict[str, str] = dict(meta.get("annotations") or {})
+    containers = []
+    for c in spec.get("containers", []) or []:
+        res = ((c.get("resources") or {}).get("limits") or {})
+        req = ((c.get("resources") or {}).get("requests") or {})
+        chips = int(res.get(RES_TPU, req.get(RES_TPU, 0)) or 0)
+        containers.append(ContainerInfo(name=c.get("name", ""), tpu_chips=chips))
+    pod = PodInfo(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        uid=meta.get("uid", ""),
+        containers=containers,
+        annotations=ann,
+        labels=dict(meta.get("labels") or {}),
+        node_name=spec.get("nodeName"),
+    )
+    pod.pod_group = ann.get(POD_GROUP)
+    try:
+        pod.pod_group_size = int(ann.get(POD_GROUP_SIZE, "1"))
+    except ValueError:
+        pod.pod_group_size = 1
+    pod.require_contiguous = ann.get(POD_CONTIGUOUS, "true").lower() != "false"
+    try:
+        pod.priority = int(ann.get(POD_PRIORITY, str(spec.get("priority", 0) or 0)))
+    except ValueError:
+        pod.priority = 0
+    return pod
+
+
+def node_from_k8s(obj: dict) -> NodeInfo:
+    meta = obj.get("metadata", {}) or {}
+    ann = dict(meta.get("annotations") or {})
+    name = meta.get("name", "")
+    if NODE_TOPOLOGY in ann:
+        return decode_node_topology(name, ann[NODE_TOPOLOGY])
+    return NodeInfo(name=name)
+
+
+def assignment_from_pod(obj_or_annotations) -> Optional[Assignment]:
+    """Extract the bind-time assignment from a pod object or its annotation
+    map; None if the pod was never device-scheduled.
+
+    Disambiguation: a k8s Pod object has a dict under "metadata"; an
+    annotation map's values are all strings (a legal annotation may be
+    *named* "metadata", so key presence alone is not enough)."""
+    d = obj_or_annotations or {}
+    if isinstance(d.get("metadata"), dict):
+        ann = d["metadata"].get("annotations") or {}
+    else:
+        ann = d
+    payload = ann.get(POD_ASSIGNMENT)
+    return decode_assignment(payload) if payload else None

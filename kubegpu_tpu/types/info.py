@@ -1,0 +1,196 @@
+"""Node/Pod/Container bookkeeping records.
+
+Capability parity with the reference's ``NodeInfo``/``PodInfo``/
+``ContainerInfo`` (SURVEY.md §2 #1): a node carries capacity/allocatable/used
+grouped-resource trees; a pod carries per-container requests.  TPU deltas: a
+node also carries the *slice fragment* it owns (its chips with global mesh
+coordinates), and a pod may carry gang metadata (pod group + size) and a
+contiguity constraint — first-class here, bolted-on nowhere (SURVEY.md §7).
+
+"Multi-node without a cluster" (SURVEY.md §4): these are plain values,
+decodable from annotation strings, so whole scheduling scenarios are unit
+tests over fabricated NodeInfos.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kubegpu_tpu.types.resource import LEAF_TPU, ResourcePath, ResourceTree
+from kubegpu_tpu.types.topology import Chip, Coord, TpuGeneration
+
+
+@dataclass
+class NodeInfo:
+    """One Kubernetes node as the scheduler sees it."""
+
+    name: str
+    # TPU slice fragment owned by this host (empty for non-TPU nodes).
+    slice_id: Optional[str] = None
+    generation: Optional[TpuGeneration] = None
+    mesh_shape: Optional[Coord] = None
+    wrap: Optional[Tuple[bool, ...]] = None
+    chips: List[Chip] = field(default_factory=list)
+    # Grouped-resource bookkeeping (device resources only; cpu/mem stay with
+    # the default scheduler, as in the reference).
+    capacity: ResourceTree = field(default_factory=ResourceTree)
+    used: ResourceTree = field(default_factory=ResourceTree)
+
+    @property
+    def is_tpu_node(self) -> bool:
+        return bool(self.chips)
+
+    def allocatable(self) -> ResourceTree:
+        t = self.capacity.clone()
+        t.add_tree(self.used, sign=-1)
+        return t
+
+    def chip_path(self, chip: Chip) -> ResourcePath:
+        """Canonical grouped path for one chip's allocatable unit:
+        ``tpu-slice/<slice>/host/<node>/chip/<local-index>/tpu`` — the
+        slice→host→chip ownership encoding (resource.py docstring).  The host
+        level keeps paths cluster-globally unique so slice-wide aggregation
+        across NodeInfos cannot conflate chips; the leaf is the slash-free
+        LEAF_TPU (the k8s name RES_TPU contains '/', which is illegal in a
+        path segment)."""
+        return ResourcePath(
+            groups=(
+                ("tpu-slice", self.slice_id or "none"),
+                ("host", self.name),
+                ("chip", str(chip.device_index)),
+            ),
+            leaf=LEAF_TPU,
+        )
+
+    def rebuild_capacity(self) -> None:
+        """Capacity tree from the chip list: healthy chips only — dead chips
+        fall out of the allocatable set (SURVEY.md §5.3)."""
+        self.capacity = ResourceTree()
+        for ch in self.chips:
+            if ch.healthy:
+                path = self.chip_path(ch)
+                self.capacity.add(path, 1)
+                node = self.capacity
+                for kind, idx in path.groups:
+                    node = node.child(kind, idx)
+                node.meta["coords"] = ch.coords
+                node.meta["chip_id"] = ch.chip_id
+
+    def coords_by_device_index(self) -> Dict[int, Coord]:
+        return {ch.device_index: ch.coords for ch in self.chips}
+
+
+@dataclass
+class ContainerInfo:
+    name: str
+    tpu_chips: int = 0                      # scalar google.com/tpu request
+    grouped: Optional[ResourceTree] = None  # explicit grouped request (rare)
+
+
+@dataclass
+class PodInfo:
+    name: str
+    namespace: str = "default"
+    uid: str = ""
+    containers: List[ContainerInfo] = field(default_factory=list)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
+    priority: int = 0
+    node_name: Optional[str] = None
+    # Gang metadata (parsed from annotations by scheduler.podgroup).
+    pod_group: Optional[str] = None
+    pod_group_size: int = 1
+    require_contiguous: bool = True
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def total_tpu_chips(self) -> int:
+        return sum(c.tpu_chips for c in self.containers)
+
+
+@dataclass(frozen=True)
+class ChipRef:
+    """A concrete allocated chip: enough for both the CRI shim (host-local
+    device index) and observability (global coords)."""
+
+    host: str
+    device_index: int
+    chip_id: int
+    coords: Coord
+
+    def to_dict(self) -> dict:
+        return {
+            "host": self.host,
+            "device_index": self.device_index,
+            "chip_id": self.chip_id,
+            "coords": list(self.coords),
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "ChipRef":
+        return ChipRef(
+            host=str(d["host"]),
+            device_index=int(d["device_index"]),
+            chip_id=int(d["chip_id"]),
+            coords=tuple(int(x) for x in d["coords"]),
+        )
+
+
+@dataclass
+class Assignment:
+    """The bind-time decision for one pod, written into its annotations
+    (SURVEY.md §1 data-flow contract: state lives in the API server)."""
+
+    node: str
+    slice_id: Optional[str]
+    per_container: Dict[str, List[ChipRef]] = field(default_factory=dict)
+    score: float = 0.0
+
+    def all_chips(self) -> List[ChipRef]:
+        out: List[ChipRef] = []
+        for refs in self.per_container.values():
+            out.extend(refs)
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "node": self.node,
+            "slice_id": self.slice_id,
+            "score": self.score,
+            "per_container": {
+                c: [r.to_dict() for r in refs] for c, refs in self.per_container.items()
+            },
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "Assignment":
+        return Assignment(
+            node=str(d["node"]),
+            slice_id=d.get("slice_id"),
+            score=float(d.get("score", 0.0)),
+            per_container={
+                c: [ChipRef.from_dict(r) for r in refs]
+                for c, refs in d.get("per_container", {}).items()
+            },
+        )
+
+
+@dataclass
+class TpuRequest:
+    """A pod's device request, normalized for the allocator."""
+
+    total_chips: int
+    contiguous: bool = True
+    per_container: Dict[str, int] = field(default_factory=dict)
+
+    @staticmethod
+    def from_pod(pod: PodInfo) -> "TpuRequest":
+        per = {c.name: c.tpu_chips for c in pod.containers if c.tpu_chips > 0}
+        return TpuRequest(
+            total_chips=sum(per.values()),
+            contiguous=pod.require_contiguous,
+            per_container=per,
+        )

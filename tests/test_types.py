@@ -1,0 +1,245 @@
+"""L0 substrate tests: resource trees, topology geometry, annotation codecs."""
+
+import json
+
+import pytest
+
+from kubegpu_tpu.types import (
+    Chip,
+    NodeInfo,
+    PodInfo,
+    ResourcePath,
+    ResourceTree,
+    RES_TPU,
+    LEAF_TPU,
+    SliceTopology,
+    Submesh,
+    TpuGeneration,
+    annotations,
+    coords_bounding_box,
+    enumerate_rectangles,
+    is_contiguous_submesh,
+)
+from kubegpu_tpu.types.info import Assignment, ChipRef
+from kubegpu_tpu.types.topology import factor_shapes
+
+
+# -- ResourcePath -----------------------------------------------------------
+
+def test_path_roundtrip():
+    # 'google.com/tpu' contains a slash, so tree paths use the slash-free
+    # LEAF_TPU leaf; RES_TPU appears only in k8s specs.
+    p = ResourcePath.parse("tpu-slice/s0/chip/3/tpu")
+    assert str(p) == "tpu-slice/s0/chip/3/tpu"
+    assert p.groups == (("tpu-slice", "s0"), ("chip", "3"))
+    assert p.leaf == "tpu"
+
+
+def test_path_wildcard_match():
+    req = ResourcePath.parse("tpu-slice/*/chip/*/tpu")
+    con = ResourcePath.parse("tpu-slice/s0/chip/2/tpu")
+    other = ResourcePath.parse("tpu-slice/s0/host/2/tpu")
+    assert req.has_wildcard
+    assert req.matches(con)
+    assert not req.matches(other)
+
+
+def test_path_malformed():
+    with pytest.raises(ValueError):
+        ResourcePath.parse("a/b")  # even segment count
+    with pytest.raises(ValueError):
+        ResourcePath.parse("a//b")
+
+
+# -- ResourceTree -----------------------------------------------------------
+
+def test_tree_add_get_walk_deterministic():
+    t = ResourceTree()
+    for i in (2, 0, 1):
+        t.add(ResourcePath.parse(f"chip/{i}/tpu"), 1)
+    walked = [str(p) for p, _ in t.walk()]
+    assert walked == ["chip/0/tpu", "chip/1/tpu", "chip/2/tpu"]
+    assert t.get(ResourcePath.parse("chip/1/tpu")) == 1
+    assert t.total("tpu") == 3
+
+
+def test_tree_take_return_roundtrip():
+    cap = ResourceTree.from_flat({"chip/0/tpu": 1, "chip/1/tpu": 1})
+    used = ResourceTree.from_flat({"chip/0/tpu": 1})
+    avail = cap.clone()
+    avail.add_tree(used, sign=-1)
+    assert avail.to_flat() == {"chip/1/tpu": 1}
+    avail.add_tree(used, sign=1)
+    assert avail == cap
+    with pytest.raises(ValueError):
+        bad = ResourceTree.from_flat({"chip/5/tpu": 2})
+        avail.add_tree(bad, sign=-1)
+
+
+def test_tree_flat_roundtrip():
+    flat = {"tpu-slice/s0/chip/0/tpu": 1, "tpu-slice/s0/chip/1/tpu": 1}
+    t = ResourceTree.from_flat(flat)
+    assert t.to_flat() == flat
+
+
+# -- topology geometry ------------------------------------------------------
+
+def test_factor_shapes():
+    assert factor_shapes(4, 2) == [(1, 4), (2, 2), (4, 1)]
+    assert (2, 2, 2) in factor_shapes(8, 3)
+
+
+def test_enumerate_rectangles_v5e16():
+    rects = list(enumerate_rectangles(4, (4, 4)))
+    shapes = {r.shape for r in rects}
+    assert shapes == {(1, 4), (2, 2), (4, 1)}
+    # 2x2 has 3x3 origins, 1x4/4x1 have 4 each → 9 + 4 + 4
+    assert len(rects) == 17
+
+
+def test_enumerate_rectangles_wrap():
+    rects = list(enumerate_rectangles(4, (4, 4), wrap=(True, True)))
+    # wraparound: every origin is legal in dims where shape < extent; a
+    # full-extent dim has exactly one distinct origin.
+    # (1,4): 4×1, (2,2): 4×4, (4,1): 1×4 → 24
+    assert len(rects) == 24
+    sub = Submesh(origin=(3, 0), shape=(2, 2))
+    coords = sub.coords((4, 4), (True, True))
+    assert (0, 0) in coords and (3, 1) in coords
+
+
+def test_is_contiguous():
+    assert is_contiguous_submesh({(0, 0), (0, 1), (1, 0), (1, 1)}, (4, 4))
+    assert not is_contiguous_submesh({(0, 0), (0, 1), (1, 0), (2, 2)}, (4, 4))
+    assert not is_contiguous_submesh({(0, 0), (1, 1)}, (4, 4))
+    # L-shape of 4
+    assert not is_contiguous_submesh({(0, 0), (0, 1), (0, 2), (1, 0)}, (4, 4))
+    # wraparound rectangle on a torus
+    wrapped = {(3, 0), (3, 1), (0, 0), (0, 1)}
+    assert not is_contiguous_submesh(wrapped, (4, 4))
+    assert is_contiguous_submesh(wrapped, (4, 4), wrap=(True, False))
+
+
+def test_bounding_box():
+    origin, shape = coords_bounding_box({(1, 2), (2, 3)})
+    assert origin == (1, 2) and shape == (2, 2)
+
+
+# -- SliceTopology ----------------------------------------------------------
+
+def test_build_v5e16():
+    topo = SliceTopology.build("s0", TpuGeneration.V5E, (4, 4), host_block=(2, 2))
+    assert topo.num_chips == 16
+    assert len(topo.hosts()) == 4
+    for h in topo.hosts():
+        chips = topo.host_chips(h)
+        assert len(chips) == 4
+        assert [c.device_index for c in chips] == [0, 1, 2, 3]
+        # each host's block is itself contiguous
+        assert is_contiguous_submesh({c.coords for c in chips}, (4, 4))
+
+
+def test_build_with_unhealthy():
+    topo = SliceTopology.build(
+        "s0", TpuGeneration.V5E, (4, 4), host_block=(2, 2), unhealthy=[(0, 0)]
+    )
+    assert len(topo.healthy_coords()) == 15
+
+
+def test_topology_dict_roundtrip():
+    topo = SliceTopology.build("s0", TpuGeneration.V5E, (4, 4), host_block=(2, 2))
+    topo2 = SliceTopology.from_dict(json.loads(json.dumps(topo.to_dict())))
+    assert topo2.mesh_shape == (4, 4)
+    assert topo2.chips == topo.chips
+
+
+# -- NodeInfo / annotations -------------------------------------------------
+
+def _node_from_slice(topo: SliceTopology, host: str) -> NodeInfo:
+    node = NodeInfo(
+        name=host,
+        slice_id=topo.slice_id,
+        generation=topo.generation,
+        mesh_shape=topo.mesh_shape,
+        wrap=topo.wrap,
+        chips=topo.host_chips(host),
+    )
+    node.rebuild_capacity()
+    return node
+
+
+def test_nodeinfo_capacity_excludes_unhealthy():
+    topo = SliceTopology.build(
+        "s0", TpuGeneration.V5E, (4, 4), host_block=(2, 2), unhealthy=[(0, 0)]
+    )
+    host = topo.chips[(0, 0)].host_id
+    node = _node_from_slice(topo, host)
+    assert node.capacity.total(LEAF_TPU) == 3
+    assert node.allocatable().total(LEAF_TPU) == 3
+    # wire-format regression: capacity trees must round-trip through flat form
+    assert ResourceTree.from_flat(node.capacity.to_flat()) == node.capacity
+
+
+def test_node_annotation_roundtrip():
+    topo = SliceTopology.build("s0", TpuGeneration.V5E, (4, 4), host_block=(2, 2))
+    host = topo.hosts()[0]
+    node = _node_from_slice(topo, host)
+    payload = annotations.encode_node_topology(node)
+    node2 = annotations.decode_node_topology(host, payload)
+    assert node2.slice_id == "s0"
+    assert node2.mesh_shape == (4, 4)
+    assert node2.chips == node.chips
+    assert node2.capacity.total(LEAF_TPU) == 4
+
+
+def test_pod_from_k8s_and_assignment_roundtrip():
+    obj = {
+        "metadata": {
+            "name": "w0",
+            "namespace": "ml",
+            "uid": "u1",
+            "annotations": {
+                annotations.POD_GROUP: "job-a",
+                annotations.POD_GROUP_SIZE: "4",
+                annotations.POD_CONTIGUOUS: "true",
+                annotations.POD_PRIORITY: "10",
+            },
+        },
+        "spec": {
+            "containers": [
+                {"name": "train", "resources": {"limits": {RES_TPU: "4"}}},
+                {"name": "sidecar"},
+            ]
+        },
+    }
+    pod = annotations.pod_from_k8s(obj)
+    assert pod.key == "ml/w0"
+    assert pod.total_tpu_chips() == 4
+    assert pod.pod_group == "job-a" and pod.pod_group_size == 4
+    assert pod.priority == 10
+    a = Assignment(
+        node="n0",
+        slice_id="s0",
+        per_container={"train": [ChipRef("n0", 0, 0, (0, 0)), ChipRef("n0", 1, 1, (0, 1))]},
+        score=1.5,
+    )
+    pod.annotations[annotations.POD_ASSIGNMENT] = annotations.encode_assignment(a)
+    a2 = annotations.assignment_from_pod(pod.annotations)
+    assert a2 is not None
+    assert a2.node == "n0" and len(a2.all_chips()) == 2
+    assert a2.per_container["train"][1].coords == (0, 1)
+
+
+def test_non_tpu_node_passthrough():
+    node = annotations.node_from_k8s({"metadata": {"name": "cpu-node"}})
+    assert not node.is_tpu_node
+    assert node.capacity.total(LEAF_TPU) == 0
+
+
+def test_assignment_from_annotation_map_with_metadata_key():
+    # a legal annotation literally named "metadata" must not derail the
+    # pod-object/annotation-map disambiguation
+    a = Assignment(node="n0", slice_id="s0", per_container={})
+    ann = {"metadata": "someval", annotations.POD_ASSIGNMENT: annotations.encode_assignment(a)}
+    got = annotations.assignment_from_pod(ann)
+    assert got is not None and got.node == "n0"
