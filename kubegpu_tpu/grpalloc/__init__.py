@@ -1,0 +1,37 @@
+"""L2 allocation core (SURVEY.md §2 #2-#3): pure fit/score/take/return logic.
+
+No I/O, no Kubernetes dependency — exhaustively unit-testable with fabricated
+topologies, exactly the property that made the reference's grpalloc its
+crown-jewel test target (SURVEY.md §4).
+"""
+
+from kubegpu_tpu.grpalloc.allocator import (
+    FitResult,
+    GangResult,
+    fit_gang,
+    pod_fits_group_constraints,
+    return_pod_resources,
+    take_pod_resources,
+)
+from kubegpu_tpu.grpalloc.scoring import placement_score
+from kubegpu_tpu.grpalloc.treefit import (
+    TreeFitResult,
+    expand_scalar_request,
+    fit_request_tree,
+)
+from kubegpu_tpu.grpalloc.view import SliceView, build_slice_views
+
+__all__ = [
+    "FitResult",
+    "GangResult",
+    "fit_gang",
+    "pod_fits_group_constraints",
+    "return_pod_resources",
+    "take_pod_resources",
+    "placement_score",
+    "TreeFitResult",
+    "expand_scalar_request",
+    "fit_request_tree",
+    "SliceView",
+    "build_slice_views",
+]

@@ -1,0 +1,358 @@
+"""The allocation core: fit, score, take/return — pure logic, no I/O.
+
+Capability parity with the reference's grpalloc (SURVEY.md §2 #2):
+``pod_fits_group_constraints`` (feasibility + best concrete placement + score
+per node), ``take_pod_resources``/``return_pod_resources`` (bookkeeping), plus
+what the reference lacked and the north star requires: ``fit_gang``
+(all-or-nothing multi-pod placement on one ICI-contiguous rectangle,
+SURVEY.md §7 stage 6).
+
+Hot loop shape (SURVEY.md §3.1): tree walk per (pod × node) is replaced by a
+subset scan over a host's ≤8 free chips (C(8,4)=70 candidates worst case) and
+a rectangle scan over the slice mesh (≤256 chips) — small, deterministic,
+exhaustive.  A C++ twin of the rectangle/subset scan lives in ``native/`` for
+large meshes; semantics are defined here and the twin is parity-tested.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from kubegpu_tpu.grpalloc.scoring import placement_score
+from kubegpu_tpu.grpalloc.view import SliceView
+from kubegpu_tpu.types.info import Assignment, ChipRef, NodeInfo, PodInfo, TpuRequest
+from kubegpu_tpu.types.resource import ResourceTree
+from kubegpu_tpu.types.topology import (
+    Coord,
+    enumerate_rectangles,
+    is_contiguous_submesh,
+)
+
+
+@dataclass
+class FitResult:
+    fits: bool
+    reason: str = ""
+    score: float = 0.0
+    assignment: Optional[Assignment] = None
+
+
+@dataclass
+class GangResult:
+    success: bool
+    reason: str = ""
+    score: float = 0.0
+    per_pod: Dict[str, Assignment] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Single-pod fit (one pod's chips always live on ONE node: a container can
+# only see its own host's chips — same constraint the reference had).
+# ---------------------------------------------------------------------------
+
+def _best_subset(
+    free_on_node: FrozenSet[Coord],
+    n: int,
+    view: SliceView,
+    require_contiguous: bool,
+) -> Tuple[Optional[FrozenSet[Coord]], float]:
+    """Exhaustively score all n-subsets of a host's free chips; return the
+    best (deterministic: ties broken by sorted coords)."""
+    best: Optional[Tuple[Coord, ...]] = None
+    best_score = -1.0
+    for combo in itertools.combinations(sorted(free_on_node), n):
+        cset = frozenset(combo)
+        if require_contiguous and not is_contiguous_submesh(cset, view.mesh_shape, view.wrap):
+            continue
+        s = placement_score(cset, view.free, view.mesh_shape, view.wrap)
+        # combinations over sorted input arrive in lexicographic order, so
+        # keeping the first strictly-better combo already breaks ties toward
+        # the smallest coord tuple → deterministic
+        if s > best_score:
+            best, best_score = combo, s
+    if best is None:
+        return None, -1.0
+    return frozenset(best), best_score
+
+
+def _split_containers(
+    chips: Sequence[ChipRef], request: TpuRequest
+) -> Dict[str, List[ChipRef]]:
+    """Deal the pod's chips out to its containers in spec order."""
+    ordered = sorted(chips, key=lambda r: (r.host, r.device_index))
+    out: Dict[str, List[ChipRef]] = {}
+    i = 0
+    for cname, cnt in request.per_container.items():
+        out[cname] = list(ordered[i : i + cnt])
+        i += cnt
+    return out
+
+
+def pod_fits_group_constraints(
+    node: NodeInfo,
+    request: TpuRequest,
+    view: Optional[SliceView] = None,
+) -> FitResult:
+    """Can this pod's device request be satisfied on this node, and if so,
+    which concrete chips and how good is that placement?
+
+    Mirrors the reference's PodFitsGroupConstraints semantics (SURVEY.md §2
+    #2) with the ICI scorer replacing tree-nesting affinity."""
+    if request.total_chips == 0:
+        # 0-device passthrough (BASELINE config 1): never blocks a pod.
+        return FitResult(fits=True, reason="no device request", score=0.0)
+    if not node.is_tpu_node:
+        return FitResult(fits=False, reason=f"node {node.name} advertises no TPU chips")
+    if view is None:
+        view = _single_node_view(node)
+    free = view.free_on_host(node.name)
+    if request.total_chips > len(free):
+        return FitResult(
+            fits=False,
+            reason=(
+                f"insufficient free chips on {node.name}: "
+                f"want {request.total_chips}, free {len(free)}"
+            ),
+        )
+    subset, score = _best_subset(free, request.total_chips, view, request.contiguous)
+    if subset is None:
+        return FitResult(
+            fits=False,
+            reason=(
+                f"no ICI-contiguous {request.total_chips}-chip placement free on "
+                f"{node.name} (set annotation kubegpu-tpu/contiguous=false to relax)"
+            ),
+        )
+    refs = [view.chips[c] for c in sorted(subset)]
+    assignment = Assignment(
+        node=node.name,
+        slice_id=view.slice_id,
+        per_container=_split_containers(refs, request),
+        score=score,
+    )
+    return FitResult(fits=True, score=score, assignment=assignment)
+
+
+def _single_node_view(node: NodeInfo) -> SliceView:
+    from kubegpu_tpu.grpalloc.view import build_slice_views
+
+    views = build_slice_views([node])
+    if node.slice_id in views:
+        return views[node.slice_id]
+    # non-TPU or malformed: empty view
+    return SliceView(slice_id=node.slice_id or "none", mesh_shape=(1,), wrap=(False,))
+
+
+# ---------------------------------------------------------------------------
+# Take / return bookkeeping (the reference's TakePodGroupResource twins,
+# SURVEY.md §2 #2): mutate the node's used-tree; SliceViews are derived.
+# ---------------------------------------------------------------------------
+
+def take_pod_resources(node: NodeInfo, assignment: Assignment) -> None:
+    """Commit an assignment against the node's used-tree.
+
+    Validates-then-mutates: raises ValueError (with NO state change) if any
+    chip is already taken — a second take of the same chips is a bind race
+    or a retry bug, and surfacing it here keeps the cache consistent
+    (SURVEY.md §7 hard part (c): serialize/detect bind races)."""
+    by_idx = {ch.device_index: ch for ch in node.chips}
+    mine = [r for r in assignment.all_chips() if r.host == node.name]
+    chips = []
+    for ref in mine:
+        ch = by_idx.get(ref.device_index)
+        if ch is None:
+            raise KeyError(f"node {node.name} has no chip index {ref.device_index}")
+        if node.used.get(node.chip_path(ch)) > 0:
+            raise ValueError(
+                f"chip {ref.device_index} on {node.name} already allocated "
+                f"(double-take / bind race)"
+            )
+        chips.append(ch)
+    for ch in chips:
+        node.used.add(node.chip_path(ch), 1)
+
+
+def return_pod_resources(node: NodeInfo, assignment: Assignment) -> None:
+    """Release an assignment.  Idempotent: chips already returned (or no
+    longer advertised) are skipped — return is cleanup and must be safe to
+    replay after a failed bind or a restart (SURVEY.md §3.1 failure
+    containment, §3.5 replay)."""
+    by_idx = {ch.device_index: ch for ch in node.chips}
+    for ref in assignment.all_chips():
+        if ref.host != node.name:
+            continue
+        ch = by_idx.get(ref.device_index)
+        if ch is None:
+            continue  # chip disappeared from advertisement; nothing to return
+        path = node.chip_path(ch)
+        if node.used.get(path) > 0:
+            single = ResourceTree()
+            single.add(path, 1)
+            node.used.add_tree(single, sign=-1)
+
+
+# ---------------------------------------------------------------------------
+# Gang fit: place N pods all-or-nothing on one contiguous rectangle.
+# ---------------------------------------------------------------------------
+
+def fit_gang(view: SliceView, pods: Sequence[PodInfo]) -> GangResult:
+    """All-or-nothing placement of a pod group onto ONE rectangular submesh.
+
+    Strategy (SURVEY.md §7 stage 2: exhaustive rectangle scan is fine at
+    these sizes): enumerate every free rectangle of the gang's total size,
+    highest placement score first; for each, bin-pack pods onto the hosts
+    owning the rectangle (first-fit decreasing); every pod's own chips must
+    be host-local and, if required, contiguous.  First rectangle that packs
+    wins.  Falls back to best-effort scatter only if every pod in the gang
+    relaxed contiguity."""
+    requests = {p.key: TpuRequest.from_pod(p) for p in pods}
+    total = sum(r.total_chips for r in requests.values())
+    if total == 0:
+        return GangResult(success=True, reason="no device request", score=0.0)
+    free = view.free
+    if total > len(free):
+        return GangResult(
+            success=False, reason=f"slice {view.slice_id}: want {total} chips, free {len(free)}"
+        )
+    max_host = max((len(view.free_on_host(h)) for h in view.hosts()), default=0)
+    for p in pods:
+        if requests[p.key].total_chips > max_host:
+            return GangResult(
+                success=False,
+                reason=(
+                    f"pod {p.key} wants {requests[p.key].total_chips} chips but no host "
+                    f"has more than {max_host} free (a pod cannot span hosts)"
+                ),
+            )
+
+    candidates = []
+    for rect in enumerate_rectangles(total, view.mesh_shape, view.wrap):
+        coords = rect.coords(view.mesh_shape, view.wrap)
+        if not coords <= free:
+            continue
+        s = placement_score(coords, free, view.mesh_shape, view.wrap)
+        candidates.append((s, sorted(coords), coords))
+    # deterministic: score desc, then lexicographic coords
+    candidates.sort(key=lambda t: (-t[0], t[1]))
+
+    for s, _, coords in candidates:
+        packed = _pack_rectangle(view, pods, requests, coords)
+        if packed is not None:
+            return GangResult(success=True, score=s, per_pod=packed)
+
+    if all(not requests[p.key].contiguous for p in pods if requests[p.key].total_chips):
+        packed = _pack_scatter(view, pods, requests)
+        if packed is not None:
+            score = placement_score(
+                frozenset(
+                    r.coords for a in packed.values() for r in a.all_chips()
+                ),
+                free,
+                view.mesh_shape,
+                view.wrap,
+            )
+            return GangResult(success=True, score=score, per_pod=packed)
+
+    return GangResult(
+        success=False,
+        reason=(
+            f"no ICI-contiguous {total}-chip rectangle packs gang of "
+            f"{len(pods)} pods on slice {view.slice_id}"
+        ),
+    )
+
+
+def _pack_rectangle(
+    view: SliceView,
+    pods: Sequence[PodInfo],
+    requests: Dict[str, TpuRequest],
+    rect_coords: FrozenSet[Coord],
+) -> Optional[Dict[str, Assignment]]:
+    """Bin-pack the gang's pods onto the hosts that own rect_coords."""
+    host_avail: Dict[str, set] = {}
+    for c in rect_coords:
+        host_avail.setdefault(view.chips[c].host, set()).add(c)
+    # first-fit decreasing over pod size; deterministic order
+    order = sorted(pods, key=lambda p: (-requests[p.key].total_chips, p.key))
+    out: Dict[str, Assignment] = {}
+    for pod in order:
+        req = requests[pod.key]
+        if req.total_chips == 0:
+            out[pod.key] = Assignment(node="", slice_id=view.slice_id)
+            continue
+        placed = False
+        for host in sorted(host_avail, key=lambda h: (len(host_avail[h]), h)):
+            avail = host_avail[host]
+            if len(avail) < req.total_chips:
+                continue
+            subset = _pick_pod_subset(avail, req, view)
+            if subset is None:
+                continue
+            refs = [view.chips[c] for c in sorted(subset)]
+            out[pod.key] = Assignment(
+                node=host,
+                slice_id=view.slice_id,
+                per_container=_split_containers(refs, req),
+                score=placement_score(subset, view.free, view.mesh_shape, view.wrap),
+            )
+            avail -= subset
+            placed = True
+            break
+        if not placed:
+            return None
+    return out
+
+
+def _pick_pod_subset(
+    avail: set, req: TpuRequest, view: SliceView
+) -> Optional[FrozenSet[Coord]]:
+    best = None
+    best_score = -1.0
+    for combo in itertools.combinations(sorted(avail), req.total_chips):
+        cset = frozenset(combo)
+        if req.contiguous and not is_contiguous_submesh(cset, view.mesh_shape, view.wrap):
+            continue
+        s = placement_score(cset, view.free, view.mesh_shape, view.wrap)
+        if s > best_score:
+            best, best_score = cset, s
+    return best
+
+
+def _pack_scatter(
+    view: SliceView, pods: Sequence[PodInfo], requests: Dict[str, TpuRequest]
+) -> Optional[Dict[str, Assignment]]:
+    """Relaxed fallback: greedy per-pod best placement, no global rectangle."""
+    remaining = set(view.free)
+    out: Dict[str, Assignment] = {}
+    order = sorted(pods, key=lambda p: (-requests[p.key].total_chips, p.key))
+    for pod in order:
+        req = requests[pod.key]
+        if req.total_chips == 0:
+            out[pod.key] = Assignment(node="", slice_id=view.slice_id)
+            continue
+        best = None
+        best_score = -1.0
+        best_host = None
+        for host in view.hosts():
+            avail = view.by_host[host] & frozenset(remaining)
+            if len(avail) < req.total_chips:
+                continue
+            subset = _pick_pod_subset(set(avail), req, view)
+            if subset is None:
+                continue
+            s = placement_score(subset, frozenset(remaining), view.mesh_shape, view.wrap)
+            if s > best_score:
+                best, best_score, best_host = subset, s, host
+        if best is None:
+            return None
+        refs = [view.chips[c] for c in sorted(best)]
+        out[pod.key] = Assignment(
+            node=best_host,
+            slice_id=view.slice_id,
+            per_container=_split_containers(refs, req),
+            score=best_score,
+        )
+        remaining -= best
+    return out

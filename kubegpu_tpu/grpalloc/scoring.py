@@ -1,0 +1,104 @@
+"""Placement scoring: what "good" means on an ICI mesh.
+
+Semantics (the TPU analog of the reference's NVLink-beats-PCIe ordering,
+SURVEY.md §4: "score ordering (NVLink-local beats cross-group)"):
+
+1. **Contiguity** — a chip set that is exactly a rectangular submesh gets the
+   full contiguity term; otherwise it is scored by packing density (n /
+   bounding-box volume), so tighter scatter still beats wide scatter.  XLA
+   collectives ride nearest-neighbor ICI links; a rectangle gives every
+   worker its ring.
+2. **Aspect** — among rectangles of equal size, prefer squarer ones (max
+   all-reduce bandwidth, shorter rings; a 2×2 beats a 1×4).
+3. **Anti-fragmentation** — prefer placements hugging mesh edges / used
+   regions (fewer exposed free neighbors), so the remaining free space stays
+   rectangular for the *next* job.  This is the packing-tension heuristic
+   SURVEY.md §7 calls out.
+
+Scores are 0–100 floats; the extender rescales to the k8s extender's 0–10
+priority range at the HTTP boundary.  All pure functions of (coords, mesh).
+"""
+
+from __future__ import annotations
+
+from typing import FrozenSet, Iterable, Optional, Tuple
+
+from kubegpu_tpu.types.topology import (
+    Coord,
+    coords_bounding_box,
+    is_contiguous_submesh,
+)
+
+W_CONTIG = 60.0
+W_ASPECT = 15.0
+W_FRAG = 25.0
+
+
+def neighbors(c: Coord, mesh_shape: Coord, wrap: Tuple[bool, ...]):
+    for d in range(len(c)):
+        for step in (-1, 1):
+            v = c[d] + step
+            if 0 <= v < mesh_shape[d]:
+                yield c[:d] + (v,) + c[d + 1 :]
+            elif wrap[d] and mesh_shape[d] > 2:
+                yield c[:d] + (v % mesh_shape[d],) + c[d + 1 :]
+
+
+def packing_density(coords: FrozenSet[Coord]) -> float:
+    """n / bounding-box volume ∈ (0, 1]; 1.0 iff exactly a rectangle."""
+    if not coords:
+        return 0.0
+    _, shape = coords_bounding_box(coords)
+    vol = 1
+    for s in shape:
+        vol *= s
+    return len(coords) / vol
+
+
+def aspect_score(coords: FrozenSet[Coord]) -> float:
+    """1.0 for a perfect hypercube bounding box, → 0 as it elongates."""
+    if not coords:
+        return 0.0
+    _, shape = coords_bounding_box(coords)
+    return min(shape) / max(shape)
+
+
+def frag_score(
+    coords: FrozenSet[Coord],
+    free: FrozenSet[Coord],
+    mesh_shape: Coord,
+    wrap: Tuple[bool, ...],
+) -> float:
+    """1 - (exposed free perimeter / max possible): placements that leave
+    fewer free cells touching the allocation fragment the mesh less."""
+    if not coords:
+        return 0.0
+    remaining_free = free - coords
+    exposed = 0
+    for c in coords:
+        for nb in neighbors(c, mesh_shape, wrap):
+            if nb in remaining_free:
+                exposed += 1
+    max_exposed = 2 * len(mesh_shape) * len(coords)
+    return 1.0 - exposed / max_exposed
+
+
+def placement_score(
+    coords: Iterable[Coord],
+    free: FrozenSet[Coord],
+    mesh_shape: Coord,
+    wrap: Optional[Tuple[bool, ...]] = None,
+) -> float:
+    """Total 0–100 score for allocating `coords` out of `free`."""
+    cset = frozenset(coords)
+    if not cset:
+        return 0.0
+    if wrap is None:
+        wrap = tuple(False for _ in mesh_shape)
+    contig = 1.0 if is_contiguous_submesh(cset, mesh_shape, wrap) else packing_density(cset)
+    score = (
+        W_CONTIG * contig
+        + W_ASPECT * aspect_score(cset)
+        + W_FRAG * frag_score(cset, free, mesh_shape, wrap)
+    )
+    return score

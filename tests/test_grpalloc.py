@@ -1,0 +1,372 @@
+"""Allocator core tests: fabricated v5e topologies, no cluster, no TPUs —
+the reference's crown-jewel test pattern (SURVEY.md §4)."""
+
+from typing import Dict, List
+
+import pytest
+
+from kubegpu_tpu.grpalloc import (
+    build_slice_views,
+    fit_gang,
+    fit_request_tree,
+    expand_scalar_request,
+    placement_score,
+    pod_fits_group_constraints,
+    return_pod_resources,
+    take_pod_resources,
+)
+from kubegpu_tpu.types import (
+    LEAF_TPU,
+    NodeInfo,
+    PodInfo,
+    ResourceTree,
+    SliceTopology,
+    TpuGeneration,
+    is_contiguous_submesh,
+)
+from kubegpu_tpu.types.info import ContainerInfo, TpuRequest
+
+
+def make_cluster(
+    mesh=(4, 4), host_block=(2, 2), unhealthy=(), slice_id="s0"
+) -> Dict[str, NodeInfo]:
+    topo = SliceTopology.build(
+        slice_id, TpuGeneration.V5E, mesh, host_block=host_block, unhealthy=unhealthy
+    )
+    nodes = {}
+    for h in topo.hosts():
+        n = NodeInfo(
+            name=h,
+            slice_id=slice_id,
+            generation=topo.generation,
+            mesh_shape=topo.mesh_shape,
+            wrap=topo.wrap,
+            chips=topo.host_chips(h),
+        )
+        n.rebuild_capacity()
+        nodes[h] = n
+    return nodes
+
+
+def make_pod(name, chips, contiguous=True, group=None, group_size=1) -> PodInfo:
+    return PodInfo(
+        name=name,
+        containers=[ContainerInfo(name="main", tpu_chips=chips)],
+        require_contiguous=contiguous,
+        pod_group=group,
+        pod_group_size=group_size,
+    )
+
+
+def req(pod: PodInfo) -> TpuRequest:
+    return TpuRequest.from_pod(pod)
+
+
+# -- single-pod fit ---------------------------------------------------------
+
+def test_zero_request_passthrough():
+    nodes = make_cluster()
+    n = next(iter(nodes.values()))
+    r = pod_fits_group_constraints(n, req(make_pod("p", 0)))
+    assert r.fits and r.assignment is None
+
+
+def test_zero_request_on_cpu_node():
+    r = pod_fits_group_constraints(NodeInfo(name="cpu-1"), req(make_pod("p", 0)))
+    assert r.fits
+
+
+def test_tpu_request_on_cpu_node_rejected():
+    r = pod_fits_group_constraints(NodeInfo(name="cpu-1"), req(make_pod("p", 1)))
+    assert not r.fits and "no TPU" in r.reason
+
+
+def test_whole_host_block_allocation():
+    nodes = make_cluster()
+    views = build_slice_views(nodes.values())
+    n = nodes[sorted(nodes)[0]]
+    r = pod_fits_group_constraints(n, req(make_pod("p", 4)), views["s0"])
+    assert r.fits
+    coords = {c.coords for c in r.assignment.all_chips()}
+    assert is_contiguous_submesh(coords, (4, 4))
+    assert len(coords) == 4
+    assert r.assignment.node == n.name
+    # 2x2 block: full contiguity + perfect aspect
+    assert r.score > 75
+
+
+def test_insufficient_chips_reason():
+    nodes = make_cluster()
+    n = nodes[sorted(nodes)[0]]
+    r = pod_fits_group_constraints(n, req(make_pod("p", 5)))
+    assert not r.fits and "insufficient" in r.reason
+
+
+def test_contiguity_constraint_enforced_and_relaxable():
+    nodes = make_cluster()
+    n = nodes[sorted(nodes)[0]]  # owns (0,0),(0,1),(1,0),(1,1)
+    views = build_slice_views(nodes.values())
+    view = views["s0"]
+    # occupy the diagonal so only (0,1),(1,0) remain — not adjacent
+    by_coord = {c.coords: c for c in n.chips}
+    fake_assignment_chips = [(0, 0), (1, 1)]
+    from kubegpu_tpu.types.info import Assignment, ChipRef
+
+    a = Assignment(
+        node=n.name,
+        slice_id="s0",
+        per_container={
+            "main": [
+                ChipRef(n.name, by_coord[c].device_index, by_coord[c].chip_id, c)
+                for c in fake_assignment_chips
+            ]
+        },
+    )
+    take_pod_resources(n, a)
+    views = build_slice_views(nodes.values())
+    r = pod_fits_group_constraints(n, req(make_pod("p", 2)), views["s0"])
+    assert not r.fits and "contiguous" in r.reason
+    r2 = pod_fits_group_constraints(n, req(make_pod("p", 2, contiguous=False)), views["s0"])
+    assert r2.fits
+    got = {c.coords for c in r2.assignment.all_chips()}
+    assert got == {(0, 1), (1, 0)}
+
+
+def test_score_prefers_square_over_line():
+    # the ICI analog of "NVLink-local beats cross-group" (SURVEY.md §4):
+    # a 2x2 placement outranks a 1x4 line of the same size
+    square = placement_score({(0, 0), (0, 1), (1, 0), (1, 1)}, frozenset(), (4, 4))
+    line = placement_score({(0, 0), (0, 1), (0, 2), (0, 3)}, frozenset(), (4, 4))
+    scatter = placement_score({(0, 0), (0, 2), (2, 0), (2, 2)}, frozenset(), (4, 4))
+    assert square > line > scatter
+
+
+def test_corner_preferred_over_center_for_fragmentation():
+    nodes = make_cluster(mesh=(4, 4), host_block=(4, 4))  # single host owns all 16
+    n = next(iter(nodes.values()))
+    views = build_slice_views(nodes.values())
+    r = pod_fits_group_constraints(n, req(make_pod("p", 4)), views["s0"])
+    assert r.fits
+    coords = {c.coords for c in r.assignment.all_chips()}
+    # best placement hugs a corner, not the center of the mesh
+    assert (0, 0) in coords or (3, 3) in coords or (0, 3) in coords or (3, 0) in coords
+
+
+def test_determinism():
+    nodes = make_cluster()
+    n = nodes[sorted(nodes)[0]]
+    views = build_slice_views(nodes.values())
+    r1 = pod_fits_group_constraints(n, req(make_pod("p", 2)), views["s0"])
+    r2 = pod_fits_group_constraints(n, req(make_pod("p", 2)), views["s0"])
+    assert [c.coords for c in r1.assignment.all_chips()] == [
+        c.coords for c in r2.assignment.all_chips()
+    ]
+
+
+# -- take / return ----------------------------------------------------------
+
+def test_take_return_roundtrip():
+    nodes = make_cluster()
+    n = nodes[sorted(nodes)[0]]
+    r = pod_fits_group_constraints(n, req(make_pod("p", 2)))
+    take_pod_resources(n, r.assignment)
+    assert n.allocatable().total(LEAF_TPU) == 2
+    views = build_slice_views(nodes.values())
+    assert len(views["s0"].free) == 14
+    return_pod_resources(n, r.assignment)
+    assert n.allocatable().total(LEAF_TPU) == 4
+    assert n.used.to_flat() == {}
+
+
+def test_double_take_rejected_atomically():
+    nodes = make_cluster()
+    n = nodes[sorted(nodes)[0]]
+    r = pod_fits_group_constraints(n, req(make_pod("p", 2)))
+    take_pod_resources(n, r.assignment)
+    with pytest.raises(ValueError, match="double-take|already allocated"):
+        take_pod_resources(n, r.assignment)
+    # no partial mutation: still exactly one take recorded
+    assert n.allocatable().total(LEAF_TPU) == 2
+
+
+def test_double_return_idempotent():
+    nodes = make_cluster()
+    n = nodes[sorted(nodes)[0]]
+    r = pod_fits_group_constraints(n, req(make_pod("p", 2)))
+    take_pod_resources(n, r.assignment)
+    return_pod_resources(n, r.assignment)
+    return_pod_resources(n, r.assignment)  # replay-safe cleanup
+    assert n.used.to_flat() == {} and n.allocatable().total(LEAF_TPU) == 4
+
+
+def test_unhealthy_chips_never_allocated():
+    nodes = make_cluster(unhealthy=[(0, 0), (0, 1)])
+    views = build_slice_views(nodes.values())
+    assert len(views["s0"].free) == 14
+    host = None
+    for h, n in nodes.items():
+        if any(not c.healthy for c in n.chips):
+            host = h
+    r = pod_fits_group_constraints(nodes[host], req(make_pod("p", 4)), views["s0"])
+    assert not r.fits  # only 2 healthy chips left on that host
+
+
+# -- gang fit ---------------------------------------------------------------
+
+def test_gang_four_singles_on_empty_slice():
+    nodes = make_cluster()
+    view = build_slice_views(nodes.values())["s0"]
+    pods = [make_pod(f"w{i}", 1, group="j", group_size=4) for i in range(4)]
+    g = fit_gang(view, pods)
+    assert g.success
+    coords = {r.coords for a in g.per_pod.values() for r in a.all_chips()}
+    assert len(coords) == 4
+    assert is_contiguous_submesh(coords, (4, 4))
+
+
+def test_gang_two_quads_spans_hosts():
+    nodes = make_cluster()
+    view = build_slice_views(nodes.values())["s0"]
+    pods = [make_pod(f"w{i}", 4, group="j", group_size=2) for i in range(2)]
+    g = fit_gang(view, pods)
+    assert g.success
+    all_coords = set()
+    for key, a in g.per_pod.items():
+        pod_coords = {r.coords for r in a.all_chips()}
+        # every pod's own chips must be host-local and contiguous
+        assert len({r.host for r in a.all_chips()}) == 1
+        assert is_contiguous_submesh(pod_coords, (4, 4))
+        all_coords |= pod_coords
+    assert len(all_coords) == 8
+    assert is_contiguous_submesh(all_coords, (4, 4))
+
+
+def test_gang_pod_too_big_for_any_host():
+    nodes = make_cluster()
+    view = build_slice_views(nodes.values())["s0"]
+    g = fit_gang(view, [make_pod("w0", 8, group="j")])
+    assert not g.success and "span hosts" in g.reason
+
+
+def test_gang_all_or_nothing_capacity():
+    nodes = make_cluster()
+    view = build_slice_views(nodes.values())["s0"]
+    pods = [make_pod(f"w{i}", 4, group="j", group_size=5) for i in range(5)]
+    g = fit_gang(view, pods)
+    assert not g.success and "want 20" in g.reason
+
+
+def test_gang_contiguous_blocked_by_holes_then_relaxed():
+    nodes = make_cluster()
+    # poke used holes so no 8-rectangle is free: occupy (1,1) and (2,2)
+    from kubegpu_tpu.types.info import Assignment, ChipRef
+
+    for hole in [(1, 1), (2, 2)]:
+        for n in nodes.values():
+            for ch in n.chips:
+                if ch.coords == hole:
+                    take_pod_resources(
+                        n,
+                        Assignment(
+                            node=n.name,
+                            slice_id="s0",
+                            per_container={"m": [ChipRef(n.name, ch.device_index, ch.chip_id, hole)]},
+                        ),
+                    )
+    view = build_slice_views(nodes.values())["s0"]
+    assert len(view.free) == 14
+    pods = [make_pod(f"w{i}", 4, group="j", group_size=2) for i in range(2)]
+    g = fit_gang(view, pods)
+    assert not g.success
+    relaxed = [make_pod(f"w{i}", 4, contiguous=False, group="j", group_size=2) for i in range(2)]
+    g2 = fit_gang(view, relaxed)
+    assert g2.success
+
+
+def test_two_sequential_gangs_fill_slice():
+    # BASELINE config 5 shape (without preemption): two 8-chip tenants
+    nodes = make_cluster()
+    for tenant in ("a", "b"):
+        view = build_slice_views(nodes.values())["s0"]
+        pods = [make_pod(f"{tenant}{i}", 4, group=tenant, group_size=2) for i in range(2)]
+        g = fit_gang(view, pods)
+        assert g.success, g.reason
+        for key, a in g.per_pod.items():
+            take_pod_resources(nodes[a.node], a)
+    view = build_slice_views(nodes.values())["s0"]
+    assert len(view.free) == 0
+    # a third tenant must be cleanly rejected
+    g3 = fit_gang(view, [make_pod("c0", 4, group="c")])
+    assert not g3.success
+
+
+def test_gang_zero_chip_pods():
+    nodes = make_cluster()
+    view = build_slice_views(nodes.values())["s0"]
+    g = fit_gang(view, [make_pod("w0", 0)])
+    assert g.success
+
+
+# -- generic tree fit (capability parity) -----------------------------------
+
+def test_treefit_wildcard():
+    alloc = ResourceTree.from_flat(
+        {
+            "grp/0/dev/0/cards": 1,
+            "grp/0/dev/1/cards": 1,
+            "grp/1/dev/0/cards": 1,
+        }
+    )
+    request = expand_scalar_request("cards", 2, "grp/*/dev/*/cards")
+    r = fit_request_tree(request, alloc)
+    assert r.fits
+    taken = r.bindings["grp/*/dev/*/cards"]
+    assert sum(q for _, q in taken) == 2
+
+
+def test_treefit_insufficient():
+    alloc = ResourceTree.from_flat({"grp/0/dev/0/cards": 1})
+    request = expand_scalar_request("cards", 3, "grp/*/dev/*/cards")
+    r = fit_request_tree(request, alloc)
+    assert not r.fits and "wants 3" in r.reason
+
+
+def test_treefit_concrete_path():
+    alloc = ResourceTree.from_flat({"grp/0/dev/0/cards": 2})
+    request = expand_scalar_request("cards", 2, "grp/0/dev/0/cards")
+    r = fit_request_tree(request, alloc)
+    assert r.fits
+
+
+def test_treefit_wildcard_must_not_starve_specific_request():
+    # regression (review finding): greedy matching rejected this satisfiable
+    # set — the wildcard must yield grp/0 to the concrete request and take
+    # grp/1 instead; max-flow finds it.
+    alloc = ResourceTree.from_flat(
+        {"grp/0/dev/0/cards": 1, "grp/0/dev/1/cards": 1, "grp/1/dev/0/cards": 2}
+    )
+    request = ResourceTree()
+    wild = expand_scalar_request("cards", 2, "grp/*/dev/*/cards")
+    specific = expand_scalar_request("cards", 2, "grp/0/dev/*/cards")
+    for src in (wild, specific):
+        for p, q in src.walk():
+            node = request
+            for kind, idx in p.groups:
+                node = node.child(kind, idx, create=True)
+            node.leaves[p.leaf] = node.leaves.get(p.leaf, 0) + q
+    r = fit_request_tree(request, alloc)
+    assert r.fits, r.reason
+    specific_bindings = r.bindings["grp/0/dev/*/cards"]
+    assert sum(q for _, q in specific_bindings) == 2
+    assert all(path.startswith("grp/0/") for path, _ in specific_bindings)
+
+
+def test_slice_view_skips_wrap_disagreement():
+    nodes = make_cluster()
+    rogue = nodes[sorted(nodes)[0]]
+    rogue.wrap = (True, True)  # misconfigured advertiser
+    views = build_slice_views(nodes.values())
+    v = views["s0"]
+    # rogue host excluded; its 4 chips missing from the view
+    assert len(v.chips) == 12
+    assert rogue.name not in v.by_host
