@@ -1,0 +1,28 @@
+"""L1 device backends (SURVEY.md §2 #5-#7, #9): TPU providers, advertiser."""
+
+from kubegpu_tpu.plugins.provider import (
+    AllocateResponse,
+    ENV_ACCEL_TYPE,
+    ENV_TOPOLOGY,
+    ENV_VISIBLE_CHIPS,
+    HostFragment,
+    TpuProvider,
+    visible_chips_env,
+)
+from kubegpu_tpu.plugins.fake import FakeSlice, FakeTpuProvider
+from kubegpu_tpu.plugins.discovery import GkeTpuProvider
+from kubegpu_tpu.plugins.advertiser import Advertiser
+
+__all__ = [
+    "AllocateResponse",
+    "ENV_ACCEL_TYPE",
+    "ENV_TOPOLOGY",
+    "ENV_VISIBLE_CHIPS",
+    "HostFragment",
+    "TpuProvider",
+    "visible_chips_env",
+    "FakeSlice",
+    "FakeTpuProvider",
+    "GkeTpuProvider",
+    "Advertiser",
+]

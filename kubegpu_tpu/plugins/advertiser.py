@@ -1,0 +1,71 @@
+"""Node advertiser daemon: publish this host's TPU fragment to the cluster.
+
+Capability parity with the reference's advertise loop (SURVEY.md §2 #9,
+§3.2): periodically (re-)enumerate devices, fold in a fresh health probe, and
+patch the node object — topology annotation + ``google.com/tpu`` extended
+resource capacity.  The scheduler watches nodes and rebuilds its cache from
+exactly this annotation, so a chip that dies here falls out of the
+allocatable set cluster-wide on the next cycle (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Optional
+
+from kubegpu_tpu.plugins.provider import TpuProvider
+from kubegpu_tpu.types import annotations
+from kubegpu_tpu.types.resource import RES_TPU
+from kubegpu_tpu.utils.apiserver import ApiServer
+
+log = logging.getLogger(__name__)
+
+
+class Advertiser:
+    def __init__(self, provider: TpuProvider, api: ApiServer, interval_s: float = 30.0) -> None:
+        self.provider = provider
+        self.api = api
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+
+    def advertise_once(self) -> Optional[str]:
+        """One advertisement cycle; returns the node name patched, or None
+        for a TPU-less host (clean no-op: BASELINE config 1 passthrough)."""
+        frag = self.provider.enumerate()
+        if frag is None:
+            return None
+        healthy = self.provider.healthy_device_indices()
+        if healthy is not None:
+            alive = set(healthy)
+            frag.chips = [
+                dataclasses.replace(ch, healthy=ch.healthy and ch.device_index in alive)
+                for ch in frag.chips
+            ]
+        node = frag.to_node_info()
+        n_healthy = sum(1 for ch in node.chips if ch.healthy)
+        self.api.patch_node_annotations(
+            node.name, {annotations.NODE_TOPOLOGY: annotations.encode_node_topology(node)}
+        )
+        self.api.patch_node_capacity(node.name, {RES_TPU: str(n_healthy)})
+        log.info(
+            "advertised %s: slice=%s chips=%d healthy=%d",
+            node.name,
+            node.slice_id,
+            len(node.chips),
+            n_healthy,
+        )
+        return node.name
+
+    def run(self) -> None:
+        """Blocking advertise loop (the DaemonSet entrypoint)."""
+        while not self._stop.is_set():
+            try:
+                self.advertise_once()
+            except Exception:  # noqa: BLE001 - daemon must survive API blips
+                log.exception("advertise cycle failed; will retry")
+            self._stop.wait(self.interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,254 @@
+"""GkeTpuProvider: discover this host's TPU fragment from the real machine.
+
+The TPU analog of the reference's NVML enumeration path (SURVEY.md §2 #6):
+where NVML answered "how many GPUs, how are they linked", a GKE/GCE TPU VM
+answers through (a) the TPU runtime environment variables the platform sets,
+(b) /dev/accel* (or /dev/vfio/*) device nodes, and (c) optionally the native
+libtpu shim (native/tpu_discovery) when present.  All inputs are injectable
+so discovery is unit-testable off-TPU (fake env + fake devfs), mirroring how
+the reference isolated NVML behind an interface.
+
+Known env on GKE TPU node pools (the fiddly contract SURVEY.md §7(d) warns
+about — verified against public GKE TPU docs' variable names; re-verify on a
+live cluster before relying on exotic combinations):
+  TPU_ACCELERATOR_TYPE  e.g. "v5litepod-16", "v4-8"
+  TPU_TOPOLOGY          e.g. "4x4" (v5e), "2x2x2" (v4/v5p)
+  TPU_WORKER_ID         this host's worker index within the slice, "0"..
+  TPU_WORKER_HOSTNAMES  comma-separated worker hostnames (rendezvous + a
+                        stable slice identity)
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import os
+import socket
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from kubegpu_tpu.plugins.provider import (
+    AllocateResponse,
+    ENV_ACCEL_TYPE,
+    ENV_TOPOLOGY,
+    ENV_VISIBLE_CHIPS,
+    HostFragment,
+    TpuProvider,
+    visible_chips_env,
+)
+from kubegpu_tpu.types.info import ChipRef
+from kubegpu_tpu.types.topology import Chip, Coord, TpuGeneration
+
+
+def parse_accelerator_type(s: str) -> Optional[Tuple[TpuGeneration, int]]:
+    """"v5litepod-16" → (V5E, 16); "v4-8" → (V4, 8 TensorCores = 4 chips —
+    v4/v5p accelerator types count cores, v5e/v6e count chips)."""
+    s = s.strip().lower()
+    if not s:
+        return None
+    head, _, tail = s.rpartition("-")
+    try:
+        n = int(tail)
+    except ValueError:
+        return None
+    if head in ("v5litepod", "v5e"):
+        return TpuGeneration.V5E, n
+    if head in ("v6e", "v6litepod"):
+        return TpuGeneration.V6E, n
+    if head == "v4":
+        return TpuGeneration.V4, n // 2
+    if head in ("v5p", "v5"):
+        return TpuGeneration.V5P, n // 2
+    return None
+
+
+def parse_topology(s: str) -> Optional[Coord]:
+    """"4x4" → (4,4); "2x2x4" → (2,2,4)."""
+    try:
+        dims = tuple(int(p) for p in s.strip().lower().split("x"))
+    except ValueError:
+        return None
+    return dims if dims and all(d > 0 for d in dims) else None
+
+
+def _block_shape(mesh_shape: Coord, num_hosts: int, chips_local: int) -> Optional[Coord]:
+    """Infer the per-host block shape: the factorization of chips_local that
+    tiles mesh_shape into exactly num_hosts blocks.  Prefers blocks that are
+    squarest (GKE v5e hosts own 2x2, v4 hosts own 2x2x1)."""
+    from kubegpu_tpu.types.topology import factor_shapes
+
+    candidates = []
+    for shape in factor_shapes(chips_local, len(mesh_shape)):
+        if any(mesh_shape[d] % shape[d] != 0 for d in range(len(mesh_shape))):
+            continue
+        blocks = 1
+        for d in range(len(mesh_shape)):
+            blocks *= mesh_shape[d] // shape[d]
+        if blocks == num_hosts:
+            candidates.append(shape)
+    if not candidates:
+        return None
+    return min(candidates, key=lambda sh: (max(sh) - min(sh), sh))
+
+
+class GkeTpuProvider(TpuProvider):
+    def __init__(
+        self,
+        env: Optional[Dict[str, str]] = None,
+        list_devfs: Optional[Callable[[], List[str]]] = None,
+        node_name: Optional[str] = None,
+    ) -> None:
+        self._env = dict(os.environ if env is None else env)
+        self._list_devfs = list_devfs or self._default_devfs
+        self._node_name = node_name or self._env.get("NODE_NAME") or socket.gethostname()
+
+    @staticmethod
+    def _default_devfs() -> List[str]:
+        return sorted(glob.glob("/dev/accel*")) or sorted(glob.glob("/dev/vfio/[0-9]*"))
+
+    def _device_map(self) -> Dict[int, str]:
+        """chip device_index -> device node path.
+
+        /dev/accelN encodes the chip index in the path, so a missing lower
+        node must NOT shift later chips (positional mapping would report the
+        wrong chip dead and hand containers a neighbour's device).  Paths
+        without a parseable accel index (vfio) are ranked by trailing number
+        numerically (lexicographic sort puts vfio/10 before vfio/2)."""
+        paths = self._list_devfs()
+        out: Dict[int, str] = {}
+        unnumbered: List[Tuple[int, str]] = []
+        for p in paths:
+            base = p.rsplit("/", 1)[-1]
+            if base.startswith("accel") and base[len("accel"):].isdigit():
+                out[int(base[len("accel"):])] = p
+            else:
+                digits = "".join(ch for ch in base if ch.isdigit())
+                unnumbered.append((int(digits) if digits else 0, p))
+        if not out and unnumbered:
+            for i, (_, p) in enumerate(sorted(unnumbered)):
+                out[i] = p
+        return out
+
+    # -- enumeration ------------------------------------------------------
+    def enumerate(self) -> Optional[HostFragment]:
+        acc = parse_accelerator_type(self._env.get("TPU_ACCELERATOR_TYPE", ""))
+        topo_dims = parse_topology(self._env.get("TPU_TOPOLOGY", ""))
+        if acc is None or topo_dims is None:
+            # CPU node: decided from env alone — no filesystem touched on
+            # the advertise hot loop
+            return None
+        generation, total_chips = acc
+        mesh_total = 1
+        for d in topo_dims:
+            mesh_total *= d
+        if mesh_total != total_chips:
+            # disagreeing platform env: trust the explicit topology
+            total_chips = mesh_total
+
+        hostnames = [
+            h for h in self._env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h.strip()
+        ]
+        num_hosts = max(len(hostnames), 1)
+        try:
+            worker_id = int(self._env.get("TPU_WORKER_ID", "0"))
+        except ValueError:
+            worker_id = 0
+        # Nominal chips per host comes from the platform (total/hosts); the
+        # devfs only tells us which of those are actually present.  A host
+        # with missing device nodes still advertises its full block with the
+        # missing chips marked unhealthy, so the slice geometry stays intact
+        # and the dead capacity falls out of the allocatable set
+        # (SURVEY.md §5.3) instead of the whole host vanishing.
+        if total_chips % num_hosts != 0:
+            return None
+        chips_local = total_chips // num_hosts
+        if chips_local <= 0:
+            return None
+        # chips whose device node is missing are advertised unhealthy; an
+        # empty devfs therefore advertises the block at zero capacity (a
+        # host with no working devices must not look fully healthy)
+        present = set(self._device_map())
+        block = _block_shape(topo_dims, num_hosts, chips_local)
+        if block is None:
+            return None
+
+        # slice identity: platform-provided name, else a stable digest of the
+        # worker hostname set (same on every host of the slice)
+        slice_id = self._env.get("TPU_NAME") or (
+            "slice-" + hashlib.sha1(",".join(sorted(hostnames)).encode()).hexdigest()[:8]
+            if hostnames
+            else "slice-local"
+        )
+
+        # worker_id rasters row-major across the host-block grid
+        grid = tuple(topo_dims[d] // block[d] for d in range(len(topo_dims)))
+        num_blocks = 1
+        for g in grid:
+            num_blocks *= g
+        if not (0 <= worker_id < num_blocks):
+            # advertising modulo-wrapped coords would collide with another
+            # host's chips and corrupt the slice view — refuse instead
+            return None
+        host_coord = []
+        rem = worker_id
+        for d in reversed(range(len(grid))):
+            host_coord.append(rem % grid[d])
+            rem //= grid[d]
+        host_coord = tuple(reversed(host_coord))
+        origin = tuple(host_coord[d] * block[d] for d in range(len(block)))
+
+        chips: List[Chip] = []
+        local = 0
+        import itertools
+
+        strides = []
+        for d in range(len(topo_dims)):
+            s = 1
+            for d2 in range(d + 1, len(topo_dims)):
+                s *= topo_dims[d2]
+            strides.append(s)
+        for offs in itertools.product(*(range(b) for b in block)):
+            coords = tuple(origin[d] + offs[d] for d in range(len(block)))
+            chip_id = sum(coords[d] * strides[d] for d in range(len(coords)))
+            chips.append(
+                Chip(
+                    coords=coords,
+                    chip_id=chip_id,
+                    host_id=self._node_name,
+                    device_index=local,
+                    healthy=local in present,
+                )
+            )
+            local += 1
+        return HostFragment(
+            node_name=self._node_name,
+            slice_id=slice_id,
+            generation=generation,
+            mesh_shape=topo_dims,
+            wrap=tuple(False for _ in topo_dims),
+            chips=chips,
+        )
+
+    # -- allocate ---------------------------------------------------------
+    def allocate(self, chips: Sequence[ChipRef]) -> AllocateResponse:
+        env = {ENV_VISIBLE_CHIPS: visible_chips_env(chips)}
+        if self._env.get("TPU_ACCELERATOR_TYPE"):
+            env[ENV_ACCEL_TYPE] = self._env["TPU_ACCELERATOR_TYPE"]
+        if self._env.get("TPU_TOPOLOGY"):
+            env[ENV_TOPOLOGY] = self._env["TPU_TOPOLOGY"]
+        dev_map = self._device_map()
+        wanted = sorted({c.device_index for c in chips})
+        missing = [i for i in wanted if i not in dev_map]
+        if missing:
+            # starting a container that believes it owns chips with no
+            # device node would fail obscurely inside libtpu — fail loudly
+            # at injection time instead (the CRI shim surfaces this as a
+            # CreateContainer error and the pod reschedules)
+            raise ValueError(
+                f"allocated chip indices {missing} have no device node "
+                f"(present: {sorted(dev_map)})"
+            )
+        devices = [dev_map[i] for i in wanted]
+        return AllocateResponse(env=env, devices=devices, mounts=[])
+
+    def healthy_device_indices(self) -> Optional[List[int]]:
+        return sorted(self._device_map())

@@ -1,0 +1,109 @@
+"""FakeTpuProvider: configurable in-memory TPU backend for tests/benchmarks.
+
+Mirrors the reference's fake-NVML pattern (SURVEY.md §4): a full v5e/v4 slice
+is fabricated in memory; each FakeTpuProvider instance impersonates ONE host
+of it.  Supports failure injection (kill/revive chips at runtime) so cache
+refresh and health-driven reallocation are testable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from kubegpu_tpu.plugins.provider import (
+    AllocateResponse,
+    ENV_ACCEL_TYPE,
+    ENV_TOPOLOGY,
+    ENV_VISIBLE_CHIPS,
+    HostFragment,
+    TpuProvider,
+    visible_chips_env,
+)
+from kubegpu_tpu.types.info import ChipRef
+from kubegpu_tpu.types.topology import Coord, SliceTopology, TpuGeneration
+
+
+class FakeSlice:
+    """A fabricated slice shared by the FakeTpuProviders of its hosts."""
+
+    def __init__(
+        self,
+        slice_id: str = "fake-slice",
+        generation: TpuGeneration = TpuGeneration.V5E,
+        mesh_shape: Coord = (4, 4),
+        host_block: Coord = (2, 2),
+        wrap: Optional[Tuple[bool, ...]] = None,
+    ) -> None:
+        self.topology = SliceTopology.build(
+            slice_id, generation, mesh_shape, host_block=host_block, wrap=wrap
+        )
+        self.dead: Set[Coord] = set()
+
+    def kill_chip(self, coords: Coord) -> None:
+        if coords not in self.topology.chips:
+            raise KeyError(f"no chip at {coords}")
+        self.dead.add(coords)
+
+    def revive_chip(self, coords: Coord) -> None:
+        self.dead.discard(coords)
+
+    def hosts(self) -> List[str]:
+        return self.topology.hosts()
+
+    def provider_for(self, host: str) -> "FakeTpuProvider":
+        return FakeTpuProvider(self, host)
+
+    def providers(self) -> Dict[str, "FakeTpuProvider"]:
+        return {h: self.provider_for(h) for h in self.hosts()}
+
+
+class FakeTpuProvider(TpuProvider):
+    def __init__(self, fake_slice: FakeSlice, host: str) -> None:
+        self._slice = fake_slice
+        self._host = host
+
+    def enumerate(self) -> Optional[HostFragment]:
+        topo = self._slice.topology
+        chips = []
+        for ch in topo.host_chips(self._host):
+            chips.append(
+                dataclasses.replace(
+                    ch, healthy=ch.healthy and ch.coords not in self._slice.dead
+                )
+            )
+        if not chips:
+            return None
+        return HostFragment(
+            node_name=self._host,
+            slice_id=topo.slice_id,
+            generation=topo.generation,
+            mesh_shape=topo.mesh_shape,
+            wrap=topo.wrap,
+            chips=chips,
+        )
+
+    def allocate(self, chips: Sequence[ChipRef]) -> AllocateResponse:
+        topo = self._slice.topology
+        mesh = "x".join(str(d) for d in topo.mesh_shape)
+        # v4/v5p accelerator types count TensorCores (2 per chip); v5e/v6e
+        # count chips — keep the fake's env round-trippable through
+        # discovery.parse_accelerator_type
+        cores_per_chip = 2 if topo.generation in (TpuGeneration.V4, TpuGeneration.V5P) else 1
+        return AllocateResponse(
+            env={
+                ENV_VISIBLE_CHIPS: visible_chips_env(chips),
+                ENV_ACCEL_TYPE: f"{topo.generation.value}-{topo.num_chips * cores_per_chip}",
+                ENV_TOPOLOGY: mesh,
+            },
+            devices=[f"/dev/accel{c.device_index}" for c in sorted(chips, key=lambda r: r.device_index)],
+            mounts=[],
+        )
+
+    def healthy_device_indices(self) -> Optional[List[int]]:
+        topo = self._slice.topology
+        return [
+            ch.device_index
+            for ch in topo.host_chips(self._host)
+            if ch.healthy and ch.coords not in self._slice.dead
+        ]

@@ -1,0 +1,90 @@
+"""TpuProvider: the device-backend interface (L1).
+
+Capability parity with the reference's Device interface + NVML isolation
+(SURVEY.md §2 #6-#7): enumerate devices and topology, report health, and
+answer per-container ``Allocate`` with the env/device/mount injection set.
+Every hardware dependency sits behind this interface with an in-memory fake
+(SURVEY.md §4: the transferable test pattern), so the whole framework runs
+and tests without TPUs.
+
+Implementations:
+- ``fake.FakeTpuProvider`` — configurable mesh + failure injection.
+- ``discovery.GkeTpuProvider`` — real host discovery from the GKE TPU VM
+  environment (env vars + /dev/accel* device nodes + optional libtpu C shim).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from kubegpu_tpu.types.info import ChipRef, NodeInfo
+from kubegpu_tpu.types.topology import Chip, Coord, TpuGeneration
+
+# The device-visibility env var the CRI shim injects (BASELINE.json north
+# star names TPU_VISIBLE_CHIPS); libtpu reads it to restrict which of the
+# host's chips the container's process may claim.
+ENV_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
+ENV_ACCEL_TYPE = "TPU_ACCELERATOR_TYPE"
+ENV_TOPOLOGY = "TPU_TOPOLOGY"
+
+
+@dataclass
+class HostFragment:
+    """What one host contributes to a slice: the TPU analog of the
+    reference's per-node NVML device tree (SURVEY.md §3.2)."""
+
+    node_name: str
+    slice_id: str
+    generation: TpuGeneration
+    mesh_shape: Coord
+    wrap: Tuple[bool, ...]
+    chips: List[Chip] = field(default_factory=list)
+
+    def to_node_info(self) -> NodeInfo:
+        node = NodeInfo(
+            name=self.node_name,
+            slice_id=self.slice_id,
+            generation=self.generation,
+            mesh_shape=self.mesh_shape,
+            wrap=self.wrap,
+            chips=list(self.chips),
+        )
+        node.rebuild_capacity()
+        return node
+
+
+@dataclass
+class AllocateResponse:
+    """Injection set for one container (SURVEY.md §3.3): env vars, device
+    nodes, and mounts the CRI shim must add to the container config."""
+
+    env: Dict[str, str] = field(default_factory=dict)
+    devices: List[str] = field(default_factory=list)   # host /dev paths
+    mounts: List[Tuple[str, str]] = field(default_factory=list)  # (host, ctr)
+
+
+class TpuProvider:
+    """Device backend interface.  All methods must be side-effect free and
+    callable repeatedly (the advertiser polls enumerate/health)."""
+
+    def enumerate(self) -> Optional[HostFragment]:
+        """This host's chips with global slice coordinates; None when the
+        host has no TPUs (a CPU node)."""
+        raise NotImplementedError
+
+    def allocate(self, chips: Sequence[ChipRef]) -> AllocateResponse:
+        """Injection set granting a container exactly these host-local
+        chips."""
+        raise NotImplementedError
+
+    def healthy_device_indices(self) -> Optional[List[int]]:
+        """Fresh health probe; None = provider cannot probe (assume
+        enumerate()'s view)."""
+        return None
+
+
+def visible_chips_env(chips: Sequence[ChipRef]) -> str:
+    """Canonical TPU_VISIBLE_CHIPS value: comma-joined host-local indices,
+    sorted ascending (libtpu expects a stable, duplicate-free list)."""
+    return ",".join(str(i) for i in sorted({c.device_index for c in chips}))

@@ -1,0 +1,11 @@
+"""L0 utilities (SURVEY.md §2 #10): API-server clients, logging helpers."""
+
+from kubegpu_tpu.utils.apiserver import (
+    ApiServer,
+    Conflict,
+    InMemoryApiServer,
+    KubeApiServer,
+    NotFound,
+)
+
+__all__ = ["ApiServer", "Conflict", "InMemoryApiServer", "KubeApiServer", "NotFound"]

@@ -1,0 +1,282 @@
+"""API-server client abstraction: the framework's only stateful boundary.
+
+The reference talks to the Kubernetes API server via client-go informers and
+writes (SURVEY.md §3.1/§3.2); every component is stateless because node/pod
+annotations ARE the durable state.  Same split here: an ``ApiServer``
+interface small enough to fake in-memory (the test strategy SURVEY.md §4
+calls the transferable pattern: every cluster dependency behind an interface
+with an in-memory fake), plus a thin real client for in-cluster use.
+
+The in-memory fake is also the engine of the simulated e2e benchmark.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import ssl
+import threading
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+
+class Conflict(Exception):
+    """Optimistic-concurrency conflict (e.g. binding an already-bound pod)."""
+
+
+class NotFound(Exception):
+    pass
+
+
+class ApiServer:
+    """Minimal surface the framework needs; see InMemoryApiServer for the
+    reference semantics."""
+
+    # nodes
+    def list_nodes(self) -> List[dict]:
+        raise NotImplementedError
+
+    def get_node(self, name: str) -> dict:
+        raise NotImplementedError
+
+    def patch_node_annotations(self, name: str, ann: Dict[str, str]) -> None:
+        raise NotImplementedError
+
+    def patch_node_capacity(self, name: str, capacity: Dict[str, str]) -> None:
+        raise NotImplementedError
+
+    # pods
+    def list_pods(self, namespace: Optional[str] = None) -> List[dict]:
+        raise NotImplementedError
+
+    def get_pod(self, namespace: str, name: str) -> dict:
+        raise NotImplementedError
+
+    def create_pod(self, obj: dict) -> dict:
+        raise NotImplementedError
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        raise NotImplementedError
+
+    def patch_pod_annotations(self, namespace: str, name: str, ann: Dict[str, str]) -> None:
+        raise NotImplementedError
+
+    def bind_pod(self, namespace: str, name: str, node: str) -> None:
+        raise NotImplementedError
+
+
+class InMemoryApiServer(ApiServer):
+    """Thread-safe fake with the semantics the scheduler relies on:
+    bind is exactly-once (second bind → Conflict), annotation patches merge,
+    and an observer hook lets tests/benchmarks watch mutations (the moral
+    equivalent of a k8s watch)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._nodes: Dict[str, dict] = {}
+        self._pods: Dict[str, dict] = {}
+        self._observers: List[Callable[[str, dict], None]] = []
+
+    # -- helpers ----------------------------------------------------------
+    @staticmethod
+    def _key(namespace: str, name: str) -> str:
+        return f"{namespace}/{name}"
+
+    def observe(self, fn: Callable[[str, dict], None]) -> None:
+        """fn(event, obj) with event in {node-updated, pod-created,
+        pod-updated, pod-bound, pod-deleted}."""
+        with self._lock:
+            self._observers.append(fn)
+
+    def _emit(self, event: str, obj: dict) -> None:
+        for fn in list(self._observers):
+            fn(event, copy.deepcopy(obj))
+
+    # -- nodes ------------------------------------------------------------
+    def add_node(self, obj: dict) -> None:
+        with self._lock:
+            name = obj["metadata"]["name"]
+            self._nodes[name] = copy.deepcopy(obj)
+            self._emit("node-updated", self._nodes[name])
+
+    def list_nodes(self) -> List[dict]:
+        with self._lock:
+            return [copy.deepcopy(n) for n in self._nodes.values()]
+
+    def get_node(self, name: str) -> dict:
+        with self._lock:
+            if name not in self._nodes:
+                raise NotFound(f"node {name}")
+            return copy.deepcopy(self._nodes[name])
+
+    def patch_node_annotations(self, name: str, ann: Dict[str, str]) -> None:
+        with self._lock:
+            node = self._nodes.setdefault(name, {"metadata": {"name": name}})
+            meta = node.setdefault("metadata", {})
+            meta.setdefault("annotations", {}).update(ann)
+            self._emit("node-updated", node)
+
+    def patch_node_capacity(self, name: str, capacity: Dict[str, str]) -> None:
+        with self._lock:
+            node = self._nodes.setdefault(name, {"metadata": {"name": name}})
+            status = node.setdefault("status", {})
+            status.setdefault("capacity", {}).update(capacity)
+            status.setdefault("allocatable", {}).update(capacity)
+            self._emit("node-updated", node)
+
+    # -- pods -------------------------------------------------------------
+    def list_pods(self, namespace: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            return [
+                copy.deepcopy(p)
+                for p in self._pods.values()
+                if namespace is None or p["metadata"].get("namespace", "default") == namespace
+            ]
+
+    def get_pod(self, namespace: str, name: str) -> dict:
+        with self._lock:
+            k = self._key(namespace, name)
+            if k not in self._pods:
+                raise NotFound(f"pod {k}")
+            return copy.deepcopy(self._pods[k])
+
+    def create_pod(self, obj: dict) -> dict:
+        with self._lock:
+            meta = obj.setdefault("metadata", {})
+            meta.setdefault("namespace", "default")
+            k = self._key(meta["namespace"], meta["name"])
+            if k in self._pods:
+                raise Conflict(f"pod {k} exists")
+            self._pods[k] = copy.deepcopy(obj)
+            self._emit("pod-created", self._pods[k])
+            return copy.deepcopy(self._pods[k])
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            k = self._key(namespace, name)
+            pod = self._pods.pop(k, None)
+        if pod is not None:
+            self._emit("pod-deleted", pod)
+
+    def patch_pod_annotations(self, namespace: str, name: str, ann: Dict[str, str]) -> None:
+        with self._lock:
+            k = self._key(namespace, name)
+            if k not in self._pods:
+                raise NotFound(f"pod {k}")
+            meta = self._pods[k].setdefault("metadata", {})
+            meta.setdefault("annotations", {}).update(ann)
+            self._emit("pod-updated", self._pods[k])
+
+    def bind_pod(self, namespace: str, name: str, node: str) -> None:
+        with self._lock:
+            k = self._key(namespace, name)
+            if k not in self._pods:
+                raise NotFound(f"pod {k}")
+            spec = self._pods[k].setdefault("spec", {})
+            if spec.get("nodeName"):
+                raise Conflict(f"pod {k} already bound to {spec['nodeName']}")
+            spec["nodeName"] = node
+            self._emit("pod-bound", self._pods[k])
+
+
+class KubeApiServer(ApiServer):
+    """Thin in-cluster REST client (service-account token + CA bundle).
+
+    Capability parity with the reference's client-go usage (SURVEY.md §2 #4);
+    kept deliberately minimal — JSON over HTTPS with merge-patches and the
+    pods/binding subresource.  Not exercisable in this environment (no
+    cluster); the in-memory fake carries all test coverage."""
+
+    TOKEN = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+    CA = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
+
+    def __init__(self, base_url: Optional[str] = None) -> None:
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        self.base = base_url or f"https://{host}:{port}"
+        self._ctx = ssl.create_default_context(
+            cafile=self.CA if os.path.exists(self.CA) else None
+        )
+
+    def _token(self) -> str:
+        try:
+            with open(self.TOKEN) as f:
+                return f.read().strip()
+        except OSError:
+            return ""
+
+    def _req(self, method: str, path: str, body: Optional[dict] = None,
+             content_type: str = "application/json") -> dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(self.base + path, data=data, method=method)
+        req.add_header("Authorization", f"Bearer {self._token()}")
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        try:
+            with urllib.request.urlopen(req, context=self._ctx, timeout=30) as resp:
+                payload = resp.read()
+                return json.loads(payload) if payload else {}
+        except urllib.error.HTTPError as e:  # pragma: no cover - needs cluster
+            if e.code == 404:
+                raise NotFound(path)
+            if e.code == 409:
+                raise Conflict(path)
+            raise
+
+    def list_nodes(self) -> List[dict]:
+        return self._req("GET", "/api/v1/nodes").get("items", [])
+
+    def get_node(self, name: str) -> dict:
+        return self._req("GET", f"/api/v1/nodes/{name}")
+
+    def patch_node_annotations(self, name: str, ann: Dict[str, str]) -> None:
+        self._req(
+            "PATCH",
+            f"/api/v1/nodes/{name}",
+            {"metadata": {"annotations": ann}},
+            content_type="application/merge-patch+json",
+        )
+
+    def patch_node_capacity(self, name: str, capacity: Dict[str, str]) -> None:
+        self._req(
+            "PATCH",
+            f"/api/v1/nodes/{name}/status",
+            {"status": {"capacity": capacity, "allocatable": capacity}},
+            content_type="application/merge-patch+json",
+        )
+
+    def list_pods(self, namespace: Optional[str] = None) -> List[dict]:
+        path = f"/api/v1/namespaces/{namespace}/pods" if namespace else "/api/v1/pods"
+        return self._req("GET", path).get("items", [])
+
+    def get_pod(self, namespace: str, name: str) -> dict:
+        return self._req("GET", f"/api/v1/namespaces/{namespace}/pods/{name}")
+
+    def create_pod(self, obj: dict) -> dict:
+        ns = obj.get("metadata", {}).get("namespace", "default")
+        return self._req("POST", f"/api/v1/namespaces/{ns}/pods", obj)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self._req("DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}")
+
+    def patch_pod_annotations(self, namespace: str, name: str, ann: Dict[str, str]) -> None:
+        self._req(
+            "PATCH",
+            f"/api/v1/namespaces/{namespace}/pods/{name}",
+            {"metadata": {"annotations": ann}},
+            content_type="application/merge-patch+json",
+        )
+
+    def bind_pod(self, namespace: str, name: str, node: str) -> None:
+        self._req(
+            "POST",
+            f"/api/v1/namespaces/{namespace}/pods/{name}/binding",
+            {
+                "apiVersion": "v1",
+                "kind": "Binding",
+                "metadata": {"name": name, "namespace": namespace},
+                "target": {"apiVersion": "v1", "kind": "Node", "name": node},
+            },
+        )

@@ -1,0 +1,224 @@
+"""L1 plugin tests: fake provider, GKE discovery (fake env/devfs), advertiser."""
+
+import json
+
+from kubegpu_tpu.grpalloc import build_slice_views
+from kubegpu_tpu.plugins import (
+    Advertiser,
+    ENV_VISIBLE_CHIPS,
+    FakeSlice,
+    GkeTpuProvider,
+    visible_chips_env,
+)
+from kubegpu_tpu.types import RES_TPU, annotations
+from kubegpu_tpu.types.info import ChipRef
+from kubegpu_tpu.types.topology import TpuGeneration
+from kubegpu_tpu.plugins.discovery import parse_accelerator_type, parse_topology
+from kubegpu_tpu.utils import InMemoryApiServer
+
+
+# -- fake provider ----------------------------------------------------------
+
+def test_fake_provider_enumerate_and_allocate():
+    fs = FakeSlice(mesh_shape=(4, 4), host_block=(2, 2))
+    host = fs.hosts()[0]
+    prov = fs.provider_for(host)
+    frag = prov.enumerate()
+    assert frag is not None and len(frag.chips) == 4
+    node = frag.to_node_info()
+    assert node.capacity.total("tpu") == 4
+    chips = [ChipRef(host, ch.device_index, ch.chip_id, ch.coords) for ch in frag.chips[:2]]
+    resp = prov.allocate(chips)
+    assert resp.env[ENV_VISIBLE_CHIPS] == "0,1"
+    assert resp.devices == ["/dev/accel0", "/dev/accel1"]
+
+
+def test_fake_failure_injection():
+    fs = FakeSlice(mesh_shape=(4, 4), host_block=(2, 2))
+    victim = (0, 0)
+    host = fs.topology.chips[victim].host_id
+    fs.kill_chip(victim)
+    frag = fs.provider_for(host).enumerate()
+    healthy = [c for c in frag.chips if c.healthy]
+    assert len(healthy) == 3
+    fs.revive_chip(victim)
+    frag = fs.provider_for(host).enumerate()
+    assert all(c.healthy for c in frag.chips)
+
+
+def test_visible_chips_env_sorted_deduped():
+    refs = [ChipRef("h", 3, 3, (0, 0)), ChipRef("h", 1, 1, (0, 1)), ChipRef("h", 3, 3, (0, 0))]
+    assert visible_chips_env(refs) == "1,3"
+
+
+# -- GKE discovery ----------------------------------------------------------
+
+GKE_ENV_V5E16_W0 = {
+    "TPU_ACCELERATOR_TYPE": "v5litepod-16",
+    "TPU_TOPOLOGY": "4x4",
+    "TPU_WORKER_ID": "0",
+    "TPU_WORKER_HOSTNAMES": "job-0.svc,job-1.svc,job-2.svc,job-3.svc",
+    "NODE_NAME": "gke-node-0",
+}
+
+
+def fake_devfs4():
+    return ["/dev/accel0", "/dev/accel1", "/dev/accel2", "/dev/accel3"]
+
+
+def test_parse_helpers():
+    assert parse_accelerator_type("v5litepod-16") == (TpuGeneration.V5E, 16)
+    assert parse_accelerator_type("v4-8") == (TpuGeneration.V4, 4)
+    assert parse_accelerator_type("") is None
+    assert parse_accelerator_type("tpu") is None
+    assert parse_topology("4x4") == (4, 4)
+    assert parse_topology("2x2x2") == (2, 2, 2)
+    assert parse_topology("abc") is None
+
+
+def test_gke_discovery_worker0():
+    prov = GkeTpuProvider(env=GKE_ENV_V5E16_W0, list_devfs=fake_devfs4)
+    frag = prov.enumerate()
+    assert frag is not None
+    assert frag.generation == TpuGeneration.V5E
+    assert frag.mesh_shape == (4, 4)
+    assert len(frag.chips) == 4
+    assert frag.node_name == "gke-node-0"
+    # worker 0 owns the origin 2x2 block
+    assert {c.coords for c in frag.chips} == {(0, 0), (0, 1), (1, 0), (1, 1)}
+
+
+def test_gke_discovery_worker3_block_and_same_slice_id():
+    env3 = dict(GKE_ENV_V5E16_W0, TPU_WORKER_ID="3", NODE_NAME="gke-node-3")
+    frag0 = GkeTpuProvider(env=GKE_ENV_V5E16_W0, list_devfs=fake_devfs4).enumerate()
+    frag3 = GkeTpuProvider(env=env3, list_devfs=fake_devfs4).enumerate()
+    assert frag3.slice_id == frag0.slice_id  # same hostname set → same identity
+    assert {c.coords for c in frag3.chips} == {(2, 2), (2, 3), (3, 2), (3, 3)}
+    # fragments must tile without overlap
+    assert not ({c.coords for c in frag0.chips} & {c.coords for c in frag3.chips})
+
+
+def test_gke_discovery_all_workers_tile_slice():
+    coords = set()
+    for w in range(4):
+        env = dict(GKE_ENV_V5E16_W0, TPU_WORKER_ID=str(w), NODE_NAME=f"gke-node-{w}")
+        frag = GkeTpuProvider(env=env, list_devfs=fake_devfs4).enumerate()
+        coords |= {c.coords for c in frag.chips}
+    assert len(coords) == 16
+
+
+def test_gke_discovery_non_tpu_host():
+    prov = GkeTpuProvider(env={"PATH": "/usr/bin"}, list_devfs=lambda: [])
+    assert prov.enumerate() is None
+
+
+def test_gke_discovery_v4_3d():
+    env = {
+        "TPU_ACCELERATOR_TYPE": "v4-16",
+        "TPU_TOPOLOGY": "2x2x2",
+        "TPU_WORKER_ID": "1",
+        "TPU_WORKER_HOSTNAMES": "a,b",
+        "NODE_NAME": "n1",
+    }
+    frag = GkeTpuProvider(env=env, list_devfs=fake_devfs4).enumerate()
+    assert frag is not None
+    assert frag.mesh_shape == (2, 2, 2)
+    assert len(frag.chips) == 4
+
+
+def test_gke_discovery_degraded_devfs_marks_unhealthy():
+    # broken driver: platform says 4 chips/host, devfs shows 2 — the host
+    # must still advertise its full block, missing chips unhealthy
+    env = dict(GKE_ENV_V5E16_W0)
+    frag = GkeTpuProvider(env=env, list_devfs=lambda: ["/dev/accel0", "/dev/accel1"]).enumerate()
+    assert frag is not None and len(frag.chips) == 4
+    assert sum(1 for c in frag.chips if c.healthy) == 2
+
+
+def test_gke_discovery_out_of_range_worker_refused():
+    env = dict(GKE_ENV_V5E16_W0, TPU_WORKER_ID="9")
+    assert GkeTpuProvider(env=env, list_devfs=fake_devfs4).enumerate() is None
+
+
+def test_gke_allocate_missing_device_node_raises():
+    import pytest
+
+    prov = GkeTpuProvider(env=GKE_ENV_V5E16_W0, list_devfs=lambda: ["/dev/accel0"])
+    with pytest.raises(ValueError, match="no device node"):
+        prov.allocate([ChipRef("gke-node-0", 3, 3, (1, 1))])
+
+
+def test_gke_empty_devfs_advertises_zero_capacity():
+    # a host with no working device nodes must not look healthy
+    frag = GkeTpuProvider(env=GKE_ENV_V5E16_W0, list_devfs=lambda: []).enumerate()
+    assert frag is not None and len(frag.chips) == 4
+    assert sum(1 for c in frag.chips if c.healthy) == 0
+
+
+def test_gke_missing_low_device_does_not_shift_mapping():
+    # /dev/accel0 gone: chip 0 (not chip 3) must be the unhealthy one, and
+    # allocate(chip 2) must hand out /dev/accel2, not a neighbour's node
+    devfs = lambda: ["/dev/accel1", "/dev/accel2", "/dev/accel3"]
+    prov = GkeTpuProvider(env=GKE_ENV_V5E16_W0, list_devfs=devfs)
+    frag = prov.enumerate()
+    unhealthy = [c.device_index for c in frag.chips if not c.healthy]
+    assert unhealthy == [0]
+    resp = prov.allocate([ChipRef("gke-node-0", 2, 2, (1, 0))])
+    assert resp.devices == ["/dev/accel2"]
+    import pytest
+
+    with pytest.raises(ValueError):
+        prov.allocate([ChipRef("gke-node-0", 0, 0, (0, 0))])
+
+
+def test_fake_accel_type_roundtrips_for_v4():
+    from kubegpu_tpu.plugins.fake import FakeSlice
+
+    fs = FakeSlice(generation=TpuGeneration.V4, mesh_shape=(2, 2, 2), host_block=(2, 2, 1))
+    host = fs.hosts()[0]
+    prov = fs.provider_for(host)
+    frag = prov.enumerate()
+    chips = [ChipRef(host, c.device_index, c.chip_id, c.coords) for c in frag.chips[:1]]
+    resp = prov.allocate(chips)
+    gen, n_chips = parse_accelerator_type(resp.env["TPU_ACCELERATOR_TYPE"])
+    assert gen == TpuGeneration.V4 and n_chips == 8
+
+
+# -- advertiser -------------------------------------------------------------
+
+def test_advertiser_publishes_topology_and_capacity():
+    api = InMemoryApiServer()
+    fs = FakeSlice(mesh_shape=(4, 4), host_block=(2, 2))
+    for host, prov in fs.providers().items():
+        Advertiser(prov, api).advertise_once()
+    nodes = api.list_nodes()
+    assert len(nodes) == 4
+    infos = [annotations.node_from_k8s(n) for n in nodes]
+    views = build_slice_views(infos)
+    assert len(views) == 1
+    view = next(iter(views.values()))
+    assert len(view.free) == 16
+    for n in nodes:
+        assert n["status"]["capacity"][RES_TPU] == "4"
+
+
+def test_advertiser_health_propagates_to_cluster_view():
+    api = InMemoryApiServer()
+    fs = FakeSlice(mesh_shape=(4, 4), host_block=(2, 2))
+    advs = {h: Advertiser(p, api) for h, p in fs.providers().items()}
+    for a in advs.values():
+        a.advertise_once()
+    fs.kill_chip((0, 0))
+    victim_host = fs.topology.chips[(0, 0)].host_id
+    advs[victim_host].advertise_once()
+    infos = [annotations.node_from_k8s(n) for n in api.list_nodes()]
+    view = next(iter(build_slice_views(infos).values()))
+    assert len(view.free) == 15
+    assert api.get_node(victim_host)["status"]["capacity"][RES_TPU] == "3"
+
+
+def test_advertiser_noop_on_cpu_host():
+    api = InMemoryApiServer()
+    prov = GkeTpuProvider(env={}, list_devfs=lambda: [])
+    assert Advertiser(prov, api).advertise_once() is None
+    assert api.list_nodes() == []
