@@ -1,0 +1,17 @@
+"""L4 scheduling service (SURVEY.md §2 #4): cache, core verbs, gang
+registry, HTTP extender server."""
+
+from kubegpu_tpu.scheduler.cache import ClusterCache
+from kubegpu_tpu.scheduler.core import FilterResult, Scheduler
+from kubegpu_tpu.scheduler.podgroup import GangPlan, PodGroupRegistry
+from kubegpu_tpu.scheduler.server import ExtenderServer, build_fake_cluster
+
+__all__ = [
+    "ClusterCache",
+    "FilterResult",
+    "Scheduler",
+    "GangPlan",
+    "PodGroupRegistry",
+    "ExtenderServer",
+    "build_fake_cluster",
+]

@@ -1,0 +1,180 @@
+"""ClusterCache: the extender's only mutable state — rebuilt from the API
+server at any time.
+
+Parity with the reference's in-memory cluster cache (SURVEY.md §2 #4, §3.5):
+nodes' device trees decoded from annotations; in-flight + bound allocations
+replayed into per-node used-trees.  Because every allocation is durably
+recorded in pod annotations at bind time, a restarted scheduler calls
+``refresh()`` and is exactly where it left off — no database (§1 data-flow
+contract).  Thread-safe: filter/prioritize run concurrently; mutations are
+serialized (SURVEY.md §7 hard part (c))."""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from kubegpu_tpu.grpalloc import (
+    SliceView,
+    build_slice_views,
+    return_pod_resources,
+    take_pod_resources,
+)
+from kubegpu_tpu.types import annotations
+from kubegpu_tpu.types.info import Assignment, NodeInfo
+from kubegpu_tpu.utils.apiserver import ApiServer
+
+log = logging.getLogger(__name__)
+
+
+class ClusterCache:
+    def __init__(self, api: ApiServer) -> None:
+        self.api = api
+        self._lock = threading.RLock()
+        self._nodes: Dict[str, NodeInfo] = {}
+        # pod key -> Assignment, both bound (from annotations) and assumed
+        # (bind in flight); the used-trees of _nodes are derived from this
+        self._assignments: Dict[str, Assignment] = {}
+        # keys reserved in memory but not yet durably annotated (gang plans,
+        # binds in flight).  refresh() must carry these over — wiping them
+        # would let the resync loop double-allocate chips under a live plan.
+        self._assumed: set = set()
+
+    # -- building ---------------------------------------------------------
+    def refresh(self) -> None:
+        """Full rebuild from API-server state (startup + resync): decode
+        node annotations, then replay every scheduled pod's assignment
+        (SURVEY.md §3.5 — what makes restarts safe with no database)."""
+        nodes_raw = self.api.list_nodes()
+        pods_raw = self.api.list_pods()
+        with self._lock:
+            prev_assumed = {
+                k: self._assignments[k]
+                for k in self._assumed
+                if k in self._assignments
+            }
+            self._nodes = {}
+            self._assignments = {}
+            self._assumed = set()
+            for obj in nodes_raw:
+                try:
+                    node = annotations.node_from_k8s(obj)
+                except Exception:  # noqa: BLE001 - one bad annotation must not
+                    log.exception("ignoring undecodable node annotation")
+                    continue
+                self._nodes[node.name] = node
+            live_keys = set()
+            for obj in pods_raw:
+                meta = obj.get("metadata", {})
+                key = f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
+                live_keys.add(key)
+                try:
+                    a = annotations.assignment_from_pod(obj)
+                except Exception:  # noqa: BLE001
+                    log.exception("ignoring undecodable pod assignment")
+                    continue
+                if a is None:
+                    continue
+                self._replay(key, a)
+            # carry over in-flight reservations whose pods still exist and
+            # have not become durable yet
+            for key, a in prev_assumed.items():
+                if key in self._assignments or key not in live_keys:
+                    continue
+                try:
+                    node = self._nodes.get(a.node)
+                    if node is None:
+                        raise KeyError(f"unknown node {a.node}")
+                    take_pod_resources(node, a)
+                    self._assignments[key] = a
+                    self._assumed.add(key)
+                except (ValueError, KeyError) as e:
+                    log.warning("dropping stale reservation for %s: %s", key, e)
+
+    def _replay(self, key: str, a: Assignment) -> None:
+        node = self._nodes.get(a.node)
+        if node is None:
+            log.warning("assignment for %s names unknown node %s", key, a.node)
+            return
+        try:
+            take_pod_resources(node, a)
+        except (ValueError, KeyError) as e:
+            # chips vanished or double-booked while we were away; keep the
+            # pod's record but do not corrupt the tree
+            log.warning("replay of %s partially failed: %s", key, e)
+        self._assignments[key] = a
+
+    def update_node(self, obj: dict) -> None:
+        """Apply a node watch event: re-decode and re-apply the assignments
+        that live on it (a died chip falls out of capacity here)."""
+        try:
+            node = annotations.node_from_k8s(obj)
+        except Exception:  # noqa: BLE001
+            log.exception("ignoring undecodable node annotation")
+            return
+        with self._lock:
+            self._nodes[node.name] = node
+            for key, a in self._assignments.items():
+                if a.node == node.name:
+                    try:
+                        take_pod_resources(node, a)
+                    except (ValueError, KeyError) as e:
+                        log.warning("re-apply of %s on %s: %s", key, node.name, e)
+
+    def remove_pod(self, key: str) -> None:
+        """Pod deleted/finished: return its chips."""
+        with self._lock:
+            self._assumed.discard(key)
+            a = self._assignments.pop(key, None)
+            if a is None:
+                return
+            node = self._nodes.get(a.node)
+            if node is not None:
+                return_pod_resources(node, a)
+
+    # -- allocation bookkeeping -------------------------------------------
+    def assume(self, key: str, assignment: Assignment) -> None:
+        """Reserve an in-flight allocation.  Raises ValueError on any chip
+        already taken (bind race) with no state change."""
+        with self._lock:
+            if key in self._assignments:
+                raise ValueError(f"pod {key} already has an assignment")
+            node = self._nodes.get(assignment.node)
+            if node is None:
+                raise KeyError(f"unknown node {assignment.node}")
+            take_pod_resources(node, assignment)
+            self._assignments[key] = assignment
+            self._assumed.add(key)
+
+    def confirm(self, key: str) -> None:
+        """Mark a reservation durable (its assignment annotation is written):
+        refresh() will from now on rebuild it from the API server."""
+        with self._lock:
+            self._assumed.discard(key)
+
+    def forget(self, key: str) -> None:
+        """Undo a failed bind (SURVEY.md §3.1 failure containment)."""
+        self.remove_pod(key)
+
+    # -- queries ----------------------------------------------------------
+    def node(self, name: str) -> Optional[NodeInfo]:
+        with self._lock:
+            return self._nodes.get(name)
+
+    def node_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._nodes)
+
+    def views(self) -> Dict[str, SliceView]:
+        with self._lock:
+            return build_slice_views(self._nodes.values())
+
+    def assignment_of(self, key: str) -> Optional[Assignment]:
+        with self._lock:
+            return self._assignments.get(key)
+
+    @property
+    def lock(self) -> threading.RLock:
+        """Callers that must fit+assume atomically (bind) hold this."""
+        return self._lock

@@ -1,0 +1,269 @@
+"""Scheduler core: the filter / prioritize / bind verbs (SURVEY.md §3.1).
+
+Pure-ish orchestration over ClusterCache + grpalloc + PodGroupRegistry; the
+HTTP layer (server.py) is a thin codec around this so every scheduling
+behavior is testable without sockets (SURVEY.md §4 "multi-node without a
+cluster").
+
+Flow per verb:
+- filter: non-TPU pods pass everywhere (BASELINE config 1); gang pods pass
+  only on their plan's node (planning + reservation happen here); plain TPU
+  pods pass where pod_fits_group_constraints fits.
+- prioritize: placement score per node, rescaled to the extender's 0-10.
+- bind: re-fit under the cache lock (assume-then-commit), write the
+  assignment annotation, then the binding; any API failure rolls the
+  reservation back (SURVEY.md §3.1 failure containment).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kubegpu_tpu.grpalloc import pod_fits_group_constraints
+from kubegpu_tpu.scheduler.cache import ClusterCache
+from kubegpu_tpu.scheduler.podgroup import PodGroupRegistry
+from kubegpu_tpu.types import annotations
+from kubegpu_tpu.types.info import Assignment, PodInfo, TpuRequest
+from kubegpu_tpu.types.topology import is_contiguous_submesh
+from kubegpu_tpu.utils.apiserver import ApiServer, Conflict, NotFound
+from kubegpu_tpu.utils.metrics import Metrics, default_metrics
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class FilterResult:
+    nodes: List[str] = field(default_factory=list)
+    failed: Dict[str, str] = field(default_factory=dict)
+    error: str = ""
+
+
+class Scheduler:
+    def __init__(
+        self,
+        api: ApiServer,
+        cache: Optional[ClusterCache] = None,
+        metrics: Optional[Metrics] = None,
+        gang_plan_ttl_s: float = 120.0,
+    ) -> None:
+        self.api = api
+        self.cache = cache or ClusterCache(api)
+        self.groups = PodGroupRegistry(self.cache, plan_ttl_s=gang_plan_ttl_s)
+        self.metrics = metrics or default_metrics
+
+    # -- filter -----------------------------------------------------------
+    def filter(self, pod_obj: dict, node_names: List[str]) -> FilterResult:
+        t0 = time.monotonic()
+        try:
+            pod = annotations.pod_from_k8s(pod_obj)
+        except Exception as e:  # noqa: BLE001 - a malformed pod must not 500
+            return FilterResult(error=f"unparseable pod: {e}")
+        try:
+            result = self._filter(pod, node_names)
+            return result
+        finally:
+            self.metrics.inc("kubegpu_filter_total")
+            self.metrics.observe("kubegpu_filter_seconds", time.monotonic() - t0)
+
+    def _filter(self, pod: PodInfo, node_names: List[str]) -> FilterResult:
+        request = TpuRequest.from_pod(pod)
+        if request.total_chips == 0:
+            # 0-device passthrough: every node is fine by us
+            return FilterResult(nodes=list(node_names))
+
+        if pod.pod_group:
+            outcome = self.groups.plan_for(pod) or None
+            if outcome is None:
+                planned = self.groups.try_plan(pod)
+                if planned.plan is None:
+                    return FilterResult(
+                        failed={n: planned.reason for n in node_names},
+                        error="",
+                    )
+                outcome = planned.plan
+            target = outcome.per_pod[pod.key].node
+            failed = {n: f"gang plan places {pod.key} on {target}" for n in node_names if n != target}
+            nodes = [n for n in node_names if n == target]
+            if not nodes:
+                # planned node isn't in the candidate list (e.g. cordoned):
+                # drop the plan so the gang can re-plan elsewhere
+                self.groups.drop_plan(self.groups.group_key(pod))
+                return FilterResult(
+                    failed={n: f"planned node {target} not in candidate list" for n in node_names}
+                )
+            return FilterResult(nodes=nodes, failed=failed)
+
+        views = self.cache.views()
+        nodes, failed = [], {}
+        for name in node_names:
+            node = self.cache.node(name)
+            if node is None:
+                failed[name] = "node not in scheduler cache"
+                continue
+            view = views.get(node.slice_id) if node.slice_id else None
+            fit = pod_fits_group_constraints(node, request, view)
+            if fit.fits:
+                nodes.append(name)
+            else:
+                failed[name] = fit.reason
+        return FilterResult(nodes=nodes, failed=failed)
+
+    # -- prioritize -------------------------------------------------------
+    def prioritize(self, pod_obj: dict, node_names: List[str]) -> List[Tuple[str, int]]:
+        """(host, score 0-10) per extender API."""
+        t0 = time.monotonic()
+        try:
+            pod = annotations.pod_from_k8s(pod_obj)
+        except Exception:  # noqa: BLE001
+            return [(n, 0) for n in node_names]
+        try:
+            request = TpuRequest.from_pod(pod)
+            if request.total_chips == 0:
+                return [(n, 0) for n in node_names]
+            if pod.pod_group:
+                plan = self.groups.plan_for(pod)
+                target = plan.per_pod[pod.key].node if plan else None
+                return [(n, 10 if n == target else 0) for n in node_names]
+            views = self.cache.views()
+            out = []
+            for name in node_names:
+                node = self.cache.node(name)
+                if node is None:
+                    out.append((name, 0))
+                    continue
+                view = views.get(node.slice_id) if node.slice_id else None
+                fit = pod_fits_group_constraints(node, request, view)
+                out.append((name, round(fit.score / 10) if fit.fits else 0))
+            return out
+        finally:
+            self.metrics.inc("kubegpu_prioritize_total")
+            self.metrics.observe("kubegpu_prioritize_seconds", time.monotonic() - t0)
+
+    # -- bind -------------------------------------------------------------
+    def bind(self, namespace: str, name: str, node_name: str) -> Optional[str]:
+        """Commit placement; returns an error string or None on success."""
+        t0 = time.monotonic()
+        try:
+            return self._bind(namespace, name, node_name)
+        finally:
+            self.metrics.inc("kubegpu_bind_total")
+            self.metrics.observe("kubegpu_bind_seconds", time.monotonic() - t0)
+
+    def _bind(self, namespace: str, name: str, node_name: str) -> Optional[str]:
+        key = f"{namespace}/{name}"
+        try:
+            pod_obj = self.api.get_pod(namespace, name)
+        except NotFound:
+            return f"pod {key} not found"
+        try:
+            pod = annotations.pod_from_k8s(pod_obj)
+        except Exception as e:  # noqa: BLE001
+            return f"unparseable pod {key}: {e}"
+        request = TpuRequest.from_pod(pod)
+
+        assignment: Optional[Assignment] = None
+        reserved_here = False
+        gk = self.groups.group_key(pod)
+
+        if request.total_chips == 0:
+            assignment = None  # plain bind, no device commitment
+        elif gk is not None:
+            plan = self.groups.plan_for(pod)
+            if plan is not None and pod.key in plan.per_pod:
+                assignment = plan.per_pod[pod.key]
+            else:
+                # plan may have been dropped (fully committed) while the
+                # scheduler retries this bind: fall back to the live
+                # reservation
+                assignment = self.cache.assignment_of(key)
+                if assignment is None:
+                    return f"gang pod {key} has no live plan (re-run filter)"
+            if assignment.node != node_name:
+                return (
+                    f"gang plan places {key} on {assignment.node}, "
+                    f"but bind requested {node_name}"
+                )
+        else:
+            with self.cache.lock:
+                node = self.cache.node(node_name)
+                if node is None:
+                    return f"unknown node {node_name}"
+                view = self.cache.views().get(node.slice_id) if node.slice_id else None
+                fit = pod_fits_group_constraints(node, request, view)
+                if not fit.fits:
+                    self.metrics.inc("kubegpu_bind_conflicts_total")
+                    return f"no longer fits on {node_name}: {fit.reason}"
+                assignment = fit.assignment
+                try:
+                    self.cache.assume(key, assignment)
+                    reserved_here = True
+                except (ValueError, KeyError) as e:
+                    self.metrics.inc("kubegpu_bind_conflicts_total")
+                    return f"reservation race on {node_name}: {e}"
+
+        # durable commit: assignment annotation first, then the binding —
+        # a crash between the two leaves an annotated-unbound pod that
+        # refresh() replays correctly (state lives in the API server)
+        try:
+            if assignment is not None:
+                self.api.patch_pod_annotations(
+                    namespace,
+                    name,
+                    {annotations.POD_ASSIGNMENT: annotations.encode_assignment(assignment)},
+                )
+            self.api.bind_pod(namespace, name, node_name)
+        except (Conflict, NotFound, OSError) as e:
+            if reserved_here:
+                self.cache.forget(key)
+            if assignment is not None:
+                # clear the annotation for gang pods too: leaving it would
+                # let a later refresh() replay a ghost placement for a pod
+                # that never bound (stranding its chips)
+                try:
+                    self.api.patch_pod_annotations(
+                        namespace, name, {annotations.POD_ASSIGNMENT: ""}
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+            return f"bind of {key} to {node_name} failed: {e}"
+
+        if assignment is not None:
+            # annotation + binding both durable: refresh() now rebuilds this
+            # reservation from the API server
+            self.cache.confirm(key)
+        if gk is not None:
+            self.groups.mark_committed(key, gk)
+        if assignment is not None:
+            self._record_placement_metrics(assignment)
+        log.info("bound %s -> %s", key, node_name)
+        return None
+
+    def _record_placement_metrics(self, a: Assignment) -> None:
+        chips = a.all_chips()
+        if not chips:
+            return
+        node = self.cache.node(a.node)
+        contiguous = False
+        if node is not None and node.mesh_shape is not None:
+            coords = {c.coords for c in chips}
+            wrap = node.wrap or tuple(False for _ in node.mesh_shape)
+            contiguous = is_contiguous_submesh(coords, node.mesh_shape, wrap)
+        self.metrics.inc("kubegpu_placements_total")
+        if contiguous:
+            self.metrics.inc("kubegpu_placements_contiguous_total")
+        self.metrics.inc("kubegpu_chips_allocated_total", len(chips))
+
+    # -- lifecycle events -------------------------------------------------
+    def on_pod_deleted(self, pod_obj: dict) -> None:
+        try:
+            pod = annotations.pod_from_k8s(pod_obj)
+        except Exception:  # noqa: BLE001
+            return
+        self.cache.remove_pod(pod.key)
+        self.groups.on_pod_deleted(pod)
+
+    def on_node_updated(self, node_obj: dict) -> None:
+        self.cache.update_node(node_obj)

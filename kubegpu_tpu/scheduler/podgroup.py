@@ -1,0 +1,201 @@
+"""Gang scheduling: pod-group plans with all-or-nothing reservation.
+
+The scheduler-extender API is one-pod-at-a-time, which deadlocks naive gang
+placement (SURVEY.md §7 hard part (b): partial placements strand chips).
+Solution: when the FIRST member of a group reaches filter, gather the whole
+group from the API server, run ``grpalloc.fit_gang`` over a slice, and if it
+fits **reserve every member's chips in the cache immediately** (assume).
+Later members hit the existing plan; bind just confirms.  If the group never
+fully arrives or binds stall, the plan expires and its uncommitted
+reservations are returned — no leaked chips.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from kubegpu_tpu.grpalloc import fit_gang
+from kubegpu_tpu.scheduler.cache import ClusterCache
+from kubegpu_tpu.types import annotations
+from kubegpu_tpu.types.info import Assignment, PodInfo
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class GangPlan:
+    group: str                       # namespace/groupname
+    created: float
+    per_pod: Dict[str, Assignment]   # pod key -> assignment
+    committed: Set[str] = field(default_factory=set)
+    score: float = 0.0
+
+
+class PodGroupRegistry:
+    def __init__(self, cache: ClusterCache, plan_ttl_s: float = 120.0) -> None:
+        self.cache = cache
+        self.plan_ttl_s = plan_ttl_s
+        self._lock = threading.RLock()
+        self._plans: Dict[str, GangPlan] = {}
+
+    @staticmethod
+    def group_key(pod: PodInfo) -> Optional[str]:
+        if not pod.pod_group:
+            return None
+        return f"{pod.namespace}/{pod.pod_group}"
+
+    # -- plan lifecycle ---------------------------------------------------
+    def plan_for(self, pod: PodInfo, now: Optional[float] = None) -> Optional[GangPlan]:
+        """Return a live plan covering this pod, if any (expiring stale
+        plans on the way)."""
+        gk = self.group_key(pod)
+        if gk is None:
+            return None
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            plan = self._plans.get(gk)
+            if plan is None:
+                return None
+            if now - plan.created > self.plan_ttl_s and len(plan.committed) < len(plan.per_pod):
+                self._expire(gk, plan)
+                return None
+            return plan if pod.key in plan.per_pod else None
+
+    def _expire(self, gk: str, plan: GangPlan) -> None:
+        log.warning(
+            "gang plan %s expired with %d/%d committed; returning reservations",
+            gk,
+            len(plan.committed),
+            len(plan.per_pod),
+        )
+        for key in plan.per_pod:
+            if key not in plan.committed:
+                self.cache.forget(key)
+        del self._plans[gk]
+
+    def drop_plan(self, gk: str) -> None:
+        with self._lock:
+            plan = self._plans.pop(gk, None)
+            if plan:
+                for key in plan.per_pod:
+                    if key not in plan.committed:
+                        self.cache.forget(key)
+
+    def try_plan(self, pod: PodInfo, now: Optional[float] = None) -> "PlanOutcome":
+        """Gather the group, fit it, reserve it.  Called from filter when no
+        live plan covers the pod.
+
+        The (blocking) API-server LIST happens *before* the registry lock is
+        taken — one slow list must not stall every other gang's verbs — and
+        the plan covers only the group's still-unscheduled members, so a
+        partially-bound gang (or a deleted-and-recreated member) re-plans
+        the remainder instead of deadlocking on its own bound members."""
+        gk = self.group_key(pod)
+        assert gk is not None
+        members, scheduled = self._gather_members(pod)
+        with self._lock:
+            existing = self.plan_for(pod, now=now)
+            if existing:
+                return PlanOutcome(plan=existing)
+            if len(members) + len(scheduled) < pod.pod_group_size:
+                return PlanOutcome(
+                    reason=(
+                        f"gang {gk}: waiting for members "
+                        f"({len(members) + len(scheduled)}/{pod.pod_group_size} created)"
+                    )
+                )
+            want = pod.pod_group_size - len(scheduled)
+            members = sorted(members, key=lambda p: p.key)[:want]
+            if pod.key not in {p.key for p in members}:
+                # deterministic membership: first N by name; this pod lost
+                return PlanOutcome(
+                    reason=f"gang {gk}: pod {pod.key} not in first {pod.pod_group_size} members"
+                )
+            # fit on the best slice; cache lock held through reserve so the
+            # view cannot go stale under us
+            with self.cache.lock:
+                views = self.cache.views()
+                best = None
+                reasons = []
+                for sid in sorted(views):
+                    g = fit_gang(views[sid], members)
+                    if g.success and (best is None or g.score > best[1].score):
+                        best = (sid, g)
+                    elif not g.success:
+                        reasons.append(f"{sid}: {g.reason}")
+                if best is None:
+                    detail = "; ".join(reasons) if reasons else "no TPU slices advertised"
+                    return PlanOutcome(reason=f"gang {gk} does not fit: {detail}")
+                sid, g = best
+                taken = []
+                for key, a in g.per_pod.items():
+                    try:
+                        self.cache.assume(key, a)
+                        taken.append(key)
+                    except (ValueError, KeyError) as e:
+                        for k2 in taken:
+                            self.cache.forget(k2)
+                        return PlanOutcome(reason=f"gang {gk} reservation race: {e}")
+            plan = GangPlan(
+                group=gk,
+                created=time.monotonic() if now is None else now,
+                per_pod=dict(g.per_pod),
+                score=g.score,
+            )
+            self._plans[gk] = plan
+            log.info("gang %s planned on slice %s score=%.1f", gk, sid, g.score)
+            return PlanOutcome(plan=plan)
+
+    def _gather_members(self, pod: PodInfo):
+        """Group members split into (pending, already_scheduled).  A member
+        is scheduled if it is bound (spec.nodeName) or holds a reservation
+        in the cache — those keep their chips and are NOT re-planned."""
+        pending = {}
+        scheduled = {}
+        seen = {}
+        for obj in self.cache.api.list_pods(namespace=pod.namespace):
+            try:
+                p = annotations.pod_from_k8s(obj)
+            except Exception:  # noqa: BLE001 - malformed neighbours don't block
+                continue
+            if p.pod_group == pod.pod_group:
+                seen[p.key] = p
+        seen.setdefault(pod.key, pod)
+        for key, p in seen.items():
+            if p.node_name or (key != pod.key and self.cache.assignment_of(key) is not None):
+                scheduled[key] = p
+            else:
+                pending[key] = p
+        return list(pending.values()), list(scheduled.values())
+
+    def mark_committed(self, pod_key: str, group_key: str) -> None:
+        with self._lock:
+            plan = self._plans.get(group_key)
+            if plan and pod_key in plan.per_pod:
+                plan.committed.add(pod_key)
+                if plan.committed >= set(plan.per_pod):
+                    # fully bound: the plan has served its purpose; durable
+                    # state lives in pod annotations now.  Keeping it would
+                    # leak memory and hand stale placements to same-named
+                    # recreated pods.
+                    del self._plans[group_key]
+
+    def on_pod_deleted(self, pod: PodInfo) -> None:
+        gk = self.group_key(pod)
+        if gk is None:
+            return
+        with self._lock:
+            plan = self._plans.get(gk)
+            if plan and pod.key in plan.per_pod and pod.key not in plan.committed:
+                # a member died before binding: the gang cannot complete
+                self.drop_plan(gk)
+
+
+@dataclass
+class PlanOutcome:
+    plan: Optional[GangPlan] = None
+    reason: str = ""

@@ -1,0 +1,272 @@
+"""Scheduler-extender HTTP service (SURVEY.md §2 #4, §3.1).
+
+Implements the Kubernetes scheduler-extender wire API — POST /filter,
+/prioritize, /bind with the upstream JSON shapes — plus /healthz, /metrics
+(Prometheus text) and /state (debug dump), mirroring the observability the
+rebuild adds over the reference (SURVEY.md §5.1/§5.5).
+
+Run in-cluster against the real API server:
+    python -m kubegpu_tpu.scheduler.server --listen 0.0.0.0:12345
+
+or self-hosted on a fabricated cluster for demos/tests (no k8s needed):
+    python -m kubegpu_tpu.scheduler.server --fake-cluster v5e-16
+
+Register with kube-scheduler via deploy/extender-policy.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from kubegpu_tpu.scheduler.core import Scheduler
+from kubegpu_tpu.utils.apiserver import ApiServer, InMemoryApiServer
+
+log = logging.getLogger(__name__)
+
+
+def make_handler(sched: Scheduler):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        # -- plumbing ----------------------------------------------------
+        def _read_json(self) -> Optional[dict]:
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                raw = self.rfile.read(length)
+                return json.loads(raw) if raw else {}
+            except (ValueError, json.JSONDecodeError):
+                return None
+
+        def _send(self, code: int, payload, content_type="application/json") -> None:
+            body = (
+                json.dumps(payload).encode()
+                if content_type == "application/json"
+                else payload.encode()
+            )
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):  # route through logging, not stderr
+            log.debug("http: " + fmt, *args)
+
+        # -- verbs -------------------------------------------------------
+        def do_DELETE(self):
+            parts = self.path.strip("/").split("/")
+            if (
+                len(parts) == 3
+                and parts[0] == "pods"
+                and isinstance(sched.api, InMemoryApiServer)
+            ):
+                ns, name = parts[1], parts[2]
+                try:
+                    obj = sched.api.get_pod(ns, name)
+                    sched.api.delete_pod(ns, name)
+                    sched.on_pod_deleted(obj)
+                    self._send(200, {"deleted": f"{ns}/{name}"})
+                except Exception as e:  # noqa: BLE001
+                    self._send(404, {"error": str(e)})
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, "ok", content_type="text/plain")
+            elif self.path == "/metrics":
+                self._send(200, sched.metrics.render(), content_type="text/plain")
+            elif self.path == "/state":
+                self._send(200, _debug_state(sched))
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            body = self._read_json()
+            if body is None:
+                self._send(400, {"Error": "malformed JSON body"})
+                return
+            try:
+                if self.path == "/filter":
+                    self._send(200, self._filter(body))
+                elif self.path == "/prioritize":
+                    self._send(200, self._prioritize(body))
+                elif self.path == "/bind":
+                    self._send(200, self._bind(body))
+                elif self.path == "/pods" and isinstance(sched.api, InMemoryApiServer):
+                    # fake-cluster demo mode only: lets curl drive the full
+                    # filter→prioritize→bind flow without a real API server
+                    self._send(200, sched.api.create_pod(body))
+                else:
+                    self._send(404, {"error": f"no route {self.path}"})
+            except Exception as e:  # noqa: BLE001 - extender must never hang kube-scheduler
+                log.exception("handler error on %s", self.path)
+                self._send(200, {"Error": f"internal error: {e}"})
+
+        def _candidates(self, body: dict) -> Tuple[list, bool]:
+            """Extender args carry either NodeNames (preferred) or full
+            Nodes.Items; return (names, used_full_objects)."""
+            if body.get("NodeNames"):
+                return list(body["NodeNames"]), False
+            items = (body.get("Nodes") or {}).get("Items") or []
+            return [n.get("metadata", {}).get("name", "") for n in items], True
+
+        def _filter(self, body: dict) -> dict:
+            names, full = self._candidates(body)
+            result = sched.filter(body.get("Pod") or {}, names)
+            out = {
+                "NodeNames": result.nodes,
+                "FailedNodes": result.failed,
+                "Error": result.error,
+            }
+            if full:
+                items = (body.get("Nodes") or {}).get("Items") or []
+                keep = set(result.nodes)
+                out["Nodes"] = {
+                    "Items": [
+                        n for n in items if n.get("metadata", {}).get("name") in keep
+                    ]
+                }
+            return out
+
+        def _prioritize(self, body: dict) -> list:
+            names, _ = self._candidates(body)
+            scores = sched.prioritize(body.get("Pod") or {}, names)
+            return [{"Host": h, "Score": s} for h, s in scores]
+
+        def _bind(self, body: dict) -> dict:
+            err = sched.bind(
+                body.get("PodNamespace", "default"),
+                body.get("PodName", ""),
+                body.get("Node", ""),
+            )
+            return {"Error": err or ""}
+
+    return Handler
+
+
+def _debug_state(sched: Scheduler) -> dict:
+    views = sched.cache.views()
+    return {
+        "nodes": sched.cache.node_names(),
+        "slices": {
+            sid: {
+                "mesh": list(v.mesh_shape),
+                "free": sorted(list(c) for c in v.free),
+                "used": sorted(list(c) for c in v.used),
+                "hosts": v.hosts(),
+            }
+            for sid, v in views.items()
+        },
+    }
+
+
+class ExtenderServer:
+    """Owns the HTTP server + a cache resync loop."""
+
+    def __init__(
+        self,
+        sched: Scheduler,
+        listen: Tuple[str, int] = ("127.0.0.1", 12345),
+        resync_interval_s: float = 30.0,
+    ) -> None:
+        self.sched = sched
+        self.httpd = ThreadingHTTPServer(listen, make_handler(sched))
+        self.resync_interval_s = resync_interval_s
+        self._stop = threading.Event()
+        self._threads = []
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.httpd.server_address[:2]
+
+    def start(self) -> None:
+        self.sched.cache.refresh()
+        t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        t.start()
+        self._threads.append(t)
+        r = threading.Thread(target=self._resync_loop, daemon=True)
+        r.start()
+        self._threads.append(r)
+
+    def _resync_loop(self) -> None:
+        while not self._stop.wait(self.resync_interval_s):
+            try:
+                self.sched.cache.refresh()
+            except Exception:  # noqa: BLE001
+                log.exception("cache resync failed; keeping stale cache")
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+FAKE_PRESETS = {
+    # name -> (mesh_shape, host_block)
+    "v5e-4": ((2, 2), (2, 2)),
+    "v5e-8": ((2, 4), (2, 2)),
+    "v5e-16": ((4, 4), (2, 2)),
+    "v5e-32": ((4, 8), (2, 2)),
+    "v5e-64": ((8, 8), (2, 2)),
+    "v5e-256": ((16, 16), (2, 2)),
+}
+
+
+def build_fake_cluster(preset: str) -> ApiServer:
+    from kubegpu_tpu.plugins import Advertiser, FakeSlice
+
+    if preset not in FAKE_PRESETS:
+        raise SystemExit(f"unknown preset {preset}; choose from {sorted(FAKE_PRESETS)}")
+    mesh, block = FAKE_PRESETS[preset]
+    api = InMemoryApiServer()
+    fs = FakeSlice(slice_id=f"fake-{preset}", mesh_shape=mesh, host_block=block)
+    for host, prov in fs.providers().items():
+        Advertiser(prov, api).advertise_once()
+    return api
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--listen", default="127.0.0.1:12345")
+    ap.add_argument(
+        "--fake-cluster",
+        metavar="PRESET",
+        help="serve a fabricated in-memory cluster (e.g. v5e-16) instead of "
+        "connecting to a real API server",
+    )
+    ap.add_argument("--resync-interval", type=float, default=30.0)
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+
+    if args.fake_cluster:
+        api = build_fake_cluster(args.fake_cluster)
+    else:
+        from kubegpu_tpu.utils.apiserver import KubeApiServer
+
+        api = KubeApiServer()
+    host, _, port = args.listen.rpartition(":")
+    server = ExtenderServer(
+        Scheduler(api), listen=(host or "127.0.0.1", int(port)),
+        resync_interval_s=args.resync_interval,
+    )
+    server.start()
+    log.info("extender listening on %s:%d", *server.address)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Tiny Prometheus-style metrics registry (SURVEY.md §5.5).
+
+The reference had only glog verbosity; the rebuild's north-star metrics
+(schedule-to-first-step latency, ICI-contiguous placement rate) need real
+counters.  Text exposition format only — no client library dependency.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict, deque
+from typing import Dict, List, Tuple
+
+# Quantiles come from a bounded reservoir of the most recent observations;
+# count/sum are exact running totals.  A long-lived extender must not grow
+# (or re-sort) an unbounded list on the scheduling hot path.
+RESERVOIR_SIZE = 1024
+
+
+class _Histogram:
+    __slots__ = ("count", "total", "recent")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total = 0.0
+        self.recent: deque = deque(maxlen=RESERVOIR_SIZE)
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = defaultdict(float)
+        self._histograms: Dict[str, _Histogram] = defaultdict(_Histogram)
+
+    def inc(self, name: str, value: float = 1.0, **labels: str) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._counters[key] += value
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            h = self._histograms[name]
+            h.count += 1
+            h.total += value
+            h.recent.append(value)
+
+    def get(self, name: str, **labels: str) -> float:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            return self._counters.get(key, 0.0)
+
+    def render(self) -> str:
+        """Prometheus text exposition."""
+        out: List[str] = []
+        with self._lock:
+            for (name, labels), v in sorted(self._counters.items()):
+                if labels:
+                    lbl = ",".join(f'{k}="{val}"' for k, val in labels)
+                    out.append(f"{name}{{{lbl}}} {v}")
+                else:
+                    out.append(f"{name} {v}")
+            for name, h in sorted(self._histograms.items()):
+                out.append(f"{name}_count {h.count}")
+                out.append(f"{name}_sum {h.total}")
+                if h.recent:
+                    s = sorted(h.recent)
+                    for q in (0.5, 0.9, 0.99):
+                        idx = min(len(s) - 1, int(q * len(s)))
+                        out.append(f'{name}{{quantile="{q}"}} {s[idx]}')
+        return "\n".join(out) + "\n"
+
+
+# process-global default registry
+default_metrics = Metrics()

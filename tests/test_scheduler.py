@@ -1,0 +1,455 @@
+"""L4 scheduler tests: verbs, gang planning, replay, races, HTTP wire."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubegpu_tpu.plugins import Advertiser, FakeSlice
+from kubegpu_tpu.scheduler import ExtenderServer, Scheduler
+from kubegpu_tpu.types import RES_TPU, annotations, is_contiguous_submesh
+from kubegpu_tpu.utils import InMemoryApiServer
+from kubegpu_tpu.utils.metrics import Metrics
+
+
+def fake_cluster(mesh=(4, 4), block=(2, 2)):
+    api = InMemoryApiServer()
+    fs = FakeSlice(slice_id="s0", mesh_shape=mesh, host_block=block)
+    advs = {h: Advertiser(p, api) for h, p in fs.providers().items()}
+    for a in advs.values():
+        a.advertise_once()
+    return api, fs, advs
+
+
+def make_sched(api, **kw) -> Scheduler:
+    s = Scheduler(api, metrics=Metrics(), **kw)
+    s.cache.refresh()
+    return s
+
+
+def pod_obj(name, chips, ns="default", group=None, group_size=None, contiguous=True, uid=None):
+    ann = {}
+    if group:
+        ann[annotations.POD_GROUP] = group
+        ann[annotations.POD_GROUP_SIZE] = str(group_size or 1)
+    if not contiguous:
+        ann[annotations.POD_CONTIGUOUS] = "false"
+    return {
+        "metadata": {"name": name, "namespace": ns, "uid": uid or f"uid-{name}", "annotations": ann},
+        "spec": {
+            "containers": [
+                {"name": "main", "resources": {"limits": {RES_TPU: str(chips)}}}
+            ]
+        },
+    }
+
+
+def nodes_of(api):
+    return sorted(n["metadata"]["name"] for n in api.list_nodes())
+
+
+# -- config 1: passthrough --------------------------------------------------
+
+def test_filter_passthrough_zero_chips():
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    obj = pod_obj("web", 0)
+    api.create_pod(obj)
+    r = sched.filter(obj, nodes_of(api))
+    assert r.nodes == nodes_of(api) and not r.failed
+
+
+# -- config 2: single chip --------------------------------------------------
+
+def test_single_chip_schedule_and_bind():
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    obj = pod_obj("one", 1)
+    api.create_pod(obj)
+    names = nodes_of(api)
+    r = sched.filter(obj, names)
+    assert len(r.nodes) == 4
+    scores = dict(sched.prioritize(obj, r.nodes))
+    assert all(0 <= s <= 10 for s in scores.values())
+    target = max(r.nodes, key=lambda n: scores[n])
+    assert sched.bind("default", "one", target) is None
+    stored = api.get_pod("default", "one")
+    assert stored["spec"]["nodeName"] == target
+    a = annotations.assignment_from_pod(stored)
+    assert a is not None and len(a.all_chips()) == 1
+    assert sched.metrics.get("kubegpu_placements_total") == 1
+    assert sched.metrics.get("kubegpu_placements_contiguous_total") == 1
+
+
+# -- config 3: 4 chips contiguous -------------------------------------------
+
+def test_four_chip_contiguous_bind():
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    obj = pod_obj("quad", 4)
+    api.create_pod(obj)
+    r = sched.filter(obj, nodes_of(api))
+    assert len(r.nodes) == 4
+    assert sched.bind("default", "quad", r.nodes[0]) is None
+    a = annotations.assignment_from_pod(api.get_pod("default", "quad"))
+    coords = {c.coords for c in a.all_chips()}
+    assert is_contiguous_submesh(coords, (4, 4))
+
+
+def test_filter_reports_reasons_when_full():
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    for i, n in enumerate(nodes_of(api)):
+        obj = pod_obj(f"f{i}", 4)
+        api.create_pod(obj)
+        assert sched.filter(obj, [n]).nodes == [n]
+        assert sched.bind("default", f"f{i}", n) is None
+    late = pod_obj("late", 1)
+    api.create_pod(late)
+    r = sched.filter(late, nodes_of(api))
+    assert r.nodes == []
+    assert all("insufficient" in reason for reason in r.failed.values())
+
+
+# -- bind edge cases --------------------------------------------------------
+
+def test_bind_refits_on_chosen_node():
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    n = nodes_of(api)[0]
+    for i in range(4):
+        obj = pod_obj(f"p{i}", 1)
+        api.create_pod(obj)
+        assert sched.bind("default", f"p{i}", n) is None
+    chips = set()
+    for i in range(4):
+        a = annotations.assignment_from_pod(api.get_pod("default", f"p{i}"))
+        chips |= {(c.host, c.device_index) for c in a.all_chips()}
+    assert len(chips) == 4  # no double allocation
+    obj = pod_obj("p4", 1)
+    api.create_pod(obj)
+    err = sched.bind("default", "p4", n)
+    assert err is not None and "no longer fits" in err
+
+
+def test_bind_unknown_pod_and_node():
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    assert "not found" in sched.bind("default", "ghost", nodes_of(api)[0])
+    obj = pod_obj("x", 1)
+    api.create_pod(obj)
+    assert "unknown node" in sched.bind("default", "x", "nope")
+
+
+def test_concurrent_binds_never_double_allocate():
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    names = nodes_of(api)
+    for i in range(16):
+        api.create_pod(pod_obj(f"c{i}", 1))
+    errs = []
+
+    def bind_one(i):
+        err = sched.bind("default", f"c{i}", names[i % 4])
+        if err:
+            errs.append(err)
+
+    threads = [threading.Thread(target=bind_one, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    seen = set()
+    for i in range(16):
+        a = annotations.assignment_from_pod(api.get_pod("default", f"c{i}"))
+        for c in a.all_chips():
+            key = (c.host, c.device_index)
+            assert key not in seen
+            seen.add(key)
+    assert len(seen) == 16
+
+
+# -- config 4: gang ---------------------------------------------------------
+
+def test_gang_waits_for_all_members():
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    p0 = pod_obj("w0", 1, group="job", group_size=4)
+    api.create_pod(p0)
+    r = sched.filter(p0, nodes_of(api))
+    assert r.nodes == []
+    assert any("waiting for members" in v for v in r.failed.values())
+
+
+def test_gang_schedules_all_or_nothing():
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    objs = [pod_obj(f"w{i}", 1, group="job", group_size=4) for i in range(4)]
+    for o in objs:
+        api.create_pod(o)
+    coords = set()
+    for o in objs:
+        name = o["metadata"]["name"]
+        r = sched.filter(o, nodes_of(api))
+        assert len(r.nodes) == 1, r.failed
+        assert sched.bind("default", name, r.nodes[0]) is None
+        a = annotations.assignment_from_pod(api.get_pod("default", name))
+        coords |= {c.coords for c in a.all_chips()}
+    assert len(coords) == 4
+    assert is_contiguous_submesh(coords, (4, 4))
+
+
+def test_gang_too_big_rejected_with_reason():
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    objs = [pod_obj(f"g{i}", 4, group="huge", group_size=5) for i in range(5)]
+    for o in objs:
+        api.create_pod(o)
+    r = sched.filter(objs[0], nodes_of(api))
+    assert r.nodes == []
+    assert any("does not fit" in v for v in r.failed.values())
+
+
+def test_gang_plan_expiry_returns_reservations():
+    api, _, _ = fake_cluster()
+    sched = make_sched(api, gang_plan_ttl_s=0.0)
+    objs = [pod_obj(f"w{i}", 4, group="job", group_size=2) for i in range(2)]
+    for o in objs:
+        api.create_pod(o)
+    r = sched.filter(objs[0], nodes_of(api))
+    assert len(r.nodes) == 1
+    time.sleep(0.01)
+    # TTL elapsed, nothing committed: reservations must be released
+    assert sched.groups.plan_for(annotations.pod_from_k8s(objs[0])) is None
+    view = next(iter(sched.cache.views().values()))
+    assert len(view.free) == 16
+
+
+def test_gang_member_deleted_before_bind_drops_plan():
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    objs = [pod_obj(f"w{i}", 4, group="job", group_size=2) for i in range(2)]
+    for o in objs:
+        api.create_pod(o)
+    r = sched.filter(objs[0], nodes_of(api))
+    assert len(r.nodes) == 1
+    api.delete_pod("default", "w1")
+    sched.on_pod_deleted(objs[1])
+    view = next(iter(sched.cache.views().values()))
+    assert len(view.free) == 16
+
+
+def test_resync_preserves_gang_reservations():
+    # regression (review finding): a cache refresh between gang planning and
+    # the members' binds must NOT erase the plan's reservations
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    objs = [pod_obj(f"w{i}", 4, group="job", group_size=2) for i in range(2)]
+    for o in objs:
+        api.create_pod(o)
+    r = sched.filter(objs[0], nodes_of(api))
+    assert len(r.nodes) == 1
+    sched.cache.refresh()  # the 30s resync loop fires mid-gang
+    view = next(iter(sched.cache.views().values()))
+    assert len(view.used) == 8  # both members' reservations survived
+    # a competing pod cannot steal the reserved chips
+    competitor = pod_obj("steal", 4)
+    api.create_pod(competitor)
+    rc = sched.filter(competitor, nodes_of(api))
+    for n in rc.nodes:
+        assert sched.bind("default", "steal", n) is None
+        a = annotations.assignment_from_pod(api.get_pod("default", "steal"))
+        assert not ({c.coords for c in a.all_chips()} & view.used)
+        break
+    # and the gang still binds cleanly
+    for o in objs:
+        name = o["metadata"]["name"]
+        rf = sched.filter(o, nodes_of(api))
+        assert len(rf.nodes) == 1, rf.failed
+        assert sched.bind("default", name, rf.nodes[0]) is None
+
+
+def test_fully_committed_plan_dropped_and_recreated_pod_replans():
+    # regression (review finding): a deleted-then-recreated gang member must
+    # get a fresh placement, not the stale plan's chips
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    objs = [pod_obj(f"w{i}", 4, group="job", group_size=2) for i in range(2)]
+    for o in objs:
+        api.create_pod(o)
+    for o in objs:
+        r = sched.filter(o, nodes_of(api))
+        assert r.nodes, r.failed
+        assert sched.bind("default", o["metadata"]["name"], r.nodes[0]) is None
+    assert sched.groups._plans == {}  # plan dropped once fully committed
+    # w1 dies and is recreated (Job/StatefulSet restart pattern)
+    api.delete_pod("default", "w1")
+    sched.on_pod_deleted(objs[1])
+    fresh = pod_obj("w1", 4, group="job", group_size=2)
+    api.create_pod(fresh)
+    r = sched.filter(fresh, nodes_of(api))
+    assert len(r.nodes) == 1, r.failed
+    assert sched.bind("default", "w1", r.nodes[0]) is None
+    # no chip double-booked
+    seen = set()
+    for name in ("w0", "w1"):
+        a = annotations.assignment_from_pod(api.get_pod("default", name))
+        for c in a.all_chips():
+            assert (c.host, c.device_index) not in seen
+            seen.add((c.host, c.device_index))
+    assert len(seen) == 8
+
+
+def test_partially_committed_gang_replans_remainder():
+    # regression (review finding): after a partial commit + plan drop, the
+    # unbound members must re-plan around the bound ones, not deadlock
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    objs = [pod_obj(f"w{i}", 4, group="job", group_size=2) for i in range(2)]
+    for o in objs:
+        api.create_pod(o)
+    r0 = sched.filter(objs[0], nodes_of(api))
+    assert sched.bind("default", "w0", r0.nodes[0]) is None
+    # simulate plan loss before w1 binds (e.g. planned node cordoned)
+    sched.groups.drop_plan("default/job")
+    r1 = sched.filter(objs[1], nodes_of(api))
+    assert len(r1.nodes) == 1, r1.failed
+    assert sched.bind("default", "w1", r1.nodes[0]) is None
+    seen = set()
+    for name in ("w0", "w1"):
+        a = annotations.assignment_from_pod(api.get_pod("default", name))
+        seen |= {(c.host, c.device_index) for c in a.all_chips()}
+    assert len(seen) == 8
+
+
+# -- restart replay ---------------------------------------------------------
+
+def test_restart_replay_restores_used_state():
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    for i in range(3):
+        obj = pod_obj(f"r{i}", 2)
+        api.create_pod(obj)
+        r = sched.filter(obj, nodes_of(api))
+        assert sched.bind("default", f"r{i}", r.nodes[0]) is None
+    # "restart": a brand-new scheduler over the same API server
+    sched2 = make_sched(api)
+    v1 = next(iter(sched.cache.views().values()))
+    v2 = next(iter(sched2.cache.views().values()))
+    assert v1.used == v2.used and len(v2.used) == 6
+    # and new placements avoid the replayed chips
+    obj = pod_obj("after", 4)
+    api.create_pod(obj)
+    r = sched2.filter(obj, nodes_of(api))
+    assert sched2.bind("default", "after", r.nodes[0]) is None
+    a = annotations.assignment_from_pod(api.get_pod("default", "after"))
+    assert not ({c.coords for c in a.all_chips()} & v2.used)
+
+
+# -- health-driven node updates --------------------------------------------
+
+def test_dead_chip_falls_out_via_node_update():
+    api, fs, advs = fake_cluster()
+    sched = make_sched(api)
+    fs.kill_chip((0, 0))
+    victim = fs.topology.chips[(0, 0)].host_id
+    advs[victim].advertise_once()
+    sched.on_node_updated(api.get_node(victim))
+    view = next(iter(sched.cache.views().values()))
+    assert len(view.free) == 15 and (0, 0) not in view.free
+
+
+def test_pod_delete_returns_chips():
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    obj = pod_obj("tmp", 4)
+    api.create_pod(obj)
+    r = sched.filter(obj, nodes_of(api))
+    assert sched.bind("default", "tmp", r.nodes[0]) is None
+    sched.on_pod_deleted(obj)
+    view = next(iter(sched.cache.views().values()))
+    assert len(view.free) == 16
+
+
+# -- HTTP wire --------------------------------------------------------------
+
+@pytest.fixture()
+def http_server():
+    api, _, _ = fake_cluster()
+    sched = Scheduler(api, metrics=Metrics())
+    srv = ExtenderServer(sched, listen=("127.0.0.1", 0))
+    srv.start()
+    yield api, srv
+    srv.stop()
+
+
+def _post(addr, path, payload):
+    req = urllib.request.Request(
+        f"http://{addr[0]}:{addr[1]}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+def _get(addr, path):
+    with urllib.request.urlopen(f"http://{addr[0]}:{addr[1]}{path}", timeout=5) as resp:
+        return resp.read().decode()
+
+
+def test_http_extender_end_to_end(http_server):
+    api, srv = http_server
+    addr = srv.address
+    assert _get(addr, "/healthz") == "ok"
+    obj = pod_obj("h0", 2)
+    api.create_pod(obj)
+    flt = _post(addr, "/filter", {"Pod": obj, "NodeNames": nodes_of(api)})
+    assert flt["Error"] == "" and len(flt["NodeNames"]) == 4
+    pri = _post(addr, "/prioritize", {"Pod": obj, "NodeNames": flt["NodeNames"]})
+    assert all(0 <= e["Score"] <= 10 for e in pri)
+    best = max(pri, key=lambda e: e["Score"])["Host"]
+    bnd = _post(
+        addr, "/bind", {"PodName": "h0", "PodNamespace": "default", "Node": best}
+    )
+    assert bnd["Error"] == ""
+    assert api.get_pod("default", "h0")["spec"]["nodeName"] == best
+    metrics = _get(addr, "/metrics")
+    assert "kubegpu_bind_total 1.0" in metrics
+    state = json.loads(_get(addr, "/state"))
+    assert len(state["slices"]["s0"]["used"]) == 2
+
+
+def test_http_full_node_objects_supported(http_server):
+    api, srv = http_server
+    addr = srv.address
+    obj = pod_obj("h1", 1)
+    api.create_pod(obj)
+    flt = _post(addr, "/filter", {"Pod": obj, "Nodes": {"Items": api.list_nodes()}})
+    assert len(flt["Nodes"]["Items"]) == 4
+
+
+def test_http_malformed_body_is_400_not_crash(http_server):
+    _, srv = http_server
+    addr = srv.address
+    req = urllib.request.Request(
+        f"http://{addr[0]}:{addr[1]}/filter", data=b"{not json", method="POST"
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=5)
+    assert ei.value.code == 400
+    # server still alive
+    assert _get(addr, "/healthz") == "ok"
+
+
+def test_http_malformed_pod_returns_error_not_500(http_server):
+    api, srv = http_server
+    addr = srv.address
+    bad = {"metadata": {"name": "b"}, "spec": {"containers": [
+        {"name": "m", "resources": {"limits": {RES_TPU: "four"}}}]}}
+    flt = _post(addr, "/filter", {"Pod": bad, "NodeNames": nodes_of(api)})
+    assert "unparseable pod" in flt["Error"]
