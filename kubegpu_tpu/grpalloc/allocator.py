@@ -37,6 +37,10 @@ class FitResult:
     reason: str = ""
     score: float = 0.0
     assignment: Optional[Assignment] = None
+    # True when the failure is capacity-shaped (not enough free/contiguous
+    # chips) — i.e. something preemption could fix.  Structured so callers
+    # never probe reason strings.
+    capacity_failure: bool = False
 
 
 @dataclass
@@ -115,6 +119,7 @@ def pod_fits_group_constraints(
                 f"insufficient free chips on {node.name}: "
                 f"want {request.total_chips}, free {len(free)}"
             ),
+            capacity_failure=True,
         )
     subset, score = _best_subset(free, request.total_chips, view, request.contiguous)
     if subset is None:
@@ -124,6 +129,7 @@ def pod_fits_group_constraints(
                 f"no ICI-contiguous {request.total_chips}-chip placement free on "
                 f"{node.name} (set annotation kubegpu-tpu/contiguous=false to relax)"
             ),
+            capacity_failure=True,
         )
     refs = [view.chips[c] for c in sorted(subset)]
     assignment = Assignment(

@@ -174,6 +174,10 @@ class ClusterCache:
         with self._lock:
             return self._assignments.get(key)
 
+    def assignments_snapshot(self) -> Dict[str, Assignment]:
+        with self._lock:
+            return dict(self._assignments)
+
     @property
     def lock(self) -> threading.RLock:
         """Callers that must fit+assume atomically (bind) hold this."""

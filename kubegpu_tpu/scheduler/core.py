@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Tuple
 from kubegpu_tpu.grpalloc import pod_fits_group_constraints
 from kubegpu_tpu.scheduler.cache import ClusterCache
 from kubegpu_tpu.scheduler.podgroup import PodGroupRegistry
+from kubegpu_tpu.scheduler.preemption import collect_units, find_victims
 from kubegpu_tpu.types import annotations
 from kubegpu_tpu.types.info import Assignment, PodInfo, TpuRequest
 from kubegpu_tpu.types.topology import is_contiguous_submesh
@@ -39,6 +40,9 @@ class FilterResult:
     nodes: List[str] = field(default_factory=list)
     failed: Dict[str, str] = field(default_factory=dict)
     error: str = ""
+    # at least one node failed for a capacity-shaped reason (internal;
+    # not part of the HTTP response)
+    capacity_failure: bool = False
 
 
 class Scheduler:
@@ -78,6 +82,11 @@ class Scheduler:
             outcome = self.groups.plan_for(pod) or None
             if outcome is None:
                 planned = self.groups.try_plan(pod)
+                if planned.plan is None and planned.capacity_failure:
+                    # multi-tenant path (BASELINE config 5): evict strictly
+                    # lower-priority units, then re-plan once
+                    if self._attempt_preemption(pod, self._slices_of(node_names)):
+                        planned = self.groups.try_plan(pod)
                 if planned.plan is None:
                     return FilterResult(
                         failed={n: planned.reason for n in node_names},
@@ -96,20 +105,134 @@ class Scheduler:
                 )
             return FilterResult(nodes=nodes, failed=failed)
 
+        result = self._filter_plain(pod, request, node_names)
+        if not result.nodes and result.capacity_failure:
+            if self._attempt_preemption(pod, self._slices_of(node_names)):
+                result = self._filter_plain(pod, request, node_names)
+        return result
+
+    def _filter_plain(self, pod: PodInfo, request: TpuRequest, node_names: List[str]) -> FilterResult:
         views = self.cache.views()
-        nodes, failed = [], {}
+        result = FilterResult()
         for name in node_names:
             node = self.cache.node(name)
             if node is None:
-                failed[name] = "node not in scheduler cache"
+                result.failed[name] = "node not in scheduler cache"
                 continue
             view = views.get(node.slice_id) if node.slice_id else None
             fit = pod_fits_group_constraints(node, request, view)
             if fit.fits:
-                nodes.append(name)
+                result.nodes.append(name)
             else:
-                failed[name] = fit.reason
-        return FilterResult(nodes=nodes, failed=failed)
+                result.failed[name] = fit.reason
+                result.capacity_failure = result.capacity_failure or fit.capacity_failure
+        return result
+
+    def _slices_of(self, node_names: List[str]) -> set:
+        """Slice ids reachable from a candidate node list — preemption must
+        never evict on a slice the scheduler excluded."""
+        out = set()
+        for name in node_names:
+            node = self.cache.node(name)
+            if node is not None and node.slice_id:
+                out.add(node.slice_id)
+        return out
+
+    # -- preemption -------------------------------------------------------
+    def _members_for_preemption(self, pod: PodInfo) -> Optional[List[PodInfo]]:
+        if not pod.pod_group:
+            return [pod]
+        # shared helper so the simulation can never diverge from what
+        # try_plan would actually place
+        return self.groups.planned_members(pod)
+
+    def _attempt_preemption(self, pod: PodInfo, allowed_slices: set) -> bool:
+        """Evict strictly-lower-priority units so `pod` (or its gang) fits.
+        Returns True if anything was evicted (caller retries placement)."""
+        if pod.priority <= 0 or not allowed_slices:
+            return False
+        members = self._members_for_preemption(pod)
+        if members is None:
+            return False
+        pods_raw = self.api.list_pods()
+        with self.cache.lock:
+            units = collect_units(pods_raw, self.cache.assignments_snapshot())
+            decision = find_victims(
+                self.cache.views(), units, members, pod.priority, allowed_slices
+            )
+        if decision is None or not decision.victims:
+            return False
+        for u in decision.victims:
+            if u.unit_id.startswith("gang:"):
+                self.groups.drop_plan(u.unit_id[len("gang:"):])
+        evicted = 0
+        for key in decision.victim_pod_keys():
+            ns, name = key.split("/", 1)
+            # clear the assignment annotation BEFORE deleting: a victim
+            # lingering in Terminating (graceful deletion on a real
+            # cluster) must not be replayed by the next cache refresh onto
+            # chips the preemptor now owns
+            try:
+                self.api.patch_pod_annotations(
+                    ns, name, {annotations.POD_ASSIGNMENT: ""}
+                )
+            except (NotFound, OSError):
+                pass
+            try:
+                self.api.delete_pod(ns, name)
+            except NotFound:
+                pass
+            self.cache.remove_pod(key)
+            evicted += 1
+        self.metrics.inc("kubegpu_preemptions_total")
+        self.metrics.inc("kubegpu_preempted_pods_total", evicted)
+        log.warning(
+            "preempted %d pods (units: %s) for %s (priority %d)",
+            evicted,
+            [u.unit_id for u in decision.victims],
+            pod.key,
+            pod.priority,
+        )
+        return True
+
+    def preemption_victims(
+        self, pod_obj: dict, candidate_nodes: Optional[List[str]] = None
+    ) -> Dict[str, List[str]]:
+        """The extender /preemption verb (advisory: kube-scheduler performs
+        the eviction): node name -> victim pod keys on that node, restricted
+        to the nodes kube-scheduler nominated (when provided)."""
+        try:
+            pod = annotations.pod_from_k8s(pod_obj)
+        except Exception:  # noqa: BLE001
+            return {}
+        members = self._members_for_preemption(pod)
+        if members is None:
+            return {}
+        allowed = (
+            self._slices_of(candidate_nodes) if candidate_nodes is not None else None
+        )
+        if candidate_nodes is not None and not allowed:
+            return {}
+        pods_raw = self.api.list_pods()
+        with self.cache.lock:
+            assignments = self.cache.assignments_snapshot()
+            units = collect_units(pods_raw, assignments)
+            decision = find_victims(
+                self.cache.views(), units, members, pod.priority, allowed
+            )
+        if decision is None:
+            return {}
+        by_node: Dict[str, List[str]] = {}
+        for key in decision.victim_pod_keys():
+            a = assignments.get(key)
+            if a is None:
+                continue
+            # victims are restricted to candidate slices above, but NOT
+            # filtered per candidate node: gangs are evicted whole, and a
+            # gang's members may sit on sibling (non-nominated) nodes of
+            # the same slice
+            by_node.setdefault(a.node, []).append(key)
+        return by_node
 
     # -- prioritize -------------------------------------------------------
     def prioritize(self, pod_obj: dict, node_names: List[str]) -> List[Tuple[str, int]]:

@@ -96,21 +96,20 @@ class PodGroupRegistry:
         the remainder instead of deadlocking on its own bound members."""
         gk = self.group_key(pod)
         assert gk is not None
-        members, scheduled = self._gather_members(pod)
+        pending, scheduled = self._gather_members(pod)
         with self._lock:
             existing = self.plan_for(pod, now=now)
             if existing:
                 return PlanOutcome(plan=existing)
-            if len(members) + len(scheduled) < pod.pod_group_size:
+            if len(pending) + len(scheduled) < pod.pod_group_size:
                 return PlanOutcome(
                     reason=(
                         f"gang {gk}: waiting for members "
-                        f"({len(members) + len(scheduled)}/{pod.pod_group_size} created)"
+                        f"({len(pending) + len(scheduled)}/{pod.pod_group_size} created)"
                     )
                 )
-            want = pod.pod_group_size - len(scheduled)
-            members = sorted(members, key=lambda p: p.key)[:want]
-            if pod.key not in {p.key for p in members}:
+            members = self._select_members(pod, pending, scheduled)
+            if members is None:
                 # deterministic membership: first N by name; this pod lost
                 return PlanOutcome(
                     reason=f"gang {gk}: pod {pod.key} not in first {pod.pod_group_size} members"
@@ -129,7 +128,10 @@ class PodGroupRegistry:
                         reasons.append(f"{sid}: {g.reason}")
                 if best is None:
                     detail = "; ".join(reasons) if reasons else "no TPU slices advertised"
-                    return PlanOutcome(reason=f"gang {gk} does not fit: {detail}")
+                    return PlanOutcome(
+                        reason=f"gang {gk} does not fit: {detail}",
+                        capacity_failure=bool(views),
+                    )
                 sid, g = best
                 taken = []
                 for key, a in g.per_pod.items():
@@ -149,6 +151,25 @@ class PodGroupRegistry:
             self._plans[gk] = plan
             log.info("gang %s planned on slice %s score=%.1f", gk, sid, g.score)
             return PlanOutcome(plan=plan)
+
+    @staticmethod
+    def _select_members(pod: PodInfo, pending, scheduled) -> Optional[List[PodInfo]]:
+        """The single source of truth for which pending pods a plan covers:
+        first (group_size - already_scheduled) by name; None if this pod is
+        not among them."""
+        want = pod.pod_group_size - len(scheduled)
+        members = sorted(pending, key=lambda p: p.key)[:want]
+        if pod.key not in {p.key for p in members}:
+            return None
+        return members
+
+    def planned_members(self, pod: PodInfo) -> Optional[List[PodInfo]]:
+        """The member set try_plan would plan for this pod right now (used
+        by preemption simulation so it can never diverge from planning)."""
+        pending, scheduled = self._gather_members(pod)
+        if len(pending) + len(scheduled) < pod.pod_group_size:
+            return None
+        return self._select_members(pod, pending, scheduled)
 
     def _gather_members(self, pod: PodInfo):
         """Group members split into (pending, already_scheduled).  A member
@@ -199,3 +220,6 @@ class PodGroupRegistry:
 class PlanOutcome:
     plan: Optional[GangPlan] = None
     reason: str = ""
+    # capacity-shaped failure (preemption could fix it); never probe reason
+    # strings for this
+    capacity_failure: bool = False

@@ -98,6 +98,8 @@ def make_handler(sched: Scheduler):
                     self._send(200, self._prioritize(body))
                 elif self.path == "/bind":
                     self._send(200, self._bind(body))
+                elif self.path == "/preemption":
+                    self._send(200, self._preemption(body))
                 elif self.path == "/pods" and isinstance(sched.api, InMemoryApiServer):
                     # fake-cluster demo mode only: lets curl drive the full
                     # filter→prioritize→bind flow without a real API server
@@ -146,6 +148,28 @@ def make_handler(sched: Scheduler):
                 body.get("Node", ""),
             )
             return {"Error": err or ""}
+
+        def _preemption(self, body: dict) -> dict:
+            """ExtenderPreemptionArgs -> NodeNameToMetaVictims (advisory:
+            kube-scheduler performs the eviction for this verb).  The
+            nominated-node map scopes which slices victims may come from."""
+            nominated = (
+                body.get("NodeNameToVictims") or body.get("NodeNameToMetaVictims") or {}
+            )
+            candidates = sorted(nominated) if nominated else None
+            by_node = sched.preemption_victims(body.get("Pod") or {}, candidates)
+            meta = {}
+            for node, keys in by_node.items():
+                pods = []
+                for key in keys:
+                    ns, name = key.split("/", 1)
+                    try:
+                        uid = sched.api.get_pod(ns, name).get("metadata", {}).get("uid", key)
+                    except Exception:  # noqa: BLE001
+                        uid = key
+                    pods.append({"UID": uid})
+                meta[node] = {"Pods": pods, "NumPDBViolations": 0}
+            return {"NodeNameToMetaVictims": meta}
 
     return Handler
 

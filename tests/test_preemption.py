@@ -1,0 +1,207 @@
+"""Preemption + multi-tenant tests (BASELINE config 5)."""
+
+import pytest
+
+from kubegpu_tpu.scheduler.preemption import collect_units, find_victims
+from kubegpu_tpu.types import annotations, is_contiguous_submesh
+from kubegpu_tpu.types.info import PodInfo, ContainerInfo
+
+from test_scheduler import fake_cluster, make_sched, pod_obj, nodes_of
+
+
+def schedule_gang(sched, api, prefix, n_pods, chips, group, priority=0):
+    objs = [
+        pod_obj(f"{prefix}{i}", chips, group=group, group_size=n_pods)
+        for i in range(n_pods)
+    ]
+    for o in objs:
+        if priority:
+            o["metadata"]["annotations"][annotations.POD_PRIORITY] = str(priority)
+        api.create_pod(o)
+    for o in objs:
+        name = o["metadata"]["name"]
+        r = sched.filter(o, nodes_of(api))
+        assert r.nodes, f"{name}: {r.failed}"
+        err = sched.bind("default", name, r.nodes[0])
+        assert err is None, err
+    return objs
+
+
+# -- config 5: two concurrent 8-chip tenants (no preemption needed) ---------
+
+def test_two_tenants_bin_pack_the_slice():
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    schedule_gang(sched, api, "a", 2, 4, group="tenant-a")
+    schedule_gang(sched, api, "b", 2, 4, group="tenant-b")
+    view = next(iter(sched.cache.views().values()))
+    assert len(view.free) == 0
+    # each tenant's 8 chips form a contiguous rectangle
+    for tenant in ("a", "b"):
+        coords = set()
+        for i in range(2):
+            a = annotations.assignment_from_pod(api.get_pod("default", f"{tenant}{i}"))
+            coords |= {c.coords for c in a.all_chips()}
+        assert len(coords) == 8
+        assert is_contiguous_submesh(coords, (4, 4))
+
+
+def test_third_tenant_rejected_without_priority():
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    schedule_gang(sched, api, "a", 2, 4, group="tenant-a")
+    schedule_gang(sched, api, "b", 2, 4, group="tenant-b")
+    objs = [pod_obj(f"c{i}", 4, group="tenant-c", group_size=2) for i in range(2)]
+    for o in objs:
+        api.create_pod(o)
+    r = sched.filter(objs[0], nodes_of(api))
+    assert r.nodes == []
+    # nothing was evicted
+    assert len(api.list_pods()) == 6
+    assert sched.metrics.get("kubegpu_preemptions_total") == 0
+
+
+# -- preemption -------------------------------------------------------------
+
+def test_high_priority_gang_preempts_lowest_tenant():
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    schedule_gang(sched, api, "a", 2, 4, group="tenant-a", priority=5)
+    schedule_gang(sched, api, "b", 2, 4, group="tenant-b", priority=1)
+    # high-priority 8-chip job arrives on the full slice
+    vip = schedule_gang(sched, api, "v", 2, 4, group="tenant-vip", priority=10)
+    assert sched.metrics.get("kubegpu_preemptions_total") == 1
+    # the LOWEST-priority tenant (b) was evicted whole; a survives
+    remaining = {p["metadata"]["name"] for p in api.list_pods()}
+    assert remaining == {"a0", "a1", "v0", "v1"}
+    # vip got contiguous chips
+    coords = set()
+    for o in vip:
+        a = annotations.assignment_from_pod(
+            api.get_pod("default", o["metadata"]["name"])
+        )
+        coords |= {c.coords for c in a.all_chips()}
+    assert len(coords) == 8 and is_contiguous_submesh(coords, (4, 4))
+
+
+def test_preemption_evicts_gangs_whole():
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    schedule_gang(sched, api, "low", 4, 1, group="tenant-low", priority=1)  # 4 chips
+    schedule_gang(sched, api, "mid", 2, 4, group="tenant-mid", priority=5)  # 8 chips
+    # 8-chip vip: evicting tenant-low (4 chips) is not enough on its own if
+    # the free 4 don't align; whatever is evicted must be whole units
+    schedule_gang(sched, api, "v", 2, 4, group="tenant-vip", priority=10)
+    names = {p["metadata"]["name"] for p in api.list_pods()}
+    # tenant-low either fully present or fully evicted
+    low = {f"low{i}" for i in range(4)}
+    assert low <= names or not (low & names)
+
+
+def test_equal_priority_never_preempts():
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    schedule_gang(sched, api, "a", 2, 4, group="tenant-a", priority=5)
+    schedule_gang(sched, api, "b", 2, 4, group="tenant-b", priority=5)
+    objs = [pod_obj(f"c{i}", 4, group="tenant-c", group_size=2) for i in range(2)]
+    for o in objs:
+        o["metadata"]["annotations"][annotations.POD_PRIORITY] = "5"
+        api.create_pod(o)
+    r = sched.filter(objs[0], nodes_of(api))
+    assert r.nodes == []
+    assert len(api.list_pods()) == 6
+
+
+def test_single_pod_preemption_path():
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    # fill the slice with low-priority singles
+    for i in range(4):
+        obj = pod_obj(f"low{i}", 4)
+        obj["metadata"]["annotations"][annotations.POD_PRIORITY] = "1"
+        api.create_pod(obj)
+        r = sched.filter(obj, nodes_of(api))
+        assert sched.bind("default", f"low{i}", r.nodes[0]) is None
+    vip = pod_obj("vip", 4)
+    vip["metadata"]["annotations"][annotations.POD_PRIORITY] = "10"
+    api.create_pod(vip)
+    r = sched.filter(vip, nodes_of(api))
+    assert r.nodes, r.failed
+    assert sched.bind("default", "vip", r.nodes[0]) is None
+    # exactly one victim evicted (minimal set)
+    assert len([p for p in api.list_pods() if p["metadata"]["name"].startswith("low")]) == 3
+
+
+def test_preemption_scoped_to_candidate_slices():
+    # regression (review finding): victims must never be evicted on slices
+    # the candidate node list cannot reach
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    schedule_gang(sched, api, "low", 2, 4, group="tenant-low", priority=1)
+    vip = pod_obj("vip", 4)
+    vip["metadata"]["annotations"][annotations.POD_PRIORITY] = "10"
+    api.create_pod(vip)
+    # candidate list contains only unknown (non-TPU) nodes
+    r = sched.filter(vip, ["unrelated-node-1", "unrelated-node-2"])
+    assert r.nodes == []
+    # nothing was evicted for zero benefit
+    assert sched.metrics.get("kubegpu_preemptions_total") == 0
+    assert len(api.list_pods()) == 3
+
+
+def test_evicted_victim_annotation_cleared_before_delete():
+    # regression (review finding): a victim lingering in Terminating must
+    # not be replayed by refresh onto the preemptor's chips
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    seen_cleared = []
+
+    def watcher(event, obj):
+        if event == "pod-updated":
+            ann = obj.get("metadata", {}).get("annotations", {})
+            if ann.get(annotations.POD_ASSIGNMENT) == "":
+                seen_cleared.append(obj["metadata"]["name"])
+
+    api.observe(watcher)
+    schedule_gang(sched, api, "low", 2, 4, group="tenant-low", priority=1)
+    schedule_gang(sched, api, "mid", 2, 4, group="tenant-mid", priority=5)
+    schedule_gang(sched, api, "v", 2, 4, group="tenant-vip", priority=10)
+    assert sorted(seen_cleared) == ["low0", "low1"]
+
+
+# -- pure victim-finding ----------------------------------------------------
+
+def make_pod_info(name, chips, priority=0, group=None):
+    return PodInfo(
+        name=name,
+        containers=[ContainerInfo("m", chips)],
+        priority=priority,
+        pod_group=group,
+    )
+
+
+def test_find_victims_none_when_all_higher():
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    schedule_gang(sched, api, "a", 4, 4, group="tenant-a", priority=10)
+    units = collect_units(api.list_pods(), sched.cache.assignments_snapshot())
+    assert all(u.priority == 10 for u in units)
+    d = find_victims(sched.cache.views(), units, [make_pod_info("x", 4)], incoming_priority=5)
+    assert d is None
+
+
+def test_find_victims_minimal_set():
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    for i, prio in enumerate((1, 2, 3, 4)):
+        obj = pod_obj(f"p{i}", 4)
+        obj["metadata"]["annotations"][annotations.POD_PRIORITY] = str(prio)
+        api.create_pod(obj)
+        r = sched.filter(obj, nodes_of(api))
+        assert sched.bind("default", f"p{i}", r.nodes[0]) is None
+    units = collect_units(api.list_pods(), sched.cache.assignments_snapshot())
+    d = find_victims(
+        sched.cache.views(), units, [make_pod_info("x", 4, priority=10)], incoming_priority=10
+    )
+    assert d is not None and len(d.victims) == 1
+    assert d.victims[0].priority == 1  # cheapest victim chosen
