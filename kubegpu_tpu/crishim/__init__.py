@@ -1,0 +1,19 @@
+"""L3 node runtime shim (SURVEY.md §2 #8): CRI proxy + device/env injection."""
+
+from kubegpu_tpu.crishim.inject import Injection, compute_injection, worker_env
+from kubegpu_tpu.crishim.proxy import (
+    CriProxy,
+    mutate_create_request,
+    parse_create_request,
+)
+from kubegpu_tpu.crishim.daemon import ShimDaemon
+
+__all__ = [
+    "Injection",
+    "compute_injection",
+    "worker_env",
+    "CriProxy",
+    "mutate_create_request",
+    "parse_create_request",
+    "ShimDaemon",
+]

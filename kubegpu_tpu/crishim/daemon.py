@@ -1,0 +1,134 @@
+"""CRI shim daemon: wires the proxy to the API server + TPU provider.
+
+DaemonSet twin of the reference's crishim process (SURVEY.md §2 #8): one per
+TPU node, kubelet's --container-runtime-endpoint points at it.
+
+    python -m kubegpu_tpu.crishim.daemon \
+        --upstream unix:///run/containerd/containerd.sock \
+        --listen unix:///run/kubegpu-tpu/crishim.sock
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import threading
+from typing import Optional, Sequence
+
+from kubegpu_tpu.crishim.inject import Injection, InjectionError, compute_injection
+from kubegpu_tpu.crishim.proxy import CriProxy
+from kubegpu_tpu.plugins.provider import TpuProvider
+from kubegpu_tpu.types import annotations
+from kubegpu_tpu.types.info import PodInfo
+from kubegpu_tpu.utils.apiserver import ApiServer
+
+log = logging.getLogger(__name__)
+
+
+class ShimDaemon:
+    def __init__(self, api: ApiServer, provider: TpuProvider) -> None:
+        self.api = api
+        self.provider = provider
+
+    def decide(
+        self,
+        namespace: str,
+        pod_name: str,
+        container_name: str,
+        sandbox_annotations: dict,
+        hostname: str,
+    ) -> Optional[Injection]:
+        pod = self._pod(namespace, pod_name, sandbox_annotations)
+        if annotations.assignment_from_pod(pod.annotations) is None:
+            return None  # not a device pod: pure passthrough
+        members: Optional[Sequence[str]] = None
+        if pod.pod_group:
+            # only reached for pods that DO need injection — an API outage
+            # here raises InjectionError (fail CreateContainer, retry)
+            # rather than degrading innocent passthrough containers
+            members = self._gang_member_names(pod)
+        return compute_injection(
+            pod, container_name, self.provider, member_names=members,
+            subdomain=pod.subdomain,
+        )
+
+    def _pod(self, namespace: str, pod_name: str, sandbox_annotations: dict) -> PodInfo:
+        """Fresh pod from the API server (its assignment annotation is
+        written at bind); the sandbox's annotation copy is the offline
+        fallback — same data, captured at sandbox creation."""
+        try:
+            return annotations.pod_from_k8s(self.api.get_pod(namespace, pod_name))
+        except Exception:  # noqa: BLE001 - degrade to the sandbox's copy,
+            # but say so: repeated fallbacks signal an API/parse problem
+            log.warning(
+                "could not fetch pod %s/%s from API server; using sandbox "
+                "annotations", namespace, pod_name, exc_info=True,
+            )
+            pod = PodInfo(
+                name=pod_name,
+                namespace=namespace,
+                annotations=dict(sandbox_annotations),
+            )
+            pod.pod_group = sandbox_annotations.get(annotations.POD_GROUP)
+            try:
+                pod.pod_group_size = int(
+                    sandbox_annotations.get(annotations.POD_GROUP_SIZE, "1")
+                )
+            except ValueError:
+                pod.pod_group_size = 1
+            return pod
+
+    def _gang_member_names(self, pod: PodInfo) -> Sequence[str]:
+        """All member names of the pod's gang — required exactly, or the
+        rendezvous env would be wrong for every worker.  Raises
+        InjectionError when the list cannot be established (API down,
+        members missing): CreateContainer must fail-and-retry rather than
+        start a worker that initializes as a standalone job while its
+        siblings block at rendezvous."""
+        try:
+            names = []
+            for obj in self.api.list_pods(namespace=pod.namespace):
+                try:
+                    p = annotations.pod_from_k8s(obj)
+                except Exception:  # noqa: BLE001 - unrelated malformed pods
+                    continue
+                if p.pod_group == pod.pod_group:
+                    names.append(p.name)
+        except Exception as e:  # noqa: BLE001
+            raise InjectionError(
+                f"cannot list gang members of {pod.key}: {e}"
+            ) from e
+        if pod.name not in names:
+            names.append(pod.name)
+        if len(names) < pod.pod_group_size:
+            raise InjectionError(
+                f"gang {pod.pod_group}: only {len(names)}/{pod.pod_group_size} "
+                f"members visible; refusing to inject a partial worker table"
+            )
+        return sorted(names)[: pod.pod_group_size]
+
+    def serve(self, upstream: str, listen: str) -> CriProxy:
+        proxy = CriProxy(upstream_target=upstream, decide=self.decide, listen_target=listen)
+        proxy.start()
+        log.info("crishim proxying %s -> %s", listen, upstream)
+        return proxy
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--upstream", default="unix:///run/containerd/containerd.sock")
+    ap.add_argument("--listen", default="unix:///run/kubegpu-tpu/crishim.sock")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+
+    from kubegpu_tpu.plugins.discovery import GkeTpuProvider
+    from kubegpu_tpu.utils.apiserver import KubeApiServer
+
+    daemon = ShimDaemon(KubeApiServer(), GkeTpuProvider())
+    daemon.serve(args.upstream, args.listen)
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""Device + multi-host env injection (SURVEY.md §3.3): the logic layer of
+the CRI shim, pure and fully testable off-cluster.
+
+Given the pod's bind-time assignment annotation (written by the extender)
+and its gang metadata, compute what the container must receive:
+
+- ``TPU_VISIBLE_CHIPS`` + /dev entries (+ accelerator/topology env) from the
+  node's TpuProvider — the TPU twin of NVIDIA_VISIBLE_DEVICES + driver
+  mounts in the reference (SURVEY.md §2 #6).
+- The JAX multi-host rendezvous contract (SURVEY.md §3.4, §7(d) calls it
+  fiddly — the variable set below is the jax.distributed standard:
+  coordinator address + process count + process id, plus the TPU worker
+  identity vars GKE sets):
+    TPU_WORKER_ID            index of this pod among its gang (sorted keys)
+    TPU_WORKER_HOSTNAMES     comma list of all workers' stable hostnames
+    JAX_COORDINATOR_ADDRESS  worker 0's hostname:port
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from kubegpu_tpu.plugins.provider import AllocateResponse, TpuProvider
+from kubegpu_tpu.types import annotations
+from kubegpu_tpu.types.info import Assignment, PodInfo
+
+log = logging.getLogger(__name__)
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+class InjectionError(Exception):
+    """The shim POSITIVELY knows this container needs injection but cannot
+    compute it correctly (e.g. gang rendezvous with the API server down).
+    CreateContainer must fail — kubelet retries — rather than start a worker
+    with wrong env that silently corrupts the whole gang."""
+
+
+@dataclass
+class Injection:
+    env: Dict[str, str] = field(default_factory=dict)
+    devices: List[str] = field(default_factory=list)
+    mounts: List[tuple] = field(default_factory=list)  # (host_path, container_path)
+
+    @property
+    def empty(self) -> bool:
+        return not (self.env or self.devices or self.mounts)
+
+
+def pod_hostname(pod_name: str, subdomain: Optional[str], namespace: str) -> str:
+    """Stable DNS name for a worker: headless-service form when the pod spec
+    sets a subdomain (the supported pattern for gang jobs), else the bare
+    pod name (same-node resolution only)."""
+    if subdomain:
+        return f"{pod_name}.{subdomain}.{namespace}.svc"
+    return pod_name
+
+
+def worker_env(
+    pod: PodInfo,
+    member_names: Sequence[str],
+    subdomain: Optional[str] = None,
+    coordinator_port: int = DEFAULT_COORDINATOR_PORT,
+) -> Dict[str, str]:
+    """The multi-host rendezvous env for one gang member.  member_names are
+    the gang's pod names; ordering is canonicalized here (sorted) so every
+    member derives the same worker table independently."""
+    names = sorted(member_names)
+    if pod.name not in names:
+        names = sorted(names + [pod.name])
+    worker_id = names.index(pod.name)
+    hostnames = [pod_hostname(n, subdomain, pod.namespace) for n in names]
+    coordinator = f"{hostnames[0]}:{coordinator_port}"
+    return {
+        "TPU_WORKER_ID": str(worker_id),
+        "TPU_WORKER_HOSTNAMES": ",".join(hostnames),
+        "JAX_COORDINATOR_ADDRESS": coordinator,
+        "JAX_NUM_PROCESSES": str(len(names)),
+        "JAX_PROCESS_ID": str(worker_id),
+    }
+
+
+def compute_injection(
+    pod: PodInfo,
+    container_name: str,
+    provider: TpuProvider,
+    member_names: Optional[Sequence[str]] = None,
+    subdomain: Optional[str] = None,
+) -> Injection:
+    """Everything to add to one container's config at CreateContainer time.
+
+    Non-TPU pods (no assignment annotation) get an empty injection — the
+    shim is a transparent passthrough for them (BASELINE config 1)."""
+    a = annotations.assignment_from_pod(pod.annotations)
+    if a is None:
+        return Injection()
+    chips = a.per_container.get(container_name, [])
+    if not chips:
+        return Injection()
+    alloc: AllocateResponse = provider.allocate(chips)
+    inj = Injection(env=dict(alloc.env), devices=list(alloc.devices), mounts=list(alloc.mounts))
+    if pod.pod_group:
+        members = list(member_names) if member_names is not None else [pod.name]
+        inj.env.update(worker_env(pod, members, subdomain=subdomain))
+    else:
+        inj.env.setdefault("TPU_WORKER_ID", "0")
+        inj.env.setdefault("JAX_NUM_PROCESSES", "1")
+        inj.env.setdefault("JAX_PROCESS_ID", "0")
+    return inj

@@ -99,6 +99,7 @@ def pod_from_k8s(obj: dict) -> PodInfo:
         annotations=ann,
         labels=dict(meta.get("labels") or {}),
         node_name=spec.get("nodeName"),
+        subdomain=spec.get("subdomain"),
     )
     pod.pod_group = ann.get(POD_GROUP)
     try:

@@ -98,6 +98,7 @@ class PodInfo:
     labels: Dict[str, str] = field(default_factory=dict)
     priority: int = 0
     node_name: Optional[str] = None
+    subdomain: Optional[str] = None  # spec.subdomain (headless-service DNS)
     # Gang metadata (parsed from annotations by scheduler.podgroup).
     pod_group: Optional[str] = None
     pod_group_size: int = 1

@@ -1,0 +1,314 @@
+"""L3 CRI shim tests: wire codec, injection logic, full gRPC proxy path."""
+
+from concurrent import futures
+
+import grpc
+import pytest
+
+from kubegpu_tpu.crishim import (
+    CriProxy,
+    ShimDaemon,
+    compute_injection,
+    mutate_create_request,
+    parse_create_request,
+    worker_env,
+)
+from kubegpu_tpu.crishim.proxy import CREATE_CONTAINER
+from kubegpu_tpu.plugins import FakeSlice
+from kubegpu_tpu.types import annotations
+from kubegpu_tpu.types.info import PodInfo
+from kubegpu_tpu.utils import protowire as pw
+
+from test_scheduler import fake_cluster, make_sched, nodes_of, pod_obj
+
+
+# -- protowire --------------------------------------------------------------
+
+def test_varint_roundtrip():
+    for n in (0, 1, 127, 128, 300, 2**32, 2**60):
+        data = pw.encode_varint(n)
+        val, pos = pw.decode_varint(data, 0)
+        assert val == n and pos == len(data)
+
+
+def test_field_iteration_and_maps():
+    msg = (
+        pw.encode_string_field(1, "hello")
+        + pw.encode_varint((2 << 3) | 0) + pw.encode_varint(42)
+        + pw.encode_len_field(7, pw.encode_key_value("k1", "v1"))
+        + pw.encode_len_field(7, pw.encode_key_value("k2", "v2"))
+    )
+    assert pw.get_field(msg, 1) == b"hello"
+    assert pw.get_field(msg, 2) == 42
+    assert pw.decode_string_map(pw.get_all(msg, 7)) == {"k1": "v1", "k2": "v2"}
+
+
+def test_append_and_replace_preserve_unknown_fields():
+    inner = pw.encode_string_field(1, "ctr")
+    msg = pw.encode_len_field(1, inner) + pw.encode_string_field(99, "unknown-field")
+    appended = pw.append_to_message_field(msg, 6, [pw.encode_key_value("A", "B")])
+    assert pw.get_field(appended, 99) == b"unknown-field"
+    envs = pw.decode_string_map(pw.get_all(appended, 6))
+    assert envs == {"A": "B"}
+    replaced = pw.replace_field(appended, 1, pw.encode_string_field(1, "other"))
+    assert pw.get_field(pw.get_field(replaced, 1), 1) == b"other"
+    assert pw.get_field(replaced, 99) == b"unknown-field"
+
+
+# -- worker env contract ----------------------------------------------------
+
+def test_worker_env_stable_across_members():
+    members = ["job-w2", "job-w0", "job-w1"]
+    envs = []
+    for name in members:
+        pod = PodInfo(name=name, namespace="ml", pod_group="job", pod_group_size=3)
+        envs.append(worker_env(pod, members, subdomain="job-svc"))
+    # every member derives the same worker table
+    assert len({e["TPU_WORKER_HOSTNAMES"] for e in envs}) == 1
+    assert len({e["JAX_COORDINATOR_ADDRESS"] for e in envs}) == 1
+    assert sorted(e["TPU_WORKER_ID"] for e in envs) == ["0", "1", "2"]
+    assert sorted(e["JAX_PROCESS_ID"] for e in envs) == ["0", "1", "2"]
+    assert all(e["JAX_NUM_PROCESSES"] == "3" for e in envs)
+    assert envs[1]["TPU_WORKER_ID"] == "0"  # job-w0 sorts first
+    assert envs[1]["JAX_COORDINATOR_ADDRESS"] == "job-w0.job-svc.ml.svc:8476"
+
+
+def test_worker_env_without_subdomain_uses_pod_names():
+    pod = PodInfo(name="a", pod_group="g")
+    env = worker_env(pod, ["a", "b"])
+    assert env["TPU_WORKER_HOSTNAMES"] == "a,b"
+
+
+# -- injection logic --------------------------------------------------------
+
+def bound_tpu_pod(api, sched, name="p0", chips=2, group=None, group_size=1):
+    obj = pod_obj(name, chips, group=group, group_size=group_size)
+    api.create_pod(obj)
+    r = sched.filter(obj, nodes_of(api))
+    assert r.nodes, r.failed
+    assert sched.bind("default", name, r.nodes[0]) is None
+    return annotations.pod_from_k8s(api.get_pod("default", name)), r.nodes[0]
+
+
+def test_compute_injection_for_scheduled_pod():
+    api, fs, _ = fake_cluster()
+    sched = make_sched(api)
+    pod, node = bound_tpu_pod(api, sched, chips=2)
+    inj = compute_injection(pod, "main", fs.provider_for(node))
+    assert inj.env["TPU_VISIBLE_CHIPS"].count(",") == 1
+    assert len(inj.devices) == 2
+    assert inj.env["JAX_NUM_PROCESSES"] == "1"
+
+
+def test_compute_injection_passthrough_for_plain_pod():
+    api, fs, _ = fake_cluster()
+    pod = annotations.pod_from_k8s(pod_obj("web", 0))
+    inj = compute_injection(pod, "main", fs.provider_for(fs.hosts()[0]))
+    assert inj.env == {} and inj.devices == []
+
+
+def test_compute_injection_sidecar_gets_nothing():
+    api, fs, _ = fake_cluster()
+    sched = make_sched(api)
+    pod, node = bound_tpu_pod(api, sched, chips=2)
+    inj = compute_injection(pod, "sidecar", fs.provider_for(node))
+    assert inj.env == {} and inj.devices == []
+
+
+# -- CreateContainer wire surgery -------------------------------------------
+
+def make_create_request(ns, pod_name, container, ann=None, hostname=""):
+    sandbox_meta = pw.encode_string_field(1, pod_name) + pw.encode_string_field(3, ns)
+    sandbox = pw.encode_len_field(1, sandbox_meta)
+    if hostname:
+        sandbox += pw.encode_string_field(2, hostname)
+    for k, v in (ann or {}).items():
+        sandbox += pw.encode_len_field(7, pw.encode_key_value(k, v))
+    cmeta = pw.encode_string_field(1, container)
+    config = pw.encode_len_field(1, cmeta) + pw.encode_string_field(2, "img:latest")
+    config += pw.encode_len_field(6, pw.encode_key_value("EXISTING", "1"))
+    return (
+        pw.encode_string_field(1, "sandbox-123")
+        + pw.encode_len_field(2, config)
+        + pw.encode_len_field(3, sandbox)
+    )
+
+
+def test_parse_and_mutate_create_request():
+    req = make_create_request("ml", "w0", "train", ann={"a": "b"}, hostname="w0")
+    ns, pod, cname, ann, hostname = parse_create_request(req)
+    assert (ns, pod, cname, hostname) == ("ml", "w0", "train", "w0")
+    assert ann == {"a": "b"}
+    from kubegpu_tpu.crishim.inject import Injection
+
+    mutated = mutate_create_request(
+        req, Injection(env={"TPU_VISIBLE_CHIPS": "0,1"}, devices=["/dev/accel0", "/dev/accel1"])
+    )
+    config = bytes(pw.get_field(mutated, 2))
+    envs = pw.decode_string_map(pw.get_all(config, 6))
+    assert envs == {"EXISTING": "1", "TPU_VISIBLE_CHIPS": "0,1"}
+    devices = pw.get_all(config, 8)
+    assert len(devices) == 2
+    assert pw.get_field(bytes(devices[0]), 2) == b"/dev/accel0"
+    # unrelated fields untouched
+    assert pw.get_field(mutated, 1) == b"sandbox-123"
+    assert pw.get_field(bytes(pw.get_field(mutated, 2)), 2) == b"img:latest"
+
+
+def test_mounts_injected():
+    from kubegpu_tpu.crishim.inject import Injection
+
+    req = make_create_request("ml", "w0", "train")
+    mutated = mutate_create_request(
+        req, Injection(mounts=[("/var/lib/libtpu", "/usr/lib/libtpu")])
+    )
+    config = bytes(pw.get_field(mutated, 2))
+    mounts = pw.get_all(config, 7)
+    assert len(mounts) == 1
+    assert pw.get_field(bytes(mounts[0]), 1) == b"/usr/lib/libtpu"
+    assert pw.get_field(bytes(mounts[0]), 2) == b"/var/lib/libtpu"
+
+
+# -- full gRPC proxy path ---------------------------------------------------
+
+_IDENT = lambda b: b  # noqa: E731
+
+
+class FakeCriBackend(grpc.GenericRpcHandler):
+    """Upstream 'containerd': records every request, returns a canned
+    CreateContainerResponse."""
+
+    def __init__(self):
+        self.requests = {}
+
+    def service(self, hcd):
+        method = hcd.method
+
+        def handler(req, ctx):
+            self.requests.setdefault(method, []).append(req)
+            return pw.encode_string_field(1, "ctr-1")
+
+        return grpc.unary_unary_rpc_method_handler(
+            handler, request_deserializer=_IDENT, response_serializer=_IDENT
+        )
+
+
+@pytest.fixture()
+def cri_stack():
+    backend = FakeCriBackend()
+    upstream = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    upstream.add_generic_rpc_handlers((backend,))
+    up_port = upstream.add_insecure_port("127.0.0.1:0")
+    upstream.start()
+
+    api, fs, _ = fake_cluster()
+    sched = make_sched(api)
+    # the shim runs on a node: pick host-0's provider
+    daemon = ShimDaemon(api, fs.provider_for(fs.hosts()[0]))
+    proxy = CriProxy(
+        upstream_target=f"127.0.0.1:{up_port}",
+        decide=daemon.decide,
+        listen_target="127.0.0.1:0",
+    )
+    proxy.start()
+    channel = grpc.insecure_channel(f"127.0.0.1:{proxy.port}")
+    yield api, sched, fs, backend, channel
+    channel.close()
+    proxy.stop(0)
+    upstream.stop(0)
+
+
+def _call(channel, method, payload):
+    return channel.unary_unary(
+        method, request_serializer=_IDENT, response_deserializer=_IDENT
+    )(payload, timeout=5)
+
+
+def test_proxy_passthrough_unrelated_method(cri_stack):
+    api, sched, fs, backend, channel = cri_stack
+    payload = pw.encode_string_field(1, "v1")
+    resp = _call(channel, "/runtime.v1.RuntimeService/Version", payload)
+    assert backend.requests["/runtime.v1.RuntimeService/Version"] == [payload]
+    assert pw.get_field(resp, 1) == b"ctr-1"
+
+
+def test_proxy_injects_for_scheduled_pod(cri_stack):
+    api, sched, fs, backend, channel = cri_stack
+    # schedule a pod onto host-0 specifically (the shim's node)
+    host0 = fs.hosts()[0]
+    obj = pod_obj("w0", 2)
+    api.create_pod(obj)
+    assert sched.filter(obj, [host0]).nodes == [host0]
+    assert sched.bind("default", "w0", host0) is None
+    stored = api.get_pod("default", "w0")
+    req = make_create_request("default", "w0", "main",
+                              ann=stored["metadata"]["annotations"])
+    _call(channel, CREATE_CONTAINER, req)
+    got = backend.requests[CREATE_CONTAINER][0]
+    config = bytes(pw.get_field(got, 2))
+    envs = pw.decode_string_map(pw.get_all(config, 6))
+    assert envs["EXISTING"] == "1"
+    assert envs["TPU_VISIBLE_CHIPS"] == "0,1"
+    assert envs["JAX_NUM_PROCESSES"] == "1"
+    assert len(pw.get_all(config, 8)) == 2
+
+
+def test_proxy_passthrough_for_non_tpu_pod(cri_stack):
+    api, sched, fs, backend, channel = cri_stack
+    obj = pod_obj("web", 0)
+    api.create_pod(obj)
+    req = make_create_request("default", "web", "main")
+    _call(channel, CREATE_CONTAINER, req)
+    got = backend.requests[CREATE_CONTAINER][0]
+    assert got == req  # byte-identical passthrough
+
+
+def test_proxy_gang_api_outage_fails_create_not_corrupts(cri_stack):
+    # regression (review finding): API down during a gang worker's
+    # CreateContainer must fail the call, not inject standalone env
+    api, sched, fs, backend, channel = cri_stack
+    objs = [pod_obj(f"w{i}", 1, group="job", group_size=4) for i in range(4)]
+    for o in objs:
+        api.create_pod(o)
+    for o in objs:
+        name = o["metadata"]["name"]
+        r = sched.filter(o, nodes_of(api))
+        assert sched.bind("default", name, r.nodes[0]) is None
+    stored = api.get_pod("default", "w1")
+    # break list_pods only (get_pod still works): partial API failure
+    def broken_list(namespace=None):
+        raise OSError("api server unreachable")
+
+    api.list_pods = broken_list
+    req = make_create_request("default", "w1", "main",
+                              ann=stored["metadata"]["annotations"])
+    with pytest.raises(grpc.RpcError) as ei:
+        _call(channel, CREATE_CONTAINER, req)
+    assert ei.value.code() == grpc.StatusCode.INTERNAL
+    assert "gang members" in ei.value.details()
+    # the request never reached containerd
+    assert CREATE_CONTAINER not in backend.requests
+
+
+def test_proxy_gang_worker_env(cri_stack):
+    api, sched, fs, backend, channel = cri_stack
+    objs = [pod_obj(f"w{i}", 1, group="job", group_size=4) for i in range(4)]
+    for o in objs:
+        o["spec"]["subdomain"] = "job-svc"
+        api.create_pod(o)
+    for o in objs:
+        name = o["metadata"]["name"]
+        r = sched.filter(o, nodes_of(api))
+        assert sched.bind("default", name, r.nodes[0]) is None
+    # create container for w2 (whichever node it landed on; the provider is
+    # host-0's but allocate only needs device indices)
+    stored = api.get_pod("default", "w2")
+    req = make_create_request("default", "w2", "main",
+                              ann=stored["metadata"]["annotations"])
+    _call(channel, CREATE_CONTAINER, req)
+    got = backend.requests[CREATE_CONTAINER][-1]
+    envs = pw.decode_string_map(pw.get_all(bytes(pw.get_field(got, 2)), 6))
+    assert envs["TPU_WORKER_ID"] == "2"
+    assert envs["JAX_NUM_PROCESSES"] == "4"
+    assert envs["JAX_COORDINATOR_ADDRESS"].startswith("w0.job-svc.default.svc:")
+    assert envs["TPU_WORKER_HOSTNAMES"].split(",")[2].startswith("w2.")
