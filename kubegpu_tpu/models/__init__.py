@@ -1,0 +1,32 @@
+"""Reference JAX workloads — the payloads the framework schedules
+(SURVEY.md §2.2: the scheduled TensorFlow/JAX jobs, re-done jax-native)."""
+
+from kubegpu_tpu.models.resnet import ResNet, ResNet18, ResNet50, ResNet101, ResNet152
+from kubegpu_tpu.models.transformer import TransformerLM
+from kubegpu_tpu.models.train import (
+    TrainState,
+    create_train_state,
+    cross_entropy,
+    make_lm_train_step,
+    make_resnet_train_step,
+    place_lm,
+    place_resnet,
+    state_shardings,
+)
+
+__all__ = [
+    "ResNet",
+    "ResNet18",
+    "ResNet50",
+    "ResNet101",
+    "ResNet152",
+    "TransformerLM",
+    "TrainState",
+    "create_train_state",
+    "cross_entropy",
+    "make_lm_train_step",
+    "make_resnet_train_step",
+    "place_lm",
+    "place_resnet",
+    "state_shardings",
+]

@@ -1,0 +1,100 @@
+"""ResNet-50 in flax — the sample workload of the north star (SURVEY.md
+§3.4: `samples/jax-resnet.yaml` gang-schedules a 4-pod data-parallel
+ResNet-50 on a v5e-16).
+
+TPU-first choices: bf16 compute / fp32 params + batch-norm stats (MXU-native
+mixed precision); NHWC layout (XLA TPU's native conv layout); BatchNorm
+statistics reduce over the *global* batch automatically under GSPMD when the
+batch dim is sharded over "data" — no axis_name/pmean plumbing needed.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 → 3x3 → 1x1 bottleneck with projection shortcut on shape change."""
+
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), use_bias=False, name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = self.act(y)
+        y = self.conv(
+            self.filters, (3, 3), self.strides, use_bias=False, name="conv2"
+        )(y)
+        y = self.norm(name="bn2")(y)
+        y = self.act(y)
+        y = self.conv(4 * self.filters, (1, 1), use_bias=False, name="conv3")(y)
+        # zero-init the last BN scale: residual branches start as identity
+        y = self.norm(scale_init=nn.initializers.zeros, name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                4 * self.filters, (1, 1), self.strides, use_bias=False, name="conv_proj"
+            )(x)
+            residual = self.norm(name="bn_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    """Classic ResNet v1.5 (stride-2 on the 3x3, per the common benchmark
+    recipe)."""
+
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, dtype=self.dtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+        )
+        x = x.astype(self.dtype)
+        x = conv(
+            self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+            use_bias=False, name="conv_init",
+        )(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = BottleneckBlock(
+                    filters=self.num_filters * 2**i,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                    name=f"stage{i + 1}_block{j + 1}",
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        # classifier head in fp32 for a numerically stable softmax
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2))  # (basic-block depth kept
+# bottleneck here for uniformity; used only for quick tests)
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3))
+ResNet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3))
+ResNet152 = partial(ResNet, stage_sizes=(3, 8, 36, 3))

@@ -1,0 +1,144 @@
+"""Train steps: jitted, sharded, donate-friendly — the compute payload.
+
+One pattern for both workloads (the scaling-book recipe): build a Mesh,
+place the state/batch with NamedShardings, jit the step, let GSPMD insert
+collectives.  Nothing here knows about hosts or NCCL-style process groups —
+multi-host is jax.distributed (brought up from the env the CRI shim
+injected) plus the same jit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from kubegpu_tpu.parallel.sharding import (
+    TRANSFORMER_TP_RULES,
+    batch_sharding,
+    param_shardings,
+    replicated,
+)
+
+
+class TrainState(flax.struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    batch_stats: Any          # {} for stateless models
+    opt_state: Any
+    apply_fn: Callable = flax.struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads, new_batch_stats=None):
+        updates, new_opt = self.tx.update(grads, self.opt_state, self.params)
+        return self.replace(
+            step=self.step + 1,
+            params=optax.apply_updates(self.params, updates),
+            batch_stats=(
+                new_batch_stats if new_batch_stats is not None else self.batch_stats
+            ),
+            opt_state=new_opt,
+        )
+
+
+def create_train_state(
+    model, rng, sample_input, tx: Optional[optax.GradientTransformation] = None
+) -> TrainState:
+    tx = tx or optax.sgd(0.1, momentum=0.9, nesterov=True)
+    variables = model.init(rng, sample_input)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+        apply_fn=model.apply,
+        tx=tx,
+    )
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], axis=-1))
+
+
+# ---------------------------------------------------------------------------
+# ResNet (image classification, DP)
+# ---------------------------------------------------------------------------
+
+def resnet_loss(state: TrainState, params, batch_stats, images, labels):
+    logits, mutated = state.apply_fn(
+        {"params": params, "batch_stats": batch_stats},
+        images,
+        train=True,
+        mutable=["batch_stats"],
+    )
+    return cross_entropy(logits, labels), mutated["batch_stats"]
+
+
+def make_resnet_train_step(mesh: Mesh, donate: bool = True):
+    """Jitted DP step: state replicated, batch sharded over "data"."""
+
+    def step(state: TrainState, images, labels):
+        (loss, new_stats), grads = jax.value_and_grad(
+            lambda p: resnet_loss(state, p, state.batch_stats, images, labels),
+            has_aux=True,
+        )(state.params)
+        return state.apply_gradients(grads, new_batch_stats=new_stats), loss
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def place_resnet(state: TrainState, batch, mesh: Mesh):
+    """Device placement: replicate state, shard the batch."""
+    state = jax.device_put(state, replicated(mesh))
+    images, labels = batch
+    images = jax.device_put(images, batch_sharding(mesh))
+    labels = jax.device_put(labels, batch_sharding(mesh))
+    return state, images, labels
+
+
+# ---------------------------------------------------------------------------
+# Transformer LM (DP x TP + SP)
+# ---------------------------------------------------------------------------
+
+def lm_loss(state: TrainState, params, tokens):
+    logits = state.apply_fn({"params": params}, tokens[:, :-1])
+    return cross_entropy(logits, tokens[:, 1:])
+
+
+def make_lm_train_step(mesh: Mesh, donate: bool = True):
+    from kubegpu_tpu.parallel.sharding import current_mesh
+
+    def step(state: TrainState, tokens):
+        # context active during tracing so the model's sequence-parallel
+        # sharding constraints resolve against this mesh
+        with current_mesh(mesh):
+            loss, grads = jax.value_and_grad(lambda p: lm_loss(state, p, tokens))(
+                state.params
+            )
+            return state.apply_gradients(grads), loss
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def state_shardings(state: TrainState, mesh: Mesh, rules) -> TrainState:
+    """NamedShardings for every leaf of the train state by path rules —
+    just param_shardings over the whole state pytree: optimizer-moment
+    trees mirror the param tree, so their leaf paths end in the same
+    ``.../q_proj/kernel`` suffix and the SAME rules shard them consistently
+    with their params (the standard requirement for TP)."""
+    return param_shardings(state, mesh, rules)
+
+
+def place_lm(state: TrainState, tokens, mesh: Mesh):
+    """TP placement per TRANSFORMER_TP_RULES (params AND mirrored optimizer
+    moments); batch sharded over "data"."""
+    state = jax.device_put(state, state_shardings(state, mesh, TRANSFORMER_TP_RULES))
+    tokens = jax.device_put(tokens, batch_sharding(mesh))
+    return state, tokens

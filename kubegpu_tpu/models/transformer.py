@@ -1,0 +1,108 @@
+"""Decoder-only transformer LM with Megatron-style TP + sequence parallelism.
+
+The second reference workload: exercises the tensor/sequence-parallel
+shardings the placement layer exists to serve (SURVEY.md §2.2: the framework
+hands JAX an ICI-contiguous sub-mesh precisely so tp/sp collectives ride
+ICI).  Module names (q_proj/o_proj/mlp_up/mlp_down/embed/lm_head) are the
+contract with ``parallel.sharding.TRANSFORMER_TP_RULES``:
+
+- column-parallel qkv/mlp_up kernels shard their output dim over "model",
+- row-parallel o_proj/mlp_down shard their input dim,
+- with ``sequence_parallel=True`` the residual stream between blocks is
+  sharded (data, model, None) so LN/residual memory divides by the tp group
+  — the long-context enabler.
+
+All attention math is einsum over static shapes (MXU-friendly, no dynamic
+control flow), causal mask via a lower-triangular bias.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from kubegpu_tpu.parallel.sharding import constrain_seq_sharded
+
+
+class CausalSelfAttention(nn.Module):
+    num_heads: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        h = self.num_heads
+        head_dim = d // h
+        dense = partial(nn.Dense, use_bias=False, dtype=self.dtype)
+        q = dense(d, name="q_proj")(x).reshape(b, s, h, head_dim)
+        k = dense(d, name="k_proj")(x).reshape(b, s, h, head_dim)
+        v = dense(d, name="v_proj")(x).reshape(b, s, h, head_dim)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(head_dim).astype(
+            self.dtype
+        )
+        mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+        scores = jnp.where(mask[None, None, :, :], scores, jnp.finfo(self.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(self.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, d)
+        return dense(d, name="o_proj")(out)
+
+
+class Block(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+    sequence_parallel: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        x = x + CausalSelfAttention(self.num_heads, self.dtype, name="attn")(y)
+        if self.sequence_parallel:
+            x = constrain_seq_sharded(x)
+        y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        y = nn.Dense(
+            d * self.mlp_ratio, use_bias=False, dtype=self.dtype, name="mlp_up"
+        )(y)
+        y = nn.gelu(y)
+        y = nn.Dense(d, use_bias=False, dtype=self.dtype, name="mlp_down")(y)
+        x = x + y
+        if self.sequence_parallel:
+            x = constrain_seq_sharded(x)
+        return x
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int = 32000
+    num_layers: int = 4
+    num_heads: int = 8
+    hidden: int = 512
+    max_seq: int = 2048
+    dtype: jnp.dtype = jnp.bfloat16
+    sequence_parallel: bool = False
+
+    @nn.compact
+    def __call__(self, tokens):
+        b, s = tokens.shape
+        x = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype, name="embed")(
+            tokens
+        )
+        pos = nn.Embed(self.max_seq, self.hidden, dtype=self.dtype, name="pos_embed")(
+            jnp.arange(s)[None, :]
+        )
+        x = x + pos
+        for i in range(self.num_layers):
+            x = Block(
+                self.num_heads,
+                dtype=self.dtype,
+                sequence_parallel=self.sequence_parallel,
+                name=f"layer{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        # fp32 logits for a stable softmax-xent
+        return nn.Dense(
+            self.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head"
+        )(x)

@@ -1,0 +1,37 @@
+"""Mesh + sharding plumbing: scheduled chips -> jax.sharding.Mesh -> GSPMD."""
+
+from kubegpu_tpu.parallel.mesh import (
+    device_mesh,
+    distributed_init_from_env,
+    local_chip_count,
+    mesh_from_assignment,
+)
+from kubegpu_tpu.parallel.sharding import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    TRANSFORMER_TP_RULES,
+    batch_sharding,
+    batch_spec,
+    constrain_batch_sharded,
+    constrain_seq_sharded,
+    param_shardings,
+    replicated,
+    spec_for_param,
+)
+
+__all__ = [
+    "device_mesh",
+    "distributed_init_from_env",
+    "local_chip_count",
+    "mesh_from_assignment",
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "TRANSFORMER_TP_RULES",
+    "batch_sharding",
+    "batch_spec",
+    "constrain_batch_sharded",
+    "constrain_seq_sharded",
+    "param_shardings",
+    "replicated",
+    "spec_for_param",
+]

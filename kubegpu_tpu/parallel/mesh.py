@@ -1,0 +1,119 @@
+"""Mesh plumbing: from scheduled chips to a ``jax.sharding.Mesh``.
+
+This is the handoff point between the control plane and XLA (SURVEY.md §2.2:
+the framework's job is to hand JAX an ICI-contiguous sub-mesh; XLA's GSPMD
+does the collectives).  Three entry paths:
+
+- ``distributed_init_from_env()`` — inside a scheduled pod, consume exactly
+  the env the CRI shim injected (crishim/inject.py) and bring up
+  ``jax.distributed`` over DCN.
+- ``device_mesh(axes)`` — build a named Mesh over the visible devices
+  (which TPU_VISIBLE_CHIPS already restricted to the allocation).
+- ``mesh_from_assignment(...)`` — order devices by the assignment's ICI
+  coordinates so that mesh-adjacent devices are ICI-adjacent (rings ride
+  ICI, not hops) before reshaping to the requested logical axes.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from kubegpu_tpu.types.info import Assignment
+
+log = logging.getLogger(__name__)
+
+
+def distributed_init_from_env(env: Optional[Dict[str, str]] = None) -> bool:
+    """Initialize jax.distributed from the injected rendezvous env;
+    returns True if multi-process init ran (idempotent-safe to call in
+    single-process jobs — it just no-ops)."""
+    env = dict(os.environ if env is None else env)
+    coord = env.get("JAX_COORDINATOR_ADDRESS")
+    try:
+        n = int(env.get("JAX_NUM_PROCESSES", "1"))
+        pid = int(env.get("JAX_PROCESS_ID", "0"))
+    except ValueError as e:
+        if coord:
+            # a coordinator is configured but the process table is mangled:
+            # running as a silent single-process job would leave the other
+            # workers blocked at rendezvous — fail loudly instead
+            raise ValueError(
+                f"malformed JAX_NUM_PROCESSES/JAX_PROCESS_ID with "
+                f"JAX_COORDINATOR_ADDRESS={coord!r} set"
+            ) from e
+        return False
+    if not coord or n <= 1:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=n, process_id=pid
+    )
+    log.info("jax.distributed up: process %d/%d via %s", pid, n, coord)
+    return True
+
+
+def device_mesh(
+    axes: Dict[str, int],
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Named mesh over the visible devices, row-major.
+
+    axes maps axis name -> size; one axis may be -1 (inferred).  E.g.
+    ``device_mesh({"data": -1})`` or ``device_mesh({"data": 2, "model": 4})``.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    sizes = dict(axes)
+    unknown = [k for k, v in sizes.items() if v == -1]
+    if len(unknown) > 1:
+        raise ValueError("at most one axis may be -1")
+    known = 1
+    for k, v in sizes.items():
+        if v != -1:
+            known *= v
+    if unknown:
+        if len(devs) % known != 0:
+            raise ValueError(f"{len(devs)} devices not divisible by {known}")
+        sizes[unknown[0]] = len(devs) // known
+    total = 1
+    for v in sizes.values():
+        total *= v
+    if total != len(devs):
+        raise ValueError(f"mesh {sizes} wants {total} devices, have {len(devs)}")
+    grid = np.array(devs, dtype=object).reshape(tuple(sizes.values()))
+    return Mesh(grid, tuple(sizes.keys()))
+
+
+def mesh_from_assignment(
+    assignment: Assignment,
+    axes: Dict[str, int],
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Mesh whose device order follows the assignment's ICI coordinates
+    (row-major over the allocated rectangle), so logical neighbours are
+    physical neighbours."""
+    devs = list(devices if devices is not None else jax.devices())
+    chips = sorted(assignment.all_chips(), key=lambda c: c.coords)
+    if len(chips) == len(devs):
+        # jax device i corresponds to the i-th *sorted* visible chip index
+        # (TPU_VISIBLE_CHIPS is emitted sorted); walking chips in coord
+        # order and mapping each chip's device_index rank gives the
+        # ICI-ordered device list
+        index_rank = {
+            idx: rank
+            for rank, idx in enumerate(sorted(c.device_index for c in chips))
+        }
+        devs = [devs[index_rank[c.device_index]] for c in chips]
+    return device_mesh(axes, devices=devs)
+
+
+def local_chip_count(env: Optional[Dict[str, str]] = None) -> int:
+    env = dict(os.environ if env is None else env)
+    vis = env.get("TPU_VISIBLE_CHIPS", "")
+    if vis:
+        return len([c for c in vis.split(",") if c.strip() != ""])
+    return jax.local_device_count()

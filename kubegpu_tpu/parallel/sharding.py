@@ -1,0 +1,145 @@
+"""Sharding rules: PartitionSpecs for DP / TP / SP over a named mesh.
+
+TPU-first design (pallas_guide / scaling-book recipe): pick a mesh, annotate
+shardings, let XLA GSPMD insert the collectives.  Nothing here opens a
+transport — the specs ARE the parallelism strategy:
+
+- DP:  batch dim over "data"; params replicated.
+- TP (Megatron-style): attention heads + MLP hidden over "model"
+  (column-parallel kernel then row-parallel kernel → one psum per block,
+  riding ICI).
+- SP:  between blocks, activations re-shard their sequence dim over
+  "model" (with_sharding_constraint) so layernorm/residual work is also
+  divided — long-context's memory bottleneck.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import re
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+# Ambient mesh for sharding constraints inside model code (jax's own
+# context-mesh API has churned across versions; an explicit, version-proof
+# context of our own keeps model modules mesh-agnostic).
+_ctx = threading.local()
+
+
+@contextlib.contextmanager
+def current_mesh(mesh: Mesh):
+    prev = getattr(_ctx, "mesh", None)
+    _ctx.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _ctx.mesh = prev
+
+
+def get_current_mesh() -> Optional[Mesh]:
+    return getattr(_ctx, "mesh", None)
+
+
+def batch_spec() -> P:
+    return P(DATA_AXIS)
+
+
+def replicated_spec() -> P:
+    return P()
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-dim batch sharding (works for inputs and labels alike)."""
+    return NamedSharding(mesh, batch_spec())
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, replicated_spec())
+
+
+# ---------------------------------------------------------------------------
+# Parameter sharding rules — path-pattern → PartitionSpec.
+# ---------------------------------------------------------------------------
+
+# Megatron-style TP for the transformer blocks (models/transformer.py): the
+# first (column-parallel) matmul shards its OUTPUT dim, the second
+# (row-parallel) shards its INPUT dim, so activations only need one
+# all-reduce per block.
+TRANSFORMER_TP_RULES: Tuple[Tuple[str, P], ...] = (
+    (r".*embed.*/embedding$", P(None, MODEL_AXIS)),
+    (r".*(q_proj|k_proj|v_proj)/kernel$", P(None, MODEL_AXIS)),
+    (r".*o_proj/kernel$", P(MODEL_AXIS, None)),
+    (r".*mlp_up/kernel$", P(None, MODEL_AXIS)),
+    (r".*mlp_down/kernel$", P(MODEL_AXIS, None)),
+    (r".*lm_head/kernel$", P(None, MODEL_AXIS)),
+    (r".*bias$", P()),
+    (r".*scale$", P()),
+)
+
+
+def spec_for_param(path: str, rules: Tuple[Tuple[str, P], ...]) -> P:
+    for pattern, spec in rules:
+        if re.match(pattern, path):
+            return spec
+    return P()
+
+
+def keypath_str(kp) -> str:
+    """Canonical '/'-joined rendering of a jax tree keypath (the single
+    source of truth — rules are written against this form)."""
+    parts = []
+    for k in kp:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):  # GetAttrKey
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def param_shardings(
+    params: Any,
+    mesh: Mesh,
+    rules: Optional[Tuple[Tuple[str, P], ...]] = None,
+) -> Any:
+    """A pytree of NamedShardings matching `params`: rules matched per
+    keypath (None rules → fully replicated, i.e. plain DP); scalar leaves
+    always replicate.  Works on any state pytree, not just params —
+    optimizer-moment trees mirror param paths, so the same rules shard them
+    consistently."""
+
+    def spec_of(kp, leaf) -> NamedSharding:
+        if hasattr(leaf, "ndim") and leaf.ndim == 0:
+            return NamedSharding(mesh, P())
+        spec = spec_for_param(keypath_str(kp), rules) if rules else P()
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(spec_of, params)
+
+
+def constrain_seq_sharded(x: jax.Array) -> jax.Array:
+    """Sequence-parallel residual/LN activations: [batch, seq, hidden]
+    sharded (data, model, None).  No-op outside a ``current_mesh`` context
+    (single-device paths)."""
+    mesh = get_current_mesh()
+    if mesh is None or MODEL_AXIS not in mesh.axis_names:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS, None))
+    )
+
+
+def constrain_batch_sharded(x: jax.Array) -> jax.Array:
+    mesh = get_current_mesh()
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(DATA_AXIS)))

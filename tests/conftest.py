@@ -1,8 +1,13 @@
 """Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
 
-Multi-chip TPU hardware is not available in CI; all sharding/mesh tests run on
-8 virtual CPU devices (the driver separately dry-run-compiles the multi-chip
-path via __graft_entry__.dryrun_multichip)."""
+Multi-chip TPU hardware is not available in CI; all sharding/mesh tests run
+on 8 virtual CPU devices (the driver separately dry-run-compiles the
+multi-chip path via __graft_entry__.dryrun_multichip).
+
+Note: this environment's sitecustomize may import jax at interpreter start
+with a TPU platform pinned, so setting env vars alone is not enough —
+jax.config.update('jax_platforms', ...) before first backend use is the
+reliable switch (backends initialize lazily on first device query)."""
 
 import os
 import sys
@@ -13,3 +18,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # pure control-plane environments without jax
+    pass
