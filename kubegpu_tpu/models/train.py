@@ -49,17 +49,26 @@ def create_train_state(
     model, rng, sample_input, tx: Optional[optax.GradientTransformation] = None
 ) -> TrainState:
     tx = tx or optax.sgd(0.1, momentum=0.9, nesterov=True)
-    variables = model.init(rng, sample_input)
-    params = variables["params"]
-    batch_stats = variables.get("batch_stats", {})
-    return TrainState(
-        step=jnp.zeros((), jnp.int32),
-        params=params,
-        batch_stats=batch_stats,
-        opt_state=tx.init(params),
-        apply_fn=model.apply,
-        tx=tx,
-    )
+
+    # jit the whole init: eager flax init dispatches one tiny op per
+    # parameter, which is pathologically slow on remote/tunnelled
+    # accelerators (measured ~15x slower than one compiled program for
+    # ResNet-50 on a tunnelled v5e chip).  sample_input is a traced
+    # argument, not a closure capture — baking a real batch in as a
+    # constant would bloat the program and key caches on its values.
+    def _init(rng, x):
+        variables = model.init(rng, x)
+        params = variables["params"]
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=variables.get("batch_stats", {}),
+            opt_state=tx.init(params),
+            apply_fn=model.apply,
+            tx=tx,
+        )
+
+    return jax.jit(_init)(rng, sample_input)
 
 
 def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
