@@ -1,0 +1,116 @@
+"""Training-worker entrypoint for the sample pod specs (SURVEY.md §3.4).
+
+This is the process that runs *inside* the scheduled containers — the
+workload side of the framework's env contract.  It consumes exactly what the
+CRI shim injects (crishim/inject.py):
+
+    TPU_VISIBLE_CHIPS        which host chips this container may claim
+    TPU_WORKER_ID            this worker's index in the gang
+    JAX_COORDINATOR_ADDRESS  worker 0's host:port for jax.distributed
+    JAX_NUM_PROCESSES        gang size
+    JAX_PROCESS_ID           == TPU_WORKER_ID
+
+and runs data-parallel ResNet-50 training steps under pjit over a
+``("data",)`` mesh spanning the gang's chips, printing the pod-visible half
+of the north-star metric: time from process start to the first completed
+optimizer step (BASELINE.json: schedule-to-first-step < 60 s).
+
+Single-worker mode (JAX_NUM_PROCESSES absent or 1) skips the distributed
+rendezvous, so the same image serves BASELINE configs 2-5.
+
+    python -m kubegpu_tpu.models.worker --steps 20 --batch-per-chip 32
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import time
+
+log = logging.getLogger("kubegpu_tpu.worker")
+
+
+def initialize_distributed() -> None:
+    """jax.distributed rendezvous from the injected env; no-op when solo."""
+    import jax
+
+    num = int(os.environ.get("JAX_NUM_PROCESSES", "1") or 1)
+    if num <= 1:
+        return
+    coordinator = os.environ["JAX_COORDINATOR_ADDRESS"]
+    pid = int(os.environ.get("JAX_PROCESS_ID", os.environ.get("TPU_WORKER_ID", "0")))
+    log.info("jax.distributed.initialize(%s, num=%d, id=%d)", coordinator, num, pid)
+    jax.distributed.initialize(
+        coordinator_address=coordinator, num_processes=num, process_id=pid
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="resnet50", choices=["resnet50", "resnet-tiny"])
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch-per-chip", type=int, default=32)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--num-classes", type=int, default=1000)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    t0 = time.monotonic()
+    initialize_distributed()
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubegpu_tpu.models import (
+        ResNet,
+        ResNet50,
+        create_train_state,
+        make_resnet_train_step,
+        place_resnet,
+    )
+    from kubegpu_tpu.parallel import device_mesh
+
+    n = jax.device_count()
+    log.info(
+        "devices: %d global / %d local (%s), visible_chips=%s",
+        n,
+        jax.local_device_count(),
+        jax.devices()[0].platform,
+        os.environ.get("TPU_VISIBLE_CHIPS", "<unset>"),
+    )
+    mesh = device_mesh({"data": n})
+    if args.model == "resnet50":
+        model = ResNet50(num_classes=args.num_classes)
+        size = args.image_size
+    else:  # CI-sized twin, same code path
+        model = ResNet(stage_sizes=(1, 1, 1, 1), num_filters=8, num_classes=10)
+        size = 32
+
+    batch = args.batch_per_chip * n
+    rng = jax.random.PRNGKey(0)
+    images = jnp.ones((batch, size, size, 3), jnp.float32)
+    labels = jnp.zeros((batch,), jnp.int32)
+    state = create_train_state(model, rng, images)
+    state, images, labels = place_resnet(state, (images, labels), mesh)
+    step = make_resnet_train_step(mesh)
+
+    state, loss = step(state, images, labels)
+    jax.block_until_ready(loss)
+    first_step_s = time.monotonic() - t0
+    # the string the e2e latency probe (and a human) greps for
+    print(f"FIRST_STEP_DONE seconds={first_step_s:.2f} loss={float(loss):.4f}", flush=True)
+
+    t1 = time.monotonic()
+    for _ in range(args.steps - 1):
+        state, loss = step(state, images, labels)
+    jax.block_until_ready(loss)
+    dt = time.monotonic() - t1
+    if args.steps > 1:
+        ips = batch * (args.steps - 1) / dt
+        print(f"steady_state images_per_sec={ips:.1f} loss={float(loss):.4f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

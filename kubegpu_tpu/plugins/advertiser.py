@@ -69,3 +69,31 @@ class Advertiser:
 
     def stop(self) -> None:
         self._stop.set()
+
+
+def main(argv=None) -> None:
+    """DaemonSet entrypoint (deploy/advertiser-daemonset.yaml): discover
+    this host's TPU fragment via GkeTpuProvider and advertise it to the real
+    API server on a loop (--once for a single cycle)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--interval", type=float, default=30.0)
+    ap.add_argument("--once", action="store_true", help="one cycle, then exit")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+
+    from kubegpu_tpu.plugins.discovery import GkeTpuProvider
+    from kubegpu_tpu.utils.apiserver import KubeApiServer
+
+    adv = Advertiser(GkeTpuProvider(), KubeApiServer(), interval_s=args.interval)
+    if args.once:
+        name = adv.advertise_once()
+        log.info("advertised once: %s", name or "<no TPUs on this host>")
+    else:
+        adv.run()
+
+
+if __name__ == "__main__":
+    main()
