@@ -1,0 +1,26 @@
+# Developer entry points.  Everything runs without TPUs (fake provider +
+# 8-device virtual CPU mesh) except `bench`, which uses the real accelerator.
+
+PY ?= python
+
+.PHONY: test native bench dryrun image clean
+
+test: native
+	$(PY) -m pytest tests/ -x -q
+
+native:
+	$(MAKE) -C native
+
+bench:
+	$(PY) bench.py
+
+dryrun:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+	  $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+image:
+	docker build -f deploy/Dockerfile -t kubegpu-tpu:latest .
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -exec rm -rf {} +

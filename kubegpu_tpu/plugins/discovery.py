@@ -96,14 +96,41 @@ class GkeTpuProvider(TpuProvider):
         env: Optional[Dict[str, str]] = None,
         list_devfs: Optional[Callable[[], List[str]]] = None,
         node_name: Optional[str] = None,
+        use_native: bool = True,
     ) -> None:
         self._env = dict(os.environ if env is None else env)
+        # an explicitly injected devfs lister (tests, exotic hosts) takes
+        # priority over the native shim; otherwise prefer native and fall
+        # back to the pure-Python glob when the library isn't built
         self._list_devfs = list_devfs or self._default_devfs
+        self._use_native = use_native and list_devfs is None
         self._node_name = node_name or self._env.get("NODE_NAME") or socket.gethostname()
+        self._probe_cache: Tuple[float, object] = (0.0, None)
+        self._probe_ttl_s = 1.0
 
     @staticmethod
     def _default_devfs() -> List[str]:
         return sorted(glob.glob("/dev/accel*")) or sorted(glob.glob("/dev/vfio/[0-9]*"))
+
+    def _native_probe(self):
+        """Host probe via the C++ shim (native/tpu_discovery.cpp), the
+        NVML-binding analog; None when unavailable or disabled.  Briefly
+        memoized: one advertise cycle (enumerate + health) and one
+        CreateContainer (allocate) each cost a single devfs scan."""
+        if not self._use_native:
+            return None
+        import time as _time
+
+        now = _time.monotonic()
+        ts, cached = self._probe_cache
+        if cached is not None and now - ts < self._probe_ttl_s:
+            return cached
+        from kubegpu_tpu.plugins import native
+
+        out = native.probe()
+        if out is not None:
+            self._probe_cache = (now, out)
+        return out
 
     def _device_map(self) -> Dict[int, str]:
         """chip device_index -> device node path.
@@ -113,6 +140,9 @@ class GkeTpuProvider(TpuProvider):
         wrong chip dead and hand containers a neighbour's device).  Paths
         without a parseable accel index (vfio) are ranked by trailing number
         numerically (lexicographic sort puts vfio/10 before vfio/2)."""
+        nat = self._native_probe()
+        if nat is not None:
+            return {c.index: c.path for c in nat.chips}
         paths = self._list_devfs()
         out: Dict[int, str] = {}
         unnumbered: List[Tuple[int, str]] = []
@@ -251,4 +281,10 @@ class GkeTpuProvider(TpuProvider):
         return AllocateResponse(env=env, devices=devices, mounts=[])
 
     def healthy_device_indices(self) -> Optional[List[int]]:
+        nat = self._native_probe()
+        if nat is not None:
+            # native adds an accessibility check on top of node presence: a
+            # chip whose device node exists but cannot be opened R+W is
+            # present-but-dead and must not be advertised as capacity
+            return sorted(c.index for c in nat.chips if c.accessible)
         return sorted(self._device_map())

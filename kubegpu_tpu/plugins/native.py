@@ -1,0 +1,133 @@
+"""ctypes binding for the native TPU discovery shim (native/tpu_discovery.cpp).
+
+The Python face of the framework's one native component — the TPU analog of
+the reference's cgo→NVML layer (SURVEY.md §2 #7).  Loads
+``libtpu_discovery.so`` and exposes a typed :func:`probe`; every consumer
+must tolerate :func:`load` returning None (library not built / wrong arch)
+and fall back to the pure-Python devfs scan in ``discovery.py`` — native is
+an acceleration and fidelity layer, never a hard dependency.
+
+Search order for the library: $KUBEGPU_TPU_NATIVE_LIB, the in-repo build
+(native/libtpu_discovery.so), then the system loader path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from dataclasses import dataclass
+from typing import List, Optional
+
+_MAX_CHIPS = 256
+_PATH_MAX = 128
+
+
+class _ChipNode(ctypes.Structure):
+    _fields_ = [
+        ("index", ctypes.c_int),
+        ("path", ctypes.c_char * _PATH_MAX),
+        ("accessible", ctypes.c_int),
+    ]
+
+
+class _HostProbe(ctypes.Structure):
+    _fields_ = [
+        ("chip_count", ctypes.c_int),
+        ("chips", _ChipNode * _MAX_CHIPS),
+        ("libtpu_present", ctypes.c_int),
+        ("libtpu_has_pjrt", ctypes.c_int),
+        ("libtpu_path", ctypes.c_char * _PATH_MAX),
+    ]
+
+
+@dataclass(frozen=True)
+class ChipNode:
+    index: int
+    path: str
+    accessible: bool
+
+
+@dataclass(frozen=True)
+class HostProbe:
+    chips: List[ChipNode]
+    libtpu_present: bool
+    libtpu_has_pjrt: bool
+    libtpu_path: str
+
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _candidates() -> List[str]:
+    out = []
+    env = os.environ.get("KUBEGPU_TPU_NATIVE_LIB")
+    if env:
+        out.append(env)
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    out.append(os.path.join(repo_root, "native", "libtpu_discovery.so"))
+    out.append("libtpu_discovery.so")
+    return out
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The shim library, or None when unavailable (cached either way)."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        for path in _candidates():
+            try:
+                lib = ctypes.CDLL(path)
+                lib.tpu_discovery_probe.argtypes = [
+                    ctypes.c_char_p,
+                    ctypes.c_int,
+                    ctypes.POINTER(_HostProbe),
+                ]
+                lib.tpu_discovery_probe.restype = ctypes.c_int
+                lib.tpu_discovery_version.restype = ctypes.c_char_p
+            except (OSError, AttributeError):
+                # wrong library at this path (e.g. a foreign .so via
+                # $KUBEGPU_TPU_NATIVE_LIB): keep trying the next candidate
+                continue
+            _lib = lib
+            return _lib
+        _load_failed = True
+        return None
+
+
+def version() -> Optional[str]:
+    lib = load()
+    return lib.tpu_discovery_version().decode() if lib else None
+
+
+def probe(devfs_root: str = "/dev", check_libtpu: bool = False) -> Optional[HostProbe]:
+    """One native probe of the host; None when the library is unavailable.
+
+    check_libtpu dlopens libtpu.so to report its presence — expensive (it is
+    a very large library), so off by default; the device-node scan is all
+    the enumeration/health paths need."""
+    lib = load()
+    if lib is None:
+        return None
+    raw = _HostProbe()
+    rc = lib.tpu_discovery_probe(devfs_root.encode(), 1 if check_libtpu else 0,
+                                 ctypes.byref(raw))
+    if rc != 0:
+        return None
+    chips = [
+        ChipNode(
+            index=raw.chips[i].index,
+            path=raw.chips[i].path.decode(),
+            accessible=bool(raw.chips[i].accessible),
+        )
+        for i in range(raw.chip_count)
+    ]
+    return HostProbe(
+        chips=chips,
+        libtpu_present=bool(raw.libtpu_present),
+        libtpu_has_pjrt=bool(raw.libtpu_has_pjrt),
+        libtpu_path=raw.libtpu_path.decode(),
+    )

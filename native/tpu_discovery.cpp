@@ -1,0 +1,134 @@
+// Native TPU discovery shim — the TPU-native equivalent of the reference's
+// cgo→NVML binding (SURVEY.md §2 #7, §2.1): the one native component the
+// reference had, rebuilt for TPU hosts.  Where NVML answered "how many GPUs,
+// what state are they in", this library answers the same for TPU chips from
+// the three host-level sources a (GKE/GCE) TPU VM exposes:
+//
+//   1. devfs:   /dev/accel<N> (TPU VM runtime) or /dev/vfio/<N> device nodes
+//   2. libtpu:  dlopen("libtpu.so"), presence of the PJRT entry symbol
+//   3. env:     TPU_ACCELERATOR_TYPE / TPU_TOPOLOGY / TPU_WORKER_ID
+//
+// Exposed as a plain C ABI consumed from Python via ctypes
+// (kubegpu_tpu/plugins/native.py) — no pybind11 dependency.  All inputs are
+// parameterized (devfs root, env already read by the caller) so the library
+// itself is unit-testable against a fabricated /dev tree.
+//
+// Health semantics: a chip index whose device node exists but is not
+// readable+writable by this process is reported present-but-unhealthy;
+// the Python layer folds that into the advertised capacity so dead chips
+// drop out of the cluster's allocatable set (SURVEY.md §5.3).
+
+#include <dirent.h>
+#include <dlfcn.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <climits>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+#define TPU_DISCOVERY_MAX_CHIPS 256
+#define TPU_DISCOVERY_PATH_MAX 128
+
+typedef struct {
+  int index;                          // chip index parsed from the node name
+  char path[TPU_DISCOVERY_PATH_MAX];  // absolute device-node path
+  int accessible;                     // 1 = R+W openable by this process
+} tpu_chip_node;
+
+typedef struct {
+  int chip_count;
+  tpu_chip_node chips[TPU_DISCOVERY_MAX_CHIPS];
+  int libtpu_present;   // dlopen(libtpu.so) succeeded
+  int libtpu_has_pjrt;  // ...and it exports GetPjrtApi
+  char libtpu_path[TPU_DISCOVERY_PATH_MAX];
+} tpu_host_probe;
+
+const char* tpu_discovery_version(void) { return "kubegpu-tpu-discovery/1"; }
+
+namespace {
+
+// accel nodes carry their chip index in the name ("accel3" -> 3); vfio
+// nodes are numbered but the number is a group id, not a chip id — they
+// are ranked numerically and re-indexed densely by the caller's policy.
+bool parse_index(const char* name, const char* prefix, int* out) {
+  size_t plen = std::strlen(prefix);
+  if (std::strncmp(name, prefix, plen) != 0) return false;
+  const char* digits = name + plen;
+  if (*digits == '\0') return false;
+  char* end = nullptr;
+  long v = std::strtol(digits, &end, 10);
+  if (*end != '\0' || v < 0 || v > INT_MAX) return false;
+  *out = static_cast<int>(v);
+  return true;
+}
+
+void scan_dir(const std::string& dir, const char* prefix, bool index_is_chip_id,
+              std::vector<tpu_chip_node>* out) {
+  DIR* d = opendir(dir.c_str());
+  if (d == nullptr) return;
+  std::vector<tpu_chip_node> found;
+  struct dirent* ent;
+  while ((ent = readdir(d)) != nullptr) {
+    int idx = -1;
+    if (!parse_index(ent->d_name, prefix, &idx)) continue;
+    std::string path = dir + "/" + ent->d_name;
+    struct stat st{};
+    if (stat(path.c_str(), &st) != 0) continue;
+    tpu_chip_node node{};
+    node.index = idx;
+    std::snprintf(node.path, sizeof(node.path), "%s", path.c_str());
+    node.accessible = access(path.c_str(), R_OK | W_OK) == 0 ? 1 : 0;
+    found.push_back(node);
+  }
+  closedir(d);
+  // deterministic ascending order regardless of readdir order
+  for (size_t i = 0; i < found.size(); ++i)
+    for (size_t j = i + 1; j < found.size(); ++j)
+      if (found[j].index < found[i].index) std::swap(found[i], found[j]);
+  if (!index_is_chip_id)  // vfio: dense re-index after sorting
+    for (size_t i = 0; i < found.size(); ++i) found[i].index = static_cast<int>(i);
+  out->insert(out->end(), found.begin(), found.end());
+}
+
+}  // namespace
+
+// Probe device nodes under `devfs_root` (e.g. "/dev"); fills `out`.
+// probe_libtpu != 0 additionally dlopens libtpu.so to report its presence —
+// costly (libtpu is huge), so callers on hot paths pass 0.
+// Returns 0 on success (including zero chips — a CPU host), -1 on bad args.
+int tpu_discovery_probe(const char* devfs_root, int probe_libtpu,
+                        tpu_host_probe* out) {
+  if (devfs_root == nullptr || out == nullptr) return -1;
+  std::memset(out, 0, sizeof(*out));
+
+  std::vector<tpu_chip_node> nodes;
+  scan_dir(devfs_root, "accel", /*index_is_chip_id=*/true, &nodes);
+  if (nodes.empty())  // TPU VM runtime absent: fall back to vfio passthrough
+    scan_dir(std::string(devfs_root) + "/vfio", "", /*index_is_chip_id=*/false,
+             &nodes);
+  for (const auto& n : nodes) {
+    if (out->chip_count >= TPU_DISCOVERY_MAX_CHIPS) break;
+    out->chips[out->chip_count++] = n;
+  }
+
+  if (probe_libtpu == 0) return 0;
+  static const char* kLibtpuNames[] = {"libtpu.so", "libtpu.so.1"};
+  for (const char* name : kLibtpuNames) {
+    void* h = dlopen(name, RTLD_LAZY | RTLD_LOCAL);
+    if (h == nullptr) continue;
+    out->libtpu_present = 1;
+    out->libtpu_has_pjrt = dlsym(h, "GetPjrtApi") != nullptr ? 1 : 0;
+    std::snprintf(out->libtpu_path, sizeof(out->libtpu_path), "%s", name);
+    dlclose(h);
+    break;
+  }
+  return 0;
+}
+
+}  // extern "C"
