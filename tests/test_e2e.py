@@ -1,0 +1,191 @@
+"""Full-stack end-to-end over both wire protocols (SURVEY.md §3.4).
+
+The integration coverage the reference lacked (SURVEY.md §4): one flow from
+the north-star sample YAML through every process boundary the real cluster
+has — advertiser → extender **HTTP** (filter/prioritize/bind as
+kube-scheduler would call it) → assignment annotations → CRI **gRPC**
+CreateContainer through the proxy — asserting the container config that
+reaches "containerd" carries the full TPU + gang env.  Plus two gangs
+racing through the threaded HTTP server for one slice (BASELINE config 5's
+concurrency hazard: SURVEY.md §7 hard part (c))."""
+
+import json
+import pathlib
+import threading
+import urllib.request
+from concurrent import futures
+
+import grpc
+import pytest
+import yaml
+
+from kubegpu_tpu.crishim import CriProxy, ShimDaemon
+from kubegpu_tpu.crishim.proxy import CREATE_CONTAINER
+from kubegpu_tpu.plugins import Advertiser, FakeSlice
+from kubegpu_tpu.scheduler import Scheduler
+from kubegpu_tpu.scheduler.server import ExtenderServer
+from kubegpu_tpu.types import annotations, is_contiguous_submesh
+from kubegpu_tpu.utils import InMemoryApiServer
+from kubegpu_tpu.utils import protowire as pw
+
+from test_crishim import FakeCriBackend, _call, make_create_request
+
+SAMPLES = pathlib.Path(__file__).resolve().parent.parent / "samples"
+MESH = (4, 4)
+
+
+@pytest.fixture()
+def stack():
+    api = InMemoryApiServer()
+    fs = FakeSlice(slice_id="v5e-16", mesh_shape=MESH, host_block=(2, 2))
+    for prov in fs.providers().values():
+        Advertiser(prov, api).advertise_once()
+    server = ExtenderServer(Scheduler(api), listen=("127.0.0.1", 0))
+    server.start()
+    yield api, fs, server
+    server.stop()
+
+
+def http(server, method, path, obj=None):
+    host, port = server.address
+    req = urllib.request.Request(
+        f"http://{host}:{port}{path}",
+        data=None if obj is None else json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+        method=method,
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def schedule_over_http(server, api, pod_objs):
+    nodes = sorted(n["metadata"]["name"] for n in api.list_nodes())
+    for obj in pod_objs:
+        http(server, "POST", "/pods", obj)
+    out = {}
+    for obj in pod_objs:
+        name = obj["metadata"]["name"]
+        f = http(server, "POST", "/filter", {"Pod": obj, "NodeNames": nodes})
+        assert f["NodeNames"], (name, f["FailedNodes"])
+        scores = {e["Host"]: e["Score"] for e in
+                  http(server, "POST", "/prioritize", {"Pod": obj, "NodeNames": f["NodeNames"]})}
+        best = max(f["NodeNames"], key=lambda n: (scores.get(n, 0), n))
+        b = http(server, "POST", "/bind",
+                 {"PodNamespace": "default", "PodName": name, "Node": best})
+        assert not b["Error"], (name, b)
+        out[name] = annotations.assignment_from_pod(api.get_pod("default", name))
+    return out
+
+
+def test_north_star_sample_full_stack_over_wire(stack):
+    api, fs, server = stack
+    pods = [d for d in yaml.safe_load_all((SAMPLES / "jax-resnet.yaml").read_text())
+            if d and d.get("kind") == "Pod"]
+    assigned = schedule_over_http(server, api, pods)
+
+    union = {c.coords for a in assigned.values() for c in a.all_chips()}
+    assert len(union) == 4 and is_contiguous_submesh(union, MESH)
+
+    # one CRI proxy per node that received gang members, like the DaemonSet
+    by_node = {}
+    for name, a in assigned.items():
+        by_node.setdefault(a.node, []).append(name)
+
+    for node, names in by_node.items():
+        backend = FakeCriBackend()
+        upstream = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        upstream.add_generic_rpc_handlers((backend,))
+        up_port = upstream.add_insecure_port("127.0.0.1:0")
+        upstream.start()
+        daemon = ShimDaemon(api, fs.provider_for(node))
+        proxy = CriProxy(upstream_target=f"127.0.0.1:{up_port}",
+                         decide=daemon.decide, listen_target="127.0.0.1:0")
+        proxy.start()
+        channel = grpc.insecure_channel(f"127.0.0.1:{proxy.port}")
+        try:
+            for name in names:
+                req = make_create_request("default", name, "worker")
+                _call(channel, CREATE_CONTAINER, req)
+                mutated = backend.requests[CREATE_CONTAINER][-1]
+                config = bytes(pw.get_field(mutated, 2))
+                env = pw.decode_string_map(pw.get_all(config, 6))
+                assert env["TPU_VISIBLE_CHIPS"]
+                assert env["JAX_NUM_PROCESSES"] == "4"
+                assert env["TPU_WORKER_ID"] == env["JAX_PROCESS_ID"]
+                assert f"{name}.jax-resnet.default.svc" in env["TPU_WORKER_HOSTNAMES"]
+                # device nodes rode along with the env
+                assert pw.get_all(config, 8), "no devices injected"
+        finally:
+            channel.close()
+            proxy.stop(0)
+            upstream.stop(0)
+
+
+def test_two_gangs_race_over_threaded_http(stack):
+    api, fs, server = stack
+    pods = [d for d in yaml.safe_load_all((SAMPLES / "multi-tenant.yaml").read_text())
+            if d and d.get("kind") == "Pod"]
+    gangs = {}
+    for obj in pods:
+        gangs.setdefault(
+            obj["metadata"]["annotations"]["kubegpu-tpu/pod-group"], []
+        ).append(obj)
+    assert set(gangs) == {"tenant-a", "tenant-b"}
+    for obj in pods:
+        http(server, "POST", "/pods", obj)
+
+    results, errors = {}, []
+
+    def run_gang(gang, objs):
+        try:
+            nodes = sorted(n["metadata"]["name"] for n in api.list_nodes())
+            for obj in objs:
+                name = obj["metadata"]["name"]
+                f = http(server, "POST", "/filter", {"Pod": obj, "NodeNames": nodes})
+                assert f["NodeNames"], (name, f["FailedNodes"])
+                b = http(server, "POST", "/bind",
+                         {"PodNamespace": "default", "PodName": name,
+                          "Node": f["NodeNames"][0]})
+                assert not b["Error"], (name, b)
+                results[name] = annotations.assignment_from_pod(
+                    api.get_pod("default", name))
+        except Exception as e:  # noqa: BLE001
+            errors.append((gang, repr(e)))
+
+    threads = [threading.Thread(target=run_gang, args=(g, objs))
+               for g, objs in gangs.items()]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+
+    per_gang = {}
+    for obj in pods:
+        name = obj["metadata"]["name"]
+        gang = obj["metadata"]["annotations"]["kubegpu-tpu/pod-group"]
+        per_gang.setdefault(gang, set()).update(
+            c.coords for c in results[name].all_chips())
+    assert all(len(v) == 8 for v in per_gang.values()), {
+        k: len(v) for k, v in per_gang.items()}
+    assert not (per_gang["tenant-a"] & per_gang["tenant-b"]), "double-allocated chips"
+    for gang, coords in per_gang.items():
+        assert is_contiguous_submesh(coords, MESH), f"{gang} fragmented"
+
+
+def test_state_survives_extender_restart_over_http(stack):
+    """§3.5 replay at the service level: a brand-new extender process built
+    from the same API server reports the identical used-set."""
+    api, fs, server = stack
+    pods = [d for d in yaml.safe_load_all((SAMPLES / "four-chip.yaml").read_text())
+            if d and d.get("kind") == "Pod"]
+    schedule_over_http(server, api, pods)
+    before = http(server, "GET", "/state")
+
+    server2 = ExtenderServer(Scheduler(api), listen=("127.0.0.1", 0))
+    server2.start()
+    try:
+        after = http(server2, "GET", "/state")
+        assert after["slices"] == before["slices"]
+    finally:
+        server2.stop()
