@@ -30,6 +30,7 @@ from kubegpu_tpu.parallel.sharding import constrain_seq_sharded
 class CausalSelfAttention(nn.Module):
     num_heads: int
     dtype: jnp.dtype = jnp.bfloat16
+    attn_impl: str = "einsum"  # "einsum" | "flash" (ops/attention.py pallas kernel)
 
     @nn.compact
     def __call__(self, x):
@@ -40,13 +41,22 @@ class CausalSelfAttention(nn.Module):
         q = dense(d, name="q_proj")(x).reshape(b, s, h, head_dim)
         k = dense(d, name="k_proj")(x).reshape(b, s, h, head_dim)
         v = dense(d, name="v_proj")(x).reshape(b, s, h, head_dim)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(head_dim).astype(
-            self.dtype
-        )
-        mask = jnp.tril(jnp.ones((s, s), dtype=bool))
-        scores = jnp.where(mask[None, None, :, :], scores, jnp.finfo(self.dtype).min)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(self.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, d)
+        if self.attn_impl == "flash":
+            from kubegpu_tpu.ops import flash_attention
+
+            out = flash_attention(q, k, v, True).reshape(b, s, d)
+        else:
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(head_dim).astype(
+                self.dtype
+            )
+            mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+            scores = jnp.where(
+                mask[None, None, :, :], scores, jnp.finfo(self.dtype).min
+            )
+            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
+                self.dtype
+            )
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, d)
         return dense(d, name="o_proj")(out)
 
 
@@ -55,12 +65,15 @@ class Block(nn.Module):
     mlp_ratio: int = 4
     dtype: jnp.dtype = jnp.bfloat16
     sequence_parallel: bool = False
+    attn_impl: str = "einsum"
 
     @nn.compact
     def __call__(self, x):
         d = x.shape[-1]
         y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
-        x = x + CausalSelfAttention(self.num_heads, self.dtype, name="attn")(y)
+        x = x + CausalSelfAttention(
+            self.num_heads, self.dtype, self.attn_impl, name="attn"
+        )(y)
         if self.sequence_parallel:
             x = constrain_seq_sharded(x)
         y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
@@ -83,6 +96,7 @@ class TransformerLM(nn.Module):
     max_seq: int = 2048
     dtype: jnp.dtype = jnp.bfloat16
     sequence_parallel: bool = False
+    attn_impl: str = "einsum"
 
     @nn.compact
     def __call__(self, tokens):
@@ -99,6 +113,7 @@ class TransformerLM(nn.Module):
                 self.num_heads,
                 dtype=self.dtype,
                 sequence_parallel=self.sequence_parallel,
+                attn_impl=self.attn_impl,
                 name=f"layer{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)

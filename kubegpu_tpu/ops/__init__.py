@@ -1,0 +1,15 @@
+"""Hot ops for the TPU workload stack (pallas kernels + ring collectives)."""
+
+from kubegpu_tpu.ops.attention import (
+    flash_attention,
+    reference_attention,
+    ring_attention,
+    ring_attention_sharded,
+)
+
+__all__ = [
+    "flash_attention",
+    "reference_attention",
+    "ring_attention",
+    "ring_attention_sharded",
+]

@@ -1,0 +1,282 @@
+"""Attention ops: Pallas flash attention + ring attention (context parallel).
+
+The hot-op layer of the workload stack (the reference framework schedules
+long-context jobs; this is what those jobs run).  Two TPU-first designs:
+
+- :func:`flash_attention` — a Pallas TPU kernel (pallas_guide.md patterns):
+  online-softmax over K/V blocks so the s×s score matrix never exists in
+  HBM; grid (batch*heads, q-blocks, k-blocks) with the sequential innermost
+  grid dimension carrying running max/denominator in VMEM scratch; causal
+  upper-triangle blocks are skipped outright (half the FLOPs).  MXU-shaped:
+  128-lane blocks, f32 accumulation via preferred_element_type.  Backward
+  is a recompute VJP (flash forward is O(s) memory; the backward recomputes
+  scores blockwise through the same kernel semantics via XLA einsum —
+  rematerialisation over HBM residuals, the standard TPU trade).
+
+- :func:`ring_attention` — sequence/context parallelism over a mesh axis:
+  each device owns a query shard, K/V shards rotate around the ring via
+  ``jax.lax.ppermute`` (XLA lowers to ICI neighbour exchange), partial
+  attention folded with the same online-softmax algebra.  Communication
+  overlaps compute naturally (one hop per step), memory per chip is
+  O(seq/ring).  Use inside ``shard_map``; :func:`ring_attention_sharded`
+  wraps that for convenience.
+
+Shapes are BSHD: (batch, seq, heads, head_dim) throughout.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = float("-inf")
+
+
+# ---------------------------------------------------------------------------
+# Reference implementation (the numerics oracle; also the VJP recompute path)
+# ---------------------------------------------------------------------------
+
+def reference_attention(q, k, v, causal: bool = True):
+    """Plain einsum attention in f32 — O(s^2) memory, used for testing and
+    as the recompute body of flash_attention's backward."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) / math.sqrt(d)
+    if causal:
+        # global positions: q row i attends to k col j iff j <= i + (sk - sq)
+        # (the offset form supports sq != sk, e.g. ring attention shards)
+        qi = jnp.arange(sq)[:, None]
+        kj = jnp.arange(sk)[None, :]
+        scores = jnp.where(kj <= qi + (sk - sq), scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash attention
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                  *, sm_scale: float, causal: bool, block_q: int, block_k: int,
+                  seq_k: Optional[int]):
+    """One (bh, qi, ki) grid step: fold K/V block ki into the running
+    softmax state for query block qi.  TPU iterates the last grid dim
+    sequentially, so m/l/acc scratch persists across ki for a fixed qi."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    # causal: skip blocks strictly above the diagonal band
+    @pl.when(jnp.logical_not(causal) | (k_start <= q_start + block_q - 1))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)          # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)          # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)          # (block_k, d)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale                               # (block_q, block_k)
+        if causal or seq_k is not None:
+            rows = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0) + q_start
+            cols = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1) + k_start
+            valid = jnp.ones(scores.shape, bool)
+            if causal:
+                valid &= cols <= rows
+            if seq_k is not None:                  # padded K tail is invalid
+                valid &= cols < seq_k
+            scores = jnp.where(valid, scores, NEG_INF)
+
+        m_prev = m_ref[:, :1]                      # (block_q, 1), lane-replicated
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # m_new is -inf only on fully-masked rows; exp(scores - -inf) -> nan,
+        # guard with a zero-safe shift
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(scores - shift)                # (block_q, block_k)
+        correction = jnp.where(
+            jnp.isfinite(m_prev), jnp.exp(m_prev - shift), 0.0
+        )                                          # (block_q, 1)
+        l_new = correction * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        denom = jnp.where(l == 0.0, 1.0, l)        # fully-masked row -> 0 output
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
+                   interpret: Optional[bool]):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if causal and sq != sk:
+        raise ValueError(f"causal flash requires sq == sk, got ({sq}, {sk})")
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    # arbitrary lengths: pad to block multiples; padded K columns are masked
+    # inside the kernel, padded Q rows are sliced off the output
+    pad_q = -sq % block_q
+    pad_k = -sk % block_k
+    if pad_q or pad_k:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+    sqp, skp = sq + pad_q, sk + pad_k
+    sm_scale = 1.0 / math.sqrt(d)
+
+    # fold heads into the leading grid dim: (b, s, h, d) -> (b*h, s, d)
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    grid = (b * h, sqp // block_q, skp // block_k)
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_k=sk if pad_k else None,
+    )
+    of = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sqp, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max (lane-replicated)
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running denominator
+            pltpu.VMEM((block_q, d), jnp.float32),     # output accumulator
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return of.reshape(b, h, sqp, d)[:, :, :sq].transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: Optional[bool] = None):
+    """Flash attention, BSHD.  O(seq) memory in the forward; backward
+    recomputes scores (rematerialisation) instead of storing them."""
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: reference_attention(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention (context parallelism over a mesh axis)
+# ---------------------------------------------------------------------------
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True):
+    """Blockwise ring attention for sequence shards.  Call INSIDE shard_map:
+    q/k/v are this device's (batch, seq_local, heads, head_dim) shards of a
+    sequence sharded over `axis_name`; K/V rotate one ICI hop per step while
+    the online-softmax state folds each incoming block.
+
+    Equivalent to full attention over the global sequence (causal masking
+    uses global positions); memory per chip O(seq_local), comms 2·(ring-1)
+    neighbour exchanges riding ICI."""
+    size = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    sm_scale = 1.0 / math.sqrt(d)
+    perm = [(i, (i + 1) % size) for i in range(size)]
+
+    qf = q.astype(jnp.float32)
+    q_pos = my * s_loc + jnp.arange(s_loc)                 # global q rows
+
+    def fold_block(o, m, l, k_cur, v_cur, step):
+        """Fold one resident K/V block into the online-softmax state."""
+        src = (my - step) % size                            # owner of k_cur
+        k_pos = src * s_loc + jnp.arange(s_loc)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32)
+        ) * sm_scale
+        if causal:
+            mask = k_pos[None, :] <= q_pos[:, None]         # (s_loc, s_loc) global
+            scores = jnp.where(mask[None, None], scores, NEG_INF)
+        m_cur = jnp.max(scores, axis=-1)                    # (b, h, q)
+        m_new = jnp.maximum(m, m_cur)
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(scores - shift[..., None])
+        correction = jnp.where(jnp.isfinite(m), jnp.exp(m - shift), 0.0)
+        l_new = correction * l + jnp.sum(p, axis=-1)
+        o_new = o * correction[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32)
+        )
+        return o_new, m_new, l_new
+
+    def body(carry, step):
+        o, m, l, k_cur, v_cur = carry
+        o, m, l = fold_block(o, m, l, k_cur, v_cur, step)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o, m, l, k_nxt, v_nxt), None
+
+    o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    m0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    # constants are device-invariant to shard_map's varying-axes typing, but
+    # the folded carries vary over the ring axis — mark them so scan's
+    # carry types match
+    o0, m0, l0 = (jax.lax.pcast(x, (axis_name,), to="varying") for x in (o0, m0, l0))
+    # scan rotates size-1 times; the last resident block folds outside so no
+    # dead final exchange is issued (2*(size-1) hops total, as documented)
+    (o, m, l, k_last, v_last), _ = jax.lax.scan(
+        body, (o0, m0, l0, k, v), jnp.arange(size - 1)
+    )
+    o, m, l = fold_block(o, m, l, k_last, v_last, size - 1)
+    denom = jnp.where(l == 0.0, 1.0, l)
+    out = (o / denom[..., None]).transpose(0, 2, 1, 3)      # -> (b, s, h, d)
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh: Mesh, axis: str, causal: bool = True):
+    """shard_map wrapper: q/k/v are GLOBAL (batch, seq, heads, head_dim)
+    arrays; seq is sharded over `axis`, everything else replicated."""
+    spec = P(None, axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
