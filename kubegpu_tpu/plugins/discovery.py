@@ -267,6 +267,12 @@ class GkeTpuProvider(TpuProvider):
             env[ENV_TOPOLOGY] = self._env["TPU_TOPOLOGY"]
         dev_map = self._device_map()
         wanted = sorted({c.device_index for c in chips})
+        nat = self._native_probe()
+        if nat is not None:
+            # the native probe adds an accessibility bit on top of presence;
+            # a present-but-unopenable node is as dead as a missing one
+            accessible = {c.index for c in nat.chips if c.accessible}
+            dev_map = {i: p for i, p in dev_map.items() if i in accessible}
         missing = [i for i in wanted if i not in dev_map]
         if missing:
             # starting a container that believes it owns chips with no

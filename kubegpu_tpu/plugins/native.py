@@ -88,6 +88,11 @@ def load() -> Optional[ctypes.CDLL]:
                 ]
                 lib.tpu_discovery_probe.restype = ctypes.c_int
                 lib.tpu_discovery_version.restype = ctypes.c_char_p
+                lib.tpu_discovery_probe_size.restype = ctypes.c_int
+                if lib.tpu_discovery_probe_size() != ctypes.sizeof(_HostProbe):
+                    # ABI mismatch (stale build with different MAX_CHIPS /
+                    # PATH_MAX): calling probe would overrun our struct
+                    continue
             except (OSError, AttributeError):
                 # wrong library at this path (e.g. a foreign .so via
                 # $KUBEGPU_TPU_NATIVE_LIB): keep trying the next candidate

@@ -51,6 +51,11 @@ typedef struct {
 
 const char* tpu_discovery_version(void) { return "kubegpu-tpu-discovery/1"; }
 
+// ABI handshake: callers must verify this equals sizeof their struct before
+// passing one in (the NVML versioned-struct pattern) — a stale library with
+// different MAX_CHIPS/PATH_MAX would otherwise overrun the caller's buffer.
+int tpu_discovery_probe_size(void) { return (int)sizeof(tpu_host_probe); }
+
 namespace {
 
 // accel nodes carry their chip index in the name ("accel3" -> 3); vfio
