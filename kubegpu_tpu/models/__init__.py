@@ -3,13 +3,16 @@
 
 from kubegpu_tpu.models.resnet import ResNet, ResNet18, ResNet50, ResNet101, ResNet152
 from kubegpu_tpu.models.transformer import TransformerLM
+from kubegpu_tpu.models.moe import MoEMLP, MoeBlock, MoeTransformerLM
 from kubegpu_tpu.models.train import (
     TrainState,
     create_train_state,
     cross_entropy,
     make_lm_train_step,
+    make_moe_train_step,
     make_resnet_train_step,
     place_lm,
+    place_moe,
     place_resnet,
     state_shardings,
 )
@@ -21,12 +24,17 @@ __all__ = [
     "ResNet101",
     "ResNet152",
     "TransformerLM",
+    "MoEMLP",
+    "MoeBlock",
+    "MoeTransformerLM",
     "TrainState",
     "create_train_state",
     "cross_entropy",
     "make_lm_train_step",
+    "make_moe_train_step",
     "make_resnet_train_step",
     "place_lm",
+    "place_moe",
     "place_resnet",
     "state_shardings",
 ]

@@ -1,0 +1,179 @@
+"""Mixture-of-Experts transformer with expert parallelism (EP).
+
+The third reference workload: exercises the expert-parallel sharding the
+placement layer serves (SURVEY.md §2.2 — the framework hands JAX an
+ICI-contiguous sub-mesh so the MoE all-to-all rides ICI, exactly the
+workload class the reference gang-scheduled as multi-pod training jobs).
+
+TPU-first routing design (GShard/Switch recipe, NOT a CUDA-style gather):
+
+- **Static capacity.** Every expert processes exactly ``capacity`` token
+  slots per step; overflowing tokens are dropped (their residual branch
+  contributes zero).  Shapes never depend on routing decisions, so the whole
+  layer jits to one XLA program with no dynamic shapes.
+- **Einsum dispatch.** Tokens are routed with one-hot dispatch/combine
+  tensors and ``einsum`` — batched matmuls that tile onto the MXU.  With the
+  expert dim of the dispatched tensor sharded over the "expert" mesh axis
+  (``constrain_expert_sharded``), GSPMD lowers the dispatch einsum to an
+  all-to-all over ICI; nothing here opens a transport.
+- **Top-1 (Switch) routing** with the Switch load-balancing auxiliary loss,
+  exposed via ``sow("intermediates", "aux_loss", ...)`` so the train step
+  can weigh it without threading extra return values through flax.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from kubegpu_tpu.models.transformer import CausalSelfAttention
+from kubegpu_tpu.parallel.sharding import (
+    constrain_expert_sharded,
+    constrain_seq_sharded,
+)
+
+
+class MoEMLP(nn.Module):
+    """Switch-style top-1 MoE feed-forward layer with static capacity."""
+
+    num_experts: int
+    capacity_factor: float = 2.0
+    mlp_ratio: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        e = self.num_experts
+        h = d * self.mlp_ratio
+        n = b * s
+        capacity = min(n, int(math.ceil(n * self.capacity_factor / e)))
+
+        xf = x.reshape(n, d)
+        # Router in fp32: softmax/argmax over expert logits must not lose
+        # ties to bf16 rounding, and the aux loss needs accurate densities.
+        router_logits = nn.Dense(
+            e, use_bias=False, dtype=jnp.float32, name="router"
+        )(xf.astype(jnp.float32))
+        gates = jax.nn.softmax(router_logits, axis=-1)            # [n, e]
+        expert_index = jnp.argmax(gates, axis=-1)                 # [n]
+        mask = jax.nn.one_hot(expert_index, e, dtype=jnp.float32)  # [n, e]
+        gate = jnp.sum(gates * mask, axis=-1)                     # [n]
+
+        # Switch aux loss (their eq. 4): e * Σ_i fraction_routed_i * mean_prob_i,
+        # = 1.0 at perfect balance; the train step adds aux_weight * this.
+        density = jnp.mean(mask, axis=0)
+        density_proxy = jnp.mean(gates, axis=0)
+        aux = e * jnp.sum(density * density_proxy)
+        self.sow("intermediates", "aux_loss", aux)
+
+        # Position of each token within its expert's capacity (1-based over
+        # the flat token order); tokens past capacity are dropped.  Integer
+        # cumsum: fp32 would silently merge slots past 2^24 tokens.
+        imask = mask.astype(jnp.int32)
+        position = jnp.cumsum(imask, axis=0) * imask              # [n, e]
+        keep = ((position > 0) & (position <= capacity)).astype(jnp.float32)
+        slot = jnp.maximum(position - 1, 0)                       # 0-based
+        dispatch = keep[..., None] * jax.nn.one_hot(
+            slot, capacity, dtype=jnp.float32
+        )                                                         # [n, e, c]
+        combine = dispatch * gate[:, None, None]
+
+        # Dispatch → [e, c, d], sharded over "expert" (the all-to-all).
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch, xf.astype(jnp.float32))
+        expert_in = constrain_expert_sharded(expert_in.astype(self.dtype))
+
+        stacked_init = nn.initializers.variance_scaling(
+            1.0, "fan_in", "truncated_normal", in_axis=-2, out_axis=-1, batch_axis=(0,)
+        )
+        w_up = self.param("w_up", stacked_init, (e, d, h), jnp.float32)
+        w_down = self.param("w_down", stacked_init, (e, h, d), jnp.float32)
+
+        mid = nn.gelu(
+            jnp.einsum("ecd,edh->ech", expert_in, w_up.astype(self.dtype))
+        )
+        expert_out = jnp.einsum("ech,ehd->ecd", mid, w_down.astype(self.dtype))
+        expert_out = constrain_expert_sharded(expert_out)
+
+        # Combine (the return all-to-all); fp32 accumulation of the weighted sum.
+        out = jnp.einsum(
+            "nec,ecd->nd", combine, expert_out.astype(jnp.float32)
+        )
+        return out.reshape(b, s, d).astype(x.dtype)
+
+
+class MoeBlock(nn.Module):
+    """Pre-LN transformer block whose MLP is a Switch MoE layer."""
+
+    num_heads: int
+    num_experts: int
+    capacity_factor: float = 2.0
+    mlp_ratio: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+    sequence_parallel: bool = False
+    attn_impl: str = "einsum"
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        x = x + CausalSelfAttention(
+            self.num_heads, self.dtype, self.attn_impl, name="attn"
+        )(y)
+        if self.sequence_parallel:
+            x = constrain_seq_sharded(x)
+        y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        x = x + MoEMLP(
+            num_experts=self.num_experts,
+            capacity_factor=self.capacity_factor,
+            mlp_ratio=self.mlp_ratio,
+            dtype=self.dtype,
+            name="moe_mlp",
+        )(y)
+        if self.sequence_parallel:
+            x = constrain_seq_sharded(x)
+        return x
+
+
+class MoeTransformerLM(nn.Module):
+    """Decoder-only LM where every block's FFN is a Switch MoE layer."""
+
+    vocab_size: int = 32000
+    num_layers: int = 4
+    num_heads: int = 8
+    hidden: int = 512
+    num_experts: int = 8
+    capacity_factor: float = 2.0
+    max_seq: int = 2048
+    dtype: jnp.dtype = jnp.bfloat16
+    sequence_parallel: bool = False
+    attn_impl: str = "einsum"
+
+    @nn.compact
+    def __call__(self, tokens):
+        b, s = tokens.shape
+        x = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype, name="embed")(
+            tokens
+        )
+        pos = nn.Embed(self.max_seq, self.hidden, dtype=self.dtype, name="pos_embed")(
+            jnp.arange(s)[None, :]
+        )
+        x = x + pos
+        block = partial(
+            MoeBlock,
+            num_heads=self.num_heads,
+            num_experts=self.num_experts,
+            capacity_factor=self.capacity_factor,
+            dtype=self.dtype,
+            sequence_parallel=self.sequence_parallel,
+            attn_impl=self.attn_impl,
+        )
+        for i in range(self.num_layers):
+            x = block(name=f"layer{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        return nn.Dense(
+            self.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head"
+        )(x)

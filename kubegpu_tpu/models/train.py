@@ -18,8 +18,10 @@ import optax
 from jax.sharding import Mesh
 
 from kubegpu_tpu.parallel.sharding import (
+    MOE_EP_RULES,
     TRANSFORMER_TP_RULES,
     batch_sharding,
+    current_mesh,
     param_shardings,
     replicated,
 )
@@ -122,8 +124,6 @@ def lm_loss(state: TrainState, params, tokens):
 
 
 def make_lm_train_step(mesh: Mesh, donate: bool = True):
-    from kubegpu_tpu.parallel.sharding import current_mesh
-
     def step(state: TrainState, tokens):
         # context active during tracing so the model's sequence-parallel
         # sharding constraints resolve against this mesh
@@ -134,6 +134,56 @@ def make_lm_train_step(mesh: Mesh, donate: bool = True):
             return state.apply_gradients(grads), loss
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+# ---------------------------------------------------------------------------
+# MoE transformer LM (DP x EP)
+# ---------------------------------------------------------------------------
+
+def moe_loss(state: TrainState, params, tokens, aux_weight: float):
+    logits, mutated = state.apply_fn(
+        {"params": params}, tokens[:, :-1], mutable=["intermediates"]
+    )
+    # each MoEMLP sowed one aux_loss scalar; select by name so unrelated
+    # diagnostic sows never leak into the loss; mean over layers keeps the
+    # weight independent of depth
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        mutated.get("intermediates", {})
+    )
+    aux_leaves = [
+        leaf
+        for path, leaf in flat
+        if any(getattr(k, "key", None) == "aux_loss" for k in path)
+    ]
+    aux = (
+        sum(jnp.mean(a) for a in aux_leaves) / max(len(aux_leaves), 1)
+        if aux_leaves
+        else jnp.zeros(())
+    )
+    return cross_entropy(logits, tokens[:, 1:]) + aux_weight * aux, aux
+
+
+def make_moe_train_step(mesh: Mesh, aux_weight: float = 0.01, donate: bool = True):
+    """Jitted DP x EP step: expert params sharded over "expert" per
+    MOE_EP_RULES, batch over "data"; the Switch aux loss keeps the router
+    balanced (returned as the step's second metric)."""
+
+    def step(state: TrainState, tokens):
+        with current_mesh(mesh):
+            (loss, aux), grads = jax.value_and_grad(
+                lambda p: moe_loss(state, p, tokens, aux_weight), has_aux=True
+            )(state.params)
+            return state.apply_gradients(grads), loss, aux
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def place_moe(state: TrainState, tokens, mesh: Mesh):
+    """EP placement per MOE_EP_RULES (params AND mirrored optimizer
+    moments); batch sharded over "data"."""
+    state = jax.device_put(state, state_shardings(state, mesh, MOE_EP_RULES))
+    tokens = jax.device_put(tokens, batch_sharding(mesh))
+    return state, tokens
 
 
 def state_shardings(state: TrainState, mesh: Mesh, rules) -> TrainState:

@@ -8,11 +8,14 @@ from kubegpu_tpu.parallel.mesh import (
 )
 from kubegpu_tpu.parallel.sharding import (
     DATA_AXIS,
+    EXPERT_AXIS,
     MODEL_AXIS,
+    MOE_EP_RULES,
     TRANSFORMER_TP_RULES,
     batch_sharding,
     batch_spec,
     constrain_batch_sharded,
+    constrain_expert_sharded,
     constrain_seq_sharded,
     param_shardings,
     replicated,
@@ -25,11 +28,14 @@ __all__ = [
     "local_chip_count",
     "mesh_from_assignment",
     "DATA_AXIS",
+    "EXPERT_AXIS",
     "MODEL_AXIS",
+    "MOE_EP_RULES",
     "TRANSFORMER_TP_RULES",
     "batch_sharding",
     "batch_spec",
     "constrain_batch_sharded",
+    "constrain_expert_sharded",
     "constrain_seq_sharded",
     "param_shardings",
     "replicated",

@@ -25,6 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+EXPERT_AXIS = "expert"
 
 # Ambient mesh for sharding constraints inside model code (jax's own
 # context-mesh API has churned across versions; an explicit, version-proof
@@ -78,6 +79,20 @@ TRANSFORMER_TP_RULES: Tuple[Tuple[str, P], ...] = (
     (r".*mlp_up/kernel$", P(None, MODEL_AXIS)),
     (r".*mlp_down/kernel$", P(MODEL_AXIS, None)),
     (r".*lm_head/kernel$", P(None, MODEL_AXIS)),
+    (r".*bias$", P()),
+    (r".*scale$", P()),
+)
+
+
+# Expert parallelism for the MoE transformer (models/moe.py): stacked expert
+# kernels [E, d_in, d_out] shard their leading EXPERT dim; the dispatch/combine
+# einsums then lower to an all-to-all over "expert" (GShard's recipe), which
+# the placement layer guarantees rides ICI.  The router stays replicated —
+# every token needs every router row.
+MOE_EP_RULES: Tuple[Tuple[str, P], ...] = (
+    (r".*moe_mlp/w_up$", P(EXPERT_AXIS, None, None)),
+    (r".*moe_mlp/w_down$", P(EXPERT_AXIS, None, None)),
+    (r".*router/kernel$", P()),
     (r".*bias$", P()),
     (r".*scale$", P()),
 )
@@ -143,3 +158,15 @@ def constrain_batch_sharded(x: jax.Array) -> jax.Array:
     if mesh is None:
         return x
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(DATA_AXIS)))
+
+
+def constrain_expert_sharded(x: jax.Array) -> jax.Array:
+    """Dispatched expert tensors [E, capacity, ...]: leading dim over
+    "expert".  Pinning this sharding is what makes GSPMD lower the dispatch
+    einsum to an all-to-all instead of gathering all tokens everywhere.
+    No-op outside a ``current_mesh`` context or on expert-less meshes."""
+    mesh = get_current_mesh()
+    if mesh is None or EXPERT_AXIS not in mesh.axis_names:
+        return x
+    spec = P(EXPERT_AXIS, *([None] * (x.ndim - 1)))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
