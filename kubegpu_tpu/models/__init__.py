@@ -4,6 +4,13 @@
 from kubegpu_tpu.models.resnet import ResNet, ResNet18, ResNet50, ResNet101, ResNet152
 from kubegpu_tpu.models.transformer import TransformerLM
 from kubegpu_tpu.models.moe import MoEMLP, MoeBlock, MoeTransformerLM
+from kubegpu_tpu.models.pipeline_lm import (
+    init_pipeline_lm,
+    make_pipeline_lm_train_step,
+    pipeline_lm_logits,
+    place_pipeline_lm,
+    sequential_lm_logits,
+)
 from kubegpu_tpu.models.train import (
     TrainState,
     create_train_state,
@@ -27,6 +34,11 @@ __all__ = [
     "MoEMLP",
     "MoeBlock",
     "MoeTransformerLM",
+    "init_pipeline_lm",
+    "make_pipeline_lm_train_step",
+    "pipeline_lm_logits",
+    "place_pipeline_lm",
+    "sequential_lm_logits",
     "TrainState",
     "create_train_state",
     "cross_entropy",

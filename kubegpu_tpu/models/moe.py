@@ -7,14 +7,16 @@ workload class the reference gang-scheduled as multi-pod training jobs).
 
 TPU-first routing design (GShard/Switch recipe, NOT a CUDA-style gather):
 
-- **Static capacity.** Every expert processes exactly ``capacity`` token
-  slots per step; overflowing tokens are dropped (their residual branch
-  contributes zero).  Shapes never depend on routing decisions, so the whole
-  layer jits to one XLA program with no dynamic shapes.
+- **Static capacity, grouped routing.** Each batch row is a routing group:
+  every expert processes exactly ``capacity`` token slots per group;
+  overflowing tokens are dropped (their residual branch contributes zero).
+  Shapes never depend on routing decisions, so the whole layer jits to one
+  XLA program with no dynamic shapes — and because slots are assigned
+  within a row, nothing serializes across the data-sharded batch dim.
 - **Einsum dispatch.** Tokens are routed with one-hot dispatch/combine
   tensors and ``einsum`` — batched matmuls that tile onto the MXU.  With the
   expert dim of the dispatched tensor sharded over the "expert" mesh axis
-  (``constrain_expert_sharded``), GSPMD lowers the dispatch einsum to an
+  (``constrain_expert_grouped``), GSPMD lowers the dispatch einsum to an
   all-to-all over ICI; nothing here opens a transport.
 - **Top-1 (Switch) routing** with the Switch load-balancing auxiliary loss,
   exposed via ``sow("intermediates", "aux_loss", ...)`` so the train step
@@ -32,7 +34,7 @@ import jax.numpy as jnp
 
 from kubegpu_tpu.models.transformer import CausalSelfAttention
 from kubegpu_tpu.parallel.sharding import (
-    constrain_expert_sharded,
+    constrain_expert_grouped,
     constrain_seq_sharded,
 )
 
@@ -50,42 +52,47 @@ class MoEMLP(nn.Module):
         b, s, d = x.shape
         e = self.num_experts
         h = d * self.mlp_ratio
-        n = b * s
-        capacity = min(n, int(math.ceil(n * self.capacity_factor / e)))
+        # GShard-style GROUPED routing: each batch row is a routing group
+        # with its own capacity, so (a) dispatch/combine tensors are
+        # [b, s, e, c] — linear in tokens, not O(n^2) — and (b) the slot
+        # cumsum runs within a row, never across the data-sharded batch dim,
+        # keeping the only cross-device collective the expert all-to-all.
+        capacity = min(s, int(math.ceil(s * self.capacity_factor / e)))
 
-        xf = x.reshape(n, d)
         # Router in fp32: softmax/argmax over expert logits must not lose
         # ties to bf16 rounding, and the aux loss needs accurate densities.
         router_logits = nn.Dense(
             e, use_bias=False, dtype=jnp.float32, name="router"
-        )(xf.astype(jnp.float32))
-        gates = jax.nn.softmax(router_logits, axis=-1)            # [n, e]
-        expert_index = jnp.argmax(gates, axis=-1)                 # [n]
-        mask = jax.nn.one_hot(expert_index, e, dtype=jnp.float32)  # [n, e]
-        gate = jnp.sum(gates * mask, axis=-1)                     # [n]
+        )(x.astype(jnp.float32))
+        gates = jax.nn.softmax(router_logits, axis=-1)              # [b, s, e]
+        expert_index = jnp.argmax(gates, axis=-1)                   # [b, s]
+        mask = jax.nn.one_hot(expert_index, e, dtype=jnp.float32)   # [b, s, e]
+        gate = jnp.sum(gates * mask, axis=-1)                       # [b, s]
 
         # Switch aux loss (their eq. 4): e * Σ_i fraction_routed_i * mean_prob_i,
         # = 1.0 at perfect balance; the train step adds aux_weight * this.
-        density = jnp.mean(mask, axis=0)
-        density_proxy = jnp.mean(gates, axis=0)
+        density = jnp.mean(mask, axis=(0, 1))
+        density_proxy = jnp.mean(gates, axis=(0, 1))
         aux = e * jnp.sum(density * density_proxy)
         self.sow("intermediates", "aux_loss", aux)
 
-        # Position of each token within its expert's capacity (1-based over
-        # the flat token order); tokens past capacity are dropped.  Integer
-        # cumsum: fp32 would silently merge slots past 2^24 tokens.
+        # Position of each token within its expert's per-group capacity
+        # (1-based along the row); tokens past capacity are dropped.
+        # Integer cumsum: fp32 would silently merge slots past 2^24.
         imask = mask.astype(jnp.int32)
-        position = jnp.cumsum(imask, axis=0) * imask              # [n, e]
+        position = jnp.cumsum(imask, axis=1) * imask                # [b, s, e]
         keep = ((position > 0) & (position <= capacity)).astype(jnp.float32)
-        slot = jnp.maximum(position - 1, 0)                       # 0-based
+        slot = jnp.maximum(position - 1, 0)                         # 0-based
         dispatch = keep[..., None] * jax.nn.one_hot(
             slot, capacity, dtype=jnp.float32
-        )                                                         # [n, e, c]
-        combine = dispatch * gate[:, None, None]
+        )                                                           # [b, s, e, c]
+        combine = dispatch * gate[..., None, None]
 
-        # Dispatch → [e, c, d], sharded over "expert" (the all-to-all).
-        expert_in = jnp.einsum("nec,nd->ecd", dispatch, xf.astype(jnp.float32))
-        expert_in = constrain_expert_sharded(expert_in.astype(self.dtype))
+        # Dispatch → [b, e, c, d]; expert dim sharded (the all-to-all).
+        expert_in = jnp.einsum(
+            "bsec,bsd->becd", dispatch, x.astype(jnp.float32)
+        )
+        expert_in = constrain_expert_grouped(expert_in.astype(self.dtype))
 
         stacked_init = nn.initializers.variance_scaling(
             1.0, "fan_in", "truncated_normal", in_axis=-2, out_axis=-1, batch_axis=(0,)
@@ -94,16 +101,16 @@ class MoEMLP(nn.Module):
         w_down = self.param("w_down", stacked_init, (e, h, d), jnp.float32)
 
         mid = nn.gelu(
-            jnp.einsum("ecd,edh->ech", expert_in, w_up.astype(self.dtype))
+            jnp.einsum("becd,edh->bech", expert_in, w_up.astype(self.dtype))
         )
-        expert_out = jnp.einsum("ech,ehd->ecd", mid, w_down.astype(self.dtype))
-        expert_out = constrain_expert_sharded(expert_out)
+        expert_out = jnp.einsum("bech,ehd->becd", mid, w_down.astype(self.dtype))
+        expert_out = constrain_expert_grouped(expert_out)
 
         # Combine (the return all-to-all); fp32 accumulation of the weighted sum.
         out = jnp.einsum(
-            "nec,ecd->nd", combine, expert_out.astype(jnp.float32)
+            "bsec,becd->bsd", combine, expert_out.astype(jnp.float32)
         )
-        return out.reshape(b, s, d).astype(x.dtype)
+        return out.astype(x.dtype)
 
 
 class MoeBlock(nn.Module):

@@ -1,0 +1,205 @@
+"""Pipeline-parallel decoder LM: pure-JAX blocks over parallel.pipeline.
+
+The fourth reference workload: same pre-LN decoder math as
+``models/transformer.py`` but with layer params STACKED — [S, K, ...] =
+(stages x layers-per-stage) — so the homogeneous block stack maps onto
+:func:`kubegpu_tpu.parallel.pipeline.pipeline_apply` (leading dim sharded
+over "pipe") and the inner K layers run as a ``lax.scan`` over stacked
+weights (the standard scan-over-layers compile-time win: one block traced
+once, not L times).
+
+Pure JAX rather than flax: pipeline stages need direct control of the
+parameter stacking/sharding, and a dict-of-arrays pytree is the idiomatic
+shape for that.  Embedding/head/final-LN stay outside the pipelined region,
+replicated (they are cheap relative to the block stack).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubegpu_tpu.parallel.pipeline import PIPE_AXIS, pipeline_apply
+
+
+def _layer_norm(x, scale, bias, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * scale + bias
+
+
+def block_apply(p: Dict[str, jax.Array], x: jax.Array, num_heads: int) -> jax.Array:
+    """One pre-LN block: causal attention + gelu MLP, shape-preserving."""
+    b, s, d = x.shape
+    hd = d // num_heads
+    y = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
+    q = (y @ p["wq"]).reshape(b, s, num_heads, hd)
+    k = (y @ p["wk"]).reshape(b, s, num_heads, hd)
+    v = (y @ p["wv"]).reshape(b, s, num_heads, hd)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(hd).astype(x.dtype)
+    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+    scores = jnp.where(mask[None, None], scores, jnp.finfo(x.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, d)
+    x = x + attn @ p["wo"]
+    y = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+    return x + jax.nn.gelu(y @ p["w1"]) @ p["w2"]
+
+
+def _init_block(rng, hidden: int, mlp_ratio: int, dtype) -> Dict[str, jax.Array]:
+    ks = jax.random.split(rng, 6)
+    init = jax.nn.initializers.lecun_normal()
+    d, h = hidden, hidden * mlp_ratio
+    return {
+        "ln1_scale": jnp.ones((d,), dtype),
+        "ln1_bias": jnp.zeros((d,), dtype),
+        "ln2_scale": jnp.ones((d,), dtype),
+        "ln2_bias": jnp.zeros((d,), dtype),
+        "wq": init(ks[0], (d, d), dtype),
+        "wk": init(ks[1], (d, d), dtype),
+        "wv": init(ks[2], (d, d), dtype),
+        "wo": init(ks[3], (d, d), dtype),
+        "w1": init(ks[4], (d, h), dtype),
+        "w2": init(ks[5], (h, d), dtype),
+    }
+
+
+def init_pipeline_lm(
+    rng,
+    *,
+    vocab_size: int,
+    num_stages: int,
+    layers_per_stage: int,
+    hidden: int,
+    mlp_ratio: int = 4,
+    max_seq: int = 2048,
+    dtype=jnp.float32,
+) -> Dict[str, Any]:
+    """Params with blocks stacked [num_stages, layers_per_stage, ...]."""
+    k_blocks, k_emb, k_pos, k_head = jax.random.split(rng, 4)
+    n_layers = num_stages * layers_per_stage
+    stacked = jax.vmap(lambda r: _init_block(r, hidden, mlp_ratio, dtype))(
+        jax.random.split(k_blocks, n_layers)
+    )
+    blocks = jax.tree.map(
+        lambda a: a.reshape((num_stages, layers_per_stage) + a.shape[1:]), stacked
+    )
+    emb = jax.nn.initializers.normal(0.02)
+    return {
+        "embed": emb(k_emb, (vocab_size, hidden), dtype),
+        "pos": emb(k_pos, (max_seq, hidden), dtype),
+        "blocks": blocks,
+        "ln_f_scale": jnp.ones((hidden,), dtype),
+        "ln_f_bias": jnp.zeros((hidden,), dtype),
+        # fp32 head for a stable softmax-xent (same choice as TransformerLM)
+        "lm_head": jax.nn.initializers.lecun_normal()(
+            k_head, (hidden, vocab_size), jnp.float32
+        ),
+    }
+
+
+def stage_apply(stage_params, x, num_heads: int):
+    """Apply this stage's K stacked layers via scan-over-layers."""
+
+    def body(h, layer_p):
+        return block_apply(layer_p, h, num_heads), None
+
+    x, _ = lax.scan(body, x, stage_params)
+    return x
+
+
+def _head(params, x):
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    return (x.astype(jnp.float32) @ params["lm_head"]).astype(jnp.float32)
+
+
+def pipeline_lm_logits(
+    params,
+    tokens,
+    mesh: Mesh,
+    *,
+    num_heads: int,
+    num_microbatches: int,
+    axis: str = PIPE_AXIS,
+):
+    """Forward through the pipelined block stack; batch must divide into
+    ``num_microbatches`` equal microbatches."""
+    b, t = tokens.shape
+    if b % num_microbatches != 0:
+        raise ValueError(f"batch {b} not divisible by {num_microbatches} microbatches")
+    x = params["embed"][tokens] + params["pos"][:t][None]
+    stream = x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
+    run = pipeline_apply(partial(stage_apply, num_heads=num_heads), mesh, axis)
+    out = run(params["blocks"], stream)
+    return _head(params, out.reshape(b, t, -1))
+
+
+def sequential_lm_logits(params, tokens, *, num_heads: int):
+    """Same math with no pipelining (the correctness oracle): flatten the
+    [S, K] stage dims and scan every layer in order on the full batch."""
+    b, t = tokens.shape
+    x = params["embed"][tokens] + params["pos"][:t][None]
+    flat = jax.tree.map(
+        lambda a: a.reshape((-1,) + a.shape[2:]), params["blocks"]
+    )
+    x = stage_apply(flat, x, num_heads)
+    return _head(params, x)
+
+
+# ---------------------------------------------------------------------------
+# Training (DP-free pure PP step; compose with DP by adding a "data" axis)
+# ---------------------------------------------------------------------------
+
+def place_pipeline_lm(params, opt_state, tokens, mesh: Mesh, axis: str = PIPE_AXIS):
+    """Blocks (and their mirrored optimizer moments) sharded stage-major
+    over "pipe"; everything else replicated.  Optax moment pytrees mirror
+    the param tree, so one path rule — "under a 'blocks' key" — shards
+    both consistently."""
+
+    def shardings_for(tree):
+        def spec(path, _leaf):
+            pipelined = any(getattr(k, "key", None) == "blocks" for k in path)
+            return NamedSharding(mesh, P(axis) if pipelined else P())
+
+        return jax.tree_util.tree_map_with_path(spec, tree)
+
+    params = jax.device_put(params, shardings_for(params))
+    opt_state = jax.device_put(opt_state, shardings_for(opt_state))
+    tokens = jax.device_put(tokens, NamedSharding(mesh, P()))
+    return params, opt_state, tokens
+
+
+def make_pipeline_lm_train_step(
+    mesh: Mesh,
+    tx: optax.GradientTransformation,
+    *,
+    num_heads: int,
+    num_microbatches: int,
+    axis: str = PIPE_AXIS,
+    donate: bool = True,
+):
+    from kubegpu_tpu.models.train import cross_entropy
+
+    def loss_fn(params, tokens):
+        logits = pipeline_lm_logits(
+            params,
+            tokens[:, :-1],
+            mesh,
+            num_heads=num_heads,
+            num_microbatches=num_microbatches,
+            axis=axis,
+        )
+        return cross_entropy(logits, tokens[:, 1:])
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())

@@ -6,6 +6,7 @@ from kubegpu_tpu.parallel.mesh import (
     local_chip_count,
     mesh_from_assignment,
 )
+from kubegpu_tpu.parallel.pipeline import PIPE_AXIS, pipeline_apply
 from kubegpu_tpu.parallel.sharding import (
     DATA_AXIS,
     EXPERT_AXIS,
@@ -15,7 +16,7 @@ from kubegpu_tpu.parallel.sharding import (
     batch_sharding,
     batch_spec,
     constrain_batch_sharded,
-    constrain_expert_sharded,
+    constrain_expert_grouped,
     constrain_seq_sharded,
     param_shardings,
     replicated,
@@ -30,12 +31,14 @@ __all__ = [
     "DATA_AXIS",
     "EXPERT_AXIS",
     "MODEL_AXIS",
+    "PIPE_AXIS",
     "MOE_EP_RULES",
     "TRANSFORMER_TP_RULES",
+    "pipeline_apply",
     "batch_sharding",
     "batch_spec",
     "constrain_batch_sharded",
-    "constrain_expert_sharded",
+    "constrain_expert_grouped",
     "constrain_seq_sharded",
     "param_shardings",
     "replicated",

@@ -160,13 +160,15 @@ def constrain_batch_sharded(x: jax.Array) -> jax.Array:
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(DATA_AXIS)))
 
 
-def constrain_expert_sharded(x: jax.Array) -> jax.Array:
-    """Dispatched expert tensors [E, capacity, ...]: leading dim over
-    "expert".  Pinning this sharding is what makes GSPMD lower the dispatch
-    einsum to an all-to-all instead of gathering all tokens everywhere.
-    No-op outside a ``current_mesh`` context or on expert-less meshes."""
+def constrain_expert_grouped(x: jax.Array) -> jax.Array:
+    """Grouped dispatched expert tensors [groups(batch), E, capacity, ...]:
+    groups over "data", expert dim over "expert".  Pinning this sharding is
+    what makes GSPMD lower the dispatch einsum to an all-to-all instead of
+    gathering all tokens everywhere.  No-op outside a ``current_mesh``
+    context or on expert-less meshes."""
     mesh = get_current_mesh()
     if mesh is None or EXPERT_AXIS not in mesh.axis_names:
         return x
-    spec = P(EXPERT_AXIS, *([None] * (x.ndim - 1)))
+    data = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
+    spec = P(data, EXPERT_AXIS, *([None] * (x.ndim - 2)))
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

@@ -46,17 +46,20 @@ def test_moe_matches_dense_mlp_with_identical_experts():
 
 
 def test_moe_capacity_drops_overflow_tokens():
-    """num_experts=1 routes every token to expert 0; capacity 4 of 8 tokens
-    → the first 4 (flat order) are processed, the rest contribute zero."""
+    """num_experts=1 routes every token to expert 0; per-row capacity 4 of
+    8 tokens → the first 4 of the row are processed, the rest are zero."""
     d = 8
     layer = MoEMLP(num_experts=1, capacity_factor=0.5, mlp_ratio=2,
                    dtype=jnp.float32)
-    x = jax.random.normal(jax.random.PRNGKey(0), (1, 8, d), jnp.float32)
+    # two rows: capacity is per routing group (= batch row), so EACH row
+    # keeps its first 4 tokens — proof the cumsum never crosses rows
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, d), jnp.float32)
     params = layer.init(jax.random.PRNGKey(1), x)["params"]
-    out = np.asarray(layer.apply({"params": params}, x))[0]
+    out = np.asarray(layer.apply({"params": params}, x))
 
-    assert np.abs(out[:4]).sum() > 0, "kept tokens must produce output"
-    np.testing.assert_allclose(out[4:], 0.0, atol=1e-7)
+    for row in range(2):
+        assert np.abs(out[row, :4]).sum() > 0, "kept tokens must produce output"
+        np.testing.assert_allclose(out[row, 4:], 0.0, atol=1e-7)
 
 
 def test_moe_aux_loss_sown_and_near_one_when_balanced():
