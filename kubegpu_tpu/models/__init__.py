@@ -4,6 +4,9 @@
 from kubegpu_tpu.models.resnet import ResNet, ResNet18, ResNet50, ResNet101, ResNet152
 from kubegpu_tpu.models.transformer import TransformerLM
 from kubegpu_tpu.models.moe import MoEMLP, MoeBlock, MoeTransformerLM
+# NOTE: kubegpu_tpu.models.checkpoint is deliberately NOT imported here —
+# it pulls in orbax, which checkpoint-less deployments don't ship; import it
+# as a submodule where needed.
 from kubegpu_tpu.models.pipeline_lm import (
     init_pipeline_lm,
     make_pipeline_lm_train_step,

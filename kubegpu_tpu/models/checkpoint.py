@@ -1,0 +1,84 @@
+"""Workload checkpoint/resume via Orbax (SURVEY.md §5.4).
+
+The framework's durable state lives in API-server annotations; WORKLOAD
+state (params/optimizer) is the pods' business — this module is the pods'
+side of that contract, completing the elastic-recovery story: pod dies →
+controller restarts it → the pod re-schedules through the extender → the
+worker resumes from its last checkpoint instead of step 0.
+
+Orbax is sharding-native: arrays are saved with their shardings and
+restored into whatever shardings the (possibly different) restart mesh
+placed on the template state, so a gang rescheduled onto a *different*
+ICI-contiguous sub-mesh resumes cleanly.  Multi-host gangs need a path all
+workers share (GCS/NFS in production; save/restore are collective when
+``jax.distributed`` is up).
+
+Only the array subtree of TrainState travels — ``apply_fn``/``tx`` are
+code, which belongs to the image, not the checkpoint.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from kubegpu_tpu.models.train import TrainState
+
+log = logging.getLogger(__name__)
+
+
+def _arrays_of(state: TrainState) -> dict:
+    return {
+        "step": state.step,
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "opt_state": state.opt_state,
+    }
+
+
+def make_manager(ckpt_dir: str, max_to_keep: int = 3) -> ocp.CheckpointManager:
+    """CheckpointManager with step numbering + retention (keeps the last
+    ``max_to_keep``); directory is created on first save."""
+    return ocp.CheckpointManager(
+        ckpt_dir,
+        options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+    )
+
+
+def save_checkpoint(mgr: ocp.CheckpointManager, state: TrainState) -> int:
+    """Save the state's array subtree at its current step; returns the step."""
+    step = int(jax.device_get(state.step))
+    mgr.save(step, args=ocp.args.StandardSave(_arrays_of(state)))
+    return step
+
+
+def latest_step(mgr: ocp.CheckpointManager) -> Optional[int]:
+    return mgr.latest_step()
+
+
+def restore_checkpoint(
+    mgr: ocp.CheckpointManager, template: TrainState, step: Optional[int] = None
+) -> Optional[TrainState]:
+    """Restore into the TEMPLATE's shardings (build the template exactly as
+    for a fresh run — model init + placement — so restored arrays land
+    sharded the same way); returns None when no checkpoint exists."""
+    step = mgr.latest_step() if step is None else step
+    if step is None:
+        return None
+    abstract = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding)
+        if hasattr(a, "sharding")
+        else a,
+        _arrays_of(template),
+    )
+    restored = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+    log.info("restored checkpoint step=%d", step)
+    return template.replace(
+        step=restored["step"],
+        params=restored["params"],
+        batch_stats=restored["batch_stats"],
+        opt_state=restored["opt_state"],
+    )

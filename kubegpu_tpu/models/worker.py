@@ -53,6 +53,12 @@ def main(argv=None) -> int:
     ap.add_argument("--batch-per-chip", type=int, default=32)
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument(
+        "--ckpt-dir",
+        default=os.environ.get("KUBEGPU_CKPT_DIR", ""),
+        help="checkpoint/resume directory (shared across the gang); empty disables",
+    )
+    ap.add_argument("--ckpt-every", type=int, default=10, help="steps between saves")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -95,6 +101,23 @@ def main(argv=None) -> int:
     state, images, labels = place_resnet(state, (images, labels), mesh)
     step = make_resnet_train_step(mesh)
 
+    mgr = None
+    start_step = 0
+    save_checkpoint = None
+    if args.ckpt_dir:
+        from kubegpu_tpu.models.checkpoint import (
+            make_manager,
+            restore_checkpoint,
+            save_checkpoint,
+        )
+
+        mgr = make_manager(os.path.abspath(args.ckpt_dir))
+        restored = restore_checkpoint(mgr, state)
+        if restored is not None:
+            state = restored
+            start_step = int(jax.device_get(state.step))
+            print(f"RESUMED step={start_step}", flush=True)
+
     state, loss = step(state, images, labels)
     jax.block_until_ready(loss)
     first_step_s = time.monotonic() - t0
@@ -102,13 +125,26 @@ def main(argv=None) -> int:
     print(f"FIRST_STEP_DONE seconds={first_step_s:.2f} loss={float(loss):.4f}", flush=True)
 
     t1 = time.monotonic()
+    save_s = 0.0
+    done = start_step + 1
     for _ in range(args.steps - 1):
         state, loss = step(state, images, labels)
+        done += 1
+        if mgr is not None and done % args.ckpt_every == 0:
+            # periodic crash-recovery saves; excluded from the throughput
+            # metric so checkpointed and plain runs stay comparable
+            ts = time.monotonic()
+            save_checkpoint(mgr, state)
+            save_s += time.monotonic() - ts
     jax.block_until_ready(loss)
-    dt = time.monotonic() - t1
+    dt = time.monotonic() - t1 - save_s
     if args.steps > 1:
         ips = batch * (args.steps - 1) / dt
         print(f"steady_state images_per_sec={ips:.1f} loss={float(loss):.4f}", flush=True)
+    if mgr is not None:
+        save_checkpoint(mgr, state)
+        mgr.wait_until_finished()
+        print(f"CHECKPOINT_SAVED step={int(jax.device_get(state.step))}", flush=True)
     return 0
 
 

@@ -1,0 +1,107 @@
+"""Checkpoint/resume tests (models/checkpoint.py) — the workload half of
+the elastic-recovery story (SURVEY.md §5.4): train, save, kill, restart,
+restore into the restart mesh's shardings, resume at the saved step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubegpu_tpu.models import (
+    ResNet,
+    create_train_state,
+    make_resnet_train_step,
+    place_resnet,
+)
+from kubegpu_tpu.models.checkpoint import (
+    make_manager,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from kubegpu_tpu.parallel import device_mesh
+
+
+def _tiny_setup(mesh, seed=0):
+    model = ResNet(stage_sizes=(1, 1, 1, 1), num_filters=8, num_classes=10)
+    rng = jax.random.PRNGKey(seed)
+    images = jnp.ones((8, 32, 32, 3), jnp.float32)
+    labels = jnp.zeros((8,), jnp.int32)
+    state = create_train_state(model, rng, images)
+    state, images, labels = place_resnet(state, (images, labels), mesh)
+    return state, images, labels
+
+
+def test_restore_none_when_empty(tmp_path):
+    mesh = device_mesh({"data": 2}, devices=jax.devices()[:2])
+    state, _, _ = _tiny_setup(mesh)
+    mgr = make_manager(str(tmp_path / "ckpt"))
+    assert restore_checkpoint(mgr, state) is None
+
+
+def test_save_restore_roundtrip_resumes_at_step(tmp_path):
+    mesh = device_mesh({"data": 2}, devices=jax.devices()[:2])
+    state, images, labels = _tiny_setup(mesh)
+    step = make_resnet_train_step(mesh, donate=False)
+    for _ in range(3):
+        state, _loss = step(state, images, labels)
+
+    mgr = make_manager(str(tmp_path / "ckpt"))
+    saved_step = save_checkpoint(mgr, state)
+    mgr.wait_until_finished()
+    assert saved_step == 3
+
+    # "restart": fresh init from a DIFFERENT seed — params must differ...
+    fresh, images2, labels2 = _tiny_setup(mesh, seed=42)
+    assert any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(fresh.params),
+                        jax.tree_util.tree_leaves(state.params))
+    )
+
+    # ...until restore brings back the saved arrays, step included
+    mgr2 = make_manager(str(tmp_path / "ckpt"))
+    restored = restore_checkpoint(mgr2, fresh)
+    assert restored is not None
+    assert int(jax.device_get(restored.step)) == 3
+    for a, b in zip(jax.tree_util.tree_leaves(restored.params),
+                    jax.tree_util.tree_leaves(state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree_util.tree_leaves(restored.opt_state),
+                    jax.tree_util.tree_leaves(state.opt_state)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+    # training continues from the restored state
+    restored, loss = step(restored, images2, labels2)
+    assert int(jax.device_get(restored.step)) == 4
+    assert np.isfinite(float(loss))
+
+
+def test_restore_onto_different_mesh_shardings(tmp_path):
+    """A rescheduled gang may land on a different sub-mesh: save from a
+    2-device mesh, restore into a 4-device template — arrays must land in
+    the TEMPLATE's shardings."""
+    mesh2 = device_mesh({"data": 2}, devices=jax.devices()[:2])
+    state, images, labels = _tiny_setup(mesh2)
+    step = make_resnet_train_step(mesh2, donate=False)
+    state, _ = step(state, images, labels)
+    mgr = make_manager(str(tmp_path / "ckpt"))
+    save_checkpoint(mgr, state)
+    mgr.wait_until_finished()
+
+    mesh4 = device_mesh({"data": 4}, devices=jax.devices()[:4])
+    template, images4, labels4 = _tiny_setup(mesh4, seed=7)
+    restored = restore_checkpoint(make_manager(str(tmp_path / "ckpt")), template)
+    assert restored is not None
+    leaf = jax.tree_util.tree_leaves(restored.params)[0]
+    assert leaf.sharding.mesh.devices.size == 4
+    step4 = make_resnet_train_step(mesh4, donate=False)
+    restored, loss = step4(restored, images4, labels4)
+    assert np.isfinite(float(loss))
+
+    # retention: max_to_keep bounds the kept steps
+    mgr3 = make_manager(str(tmp_path / "ckpt2"), max_to_keep=2)
+    s = state
+    for _ in range(4):
+        s, _ = step(s, images, labels)
+        save_checkpoint(mgr3, s)
+    mgr3.wait_until_finished()
+    assert len(mgr3.all_steps()) <= 2
