@@ -233,15 +233,7 @@ def fit_gang(view: SliceView, pods: Sequence[PodInfo]) -> GangResult:
                 ),
             )
 
-    candidates = []
-    for rect in enumerate_rectangles(total, view.mesh_shape, view.wrap):
-        coords = rect.coords(view.mesh_shape, view.wrap)
-        if not coords <= free:
-            continue
-        s = placement_score(coords, free, view.mesh_shape, view.wrap)
-        candidates.append((s, sorted(coords), coords))
-    # deterministic: score desc, then lexicographic coords
-    candidates.sort(key=lambda t: (-t[0], t[1]))
+    candidates = _candidate_rectangles(total, view, free)
 
     for s, _, coords in candidates:
         packed = _pack_rectangle(view, pods, requests, coords)
@@ -268,6 +260,30 @@ def fit_gang(view: SliceView, pods: Sequence[PodInfo]) -> GangResult:
             f"{len(pods)} pods on slice {view.slice_id}"
         ),
     )
+
+
+def _candidate_rectangles(total: int, view: SliceView, free: FrozenSet[Coord]):
+    """Scored free rectangles of `total` chips, score desc then lexicographic
+    coords: native C++ scan when built (native/grpalloc_core.cpp — the hot
+    loop on big meshes), else the defining Python loop.  Parity between the
+    two is tested in tests/test_native_grpalloc.py."""
+    from kubegpu_tpu.grpalloc import native_core
+
+    native = native_core.candidate_rectangles(
+        total, view.mesh_shape, view.wrap, free
+    )
+    if native is not None:
+        return native
+    candidates = []
+    for rect in enumerate_rectangles(total, view.mesh_shape, view.wrap):
+        coords = rect.coords(view.mesh_shape, view.wrap)
+        if not coords <= free:
+            continue
+        s = placement_score(coords, free, view.mesh_shape, view.wrap)
+        candidates.append((s, sorted(coords), coords))
+    # deterministic: score desc, then lexicographic coords
+    candidates.sort(key=lambda t: (-t[0], t[1]))
+    return candidates
 
 
 def _pack_rectangle(

@@ -1,0 +1,143 @@
+"""ctypes binding for the native allocator core (native/grpalloc_core.cpp).
+
+Twin of the rectangle scan in ``fit_gang`` (allocator.py): on large meshes
+the candidate enumeration+scoring dominates extender filter latency, so a
+C++ fast path serves it; semantics are defined by the Python code and the
+two are parity-tested (tests/test_native_grpalloc.py).
+
+Same contract as plugins/native.py: :func:`load` returning None (not built,
+wrong arch, or ``KUBEGPU_NO_NATIVE=1``) must be tolerated everywhere — the
+pure-Python loop is always correct, native is only faster.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import FrozenSet, List, Optional, Tuple
+
+from kubegpu_tpu.types.topology import Coord, enumerate_rectangles
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _candidates_paths() -> List[str]:
+    out = []
+    env = os.environ.get("KUBEGPU_TPU_NATIVE_GRPALLOC")
+    if env:
+        out.append(env)
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    out.append(os.path.join(repo_root, "native", "libgrpalloc_core.so"))
+    out.append("libgrpalloc_core.so")
+    return out
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The core library, or None when unavailable/disabled (cached)."""
+    global _lib, _load_failed
+    if os.environ.get("KUBEGPU_NO_NATIVE"):
+        return None
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        for path in _candidates_paths():
+            try:
+                lib = ctypes.CDLL(path)
+                lib.grpalloc_core_version.restype = ctypes.c_char_p
+                if lib.grpalloc_core_version() != b"kubegpu-tpu-grpalloc/1":
+                    continue  # foreign/stale library
+                lib.grpalloc_candidate_rectangles.argtypes = [
+                    ctypes.POINTER(ctypes.c_int),    # mesh_shape
+                    ctypes.POINTER(ctypes.c_uint8),  # wrap
+                    ctypes.c_int,                    # ndims
+                    ctypes.POINTER(ctypes.c_uint8),  # free_mask
+                    ctypes.c_int,                    # n_chips
+                    ctypes.POINTER(ctypes.c_int),    # out_cells
+                    ctypes.POINTER(ctypes.c_double), # out_scores
+                    ctypes.c_int,                    # max_out
+                ]
+                lib.grpalloc_candidate_rectangles.restype = ctypes.c_int
+                lib.grpalloc_score.argtypes = [
+                    ctypes.POINTER(ctypes.c_int),
+                    ctypes.POINTER(ctypes.c_uint8),
+                    ctypes.c_int,
+                    ctypes.POINTER(ctypes.c_uint8),
+                    ctypes.POINTER(ctypes.c_int),
+                    ctypes.c_int,
+                ]
+                lib.grpalloc_score.restype = ctypes.c_double
+            except (OSError, AttributeError):
+                continue
+            _lib = lib
+            return _lib
+        _load_failed = True
+        return None
+
+
+def _flatten(c: Coord, mesh_shape: Coord) -> int:
+    idx = 0
+    for d in range(len(mesh_shape)):
+        idx = idx * mesh_shape[d] + c[d]
+    return idx
+
+
+def _unflatten(idx: int, mesh_shape: Coord) -> Coord:
+    out = [0] * len(mesh_shape)
+    for d in range(len(mesh_shape) - 1, -1, -1):
+        out[d] = idx % mesh_shape[d]
+        idx //= mesh_shape[d]
+    return tuple(out)
+
+
+def _max_candidates(n: int, mesh_shape: Coord, wrap: Tuple[bool, ...]) -> int:
+    """Exact bound on emitted rectangles: count the defining enumeration
+    itself (cheap — no scoring), so the bound can never drift from it."""
+    return sum(1 for _ in enumerate_rectangles(n, mesh_shape, wrap))
+
+
+def candidate_rectangles(
+    n_chips: int,
+    mesh_shape: Coord,
+    wrap: Tuple[bool, ...],
+    free: FrozenSet[Coord],
+) -> Optional[List[Tuple[float, List[Coord], FrozenSet[Coord]]]]:
+    """Native scored free-rectangle candidates in fit_gang's sort order —
+    (score, sorted_coords, coord_set) triples — or None when the native
+    core is unavailable (caller falls back to the Python loop)."""
+    lib = load()
+    if lib is None or not (1 <= len(mesh_shape) <= 3) or n_chips < 1:
+        return None
+    volume = 1
+    for s in mesh_shape:
+        volume *= s
+    free_mask = (ctypes.c_uint8 * volume)()
+    for c in free:
+        free_mask[_flatten(c, mesh_shape)] = 1
+    max_out = _max_candidates(n_chips, mesh_shape, wrap)
+    out_cells = (ctypes.c_int * (max_out * n_chips))()
+    out_scores = (ctypes.c_double * max_out)()
+    count = lib.grpalloc_candidate_rectangles(
+        (ctypes.c_int * len(mesh_shape))(*mesh_shape),
+        (ctypes.c_uint8 * len(wrap))(*[1 if w else 0 for w in wrap]),
+        len(mesh_shape),
+        free_mask,
+        n_chips,
+        out_cells,
+        out_scores,
+        max_out,
+    )
+    if count < 0:
+        return None
+    result = []
+    for i in range(count):
+        coords = [
+            _unflatten(out_cells[i * n_chips + j], mesh_shape)
+            for j in range(n_chips)
+        ]
+        result.append((out_scores[i], coords, frozenset(coords)))
+    return result

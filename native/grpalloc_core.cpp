@@ -1,0 +1,290 @@
+// Native allocator hot loop — the C++ twin of grpalloc's rectangle scan
+// (kubegpu_tpu/grpalloc/allocator.py fit_gang candidates; SURVEY.md §3.1
+// marks this walk as the scheduler's hot loop).  Semantics are DEFINED by
+// the Python code in kubegpu_tpu/grpalloc/scoring.py +
+// kubegpu_tpu/types/topology.py; this file replicates them operation-for-
+// operation (same IEEE-double arithmetic order, same tie-breaks) and is
+// parity-tested against the Python in tests/test_native_grpalloc.py — any
+// divergence is a bug HERE, not there.
+//
+// Plain C ABI over flat arrays (no structs, so no size handshake needed),
+// consumed from Python via ctypes (kubegpu_tpu/grpalloc/native_core.py).
+// Cells are row-major flat indices into the mesh; masks are uint8[volume].
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr double W_CONTIG = 60.0;
+constexpr double W_ASPECT = 15.0;
+constexpr double W_FRAG = 25.0;
+constexpr int MAX_NDIMS = 3;
+
+struct Geometry {
+  int ndims;
+  int shape[MAX_NDIMS];
+  bool wrap[MAX_NDIMS];
+  int volume;
+};
+
+inline void unflatten(const Geometry& g, int idx, int* c) {
+  for (int d = g.ndims - 1; d >= 0; --d) {
+    c[d] = idx % g.shape[d];
+    idx /= g.shape[d];
+  }
+}
+
+inline int flatten(const Geometry& g, const int* c) {
+  int idx = 0;
+  for (int d = 0; d < g.ndims; ++d) idx = idx * g.shape[d] + c[d];
+  return idx;
+}
+
+// factor_shapes(n, ndims): all ndims-tuples with product n, sorted unique
+// (topology.py:factor_shapes — recursion emits sorted output after dedup).
+void factor_shapes(int n, int ndims, std::vector<std::vector<int>>* out) {
+  if (ndims == 1) {
+    out->push_back({n});
+    return;
+  }
+  for (int first = 1; first <= n; ++first) {
+    if (n % first != 0) continue;
+    std::vector<std::vector<int>> rest;
+    factor_shapes(n / first, ndims - 1, &rest);
+    for (auto& r : rest) {
+      std::vector<int> s;
+      s.push_back(first);
+      s.insert(s.end(), r.begin(), r.end());
+      out->push_back(std::move(s));
+    }
+  }
+  std::sort(out->begin(), out->end());
+  out->erase(std::unique(out->begin(), out->end()), out->end());
+}
+
+// coords_bounding_box over a set of cells (topology.py).
+void bounding_shape(const Geometry& g, const std::vector<int>& cells,
+                    int* out_shape) {
+  int lo[MAX_NDIMS], hi[MAX_NDIMS], c[MAX_NDIMS];
+  for (int d = 0; d < g.ndims; ++d) {
+    lo[d] = INT32_MAX;
+    hi[d] = INT32_MIN;
+  }
+  for (int cell : cells) {
+    unflatten(g, cell, c);
+    for (int d = 0; d < g.ndims; ++d) {
+      lo[d] = std::min(lo[d], c[d]);
+      hi[d] = std::max(hi[d], c[d]);
+    }
+  }
+  for (int d = 0; d < g.ndims; ++d) out_shape[d] = hi[d] - lo[d] + 1;
+}
+
+// scoring.py:aspect_score — min/max of the bounding-box extents.
+double aspect_score(const Geometry& g, const std::vector<int>& cells) {
+  if (cells.empty()) return 0.0;
+  int shape[MAX_NDIMS];
+  bounding_shape(g, cells, shape);
+  int mn = shape[0], mx = shape[0];
+  for (int d = 1; d < g.ndims; ++d) {
+    mn = std::min(mn, shape[d]);
+    mx = std::max(mx, shape[d]);
+  }
+  return static_cast<double>(mn) / static_cast<double>(mx);
+}
+
+// scoring.py:frag_score — 1 - exposed free perimeter / max possible.
+// Neighbor iteration mirrors scoring.py:neighbors exactly: per dim, ±1,
+// in-range, else wrapped only when wrap[d] and shape[d] > 2.
+double frag_score(const Geometry& g, const std::vector<int>& cells,
+                  const uint8_t* free_mask) {
+  if (cells.empty()) return 0.0;
+  std::vector<uint8_t> alloc(g.volume, 0);
+  for (int cell : cells) alloc[cell] = 1;
+  int exposed = 0;
+  int c[MAX_NDIMS];
+  for (int cell : cells) {
+    unflatten(g, cell, c);
+    for (int d = 0; d < g.ndims; ++d) {
+      for (int step = -1; step <= 1; step += 2) {
+        int v = c[d] + step;
+        int nb;
+        if (v >= 0 && v < g.shape[d]) {
+          int saved = c[d];
+          c[d] = v;
+          nb = flatten(g, c);
+          c[d] = saved;
+        } else if (g.wrap[d] && g.shape[d] > 2) {
+          int saved = c[d];
+          c[d] = ((v % g.shape[d]) + g.shape[d]) % g.shape[d];
+          nb = flatten(g, c);
+          c[d] = saved;
+        } else {
+          continue;
+        }
+        // "in remaining_free" = free minus the allocation itself
+        if (free_mask[nb] && !alloc[nb]) ++exposed;
+      }
+    }
+  }
+  double max_exposed =
+      2.0 * static_cast<double>(g.ndims) * static_cast<double>(cells.size());
+  return 1.0 - static_cast<double>(exposed) / max_exposed;
+}
+
+// topology.py:is_contiguous_submesh.  Rectangle candidates from the
+// enumeration are contiguous by construction; this generic form also
+// serves the exported scoring entry point.
+bool is_contiguous(const Geometry& g, const std::vector<int>& sorted_cells);
+
+// scoring.py:placement_score — same term order, same weights.
+double placement_score(const Geometry& g, const std::vector<int>& sorted_cells,
+                       const uint8_t* free_mask, bool known_contiguous) {
+  if (sorted_cells.empty()) return 0.0;
+  double contig;
+  if (known_contiguous || is_contiguous(g, sorted_cells)) {
+    contig = 1.0;
+  } else {
+    int shape[MAX_NDIMS];
+    bounding_shape(g, sorted_cells, shape);
+    int vol = 1;
+    for (int d = 0; d < g.ndims; ++d) vol *= shape[d];
+    contig = static_cast<double>(sorted_cells.size()) / static_cast<double>(vol);
+  }
+  return W_CONTIG * contig + W_ASPECT * aspect_score(g, sorted_cells) +
+         W_FRAG * frag_score(g, sorted_cells, free_mask);
+}
+
+// Emit every rectangle of exactly n cells (topology.py:enumerate_rectangles
+// order: factor shapes sorted, then row-major origins), as sorted flat cells.
+// visit returns false to stop early (buffer full).
+template <typename Visit>
+void enumerate_rects(const Geometry& g, int n, Visit visit) {
+  std::vector<std::vector<int>> shapes;
+  factor_shapes(n, g.ndims, &shapes);
+  for (const auto& shape : shapes) {
+    bool fits = true;
+    for (int d = 0; d < g.ndims; ++d)
+      if (shape[d] > g.shape[d]) fits = false;
+    if (!fits) continue;
+    int ranges[MAX_NDIMS];
+    for (int d = 0; d < g.ndims; ++d)
+      ranges[d] = (g.wrap[d] && shape[d] < g.shape[d])
+                      ? g.shape[d]
+                      : g.shape[d] - shape[d] + 1;
+    int origin[MAX_NDIMS] = {0, 0, 0};
+    for (;;) {
+      std::vector<int> cells;
+      cells.reserve(n);
+      int off[MAX_NDIMS] = {0, 0, 0};
+      for (;;) {
+        int c[MAX_NDIMS];
+        for (int d = 0; d < g.ndims; ++d)
+          c[d] = (origin[d] + off[d]) % g.shape[d];
+        cells.push_back(flatten(g, c));
+        int d = g.ndims - 1;
+        while (d >= 0 && ++off[d] == shape[d]) off[d--] = 0;
+        if (d < 0) break;
+      }
+      std::sort(cells.begin(), cells.end());
+      if (!visit(cells)) return;
+      int d = g.ndims - 1;
+      while (d >= 0 && ++origin[d] == ranges[d]) origin[d--] = 0;
+      if (d < 0) break;
+    }
+  }
+}
+
+bool is_contiguous(const Geometry& g, const std::vector<int>& sorted_cells) {
+  if (sorted_cells.empty()) return false;
+  bool any_wrap = false;
+  for (int d = 0; d < g.ndims; ++d) any_wrap |= g.wrap[d];
+  if (!any_wrap) {
+    int shape[MAX_NDIMS];
+    bounding_shape(g, sorted_cells, shape);
+    int vol = 1;
+    for (int d = 0; d < g.ndims; ++d) vol *= shape[d];
+    return vol == static_cast<int>(sorted_cells.size());
+  }
+  bool found = false;
+  enumerate_rects(g, static_cast<int>(sorted_cells.size()),
+                  [&](const std::vector<int>& cells) {
+                    if (cells == sorted_cells) {
+                      found = true;
+                      return false;
+                    }
+                    return true;
+                  });
+  return found;
+}
+
+bool init_geometry(const int* mesh_shape, const uint8_t* wrap, int ndims,
+                   Geometry* g) {
+  if (ndims < 1 || ndims > MAX_NDIMS) return false;
+  g->ndims = ndims;
+  g->volume = 1;
+  for (int d = 0; d < ndims; ++d) {
+    if (mesh_shape[d] < 1) return false;
+    g->shape[d] = mesh_shape[d];
+    g->wrap[d] = wrap[d] != 0;
+    g->volume *= mesh_shape[d];
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* grpalloc_core_version() { return "kubegpu-tpu-grpalloc/1"; }
+
+// All FREE rectangles of exactly n_chips cells, scored and sorted the way
+// fit_gang sorts its candidates: score descending, then lexicographic cell
+// list.  out_cells receives count*n_chips flat indices (each candidate's
+// cells ascending); out_scores receives count doubles.  Returns the
+// candidate count, -1 on bad geometry, or -2 if max_out is too small.
+int grpalloc_candidate_rectangles(const int* mesh_shape, const uint8_t* wrap,
+                                  int ndims, const uint8_t* free_mask,
+                                  int n_chips, int* out_cells,
+                                  double* out_scores, int max_out) {
+  Geometry g;
+  if (!init_geometry(mesh_shape, wrap, ndims, &g) || n_chips < 1) return -1;
+  std::vector<std::pair<double, std::vector<int>>> cands;
+  enumerate_rects(g, n_chips, [&](const std::vector<int>& cells) {
+    for (int cell : cells)
+      if (!free_mask[cell]) return true;
+    double s = placement_score(g, cells, free_mask, /*known_contiguous=*/true);
+    cands.emplace_back(s, cells);
+    return true;
+  });
+  std::sort(cands.begin(), cands.end(),
+            [](const auto& a, const auto& b) {
+              if (a.first != b.first) return a.first > b.first;
+              return a.second < b.second;
+            });
+  if (static_cast<int>(cands.size()) > max_out) return -2;
+  for (size_t i = 0; i < cands.size(); ++i) {
+    out_scores[i] = cands[i].first;
+    std::memcpy(out_cells + i * n_chips, cands[i].second.data(),
+                sizeof(int) * n_chips);
+  }
+  return static_cast<int>(cands.size());
+}
+
+// placement_score for an arbitrary cell set (twin of scoring.py entry).
+double grpalloc_score(const int* mesh_shape, const uint8_t* wrap, int ndims,
+                      const uint8_t* free_mask, const int* alloc_cells,
+                      int n_alloc) {
+  Geometry g;
+  if (!init_geometry(mesh_shape, wrap, ndims, &g) || n_alloc < 1) return -1.0;
+  std::vector<int> cells(alloc_cells, alloc_cells + n_alloc);
+  std::sort(cells.begin(), cells.end());
+  for (int cell : cells)
+    if (cell < 0 || cell >= g.volume) return -1.0;
+  return placement_score(g, cells, free_mask, /*known_contiguous=*/false);
+}
+
+}  // extern "C"
