@@ -23,7 +23,7 @@ from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 from kubegpu_tpu.grpalloc.scoring import placement_score
 from kubegpu_tpu.grpalloc.view import SliceView
 from kubegpu_tpu.types.info import Assignment, ChipRef, NodeInfo, PodInfo, TpuRequest
-from kubegpu_tpu.types.resource import ResourceTree
+from kubegpu_tpu.types.resource import ResourcePath, ResourceTree
 from kubegpu_tpu.types.topology import (
     Coord,
     enumerate_rectangles,
@@ -176,8 +176,24 @@ def take_pod_resources(node: NodeInfo, assignment: Assignment) -> None:
                 f"(double-take / bind race)"
             )
         chips.append(ch)
+    # generic plugin bindings (SURVEY.md §2 #5): validate before mutating,
+    # same all-or-nothing contract as the chip path
+    grouped = (
+        [(ResourcePath.parse(p), q) for p, q in assignment.grouped_totals().items()]
+        if assignment.node == node.name
+        else []
+    )
+    for path, qty in grouped:
+        avail = node.capacity.get(path) - node.used.get(path)
+        if qty > avail:
+            raise ValueError(
+                f"grouped resource {path} on {node.name}: want {qty}, "
+                f"available {avail} (double-take / bind race)"
+            )
     for ch in chips:
         node.used.add(node.chip_path(ch), 1)
+    for path, qty in grouped:
+        node.used.add(path, qty)
 
 
 def return_pod_resources(node: NodeInfo, assignment: Assignment) -> None:
@@ -197,6 +213,14 @@ def return_pod_resources(node: NodeInfo, assignment: Assignment) -> None:
             single = ResourceTree()
             single.add(path, 1)
             node.used.add_tree(single, sign=-1)
+    if assignment.node == node.name:
+        for p, qty in assignment.grouped_totals().items():
+            path = ResourcePath.parse(p)
+            back = min(qty, node.used.get(path))  # clamp: return is cleanup
+            if back > 0:
+                single = ResourceTree()
+                single.add(path, back)
+                node.used.add_tree(single, sign=-1)
 
 
 # ---------------------------------------------------------------------------

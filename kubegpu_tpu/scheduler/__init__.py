@@ -3,6 +3,13 @@ registry, HTTP extender server."""
 
 from kubegpu_tpu.scheduler.cache import ClusterCache
 from kubegpu_tpu.scheduler.core import FilterResult, Scheduler
+from kubegpu_tpu.scheduler.plugins import (
+    DeviceSchedulerPlugin,
+    GroupedResourceScheduler,
+    PluginRegistry,
+    TpuDeviceScheduler,
+    default_registry,
+)
 from kubegpu_tpu.scheduler.podgroup import GangPlan, PodGroupRegistry
 from kubegpu_tpu.scheduler.server import ExtenderServer, build_fake_cluster
 
@@ -14,4 +21,9 @@ __all__ = [
     "PodGroupRegistry",
     "ExtenderServer",
     "build_fake_cluster",
+    "DeviceSchedulerPlugin",
+    "GroupedResourceScheduler",
+    "PluginRegistry",
+    "TpuDeviceScheduler",
+    "default_registry",
 ]

@@ -22,12 +22,16 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from kubegpu_tpu.grpalloc import pod_fits_group_constraints
 from kubegpu_tpu.scheduler.cache import ClusterCache
+from kubegpu_tpu.scheduler.plugins import (
+    DeviceSchedulerPlugin,
+    PluginRegistry,
+    default_registry,
+)
 from kubegpu_tpu.scheduler.podgroup import PodGroupRegistry
 from kubegpu_tpu.scheduler.preemption import collect_units, find_victims
 from kubegpu_tpu.types import annotations
-from kubegpu_tpu.types.info import Assignment, PodInfo, TpuRequest
+from kubegpu_tpu.types.info import Assignment, PodInfo
 from kubegpu_tpu.types.topology import is_contiguous_submesh
 from kubegpu_tpu.utils.apiserver import ApiServer, Conflict, NotFound
 from kubegpu_tpu.utils.metrics import Metrics, default_metrics
@@ -52,11 +56,15 @@ class Scheduler:
         cache: Optional[ClusterCache] = None,
         metrics: Optional[Metrics] = None,
         gang_plan_ttl_s: float = 120.0,
+        plugins: Optional[PluginRegistry] = None,
     ) -> None:
         self.api = api
         self.cache = cache or ClusterCache(api)
         self.groups = PodGroupRegistry(self.cache, plan_ttl_s=gang_plan_ttl_s)
         self.metrics = metrics or default_metrics
+        # device-type dispatch (SURVEY.md §2 #5): TPU built-in; more device
+        # plugins via PluginRegistry.load (the Go-plugin .so analog)
+        self.plugins = plugins or default_registry()
 
     # -- filter -----------------------------------------------------------
     def filter(self, pod_obj: dict, node_names: List[str]) -> FilterResult:
@@ -72,13 +80,38 @@ class Scheduler:
             self.metrics.inc("kubegpu_filter_total")
             self.metrics.observe("kubegpu_filter_seconds", time.monotonic() - t0)
 
+    # -- plugin dispatch ---------------------------------------------------
+    def _owning_plugin(self, pod: PodInfo):
+        """(plugin, error): the single plugin serving this pod.  A pod mixing
+        device types is an error — fitting only one type would silently
+        over-commit the others."""
+        owners = self.plugins.plugins_for(pod)
+        if not owners:
+            return None, None
+        if len(owners) > 1:
+            names = "+".join(p.name for p in owners)
+            return None, (
+                f"pod requests multiple device types ({names}); "
+                "one device type per pod is supported"
+            )
+        return owners[0], None
+
+    @staticmethod
+    def _is_tpu_gang(pod: PodInfo) -> bool:
+        """Gang planning is TPU-only (it reasons in mesh rectangles); a
+        generic-device pod with gang annotations schedules plain.  The ONE
+        definition used by filter, prioritize and bind — they must agree."""
+        return bool(pod.pod_group) and pod.total_tpu_chips() > 0
+
     def _filter(self, pod: PodInfo, node_names: List[str]) -> FilterResult:
-        request = TpuRequest.from_pod(pod)
-        if request.total_chips == 0:
-            # 0-device passthrough: every node is fine by us
+        plugin, err = self._owning_plugin(pod)
+        if err:
+            return FilterResult(failed={n: err for n in node_names})
+        if plugin is None:
+            # no device request any plugin owns: every node is fine by us
             return FilterResult(nodes=list(node_names))
 
-        if pod.pod_group:
+        if self._is_tpu_gang(pod):
             outcome = self.groups.plan_for(pod) or None
             if outcome is None:
                 planned = self.groups.try_plan(pod)
@@ -105,13 +138,16 @@ class Scheduler:
                 )
             return FilterResult(nodes=nodes, failed=failed)
 
-        result = self._filter_plain(pod, request, node_names)
-        if not result.nodes and result.capacity_failure:
+        result = self._filter_plain(pod, plugin, node_names)
+        if not result.nodes and result.capacity_failure and plugin.name == "tpu":
+            # preemption reasons in chip units; generic devices don't preempt
             if self._attempt_preemption(pod, self._slices_of(node_names)):
-                result = self._filter_plain(pod, request, node_names)
+                result = self._filter_plain(pod, plugin, node_names)
         return result
 
-    def _filter_plain(self, pod: PodInfo, request: TpuRequest, node_names: List[str]) -> FilterResult:
+    def _filter_plain(
+        self, pod: PodInfo, plugin: DeviceSchedulerPlugin, node_names: List[str]
+    ) -> FilterResult:
         views = self.cache.views()
         result = FilterResult()
         for name in node_names:
@@ -120,7 +156,7 @@ class Scheduler:
                 result.failed[name] = "node not in scheduler cache"
                 continue
             view = views.get(node.slice_id) if node.slice_id else None
-            fit = pod_fits_group_constraints(node, request, view)
+            fit = plugin.fit(node, pod, view)
             if fit.fits:
                 result.nodes.append(name)
             else:
@@ -243,10 +279,10 @@ class Scheduler:
         except Exception:  # noqa: BLE001
             return [(n, 0) for n in node_names]
         try:
-            request = TpuRequest.from_pod(pod)
-            if request.total_chips == 0:
+            plugin, _ = self._owning_plugin(pod)
+            if plugin is None:  # no device request, or mixed-type error
                 return [(n, 0) for n in node_names]
-            if pod.pod_group:
+            if self._is_tpu_gang(pod):
                 plan = self.groups.plan_for(pod)
                 target = plan.per_pod[pod.key].node if plan else None
                 return [(n, 10 if n == target else 0) for n in node_names]
@@ -258,7 +294,7 @@ class Scheduler:
                     out.append((name, 0))
                     continue
                 view = views.get(node.slice_id) if node.slice_id else None
-                fit = pod_fits_group_constraints(node, request, view)
+                fit = plugin.fit(node, pod, view)
                 out.append((name, round(fit.score / 10) if fit.fits else 0))
             return out
         finally:
@@ -285,15 +321,18 @@ class Scheduler:
             pod = annotations.pod_from_k8s(pod_obj)
         except Exception as e:  # noqa: BLE001
             return f"unparseable pod {key}: {e}"
-        request = TpuRequest.from_pod(pod)
+        plugin, plugin_err = self._owning_plugin(pod)
+        if plugin_err:
+            return f"cannot bind {key}: {plugin_err}"
 
         assignment: Optional[Assignment] = None
         reserved_here = False
         gk = self.groups.group_key(pod)
+        is_tpu_gang = self._is_tpu_gang(pod) and gk is not None
 
-        if request.total_chips == 0:
+        if plugin is None:
             assignment = None  # plain bind, no device commitment
-        elif gk is not None:
+        elif is_tpu_gang:
             plan = self.groups.plan_for(pod)
             if plan is not None and pod.key in plan.per_pod:
                 assignment = plan.per_pod[pod.key]
@@ -315,7 +354,7 @@ class Scheduler:
                 if node is None:
                     return f"unknown node {node_name}"
                 view = self.cache.views().get(node.slice_id) if node.slice_id else None
-                fit = pod_fits_group_constraints(node, request, view)
+                fit = plugin.fit(node, pod, view)
                 if not fit.fits:
                     self.metrics.inc("kubegpu_bind_conflicts_total")
                     return f"no longer fits on {node_name}: {fit.reason}"
@@ -357,7 +396,7 @@ class Scheduler:
             # annotation + binding both durable: refresh() now rebuilds this
             # reservation from the API server
             self.cache.confirm(key)
-        if gk is not None:
+        if is_tpu_gang:
             self.groups.mark_committed(key, gk)
         if assignment is not None:
             self._record_placement_metrics(assignment)
@@ -367,6 +406,12 @@ class Scheduler:
     def _record_placement_metrics(self, a: Assignment) -> None:
         chips = a.all_chips()
         if not chips:
+            if a.grouped:
+                self.metrics.inc("kubegpu_placements_total")
+                self.metrics.inc(
+                    "kubegpu_grouped_allocated_total",
+                    sum(a.grouped_totals().values()),
+                )
             return
         node = self.cache.node(a.node)
         contiguous = False

@@ -269,6 +269,14 @@ def main(argv=None) -> None:
         "connecting to a real API server",
     )
     ap.add_argument("--resync-interval", type=float, default=30.0)
+    ap.add_argument(
+        "--plugin",
+        action="append",
+        default=[],
+        metavar="MODULE[:FACTORY]",
+        help="load an extra DeviceSchedulerPlugin (SURVEY.md §3.5 plugin "
+        "loading); FACTORY defaults to create_device_scheduler_plugin",
+    )
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
@@ -279,9 +287,14 @@ def main(argv=None) -> None:
         from kubegpu_tpu.utils.apiserver import KubeApiServer
 
         api = KubeApiServer()
+    from kubegpu_tpu.scheduler.plugins import default_registry
+
+    registry = default_registry()
+    for spec in args.plugin:
+        registry.load(spec)
     host, _, port = args.listen.rpartition(":")
     server = ExtenderServer(
-        Scheduler(api), listen=(host or "127.0.0.1", int(port)),
+        Scheduler(api, plugins=registry), listen=(host or "127.0.0.1", int(port)),
         resync_interval_s=args.resync_interval,
     )
     server.start()

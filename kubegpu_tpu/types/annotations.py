@@ -21,6 +21,9 @@ PREFIX = "kubegpu-tpu"
 
 # Node side (written by the advertiser daemon, read by the scheduler cache).
 NODE_TOPOLOGY = f"{PREFIX}/topology"            # JSON: slice fragment owned by host
+# Node side (written by a generic device daemon for non-TPU device types
+# served by a DeviceSchedulerPlugin, SURVEY.md §2 #5): flat {path: qty}.
+NODE_GROUPED_CAPACITY = f"{PREFIX}/grouped-capacity"
 # Pod side (written by users / controllers).
 POD_GROUP = f"{PREFIX}/pod-group"               # gang name
 POD_GROUP_SIZE = f"{PREFIX}/pod-group-size"     # gang cardinality
@@ -64,6 +67,25 @@ def decode_node_topology(name: str, payload: str) -> NodeInfo:
 
 
 # ---------------------------------------------------------------------------
+# Generic grouped-capacity annotation (non-TPU device plugins)
+# ---------------------------------------------------------------------------
+
+def encode_grouped_capacity(tree) -> str:
+    return json.dumps(tree.to_flat(), sort_keys=True)
+
+
+def decode_grouped_capacity(payload: str):
+    from kubegpu_tpu.types.resource import ResourceTree
+
+    flat = json.loads(payload)
+    if not isinstance(flat, dict):
+        raise ValueError(
+            f"grouped-capacity must be a JSON object, got {type(flat).__name__}"
+        )
+    return ResourceTree.from_flat(flat)
+
+
+# ---------------------------------------------------------------------------
 # Pod assignment annotation
 # ---------------------------------------------------------------------------
 
@@ -90,7 +112,31 @@ def pod_from_k8s(obj: dict) -> PodInfo:
         res = ((c.get("resources") or {}).get("limits") or {})
         req = ((c.get("resources") or {}).get("requests") or {})
         chips = int(res.get(RES_TPU, req.get(RES_TPU, 0)) or 0)
-        containers.append(ContainerInfo(name=c.get("name", ""), tpu_chips=chips))
+        # Other extended resources (domain/name-form) go to the plugin
+        # registry (SURVEY.md §2 #5); cpu/memory/etc stay with the default
+        # scheduler, exactly as TPU chips do.
+        extended: Dict[str, int] = {}
+        for source in (req, res):  # limits win over requests
+            for key, val in source.items():
+                if key == RES_TPU or "/" not in key:
+                    continue
+                try:
+                    extended[key] = int(val)
+                except (TypeError, ValueError):
+                    # device counts are plain integers; a quantity we cannot
+                    # parse must be VISIBLE — silently dropping it would let
+                    # the pod bypass plugin accounting entirely
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "pod %s/%s: ignoring unparseable extended resource %s=%r",
+                        meta.get("namespace", "default"), meta.get("name", ""),
+                        key, val,
+                    )
+                    continue
+        containers.append(
+            ContainerInfo(name=c.get("name", ""), tpu_chips=chips, extended=extended)
+        )
     pod = PodInfo(
         name=meta.get("name", ""),
         namespace=meta.get("namespace", "default"),
@@ -119,8 +165,22 @@ def node_from_k8s(obj: dict) -> NodeInfo:
     ann = dict(meta.get("annotations") or {})
     name = meta.get("name", "")
     if NODE_TOPOLOGY in ann:
-        return decode_node_topology(name, ann[NODE_TOPOLOGY])
-    return NodeInfo(name=name)
+        node = decode_node_topology(name, ann[NODE_TOPOLOGY])
+    else:
+        node = NodeInfo(name=name)
+    if NODE_GROUPED_CAPACITY in ann:
+        # fold generic device capacity in on top of the chip-derived tree;
+        # a malformed generic annotation must not take down the node's TPU
+        # topology (the fold is isolated, the chip tree survives)
+        try:
+            node.capacity.add_tree(decode_grouped_capacity(ann[NODE_GROUPED_CAPACITY]))
+        except (ValueError, TypeError, KeyError, AttributeError, json.JSONDecodeError):
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "ignoring malformed %s on node %s", NODE_GROUPED_CAPACITY, name
+            )
+    return node
 
 
 def assignment_from_pod(obj_or_annotations) -> Optional[Assignment]:

@@ -86,6 +86,9 @@ class ContainerInfo:
     name: str
     tpu_chips: int = 0                      # scalar google.com/tpu request
     grouped: Optional[ResourceTree] = None  # explicit grouped request (rare)
+    # Other extended resources (domain/name-style limits, e.g. a custom
+    # device type served by a non-TPU DeviceSchedulerPlugin — SURVEY.md §2 #5)
+    extended: Dict[str, int] = field(default_factory=dict)
 
 
 @dataclass
@@ -149,6 +152,9 @@ class Assignment:
     slice_id: Optional[str]
     per_container: Dict[str, List[ChipRef]] = field(default_factory=dict)
     score: float = 0.0
+    # Non-chip device bindings from a generic DeviceSchedulerPlugin
+    # (SURVEY.md §2 #5): container -> [(concrete resource path, qty)].
+    grouped: Dict[str, List[Tuple[str, int]]] = field(default_factory=dict)
 
     def all_chips(self) -> List[ChipRef]:
         out: List[ChipRef] = []
@@ -156,8 +162,16 @@ class Assignment:
             out.extend(refs)
         return out
 
+    def grouped_totals(self) -> Dict[str, int]:
+        """Aggregate grouped bindings across containers: path -> qty."""
+        out: Dict[str, int] = {}
+        for pairs in self.grouped.values():
+            for path, qty in pairs:
+                out[path] = out.get(path, 0) + qty
+        return out
+
     def to_dict(self) -> dict:
-        return {
+        d = {
             "node": self.node,
             "slice_id": self.slice_id,
             "score": self.score,
@@ -165,6 +179,11 @@ class Assignment:
                 c: [r.to_dict() for r in refs] for c, refs in self.per_container.items()
             },
         }
+        if self.grouped:
+            d["grouped"] = {
+                c: [[p, q] for p, q in pairs] for c, pairs in self.grouped.items()
+            }
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "Assignment":
@@ -175,6 +194,10 @@ class Assignment:
             per_container={
                 c: [ChipRef.from_dict(r) for r in refs]
                 for c, refs in d.get("per_container", {}).items()
+            },
+            grouped={
+                c: [(str(p), int(q)) for p, q in pairs]
+                for c, pairs in d.get("grouped", {}).items()
             },
         )
 

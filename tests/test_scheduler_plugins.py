@@ -1,0 +1,332 @@
+"""DeviceScheduler plugin registry (SURVEY.md §2 #5, §3.5 plugin loading).
+
+A second, non-TPU device type rides the whole control-plane loop: generic
+grouped-capacity advertisement -> treefit-backed filter/prioritize ->
+bind with grouped bindings in the assignment annotation -> cache bookkeeping
+-> restart replay -> release on delete.  The TPU path stays the built-in
+first-priority plugin.
+"""
+
+import sys
+import types
+
+import pytest
+
+from kubegpu_tpu.scheduler import Scheduler
+from kubegpu_tpu.scheduler.plugins import (
+    DeviceSchedulerPlugin,
+    GroupedResourceScheduler,
+    PluginRegistry,
+    TpuDeviceScheduler,
+    default_registry,
+)
+from kubegpu_tpu.types import annotations
+from kubegpu_tpu.types.info import PodInfo
+from kubegpu_tpu.types.resource import RES_TPU, ResourcePath, ResourceTree
+from kubegpu_tpu.utils.apiserver import InMemoryApiServer
+
+RES_NPU = "example.com/npu"
+NPU_TEMPLATE = "npugrp/*/npu/*/dev"
+
+
+def npu_plugin() -> GroupedResourceScheduler:
+    return GroupedResourceScheduler("npu", RES_NPU, NPU_TEMPLATE)
+
+
+def npu_capacity(groups: int = 2, per_group: int = 2) -> ResourceTree:
+    t = ResourceTree()
+    for g in range(groups):
+        for d in range(per_group):
+            t.add(ResourcePath.parse(f"npugrp/{g}/npu/{d}/dev"), 1)
+    return t
+
+
+def npu_node(api: InMemoryApiServer, name: str = "npu-node-0", **kw) -> None:
+    api.add_node({"metadata": {"name": name, "annotations": {}}})
+    api.patch_node_annotations(
+        name,
+        {
+            annotations.NODE_GROUPED_CAPACITY: annotations.encode_grouped_capacity(
+                npu_capacity(**kw)
+            )
+        },
+    )
+
+
+def npu_pod(name: str, want: int) -> dict:
+    return {
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "containers": [
+                {"name": "main", "resources": {"limits": {RES_NPU: str(want)}}}
+            ]
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# registry mechanics
+# ---------------------------------------------------------------------------
+
+def test_default_registry_owns_tpu_pods_only():
+    reg = default_registry()
+    tpu_pod = annotations.pod_from_k8s(
+        {
+            "metadata": {"name": "t"},
+            "spec": {
+                "containers": [
+                    {"name": "m", "resources": {"limits": {RES_TPU: "2"}}}
+                ]
+            },
+        }
+    )
+    cpu_pod = annotations.pod_from_k8s(
+        {"metadata": {"name": "c"}, "spec": {"containers": [{"name": "m"}]}}
+    )
+    assert reg.plugin_for(tpu_pod).name == "tpu"
+    assert reg.plugin_for(cpu_pod) is None
+
+
+def test_registration_order_is_precedence():
+    reg = default_registry()
+    reg.register(npu_plugin())
+    both = annotations.pod_from_k8s(
+        {
+            "metadata": {"name": "b"},
+            "spec": {
+                "containers": [
+                    {
+                        "name": "m",
+                        "resources": {"limits": {RES_TPU: "1", RES_NPU: "1"}},
+                    }
+                ]
+            },
+        }
+    )
+    assert reg.plugin_for(both).name == "tpu"  # tpu registered first
+
+
+def test_duplicate_name_rejected():
+    reg = default_registry()
+    with pytest.raises(ValueError):
+        reg.register(TpuDeviceScheduler())
+
+
+def test_dynamic_load_via_entry_symbol():
+    mod = types.ModuleType("fake_device_plugin")
+    mod.create_device_scheduler_plugin = npu_plugin
+    sys.modules["fake_device_plugin"] = mod
+    try:
+        reg = default_registry()
+        p = reg.load("fake_device_plugin")
+        assert p.name == "npu" and reg.names() == ["tpu", "npu"]
+    finally:
+        del sys.modules["fake_device_plugin"]
+
+
+def test_dynamic_load_rejects_non_plugin():
+    mod = types.ModuleType("bad_device_plugin")
+    mod.create_device_scheduler_plugin = lambda: object()
+    sys.modules["bad_device_plugin"] = mod
+    try:
+        with pytest.raises(TypeError):
+            PluginRegistry().load("bad_device_plugin")
+    finally:
+        del sys.modules["bad_device_plugin"]
+
+
+# ---------------------------------------------------------------------------
+# generic device type end-to-end through the scheduler verbs
+# ---------------------------------------------------------------------------
+
+def make_sched(api: InMemoryApiServer) -> Scheduler:
+    reg = default_registry()
+    reg.register(npu_plugin())
+    s = Scheduler(api, plugins=reg)
+    s.cache.refresh()
+    return s
+
+
+def test_generic_filter_prioritize_bind_and_bookkeeping():
+    api = InMemoryApiServer()
+    npu_node(api)  # 2 groups x 2 devs = 4 NPUs
+    api.add_node({"metadata": {"name": "plain-node", "annotations": {}}})
+    sched = make_sched(api)
+
+    api.create_pod(npu_pod("p1", 2))
+    r = sched.filter(api.get_pod("default", "p1"), ["npu-node-0", "plain-node"])
+    assert r.nodes == ["npu-node-0"]
+    assert "plain-node" in r.failed
+
+    scores = dict(sched.prioritize(api.get_pod("default", "p1"), ["npu-node-0"]))
+    assert scores["npu-node-0"] > 0
+
+    assert sched.bind("default", "p1", "npu-node-0") is None
+    a = annotations.assignment_from_pod(api.get_pod("default", "p1"))
+    assert a is not None and a.node == "npu-node-0" and not a.all_chips()
+    assert sum(a.grouped_totals().values()) == 2
+
+    node = sched.cache.node("npu-node-0")
+    assert node.used.total("dev") == 2
+
+    # only 2 NPUs left: a 3-NPU pod must not fit
+    api.create_pod(npu_pod("p2", 3))
+    r2 = sched.filter(api.get_pod("default", "p2"), ["npu-node-0"])
+    assert not r2.nodes
+    # ...but a 2-NPU pod still does
+    api.create_pod(npu_pod("p3", 2))
+    r3 = sched.filter(api.get_pod("default", "p3"), ["npu-node-0"])
+    assert r3.nodes == ["npu-node-0"]
+    assert sched.bind("default", "p3", "npu-node-0") is None
+    assert sched.cache.node("npu-node-0").used.total("dev") == 4
+
+
+def test_generic_release_on_delete():
+    api = InMemoryApiServer()
+    npu_node(api)
+    sched = make_sched(api)
+    api.create_pod(npu_pod("p1", 4))
+    assert sched.filter(api.get_pod("default", "p1"), ["npu-node-0"]).nodes
+    assert sched.bind("default", "p1", "npu-node-0") is None
+    assert sched.cache.node("npu-node-0").used.total("dev") == 4
+
+    obj = api.get_pod("default", "p1")
+    api.delete_pod("default", "p1")
+    sched.on_pod_deleted(obj)
+    assert sched.cache.node("npu-node-0").used.total("dev") == 0
+
+
+def test_generic_assignment_survives_restart_replay():
+    api = InMemoryApiServer()
+    npu_node(api)
+    sched = make_sched(api)
+    api.create_pod(npu_pod("p1", 3))
+    assert sched.filter(api.get_pod("default", "p1"), ["npu-node-0"]).nodes
+    assert sched.bind("default", "p1", "npu-node-0") is None
+
+    fresh = make_sched(api)  # new scheduler, same API server
+    assert fresh.cache.node("npu-node-0").used.total("dev") == 3
+    # remaining capacity is exactly 1
+    api.create_pod(npu_pod("p2", 1))
+    assert fresh.filter(api.get_pod("default", "p2"), ["npu-node-0"]).nodes
+    api.create_pod(npu_pod("p3", 2))
+    assert not fresh.filter(api.get_pod("default", "p3"), ["npu-node-0"]).nodes
+
+
+def test_generic_bind_race_detected():
+    """Two schedulers over one API server: the loser's bind must fail
+    cleanly (take validates before mutating)."""
+    api = InMemoryApiServer()
+    npu_node(api)  # 4 NPUs
+    s1 = make_sched(api)
+    s2 = make_sched(api)
+    api.create_pod(npu_pod("p1", 3))
+    api.create_pod(npu_pod("p2", 3))
+    assert s1.filter(api.get_pod("default", "p1"), ["npu-node-0"]).nodes
+    assert s2.filter(api.get_pod("default", "p2"), ["npu-node-0"]).nodes
+    assert s1.bind("default", "p1", "npu-node-0") is None
+    # s2's stale cache still thinks 4 are free; refresh inside bind path
+    # is NOT automatic — the annotation replay on refresh() is
+    s2.cache.refresh()
+    err = s2.bind("default", "p2", "npu-node-0")
+    assert err is not None
+
+
+def test_multi_container_generic_pod_binds_distinct_units():
+    api = InMemoryApiServer()
+    npu_node(api)  # 4 NPUs
+    sched = make_sched(api)
+    api.create_pod(
+        {
+            "metadata": {"name": "mc", "namespace": "default"},
+            "spec": {
+                "containers": [
+                    {"name": "a", "resources": {"limits": {RES_NPU: "2"}}},
+                    {"name": "b", "resources": {"limits": {RES_NPU: "2"}}},
+                ]
+            },
+        }
+    )
+    assert sched.filter(api.get_pod("default", "mc"), ["npu-node-0"]).nodes
+    assert sched.bind("default", "mc", "npu-node-0") is None
+    a = annotations.assignment_from_pod(api.get_pod("default", "mc"))
+    # each container got 2, and no unit is double-bound across containers
+    assert sorted(a.grouped) == ["a", "b"]
+    seen = {}
+    for c, pairs in a.grouped.items():
+        for path, qty in pairs:
+            seen[path] = seen.get(path, 0) + qty
+    assert sum(seen.values()) == 4
+    assert all(q == 1 for q in seen.values())  # 4 distinct single-unit devs
+
+
+def test_mixed_device_type_pod_rejected_not_overcommitted():
+    """A pod mixing device types must be rejected outright: fitting only
+    the first type would silently over-commit the second."""
+    api = InMemoryApiServer()
+    npu_node(api)
+    sched = make_sched(api)
+    api.create_pod(
+        {
+            "metadata": {"name": "mix", "namespace": "default"},
+            "spec": {
+                "containers": [
+                    {
+                        "name": "m",
+                        "resources": {"limits": {RES_TPU: "1", RES_NPU: "2"}},
+                    }
+                ]
+            },
+        }
+    )
+    r = sched.filter(api.get_pod("default", "mix"), ["npu-node-0"])
+    assert not r.nodes
+    assert "multiple device types" in r.failed["npu-node-0"]
+    err = sched.bind("default", "mix", "npu-node-0")
+    assert err is not None and "multiple device types" in err
+    # nothing was committed anywhere
+    assert sched.cache.node("npu-node-0").used.total("dev") == 0
+
+
+def test_malformed_grouped_capacity_keeps_tpu_topology():
+    """A broken generic-capacity annotation must not drop the node's TPU
+    topology from the cache."""
+    from kubegpu_tpu.plugins import Advertiser, FakeSlice
+
+    api = InMemoryApiServer()
+    fs = FakeSlice(slice_id="s0", mesh_shape=(2, 2), host_block=(2, 2))
+    for host, prov in fs.providers().items():
+        Advertiser(prov, api).advertise_once()
+    host = fs.hosts()[0]
+    api.patch_node_annotations(
+        host, {annotations.NODE_GROUPED_CAPACITY: "{not json"}
+    )
+    sched = make_sched(api)
+    node = sched.cache.node(host)
+    assert node is not None and node.is_tpu_node  # TPU tree survived
+
+
+def test_tpu_path_unchanged_with_extra_plugins_registered():
+    from kubegpu_tpu.plugins import Advertiser, FakeSlice
+
+    api = InMemoryApiServer()
+    fs = FakeSlice(slice_id="s0", mesh_shape=(4, 4), host_block=(2, 2))
+    for host, prov in fs.providers().items():
+        Advertiser(prov, api).advertise_once()
+    sched = make_sched(api)
+    api.create_pod(
+        {
+            "metadata": {"name": "t1", "namespace": "default"},
+            "spec": {
+                "containers": [
+                    {"name": "m", "resources": {"limits": {RES_TPU: "4"}}}
+                ]
+            },
+        }
+    )
+    nodes = sorted(n["metadata"]["name"] for n in api.list_nodes())
+    r = sched.filter(api.get_pod("default", "t1"), nodes)
+    assert r.nodes
+    assert sched.bind("default", "t1", r.nodes[0]) is None
+    a = annotations.assignment_from_pod(api.get_pod("default", "t1"))
+    assert len(a.all_chips()) == 4 and not a.grouped
