@@ -1,7 +1,17 @@
 """Reference JAX workloads — the payloads the framework schedules
 (SURVEY.md §2.2: the scheduled TensorFlow/JAX jobs, re-done jax-native)."""
 
-from kubegpu_tpu.models.resnet import ResNet, ResNet18, ResNet50, ResNet101, ResNet152
+from kubegpu_tpu.models.resnet import (
+    ResNet,
+    ResNet18,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+    ScanResNet,
+    ScanResNet50,
+    ScanResNet101,
+    ScanResNet152,
+)
 from kubegpu_tpu.models.transformer import TransformerLM
 from kubegpu_tpu.models.moe import MoEMLP, MoeBlock, MoeTransformerLM
 # NOTE: kubegpu_tpu.models.checkpoint is deliberately NOT imported here —
@@ -33,6 +43,10 @@ __all__ = [
     "ResNet50",
     "ResNet101",
     "ResNet152",
+    "ScanResNet",
+    "ScanResNet50",
+    "ScanResNet101",
+    "ScanResNet152",
     "TransformerLM",
     "MoEMLP",
     "MoeBlock",

@@ -50,17 +50,15 @@ class BottleneckBlock(nn.Module):
         return self.act(residual + y)
 
 
-class ResNet(nn.Module):
-    """Classic ResNet v1.5 (stride-2 on the 3x3, per the common benchmark
-    recipe)."""
+class _ResNetBase(nn.Module):
+    """Shared stem/head; subclasses implement the stage-body layout."""
 
     stage_sizes: Sequence[int]
     num_classes: int = 1000
     num_filters: int = 64
     dtype: jnp.dtype = jnp.bfloat16
 
-    @nn.compact
-    def __call__(self, x, train: bool = True):
+    def _conv_norm(self, train: bool):
         conv = partial(nn.Conv, dtype=self.dtype)
         norm = partial(
             nn.BatchNorm,
@@ -69,6 +67,9 @@ class ResNet(nn.Module):
             epsilon=1e-5,
             dtype=self.dtype,
         )
+        return conv, norm
+
+    def _stem(self, x, conv, norm):
         x = x.astype(self.dtype)
         x = conv(
             self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
@@ -76,7 +77,22 @@ class ResNet(nn.Module):
         )(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        return nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+    def _head(self, x):
+        x = jnp.mean(x, axis=(1, 2))
+        # classifier head in fp32 for a numerically stable softmax
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+class ResNet(_ResNetBase):
+    """Classic ResNet v1.5 (stride-2 on the 3x3, per the common benchmark
+    recipe)."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv, norm = self._conv_norm(train)
+        x = self._stem(x, conv, norm)
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
@@ -87,10 +103,68 @@ class ResNet(nn.Module):
                     norm=norm,
                     name=f"stage{i + 1}_block{j + 1}",
                 )(x)
-        x = jnp.mean(x, axis=(1, 2))
-        # classifier head in fp32 for a numerically stable softmax
-        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
-        return x
+        return self._head(x)
+
+
+class _ScanBody(nn.Module):
+    """scan body: one identity-shaped bottleneck block per iteration."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x, _):
+        x = BottleneckBlock(
+            filters=self.filters, conv=self.conv, norm=self.norm, name="block"
+        )(x)
+        return x, None
+
+
+class ScanResNet(_ResNetBase):
+    """ResNet with the identity-shaped tail blocks of each stage rolled into
+    one ``nn.scan`` — numerically the same network as `ResNet`, but the
+    traced program contains each stage's block body ONCE instead of
+    `block_count` times.
+
+    Why this exists (TPU-first): XLA compile time and executable size scale
+    with HLO size, and the north-star metric (BASELINE.json: pod
+    schedule-to-first-training-step < 60 s) pays that cost on the critical
+    path.  Rolling ResNet-50's 16 bottlenecks into 4 head blocks + 4 scanned
+    bodies shrinks the step HLO by ~3x; params for scanned blocks are
+    stacked on a leading `block` axis (still sharded per the same rules —
+    the axis is marked with ``nn.PARTITION_NAME: None``).
+    """
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv, norm = self._conv_norm(train)
+        x = self._stem(x, conv, norm)
+        for i, block_count in enumerate(self.stage_sizes):
+            strides = (2, 2) if i > 0 else (1, 1)
+            # head block: changes channels/stride, can't be scanned
+            x = BottleneckBlock(
+                filters=self.num_filters * 2**i,
+                strides=strides,
+                conv=conv,
+                norm=norm,
+                name=f"stage{i + 1}_head",
+            )(x)
+            if block_count > 1:
+                body = nn.scan(
+                    _ScanBody,
+                    variable_axes={"params": 0, "batch_stats": 0},
+                    split_rngs={"params": True},
+                    length=block_count - 1,
+                    metadata_params={nn.PARTITION_NAME: None},
+                )(
+                    filters=self.num_filters * 2**i,
+                    conv=conv,
+                    norm=norm,
+                    name=f"stage{i + 1}_body",
+                )
+                x, _ = body(x, None)
+        return self._head(x)
 
 
 ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2))  # (basic-block depth kept
@@ -98,3 +172,9 @@ ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2))  # (basic-block depth kept
 ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3))
 ResNet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3))
 ResNet152 = partial(ResNet, stage_sizes=(3, 8, 36, 3))
+
+# scan-rolled twins: same networks, ~stage-count-sized HLO instead of
+# depth-sized — the flagship for latency-critical cold starts
+ScanResNet50 = partial(ScanResNet, stage_sizes=(3, 4, 6, 3))
+ScanResNet101 = partial(ScanResNet, stage_sizes=(3, 4, 23, 3))
+ScanResNet152 = partial(ScanResNet, stage_sizes=(3, 8, 36, 3))

@@ -48,7 +48,13 @@ def initialize_distributed() -> None:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--model", default="resnet50", choices=["resnet50", "resnet-tiny"])
+    ap.add_argument(
+        "--model",
+        default="resnet50",
+        choices=["resnet50", "resnet50-unrolled", "resnet-tiny"],
+        help="resnet50 = scan-rolled flagship (fast compile); "
+        "resnet50-unrolled = plain per-block variant",
+    )
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--batch-per-chip", type=int, default=32)
     ap.add_argument("--image-size", type=int, default=224)
@@ -56,9 +62,19 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--ckpt-dir",
         default=os.environ.get("KUBEGPU_CKPT_DIR", ""),
-        help="checkpoint/resume directory (shared across the gang); empty disables",
+        help="checkpoint/resume root (shared across the gang); empty disables. "
+        "Checkpoints are written under <dir>/<model> so variants with "
+        "different param layouts (e.g. resnet50 vs resnet50-unrolled) "
+        "never collide on resume",
     )
     ap.add_argument("--ckpt-every", type=int, default=10, help="steps between saves")
+    ap.add_argument(
+        "--compile-cache",
+        default=os.environ.get("KUBEGPU_TPU_COMPILE_CACHE", ""),
+        help="persistent XLA compilation cache dir (pre-seed it in the pod "
+        "image or mount it from a PD to take compiles off the "
+        "schedule-to-first-step path); empty disables",
+    )
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -68,9 +84,16 @@ def main(argv=None) -> int:
     import jax
     import jax.numpy as jnp
 
+    if args.compile_cache:
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.abspath(args.compile_cache)
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+
     from kubegpu_tpu.models import (
         ResNet,
         ResNet50,
+        ScanResNet50,
         create_train_state,
         make_resnet_train_step,
         place_resnet,
@@ -87,6 +110,9 @@ def main(argv=None) -> int:
     )
     mesh = device_mesh({"data": n})
     if args.model == "resnet50":
+        model = ScanResNet50(num_classes=args.num_classes)
+        size = args.image_size
+    elif args.model == "resnet50-unrolled":
         model = ResNet50(num_classes=args.num_classes)
         size = args.image_size
     else:  # CI-sized twin, same code path
@@ -111,7 +137,7 @@ def main(argv=None) -> int:
             save_checkpoint,
         )
 
-        mgr = make_manager(os.path.abspath(args.ckpt_dir))
+        mgr = make_manager(os.path.abspath(os.path.join(args.ckpt_dir, args.model)))
         restored = restore_checkpoint(mgr, state)
         if restored is not None:
             state = restored

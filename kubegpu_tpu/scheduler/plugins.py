@@ -20,8 +20,11 @@ Two built-ins:
   against the node's allocatable tree; bindings ride the same Assignment
   annotation / cache bookkeeping as chips.
 
-Pods requesting several device types are owned by the FIRST registered
-plugin that claims them (registration order is precedence, TPU first).
+A pod may request ONE device type: the scheduler rejects pods whose
+containers mix device types (``Scheduler._owning_plugin``), because a single
+Assignment annotation can only commit one plugin's allocation atomically.
+``PluginRegistry.plugin_for`` resolves a single-type pod to its owning
+plugin by registration order (TPU first).
 """
 
 from __future__ import annotations

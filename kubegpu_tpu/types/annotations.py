@@ -123,17 +123,16 @@ def pod_from_k8s(obj: dict) -> PodInfo:
                 try:
                     extended[key] = int(val)
                 except (TypeError, ValueError):
-                    # device counts are plain integers; a quantity we cannot
-                    # parse must be VISIBLE — silently dropping it would let
-                    # the pod bypass plugin accounting entirely
-                    import logging
-
-                    logging.getLogger(__name__).warning(
-                        "pod %s/%s: ignoring unparseable extended resource %s=%r",
-                        meta.get("namespace", "default"), meta.get("name", ""),
-                        key, val,
+                    # device counts are plain integers; fail the pod exactly
+                    # like a malformed google.com/tpu quantity does — dropping
+                    # the request would let the pod bypass plugin device
+                    # accounting and over-commit the hardware
+                    raise ValueError(
+                        f"pod {meta.get('namespace', 'default')}/"
+                        f"{meta.get('name', '')}: unparseable extended "
+                        f"resource {key}={val!r} (device counts are plain "
+                        f"integers)"
                     )
-                    continue
         containers.append(
             ContainerInfo(name=c.get("name", ""), tpu_chips=chips, extended=extended)
         )

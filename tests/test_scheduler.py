@@ -453,3 +453,14 @@ def test_http_malformed_pod_returns_error_not_500(http_server):
         {"name": "m", "resources": {"limits": {RES_TPU: "four"}}}]}}
     flt = _post(addr, "/filter", {"Pod": bad, "NodeNames": nodes_of(api)})
     assert "unparseable pod" in flt["Error"]
+
+
+def test_http_malformed_extended_resource_rejected_like_tpu(http_server):
+    # a quantity the plugin registry can't parse must FAIL the pod, exactly
+    # like a malformed google.com/tpu — not silently bypass device accounting
+    api, srv = http_server
+    addr = srv.address
+    bad = {"metadata": {"name": "npu-bad"}, "spec": {"containers": [
+        {"name": "m", "resources": {"limits": {"example.com/npu": "2k"}}}]}}
+    flt = _post(addr, "/filter", {"Pod": bad, "NodeNames": nodes_of(api)})
+    assert "unparseable pod" in flt["Error"]
