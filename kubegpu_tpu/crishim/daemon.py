@@ -42,14 +42,19 @@ class ShimDaemon:
         if annotations.assignment_from_pod(pod.annotations) is None:
             return None  # not a device pod: pure passthrough
         members: Optional[Sequence[str]] = None
+        member_slices: Optional[dict] = None
         if pod.pod_group:
             # only reached for pods that DO need injection — an API outage
             # here raises InjectionError (fail CreateContainer, retry)
             # rather than degrading innocent passthrough containers
             members = self._gang_member_names(pod)
+            if pod.allow_multislice:
+                # the gang MAY span slices: the megascale env needs every
+                # member's bind-time slice, exactly or not at all
+                member_slices = self._gang_member_slices(pod, members)
         return compute_injection(
             pod, container_name, self.provider, member_names=members,
-            subdomain=pod.subdomain,
+            subdomain=pod.subdomain, member_slices=member_slices,
         )
 
     def _pod(self, namespace: str, pod_name: str, sandbox_annotations: dict) -> PodInfo:
@@ -57,7 +62,12 @@ class ShimDaemon:
         written at bind); the sandbox's annotation copy is the offline
         fallback — same data, captured at sandbox creation."""
         try:
-            return annotations.pod_from_k8s(self.api.get_pod(namespace, pod_name))
+            # lenient: injection only needs identity/gang/assignment fields;
+            # a malformed quantity must not push a bound pod onto the
+            # sandbox-annotation fallback path
+            return annotations.pod_from_k8s(
+                self.api.get_pod(namespace, pod_name), strict=False
+            )
         except Exception:  # noqa: BLE001 - degrade to the sandbox's copy,
             # but say so: repeated fallbacks signal an API/parse problem
             log.warning(
@@ -76,6 +86,10 @@ class ShimDaemon:
                 )
             except ValueError:
                 pod.pod_group_size = 1
+            pod.allow_multislice = (
+                sandbox_annotations.get(annotations.POD_MULTISLICE, "false").lower()
+                == "true"
+            )
             return pod
 
     def _gang_member_names(self, pod: PodInfo) -> Sequence[str]:
@@ -89,7 +103,7 @@ class ShimDaemon:
             names = []
             for obj in self.api.list_pods(namespace=pod.namespace):
                 try:
-                    p = annotations.pod_from_k8s(obj)
+                    p = annotations.pod_from_k8s(obj, strict=False)
                 except Exception:  # noqa: BLE001 - unrelated malformed pods
                     continue
                 if p.pod_group == pod.pod_group:
@@ -106,6 +120,31 @@ class ShimDaemon:
                 f"members visible; refusing to inject a partial worker table"
             )
         return sorted(names)[: pod.pod_group_size]
+
+    def _gang_member_slices(self, pod: PodInfo, members: Sequence[str]) -> dict:
+        """name -> bind-time slice_id for every gang member.  Raises
+        InjectionError when any member's assignment is not yet visible: a
+        partial slice table would compute a wrong MEGASCALE_NUM_SLICES /
+        slice index for every worker, so fail CreateContainer and let
+        kubelet retry after the siblings bind."""
+        slices: dict = {}
+        missing = []
+        for name in members:
+            try:
+                obj = self.api.get_pod(pod.namespace, name)
+                a = annotations.assignment_from_pod(obj)
+            except Exception:  # noqa: BLE001 - treat as not-yet-visible
+                a = None
+            if a is None or not a.slice_id:
+                missing.append(name)
+            else:
+                slices[name] = a.slice_id
+        if missing:
+            raise InjectionError(
+                f"gang {pod.pod_group}: members {missing} have no bind-time "
+                f"slice assignment yet; refusing a partial multislice table"
+            )
+        return slices
 
     def serve(self, upstream: str, listen: str) -> CriProxy:
         proxy = CriProxy(upstream_target=upstream, decide=self.decide, listen_target=listen)

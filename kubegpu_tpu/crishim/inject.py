@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import logging
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Mapping, Optional, Sequence
 
 from kubegpu_tpu.plugins.provider import AllocateResponse, TpuProvider
 from kubegpu_tpu.types import annotations
@@ -30,6 +30,9 @@ from kubegpu_tpu.types.info import Assignment, PodInfo
 log = logging.getLogger(__name__)
 
 DEFAULT_COORDINATOR_PORT = 8476
+# DCN transport rendezvous for multislice jobs (XLA megascale); distinct
+# from the jax.distributed coordinator port above
+DEFAULT_MEGASCALE_PORT = 8081
 
 
 class InjectionError(Exception):
@@ -83,12 +86,53 @@ def worker_env(
     }
 
 
+def multislice_env(
+    pod: PodInfo,
+    member_slices: Mapping[str, str],
+    subdomain: Optional[str] = None,
+    megascale_port: int = DEFAULT_MEGASCALE_PORT,
+) -> Dict[str, str]:
+    """The multislice (DCN) env contract for one gang member, when its gang
+    spans more than one slice (grpalloc.multislice placement).
+
+    ``member_slices`` maps every gang member's pod name to the slice_id its
+    bind-time assignment landed on.  The variables are the XLA/libtpu
+    megascale rendezvous set: slice count, this worker's slice index, and
+    the DCN coordinator — the first member ON THE FIRST SLICE (megascale
+    expects the coordinator on slice 0, so picking the globally-first name
+    would break whenever name order and slice order diverge, e.g. after a
+    member was re-planned into an existing gang's hole).  Empty when the
+    gang sits on one slice — single-slice jobs must not see megascale
+    vars."""
+    slices = sorted(set(member_slices.values()))
+    if len(slices) <= 1:
+        return {}
+    my_slice = member_slices.get(pod.name)
+    if my_slice is None:
+        raise InjectionError(
+            f"pod {pod.key}: no slice recorded for it in its own gang "
+            f"({sorted(member_slices)})"
+        )
+    coordinator = pod_hostname(
+        min(n for n, s in member_slices.items() if s == slices[0]),
+        subdomain,
+        pod.namespace,
+    )
+    return {
+        "MEGASCALE_COORDINATOR_ADDRESS": f"{coordinator}:{megascale_port}",
+        "MEGASCALE_NUM_SLICES": str(len(slices)),
+        "MEGASCALE_SLICE_ID": str(slices.index(my_slice)),
+        "MEGASCALE_PORT": str(megascale_port),
+    }
+
+
 def compute_injection(
     pod: PodInfo,
     container_name: str,
     provider: TpuProvider,
     member_names: Optional[Sequence[str]] = None,
     subdomain: Optional[str] = None,
+    member_slices: Optional[Mapping[str, str]] = None,
 ) -> Injection:
     """Everything to add to one container's config at CreateContainer time.
 
@@ -105,6 +149,10 @@ def compute_injection(
     if pod.pod_group:
         members = list(member_names) if member_names is not None else [pod.name]
         inj.env.update(worker_env(pod, members, subdomain=subdomain))
+        if member_slices:
+            inj.env.update(
+                multislice_env(pod, member_slices, subdomain=subdomain)
+            )
     else:
         inj.env.setdefault("TPU_WORKER_ID", "0")
         inj.env.setdefault("JAX_NUM_PROCESSES", "1")

@@ -13,6 +13,7 @@ from kubegpu_tpu.grpalloc.allocator import (
     return_pod_resources,
     take_pod_resources,
 )
+from kubegpu_tpu.grpalloc.multislice import MultisliceResult, fit_gang_multislice
 from kubegpu_tpu.grpalloc.scoring import placement_score
 from kubegpu_tpu.grpalloc.treefit import (
     TreeFitResult,
@@ -28,6 +29,8 @@ __all__ = [
     "pod_fits_group_constraints",
     "return_pod_resources",
     "take_pod_resources",
+    "MultisliceResult",
+    "fit_gang_multislice",
     "placement_score",
     "TreeFitResult",
     "expand_scalar_request",

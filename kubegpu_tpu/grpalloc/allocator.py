@@ -286,20 +286,30 @@ def fit_gang(view: SliceView, pods: Sequence[PodInfo]) -> GangResult:
     )
 
 
-def _candidate_rectangles(total: int, view: SliceView, free: FrozenSet[Coord]):
+def _candidate_rectangles(
+    total: int,
+    view: SliceView,
+    free: FrozenSet[Coord],
+    shape: Optional[Coord] = None,
+):
     """Scored free rectangles of `total` chips, score desc then lexicographic
     coords: native C++ scan when built (native/grpalloc_core.cpp — the hot
     loop on big meshes), else the defining Python loop.  Parity between the
-    two is tested in tests/test_native_grpalloc.py."""
+    two is tested in tests/test_native_grpalloc.py.  ``shape`` restricts the
+    scan to rectangles of exactly that shape (multislice equal-shape
+    placement); the restricted scan enumerates only that shape's origins."""
     from kubegpu_tpu.grpalloc import native_core
 
-    native = native_core.candidate_rectangles(
-        total, view.mesh_shape, view.wrap, free
-    )
-    if native is not None:
-        return native
+    if shape is None:
+        native = native_core.candidate_rectangles(
+            total, view.mesh_shape, view.wrap, free
+        )
+        if native is not None:
+            return native
     candidates = []
-    for rect in enumerate_rectangles(total, view.mesh_shape, view.wrap):
+    for rect in enumerate_rectangles(
+        total, view.mesh_shape, view.wrap, shapes=[shape] if shape else None
+    ):
         coords = rect.coords(view.mesh_shape, view.wrap)
         if not coords <= free:
             continue

@@ -137,7 +137,25 @@ def main(argv=None) -> int:
             save_checkpoint,
         )
 
-        mgr = make_manager(os.path.abspath(os.path.join(args.ckpt_dir, args.model)))
+        root = os.path.abspath(args.ckpt_dir)
+        try:
+            legacy = sorted(
+                d for d in os.listdir(root)
+                if d.isdigit() and os.path.isdir(os.path.join(root, d))
+            )
+        except OSError:
+            legacy = []
+        if legacy:
+            # checkpoints written before per-model namespacing live at the
+            # root; their param layout may not match this model variant, so
+            # they are NOT restored — but silence here would look like a
+            # silent restart from step 0
+            log.warning(
+                "ignoring legacy checkpoints at %s (steps %s); checkpoints "
+                "now live under %s — restore manually if the layouts match",
+                root, ",".join(legacy), os.path.join(root, args.model),
+            )
+        mgr = make_manager(os.path.join(root, args.model))
         restored = restore_checkpoint(mgr, state)
         if restored is not None:
             state = restored

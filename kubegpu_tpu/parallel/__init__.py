@@ -3,6 +3,7 @@
 from kubegpu_tpu.parallel.mesh import (
     device_mesh,
     distributed_init_from_env,
+    hybrid_device_mesh,
     local_chip_count,
     mesh_from_assignment,
 )
@@ -26,6 +27,7 @@ from kubegpu_tpu.parallel.sharding import (
 __all__ = [
     "device_mesh",
     "distributed_init_from_env",
+    "hybrid_device_mesh",
     "local_chip_count",
     "mesh_from_assignment",
     "DATA_AXIS",

@@ -88,6 +88,53 @@ def device_mesh(
     return Mesh(grid, tuple(sizes.keys()))
 
 
+def hybrid_device_mesh(
+    axes: Dict[str, int],
+    dcn_axis: str = "dcn",
+    num_slices: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Mesh over multiple DCN-connected slices (multislice jobs scheduled by
+    grpalloc.multislice: MEGASCALE_NUM_SLICES > 1).
+
+    ``dcn_axis`` must be the FIRST axis in ``axes`` — it spans slices and is
+    outermost, so collectives along it ride DCN while every other axis stays
+    inside one slice's ICI (the scaling-book layering: slow transport on the
+    outer mesh dimension, fast on the inner).  Devices are grouped by their
+    ``slice_index`` attribute (real TPU multislice backends expose it); when
+    absent (CPU dryruns), the visible devices are split into ``num_slices``
+    equal contiguous groups.
+    """
+    if not axes or next(iter(axes)) != dcn_axis:
+        raise ValueError(f"axes must lead with the DCN axis {dcn_axis!r}, got {list(axes)}")
+    devs = list(devices if devices is not None else jax.devices())
+    by_slice: Dict[int, List] = {}
+    if all(getattr(d, "slice_index", None) is not None for d in devs):
+        for d in devs:
+            by_slice.setdefault(d.slice_index, []).append(d)
+        groups = [by_slice[k] for k in sorted(by_slice)]
+    else:
+        k = num_slices or axes[dcn_axis]
+        if k == -1:
+            raise ValueError(
+                "the DCN axis cannot be inferred (-1) without device "
+                "slice_index metadata; pass num_slices"
+            )
+        if len(devs) % k:
+            raise ValueError(f"{len(devs)} devices not divisible into {k} slices")
+        per = len(devs) // k
+        groups = [devs[i * per : (i + 1) * per] for i in range(k)]
+    sizes = {len(g) for g in groups}
+    if len(sizes) != 1:
+        raise ValueError(f"slices are unequal ({sorted(sizes)} devices); multislice meshes need congruent slices")
+    want_dcn = axes[dcn_axis]
+    if want_dcn not in (-1, len(groups)):
+        raise ValueError(f"axes[{dcn_axis!r}]={want_dcn} but {len(groups)} slices visible")
+    ordered = {dcn_axis: len(groups)}
+    ordered.update((a, s) for a, s in axes.items() if a != dcn_axis)
+    return device_mesh(ordered, devices=[d for g in groups for d in g])
+
+
 def mesh_from_assignment(
     assignment: Assignment,
     axes: Dict[str, int],

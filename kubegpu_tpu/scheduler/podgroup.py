@@ -18,7 +18,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
-from kubegpu_tpu.grpalloc import fit_gang
+from kubegpu_tpu.grpalloc import fit_gang_multislice
+from kubegpu_tpu.grpalloc.multislice import fit_gang_into_layout
 from kubegpu_tpu.scheduler.cache import ClusterCache
 from kubegpu_tpu.types import annotations
 from kubegpu_tpu.types.info import Assignment, PodInfo
@@ -96,7 +97,7 @@ class PodGroupRegistry:
         the remainder instead of deadlocking on its own bound members."""
         gk = self.group_key(pod)
         assert gk is not None
-        pending, scheduled = self._gather_members(pod)
+        pending, scheduled, sched_slices = self._gather_members(pod)
         with self._lock:
             existing = self.plan_for(pod, now=now)
             if existing:
@@ -114,25 +115,31 @@ class PodGroupRegistry:
                 return PlanOutcome(
                     reason=f"gang {gk}: pod {pod.key} not in first {pod.pod_group_size} members"
                 )
-            # fit on the best slice; cache lock held through reserve so the
-            # view cannot go stale under us
+            # fit on the best slice — or, for opted-in gangs no single slice
+            # holds, across DCN-connected slices (grpalloc.multislice); cache
+            # lock held through reserve so the view cannot go stale under us
             with self.cache.lock:
                 views = self.cache.views()
-                best = None
-                reasons = []
-                for sid in sorted(views):
-                    g = fit_gang(views[sid], members)
-                    if g.success and (best is None or g.score > best[1].score):
-                        best = (sid, g)
-                    elif not g.success:
-                        reasons.append(f"{sid}: {g.reason}")
-                if best is None:
-                    detail = "; ".join(reasons) if reasons else "no TPU slices advertised"
+                layout: Dict[str, int] = {}
+                for sid in sched_slices.values():
+                    if sid:
+                        layout[sid] = layout.get(sid, 0) + 1
+                if layout:
+                    # partially-bound gang: replacements must rejoin the
+                    # existing slice layout — the running siblings'
+                    # rendezvous/megascale env is already baked in
+                    g = fit_gang_into_layout(
+                        views, members, layout, pod.pod_group_size
+                    )
+                else:
+                    g = fit_gang_multislice(
+                        views, members, allow_multislice=pod.allow_multislice
+                    )
+                if not g.success:
                     return PlanOutcome(
-                        reason=f"gang {gk} does not fit: {detail}",
+                        reason=f"gang {gk} does not fit: {g.reason}",
                         capacity_failure=bool(views),
                     )
-                sid, g = best
                 taken = []
                 for key, a in g.per_pod.items():
                     try:
@@ -149,7 +156,13 @@ class PodGroupRegistry:
                 score=g.score,
             )
             self._plans[gk] = plan
-            log.info("gang %s planned on slice %s score=%.1f", gk, sid, g.score)
+            log.info(
+                "gang %s planned on slice(s) %s score=%.1f%s",
+                gk,
+                ",".join(g.slice_ids),
+                g.score,
+                f" multislice shape={g.slice_shape}" if g.num_slices > 1 else "",
+            )
             return PlanOutcome(plan=plan)
 
     @staticmethod
@@ -166,32 +179,45 @@ class PodGroupRegistry:
     def planned_members(self, pod: PodInfo) -> Optional[List[PodInfo]]:
         """The member set try_plan would plan for this pod right now (used
         by preemption simulation so it can never diverge from planning)."""
-        pending, scheduled = self._gather_members(pod)
+        pending, scheduled, _ = self._gather_members(pod)
         if len(pending) + len(scheduled) < pod.pod_group_size:
             return None
         return self._select_members(pod, pending, scheduled)
 
     def _gather_members(self, pod: PodInfo):
-        """Group members split into (pending, already_scheduled).  A member
-        is scheduled if it is bound (spec.nodeName) or holds a reservation
-        in the cache — those keep their chips and are NOT re-planned."""
+        """Group members split into (pending, already_scheduled), plus each
+        scheduled member's bind-time slice (pod annotation, else cache
+        reservation) so a re-plan can anchor replacements to the gang's
+        existing slice layout.  A member is scheduled if it is bound
+        (spec.nodeName) or holds a reservation in the cache — those keep
+        their chips and are NOT re-planned."""
         pending = {}
         scheduled = {}
         seen = {}
+        slices = {}
         for obj in self.cache.api.list_pods(namespace=pod.namespace):
             try:
-                p = annotations.pod_from_k8s(obj)
+                # lenient: a sibling with one malformed quantity must stay
+                # VISIBLE as a member or the gang stalls at "waiting"
+                p = annotations.pod_from_k8s(obj, strict=False)
             except Exception:  # noqa: BLE001 - malformed neighbours don't block
                 continue
             if p.pod_group == pod.pod_group:
                 seen[p.key] = p
+                a = annotations.assignment_from_pod(obj)
+                if a is not None and a.slice_id and a.all_chips():
+                    slices[p.key] = a.slice_id
         seen.setdefault(pod.key, pod)
         for key, p in seen.items():
-            if p.node_name or (key != pod.key and self.cache.assignment_of(key) is not None):
+            ca = self.cache.assignment_of(key)
+            if ca is not None and ca.slice_id and ca.all_chips():
+                slices.setdefault(key, ca.slice_id)
+            if p.node_name or (key != pod.key and ca is not None):
                 scheduled[key] = p
             else:
                 pending[key] = p
-        return list(pending.values()), list(scheduled.values())
+        sched_slices = {k: slices.get(k) for k in scheduled}
+        return list(pending.values()), list(scheduled.values()), sched_slices
 
     def mark_committed(self, pod_key: str, group_key: str) -> None:
         with self._lock:

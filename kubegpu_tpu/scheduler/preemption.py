@@ -61,7 +61,9 @@ def collect_units(pods_raw: Sequence[dict], assignments: Dict[str, Assignment]) 
     units: Dict[str, VictimUnit] = {}
     for obj in pods_raw:
         try:
-            pod = annotations.pod_from_k8s(obj)
+            # lenient: an already-bound pod's chips must stay reclaimable
+            # even if one of its quantities no longer parses
+            pod = annotations.pod_from_k8s(obj, strict=False)
         except Exception:  # noqa: BLE001 - unparseable pods aren't candidates
             continue
         a = assignments.get(pod.key)

@@ -247,15 +247,27 @@ FAKE_PRESETS = {
 
 
 def build_fake_cluster(preset: str) -> ApiServer:
+    """Fabricated in-memory cluster; an ``Nx-`` prefix (e.g. ``2x-v5e-16``)
+    advertises N DCN-connected slices of the preset, for driving multislice
+    scheduling from curl."""
     from kubegpu_tpu.plugins import Advertiser, FakeSlice
 
-    if preset not in FAKE_PRESETS:
+    count, base = 1, preset
+    if "x-" in preset:
+        head, _, rest = preset.partition("x-")
+        if head.isdigit() and int(head) > 0:
+            count, base = int(head), rest
+    if base not in FAKE_PRESETS:
         raise SystemExit(f"unknown preset {preset}; choose from {sorted(FAKE_PRESETS)}")
-    mesh, block = FAKE_PRESETS[preset]
+    mesh, block = FAKE_PRESETS[base]
     api = InMemoryApiServer()
-    fs = FakeSlice(slice_id=f"fake-{preset}", mesh_shape=mesh, host_block=block)
-    for host, prov in fs.providers().items():
-        Advertiser(prov, api).advertise_once()
+    for i in range(count):
+        suffix = f"-{chr(ord('a') + i)}" if count > 1 else ""
+        fs = FakeSlice(
+            slice_id=f"fake-{base}{suffix}", mesh_shape=mesh, host_block=block
+        )
+        for host, prov in fs.providers().items():
+            Advertiser(prov, api).advertise_once()
     return api
 
 

@@ -29,6 +29,9 @@ POD_GROUP = f"{PREFIX}/pod-group"               # gang name
 POD_GROUP_SIZE = f"{PREFIX}/pod-group-size"     # gang cardinality
 POD_CONTIGUOUS = f"{PREFIX}/contiguous"         # "true"/"false", default true
 POD_PRIORITY = f"{PREFIX}/priority"             # int, for preemption
+POD_MULTISLICE = f"{PREFIX}/multislice"         # "true" lets a gang span
+                                                # DCN-connected slices when no
+                                                # single slice fits it
 # Pod side (written by the extender at bind, read by the CRI shim).
 POD_ASSIGNMENT = f"{PREFIX}/assignment"         # JSON: Assignment
 # Pod side (written by the extender for gang coordination/observability).
@@ -101,9 +104,17 @@ def decode_assignment(payload: str) -> Assignment:
 # k8s object -> Info converters (used by extender handlers + CRI shim)
 # ---------------------------------------------------------------------------
 
-def pod_from_k8s(obj: dict) -> PodInfo:
+def pod_from_k8s(obj: dict, strict: bool = True) -> PodInfo:
     """Build a PodInfo from a Kubernetes Pod object (dict form, as received
-    by the scheduler-extender HTTP endpoints)."""
+    by the scheduler-extender HTTP endpoints).
+
+    ``strict`` governs malformed device quantities: the scheduling verbs use
+    strict=True so a pod with an unparseable request FAILS (it must never
+    bypass device accounting), while LIST-path callers (gang member
+    gathering, preemption victim collection) use strict=False so one
+    malformed quantity cannot make an already-bound pod invisible — an
+    invisible sibling wedges its whole gang's injection and hides its chips
+    from preemption."""
     meta = obj.get("metadata", {}) or {}
     spec = obj.get("spec", {}) or {}
     ann: Dict[str, str] = dict(meta.get("annotations") or {})
@@ -111,7 +122,12 @@ def pod_from_k8s(obj: dict) -> PodInfo:
     for c in spec.get("containers", []) or []:
         res = ((c.get("resources") or {}).get("limits") or {})
         req = ((c.get("resources") or {}).get("requests") or {})
-        chips = int(res.get(RES_TPU, req.get(RES_TPU, 0)) or 0)
+        try:
+            chips = int(res.get(RES_TPU, req.get(RES_TPU, 0)) or 0)
+        except (TypeError, ValueError):
+            if strict:
+                raise
+            chips = 0  # lenient list path: visibility over accounting
         # Other extended resources (domain/name-form) go to the plugin
         # registry (SURVEY.md §2 #5); cpu/memory/etc stay with the default
         # scheduler, exactly as TPU chips do.
@@ -123,16 +139,17 @@ def pod_from_k8s(obj: dict) -> PodInfo:
                 try:
                     extended[key] = int(val)
                 except (TypeError, ValueError):
-                    # device counts are plain integers; fail the pod exactly
-                    # like a malformed google.com/tpu quantity does — dropping
-                    # the request would let the pod bypass plugin device
-                    # accounting and over-commit the hardware
-                    raise ValueError(
-                        f"pod {meta.get('namespace', 'default')}/"
-                        f"{meta.get('name', '')}: unparseable extended "
-                        f"resource {key}={val!r} (device counts are plain "
-                        f"integers)"
-                    )
+                    # device counts are plain integers; in strict mode fail
+                    # the pod exactly like a malformed google.com/tpu
+                    # quantity does — dropping the request would let the pod
+                    # bypass plugin device accounting and over-commit
+                    if strict:
+                        raise ValueError(
+                            f"pod {meta.get('namespace', 'default')}/"
+                            f"{meta.get('name', '')}: unparseable extended "
+                            f"resource {key}={val!r} (device counts are "
+                            f"plain integers)"
+                        )
         containers.append(
             ContainerInfo(name=c.get("name", ""), tpu_chips=chips, extended=extended)
         )
@@ -152,6 +169,7 @@ def pod_from_k8s(obj: dict) -> PodInfo:
     except ValueError:
         pod.pod_group_size = 1
     pod.require_contiguous = ann.get(POD_CONTIGUOUS, "true").lower() != "false"
+    pod.allow_multislice = ann.get(POD_MULTISLICE, "false").lower() == "true"
     try:
         pod.priority = int(ann.get(POD_PRIORITY, str(spec.get("priority", 0) or 0)))
     except ValueError:

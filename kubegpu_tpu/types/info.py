@@ -106,6 +106,9 @@ class PodInfo:
     pod_group: Optional[str] = None
     pod_group_size: int = 1
     require_contiguous: bool = True
+    # opt-in: the gang may span DCN-connected slices when no single slice
+    # fits it (grpalloc.multislice)
+    allow_multislice: bool = False
 
     @property
     def key(self) -> str:

@@ -219,15 +219,21 @@ def factor_shapes(n: int, ndims: int) -> List[Coord]:
 
 
 def enumerate_rectangles(
-    n: int, mesh_shape: Coord, wrap: Optional[Tuple[bool, ...]] = None
+    n: int,
+    mesh_shape: Coord,
+    wrap: Optional[Tuple[bool, ...]] = None,
+    shapes: Optional[List[Coord]] = None,
 ) -> Iterator[Submesh]:
     """Every axis-aligned rectangular submesh of exactly n chips that fits in
     the mesh (with wraparound where the torus allows).  Meshes are small
-    (≤256 chips — SURVEY.md §7 stage 2), so exhaustive scan is fine."""
+    (≤256 chips — SURVEY.md §7 stage 2), so exhaustive scan is fine.
+    ``shapes`` restricts the scan to the given rectangle shapes (they must
+    each have volume n) — multislice placement uses this to enumerate only
+    the one shape every slice must share."""
     ndims = len(mesh_shape)
     if wrap is None:
         wrap = tuple(False for _ in mesh_shape)
-    for shape in factor_shapes(n, ndims):
+    for shape in shapes if shapes is not None else factor_shapes(n, ndims):
         if any(shape[d] > mesh_shape[d] for d in range(ndims)):
             continue
         origin_ranges = []

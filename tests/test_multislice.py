@@ -1,0 +1,364 @@
+"""Multislice placement tests: gangs spanning DCN-connected slices
+(grpalloc.multislice), the megascale env contract, and the hybrid
+DCN x ICI workload mesh — all on fabricated topologies (SURVEY.md §4)."""
+
+from typing import Dict
+
+import pytest
+
+from kubegpu_tpu.crishim.daemon import ShimDaemon
+from kubegpu_tpu.crishim.inject import InjectionError, multislice_env
+from kubegpu_tpu.grpalloc import build_slice_views, fit_gang_multislice
+from kubegpu_tpu.plugins import Advertiser, FakeSlice
+from kubegpu_tpu.scheduler import Scheduler
+from kubegpu_tpu.types import RES_TPU, annotations, is_contiguous_submesh
+from kubegpu_tpu.types.info import ContainerInfo, NodeInfo, PodInfo
+from kubegpu_tpu.types.topology import SliceTopology, TpuGeneration
+from kubegpu_tpu.utils import InMemoryApiServer
+from kubegpu_tpu.utils.metrics import Metrics
+
+
+def make_nodes(slice_id, mesh=(4, 4), host_block=(2, 2)) -> Dict[str, NodeInfo]:
+    topo = SliceTopology.build(slice_id, TpuGeneration.V5E, mesh, host_block=host_block)
+    nodes = {}
+    for h in topo.hosts():
+        n = NodeInfo(
+            name=h,
+            slice_id=slice_id,
+            generation=topo.generation,
+            mesh_shape=topo.mesh_shape,
+            wrap=topo.wrap,
+            chips=topo.host_chips(h),
+        )
+        n.rebuild_capacity()
+        nodes[h] = n
+    return nodes
+
+
+def two_slice_views():
+    nodes = {**make_nodes("sa"), **make_nodes("sb")}
+    return build_slice_views(nodes.values())
+
+
+def gang(n, chips, multislice=False):
+    return [
+        PodInfo(
+            name=f"w{i}",
+            containers=[ContainerInfo(name="main", tpu_chips=chips)],
+            pod_group="g",
+            pod_group_size=n,
+            allow_multislice=multislice,
+        )
+        for i in range(n)
+    ]
+
+
+# -- allocator --------------------------------------------------------------
+
+def test_single_slice_preferred_when_it_fits():
+    views = two_slice_views()
+    res = fit_gang_multislice(views, gang(4, 4, multislice=True), allow_multislice=True)
+    assert res.success and res.num_slices == 1
+    slice_ids = {a.slice_id for a in res.per_pod.values()}
+    assert len(slice_ids) == 1
+
+
+def test_multislice_requires_opt_in():
+    views = two_slice_views()  # 2 x 16 chips; 32-chip gang fits neither alone
+    res = fit_gang_multislice(views, gang(8, 4), allow_multislice=False)
+    assert not res.success
+    assert annotations.POD_MULTISLICE in res.reason  # actionable hint
+
+
+def test_multislice_spans_two_slices_with_equal_shapes():
+    views = two_slice_views()
+    pods = gang(8, 4, multislice=True)
+    res = fit_gang_multislice(views, pods, allow_multislice=True)
+    assert res.success, res.reason
+    assert sorted(res.slice_ids) == ["sa", "sb"]
+    assert res.slice_shape is not None
+    per_slice = {}
+    for a in res.per_pod.values():
+        per_slice.setdefault(a.slice_id, set()).update(
+            c.coords for c in a.all_chips()
+        )
+    assert set(per_slice) == {"sa", "sb"}
+    for sid, coords in per_slice.items():
+        assert len(coords) == 16  # whole slice each
+        assert is_contiguous_submesh(coords, (4, 4))
+        # the common rectangle shape really is the advertised one
+        from kubegpu_tpu.types.topology import coords_bounding_box
+
+        _, shape = coords_bounding_box(coords)
+        assert shape == res.slice_shape
+    # every pod's own chips are host-local and contiguous
+    for a in res.per_pod.values():
+        hosts = {c.host for c in a.all_chips()}
+        assert len(hosts) == 1
+        assert is_contiguous_submesh({c.coords for c in a.all_chips()}, (4, 4))
+
+
+def test_multislice_minimizes_slice_count():
+    # 4 slices available, but 2 suffice for 32 chips -> exactly 2 used
+    nodes = {}
+    for sid in ("sa", "sb", "sc", "sd"):
+        nodes.update(make_nodes(sid))
+    views = build_slice_views(nodes.values())
+    res = fit_gang_multislice(views, gang(8, 4, multislice=True), allow_multislice=True)
+    assert res.success and res.num_slices == 2
+
+
+def test_multislice_rejects_heterogeneous_pods():
+    views = two_slice_views()
+    pods = gang(7, 4, multislice=True) + [
+        PodInfo(
+            name="odd",
+            containers=[ContainerInfo(name="main", tpu_chips=2)],
+            pod_group="g",
+            pod_group_size=8,
+            allow_multislice=True,
+        )
+    ]
+    res = fit_gang_multislice(views, pods, allow_multislice=True)
+    assert not res.success
+    assert "homogeneous" in res.reason
+
+
+# -- scheduler e2e over two advertised slices -------------------------------
+
+def two_slice_cluster():
+    api = InMemoryApiServer()
+    slices = {
+        sid: FakeSlice(slice_id=sid, mesh_shape=(4, 4), host_block=(2, 2))
+        for sid in ("sa", "sb")
+    }
+    for fs in slices.values():
+        for h, p in fs.providers().items():
+            Advertiser(p, api).advertise_once()
+    return api, slices
+
+
+def multislice_pod(name, chips, group, size):
+    return {
+        "metadata": {
+            "name": name,
+            "namespace": "default",
+            "uid": f"uid-{name}",
+            "annotations": {
+                annotations.POD_GROUP: group,
+                annotations.POD_GROUP_SIZE: str(size),
+                annotations.POD_MULTISLICE: "true",
+            },
+        },
+        "spec": {
+            "subdomain": "ms-svc",
+            "containers": [
+                {"name": "main", "resources": {"limits": {RES_TPU: str(chips)}}}
+            ],
+        },
+    }
+
+
+def schedule_all(api, sched, pods):
+    names = sorted(n["metadata"]["name"] for n in api.list_nodes())
+    for obj in pods:
+        name = obj["metadata"]["name"]
+        r = sched.filter(obj, names)
+        assert r.nodes, f"{name}: {r.failed or r.error}"
+        scores = dict(sched.prioritize(obj, r.nodes))
+        target = max(r.nodes, key=lambda n: (scores.get(n, 0), n))
+        assert sched.bind("default", name, target) is None, name
+
+
+def test_scheduler_binds_multislice_gang_across_slices():
+    api, _ = two_slice_cluster()
+    sched = Scheduler(api, metrics=Metrics())
+    sched.cache.refresh()
+    pods = [multislice_pod(f"m{i}", 4, "ms", 8) for i in range(8)]
+    for obj in pods:
+        api.create_pod(obj)
+    schedule_all(api, sched, pods)
+    slice_ids = set()
+    for i in range(8):
+        a = annotations.assignment_from_pod(api.get_pod("default", f"m{i}"))
+        assert a is not None and a.all_chips()
+        slice_ids.add(a.slice_id)
+    assert slice_ids == {"sa", "sb"}
+
+
+def test_scheduler_gang_without_opt_in_stays_unscheduled():
+    api, _ = two_slice_cluster()
+    sched = Scheduler(api, metrics=Metrics())
+    sched.cache.refresh()
+    pods = [multislice_pod(f"m{i}", 4, "ms", 8) for i in range(8)]
+    for obj in pods:
+        del obj["metadata"]["annotations"][annotations.POD_MULTISLICE]
+        api.create_pod(obj)
+    names = sorted(n["metadata"]["name"] for n in api.list_nodes())
+    r = sched.filter(pods[0], names)
+    assert not r.nodes
+    # and the failure explains the fix
+    assert any(annotations.POD_MULTISLICE in msg for msg in r.failed.values())
+
+
+# -- megascale env injection ------------------------------------------------
+
+def test_multislice_env_contract():
+    pod = PodInfo(name="m1", namespace="default", pod_group="ms")
+    member_slices = {"m0": "sa", "m1": "sb", "m2": "sa", "m3": "sb"}
+    env = multislice_env(pod, member_slices, subdomain="ms-svc")
+    assert env["MEGASCALE_NUM_SLICES"] == "2"
+    assert env["MEGASCALE_SLICE_ID"] == "1"  # sb sorts after sa
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"].startswith("m0.ms-svc.default.svc:")
+    # single-slice gang: no megascale vars at all
+    assert multislice_env(pod, {"m0": "sa", "m1": "sa"}) == {}
+
+
+def test_multislice_env_coordinator_is_on_slice_zero():
+    # the globally-first NAME sits on the second slice: the coordinator must
+    # be the first member ON slice 0, not the first name overall
+    pod = PodInfo(name="b0", namespace="default", pod_group="ms")
+    member_slices = {"a1": "sb", "a2": "sb", "b0": "sa", "b1": "sa"}
+    env = multislice_env(pod, member_slices)
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"].startswith("b0:")
+    assert env["MEGASCALE_SLICE_ID"] == "0"
+
+
+def test_crishim_injects_megascale_for_multislice_gang():
+    api, slices = two_slice_cluster()
+    sched = Scheduler(api, metrics=Metrics())
+    sched.cache.refresh()
+    pods = [multislice_pod(f"m{i}", 4, "ms", 8) for i in range(8)]
+    for obj in pods:
+        api.create_pod(obj)
+    schedule_all(api, sched, pods)
+    a0 = annotations.assignment_from_pod(api.get_pod("default", "m0"))
+    fs = slices[a0.slice_id]
+    daemon = ShimDaemon(api, fs.provider_for(a0.node))
+    inj = daemon.decide(
+        "default", "m0", "main",
+        api.get_pod("default", "m0")["metadata"]["annotations"], "m0",
+    )
+    assert inj is not None
+    assert inj.env["MEGASCALE_NUM_SLICES"] == "2"
+    assert inj.env["MEGASCALE_SLICE_ID"] in ("0", "1")
+    assert inj.env["JAX_NUM_PROCESSES"] == "8"
+    assert inj.env["TPU_VISIBLE_CHIPS"]
+
+
+def test_crishim_refuses_partial_multislice_table():
+    api, slices = two_slice_cluster()
+    sched = Scheduler(api, metrics=Metrics())
+    sched.cache.refresh()
+    pods = [multislice_pod(f"m{i}", 4, "ms", 8) for i in range(8)]
+    for obj in pods:
+        api.create_pod(obj)
+    schedule_all(api, sched, pods)
+    # strip one sibling's assignment: the slice table is incomplete
+    victim = api.get_pod("default", "m7")
+    del victim["metadata"]["annotations"][annotations.POD_ASSIGNMENT]
+    api.delete_pod("default", "m7")
+    api.create_pod(victim)
+    a0 = annotations.assignment_from_pod(api.get_pod("default", "m0"))
+    daemon = ShimDaemon(api, slices[a0.slice_id].provider_for(a0.node))
+    with pytest.raises(InjectionError):
+        daemon.decide(
+            "default", "m0", "main",
+            api.get_pod("default", "m0")["metadata"]["annotations"], "m0",
+        )
+
+
+# -- partial re-plan anchoring ----------------------------------------------
+
+def test_replanned_member_rejoins_its_gangs_slice():
+    # a dead member's replacement must land on the slice its gang already
+    # occupies — anywhere else and its baked-in megascale table would
+    # disagree with every running sibling's
+    api, _ = two_slice_cluster()
+    sched = Scheduler(api, metrics=Metrics())
+    sched.cache.refresh()
+    pods = [multislice_pod(f"m{i}", 4, "ms", 8) for i in range(8)]
+    for obj in pods:
+        api.create_pod(obj)
+    schedule_all(api, sched, pods)
+    victim_slice = annotations.assignment_from_pod(api.get_pod("default", "m3")).slice_id
+    api.delete_pod("default", "m3")
+    sched.cache.refresh()  # chips freed via annotation replay
+    replacement = multislice_pod("m3", 4, "ms", 8)
+    api.create_pod(replacement)
+    names = sorted(n["metadata"]["name"] for n in api.list_nodes())
+    r = sched.filter(replacement, names)
+    assert r.nodes, (r.failed, r.error)
+    # every feasible node is on the dead member's slice
+    assert all(n.startswith(victim_slice) for n in r.nodes)
+    target = sorted(r.nodes)[0]
+    assert sched.bind("default", "m3", target) is None
+    a = annotations.assignment_from_pod(api.get_pod("default", "m3"))
+    assert a.slice_id == victim_slice
+
+
+def test_replanned_member_stays_on_single_slice_gang_slice():
+    # same anchoring for a single-slice gang: the replacement cannot drift
+    # to the emptier slice (rendezvous assumes one ICI domain)
+    api, _ = two_slice_cluster()
+    sched = Scheduler(api, metrics=Metrics())
+    sched.cache.refresh()
+    pods = [multislice_pod(f"g{i}", 4, "sg", 4) for i in range(4)]
+    for obj in pods:  # 16 chips: fits exactly one slice
+        api.create_pod(obj)
+    schedule_all(api, sched, pods)
+    home = annotations.assignment_from_pod(api.get_pod("default", "g0")).slice_id
+    api.delete_pod("default", "g1")
+    sched.cache.refresh()
+    replacement = multislice_pod("g1", 4, "sg", 4)
+    api.create_pod(replacement)
+    names = sorted(n["metadata"]["name"] for n in api.list_nodes())
+    r = sched.filter(replacement, names)
+    assert r.nodes and all(n.startswith(home) for n in r.nodes)
+
+
+# -- lenient sibling parsing ------------------------------------------------
+
+def test_malformed_sibling_quantity_does_not_wedge_gang_injection():
+    # one bound member's extended resource is corrupted after bind: the
+    # sibling must stay VISIBLE to gang gathering (lenient list parse), or
+    # every member's CreateContainer fails forever
+    api, slices = two_slice_cluster()
+    sched = Scheduler(api, metrics=Metrics())
+    sched.cache.refresh()
+    pods = [multislice_pod(f"m{i}", 4, "ms", 8) for i in range(8)]
+    for obj in pods:
+        api.create_pod(obj)
+    schedule_all(api, sched, pods)
+    bad = api.get_pod("default", "m7")
+    bad["spec"]["containers"][0]["resources"]["limits"]["example.com/npu"] = "2k"
+    api.delete_pod("default", "m7")
+    api.create_pod(bad)
+    a0 = annotations.assignment_from_pod(api.get_pod("default", "m0"))
+    daemon = ShimDaemon(api, slices[a0.slice_id].provider_for(a0.node))
+    inj = daemon.decide(
+        "default", "m0", "main",
+        api.get_pod("default", "m0")["metadata"]["annotations"], "m0",
+    )
+    assert inj is not None
+    assert inj.env["JAX_NUM_PROCESSES"] == "8"  # m7 still in the table
+    assert inj.env["MEGASCALE_NUM_SLICES"] == "2"
+
+
+# -- hybrid workload mesh ---------------------------------------------------
+
+def test_hybrid_device_mesh_cpu_groups():
+    import jax
+
+    from kubegpu_tpu.parallel import hybrid_device_mesh
+
+    mesh = hybrid_device_mesh({"dcn": 2, "data": 4}, num_slices=2)
+    assert mesh.shape == {"dcn": 2, "data": 4}
+    assert tuple(mesh.axis_names) == ("dcn", "data")
+    devs = jax.devices()
+    # slice-major device order: first row is the first contiguous group
+    assert [d.id for d in mesh.devices[0].flat] == [d.id for d in devs[:4]]
+    with pytest.raises(ValueError):
+        hybrid_device_mesh({"data": 4, "dcn": 2}, num_slices=2)  # dcn not first
+    with pytest.raises(ValueError):
+        hybrid_device_mesh({"dcn": 3, "data": 2}, num_slices=3)  # 8 % 3

@@ -63,6 +63,7 @@ def test_sample_dir_covers_all_graded_configs():
     assert sample_files() == [
         "cpu-pod.yaml",
         "four-chip.yaml",
+        "jax-multislice.yaml",
         "jax-resnet.yaml",
         "multi-tenant.yaml",
         "single-chip.yaml",
@@ -154,6 +155,45 @@ def test_multi_tenant_sample_both_gangs_fit():
         assert len(coords) == 8, f"{gang} got {len(coords)} chips"
         assert is_contiguous_submesh(coords, MESH), f"{gang} not contiguous"
     assert not (per_gang["tenant-a"] & per_gang["tenant-b"])
+
+
+def test_jax_multislice_sample_spans_two_slices_with_megascale_env():
+    # two v5e-16 slices: the 32-chip gang cannot fit either alone
+    api = InMemoryApiServer()
+    slices = {
+        sid: FakeSlice(slice_id=sid, mesh_shape=MESH, host_block=(2, 2))
+        for sid in ("v5e-16-a", "v5e-16-b")
+    }
+    providers = {}
+    for fs in slices.values():
+        for h, p in fs.providers().items():
+            providers[h] = p
+            Advertiser(p, api).advertise_once()
+    sched = Scheduler(api)
+    sched.cache.refresh()
+    pods = load_pods("jax-multislice.yaml")
+    assert len(pods) == 8
+    assigned = schedule_all(api, sched, pods)
+    per_slice = {}
+    for name, a in assigned.items():
+        assert a is not None and len(a.all_chips()) == 4
+        assert is_contiguous_submesh({c.coords for c in a.all_chips()}, MESH)
+        per_slice.setdefault(a.slice_id, set()).update(
+            c.coords for c in a.all_chips()
+        )
+    assert set(per_slice) == {"v5e-16-a", "v5e-16-b"}
+    for coords in per_slice.values():
+        assert len(coords) == 16 and is_contiguous_submesh(coords, MESH)
+
+    # megascale env on top of the usual rendezvous table
+    name, a = sorted(assigned.items())[0]
+    daemon = ShimDaemon(api, providers[a.node])
+    pod = api.get_pod("default", name)
+    inj = daemon.decide("default", name, "worker",
+                        pod["metadata"].get("annotations") or {}, a.node)
+    assert inj.env["MEGASCALE_NUM_SLICES"] == "2"
+    assert inj.env["JAX_NUM_PROCESSES"] == "8"
+    assert ".jax-ms.default.svc:8081" in inj.env["MEGASCALE_COORDINATOR_ADDRESS"]
 
 
 def test_deploy_manifests_parse_and_reference_real_modules():
