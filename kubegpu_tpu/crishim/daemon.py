@@ -122,19 +122,30 @@ class ShimDaemon:
         return sorted(names)[: pod.pod_group_size]
 
     def _gang_member_slices(self, pod: PodInfo, members: Sequence[str]) -> dict:
-        """name -> bind-time slice_id for every gang member.  Raises
-        InjectionError when any member's assignment is not yet visible: a
-        partial slice table would compute a wrong MEGASCALE_NUM_SLICES /
-        slice index for every worker, so fail CreateContainer and let
-        kubelet retry after the siblings bind."""
+        """name -> bind-time slice_id for every CHIP-requesting gang member.
+        Zero-chip members (coordinators/sidecars) never receive an
+        assignment annotation — they bind plain — and don't participate in
+        the TPU mesh, so they are excluded rather than treated as missing.
+        Raises InjectionError when a chip member's assignment is not yet
+        visible: a partial slice table would compute a wrong
+        MEGASCALE_NUM_SLICES / slice index for every worker, so fail
+        CreateContainer and let kubelet retry after the siblings bind."""
         slices: dict = {}
         missing = []
         for name in members:
             try:
                 obj = self.api.get_pod(pod.namespace, name)
-                a = annotations.assignment_from_pod(obj)
-            except Exception:  # noqa: BLE001 - treat as not-yet-visible
-                a = None
+            except Exception as e:  # noqa: BLE001
+                raise InjectionError(
+                    f"gang {pod.pod_group}: cannot fetch member {name}: {e}"
+                ) from e
+            try:
+                info = annotations.pod_from_k8s(obj, strict=False)
+                if info.total_tpu_chips() == 0:
+                    continue
+            except Exception:  # noqa: BLE001 - fall through to the
+                pass  # assignment check: chips unknown => require assignment
+            a = annotations.assignment_from_pod(obj)
             if a is None or not a.slice_id:
                 missing.append(name)
             else:

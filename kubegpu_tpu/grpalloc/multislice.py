@@ -106,7 +106,6 @@ def fit_gang_into_layout(
     views: Dict[str, SliceView],
     pods: Sequence[PodInfo],
     scheduled_by_slice: Dict[str, int],
-    group_size: int,
 ) -> MultisliceResult:
     """Place replacement members of a PARTIALLY-BOUND gang back into the
     gang's existing slice layout.
@@ -116,10 +115,12 @@ def fit_gang_into_layout(
     lands on any other slice would disagree with every sibling and wedge the
     job at rendezvous.  So: single-slice gangs refit strictly on their
     slice; multislice gangs refill exactly each slice's member deficit
-    (equal per-slice population, the invariant planning established).  The
-    per-slice refit places into the freed chips via fit_gang — the scorer's
-    anti-fragmentation term pulls the replacement toward the hole the dead
-    member left."""
+    (equal per-slice population of CHIP members — the invariant planning
+    established; ``scheduled_by_slice`` only ever counts chip-holding
+    members, so the math here counts chip members too and zero-chip
+    members ride along unconstrained).  The per-slice refit places into the
+    freed chips via fit_gang — the scorer's anti-fragmentation term pulls
+    the replacement toward the hole the dead member left."""
     slices = sorted(scheduled_by_slice)
     missing = [s for s in slices if s not in views]
     if missing:
@@ -127,28 +128,42 @@ def fit_gang_into_layout(
             success=False,
             reason=f"gang's existing slice(s) {missing} no longer advertised",
         )
+    chip_pods = sorted(
+        (p for p in pods if TpuRequest.from_pod(p).total_chips > 0),
+        key=lambda p: p.key,
+    )
+    zero_pods = [p for p in pods if TpuRequest.from_pod(p).total_chips == 0]
+
+    def _with_zeros(res: MultisliceResult) -> MultisliceResult:
+        if res.success:
+            for p in zero_pods:  # 0-chip members ride slice 0, no chips
+                res.per_pod[p.key] = Assignment(node="", slice_id=slices[0])
+        return res
+
     if len(slices) == 1:
-        g = fit_gang(views[slices[0]], pods)
-        return MultisliceResult(
-            success=g.success,
-            reason=(
-                "" if g.success
-                else f"cannot rejoin gang's slice {slices[0]}: {g.reason}"
-            ),
-            score=g.score,
-            per_pod=dict(g.per_pod),
-            slice_ids=slices,
+        g = fit_gang(views[slices[0]], chip_pods)
+        return _with_zeros(
+            MultisliceResult(
+                success=g.success,
+                reason=(
+                    "" if g.success
+                    else f"cannot rejoin gang's slice {slices[0]}: {g.reason}"
+                ),
+                score=g.score,
+                per_pod=dict(g.per_pod),
+                slice_ids=slices,
+            )
         )
-    expected, rem = divmod(group_size, len(slices))
+    total_chip_members = sum(scheduled_by_slice.values()) + len(chip_pods)
+    expected, rem = divmod(total_chip_members, len(slices))
     if rem:
         return MultisliceResult(
             success=False,
             reason=(
-                f"gang of {group_size} cannot split equally over its "
-                f"{len(slices)} existing slices"
+                f"{total_chip_members} chip members cannot split equally "
+                f"over the gang's {len(slices)} existing slices"
             ),
         )
-    pods_sorted = sorted(pods, key=lambda p: p.key)
     merged: Dict[str, Assignment] = {}
     total = 0.0
     i = 0
@@ -159,7 +174,7 @@ def fit_gang_into_layout(
                 success=False,
                 reason=f"slice {sid} already has more members than {expected}",
             )
-        chunk = pods_sorted[i : i + deficit]
+        chunk = chip_pods[i : i + deficit]
         i += deficit
         if not chunk:
             continue
@@ -171,19 +186,21 @@ def fit_gang_into_layout(
             )
         merged.update(g.per_pod)
         total += g.score
-    if i != len(pods_sorted):
+    if i != len(chip_pods):
         return MultisliceResult(
             success=False,
             reason=(
-                f"{len(pods_sorted)} pending members but the layout is only "
-                f"missing {i}"
+                f"{len(chip_pods)} pending chip members but the layout is "
+                f"only missing {i}"
             ),
         )
-    return MultisliceResult(
-        success=True,
-        score=total / len(slices),
-        per_pod=merged,
-        slice_ids=slices,
+    return _with_zeros(
+        MultisliceResult(
+            success=True,
+            score=total / len(slices),
+            per_pod=merged,
+            slice_ids=slices,
+        )
     )
 
 

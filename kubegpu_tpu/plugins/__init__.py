@@ -12,8 +12,10 @@ from kubegpu_tpu.plugins.provider import (
 from kubegpu_tpu.plugins.fake import FakeSlice, FakeTpuProvider
 from kubegpu_tpu.plugins.discovery import GkeTpuProvider
 from kubegpu_tpu.plugins.advertiser import Advertiser
+from kubegpu_tpu.plugins.deviceplugin import DevicePluginServer
 
 __all__ = [
+    "DevicePluginServer",
     "AllocateResponse",
     "ENV_ACCEL_TYPE",
     "ENV_TOPOLOGY",

@@ -190,6 +190,9 @@ class Scheduler:
         members = self._members_for_preemption(pod)
         if members is None:
             return False
+        allowed_slices = self._restrict_to_layout(pod, allowed_slices)
+        if not allowed_slices:
+            return False
         pods_raw = self.api.list_pods()
         with self.cache.lock:
             units = collect_units(pods_raw, self.cache.assignments_snapshot())
@@ -231,6 +234,25 @@ class Scheduler:
         )
         return True
 
+    def _restrict_to_layout(self, pod: PodInfo, allowed: Optional[set]):
+        """Align eviction simulation with anchored re-planning: a
+        partially-bound gang can only use its existing slice layout
+        (podgroup.fit_gang_into_layout), so victims elsewhere would die for
+        zero benefit.  Single-slice layouts restrict the search to that
+        slice; multi-slice layouts need joint cross-slice deficits that the
+        per-slice victim search cannot model, so preemption is declined
+        (None with an empty set => caller gives up)."""
+        if not pod.pod_group:
+            return allowed
+        layout = self.groups.layout_of(pod)
+        if not layout:
+            return allowed
+        if len(layout) > 1:
+            return set()
+        if allowed is None:
+            return set(layout)
+        return allowed & set(layout)
+
     def preemption_victims(
         self, pod_obj: dict, candidate_nodes: Optional[List[str]] = None
     ) -> Dict[str, List[str]]:
@@ -248,6 +270,9 @@ class Scheduler:
             self._slices_of(candidate_nodes) if candidate_nodes is not None else None
         )
         if candidate_nodes is not None and not allowed:
+            return {}
+        allowed = self._restrict_to_layout(pod, allowed)
+        if allowed is not None and not allowed:
             return {}
         pods_raw = self.api.list_pods()
         with self.cache.lock:

@@ -128,9 +128,7 @@ class PodGroupRegistry:
                     # partially-bound gang: replacements must rejoin the
                     # existing slice layout — the running siblings'
                     # rendezvous/megascale env is already baked in
-                    g = fit_gang_into_layout(
-                        views, members, layout, pod.pod_group_size
-                    )
+                    g = fit_gang_into_layout(views, members, layout)
                 else:
                     g = fit_gang_multislice(
                         views, members, allow_multislice=pod.allow_multislice
@@ -142,6 +140,10 @@ class PodGroupRegistry:
                     )
                 taken = []
                 for key, a in g.per_pod.items():
+                    if not a.all_chips():
+                        # chipless member (coordinator/sidecar): nothing to
+                        # reserve, and it binds plain — outside the plan
+                        continue
                     try:
                         self.cache.assume(key, a)
                         taken.append(key)
@@ -152,7 +154,7 @@ class PodGroupRegistry:
             plan = GangPlan(
                 group=gk,
                 created=time.monotonic() if now is None else now,
-                per_pod=dict(g.per_pod),
+                per_pod={k: a for k, a in g.per_pod.items() if a.all_chips()},
                 score=g.score,
             )
             self._plans[gk] = plan
@@ -176,6 +178,19 @@ class PodGroupRegistry:
             return None
         return members
 
+    def layout_of(self, pod: PodInfo) -> Dict[str, int]:
+        """The pod's gang's existing slice layout: slice_id -> count of
+        already-placed CHIP members (empty for fresh gangs).  Preemption
+        consults this so eviction simulation can never free chips on a
+        slice an anchored re-plan (try_plan's fit_gang_into_layout path)
+        would refuse to use."""
+        _, _, sched_slices = self._gather_members(pod)
+        out: Dict[str, int] = {}
+        for sid in sched_slices.values():
+            if sid:
+                out[sid] = out.get(sid, 0) + 1
+        return out
+
     def planned_members(self, pod: PodInfo) -> Optional[List[PodInfo]]:
         """The member set try_plan would plan for this pod right now (used
         by preemption simulation so it can never diverge from planning)."""
@@ -197,11 +212,24 @@ class PodGroupRegistry:
         slices = {}
         for obj in self.cache.api.list_pods(namespace=pod.namespace):
             try:
-                # lenient: a sibling with one malformed quantity must stay
-                # VISIBLE as a member or the gang stalls at "waiting"
-                p = annotations.pod_from_k8s(obj, strict=False)
-            except Exception:  # noqa: BLE001 - malformed neighbours don't block
-                continue
+                p = annotations.pod_from_k8s(obj)
+            except Exception:  # noqa: BLE001
+                # strict parse failed.  An ALREADY-PLACED sibling must stay
+                # VISIBLE (lenient) or the running gang wedges; but a
+                # PENDING sibling with a malformed quantity must stay
+                # INVISIBLE — it can never pass its own strict filter, so
+                # planning around it (as a 0-chip ghost) would bind the
+                # rest of the gang and strand those chips forever.
+                try:
+                    p = annotations.pod_from_k8s(obj, strict=False)
+                except Exception:  # noqa: BLE001 - hopeless neighbour
+                    continue
+                meta = obj.get("metadata", {}) or {}
+                placed = bool((obj.get("spec") or {}).get("nodeName")) or bool(
+                    (meta.get("annotations") or {}).get(annotations.POD_ASSIGNMENT)
+                )
+                if not placed:
+                    continue
             if p.pod_group == pod.pod_group:
                 seen[p.key] = p
                 a = annotations.assignment_from_pod(obj)

@@ -1,0 +1,162 @@
+"""Kubelet Device Plugin API tests: real gRPC over unix sockets in a
+tmpdir, fake kubelet on the other end — no cluster, no TPUs (SURVEY.md §4)."""
+
+import threading
+from concurrent import futures
+
+import grpc
+import pytest
+
+from kubegpu_tpu.plugins import DevicePluginServer, FakeSlice
+from kubegpu_tpu.plugins.deviceplugin import (
+    HEALTHY,
+    SVC_ALLOCATE,
+    SVC_LIST_AND_WATCH,
+    SVC_OPTIONS,
+    SVC_PREFERRED,
+    SVC_REGISTRATION,
+    UNHEALTHY,
+    decode_devices,
+)
+from kubegpu_tpu.types import RES_TPU, is_contiguous_submesh
+from kubegpu_tpu.utils import protowire as pw
+
+IDENT = lambda b: b  # noqa: E731
+
+
+class FakeKubelet:
+    """Registration service that records RegisterRequests."""
+
+    def __init__(self, socket_path):
+        self.requests = []
+        self._event = threading.Event()
+
+        kubelet_self = self
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, hcd):
+                if hcd.method == SVC_REGISTRATION:
+                    def register(req, ctx):
+                        kubelet_self.requests.append(bytes(req))
+                        kubelet_self._event.set()
+                        return b""
+
+                    return grpc.unary_unary_rpc_method_handler(
+                        register, request_deserializer=IDENT, response_serializer=IDENT
+                    )
+                return None
+
+        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        self.server.add_generic_rpc_handlers((Handler(),))
+        self.server.add_insecure_port(f"unix://{socket_path}")
+        self.server.start()
+
+    def wait(self, timeout=5.0) -> bool:
+        return self._event.wait(timeout)
+
+    def stop(self):
+        self.server.stop(0.1)
+
+
+@pytest.fixture()
+def plugin_env(tmp_path):
+    fs = FakeSlice(slice_id="s0", mesh_shape=(4, 4), host_block=(2, 2))
+    host = fs.hosts()[0]
+    provider = fs.provider_for(host)
+    kubelet = FakeKubelet(str(tmp_path / "kubelet.sock"))
+    plugin = DevicePluginServer(
+        provider, socket_dir=str(tmp_path), poll_interval_s=0.1
+    )
+    plugin.start()
+    yield fs, host, plugin, kubelet, tmp_path
+    plugin.stop()
+    kubelet.stop()
+
+
+def plugin_channel(plugin):
+    return grpc.insecure_channel(f"unix://{plugin.socket_path}")
+
+
+def unary(channel, method, payload=b""):
+    return channel.unary_unary(
+        method, request_serializer=IDENT, response_deserializer=IDENT
+    )(payload, timeout=5.0)
+
+
+def test_registration_handshake(plugin_env):
+    _, _, plugin, kubelet, _ = plugin_env
+    plugin.register_with_kubelet()
+    assert kubelet.wait()
+    req = kubelet.requests[0]
+    assert bytes(pw.get_field(req, 1)).decode() == "v1beta1"
+    assert bytes(pw.get_field(req, 2)).decode() == plugin.endpoint
+    assert bytes(pw.get_field(req, 3)).decode() == RES_TPU
+    # options advertise GetPreferredAllocation
+    opts = bytes(pw.get_field(req, 4))
+    assert pw.get_field(opts, 2) == 1
+
+
+def test_options_and_list_and_watch_inventory(plugin_env):
+    fs, host, plugin, _, _ = plugin_env
+    with plugin_channel(plugin) as ch:
+        opts = unary(ch, SVC_OPTIONS)
+        assert pw.get_field(opts, 2) == 1  # preferred-allocation available
+        stream = ch.unary_stream(
+            SVC_LIST_AND_WATCH, request_serializer=IDENT, response_deserializer=IDENT
+        )(b"", timeout=5.0)
+        first = decode_devices(next(stream))
+        assert set(first) == {"0", "1", "2", "3"}  # 4 chips on this host
+        assert all(h == HEALTHY for h in first.values())
+        stream.cancel()
+
+
+def test_list_and_watch_streams_health_transitions(plugin_env):
+    fs, host, plugin, _, _ = plugin_env
+    dead_coord = fs.topology.host_chips(host)[0].coords
+    with plugin_channel(plugin) as ch:
+        stream = ch.unary_stream(
+            SVC_LIST_AND_WATCH, request_serializer=IDENT, response_deserializer=IDENT
+        )(b"", timeout=10.0)
+        first = decode_devices(next(stream))
+        assert all(h == HEALTHY for h in first.values())
+        fs.kill_chip(dead_coord)
+        second = decode_devices(next(stream))  # pushed on change, no restart
+        assert second["0"] == UNHEALTHY
+        assert second["1"] == HEALTHY
+        stream.cancel()
+
+
+def test_allocate_returns_visibility_env_and_devices(plugin_env):
+    _, _, plugin, _, _ = plugin_env
+    # AllocateRequest{container_requests=1{devices_ids=1}}
+    creq = pw.encode_string_field(1, "1") + pw.encode_string_field(1, "2")
+    req = pw.encode_len_field(1, creq)
+    with plugin_channel(plugin) as ch:
+        resp = unary(ch, SVC_ALLOCATE, req)
+    containers = pw.get_all(resp, 1)
+    assert len(containers) == 1
+    envs = pw.decode_string_map(pw.get_all(bytes(containers[0]), 1))
+    assert envs["TPU_VISIBLE_CHIPS"] == "1,2"
+
+
+def test_preferred_allocation_picks_contiguous_subset(plugin_env):
+    fs, host, plugin, _, _ = plugin_env
+    frag_chips = fs.topology.host_chips(host)
+    coords_of = {str(c.device_index): c.coords for c in frag_chips}
+    # ContainerPreferredAllocationRequest{available=1, must=2, size=3}
+    creq = b"".join(pw.encode_string_field(1, d) for d in ("0", "1", "2", "3"))
+    creq += pw.encode_varint((3 << 3) | 0) + pw.encode_varint(2)
+    req = pw.encode_len_field(1, creq)
+    with plugin_channel(plugin) as ch:
+        resp = unary(ch, SVC_PREFERRED, req)
+    chosen = [bytes(i).decode() for i in pw.get_all(bytes(pw.get_all(resp, 1)[0]), 1)]
+    assert len(chosen) == 2
+    assert is_contiguous_submesh({coords_of[d] for d in chosen}, (4, 4))
+
+
+def test_allocate_unknown_device_id_fails_rpc(plugin_env):
+    _, _, plugin, _, _ = plugin_env
+    req = pw.encode_len_field(1, pw.encode_string_field(1, "99"))
+    with plugin_channel(plugin) as ch:
+        with pytest.raises(grpc.RpcError):
+            unary(ch, SVC_ALLOCATE, req)

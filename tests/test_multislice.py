@@ -345,6 +345,91 @@ def test_malformed_sibling_quantity_does_not_wedge_gang_injection():
     assert inj.env["MEGASCALE_NUM_SLICES"] == "2"
 
 
+# -- zero-chip gang members -------------------------------------------------
+
+def zero_chip_pod(name, group, size):
+    return {
+        "metadata": {
+            "name": name,
+            "namespace": "default",
+            "uid": f"uid-{name}",
+            "annotations": {
+                annotations.POD_GROUP: group,
+                annotations.POD_GROUP_SIZE: str(size),
+                annotations.POD_MULTISLICE: "true",
+            },
+        },
+        "spec": {"containers": [{"name": "main", "resources": {}}]},
+    }
+
+
+def test_zero_chip_member_does_not_wedge_multislice_injection():
+    # a chipless coordinator pod in the gang binds plain (no assignment
+    # annotation, it owns no chips) — the chip workers' megascale table must
+    # exclude it instead of waiting for an annotation that never comes
+    api, slices = two_slice_cluster()
+    sched = Scheduler(api, metrics=Metrics())
+    sched.cache.refresh()
+    pods = [multislice_pod(f"m{i}", 4, "ms", 9) for i in range(8)]
+    coord = zero_chip_pod("coord", "ms", 9)
+    for obj in pods + [coord]:
+        api.create_pod(obj)
+    schedule_all(api, sched, pods)
+    # the coordinator schedules plain: any node passes filter
+    names = sorted(n["metadata"]["name"] for n in api.list_nodes())
+    r = sched.filter(coord, names)
+    assert r.nodes == names
+    assert sched.bind("default", "coord", names[0]) is None
+    a0 = annotations.assignment_from_pod(api.get_pod("default", "m0"))
+    daemon = ShimDaemon(api, slices[a0.slice_id].provider_for(a0.node))
+    inj = daemon.decide(
+        "default", "m0", "main",
+        api.get_pod("default", "m0")["metadata"]["annotations"], "m0",
+    )
+    assert inj is not None
+    assert inj.env["MEGASCALE_NUM_SLICES"] == "2"
+    assert inj.env["JAX_NUM_PROCESSES"] == "9"  # coordinator in the table
+
+
+def test_layout_refit_counts_chip_members_only():
+    from kubegpu_tpu.grpalloc.multislice import fit_gang_into_layout
+
+    views = two_slice_views()
+    # simulate: gang had 8 chip members 4+4 over two slices; one on sb died
+    # freeing its host's 2x2 block
+    views["sa"].used = frozenset(views["sa"].chips)  # sa fully occupied
+    hole = {(2, 0), (2, 1), (3, 0), (3, 1)}  # one host's block on sb
+    views["sb"].used = frozenset(set(views["sb"].chips) - hole)
+    pending = gang(1, 4, multislice=True) + [
+        PodInfo(name="zz-coord", containers=[ContainerInfo(name="main")],
+                pod_group="g", pod_group_size=10)
+    ]
+    res = fit_gang_into_layout(views, pending, {"sa": 4, "sb": 3})
+    assert res.success, res.reason
+    chip_assignment = res.per_pod["default/w0"]
+    assert chip_assignment.slice_id == "sb"
+    assert len(chip_assignment.all_chips()) == 4
+    assert res.per_pod["default/zz-coord"].all_chips() == []
+
+
+def test_malformed_pending_sibling_keeps_gang_waiting():
+    # a PENDING member with an unparseable quantity can never pass its own
+    # strict filter — the gang must wait, not plan around it as a 0-chip
+    # ghost and strand the others' chips
+    api, _ = two_slice_cluster()
+    sched = Scheduler(api, metrics=Metrics())
+    sched.cache.refresh()
+    pods = [multislice_pod(f"m{i}", 4, "ms", 8) for i in range(7)]
+    bad = multislice_pod("m7", 4, "ms", 8)
+    bad["spec"]["containers"][0]["resources"]["limits"][RES_TPU] = "four"
+    for obj in pods + [bad]:
+        api.create_pod(obj)
+    names = sorted(n["metadata"]["name"] for n in api.list_nodes())
+    r = sched.filter(pods[0], names)
+    assert not r.nodes
+    assert any("waiting for members" in m for m in r.failed.values())
+
+
 # -- hybrid workload mesh ---------------------------------------------------
 
 def test_hybrid_device_mesh_cpu_groups():
