@@ -5,6 +5,8 @@ from kubegpu_tpu.ops.attention import (
     reference_attention,
     ring_attention,
     ring_attention_sharded,
+    ulysses_attention,
+    ulysses_attention_sharded,
 )
 
 __all__ = [
@@ -12,4 +14,6 @@ __all__ = [
     "reference_attention",
     "ring_attention",
     "ring_attention_sharded",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
 ]

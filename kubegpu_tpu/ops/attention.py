@@ -280,3 +280,66 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, axis: str, causal: bool = True):
         out_specs=spec,
     )
     return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses attention (all-to-all sequence parallelism)
+# ---------------------------------------------------------------------------
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
+                      use_flash: bool = False):
+    """All-to-all sequence parallelism (the Ulysses scheme) — the other
+    long-context strategy next to :func:`ring_attention`.  Call INSIDE
+    shard_map with (batch, seq_local, heads, head_dim) sequence shards:
+
+    1. ``all_to_all`` re-shards seq→heads: each device ends up with the FULL
+       sequence for ``heads/size`` heads (heads must divide by the axis
+       size);
+    2. attention runs entirely locally over the global sequence — no
+       masking/softmax algebra across devices at all (vs ring's folded
+       online softmax), optionally through the Pallas flash kernel;
+    3. a second ``all_to_all`` re-shards heads→seq.
+
+    Trade-off vs ring: 4 all-to-alls total (q,k,v in + out) but each is a
+    single fused ICI collective with no per-step latency chain, and the
+    compute is one dense local attention — usually the better choice when
+    heads ≥ devices; ring wins when heads are few or seq shards are huge
+    (its K/V resident set is O(seq/ring) vs Ulysses' O(seq))."""
+    size = jax.lax.psum(1, axis_name)
+    b, s_loc, h, d = q.shape
+    if h % size != 0:
+        raise ValueError(
+            f"ulysses needs heads ({h}) divisible by the '{axis_name}' axis"
+        )
+    # seq-shards -> head-shards: split heads (axis 2) across devices,
+    # concatenate everyone's seq chunk (axis 1) in axis order = global order
+    def scatter_heads(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    if use_flash:
+        out = flash_attention(qg, kg, vg, causal)
+    else:
+        out = reference_attention(qg, kg, vg, causal)
+    # head-shards -> seq-shards
+    return jax.lax.all_to_all(
+        out, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def ulysses_attention_sharded(q, k, v, mesh: Mesh, axis: str,
+                              causal: bool = True, use_flash: bool = False):
+    """shard_map wrapper: q/k/v are GLOBAL (batch, seq, heads, head_dim)
+    arrays; seq sharded over `axis`, everything else replicated."""
+    spec = P(None, axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(
+            ulysses_attention, axis_name=axis, causal=causal, use_flash=use_flash
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

@@ -57,6 +57,7 @@ class Scheduler:
         metrics: Optional[Metrics] = None,
         gang_plan_ttl_s: float = 120.0,
         plugins: Optional[PluginRegistry] = None,
+        evict_on_chip_failure: bool = True,
     ) -> None:
         self.api = api
         self.cache = cache or ClusterCache(api)
@@ -65,6 +66,10 @@ class Scheduler:
         # device-type dispatch (SURVEY.md §2 #5): TPU built-in; more device
         # plugins via PluginRegistry.load (the Go-plugin .so analog)
         self.plugins = plugins or default_registry()
+        # elastic recovery (SURVEY.md §5.3): a pod whose chips die is
+        # evicted so its controller recreates it and it re-schedules onto
+        # healthy chips (gang members rejoin their gang's slice layout)
+        self.evict_on_chip_failure = evict_on_chip_failure
 
     # -- filter -----------------------------------------------------------
     def filter(self, pod_obj: dict, node_names: List[str]) -> FilterResult:
@@ -206,22 +211,7 @@ class Scheduler:
                 self.groups.drop_plan(u.unit_id[len("gang:"):])
         evicted = 0
         for key in decision.victim_pod_keys():
-            ns, name = key.split("/", 1)
-            # clear the assignment annotation BEFORE deleting: a victim
-            # lingering in Terminating (graceful deletion on a real
-            # cluster) must not be replayed by the next cache refresh onto
-            # chips the preemptor now owns
-            try:
-                self.api.patch_pod_annotations(
-                    ns, name, {annotations.POD_ASSIGNMENT: ""}
-                )
-            except (NotFound, OSError):
-                pass
-            try:
-                self.api.delete_pod(ns, name)
-            except NotFound:
-                pass
-            self.cache.remove_pod(key)
+            self._evict_pod(key)
             evicted += 1
         self.metrics.inc("kubegpu_preemptions_total")
         self.metrics.inc("kubegpu_preempted_pods_total", evicted)
@@ -450,6 +440,25 @@ class Scheduler:
         self.metrics.inc("kubegpu_chips_allocated_total", len(chips))
 
     # -- lifecycle events -------------------------------------------------
+    def resync(self) -> None:
+        """Periodic resync (ExtenderServer loop): rebuild the cache from the
+        API server, then sweep for assignments referencing died chips —
+        without a node-watch this loop IS the failure detector, so the
+        sweep must live here or chip-death eviction never fires in a
+        deployed server.  One snapshot indexed by host keeps the sweep
+        O(assignments), not O(nodes x assignments)."""
+        self.cache.refresh()
+        if not self.evict_on_chip_failure:
+            return
+        by_host: Dict[str, list] = {}
+        for key, a in self.cache.assignments_snapshot().items():
+            for r in a.all_chips():
+                by_host.setdefault(r.host, []).append((key, r))
+        for obj in self.api.list_nodes():
+            name = (obj.get("metadata") or {}).get("name", "")
+            if name in by_host:
+                self._evict_on_dead_chips(obj, by_host[name])
+
     def on_pod_deleted(self, pod_obj: dict) -> None:
         try:
             pod = annotations.pod_from_k8s(pod_obj)
@@ -460,3 +469,75 @@ class Scheduler:
 
     def on_node_updated(self, node_obj: dict) -> None:
         self.cache.update_node(node_obj)
+        if self.evict_on_chip_failure:
+            self._evict_on_dead_chips(node_obj)
+
+    def _evict_pod(self, key: str) -> None:
+        """The one eviction sequence (preemption AND health eviction):
+        clear the assignment annotation BEFORE deleting — a victim
+        lingering in Terminating (graceful deletion on a real cluster)
+        must not be replayed by the next cache refresh onto chips a new
+        placement may own — then delete and release the cache entry."""
+        ns, name = key.split("/", 1)
+        try:
+            self.api.patch_pod_annotations(
+                ns, name, {annotations.POD_ASSIGNMENT: ""}
+            )
+        except (NotFound, OSError):
+            pass
+        try:
+            self.api.delete_pod(ns, name)
+        except NotFound:
+            pass
+        self.cache.remove_pod(key)
+
+    def _evict_on_dead_chips(self, node_obj: dict, host_refs=None) -> None:
+        """Failure detection → elastic recovery (SURVEY.md §5.3): when the
+        advertiser reports a chip unhealthy (or gone), the pods holding it
+        are dead weight — their process lost its device and cannot recover
+        in place.  Evict them (controller recreates; the replacement
+        re-schedules onto healthy chips, gang members anchored to their
+        gang's existing slice layout by the re-plan path).  Healthy
+        siblings on other chips are untouched."""
+        try:
+            node = annotations.node_from_k8s(node_obj)
+        except Exception:  # noqa: BLE001
+            return
+        if node.slice_id is None:
+            return
+        if host_refs is None:
+            host_refs = [
+                (key, r)
+                for key, a in self.cache.assignments_snapshot().items()
+                for r in a.all_chips()
+                if r.host == node.name
+            ]
+        present = {ch.device_index for ch in node.chips}
+        dead = {ch.device_index for ch in node.chips if not ch.healthy}
+        victims = sorted(
+            {
+                key
+                for key, r in host_refs
+                if r.device_index in dead or r.device_index not in present
+            }
+        )
+        for key in victims:
+            # invalidate the victim's live gang plan FIRST: a stale plan
+            # would rebind the recreated member onto the exact dead chip,
+            # producing an endless evict/recreate/rebind loop
+            self._drop_gang_plan_of(key)
+            self._evict_pod(key)
+            self.metrics.inc("kubegpu_health_evictions_total")
+            log.warning(
+                "evicted %s: its chip(s) on %s died (dead=%s)",
+                key, node.name, sorted(dead),
+            )
+
+    def _drop_gang_plan_of(self, key: str) -> None:
+        ns, name = key.split("/", 1)
+        try:
+            pod = annotations.pod_from_k8s(self.api.get_pod(ns, name), strict=False)
+        except Exception:  # noqa: BLE001 - pod already gone: nothing to drop
+            return
+        if pod.pod_group:
+            self.groups.drop_plan(f"{ns}/{pod.pod_group}")

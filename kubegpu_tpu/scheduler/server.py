@@ -221,7 +221,8 @@ class ExtenderServer:
     def _resync_loop(self) -> None:
         while not self._stop.wait(self.resync_interval_s):
             try:
-                self.sched.cache.refresh()
+                # refresh + dead-chip eviction sweep (the failure detector)
+                self.sched.resync()
             except Exception:  # noqa: BLE001
                 log.exception("cache resync failed; keeping stale cache")
 

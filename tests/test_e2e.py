@@ -121,6 +121,46 @@ def test_north_star_sample_full_stack_over_wire(stack):
             upstream.stop(0)
 
 
+def test_chip_death_evicts_via_live_resync_loop():
+    # failure detection through the DEPLOYED path: no direct
+    # on_node_updated call — the running server's periodic resync sweep
+    # must notice the died chip and evict the pod holding it
+    import time
+
+    api = InMemoryApiServer()
+    fs = FakeSlice(slice_id="v5e-16", mesh_shape=MESH, host_block=(2, 2))
+    advs = {h: Advertiser(p, api) for h, p in fs.providers().items()}
+    for a in advs.values():
+        a.advertise_once()
+    server = ExtenderServer(Scheduler(api), listen=("127.0.0.1", 0),
+                            resync_interval_s=0.2)
+    server.start()
+    try:
+        obj = {
+            "metadata": {"name": "victim", "namespace": "default",
+                         "annotations": {}},
+            "spec": {"containers": [
+                {"name": "main",
+                 "resources": {"limits": {"google.com/tpu": "1"}}}]},
+        }
+        assigned = schedule_over_http(server, api, [obj])
+        ref = assigned["victim"].all_chips()[0]
+        fs.kill_chip(ref.coords)
+        advs[ref.host].advertise_once()  # the DaemonSet's health cycle
+        deadline = time.monotonic() + 5.0
+        gone = False
+        while time.monotonic() < deadline:
+            try:
+                api.get_pod("default", "victim")
+            except Exception:  # noqa: BLE001 - NotFound
+                gone = True
+                break
+            time.sleep(0.1)
+        assert gone, "resync sweep did not evict the pod on the dead chip"
+    finally:
+        server.stop()
+
+
 def test_two_gangs_race_over_threaded_http(stack):
     api, fs, server = stack
     pods = [d for d in yaml.safe_load_all((SAMPLES / "multi-tenant.yaml").read_text())

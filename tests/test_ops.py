@@ -132,6 +132,58 @@ def test_ring_attention_grads_finite(mesh):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
 
 
+# -- ulysses attention (all-to-all sequence parallelism) --------------------
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_attention_matches_reference(mesh, causal):
+    from kubegpu_tpu.ops import ulysses_attention_sharded
+
+    q, k, v = qkv(b=2, s=8 * 16, h=8, d=16)  # heads == axis size
+    out = ulysses_attention_sharded(q, k, v, mesh, "sp", causal)
+    ref = reference_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_under_jit_keeps_seq_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubegpu_tpu.ops import ulysses_attention_sharded
+
+    q, k, v = qkv(b=1, s=8 * 8, h=16, d=16)  # heads a multiple of axis size
+    sh = NamedSharding(mesh, P(None, "sp", None, None))
+    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
+    f = jax.jit(lambda q, k, v: ulysses_attention_sharded(q, k, v, mesh, "sp", True))
+    out = f(q, k, v)
+    ref = reference_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+    assert out.sharding.spec == P(None, "sp", None, None)
+
+
+def test_ulysses_grads_match_reference(mesh):
+    from kubegpu_tpu.ops import ulysses_attention_sharded
+
+    q, k, v = qkv(b=1, s=8 * 8, h=8, d=16)
+
+    def loss(q, k, v):
+        return jnp.sum(ulysses_attention_sharded(q, k, v, mesh, "sp", True) ** 2)
+
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    ref_grads = jax.grad(
+        lambda q, k, v: jnp.sum(reference_attention(q, k, v, True) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(grads, ref_grads):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+def test_ulysses_rejects_indivisible_heads(mesh):
+    from kubegpu_tpu.ops import ulysses_attention_sharded
+
+    q, k, v = qkv(b=1, s=8 * 8, h=6, d=16)  # 6 heads over 8 devices
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention_sharded(q, k, v, mesh, "sp", True)
+
+
 # -- model integration ------------------------------------------------------
 
 def test_transformer_flash_impl_matches_einsum():

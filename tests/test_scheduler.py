@@ -362,6 +362,95 @@ def test_dead_chip_falls_out_via_node_update():
     assert len(view.free) == 15 and (0, 0) not in view.free
 
 
+def test_chip_death_evicts_only_affected_pod_and_replacement_reschedules():
+    # elastic recovery (SURVEY.md §5.3): the pod holding a died chip is
+    # evicted; its gang siblings keep running; the recreated member
+    # re-plans onto healthy chips of the same slice
+    api, fs, advs = fake_cluster()
+    sched = make_sched(api)
+    pods = [pod_obj(f"g{i}", 1, group="dp", group_size=4) for i in range(4)]
+    for obj in pods:
+        api.create_pod(obj)
+    names = nodes_of(api)
+    chip_of = {}
+    for obj in pods:
+        name = obj["metadata"]["name"]
+        r = sched.filter(obj, names)
+        assert r.nodes
+        assert sched.bind("default", name, r.nodes[0]) is None
+        a = annotations.assignment_from_pod(api.get_pod("default", name))
+        chip_of[name] = a.all_chips()[0]
+    dead_ref = chip_of["g1"]
+    fs.kill_chip(dead_ref.coords)
+    advs[dead_ref.host].advertise_once()
+    sched.on_node_updated(api.get_node(dead_ref.host))
+    # g1 evicted, siblings alive
+    import pytest as _pytest
+
+    from kubegpu_tpu.utils.apiserver import NotFound as _NF
+    with _pytest.raises(_NF):
+        api.get_pod("default", "g1")
+    for other in ("g0", "g2", "g3"):
+        assert annotations.assignment_from_pod(api.get_pod("default", other))
+    # the controller recreates g1: it rejoins on a healthy chip
+    api.create_pod(pod_obj("g1", 1, group="dp", group_size=4))
+    r = sched.filter(pod_obj("g1", 1, group="dp", group_size=4), names)
+    assert r.nodes, (r.failed, r.error)
+    assert sched.bind("default", "g1", r.nodes[0]) is None
+    a = annotations.assignment_from_pod(api.get_pod("default", "g1"))
+    assert a.all_chips()[0].coords != dead_ref.coords
+
+
+def test_chip_death_invalidates_partially_committed_gang_plan():
+    # the live GangPlan still covers the victim: without dropping it, the
+    # recreated member is rebound onto the EXACT dead chip by the stale
+    # plan, then evicted again — an endless loop
+    api, fs, advs = fake_cluster()
+    sched = make_sched(api)
+    pods = [pod_obj(f"p{i}", 1, group="pg", group_size=4) for i in range(4)]
+    for obj in pods:
+        api.create_pod(obj)
+    names = nodes_of(api)
+    # plan the whole gang (first filter) but bind only TWO members
+    for obj in pods:
+        assert sched.filter(obj, names).nodes
+    for name in ("p0", "p1"):
+        r = sched.filter(pod_obj(name, 1, group="pg", group_size=4), names)
+        assert sched.bind("default", name, r.nodes[0]) is None
+    dead_ref = annotations.assignment_from_pod(
+        api.get_pod("default", "p1")
+    ).all_chips()[0]
+    fs.kill_chip(dead_ref.coords)
+    advs[dead_ref.host].advertise_once()
+    sched.on_node_updated(api.get_node(dead_ref.host))
+    # p1 evicted; recreate it and re-schedule: must avoid the dead chip
+    api.create_pod(pod_obj("p1", 1, group="pg", group_size=4))
+    r = sched.filter(pod_obj("p1", 1, group="pg", group_size=4), names)
+    assert r.nodes, (r.failed, r.error)
+    assert sched.bind("default", "p1", r.nodes[0]) is None
+    a = annotations.assignment_from_pod(api.get_pod("default", "p1"))
+    assert a.all_chips()[0].coords != dead_ref.coords
+
+
+def test_chip_death_leaves_unrelated_pods_alone():
+    api, fs, advs = fake_cluster()
+    sched = make_sched(api)
+    obj = pod_obj("solo", 1)
+    api.create_pod(obj)
+    r = sched.filter(obj, nodes_of(api))
+    assert sched.bind("default", "solo", r.nodes[0]) is None
+    a = annotations.assignment_from_pod(api.get_pod("default", "solo"))
+    # kill a chip the pod does NOT hold, on the same host
+    host_chips = [c for c in fs.topology.chips.values() if c.host_id == a.node]
+    other = next(
+        c for c in host_chips if c.device_index != a.all_chips()[0].device_index
+    )
+    fs.kill_chip(other.coords)
+    advs[a.node].advertise_once()
+    sched.on_node_updated(api.get_node(a.node))
+    assert annotations.assignment_from_pod(api.get_pod("default", "solo"))
+
+
 def test_pod_delete_returns_chips():
     api, _, _ = fake_cluster()
     sched = make_sched(api)
