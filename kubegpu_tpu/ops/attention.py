@@ -66,7 +66,7 @@ def reference_attention(q, k, v, causal: bool = True):
 # Pallas flash attention
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                   *, sm_scale: float, causal: bool, block_q: int, block_k: int,
                   seq_k: Optional[int]):
     """One (bh, qi, ki) grid step: fold K/V block ki into the running
@@ -126,6 +126,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
         l = l_ref[:, :1]
         denom = jnp.where(l == 0.0, 1.0, l)        # fully-masked row -> 0 output
         o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        # logsumexp per row, the backward's only softmax residual
+        # (fully-masked rows stay -inf; the backward masks them out).
+        # Stored (bh, nq, 8, block_q): block_q rides the 128-divisible
+        # minor dim and the 8 merely fills one sublane tile — 16x smaller
+        # than the lane-replicated (…, 128) layout for the same
+        # TPU-layout-legality
+        m = m_ref[:, :1]
+        lse = jnp.where(
+            l > 0.0, jnp.where(jnp.isfinite(m), m, 0.0) + jnp.log(denom), NEG_INF
+        )
+        lse_ref[0, 0] = jnp.broadcast_to(lse[:, 0][None, :], lse_ref.shape[2:])
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
@@ -159,7 +170,7 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
         _flash_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, seq_k=sk if pad_k else None,
     )
-    of = pl.pallas_call(
+    of, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -167,8 +178,16 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sqp, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, 8, block_q), lambda bh, qi, ki: (bh, qi, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sqp, d), q.dtype),
+            jax.ShapeDtypeStruct(
+                (b * h, sqp // block_q, 8, block_q), jnp.float32
+            ),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),   # running max (lane-replicated)
             pltpu.VMEM((block_q, 128), jnp.float32),   # running denominator
@@ -176,26 +195,229 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return of.reshape(b, h, sqp, d)[:, :, :sq].transpose(0, 2, 1, 3)
+    out = of.reshape(b, h, sqp, d)[:, :, :sq].transpose(0, 2, 1, 3)
+    return out, lse
+
+
+def _bwd_block(q, k, v, do, o, lse_row, sm_scale, valid):
+    """Shared per-block backward algebra: recompute p from (q,k,lse), then
+    ds = p * (dO·Vᵀ - delta) * scale with delta = rowsum(dO·O) computed
+    right here from the resident blocks (materializing it in HBM would add
+    a seq-length array for an elementwise product the VPU does for free).
+    All f32, MXU dot_generals."""
+    delta_col = jnp.sum(do * o, axis=-1, keepdims=True)  # (block_q, 1)
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * sm_scale                                   # (block_q, block_k)
+    # rows with lse=-inf are fully masked (or padding): p must be 0, and
+    # exp(score - -inf) would be nan — guard the shift.  Shapes stay 2D
+    # throughout: Mosaic only supports minor-dim insertion on 32-bit
+    # values, so the bool mask must be DERIVED in 2D, never reshaped to it
+    lse_col = lse_row[:, None]                     # f32 (block_q, 1)
+    finite = jnp.isfinite(lse_col)
+    shift = jnp.where(finite, lse_col, 0.0)
+    p = jnp.where(
+        valid & finite, jnp.exp(scores - shift), 0.0
+    )                                              # (block_q, block_k)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )                                              # (block_q, block_k)
+    ds = p * (dp - delta_col) * sm_scale
+    return p, ds
+
+
+def _flash_bwd_dkdv_kernel(q_ref, do_ref, o_ref, lse_ref, k_ref, v_ref,
+                           dk_ref, dv_ref, dk_acc, dv_acc,
+                           *, sm_scale, causal, block_q, block_k,
+                           seq_q, seq_k):
+    """Grid (bh, ki, qi), qi innermost-sequential: accumulate this K/V
+    block's gradients over all query blocks in VMEM scratch."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    # causal: a q block contributes iff its rows reach these k columns
+    @pl.when(jnp.logical_not(causal) | (q_start + block_q - 1 >= k_start))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + q_start
+        cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + k_start
+        valid = (rows < seq_q) & (cols < seq_k)
+        if causal:
+            valid &= cols <= rows
+        p, ds = _bwd_block(
+            q, k, v, do, o, lse_ref[0, 0, 0], sm_scale, valid
+        )
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )                                          # pᵀ·dO (block_k, d)
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )                                          # dsᵀ·q (block_k, d)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, do_ref, o_ref, lse_ref, k_ref, v_ref,
+                         dq_ref, dq_acc,
+                         *, sm_scale, causal, block_q, block_k,
+                         seq_q, seq_k):
+    """Grid (bh, qi, ki), ki innermost-sequential: accumulate this query
+    block's gradient over all K/V blocks."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    @pl.when(jnp.logical_not(causal) | (k_start <= q_start + block_q - 1))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + q_start
+        cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + k_start
+        valid = (rows < seq_q) & (cols < seq_k)
+        if causal:
+            valid &= cols <= rows
+        _, ds = _bwd_block(
+            q, k, v, do, o, lse_ref[0, 0, 0], sm_scale, valid
+        )
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )                                          # ds·k (block_q, d)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
+    """Pallas flash backward: O(seq) memory — recomputes p blockwise from
+    the saved logsumexp instead of materializing the s×s score matrix
+    (which the old XLA-recompute backward did, defeating flash's point for
+    long sequences)."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    pad_q = -sq % block_q
+    pad_k = -sk % block_k
+    sm_scale = 1.0 / math.sqrt(d)
+
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+        g = jnp.pad(g, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+        out = jnp.pad(out, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+    sqp, skp = sq + pad_q, sk + pad_k
+    qf, kf, vf, dof, of = fold(q), fold(k), fold(v), fold(g), fold(out)
+    # lse from the forward is (b*h, nq, 8, block_q); forward and backward
+    # share block_q so the padded lengths agree.  delta = rowsum(dO*O) is
+    # computed inside the kernels from the resident blocks — no HBM array.
+
+    bh = b * h
+    nq, nk = sqp // block_q, skp // block_k
+    qspec3 = pl.BlockSpec((1, block_q, d), lambda bhi, ki, qi: (bhi, qi, 0))
+    lspec = pl.BlockSpec((1, 1, 8, block_q), lambda bhi, ki, qi: (bhi, qi, 0, 0))
+    kspec3 = pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, seq_q=sq, seq_k=sk,
+        ),
+        grid=(bh, nk, nq),
+        in_specs=[qspec3, qspec3, qspec3, lspec, kspec3, kspec3],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, skp, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, skp, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, dof, of, lse, kf, vf)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, seq_q=sq, seq_k=sk,
+        ),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((1, 1, 8, block_q), lambda bhi, qi, ki: (bhi, qi, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sqp, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, dof, of, lse, kf, vf)
+
+    def unfold(x, s, orig):
+        return x.reshape(b, h, s, d)[:, :, :orig].transpose(0, 2, 1, 3)
+
+    return unfold(dq, sqp, sq), unfold(dk, skp, sk), unfold(dv, skp, sk)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
                     block_k: int = 128, interpret: Optional[bool] = None):
-    """Flash attention, BSHD.  O(seq) memory in the forward; backward
-    recomputes scores (rematerialisation) instead of storing them."""
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    """Flash attention, BSHD.  O(seq) memory in BOTH directions: the
+    forward keeps only out + logsumexp; the backward recomputes scores
+    blockwise in its own Pallas kernels."""
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: reference_attention(q, k, v, causal), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_backward(
+        q, k, v, out, lse, g, causal, block_q, block_k, interpret
+    )
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

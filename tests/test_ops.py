@@ -75,6 +75,42 @@ def test_flash_gradients_match_reference():
         np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
 
 
+def test_flash_gradients_match_reference_noncausal_and_padded():
+    # uneven lengths exercise the backward kernels' seq_q/seq_k masking
+    # (padded rows/cols must contribute exactly zero gradient)
+    q, _, _ = qkv(s=40)
+    _, k, v = qkv(s=56)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, False, 32, 32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, False) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+
+def test_flash_backward_is_linear_memory():
+    # the residuals saved for backward must be O(seq): q,k,v,out (seq x d
+    # each) + lse/delta (seq) — NOT the s x s score matrix.  Checked via
+    # the jaxpr: no intermediate of shape (..., s, s) is saved or formed
+    # outside the kernels.
+    s = 256
+    q, k, v = qkv(s=s, h=1, d=16)
+    jaxpr = jax.make_jaxpr(
+        jax.grad(lambda q, k, v: flash_attention(q, k, v, True, 64, 64).sum())
+    )(q, k, v)
+    for eqn in jaxpr.eqns:
+        for var in eqn.outvars:
+            shape = getattr(var.aval, "shape", ())
+            assert not (
+                len(shape) >= 2 and shape[-1] == s and shape[-2] == s
+            ), f"O(s^2) intermediate {shape} in {eqn.primitive}"
+
+
 def test_flash_under_jit_and_grad():
     q, k, v = qkv(s=64)
     f = jax.jit(lambda q, k, v: flash_attention(q, k, v, True, 32, 32).sum())
