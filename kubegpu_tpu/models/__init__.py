@@ -12,6 +12,7 @@ from kubegpu_tpu.models.resnet import (
     ScanResNet101,
     ScanResNet152,
 )
+from kubegpu_tpu.models.data import prefetch_to_device, synthetic_image_batches
 from kubegpu_tpu.models.transformer import TransformerLM
 from kubegpu_tpu.models.moe import MoEMLP, MoeBlock, MoeTransformerLM
 # NOTE: kubegpu_tpu.models.checkpoint is deliberately NOT imported here —
@@ -47,6 +48,8 @@ __all__ = [
     "ScanResNet50",
     "ScanResNet101",
     "ScanResNet152",
+    "prefetch_to_device",
+    "synthetic_image_batches",
     "TransformerLM",
     "MoEMLP",
     "MoeBlock",

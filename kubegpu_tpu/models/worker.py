@@ -69,6 +69,22 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--ckpt-every", type=int, default=10, help="steps between saves")
     ap.add_argument(
+        "--data",
+        default="synthetic",
+        choices=["synthetic", "stream", "resident"],
+        help="synthetic = device-resident pool of distinct batches "
+        "(default: real data variation, zero per-step H2D); stream = "
+        "double-buffered host->device pipeline (the shape for real "
+        "loaders); resident = one constant batch (pure-step microbench)",
+    )
+    ap.add_argument(
+        "--data-pool",
+        type=int,
+        default=8,
+        help="synthetic mode: number of distinct device-resident batches "
+        "to cycle",
+    )
+    ap.add_argument(
         "--compile-cache",
         default=os.environ.get("KUBEGPU_TPU_COMPILE_CACHE", ""),
         help="persistent XLA compilation cache dir (pre-seed it in the pod "
@@ -119,10 +135,39 @@ def main(argv=None) -> int:
         model = ResNet(stage_sizes=(1, 1, 1, 1), num_filters=8, num_classes=10)
         size = 32
 
+    from kubegpu_tpu.models.data import (
+        device_pool_batches,
+        prefetch_to_device,
+        synthetic_image_batches,
+    )
+    from kubegpu_tpu.parallel.sharding import batch_sharding
+
     batch = args.batch_per_chip * n
     rng = jax.random.PRNGKey(0)
-    images = jnp.ones((batch, size, size, 3), jnp.float32)
-    labels = jnp.zeros((batch,), jnp.int32)
+    # input pipeline: each process generates ONLY its local rows of the
+    # global batch (put_global assembles the global array), seeded by the
+    # same id chain the rendezvous uses so gang workers draw disjoint
+    # streams however the env named them
+    worker_id = int(
+        os.environ.get("JAX_PROCESS_ID", os.environ.get("TPU_WORKER_ID", "0"))
+        or 0
+    )
+    local_batch = args.batch_per_chip * jax.local_device_count()
+    source = synthetic_image_batches(
+        local_batch, size=size, num_classes=args.num_classes, worker_id=worker_id
+    )
+    if args.data == "synthetic":
+        batches = device_pool_batches(
+            source, batch_sharding(mesh), pool=max(args.data_pool, 1)
+        )
+        images, labels = next(batches)
+    elif args.data == "stream":
+        batches = prefetch_to_device(source, batch_sharding(mesh), depth=2)
+        images, labels = next(batches)
+    else:  # resident: one constant device batch, no pipeline
+        images = jnp.ones((batch, size, size, 3), jnp.float32)
+        labels = jnp.zeros((batch,), jnp.int32)
+        batches = None
     state = create_train_state(model, rng, images)
     state, images, labels = place_resnet(state, (images, labels), mesh)
     step = make_resnet_train_step(mesh)
@@ -173,6 +218,8 @@ def main(argv=None) -> int:
     done = start_step + 1
     last_saved = -1
     for _ in range(args.steps - 1):
+        if batches is not None:
+            images, labels = next(batches)  # prefetched: already on device
         state, loss = step(state, images, labels)
         done += 1
         if mgr is not None and args.ckpt_every > 0 and done % args.ckpt_every == 0:

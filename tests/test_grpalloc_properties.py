@@ -1,0 +1,182 @@
+"""Property-based allocator tests (hypothesis): the invariants that must
+hold for EVERY topology and request mix, not just the hand-picked cases in
+test_grpalloc.py — the deepest version of the reference's crown-jewel
+allocator coverage (SURVEY.md §4)."""
+
+from typing import Dict
+
+from hypothesis import given, settings, strategies as st
+
+from kubegpu_tpu.grpalloc import (
+    build_slice_views,
+    fit_gang,
+    fit_gang_multislice,
+    pod_fits_group_constraints,
+    return_pod_resources,
+    take_pod_resources,
+)
+from kubegpu_tpu.types import NodeInfo, SliceTopology, TpuGeneration, is_contiguous_submesh
+from kubegpu_tpu.types.info import ContainerInfo, PodInfo, TpuRequest
+
+
+# -- topology strategy -------------------------------------------------------
+
+@st.composite
+def topologies(draw):
+    """Small v5e-style meshes with host blocks that divide them, plus an
+    arbitrary set of dead chips."""
+    hx = draw(st.sampled_from([1, 2]))
+    hy = draw(st.sampled_from([1, 2]))
+    gx = draw(st.integers(1, 3))
+    gy = draw(st.integers(1, 3))
+    mesh = (hx * gx, hy * gy)
+    all_coords = [(x, y) for x in range(mesh[0]) for y in range(mesh[1])]
+    dead = draw(st.sets(st.sampled_from(all_coords), max_size=len(all_coords) // 2))
+    topo = SliceTopology.build(
+        "s0", TpuGeneration.V5E, mesh, host_block=(hx, hy), unhealthy=dead
+    )
+    nodes = {}
+    for h in topo.hosts():
+        n = NodeInfo(
+            name=h, slice_id="s0", generation=topo.generation,
+            mesh_shape=topo.mesh_shape, wrap=topo.wrap, chips=topo.host_chips(h),
+        )
+        n.rebuild_capacity()
+        nodes[h] = n
+    return topo, nodes
+
+
+def make_pod(name, chips, contiguous=True, group=None, size=1):
+    return PodInfo(
+        name=name,
+        containers=[ContainerInfo(name="main", tpu_chips=chips)],
+        require_contiguous=contiguous,
+        pod_group=group,
+        pod_group_size=size,
+    )
+
+
+# -- single-pod fit invariants -----------------------------------------------
+
+@settings(max_examples=150, deadline=None)
+@given(topologies(), st.integers(1, 6), st.booleans())
+def test_fit_assignment_is_valid_and_scored(topo_nodes, chips, contiguous):
+    topo, nodes = topo_nodes
+    views = build_slice_views(nodes.values())
+    view = views.get("s0")
+    for node in nodes.values():
+        r = pod_fits_group_constraints(
+            node, TpuRequest.from_pod(make_pod("p", chips, contiguous)), view
+        )
+        if not r.fits:
+            continue
+        a = r.assignment
+        refs = a.all_chips()
+        # exactly the requested count, all on this node, no duplicates
+        assert len(refs) == chips
+        assert {c.host for c in refs} == {node.name}
+        assert len({c.device_index for c in refs}) == chips
+        # every granted chip is healthy
+        healthy = {c.coords for c in node.chips if c.healthy}
+        assert {c.coords for c in refs} <= healthy
+        if contiguous:
+            assert is_contiguous_submesh(
+                {c.coords for c in refs}, topo.mesh_shape, topo.wrap
+            )
+        assert 0.0 <= r.score <= 100.0
+
+
+@settings(max_examples=100, deadline=None)
+@given(topologies(), st.integers(1, 4))
+def test_take_then_return_roundtrips(topo_nodes, chips):
+    _, nodes = topo_nodes
+    views = build_slice_views(nodes.values())
+    view = views.get("s0")
+    for node in nodes.values():
+        before = node.used.to_flat()
+        r = pod_fits_group_constraints(
+            node, TpuRequest.from_pod(make_pod("p", chips)), view
+        )
+        if not r.fits:
+            continue
+        take_pod_resources(node, r.assignment)
+        # double-take of the same chips must raise and change nothing
+        mid = node.used.to_flat()
+        try:
+            take_pod_resources(node, r.assignment)
+            raise AssertionError("double-take did not raise")
+        except ValueError:
+            pass
+        assert node.used.to_flat() == mid
+        return_pod_resources(node, r.assignment)
+        assert node.used.to_flat() == before
+        # return is idempotent
+        return_pod_resources(node, r.assignment)
+        assert node.used.to_flat() == before
+
+
+# -- gang invariants ----------------------------------------------------------
+
+@settings(max_examples=100, deadline=None)
+@given(topologies(), st.integers(1, 4), st.integers(1, 3))
+def test_gang_never_double_allocates(topo_nodes, n_pods, chips):
+    topo, nodes = topo_nodes
+    views = build_slice_views(nodes.values())
+    if "s0" not in views:
+        return
+    pods = [make_pod(f"w{i}", chips, group="g", size=n_pods) for i in range(n_pods)]
+    g = fit_gang(views["s0"], pods)
+    if not g.success:
+        return
+    assert set(g.per_pod) == {p.key for p in pods}
+    seen = set()
+    for a in g.per_pod.values():
+        coords = {c.coords for c in a.all_chips()}
+        assert len(coords) == chips
+        assert not (coords & seen), "two pods share a chip"
+        seen |= coords
+        # per-pod host-locality + contiguity
+        assert len({c.host for c in a.all_chips()}) == 1
+        assert is_contiguous_submesh(coords, topo.mesh_shape, topo.wrap)
+    # the union is one contiguous rectangle (the gang contract)
+    assert is_contiguous_submesh(seen, topo.mesh_shape, topo.wrap)
+    # nothing the gang took was dead or already used
+    assert seen <= views["s0"].free
+
+
+@settings(max_examples=60, deadline=None)
+@given(topologies(), topologies(), st.integers(2, 4))
+def test_multislice_equal_shapes_property(tn_a, tn_b, n_pods):
+    _, nodes_a = tn_a
+    topo_b, nodes_b = tn_b
+    # second slice under a different id
+    for n in nodes_b.values():
+        n.slice_id = "s1"
+        n.name = "b-" + n.name
+        for i, ch in enumerate(n.chips):
+            n.chips[i] = type(ch)(
+                coords=ch.coords, chip_id=ch.chip_id, host_id=n.name,
+                device_index=ch.device_index, healthy=ch.healthy,
+            )
+        n.rebuild_capacity()
+    views = build_slice_views(list(nodes_a.values()) + list(nodes_b.values()))
+    pods = [
+        make_pod(f"w{i}", 1, group="g", size=n_pods) for i in range(n_pods)
+    ]
+    res = fit_gang_multislice(views, pods, allow_multislice=True)
+    if not res.success:
+        return
+    if res.num_slices == 1:
+        return
+    # equal per-slice chip counts and identical rectangle shape
+    per_slice: Dict[str, set] = {}
+    for a in res.per_pod.values():
+        per_slice.setdefault(a.slice_id, set()).update(
+            c.coords for c in a.all_chips()
+        )
+    counts = {len(v) for v in per_slice.values()}
+    assert len(counts) == 1
+    for sid, coords in per_slice.items():
+        assert is_contiguous_submesh(
+            coords, views[sid].mesh_shape, views[sid].wrap
+        )

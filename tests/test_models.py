@@ -163,6 +163,74 @@ def test_scan_resnet_dp_train_step_runs_and_learns():
     assert any(float(jnp.max(jnp.abs(s))) > 0 for s in stats)
 
 
+# -- input pipeline ---------------------------------------------------------
+
+def test_prefetch_to_device_shards_and_preserves_order():
+    from kubegpu_tpu.models import prefetch_to_device, synthetic_image_batches
+    from kubegpu_tpu.parallel.sharding import batch_sharding
+
+    mesh = device_mesh({"data": -1})
+    src = synthetic_image_batches(16, size=8, num_classes=10, worker_id=0)
+    host_first = next(synthetic_image_batches(16, size=8, num_classes=10, worker_id=0))
+    it = prefetch_to_device(src, batch_sharding(mesh), depth=3)
+    images, labels = next(it)
+    assert images.sharding.spec == P("data")
+    assert labels.shape == (16,)
+    # deterministic per (seed, worker): first device batch == first host batch
+    np.testing.assert_array_equal(np.asarray(labels), host_first[1])
+    # successive batches differ (it is a stream, not a repeated constant)
+    _, labels2 = next(it)
+    assert not np.array_equal(np.asarray(labels), np.asarray(labels2))
+
+
+def test_synthetic_batches_disjoint_per_worker():
+    from kubegpu_tpu.models import synthetic_image_batches
+
+    a = next(synthetic_image_batches(32, size=4, worker_id=0))[1]
+    b = next(synthetic_image_batches(32, size=4, worker_id=1))[1]
+    assert not np.array_equal(a, b)
+
+
+def test_device_pool_batches_cycles_distinct_resident_batches():
+    from kubegpu_tpu.models.data import device_pool_batches, synthetic_image_batches
+    from kubegpu_tpu.parallel.sharding import batch_sharding
+
+    mesh = device_mesh({"data": -1})
+    it = device_pool_batches(
+        synthetic_image_batches(16, size=4, num_classes=10),
+        batch_sharding(mesh),
+        pool=3,
+    )
+    first = [next(it) for _ in range(3)]
+    labels = [np.asarray(l) for _, l in first]
+    assert not np.array_equal(labels[0], labels[1])  # distinct batches
+    # cycles: batch 4 IS batch 1 (same device buffer, no new transfer)
+    again, _ = next(it)
+    assert again is first[0][0]
+    assert first[0][0].sharding.spec == P("data")
+
+
+def test_prefetch_finite_iterator_drains_fully():
+    from kubegpu_tpu.models import prefetch_to_device
+    from kubegpu_tpu.parallel.sharding import batch_sharding
+
+    mesh = device_mesh({"data": -1})
+    src = [(jnp.ones((8, 4)), jnp.full((8,), i)) for i in range(5)]
+    out = list(prefetch_to_device(iter(src), batch_sharding(mesh), depth=2))
+    assert len(out) == 5
+    assert [int(l[0]) for _, l in out] == [0, 1, 2, 3, 4]
+
+
+def test_worker_main_smoke(capsys):
+    from kubegpu_tpu.models import worker
+
+    assert worker.main(["--model", "resnet-tiny", "--steps", "3",
+                        "--batch-per-chip", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "FIRST_STEP_DONE" in out
+    assert "steady_state" in out
+
+
 # -- transformer TP+SP ------------------------------------------------------
 
 def test_lm_tp_placement_shards_params_and_moments():
