@@ -32,6 +32,7 @@ from kubegpu_tpu.models.train import (
     make_lm_train_step,
     make_moe_train_step,
     make_resnet_train_step,
+    place_cp_lm,
     place_lm,
     place_moe,
     place_resnet,
@@ -65,6 +66,7 @@ __all__ = [
     "make_lm_train_step",
     "make_moe_train_step",
     "make_resnet_train_step",
+    "place_cp_lm",
     "place_lm",
     "place_moe",
     "place_resnet",

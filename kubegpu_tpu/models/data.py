@@ -70,10 +70,20 @@ def device_pool_batches(
     step time under realistic data variation.  For real data use
     :func:`prefetch_to_device`, which streams."""
     it = iter(batches)
-    resident = [put_global(next(it), sharding) for _ in range(pool)]
+    resident: list = []
+    # eager fill, async dispatch: the puts are issued up front but
+    # device_put returns immediately, so the transfers ride under the
+    # consumer's first compile instead of delaying any step
+    for _ in range(pool):
+        try:
+            resident.append(put_global(next(it), sharding))
+        except StopIteration:
+            break  # short source: cycle what exists
+    if not resident:
+        raise ValueError("device_pool_batches: source yielded no batches")
     i = 0
     while True:
-        yield resident[i % pool]
+        yield resident[i % len(resident)]
         i += 1
 
 

@@ -201,3 +201,26 @@ def place_lm(state: TrainState, tokens, mesh: Mesh):
     state = jax.device_put(state, state_shardings(state, mesh, TRANSFORMER_TP_RULES))
     tokens = jax.device_put(tokens, batch_sharding(mesh))
     return state, tokens
+
+
+def place_cp_lm(state: TrainState, tokens, mesh: Mesh):
+    """Context-parallel placement (mesh axes ("data", "seq")): params
+    replicated, tokens batch-sharded.  The ACTIVATIONS get their (data,
+    seq, ...) layout from the model's constrain_ctx_sharded right after
+    the embed — the raw token array is a few bytes per row and its length
+    (seq+1 before the shift) need not divide the seq axis, so sharding it
+    would only add a constraint the data can't always satisfy.  Works on
+    pure-CP meshes (no "data" axis) too — tokens just replicate, matching
+    the model layer's batch_axis=None branch."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from kubegpu_tpu.parallel.sharding import DATA_AXIS
+
+    state = jax.device_put(state, replicated(mesh))
+    tok_spec = (
+        PartitionSpec(DATA_AXIS)
+        if DATA_AXIS in mesh.axis_names
+        else PartitionSpec()
+    )
+    tokens = jax.device_put(tokens, NamedSharding(mesh, tok_spec))
+    return state, tokens

@@ -24,13 +24,22 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from kubegpu_tpu.parallel.sharding import constrain_seq_sharded
+from kubegpu_tpu.parallel.sharding import (
+    DATA_AXIS,
+    SEQ_AXIS,
+    constrain_ctx_sharded,
+    constrain_seq_sharded,
+    get_current_mesh,
+)
 
 
 class CausalSelfAttention(nn.Module):
     num_heads: int
     dtype: jnp.dtype = jnp.bfloat16
-    attn_impl: str = "einsum"  # "einsum" | "flash" (ops/attention.py pallas kernel)
+    # "einsum" | "flash" (pallas kernel) | "ring" | "ulysses" (context
+    # parallelism over the mesh's "seq" axis; fall back to flash when no
+    # such axis is ambient, so the same model runs single-device)
+    attn_impl: str = "einsum"
 
     @nn.compact
     def __call__(self, x):
@@ -41,7 +50,28 @@ class CausalSelfAttention(nn.Module):
         q = dense(d, name="q_proj")(x).reshape(b, s, h, head_dim)
         k = dense(d, name="k_proj")(x).reshape(b, s, h, head_dim)
         v = dense(d, name="v_proj")(x).reshape(b, s, h, head_dim)
-        if self.attn_impl == "flash":
+        mesh = get_current_mesh()
+        cp = (
+            self.attn_impl in ("ring", "ulysses")
+            and mesh is not None
+            and SEQ_AXIS in mesh.axis_names
+        )
+        if cp:
+            from kubegpu_tpu.ops import (
+                ring_attention_sharded,
+                ulysses_attention_sharded,
+            )
+
+            fn = (
+                ring_attention_sharded
+                if self.attn_impl == "ring"
+                else ulysses_attention_sharded
+            )
+            batch_axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
+            out = fn(
+                q, k, v, mesh, SEQ_AXIS, causal=True, batch_axis=batch_axis
+            ).reshape(b, s, d)
+        elif self.attn_impl in ("flash", "ring", "ulysses"):
             from kubegpu_tpu.ops import flash_attention
 
             out = flash_attention(q, k, v, True).reshape(b, s, d)
@@ -65,7 +95,15 @@ class Block(nn.Module):
     mlp_ratio: int = 4
     dtype: jnp.dtype = jnp.bfloat16
     sequence_parallel: bool = False
+    context_parallel: bool = False
     attn_impl: str = "einsum"
+
+    def _constrain(self, x):
+        if self.context_parallel:
+            return constrain_ctx_sharded(x)
+        if self.sequence_parallel:
+            return constrain_seq_sharded(x)
+        return x
 
     @nn.compact
     def __call__(self, x):
@@ -74,8 +112,7 @@ class Block(nn.Module):
         x = x + CausalSelfAttention(
             self.num_heads, self.dtype, self.attn_impl, name="attn"
         )(y)
-        if self.sequence_parallel:
-            x = constrain_seq_sharded(x)
+        x = self._constrain(x)
         y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
         y = nn.Dense(
             d * self.mlp_ratio, use_bias=False, dtype=self.dtype, name="mlp_up"
@@ -83,9 +120,7 @@ class Block(nn.Module):
         y = nn.gelu(y)
         y = nn.Dense(d, use_bias=False, dtype=self.dtype, name="mlp_down")(y)
         x = x + y
-        if self.sequence_parallel:
-            x = constrain_seq_sharded(x)
-        return x
+        return self._constrain(x)
 
 
 class TransformerLM(nn.Module):
@@ -96,6 +131,9 @@ class TransformerLM(nn.Module):
     max_seq: int = 2048
     dtype: jnp.dtype = jnp.bfloat16
     sequence_parallel: bool = False
+    # context parallelism: activations sharded (data, seq, ...) between
+    # blocks; attention crosses shards via attn_impl="ring"/"ulysses"
+    context_parallel: bool = False
     attn_impl: str = "einsum"
 
     @nn.compact
@@ -108,11 +146,14 @@ class TransformerLM(nn.Module):
             jnp.arange(s)[None, :]
         )
         x = x + pos
+        if self.context_parallel:
+            x = constrain_ctx_sharded(x)
         for i in range(self.num_layers):
             x = Block(
                 self.num_heads,
                 dtype=self.dtype,
                 sequence_parallel=self.sequence_parallel,
+                context_parallel=self.context_parallel,
                 attn_impl=self.attn_impl,
                 name=f"layer{i}",
             )(x)

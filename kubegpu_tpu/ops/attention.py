@@ -473,13 +473,14 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return (o, m, l, k_nxt, v_nxt), None
 
-    o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
-    m0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
     # constants are device-invariant to shard_map's varying-axes typing, but
-    # the folded carries vary over the ring axis — mark them so scan's
-    # carry types match
-    o0, m0, l0 = (jax.lax.pcast(x, (axis_name,), to="varying") for x in (o0, m0, l0))
+    # the folded carries vary over every axis q varies over (the ring axis,
+    # plus any batch axis of a DP x CP mesh) — adding a zero derived from q
+    # stamps exactly that set onto the initializers, whatever the mesh
+    vma_zero = jnp.sum(qf) * 0.0
+    o0 = jnp.zeros((b, h, s_loc, d), jnp.float32) + vma_zero
+    m0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32) + vma_zero
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32) + vma_zero
     # scan rotates size-1 times; the last resident block folds outside so no
     # dead final exchange is issued (2*(size-1) hops total, as documented)
     (o, m, l, k_last, v_last), _ = jax.lax.scan(
@@ -491,10 +492,13 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
     return out.astype(q.dtype)
 
 
-def ring_attention_sharded(q, k, v, mesh: Mesh, axis: str, causal: bool = True):
+def ring_attention_sharded(q, k, v, mesh: Mesh, axis: str, causal: bool = True,
+                           batch_axis: Optional[str] = None):
     """shard_map wrapper: q/k/v are GLOBAL (batch, seq, heads, head_dim)
-    arrays; seq is sharded over `axis`, everything else replicated."""
-    spec = P(None, axis, None, None)
+    arrays; seq is sharded over `axis`; the batch dim may additionally be
+    sharded over `batch_axis` (DP x CP meshes) — the ring only ever talks
+    along `axis`, so batch shards stay independent."""
+    spec = P(batch_axis, axis, None, None)
     fn = jax.shard_map(
         functools.partial(ring_attention, axis_name=axis, causal=causal),
         mesh=mesh,
@@ -552,10 +556,11 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
 
 
 def ulysses_attention_sharded(q, k, v, mesh: Mesh, axis: str,
-                              causal: bool = True, use_flash: bool = False):
+                              causal: bool = True, use_flash: bool = False,
+                              batch_axis: Optional[str] = None):
     """shard_map wrapper: q/k/v are GLOBAL (batch, seq, heads, head_dim)
-    arrays; seq sharded over `axis`, everything else replicated."""
-    spec = P(None, axis, None, None)
+    arrays; seq sharded over `axis`; batch optionally over `batch_axis`."""
+    spec = P(batch_axis, axis, None, None)
     fn = jax.shard_map(
         functools.partial(
             ulysses_attention, axis_name=axis, causal=causal, use_flash=use_flash

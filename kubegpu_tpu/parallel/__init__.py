@@ -13,10 +13,12 @@ from kubegpu_tpu.parallel.sharding import (
     EXPERT_AXIS,
     MODEL_AXIS,
     MOE_EP_RULES,
+    SEQ_AXIS,
     TRANSFORMER_TP_RULES,
     batch_sharding,
     batch_spec,
     constrain_batch_sharded,
+    constrain_ctx_sharded,
     constrain_expert_grouped,
     constrain_seq_sharded,
     param_shardings,
@@ -34,6 +36,8 @@ __all__ = [
     "EXPERT_AXIS",
     "MODEL_AXIS",
     "PIPE_AXIS",
+    "SEQ_AXIS",
+    "constrain_ctx_sharded",
     "MOE_EP_RULES",
     "TRANSFORMER_TP_RULES",
     "pipeline_apply",

@@ -26,6 +26,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 EXPERT_AXIS = "expert"
+# context parallelism: the sequence dim of activations AND the ring/all-to-all
+# axis of ops.attention's CP kernels — distinct from Megatron SP, which
+# re-shards the residual over MODEL_AXIS between blocks
+SEQ_AXIS = "seq"
 
 # Ambient mesh for sharding constraints inside model code (jax's own
 # context-mesh API has churned across versions; an explicit, version-proof
@@ -151,6 +155,20 @@ def constrain_seq_sharded(x: jax.Array) -> jax.Array:
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS, None))
     )
+
+
+def constrain_ctx_sharded(x: jax.Array) -> jax.Array:
+    """Context-parallel activations: [batch, seq, ...] sharded
+    (data, seq, None...) — every per-token op (embed, LN, MLP) then runs on
+    1/seq of the sequence; only attention needs cross-shard communication
+    (ops.attention ring/ulysses).  No-op without a ``current_mesh`` carrying
+    the axis."""
+    mesh = get_current_mesh()
+    if mesh is None or SEQ_AXIS not in mesh.axis_names:
+        return x
+    data = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
+    spec = P(data, SEQ_AXIS, *([None] * (x.ndim - 2)))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
 def constrain_batch_sharded(x: jax.Array) -> jax.Array:
