@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from kubegpu_tpu.parallel.sharding import (
     DATA_AXIS,
+    MODEL_AXIS,
     SEQ_AXIS,
     constrain_ctx_sharded,
     constrain_seq_sharded,
@@ -68,8 +69,23 @@ class CausalSelfAttention(nn.Module):
                 else ulysses_attention_sharded
             )
             batch_axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
+            # TP x CP: keep heads sharded over "model" through the CP
+            # attention only when the division works out — (a) heads must
+            # divide by the tp size, and (b) ulysses' head-scatter needs
+            # the LOCAL head count to divide by the seq axis.  Otherwise
+            # fall back to replicated heads (the pre-TP behavior: correct,
+            # just an extra gather)
+            heads_axis = None
+            if MODEL_AXIS in mesh.axis_names:
+                tp = mesh.shape[MODEL_AXIS]
+                if h % tp == 0 and (
+                    self.attn_impl == "ring"
+                    or (h // tp) % mesh.shape[SEQ_AXIS] == 0
+                ):
+                    heads_axis = MODEL_AXIS
             out = fn(
-                q, k, v, mesh, SEQ_AXIS, causal=True, batch_axis=batch_axis
+                q, k, v, mesh, SEQ_AXIS, causal=True,
+                batch_axis=batch_axis, heads_axis=heads_axis,
             ).reshape(b, s, d)
         elif self.attn_impl in ("flash", "ring", "ulysses"):
             from kubegpu_tpu.ops import flash_attention

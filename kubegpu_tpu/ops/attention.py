@@ -493,12 +493,14 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
 
 
 def ring_attention_sharded(q, k, v, mesh: Mesh, axis: str, causal: bool = True,
-                           batch_axis: Optional[str] = None):
+                           batch_axis: Optional[str] = None,
+                           heads_axis: Optional[str] = None):
     """shard_map wrapper: q/k/v are GLOBAL (batch, seq, heads, head_dim)
-    arrays; seq is sharded over `axis`; the batch dim may additionally be
-    sharded over `batch_axis` (DP x CP meshes) — the ring only ever talks
-    along `axis`, so batch shards stay independent."""
-    spec = P(batch_axis, axis, None, None)
+    arrays; seq is sharded over `axis`; batch and heads may additionally be
+    sharded over `batch_axis` / `heads_axis` (DP x TP x CP meshes) — the
+    ring only ever talks along `axis`; attention is independent per batch
+    row AND per head, so the other shards never communicate."""
+    spec = P(batch_axis, axis, heads_axis, None)
     fn = jax.shard_map(
         functools.partial(ring_attention, axis_name=axis, causal=causal),
         mesh=mesh,
@@ -535,7 +537,10 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
     b, s_loc, h, d = q.shape
     if h % size != 0:
         raise ValueError(
-            f"ulysses needs heads ({h}) divisible by the '{axis_name}' axis"
+            f"ulysses needs the LOCAL (per-shard) head count ({h}) divisible "
+            f"by the '{axis_name}' axis size ({size}); with TP-sharded heads "
+            f"this is global_heads/tp — replicate heads over TP (heads_axis="
+            f"None) or adjust the mesh"
         )
     # seq-shards -> head-shards: split heads (axis 2) across devices,
     # concatenate everyone's seq chunk (axis 1) in axis order = global order
@@ -557,10 +562,14 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
 
 def ulysses_attention_sharded(q, k, v, mesh: Mesh, axis: str,
                               causal: bool = True, use_flash: bool = False,
-                              batch_axis: Optional[str] = None):
+                              batch_axis: Optional[str] = None,
+                              heads_axis: Optional[str] = None):
     """shard_map wrapper: q/k/v are GLOBAL (batch, seq, heads, head_dim)
-    arrays; seq sharded over `axis`; batch optionally over `batch_axis`."""
-    spec = P(batch_axis, axis, None, None)
+    arrays; seq sharded over `axis`; batch/heads optionally over
+    `batch_axis`/`heads_axis` (the LOCAL heads per TP shard must then
+    still divide by the seq axis — ulysses' head-scatter works on the
+    local head set)."""
+    spec = P(batch_axis, axis, heads_axis, None)
     fn = jax.shard_map(
         functools.partial(
             ulysses_attention, axis_name=axis, causal=causal, use_flash=use_flash

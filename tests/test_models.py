@@ -314,6 +314,59 @@ def test_cp_lm_activations_are_seq_sharded():
     assert logits.sharding.spec[:2] == ("data", "seq")
 
 
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_3d_dp_tp_cp_lm_matches_single_device(impl):
+    # the full composition: batch over "data", heads/kernels over "model"
+    # (Megatron TP), sequence over "seq" (CP) — one mesh, one jit
+    from kubegpu_tpu.models import place_lm
+    from kubegpu_tpu.models.train import lm_loss
+
+    model = TransformerLM(
+        vocab_size=64, num_layers=2, num_heads=4, hidden=32, max_seq=64,
+        context_parallel=True, attn_impl=impl,
+    )
+    tokens = (jnp.arange(2 * 33, dtype=jnp.int32) % 64).reshape(2, 33)
+    state = create_train_state(model, jax.random.PRNGKey(3), tokens[:, :-1])
+    ref = float(lm_loss(state, state.params, tokens))
+
+    mesh = device_mesh({"data": 2, "model": 2, "seq": 2})
+    state, tok = place_lm(state, tokens, mesh)  # params TP-sharded
+    qk = state.params["layer0"]["attn"]["q_proj"]["kernel"]
+    assert qk.sharding.spec == P(None, "model")
+    step = make_lm_train_step(mesh, donate=False)
+    state2, loss = step(state, tok)
+    assert abs(float(loss) - ref) < 1e-2
+    _, loss2 = step(state2, tok)
+    assert float(loss2) < float(loss)
+
+
+@pytest.mark.parametrize(
+    "impl,heads,axes",
+    [
+        # heads (2) don't divide tp (4): must fall back to replicated heads
+        ("ring", 2, {"data": 1, "model": 4, "seq": 2}),
+        # local heads (4/2=2) don't divide seq (4): ulysses falls back too
+        ("ulysses", 4, {"data": 1, "model": 2, "seq": 4}),
+    ],
+)
+def test_cp_tp_indivisible_heads_fall_back_to_replication(impl, heads, axes):
+    from kubegpu_tpu.models import place_lm
+    from kubegpu_tpu.models.train import lm_loss
+
+    model = TransformerLM(
+        vocab_size=64, num_layers=1, num_heads=heads, hidden=32, max_seq=64,
+        context_parallel=True, attn_impl=impl,
+    )
+    tokens = (jnp.arange(2 * 33, dtype=jnp.int32) % 64).reshape(2, 33)
+    state = create_train_state(model, jax.random.PRNGKey(4), tokens[:, :-1])
+    ref = float(lm_loss(state, state.params, tokens))
+    mesh = device_mesh(axes)
+    state, tok = place_lm(state, tokens, mesh)
+    step = make_lm_train_step(mesh, donate=False)
+    _, loss = step(state, tok)
+    assert abs(float(loss) - ref) < 1e-2
+
+
 def test_cp_lm_on_pure_cp_mesh():
     # no "data" axis at all: tokens replicate, activations shard over seq
     from kubegpu_tpu.models import place_cp_lm
