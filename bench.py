@@ -45,6 +45,25 @@ def schedule_config(api, sched, pods):
     return placements, None
 
 
+def make_pod(name, chips, group=None, size=1, priority=0):
+    from kubegpu_tpu.types import RES_TPU, annotations
+
+    ann = {}
+    if group:
+        ann[annotations.POD_GROUP] = group
+        ann[annotations.POD_GROUP_SIZE] = str(size)
+    if priority:
+        ann[annotations.POD_PRIORITY] = str(priority)
+    return {
+        "metadata": {"name": name, "namespace": "default", "annotations": ann},
+        "spec": {
+            "containers": [
+                {"name": "main", "resources": {"limits": {RES_TPU: str(chips)}}}
+            ]
+        },
+    }
+
+
 def contiguous_rate() -> float:
     """ICI-contiguous placement rate across the five graded configs."""
     from kubegpu_tpu.plugins import Advertiser, FakeSlice
@@ -53,21 +72,7 @@ def contiguous_rate() -> float:
     from kubegpu_tpu.utils import InMemoryApiServer
     from kubegpu_tpu.utils.metrics import Metrics
 
-    def pod(name, chips, group=None, size=1, priority=0):
-        ann = {}
-        if group:
-            ann[annotations.POD_GROUP] = group
-            ann[annotations.POD_GROUP_SIZE] = str(size)
-        if priority:
-            ann[annotations.POD_PRIORITY] = str(priority)
-        return {
-            "metadata": {"name": name, "namespace": "default", "annotations": ann},
-            "spec": {
-                "containers": [
-                    {"name": "main", "resources": {"limits": {RES_TPU: str(chips)}}}
-                ]
-            },
-        }
+    pod = make_pod
 
     configs = [
         ("0-dev passthrough", [pod("c0", 0)]),
@@ -161,6 +166,30 @@ def main() -> None:
 
     rate = contiguous_rate()
     log(f"ICI-contiguous placement rate across graded configs: {rate:.2f}")
+
+    # ---- control-plane scale: extender verb latency on a v5e-256 --------
+    # (the reference's hot loop, SURVEY.md §3.1; the native C++ rectangle
+    # scan is picked up automatically when native/ is built)
+    big_api = InMemoryApiServer()
+    big = FakeSlice(slice_id="v5e-256", mesh_shape=(16, 16), host_block=(2, 2))
+    for prov in big.providers().values():
+        Advertiser(prov, big_api).advertise_once()
+    big_sched = Scheduler(big_api, metrics=Metrics())
+    big_sched.cache.refresh()
+    big_nodes = sorted(n["metadata"]["name"] for n in big_api.list_nodes())
+    obj = make_pod("scale-probe", 4)
+    big_api.create_pod(obj)
+    t = time.perf_counter()
+    r = big_sched.filter(obj, big_nodes)
+    t_filter = time.perf_counter() - t
+    assert r.nodes, r.failed
+    t = time.perf_counter()
+    big_sched.prioritize(obj, r.nodes)
+    t_prio = time.perf_counter() - t
+    log(
+        f"v5e-256 (64 nodes) extender latency: filter {t_filter * 1e3:.1f} ms, "
+        f"prioritize {t_prio * 1e3:.1f} ms"
+    )
 
     # ---- north star: 4-pod DP ResNet-50 gang, creation -> first step ----
     api = InMemoryApiServer()

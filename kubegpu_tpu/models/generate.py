@@ -1,0 +1,183 @@
+"""Autoregressive decoding with a KV cache — the inference half of the LM
+workload family.
+
+TPU-first shape: the whole decode loop is ONE compiled program
+(``lax.scan`` over steps, static shapes everywhere).  The KV cache is a
+pre-allocated (batch, max_seq, heads, head_dim) buffer per layer updated
+with ``dynamic_update_slice``; each step attends over the full buffer with
+a length mask (dynamic-shape-free, so XLA tiles the attention onto the MXU
+and never recompiles per position).
+
+Works with the training ``TransformerLM`` checkpoints: the decode model
+reuses the same parameter names (q_proj/k_proj/... — the
+TRANSFORMER_TP_RULES contract), so a trained params pytree drops straight
+in, TP-sharded or not.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+NEG_INF = float("-inf")
+
+
+class DecodeAttention(nn.Module):
+    """Chunked attention against a running KV cache: x may be one token
+    (a decode step) or the whole prompt (prefill in ONE causal pass — L
+    sequential tiny matmuls would underuse the MXU and serialize
+    latency)."""
+
+    num_heads: int
+    max_seq: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, cache_k, cache_v, pos):
+        # x: (b, L, d); cache_*: (b, max_seq, h, hd); pos: () int32 — the
+        # cache row of x's FIRST token
+        b, L, d = x.shape
+        h = self.num_heads
+        hd = d // h
+        dense = partial(nn.Dense, use_bias=False, dtype=self.dtype)
+        q = dense(d, name="q_proj")(x).reshape(b, L, h, hd)
+        k = dense(d, name="k_proj")(x).reshape(b, L, h, hd)
+        v = dense(d, name="v_proj")(x).reshape(b, L, h, hd)
+        cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, pos, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, pos, 0, 0))
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32), cache_k.astype(jnp.float32)
+        ) / math.sqrt(hd)
+        # causal over global positions: chunk row i sits at pos+i
+        rows = pos + jnp.arange(L)[None, None, :, None]
+        cols = jnp.arange(self.max_seq)[None, None, None, :]
+        scores = jnp.where(cols <= rows, scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum(
+            "bhqk,bkhd->bqhd", probs, cache_v.astype(jnp.float32)
+        ).astype(self.dtype)
+        return dense(d, name="o_proj")(out.reshape(b, L, d)), cache_k, cache_v
+
+
+class DecodeBlock(nn.Module):
+    num_heads: int
+    max_seq: int
+    mlp_ratio: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, cache_k, cache_v, pos):
+        d = x.shape[-1]
+        y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        attn_out, cache_k, cache_v = DecodeAttention(
+            self.num_heads, self.max_seq, self.dtype, name="attn"
+        )(y, cache_k, cache_v, pos)
+        x = x + attn_out
+        y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        y = nn.Dense(
+            d * self.mlp_ratio, use_bias=False, dtype=self.dtype, name="mlp_up"
+        )(y)
+        y = nn.gelu(y)
+        y = nn.Dense(d, use_bias=False, dtype=self.dtype, name="mlp_down")(y)
+        return x + y, cache_k, cache_v
+
+
+class DecodeLM(nn.Module):
+    """Cached twin of ``TransformerLM``: identical parameter tree
+    (init-compatible with trained checkpoints), explicit KV caches, chunk
+    input — the prompt prefills in one call, decode steps pass one
+    token."""
+
+    vocab_size: int = 32000
+    num_layers: int = 4
+    num_heads: int = 8
+    hidden: int = 512
+    max_seq: int = 2048
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens, caches, pos):
+        # tokens: (b, L) int32; caches: [(k, v)] per layer; pos: () int32
+        b, L = tokens.shape
+        x = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype, name="embed")(
+            tokens
+        )
+        x = x + nn.Embed(
+            self.max_seq, self.hidden, dtype=self.dtype, name="pos_embed"
+        )((pos + jnp.arange(L))[None, :])
+        new_caches = []
+        for i in range(self.num_layers):
+            ck, cv = caches[i]
+            x, ck, cv = DecodeBlock(
+                self.num_heads, self.max_seq, dtype=self.dtype, name=f"layer{i}"
+            )(x, ck, cv, pos)
+            new_caches.append((ck, cv))
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        logits = nn.Dense(
+            self.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head"
+        )(x)
+        return logits[:, -1], new_caches
+
+
+def init_caches(batch: int, num_layers: int, num_heads: int, hidden: int,
+                max_seq: int, dtype=jnp.bfloat16):
+    hd = hidden // num_heads
+    return [
+        (
+            jnp.zeros((batch, max_seq, num_heads, hd), dtype),
+            jnp.zeros((batch, max_seq, num_heads, hd), dtype),
+        )
+        for _ in range(num_layers)
+    ]
+
+
+def greedy_generate(
+    params,
+    prompt: jax.Array,
+    num_steps: int,
+    *,
+    vocab_size: int,
+    num_layers: int,
+    num_heads: int,
+    hidden: int,
+    max_seq: int,
+    dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Greedy decode: prefill the prompt token-by-token through the cache,
+    then scan `num_steps` generation steps — all one jittable program.
+
+    ``prompt``: (b, prompt_len) int32.  Returns (b, prompt_len + num_steps).
+    """
+    b, prompt_len = prompt.shape
+    if prompt_len + num_steps > max_seq:
+        raise ValueError(
+            f"prompt ({prompt_len}) + steps ({num_steps}) exceeds "
+            f"max_seq ({max_seq}); cache writes would silently clamp"
+        )
+    model = DecodeLM(
+        vocab_size=vocab_size, num_layers=num_layers, num_heads=num_heads,
+        hidden=hidden, max_seq=max_seq, dtype=dtype,
+    )
+    caches = init_caches(b, num_layers, num_heads, hidden, max_seq, dtype)
+
+    def apply(tokens, caches, pos):
+        return model.apply({"params": params}, tokens, caches, pos)
+
+    # prefill: the whole prompt in ONE causal pass (fills every K/V row)
+    logits, caches = apply(prompt, caches, jnp.zeros((), jnp.int32))
+
+    def gen_step(carry, i):
+        caches, logits = carry
+        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits, caches = apply(token[:, None], caches, prompt_len + i)
+        return (caches, logits), token
+
+    (_, _), tokens = jax.lax.scan(
+        gen_step, (caches, logits), jnp.arange(num_steps)
+    )
+    return jnp.concatenate([prompt, tokens.T], axis=1)
