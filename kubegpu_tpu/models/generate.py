@@ -50,17 +50,23 @@ class DecodeAttention(nn.Module):
         v = dense(d, name="v_proj")(x).reshape(b, L, h, hd)
         cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, pos, 0, 0))
         cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, pos, 0, 0))
-        scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q.astype(jnp.float32), cache_k.astype(jnp.float32)
-        ) / math.sqrt(hd)
+        # numerics MIRROR the training model's einsum attention (scores in
+        # model dtype, finfo-min mask, fp32 softmax, dtype matmul with V):
+        # greedy decode must reproduce the training forward's argmax, and
+        # at bf16 a higher-precision score path rounds ties differently
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, cache_k) / jnp.sqrt(
+            hd
+        ).astype(self.dtype)
         # causal over global positions: chunk row i sits at pos+i
         rows = pos + jnp.arange(L)[None, None, :, None]
         cols = jnp.arange(self.max_seq)[None, None, None, :]
-        scores = jnp.where(cols <= rows, scores, NEG_INF)
-        probs = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum(
-            "bhqk,bkhd->bqhd", probs, cache_v.astype(jnp.float32)
-        ).astype(self.dtype)
+        scores = jnp.where(
+            cols <= rows, scores, jnp.finfo(self.dtype).min
+        )
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
+            self.dtype
+        )
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, cache_v)
         return dense(d, name="o_proj")(out.reshape(b, L, d)), cache_k, cache_v
 
 
@@ -148,8 +154,9 @@ def greedy_generate(
     max_seq: int,
     dtype=jnp.bfloat16,
 ) -> jax.Array:
-    """Greedy decode: prefill the prompt token-by-token through the cache,
-    then scan `num_steps` generation steps — all one jittable program.
+    """Greedy decode: prefill the whole prompt in one causal pass (filling
+    every K/V cache row), then scan `num_steps` generation steps — all one
+    jittable program.
 
     ``prompt``: (b, prompt_len) int32.  Returns (b, prompt_len + num_steps).
     """

@@ -51,6 +51,25 @@ def test_greedy_generate_matches_full_forward_argmax():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
 
 
+def test_greedy_generate_matches_training_argmax_at_bf16():
+    # default-dtype checkpoints: decode numerics mirror the training
+    # attention exactly (bf16 scores, finfo-min mask, fp32 softmax), so
+    # the argmax contract holds at bf16 too
+    model = TransformerLM(dtype=jnp.bfloat16, **CFG)
+    params = model.init(jax.random.PRNGKey(1), jnp.ones((2, 8), jnp.int32))[
+        "params"
+    ]
+    prompt = (jnp.arange(2 * 4, dtype=jnp.int32) % CFG["vocab_size"]).reshape(2, 4)
+    steps = 5
+    seq = prompt
+    for _ in range(steps):
+        logits = model.apply({"params": params}, seq)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    out = greedy_generate(params, prompt, steps, dtype=jnp.bfloat16, **CFG)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+
 def test_greedy_generate_rejects_cache_overflow():
     import pytest
 
