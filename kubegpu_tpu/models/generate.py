@@ -16,15 +16,11 @@ in, TP-sharded or not.
 
 from __future__ import annotations
 
-import math
 from functools import partial
-from typing import Optional, Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-
-NEG_INF = float("-inf")
 
 
 class DecodeAttention(nn.Module):

@@ -106,6 +106,12 @@ def test_tp_sharded_lm_checkpoint_roundtrip(tmp_path):
     for a, b in zip(jax.tree_util.tree_leaves(restored.params),
                     jax.tree_util.tree_leaves(state.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    # the mirrored optimizer moments restore with values AND TP shardings
+    for a, b in zip(jax.tree_util.tree_leaves(restored.opt_state),
+                    jax.tree_util.tree_leaves(state.opt_state)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    trace_qk = restored.opt_state[0].trace["layer0"]["attn"]["q_proj"]["kernel"]
+    assert trace_qk.sharding.spec == P(None, "model")
     restored, loss = step(restored, tok2)
     assert np.isfinite(float(loss))
     # decoding consumes the restored checkpoint directly (shared param tree)
