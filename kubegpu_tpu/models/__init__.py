@@ -13,7 +13,12 @@ from kubegpu_tpu.models.resnet import (
     ScanResNet152,
 )
 from kubegpu_tpu.models.data import prefetch_to_device, synthetic_image_batches
-from kubegpu_tpu.models.generate import DecodeLM, greedy_generate, init_caches
+from kubegpu_tpu.models.decoding import (
+    DecodeLM,
+    generate,
+    greedy_generate,
+    init_caches,
+)
 from kubegpu_tpu.models.transformer import TransformerLM
 from kubegpu_tpu.models.moe import MoEMLP, MoeBlock, MoeTransformerLM
 # NOTE: kubegpu_tpu.models.checkpoint is deliberately NOT imported here —
@@ -53,6 +58,7 @@ __all__ = [
     "prefetch_to_device",
     "synthetic_image_batches",
     "DecodeLM",
+    "generate",
     "greedy_generate",
     "init_caches",
     "TransformerLM",

@@ -138,7 +138,7 @@ def init_caches(batch: int, num_layers: int, num_heads: int, hidden: int,
     ]
 
 
-def greedy_generate(
+def generate(
     params,
     prompt: jax.Array,
     num_steps: int,
@@ -149,19 +149,27 @@ def greedy_generate(
     hidden: int,
     max_seq: int,
     dtype=jnp.bfloat16,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    rng: jax.Array | None = None,
 ) -> jax.Array:
-    """Greedy decode: prefill the whole prompt in one causal pass (filling
-    every K/V cache row), then scan `num_steps` generation steps — all one
+    """Decode: prefill the whole prompt in one causal pass (filling every
+    K/V cache row), then scan `num_steps` generation steps — all one
     jittable program.
 
-    ``prompt``: (b, prompt_len) int32.  Returns (b, prompt_len + num_steps).
-    """
+    ``temperature=0`` (default) is greedy argmax.  ``temperature>0``
+    samples from ``softmax(logits/temperature)``, optionally truncated to
+    the ``top_k`` highest-probability tokens (0 = no truncation); pass
+    ``rng`` for sampling.  ``prompt``: (b, prompt_len) int32.  Returns
+    (b, prompt_len + num_steps)."""
     b, prompt_len = prompt.shape
     if prompt_len + num_steps > max_seq:
         raise ValueError(
             f"prompt ({prompt_len}) + steps ({num_steps}) exceeds "
             f"max_seq ({max_seq}); cache writes would silently clamp"
         )
+    if temperature > 0.0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs an rng key")
     model = DecodeLM(
         vocab_size=vocab_size, num_layers=num_layers, num_heads=num_heads,
         hidden=hidden, max_seq=max_seq, dtype=dtype,
@@ -171,16 +179,44 @@ def greedy_generate(
     def apply(tokens, caches, pos):
         return model.apply({"params": params}, tokens, caches, pos)
 
+    if top_k > vocab_size:
+        raise ValueError(f"top_k ({top_k}) exceeds vocab_size ({vocab_size})")
+
+    def pick(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits / temperature
+        if top_k > 0:
+            # O(V) threshold; a full sort per decoded token would dominate
+            # the scan body at real vocab sizes
+            kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+            scaled = jnp.where(scaled >= kth, scaled, NEG_INF_LOGIT)
+        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
     # prefill: the whole prompt in ONE causal pass (fills every K/V row)
     logits, caches = apply(prompt, caches, jnp.zeros((), jnp.int32))
+    keys = (
+        jax.random.split(rng, num_steps)
+        if rng is not None
+        else jnp.zeros((num_steps, 2), jnp.uint32)
+    )
 
-    def gen_step(carry, i):
+    def gen_step(carry, inputs):
+        i, key = inputs
         caches, logits = carry
-        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        token = pick(logits, key)
         logits, caches = apply(token[:, None], caches, prompt_len + i)
         return (caches, logits), token
 
     (_, _), tokens = jax.lax.scan(
-        gen_step, (caches, logits), jnp.arange(num_steps)
+        gen_step, (caches, logits), (jnp.arange(num_steps), keys)
     )
     return jnp.concatenate([prompt, tokens.T], axis=1)
+
+
+NEG_INF_LOGIT = -1e9  # large-negative in f32; -inf breaks categorical's gumbel
+
+
+def greedy_generate(params, prompt, num_steps, **kw) -> jax.Array:
+    """Greedy decode (temperature 0) — see :func:`generate`."""
+    return generate(params, prompt, num_steps, temperature=0.0, **kw)

@@ -19,7 +19,7 @@ def trained_params():
 def test_decode_lm_param_tree_matches_training_model():
     params = trained_params()
     decode = DecodeLM(dtype=jnp.float32, **CFG)
-    from kubegpu_tpu.models.generate import init_caches
+    from kubegpu_tpu.models.decoding import init_caches
 
     caches = init_caches(2, CFG["num_layers"], CFG["num_heads"], CFG["hidden"],
                          CFG["max_seq"], jnp.float32)
@@ -77,6 +77,30 @@ def test_greedy_generate_rejects_cache_overflow():
     prompt = jnp.ones((1, 10), jnp.int32)
     with pytest.raises(ValueError, match="exceeds"):
         greedy_generate(params, prompt, 30, dtype=jnp.float32, **CFG)
+
+
+def test_sampling_respects_top_k_and_needs_rng():
+    import pytest
+
+    from kubegpu_tpu.models import generate
+
+    params = trained_params()
+    prompt = jnp.ones((1, 3), jnp.int32)
+    with pytest.raises(ValueError, match="rng"):
+        generate(params, prompt, 2, temperature=1.0, dtype=jnp.float32, **CFG)
+    # top_k=1 at any temperature IS greedy (only the argmax survives)
+    greedy = greedy_generate(params, prompt, 5, dtype=jnp.float32, **CFG)
+    sampled = generate(
+        params, prompt, 5, temperature=2.0, top_k=1,
+        rng=jax.random.PRNGKey(0), dtype=jnp.float32, **CFG,
+    )
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(sampled))
+    # unconstrained sampling at high temperature explores: two keys diverge
+    a = generate(params, prompt, 8, temperature=5.0,
+                 rng=jax.random.PRNGKey(1), dtype=jnp.float32, **CFG)
+    b = generate(params, prompt, 8, temperature=5.0,
+                 rng=jax.random.PRNGKey(2), dtype=jnp.float32, **CFG)
+    assert not np.array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_greedy_generate_is_jittable_one_program():
