@@ -25,7 +25,7 @@ from typing import Dict, List, Mapping, Optional, Sequence
 
 from kubegpu_tpu.plugins.provider import AllocateResponse, TpuProvider
 from kubegpu_tpu.types import annotations
-from kubegpu_tpu.types.info import Assignment, PodInfo
+from kubegpu_tpu.types.info import PodInfo
 
 log = logging.getLogger(__name__)
 

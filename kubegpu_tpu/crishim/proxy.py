@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import logging
 from concurrent import futures
-from typing import Callable, Dict, Iterable, List, Optional, Tuple
+from typing import Callable, Dict, Iterable, Optional, Tuple
 
 import grpc
 

@@ -12,7 +12,7 @@ of its own — the NodeInfo used-trees remain the single source of truth.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, FrozenSet, Iterable, List, Mapping, Optional, Tuple
+from typing import Dict, FrozenSet, Iterable, List, Tuple
 
 from kubegpu_tpu.types.info import ChipRef, NodeInfo
 from kubegpu_tpu.types.resource import LEAF_TPU

@@ -20,7 +20,7 @@ code, which belongs to the image, not the checkpoint.
 from __future__ import annotations
 
 import logging
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 import orbax.checkpoint as ocp

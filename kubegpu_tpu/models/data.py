@@ -15,7 +15,7 @@ enough to never be the bottleneck being measured.
 from __future__ import annotations
 
 import collections
-from typing import Iterable, Iterator, Optional, Tuple
+from typing import Iterable, Iterator, Tuple
 
 import jax
 import numpy as np

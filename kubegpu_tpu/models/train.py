@@ -9,7 +9,7 @@ injected) plus the same jit.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, Optional
 
 import flax.struct
 import jax

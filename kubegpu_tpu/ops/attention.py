@@ -8,10 +8,11 @@ long-context jobs; this is what those jobs run).  Two TPU-first designs:
   HBM; grid (batch*heads, q-blocks, k-blocks) with the sequential innermost
   grid dimension carrying running max/denominator in VMEM scratch; causal
   upper-triangle blocks are skipped outright (half the FLOPs).  MXU-shaped:
-  128-lane blocks, f32 accumulation via preferred_element_type.  Backward
-  is a recompute VJP (flash forward is O(s) memory; the backward recomputes
-  scores blockwise through the same kernel semantics via XLA einsum —
-  rematerialisation over HBM residuals, the standard TPU trade).
+  128-lane blocks, f32 accumulation via preferred_element_type.  The
+  backward is Pallas too (O(seq) memory end to end): dK/dV and dQ kernels
+  recompute p blockwise from the forward's saved logsumexp, so only
+  out+lse ride HBM as residuals — rematerialisation, the standard TPU
+  trade.
 
 - :func:`ring_attention` — sequence/context parallelism over a mesh axis:
   each device owns a query shard, K/V shards rotate around the ring via
@@ -34,7 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 NEG_INF = float("-inf")
 
