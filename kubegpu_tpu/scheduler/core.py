@@ -131,6 +131,25 @@ class Scheduler:
                         error="",
                     )
                 outcome = planned.plan
+            planned_slice = outcome.per_pod[pod.key].slice_id
+            if pod.slice_selector is not None and (
+                planned_slice is None
+                or planned_slice not in pod.slice_selector
+            ):
+                # planning used the FIRST member's selector; a member whose
+                # OWN selector excludes the planned slice (mixed-selector
+                # gang, or a recreated member with a new annotation) must
+                # fail loudly, never bind outside its pin
+                return FilterResult(
+                    failed={
+                        n: (
+                            f"gang plan places {pod.key} on slice "
+                            f"{planned_slice}, outside its slice-selector "
+                            f"{sorted(pod.slice_selector)}"
+                        )
+                        for n in node_names
+                    }
+                )
             target = outcome.per_pod[pod.key].node
             failed = {n: f"gang plan places {pod.key} on {target}" for n in node_names if n != target}
             nodes = [n for n in node_names if n == target]
@@ -159,6 +178,18 @@ class Scheduler:
             node = self.cache.node(name)
             if node is None:
                 result.failed[name] = "node not in scheduler cache"
+                continue
+            if pod.slice_selector is not None and (
+                node.slice_id is None
+                or node.slice_id not in pod.slice_selector
+            ):
+                # fail CLOSED: a slice-less node is outside every allowed
+                # slice — placing a pinned pod there would violate the
+                # tenant contract silently
+                result.failed[name] = (
+                    f"slice {node.slice_id} not in pod's slice-selector "
+                    f"{sorted(pod.slice_selector)}"
+                )
                 continue
             view = views.get(node.slice_id) if node.slice_id else None
             fit = plugin.fit(node, pod, view)
@@ -232,6 +263,12 @@ class Scheduler:
         slice; multi-slice layouts need joint cross-slice deficits that the
         per-slice victim search cannot model, so preemption is declined
         (None with an empty set => caller gives up)."""
+        if pod.slice_selector is not None:
+            allowed = (
+                set(pod.slice_selector)
+                if allowed is None
+                else allowed & pod.slice_selector
+            )
         if not pod.pod_group:
             return allowed
         layout = self.groups.layout_of(pod)

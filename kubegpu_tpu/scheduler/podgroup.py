@@ -120,6 +120,22 @@ class PodGroupRegistry:
             # lock held through reserve so the view cannot go stale under us
             with self.cache.lock:
                 views = self.cache.views()
+                if pod.slice_selector is not None:
+                    # tenant pinning: planning only ever sees the allowed
+                    # slices (the selector is gang-wide — members share it
+                    # via the same annotation)
+                    views = {
+                        sid: v
+                        for sid, v in views.items()
+                        if sid in pod.slice_selector
+                    }
+                    if not views:
+                        return PlanOutcome(
+                            reason=(
+                                f"gang {gk}: no advertised slice matches "
+                                f"slice-selector {sorted(pod.slice_selector)}"
+                            )
+                        )
                 layout: Dict[str, int] = {}
                 for sid in sched_slices.values():
                     if sid:

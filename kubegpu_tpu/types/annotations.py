@@ -32,6 +32,10 @@ POD_PRIORITY = f"{PREFIX}/priority"             # int, for preemption
 POD_MULTISLICE = f"{PREFIX}/multislice"         # "true" lets a gang span
                                                 # DCN-connected slices when no
                                                 # single slice fits it
+POD_SLICE_SELECTOR = f"{PREFIX}/slice-selector" # comma list of slice ids the
+                                                # pod/gang may be placed on
+                                                # (tenant pinning); absent =
+                                                # any slice
 # Pod side (written by the extender at bind, read by the CRI shim).
 POD_ASSIGNMENT = f"{PREFIX}/assignment"         # JSON: Assignment
 # Pod side (written by the extender for gang coordination/observability).
@@ -170,6 +174,11 @@ def pod_from_k8s(obj: dict, strict: bool = True) -> PodInfo:
         pod.pod_group_size = 1
     pod.require_contiguous = ann.get(POD_CONTIGUOUS, "true").lower() != "false"
     pod.allow_multislice = ann.get(POD_MULTISLICE, "false").lower() == "true"
+    selector = ann.get(POD_SLICE_SELECTOR, "").strip()
+    if selector:
+        pod.slice_selector = frozenset(
+            s.strip() for s in selector.split(",") if s.strip()
+        )
     try:
         pod.priority = int(ann.get(POD_PRIORITY, str(spec.get("priority", 0) or 0)))
     except ValueError:

@@ -109,6 +109,8 @@ class PodInfo:
     # opt-in: the gang may span DCN-connected slices when no single slice
     # fits it (grpalloc.multislice)
     allow_multislice: bool = False
+    # tenant pinning: slice ids placement may use (None = any slice)
+    slice_selector: Optional[frozenset] = None
 
     @property
     def key(self) -> str:

@@ -138,25 +138,35 @@ def two_slice_cluster():
     return api, slices
 
 
-def multislice_pod(name, chips, group, size):
+def pod_obj(name, chips, ann, subdomain=None):
+    spec = {
+        "containers": [
+            {"name": "main", "resources": {"limits": {RES_TPU: str(chips)}}}
+        ]
+    }
+    if subdomain:
+        spec["subdomain"] = subdomain
     return {
         "metadata": {
             "name": name,
             "namespace": "default",
             "uid": f"uid-{name}",
-            "annotations": {
-                annotations.POD_GROUP: group,
-                annotations.POD_GROUP_SIZE: str(size),
-                annotations.POD_MULTISLICE: "true",
-            },
+            "annotations": dict(ann),
         },
-        "spec": {
-            "subdomain": "ms-svc",
-            "containers": [
-                {"name": "main", "resources": {"limits": {RES_TPU: str(chips)}}}
-            ],
-        },
+        "spec": spec,
     }
+
+
+def multislice_pod(name, chips, group, size):
+    return pod_obj(
+        name, chips,
+        {
+            annotations.POD_GROUP: group,
+            annotations.POD_GROUP_SIZE: str(size),
+            annotations.POD_MULTISLICE: "true",
+        },
+        subdomain="ms-svc",
+    )
 
 
 def schedule_all(api, sched, pods):
@@ -428,6 +438,97 @@ def test_malformed_pending_sibling_keeps_gang_waiting():
     r = sched.filter(pods[0], names)
     assert not r.nodes
     assert any("waiting for members" in m for m in r.failed.values())
+
+
+# -- slice selectors (tenant pinning) ---------------------------------------
+
+def selector_pod(name, chips, slices, group=None, size=1, priority=0):
+    ann = {annotations.POD_SLICE_SELECTOR: ",".join(slices)}
+    if group:
+        ann[annotations.POD_GROUP] = group
+        ann[annotations.POD_GROUP_SIZE] = str(size)
+    if priority:
+        ann[annotations.POD_PRIORITY] = str(priority)
+    return pod_obj(name, chips, ann)
+
+
+def test_slice_selector_pins_plain_pod():
+    api, _ = two_slice_cluster()
+    sched = Scheduler(api, metrics=Metrics())
+    sched.cache.refresh()
+    obj = selector_pod("pinned", 2, ["sb"])
+    api.create_pod(obj)
+    names = sorted(n["metadata"]["name"] for n in api.list_nodes())
+    r = sched.filter(obj, names)
+    assert r.nodes and all(n.startswith("sb") for n in r.nodes)
+    assert any("slice-selector" in m for m in r.failed.values())
+
+
+def test_slice_selector_pins_gang():
+    api, _ = two_slice_cluster()
+    sched = Scheduler(api, metrics=Metrics())
+    sched.cache.refresh()
+    pods = [selector_pod(f"t{i}", 4, ["sb"], group="tb", size=4) for i in range(4)]
+    for obj in pods:
+        api.create_pod(obj)
+    schedule_all(api, sched, pods)
+    for i in range(4):
+        a = annotations.assignment_from_pod(api.get_pod("default", f"t{i}"))
+        assert a.slice_id == "sb"
+
+
+def test_slice_selector_unmatched_is_unschedulable_with_reason():
+    api, _ = two_slice_cluster()
+    sched = Scheduler(api, metrics=Metrics())
+    sched.cache.refresh()
+    pods = [selector_pod(f"u{i}", 4, ["nonexistent"], group="ug", size=2)
+            for i in range(2)]
+    for obj in pods:
+        api.create_pod(obj)
+    names = sorted(n["metadata"]["name"] for n in api.list_nodes())
+    r = sched.filter(pods[0], names)
+    assert not r.nodes
+    assert any("slice-selector" in m for m in r.failed.values())
+
+
+def test_mixed_selector_gang_member_fails_loudly_not_mispinned():
+    # t3's own selector excludes the slice its gang planned on: it must be
+    # held with a clear reason, never silently bound outside its pin
+    api, _ = two_slice_cluster()
+    sched = Scheduler(api, metrics=Metrics())
+    sched.cache.refresh()
+    pods = [selector_pod(f"x{i}", 4, ["sa"], group="xg", size=4) for i in range(3)]
+    odd = selector_pod("x3", 4, ["sb"], group="xg", size=4)
+    for obj in pods + [odd]:
+        api.create_pod(obj)
+    names = sorted(n["metadata"]["name"] for n in api.list_nodes())
+    # first member plans the gang (on sa, per ITS selector)
+    assert sched.filter(pods[0], names).nodes
+    r = sched.filter(odd, names)
+    assert not r.nodes
+    assert any("outside its slice-selector" in m for m in r.failed.values())
+
+
+def test_preemption_respects_slice_selector():
+    # low-priority tenants on BOTH slices; the high-priority pinned pod may
+    # only evict victims on ITS slice
+    api, _ = two_slice_cluster()
+    sched = Scheduler(api, metrics=Metrics())
+    sched.cache.refresh()
+    low = []
+    for sid in ("sa", "sb"):
+        for i in range(4):
+            obj = selector_pod(f"low-{sid}-{i}", 4, [sid], group=f"g{sid}",
+                               size=4, priority=1)
+            low.append(obj)
+            api.create_pod(obj)
+    schedule_all(api, sched, low)  # both slices now full
+    hi = selector_pod("hi", 4, ["sb"], priority=9)
+    api.create_pod(hi)
+    victims = sched.preemption_victims(hi)
+    victim_keys = {k for ks in victims.values() for k in ks}
+    assert victim_keys  # something must be evictable
+    assert all("low-sb" in k for k in victim_keys), victim_keys
 
 
 # -- hybrid workload mesh ---------------------------------------------------
