@@ -11,8 +11,10 @@ and its gang metadata, compute what the container must receive:
   fiddly — the variable set below is the jax.distributed standard:
   coordinator address + process count + process id, plus the TPU worker
   identity vars GKE sets):
-    TPU_WORKER_ID            index of this pod among its gang (sorted keys)
-    TPU_WORKER_HOSTNAMES     comma list of all workers' stable hostnames
+    TPU_WORKER_ID            index of this pod among its gang (sorted keys;
+                             slice-local index for multislice gangs)
+    TPU_WORKER_HOSTNAMES     comma list of workers' stable hostnames (the
+                             pod's own slice only, for multislice gangs)
     JAX_COORDINATOR_ADDRESS  worker 0's hostname:port
     JAX_NUM_PROCESSES / JAX_PROCESS_ID
 """
@@ -67,19 +69,44 @@ def worker_env(
     member_names: Sequence[str],
     subdomain: Optional[str] = None,
     coordinator_port: int = DEFAULT_COORDINATOR_PORT,
+    member_slices: Optional[Mapping[str, str]] = None,
 ) -> Dict[str, str]:
     """The multi-host rendezvous env for one gang member.  member_names are
     the gang's pod names; ordering is canonicalized here (sorted) so every
-    member derives the same worker table independently."""
+    member derives the same worker table independently.
+
+    The JAX_* process table is always gang-global (jax.distributed spans
+    slices over DCN).  The libtpu worker table (TPU_WORKER_ID /
+    TPU_WORKER_HOSTNAMES) is PER SLICE: each worker's id is its index within
+    its own slice and the hostname list covers only that slice's members —
+    cross-slice rendezvous rides MEGASCALE_* (multislice_env), and a
+    gang-global host list would make every slice's libtpu try to bootstrap
+    one ICI topology spanning DCN, hanging TPU init.  ``member_slices``
+    (pod name -> slice id) triggers the slice-local table when the gang
+    actually spans more than one slice."""
     names = sorted(member_names)
     if pod.name not in names:
         names = sorted(names + [pod.name])
     worker_id = names.index(pod.name)
     hostnames = [pod_hostname(n, subdomain, pod.namespace) for n in names]
     coordinator = f"{hostnames[0]}:{coordinator_port}"
+
+    local_names = names
+    if member_slices and len(set(member_slices.values())) > 1:
+        my_slice = member_slices.get(pod.name)
+        if my_slice is None:
+            raise InjectionError(
+                f"pod {pod.key}: multislice gang but no slice recorded for "
+                f"it ({sorted(member_slices)})"
+            )
+        local_names = sorted(
+            n for n in names if member_slices.get(n) == my_slice
+        )
     return {
-        "TPU_WORKER_ID": str(worker_id),
-        "TPU_WORKER_HOSTNAMES": ",".join(hostnames),
+        "TPU_WORKER_ID": str(local_names.index(pod.name)),
+        "TPU_WORKER_HOSTNAMES": ",".join(
+            pod_hostname(n, subdomain, pod.namespace) for n in local_names
+        ),
         "JAX_COORDINATOR_ADDRESS": coordinator,
         "JAX_NUM_PROCESSES": str(len(names)),
         "JAX_PROCESS_ID": str(worker_id),
@@ -148,7 +175,11 @@ def compute_injection(
     inj = Injection(env=dict(alloc.env), devices=list(alloc.devices), mounts=list(alloc.mounts))
     if pod.pod_group:
         members = list(member_names) if member_names is not None else [pod.name]
-        inj.env.update(worker_env(pod, members, subdomain=subdomain))
+        inj.env.update(
+            worker_env(
+                pod, members, subdomain=subdomain, member_slices=member_slices
+            )
+        )
         if member_slices:
             inj.env.update(
                 multislice_env(pod, member_slices, subdomain=subdomain)

@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
+import time
 from typing import Optional
 
 from kubegpu_tpu.plugins.provider import TpuProvider
@@ -46,7 +47,15 @@ class Advertiser:
         node = frag.to_node_info()
         n_healthy = sum(1 for ch in node.chips if ch.healthy)
         self.api.patch_node_annotations(
-            node.name, {annotations.NODE_TOPOLOGY: annotations.encode_node_topology(node)}
+            node.name,
+            {
+                annotations.NODE_TOPOLOGY: annotations.encode_node_topology(node),
+                # distinct value per cycle: lets the scheduler's failure
+                # detector tell "new advertisement, chip still absent" from
+                # "same stale annotation read twice" (strikes count the
+                # former only)
+                annotations.NODE_ADVERT_SEQ: str(time.time_ns()),
+            },
         )
         self.api.patch_node_capacity(node.name, {RES_TPU: str(n_healthy)})
         log.info(

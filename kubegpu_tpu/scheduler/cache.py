@@ -40,6 +40,12 @@ class ClusterCache:
         # binds in flight).  refresh() must carry these over — wiping them
         # would let the resync loop double-allocate chips under a live plan.
         self._assumed: set = set()
+        # pod key -> node name, for annotated pods whose node was NOT in the
+        # last refresh's LIST.  These never enter _assignments (no tree to
+        # charge), but the failure detector must still see them: a vanished
+        # node (dead advertiser, deregistered VM) is precisely the case
+        # where no future advertisement will ever evict the pod.
+        self._orphaned: Dict[str, str] = {}
 
     # -- building ---------------------------------------------------------
     def refresh(self) -> None:
@@ -57,6 +63,7 @@ class ClusterCache:
             self._nodes = {}
             self._assignments = {}
             self._assumed = set()
+            self._orphaned = {}
             for obj in nodes_raw:
                 try:
                     node = annotations.node_from_k8s(obj)
@@ -96,6 +103,7 @@ class ClusterCache:
         node = self._nodes.get(a.node)
         if node is None:
             log.warning("assignment for %s names unknown node %s", key, a.node)
+            self._orphaned[key] = a.node
             return
         try:
             take_pod_resources(node, a)
@@ -177,6 +185,11 @@ class ClusterCache:
     def assignments_snapshot(self) -> Dict[str, Assignment]:
         with self._lock:
             return dict(self._assignments)
+
+    def orphaned_assignments(self) -> Dict[str, str]:
+        """pod key -> vanished node name, as of the last refresh()."""
+        with self._lock:
+            return dict(self._orphaned)
 
     @property
     def lock(self) -> threading.RLock:

@@ -58,6 +58,7 @@ class Scheduler:
         gang_plan_ttl_s: float = 120.0,
         plugins: Optional[PluginRegistry] = None,
         evict_on_chip_failure: bool = True,
+        absent_grace: int = 2,
     ) -> None:
         self.api = api
         self.cache = cache or ClusterCache(api)
@@ -70,6 +71,15 @@ class Scheduler:
         # evicted so its controller recreates it and it re-schedules onto
         # healthy chips (gang members rejoin their gang's slice layout)
         self.evict_on_chip_failure = evict_on_chip_failure
+        # Eviction is irreversible, but "chip absent from an advertisement"
+        # and "node missing from a LIST" are not — a restarting advertiser
+        # or one truncated enumeration must not destroy a healthy running
+        # gang.  An explicitly-Unhealthy chip evicts immediately (positive
+        # signal); a merely-ABSENT chip or a vanished node must stay
+        # absent/vanished for `absent_grace` consecutive observations first.
+        self.absent_grace = max(1, absent_grace)
+        self._absent_chip_strikes: Dict[tuple, int] = {}
+        self._missing_node_strikes: Dict[tuple, int] = {}
 
     # -- filter -----------------------------------------------------------
     def filter(self, pod_obj: dict, node_names: List[str]) -> FilterResult:
@@ -491,10 +501,55 @@ class Scheduler:
         for key, a in self.cache.assignments_snapshot().items():
             for r in a.all_chips():
                 by_host.setdefault(r.host, []).append((key, r))
-        for obj in self.api.list_nodes():
+        # prune strike entries whose assignment is gone, so the maps stay
+        # O(live assignments) across a long-lived server
+        valid = {
+            (key, r.host, r.device_index)
+            for host_refs in by_host.values()
+            for key, r in host_refs
+        }
+        self._absent_chip_strikes = {
+            k: v for k, v in self._absent_chip_strikes.items() if k in valid
+        }
+        nodes_raw = self.api.list_nodes()
+        live = {(obj.get("metadata") or {}).get("name", "") for obj in nodes_raw}
+        for obj in nodes_raw:
             name = (obj.get("metadata") or {}).get("name", "")
             if name in by_host:
                 self._evict_on_dead_chips(obj, by_host[name])
+        # Total-failure mode the per-node sweep can never see: the node (or
+        # its advertiser) is GONE from the LIST entirely, so no future
+        # advertisement ever re-marks its chips unhealthy and its gangs
+        # would stay wedged forever.  refresh() records such pods as
+        # orphaned; evict them after the same grace window, since a node
+        # can blip out of one LIST.  A node that IS listed but whose
+        # topology annotation failed to decode also orphans its pods in the
+        # cache — that is version skew / corruption, not node loss, and
+        # must stay a warning, never an eviction.
+        orphans = {
+            key: host
+            for key, host in self.cache.orphaned_assignments().items()
+            if host not in live
+        }
+        self._missing_node_strikes = {
+            k: v
+            for k, v in self._missing_node_strikes.items()
+            if orphans.get(k[0]) == k[1]
+        }
+        for key, host in sorted(orphans.items()):
+            strikes = self._missing_node_strikes.get((key, host), 0) + 1
+            self._missing_node_strikes[(key, host)] = strikes
+            if strikes < self.absent_grace:
+                continue
+            del self._missing_node_strikes[(key, host)]
+            self._drop_gang_plan_of(key)
+            self._evict_pod(key)
+            self.metrics.inc("kubegpu_health_evictions_total")
+            log.warning(
+                "evicted %s: its node %s is no longer advertised "
+                "(%d consecutive resyncs)",
+                key, host, strikes,
+            )
 
     def on_pod_deleted(self, pod_obj: dict) -> None:
         try:
@@ -551,13 +606,38 @@ class Scheduler:
             ]
         present = {ch.device_index for ch in node.chips}
         dead = {ch.device_index for ch in node.chips if not ch.healthy}
-        victims = sorted(
-            {
-                key
-                for key, r in host_refs
-                if r.device_index in dead or r.device_index not in present
-            }
+        # strikes count distinct ADVERTISEMENTS showing the chip absent, not
+        # observations: the resync loop re-reading one stale truncated
+        # annotation (or a watch event plus the next resync double-counting
+        # the same write) must not accumulate toward eviction.  The
+        # advertiser bumps NODE_ADVERT_SEQ every cycle; fall back to the
+        # topology payload itself for third-party advertisers.
+        ann = (node_obj.get("metadata") or {}).get("annotations") or {}
+        fingerprint = ann.get(
+            annotations.NODE_ADVERT_SEQ, ann.get(annotations.NODE_TOPOLOGY, "")
         )
+        victim_set = set()
+        for key, r in host_refs:
+            strike_key = (key, node.name, r.device_index)
+            if r.device_index in dead:
+                # explicit Unhealthy report: positive signal, evict now
+                victim_set.add(key)
+                self._absent_chip_strikes.pop(strike_key, None)
+            elif r.device_index not in present:
+                # absence is ambiguous (advertiser restart, truncated
+                # enumeration): require absent_grace distinct advertisements
+                strikes, last_fp = self._absent_chip_strikes.get(
+                    strike_key, (0, None)
+                )
+                if last_fp != fingerprint:
+                    strikes += 1
+                    self._absent_chip_strikes[strike_key] = (strikes, fingerprint)
+                if strikes >= self.absent_grace:
+                    victim_set.add(key)
+                    del self._absent_chip_strikes[strike_key]
+            else:
+                self._absent_chip_strikes.pop(strike_key, None)
+        victims = sorted(victim_set)
         for key in victims:
             # invalidate the victim's live gang plan FIRST: a stale plan
             # would rebind the recreated member onto the exact dead chip,

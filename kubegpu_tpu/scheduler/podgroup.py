@@ -22,7 +22,7 @@ from kubegpu_tpu.grpalloc import fit_gang_multislice
 from kubegpu_tpu.grpalloc.multislice import fit_gang_into_layout
 from kubegpu_tpu.scheduler.cache import ClusterCache
 from kubegpu_tpu.types import annotations
-from kubegpu_tpu.types.info import Assignment, PodInfo
+from kubegpu_tpu.types.info import Assignment, PodInfo, TpuRequest
 
 log = logging.getLogger(__name__)
 
@@ -140,6 +140,31 @@ class PodGroupRegistry:
                 for sid in sched_slices.values():
                     if sid:
                         layout[sid] = layout.get(sid, 0) + 1
+                # Anchored-refit math assumes every scheduled CHIP member is
+                # counted in the layout.  A member whose slice cannot be
+                # recovered (assignment annotation cleared mid-eviction, no
+                # cache reservation) would silently undercount — and when
+                # ALL scheduled members are unrecoverable (scheduler restart
+                # mid-gang-eviction) the layout is empty and a fresh plan
+                # would bind replacements to arbitrary slices, diverging
+                # from the still-Terminating siblings' baked-in env.  Fail
+                # with the real reason in both cases so the operator sees
+                # why replacements are not being placed.
+                lost = sorted(
+                    p.key
+                    for p in scheduled
+                    if sched_slices.get(p.key) is None
+                    and TpuRequest.from_pod(p).total_chips > 0
+                )
+                if lost:
+                    return PlanOutcome(
+                        reason=(
+                            f"gang {gk}: scheduled member(s) "
+                            f"{', '.join(lost)} have no recoverable "
+                            "slice (assignment cleared mid-eviction?); "
+                            "replacements wait until they fully disappear"
+                        )
+                    )
                 if layout:
                     # partially-bound gang: replacements must rejoin the
                     # existing slice layout — the running siblings'

@@ -21,6 +21,10 @@ PREFIX = "kubegpu-tpu"
 
 # Node side (written by the advertiser daemon, read by the scheduler cache).
 NODE_TOPOLOGY = f"{PREFIX}/topology"            # JSON: slice fragment owned by host
+# Advertisement generation marker, bumped on every advertise cycle.  The
+# failure detector counts ABSENT-chip strikes per distinct advertisement —
+# re-reading one stale truncated annotation must not accumulate strikes.
+NODE_ADVERT_SEQ = f"{PREFIX}/advertised-at"
 # Node side (written by a generic device daemon for non-TPU device types
 # served by a DeviceSchedulerPlugin, SURVEY.md §2 #5): flat {path: qty}.
 NODE_GROUPED_CAPACITY = f"{PREFIX}/grouped-capacity"

@@ -100,6 +100,13 @@ class InMemoryApiServer(ApiServer):
             self._nodes[name] = copy.deepcopy(obj)
             self._emit("node-updated", self._nodes[name])
 
+    def delete_node(self, name: str) -> None:
+        """Node deregistration (the total-failure mode resync() sweeps for)."""
+        with self._lock:
+            node = self._nodes.pop(name, None)
+        if node is not None:
+            self._emit("node-deleted", node)
+
     def list_nodes(self) -> List[dict]:
         with self._lock:
             return [copy.deepcopy(n) for n in self._nodes.values()]

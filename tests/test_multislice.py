@@ -256,6 +256,69 @@ def test_crishim_injects_megascale_for_multislice_gang():
     assert inj.env["TPU_VISIBLE_CHIPS"]
 
 
+def test_multislice_worker_table_is_slice_local():
+    # ADVICE r1 (high): the libtpu worker table is PER SLICE — a gang-global
+    # TPU_WORKER_HOSTNAMES would make every slice's libtpu bootstrap one ICI
+    # topology spanning DCN and hang at TPU init.  JAX_* stays gang-global.
+    from kubegpu_tpu.crishim.inject import worker_env
+
+    member_slices = {"m0": "sa", "m1": "sa", "m2": "sb", "m3": "sb"}
+    members = sorted(member_slices)
+    envs = {}
+    for name in members:
+        pod = PodInfo(name=name, namespace="default", pod_group="ms")
+        envs[name] = worker_env(pod, members, member_slices=member_slices)
+    # slice-local table: ids restart at 0 per slice, hostnames list only
+    # the pod's own slice's members
+    assert envs["m0"]["TPU_WORKER_ID"] == "0"
+    assert envs["m1"]["TPU_WORKER_ID"] == "1"
+    assert envs["m2"]["TPU_WORKER_ID"] == "0"  # first on slice sb
+    assert envs["m3"]["TPU_WORKER_ID"] == "1"
+    assert envs["m2"]["TPU_WORKER_HOSTNAMES"] == "m2,m3"
+    assert envs["m0"]["TPU_WORKER_HOSTNAMES"] == "m0,m1"
+    # jax.distributed spans slices over DCN: global table unchanged
+    for name in members:
+        assert envs[name]["JAX_NUM_PROCESSES"] == "4"
+        assert envs[name]["JAX_PROCESS_ID"] == str(members.index(name))
+    assert len({e["JAX_COORDINATOR_ADDRESS"] for e in envs.values()}) == 1
+    # single-slice gang: global and local tables coincide (no regression)
+    env = worker_env(
+        PodInfo(name="m1", namespace="default", pod_group="g"),
+        ["m0", "m1"],
+        member_slices={"m0": "sa", "m1": "sa"},
+    )
+    assert env["TPU_WORKER_HOSTNAMES"] == "m0,m1"
+    assert env["TPU_WORKER_ID"] == "1"
+
+
+def test_crishim_multislice_injection_has_slice_local_table():
+    # end-to-end through the shim: a scheduled 2-slice gang member's
+    # injected TPU_WORKER_HOSTNAMES covers exactly its own slice's members
+    api, slices = two_slice_cluster()
+    sched = Scheduler(api, metrics=Metrics())
+    sched.cache.refresh()
+    pods = [multislice_pod(f"m{i}", 4, "ms", 8) for i in range(8)]
+    for obj in pods:
+        api.create_pod(obj)
+    schedule_all(api, sched, pods)
+    by_slice: Dict[str, set] = {}
+    for i in range(8):
+        a = annotations.assignment_from_pod(api.get_pod("default", f"m{i}"))
+        by_slice.setdefault(a.slice_id, set()).add(f"m{i}")
+    a0 = annotations.assignment_from_pod(api.get_pod("default", "m0"))
+    daemon = ShimDaemon(api, slices[a0.slice_id].provider_for(a0.node))
+    inj = daemon.decide(
+        "default", "m0", "main",
+        api.get_pod("default", "m0")["metadata"]["annotations"], "m0",
+    )
+    hosts = inj.env["TPU_WORKER_HOSTNAMES"].split(",")
+    local = by_slice[a0.slice_id]
+    assert len(hosts) == len(local) == 4
+    assert {h.split(".")[0] for h in hosts} == local
+    assert int(inj.env["TPU_WORKER_ID"]) < 4
+    assert inj.env["JAX_NUM_PROCESSES"] == "8"
+
+
 def test_crishim_refuses_partial_multislice_table():
     api, slices = two_slice_cluster()
     sched = Scheduler(api, metrics=Metrics())
@@ -276,6 +339,97 @@ def test_crishim_refuses_partial_multislice_table():
             "default", "m0", "main",
             api.get_pod("default", "m0")["metadata"]["annotations"], "m0",
         )
+
+
+def test_unrecoverable_member_slice_fails_with_explicit_reason():
+    # ADVICE r1: a bound member whose slice cannot be recovered (assignment
+    # annotation cleared mid-eviction, no cache reservation) must fail the
+    # anchored re-plan with the REAL reason, not a misleading "cannot split
+    # equally" from undercounted layout math
+    api, _ = two_slice_cluster()
+    sched = Scheduler(api, metrics=Metrics())
+    sched.cache.refresh()
+    pods = [multislice_pod(f"m{i}", 4, "ms", 8) for i in range(8)]
+    for obj in pods:
+        api.create_pod(obj)
+    schedule_all(api, sched, pods)
+    # m3 fully evicted; m4 caught mid-eviction: annotation cleared but the
+    # pod lingers bound (Terminating on a real cluster)
+    api.delete_pod("default", "m3")
+    api.patch_pod_annotations("default", "m4", {annotations.POD_ASSIGNMENT: ""})
+    sched.cache.refresh()
+    replacement = multislice_pod("m3", 4, "ms", 8)
+    api.create_pod(replacement)
+    names = sorted(n["metadata"]["name"] for n in api.list_nodes())
+    r = sched.filter(replacement, names)
+    assert not r.nodes
+    msgs = list(r.failed.values())
+    assert any("no recoverable slice" in m and "m4" in m for m in msgs), msgs
+    assert not any("split equally" in m for m in msgs)
+
+
+def test_replacement_waits_when_home_slice_chips_were_taken():
+    # code-review r2 regression: with the anchored path accidentally dead,
+    # a replacement whose gang's home-slice chips were snatched by a
+    # competitor was freshly planned onto the OTHER slice instead of
+    # waiting.  Correct behavior: the anchored refit fails loudly.
+    api, _ = two_slice_cluster()
+    sched = Scheduler(api, metrics=Metrics())
+    sched.cache.refresh()
+    pods = [multislice_pod(f"g{i}", 4, "sg", 4) for i in range(4)]
+    for obj in pods:
+        api.create_pod(obj)
+    schedule_all(api, sched, pods)
+    home = annotations.assignment_from_pod(api.get_pod("default", "g0")).slice_id
+    api.delete_pod("default", "g2")
+    sched.cache.refresh()
+    # competitor pinned to the home slice takes the freed chips
+    competitor = pod_obj(
+        "thief", 4, {annotations.POD_SLICE_SELECTOR: home}
+    )
+    api.create_pod(competitor)
+    names = sorted(n["metadata"]["name"] for n in api.list_nodes())
+    r = sched.filter(competitor, names)
+    assert r.nodes, r.failed
+    assert sched.bind("default", "thief", r.nodes[0]) is None
+    # the gang replacement must NOT drift to the other (empty) slice
+    replacement = multislice_pod("g2", 4, "sg", 4)
+    api.create_pod(replacement)
+    r = sched.filter(replacement, names)
+    assert not r.nodes, (
+        f"replacement was planned onto "
+        f"{ {annotations.assignment_from_pod(api.get_pod('default', 'g2'))} }"
+    )
+    assert any("cannot rejoin" in m or home in m for m in r.failed.values()), r.failed
+
+
+def test_all_members_unrecoverable_still_waits():
+    # code-review r2: scheduler restart mid-gang-eviction — EVERY bound
+    # member's annotation was cleared, so the recoverable layout is empty.
+    # A fresh plan would bind replacements to arbitrary slices, diverging
+    # from the Terminating siblings; the plan must wait instead.
+    api, _ = two_slice_cluster()
+    sched = Scheduler(api, metrics=Metrics())
+    sched.cache.refresh()
+    pods = [multislice_pod(f"m{i}", 4, "ms", 8) for i in range(8)]
+    for obj in pods:
+        api.create_pod(obj)
+    schedule_all(api, sched, pods)
+    api.delete_pod("default", "m3")
+    for i in range(8):
+        if i != 3:
+            api.patch_pod_annotations(
+                "default", f"m{i}", {annotations.POD_ASSIGNMENT: ""}
+            )
+    # restart: a new scheduler has no cache reservations to recover slices
+    sched2 = Scheduler(api, metrics=Metrics())
+    sched2.cache.refresh()
+    replacement = multislice_pod("m3", 4, "ms", 8)
+    api.create_pod(replacement)
+    names = sorted(n["metadata"]["name"] for n in api.list_nodes())
+    r = sched2.filter(replacement, names)
+    assert not r.nodes
+    assert any("no recoverable slice" in m for m in r.failed.values()), r.failed
 
 
 # -- partial re-plan anchoring ----------------------------------------------

@@ -451,6 +451,135 @@ def test_chip_death_leaves_unrelated_pods_alone():
     assert annotations.assignment_from_pod(api.get_pod("default", "solo"))
 
 
+def _advertise_without_chip(api, host, device_index, seq):
+    """Re-advertise `host` with one chip silently MISSING from the tree (an
+    advertiser restart / truncated enumeration), not marked unhealthy."""
+    import dataclasses
+
+    obj = api.get_node(host)
+    node = annotations.node_from_k8s(obj)
+    node = dataclasses.replace(
+        node, chips=[c for c in node.chips if c.device_index != device_index]
+    )
+    api.patch_node_annotations(
+        host,
+        {
+            annotations.NODE_TOPOLOGY: annotations.encode_node_topology(node),
+            annotations.NODE_ADVERT_SEQ: str(seq),
+        },
+    )
+    return api.get_node(host)
+
+
+def test_absent_chip_needs_strikes_from_distinct_advertisements():
+    # ADVICE r1: absence is ambiguous (advertiser restart) while eviction is
+    # irreversible — one short advertisement must not kill a healthy pod,
+    # and RE-READING the same stale advertisement (resync re-ticks, watch +
+    # resync double-observation) must not accumulate strikes either
+    api, fs, advs = fake_cluster()
+    sched = make_sched(api)
+    obj = pod_obj("solo", 1)
+    api.create_pod(obj)
+    r = sched.filter(obj, nodes_of(api))
+    assert sched.bind("default", "solo", r.nodes[0]) is None
+    a = annotations.assignment_from_pod(api.get_pod("default", "solo"))
+    ref = a.all_chips()[0]
+    # 1st short advertisement: pod survives
+    node_obj = _advertise_without_chip(api, ref.host, ref.device_index, seq=1)
+    sched.on_node_updated(node_obj)
+    assert annotations.assignment_from_pod(api.get_pod("default", "solo"))
+    # the SAME advertisement observed again (stale annotation re-read):
+    # still one strike, pod survives
+    sched.on_node_updated(node_obj)
+    sched.on_node_updated(node_obj)
+    assert annotations.assignment_from_pod(api.get_pod("default", "solo"))
+    # advertiser recovers (full tree, fresh seq): strike resets
+    advs[ref.host].advertise_once()
+    sched.on_node_updated(api.get_node(ref.host))
+    node_obj = _advertise_without_chip(api, ref.host, ref.device_index, seq=2)
+    sched.on_node_updated(node_obj)
+    assert annotations.assignment_from_pod(api.get_pod("default", "solo"))
+    # a SECOND DISTINCT advertisement still missing the chip: now it's real
+    node_obj = _advertise_without_chip(api, ref.host, ref.device_index, seq=3)
+    sched.on_node_updated(node_obj)
+    from kubegpu_tpu.utils.apiserver import NotFound
+    with pytest.raises(NotFound):
+        api.get_pod("default", "solo")
+
+
+def test_undecodable_node_annotation_is_not_node_loss():
+    # code-review r2: a node that IS listed but whose topology annotation
+    # fails to decode orphans its pods in the cache exactly like a vanished
+    # node — that is version skew, not node loss, and must never evict
+    api, fs, advs = fake_cluster()
+    sched = make_sched(api)
+    obj = pod_obj("solo", 1)
+    api.create_pod(obj)
+    r = sched.filter(obj, nodes_of(api))
+    assert sched.bind("default", "solo", r.nodes[0]) is None
+    a = annotations.assignment_from_pod(api.get_pod("default", "solo"))
+    api.patch_node_annotations(a.node, {annotations.NODE_TOPOLOGY: "{corrupt"})
+    for _ in range(4):  # well past any grace window
+        sched.resync()
+    assert annotations.assignment_from_pod(api.get_pod("default", "solo"))
+
+
+def test_explicit_unhealthy_chip_still_evicts_immediately():
+    api, fs, advs = fake_cluster()
+    sched = make_sched(api)
+    obj = pod_obj("solo", 1)
+    api.create_pod(obj)
+    r = sched.filter(obj, nodes_of(api))
+    assert sched.bind("default", "solo", r.nodes[0]) is None
+    a = annotations.assignment_from_pod(api.get_pod("default", "solo"))
+    ref = a.all_chips()[0]
+    fs.kill_chip(ref.coords)
+    advs[ref.host].advertise_once()
+    sched.on_node_updated(api.get_node(ref.host))
+    from kubegpu_tpu.utils.apiserver import NotFound
+    with pytest.raises(NotFound):
+        api.get_pod("default", "solo")
+
+
+def test_vanished_node_evicts_assignments_after_grace():
+    # ADVICE r1: a node deleted from the API (advertiser dead, no final
+    # unhealthy report) must not wedge its pods forever — resync() diffs
+    # assignment hosts against live nodes and evicts after the grace window
+    api, fs, advs = fake_cluster()
+    sched = make_sched(api)
+    obj = pod_obj("solo", 1)
+    api.create_pod(obj)
+    r = sched.filter(obj, nodes_of(api))
+    assert sched.bind("default", "solo", r.nodes[0]) is None
+    a = annotations.assignment_from_pod(api.get_pod("default", "solo"))
+    api.delete_node(a.node)
+    sched.resync()  # strike 1: grace
+    assert annotations.assignment_from_pod(api.get_pod("default", "solo"))
+    sched.resync()  # strike 2: evict
+    from kubegpu_tpu.utils.apiserver import NotFound
+    with pytest.raises(NotFound):
+        api.get_pod("default", "solo")
+    assert sched.metrics.get("kubegpu_health_evictions_total") == 1
+
+
+def test_node_blip_does_not_evict():
+    api, fs, advs = fake_cluster()
+    sched = make_sched(api)
+    obj = pod_obj("solo", 1)
+    api.create_pod(obj)
+    r = sched.filter(obj, nodes_of(api))
+    assert sched.bind("default", "solo", r.nodes[0]) is None
+    a = annotations.assignment_from_pod(api.get_pod("default", "solo"))
+    node_obj = api.get_node(a.node)
+    api.delete_node(a.node)
+    sched.resync()  # strike 1
+    api.add_node(node_obj)  # node comes back
+    sched.resync()  # strike reset
+    api.delete_node(a.node)
+    sched.resync()  # strike 1 again — still within grace
+    assert annotations.assignment_from_pod(api.get_pod("default", "solo"))
+
+
 def test_pod_delete_returns_chips():
     api, _, _ = fake_cluster()
     sched = make_sched(api)
