@@ -3,10 +3,17 @@
 
 PY ?= python
 
-.PHONY: test native bench dryrun image clean
+.PHONY: test test-slow test-all native bench dryrun image clean
 
+# fast half: control plane + wire protocols, seconds (default pytest run)
 test: native
 	$(PY) -m pytest tests/ -x -q
+
+# slow half: JAX compile-heavy workload tests on the 8-dev CPU mesh (~15 min)
+test-slow:
+	$(PY) -m pytest tests/ -x -q -m slow
+
+test-all: test test-slow
 
 native:
 	$(MAKE) -C native

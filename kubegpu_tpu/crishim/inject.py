@@ -99,9 +99,9 @@ def worker_env(
                 f"pod {pod.key}: multislice gang but no slice recorded for "
                 f"it ({sorted(member_slices)})"
             )
-        local_names = sorted(
-            n for n in names if member_slices.get(n) == my_slice
-        )
+        # names is already canonically sorted; filtering preserves it, so
+        # the slice-local table inherits the global ordering
+        local_names = [n for n in names if member_slices.get(n) == my_slice]
     return {
         "TPU_WORKER_ID": str(local_names.index(pod.name)),
         "TPU_WORKER_HOSTNAMES": ",".join(

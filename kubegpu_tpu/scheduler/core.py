@@ -78,7 +78,9 @@ class Scheduler:
         # signal); a merely-ABSENT chip or a vanished node must stay
         # absent/vanished for `absent_grace` consecutive observations first.
         self.absent_grace = max(1, absent_grace)
-        self._absent_chip_strikes: Dict[tuple, int] = {}
+        # (pod key, node, device_index) -> (strikes, advertisement fingerprint)
+        self._absent_chip_strikes: Dict[tuple, Tuple[int, str]] = {}
+        # (pod key, node) -> consecutive resyncs the node was missing
         self._missing_node_strikes: Dict[tuple, int] = {}
 
     # -- filter -----------------------------------------------------------

@@ -2,6 +2,8 @@
 the elastic-recovery story (SURVEY.md §5.4): train, save, kill, restart,
 restore into the restart mesh's shardings, resume at the saved step."""
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -18,6 +20,8 @@ from kubegpu_tpu.models.checkpoint import (
     save_checkpoint,
 )
 from kubegpu_tpu.parallel import device_mesh
+
+pytestmark = pytest.mark.slow  # JAX compile-heavy; run with -m slow
 
 
 def _tiny_setup(mesh, seed=0):

@@ -12,6 +12,8 @@ import textwrap
 
 import pytest
 
+pytestmark = pytest.mark.slow  # JAX compile-heavy; run with -m slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -1,11 +1,15 @@
 """KV-cache decoding tests: the DecodeLM twin must accept TransformerLM
 checkpoints verbatim and reproduce its next-token choices."""
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from kubegpu_tpu.models import DecodeLM, TransformerLM, greedy_generate
+
+pytestmark = pytest.mark.slow  # JAX compile-heavy; run with -m slow
 
 CFG = dict(vocab_size=61, num_layers=2, num_heads=4, hidden=32, max_seq=32)
 

@@ -24,6 +24,8 @@ from kubegpu_tpu.parallel import (
 )
 from kubegpu_tpu.types.info import Assignment, ChipRef
 
+pytestmark = pytest.mark.slow  # JAX compile-heavy; run with -m slow
+
 
 def tiny_resnet():
     return ResNet(stage_sizes=(1, 1), num_filters=8, num_classes=10)

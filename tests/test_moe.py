@@ -19,6 +19,8 @@ from kubegpu_tpu.models import (
 from kubegpu_tpu.parallel import MOE_EP_RULES, device_mesh, param_shardings
 from kubegpu_tpu.parallel.sharding import spec_for_param
 
+pytestmark = pytest.mark.slow  # JAX compile-heavy; run with -m slow
+
 
 def test_moe_matches_dense_mlp_with_identical_experts():
     """With no capacity drops and all experts holding the SAME weights, the

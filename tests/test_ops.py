@@ -13,6 +13,8 @@ from kubegpu_tpu.ops import (
     ring_attention_sharded,
 )
 
+pytestmark = pytest.mark.slow  # JAX compile-heavy; run with -m slow
+
 
 def qkv(b=2, s=128, h=2, d=32, dtype=jnp.float32, seed=0):
     ks = jax.random.split(jax.random.PRNGKey(seed), 3)

@@ -21,6 +21,8 @@ from kubegpu_tpu.models.pipeline_lm import (
 from kubegpu_tpu.parallel import device_mesh
 from kubegpu_tpu.parallel.pipeline import pipeline_apply
 
+pytestmark = pytest.mark.slow  # JAX compile-heavy; run with -m slow
+
 
 def _mesh(n):
     return device_mesh({"pipe": n}, devices=jax.devices()[:n])
