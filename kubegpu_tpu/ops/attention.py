@@ -166,6 +166,9 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
 
     qf, kf, vf = fold(q), fold(k), fold(v)
+    # under shard_map with vma checking, pallas outputs must declare which
+    # mesh axes they vary over: the join of the inputs'
+    vma = jax.typeof(qf).vma | jax.typeof(kf).vma | jax.typeof(vf).vma
     grid = (b * h, sqp // block_q, skp // block_k)
     kernel = functools.partial(
         _flash_kernel, sm_scale=sm_scale, causal=causal,
@@ -184,9 +187,9 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, 1, 8, block_q), lambda bh, qi, ki: (bh, qi, 0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, sqp, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sqp, d), q.dtype, vma=vma),
             jax.ShapeDtypeStruct(
-                (b * h, sqp // block_q, 8, block_q), jnp.float32
+                (b * h, sqp // block_q, 8, block_q), jnp.float32, vma=vma
             ),
         ],
         scratch_shapes=[
@@ -348,6 +351,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
 
     bh = b * h
     nq, nk = sqp // block_q, skp // block_k
+    vma = (jax.typeof(qf).vma | jax.typeof(kf).vma | jax.typeof(vf).vma
+           | jax.typeof(dof).vma | jax.typeof(of).vma | jax.typeof(lse).vma)
     qspec3 = pl.BlockSpec((1, block_q, d), lambda bhi, ki, qi: (bhi, qi, 0))
     lspec = pl.BlockSpec((1, 1, 8, block_q), lambda bhi, ki, qi: (bhi, qi, 0, 0))
     kspec3 = pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0))
@@ -363,8 +368,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, skp, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, skp, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, skp, d), k.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, skp, d), v.dtype, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -388,7 +393,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sqp, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, sqp, d), q.dtype, vma=vma),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(qf, dof, of, lse, kf, vf)
@@ -425,18 +430,42 @@ flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
 # ---------------------------------------------------------------------------
+# lse layout helpers (between the packed kernel layout and a dense vector)
+# ---------------------------------------------------------------------------
+
+def _lse_unpack(packed, b, h, s, block_q):
+    """Kernel lse layout (b*h, s//block_q, 8, block_q) -> dense (b, s, h)
+    f32 (BSH, matching the BSHD tensors it normalizes)."""
+    dense = packed[:, :, 0, :].reshape(b, h, s)
+    return dense.transpose(0, 2, 1)
+
+
+def _lse_pack(dense, block_q):
+    """Dense (b, s, h) f32 -> the kernel layout the backward kernels read."""
+    b, s, h = dense.shape
+    x = dense.transpose(0, 2, 1).reshape(b * h, s // block_q, 1, block_q)
+    return jnp.broadcast_to(x, (b * h, s // block_q, 8, block_q))
+
+
+def _stamp(x, *refs):
+    """Add a zero derived from `refs` so `x` carries their shard_map
+    varying-axes (vma) type.  Constant/zero initializers are device-invariant
+    by construction; folding them with per-device values needs the vma sets
+    to agree, whatever mesh axes the inputs vary over."""
+    z = jnp.zeros((), x.dtype)
+    for r in refs:
+        z = z + jnp.sum(r).astype(x.dtype) * 0
+    return x + z
+
+
+# ---------------------------------------------------------------------------
 # Ring attention (context parallelism over a mesh axis)
 # ---------------------------------------------------------------------------
 
-def ring_attention(q, k, v, axis_name: str, causal: bool = True):
-    """Blockwise ring attention for sequence shards.  Call INSIDE shard_map:
-    q/k/v are this device's (batch, seq_local, heads, head_dim) shards of a
-    sequence sharded over `axis_name`; K/V rotate one ICI hop per step while
-    the online-softmax state folds each incoming block.
-
-    Equivalent to full attention over the global sequence (causal masking
-    uses global positions); memory per chip O(seq_local), comms 2·(ring-1)
-    neighbour exchanges riding ICI."""
+def _ring_attention_einsum(q, k, v, axis_name: str, causal: bool = True):
+    """Einsum-bodied ring attention — the fallback path for shard shapes the
+    Pallas blocks can't tile (see :func:`ring_attention`), and the numerics
+    oracle for the flash-bodied ring.  O(s_loc^2) f32 transient per step."""
     size = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
@@ -476,12 +505,11 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
 
     # constants are device-invariant to shard_map's varying-axes typing, but
     # the folded carries vary over every axis q varies over (the ring axis,
-    # plus any batch axis of a DP x CP mesh) — adding a zero derived from q
-    # stamps exactly that set onto the initializers, whatever the mesh
-    vma_zero = jnp.sum(qf) * 0.0
-    o0 = jnp.zeros((b, h, s_loc, d), jnp.float32) + vma_zero
-    m0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32) + vma_zero
-    l0 = jnp.zeros((b, h, s_loc), jnp.float32) + vma_zero
+    # plus any batch axis of a DP x CP mesh) — _stamp marks exactly that set
+    # onto the initializers, whatever the mesh
+    o0 = _stamp(jnp.zeros((b, h, s_loc, d), jnp.float32), qf)
+    m0 = _stamp(jnp.full((b, h, s_loc), NEG_INF, jnp.float32), qf)
+    l0 = _stamp(jnp.zeros((b, h, s_loc), jnp.float32), qf)
     # scan rotates size-1 times; the last resident block folds outside so no
     # dead final exchange is issued (2*(size-1) hops total, as documented)
     (o, m, l, k_last, v_last), _ = jax.lax.scan(
@@ -493,9 +521,186 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
     return out.astype(q.dtype)
 
 
+def _ring_block_sizes(s_loc: int) -> Optional[tuple]:
+    """Pallas block sizes for a ring shard, or None when the shard can't be
+    tiled without padding (padding inside the ring would corrupt the global
+    position bookkeeping — those shapes take the einsum fallback)."""
+    if s_loc <= 128:
+        return s_loc, s_loc
+    if s_loc % 128 == 0:
+        return 128, 128
+    return None
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, block_q, block_k):
+    """Forward ring pass with the Pallas flash kernel as the per-block body.
+
+    Each resident K/V block is one flash_forward call (causal on the
+    diagonal step, unmasked on fully-visible steps, skipped on fully-masked
+    steps — lax.switch on the device-varying block owner, so each core only
+    runs the kernel it needs); partial (out, lse) pairs fold with the
+    logsumexp algebra.  Per-chip memory O(s_loc): no (s_loc, s_loc) array
+    ever exists outside VMEM."""
+    size = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    perm = [(i, (i + 1) % size) for i in range(size)]
+
+    def block_partial(k_cur, v_cur, step):
+        def run(causal_flag):
+            def f(kv):
+                o, lse_p = _flash_forward(
+                    q, kv[0], kv[1], causal_flag, block_q, block_k, None
+                )
+                return (
+                    o.astype(jnp.float32),
+                    _lse_unpack(lse_p, b, h, s_loc, block_q),
+                )
+            return f
+
+        if not causal:
+            return run(False)((k_cur, v_cur))
+
+        def skip(kv):
+            o = _stamp(jnp.zeros((b, s_loc, h, d), jnp.float32), q, kv[0], kv[1])
+            lse = _stamp(jnp.full((b, s_loc, h), NEG_INF, jnp.float32),
+                         q, kv[0], kv[1])
+            return o, lse
+
+        src = (my - step) % size
+        idx = jnp.where(src == my, 1, jnp.where(src < my, 0, 2))
+        return jax.lax.switch(idx, (run(False), run(True), skip), (k_cur, v_cur))
+
+    def fold(o_acc, lse_acc, o_blk, lse_blk):
+        lse_new = jnp.logaddexp(lse_acc, lse_blk)
+        shift = jnp.where(jnp.isfinite(lse_new), lse_new, 0.0)
+        w_acc = jnp.where(jnp.isfinite(lse_acc), jnp.exp(lse_acc - shift), 0.0)
+        w_blk = jnp.where(jnp.isfinite(lse_blk), jnp.exp(lse_blk - shift), 0.0)
+        o_new = o_acc * w_acc[..., None] + o_blk * w_blk[..., None]
+        return o_new, lse_new
+
+    o0 = _stamp(jnp.zeros((b, s_loc, h, d), jnp.float32), q, k, v)
+    lse0 = _stamp(jnp.full((b, s_loc, h), NEG_INF, jnp.float32), q, k, v)
+
+    def body(carry, step):
+        o, lse, k_cur, v_cur = carry
+        o, lse = fold(o, lse, *block_partial(k_cur, v_cur, step))
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o, lse, k_nxt, v_nxt), None
+
+    (o, lse, k_last, v_last), _ = jax.lax.scan(
+        body, (o0, lse0, k, v), jnp.arange(size - 1)
+    )
+    o, lse = fold(o, lse, *block_partial(k_last, v_last, size - 1))
+    return o.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_attention_flash(q, k, v, axis_name, causal, block_q, block_k):
+    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, block_q, block_k)
+    return out
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, block_q, block_k):
+    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, causal, block_q, block_k, res, g):
+    """Ring backward that RE-ROTATES K/V instead of saving per-step copies:
+    residuals are only the local (q, k, v, out, lse).  dK/dV accumulators
+    travel the ring alongside their blocks, so after `size` hops each block
+    arrives home carrying every device's contribution; dQ accumulates
+    locally.  Per-block gradients are the Pallas backward kernels, p
+    recomputed from the GLOBAL lse (partial contributions need no
+    renormalization)."""
+    q, k, v, out, lse = res
+    size = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % size) for i in range(size)]
+    lse_packed = _lse_pack(lse, block_q)
+
+    def block_grads(k_cur, v_cur, step):
+        def run(causal_flag):
+            def f(kv):
+                return _flash_backward(
+                    q, kv[0], kv[1], out, lse_packed, g,
+                    causal_flag, block_q, block_k, None,
+                )
+            return f
+
+        if not causal:
+            return run(False)((k_cur, v_cur))
+
+        def skip(kv):
+            return (
+                _stamp(jnp.zeros_like(q), kv[0], kv[1], out, g),
+                _stamp(jnp.zeros_like(kv[0]), q, out, g),
+                _stamp(jnp.zeros_like(kv[1]), q, out, g),
+            )
+
+        src = (my - step) % size
+        idx = jnp.where(src == my, 1, jnp.where(src < my, 0, 2))
+        return jax.lax.switch(idx, (run(False), run(True), skip), (k_cur, v_cur))
+
+    dq0 = _stamp(jnp.zeros(q.shape, jnp.float32), q, k, v, g)
+    dk0 = _stamp(jnp.zeros(k.shape, jnp.float32), q, k, v, g)
+    dv0 = _stamp(jnp.zeros(v.shape, jnp.float32), q, k, v, g)
+
+    rot = lambda x: jax.lax.ppermute(x, axis_name, perm)
+
+    def body(carry, step):
+        k_cur, v_cur, dk_cur, dv_cur, dq = carry
+        dq_c, dk_c, dv_c = block_grads(k_cur, v_cur, step)
+        dq = dq + dq_c.astype(jnp.float32)
+        dk_cur = dk_cur + dk_c.astype(jnp.float32)
+        dv_cur = dv_cur + dv_c.astype(jnp.float32)
+        # rotate the gradients WITH their blocks; after the full cycle each
+        # dK/dV lands back on its block's owner
+        return (rot(k_cur), rot(v_cur), rot(dk_cur), rot(dv_cur), dq), None
+
+    (k_last, v_last, dk, dv, dq), _ = jax.lax.scan(
+        body, (k, v, dk0, dv0, dq0), jnp.arange(size - 1)
+    )
+    # last block folds outside the scan so K/V skip their dead final hop;
+    # only dK/dV need the homing exchange
+    dq_c, dk_c, dv_c = block_grads(k_last, v_last, size - 1)
+    dq = dq + dq_c.astype(jnp.float32)
+    dk = rot(dk + dk_c.astype(jnp.float32))
+    dv = rot(dv + dv_c.astype(jnp.float32))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_attention_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True,
+                   impl: str = "flash"):
+    """Blockwise ring attention for sequence shards.  Call INSIDE shard_map:
+    q/k/v are this device's (batch, seq_local, heads, head_dim) shards of a
+    sequence sharded over `axis_name`; K/V rotate one ICI hop per step
+    (``jax.lax.ppermute``) while each resident block folds into the running
+    softmax state.
+
+    Equivalent to full attention over the global sequence (causal masking
+    uses global positions); comms 2·(ring-1) neighbour exchanges riding ICI.
+    ``impl="flash"`` (default) runs each block through the Pallas flash
+    kernel and a re-rotating custom VJP — O(s_loc) memory in BOTH
+    directions; shard shapes the kernel can't tile (s_loc > 128 and not a
+    multiple of 128) fall back to ``impl="einsum"`` (O(s_loc^2) transient,
+    still O(seq/ring) resident)."""
+    if impl == "flash":
+        bs = _ring_block_sizes(q.shape[1])
+        if bs is not None and q.shape == k.shape:
+            return _ring_attention_flash(q, k, v, axis_name, causal, *bs)
+    return _ring_attention_einsum(q, k, v, axis_name, causal)
+
+
 def ring_attention_sharded(q, k, v, mesh: Mesh, axis: str, causal: bool = True,
                            batch_axis: Optional[str] = None,
-                           heads_axis: Optional[str] = None):
+                           heads_axis: Optional[str] = None,
+                           impl: str = "flash"):
     """shard_map wrapper: q/k/v are GLOBAL (batch, seq, heads, head_dim)
     arrays; seq is sharded over `axis`; batch and heads may additionally be
     sharded over `batch_axis` / `heads_axis` (DP x TP x CP meshes) — the
@@ -503,7 +708,8 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, axis: str, causal: bool = True,
     row AND per head, so the other shards never communicate."""
     spec = P(batch_axis, axis, heads_axis, None)
     fn = jax.shard_map(
-        functools.partial(ring_attention, axis_name=axis, causal=causal),
+        functools.partial(ring_attention, axis_name=axis, causal=causal,
+                          impl=impl),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
@@ -516,7 +722,7 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, axis: str, causal: bool = True,
 # ---------------------------------------------------------------------------
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
-                      use_flash: bool = False):
+                      use_flash: bool = True):
     """All-to-all sequence parallelism (the Ulysses scheme) — the other
     long-context strategy next to :func:`ring_attention`.  Call INSIDE
     shard_map with (batch, seq_local, heads, head_dim) sequence shards:
@@ -526,7 +732,9 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
        size);
     2. attention runs entirely locally over the global sequence — no
        masking/softmax algebra across devices at all (vs ring's folded
-       online softmax), optionally through the Pallas flash kernel;
+       online softmax) — through the Pallas flash kernel by default, so
+       per-device memory is O(seq), not O(seq^2) (``use_flash=False`` keeps
+       the einsum oracle for testing);
     3. a second ``all_to_all`` re-shards heads→seq.
 
     Trade-off vs ring: 4 all-to-alls total (q,k,v in + out) but each is a
@@ -562,7 +770,7 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
 
 
 def ulysses_attention_sharded(q, k, v, mesh: Mesh, axis: str,
-                              causal: bool = True, use_flash: bool = False,
+                              causal: bool = True, use_flash: bool = True,
                               batch_axis: Optional[str] = None,
                               heads_axis: Optional[str] = None):
     """shard_map wrapper: q/k/v are GLOBAL (batch, seq, heads, head_dim)
