@@ -40,6 +40,20 @@ def synthetic_image_batches(
         yield images, labels
 
 
+def synthetic_token_batches(
+    batch: int,
+    seq_len: int,
+    vocab_size: int = 32000,
+    seed: int = 0,
+    worker_id: int = 0,
+) -> Iterator[np.ndarray]:
+    """Endless int32 token batches (batch, seq_len); per-worker disjoint
+    streams — the LM counterpart of :func:`synthetic_image_batches`."""
+    rng = np.random.default_rng(np.random.SeedSequence([seed, worker_id]))
+    while True:
+        yield rng.integers(0, vocab_size, size=(batch, seq_len), dtype=np.int32)
+
+
 def put_global(batch, sharding):
     """Place one host batch on device under `sharding`.  Single-process:
     plain async ``device_put``.  Multi-process: each process contributes

@@ -532,6 +532,21 @@ def _ring_block_sizes(s_loc: int) -> Optional[tuple]:
     return None
 
 
+def _ring_causal_dispatch(my, size, step, causal,
+                          run_unmasked, run_causal, skip, operands):
+    """Shared forward/backward dispatch for one ring step: which masking
+    does the resident block (owner ``src = (my - step) % size``) need?
+    Globally-causal means: src < my → fully visible (unmasked), src == my →
+    the causal diagonal, src > my → fully masked (skip).  One helper so the
+    subtle idx→branch mapping can never desynchronize between the two
+    passes."""
+    if not causal:
+        return run_unmasked(operands)
+    src = (my - step) % size
+    idx = jnp.where(src == my, 1, jnp.where(src < my, 0, 2))
+    return jax.lax.switch(idx, (run_unmasked, run_causal, skip), operands)
+
+
 def _ring_flash_fwd_impl(q, k, v, axis_name, causal, block_q, block_k):
     """Forward ring pass with the Pallas flash kernel as the per-block body.
 
@@ -558,18 +573,15 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal, block_q, block_k):
                 )
             return f
 
-        if not causal:
-            return run(False)((k_cur, v_cur))
-
         def skip(kv):
             o = _stamp(jnp.zeros((b, s_loc, h, d), jnp.float32), q, kv[0], kv[1])
             lse = _stamp(jnp.full((b, s_loc, h), NEG_INF, jnp.float32),
                          q, kv[0], kv[1])
             return o, lse
 
-        src = (my - step) % size
-        idx = jnp.where(src == my, 1, jnp.where(src < my, 0, 2))
-        return jax.lax.switch(idx, (run(False), run(True), skip), (k_cur, v_cur))
+        return _ring_causal_dispatch(
+            my, size, step, causal, run(False), run(True), skip, (k_cur, v_cur)
+        )
 
     def fold(o_acc, lse_acc, o_blk, lse_blk):
         lse_new = jnp.logaddexp(lse_acc, lse_blk)
@@ -630,9 +642,6 @@ def _ring_flash_vjp_bwd(axis_name, causal, block_q, block_k, res, g):
                 )
             return f
 
-        if not causal:
-            return run(False)((k_cur, v_cur))
-
         def skip(kv):
             return (
                 _stamp(jnp.zeros_like(q), kv[0], kv[1], out, g),
@@ -640,9 +649,9 @@ def _ring_flash_vjp_bwd(axis_name, causal, block_q, block_k, res, g):
                 _stamp(jnp.zeros_like(kv[1]), q, out, g),
             )
 
-        src = (my - step) % size
-        idx = jnp.where(src == my, 1, jnp.where(src < my, 0, 2))
-        return jax.lax.switch(idx, (run(False), run(True), skip), (k_cur, v_cur))
+        return _ring_causal_dispatch(
+            my, size, step, causal, run(False), run(True), skip, (k_cur, v_cur)
+        )
 
     dq0 = _stamp(jnp.zeros(q.shape, jnp.float32), q, k, v, g)
     dk0 = _stamp(jnp.zeros(k.shape, jnp.float32), q, k, v, g)
