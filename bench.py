@@ -212,7 +212,9 @@ def steady_state_resnet(extra: dict) -> None:
 
     state, _ = _steady_loop(run, state, pool, 5)   # warmup
     state, dt = _steady_loop(run, state, pool, 30)
-    mfu = flops / dt / V5E_PEAK_FLOPS
+    # whole-program FLOPs over the whole mesh's peak (1 chip here, but a
+    # multi-chip host must not inflate MFU by its device count)
+    mfu = flops / dt / (V5E_PEAK_FLOPS * mesh.size)
     img_s = batch / dt
     log(
         f"steady-state ResNet-50 b{batch} (unrolled, pooled pipeline): "
@@ -267,7 +269,7 @@ def steady_state_lm(extra: dict) -> None:
 
     state, _ = _steady_loop(run, state, pool, 3)   # warmup
     state, dt = _steady_loop(run, state, pool, 20)
-    mfu = flops / dt / V5E_PEAK_FLOPS
+    mfu = flops / dt / (V5E_PEAK_FLOPS * mesh.size)
     tok_s = batch * seq / dt
     log(
         f"steady-state LM ({n_params / 1e6:.0f}M params, flash attn) "

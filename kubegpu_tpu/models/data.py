@@ -54,6 +54,50 @@ def synthetic_token_batches(
         yield rng.integers(0, vocab_size, size=(batch, seq_len), dtype=np.int32)
 
 
+def synthetic_token_batches_for_mesh(
+    batch: int,
+    seq_len: int,
+    vocab_size: int,
+    mesh,
+    seed: int = 0,
+) -> Iterator[np.ndarray]:
+    """Per-process LOCAL rows of a global (batch, seq_len) token batch for a
+    mesh whose batch dim is sharded over the leading "data" axis.
+
+    The stream is seeded PER DATA-SHARD, not per process: processes whose
+    devices address the same data shard (batch replicated across a tp/seq
+    axis) draw byte-identical rows — mandatory, or
+    ``make_array_from_process_local_data`` silently stitches divergent
+    "replicas" and tp/cp collectives mix activations from different inputs —
+    while distinct shards draw disjoint streams.  Single-process callers get
+    the full global batch (all shards, in order)."""
+    import jax
+
+    axes = dict(mesh.shape)
+    dp = axes.pop("data", 1)
+    per_shard = int(np.prod(list(axes.values()))) if axes else 1
+    if batch % max(dp, 1):
+        raise ValueError(f"batch {batch} not divisible by data axis {dp}")
+    rows_per_shard = batch // max(dp, 1)
+    local = jax.local_device_count()
+    first_dev = jax.process_index() * local
+    # contiguous device→mesh-coordinate mapping (device_mesh fills the
+    # trailing axes fastest): device d sits at data coord d // per_shard
+    first_shard = first_dev // per_shard
+    n_shards = max(local // per_shard, 1)
+    rngs = [
+        np.random.default_rng(np.random.SeedSequence([seed, first_shard + s]))
+        for s in range(n_shards)
+    ]
+    while True:
+        yield np.concatenate(
+            [
+                r.integers(0, vocab_size, size=(rows_per_shard, seq_len), dtype=np.int32)
+                for r in rngs
+            ]
+        )
+
+
 def put_global(batch, sharding):
     """Place one host batch on device under `sharding`.  Single-process:
     plain async ``device_put``.  Multi-process: each process contributes

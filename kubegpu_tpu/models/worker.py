@@ -10,15 +10,29 @@ CRI shim injects (crishim/inject.py):
     JAX_NUM_PROCESSES        gang size
     JAX_PROCESS_ID           == TPU_WORKER_ID
 
-and runs data-parallel ResNet-50 training steps under pjit over a
-``("data",)`` mesh spanning the gang's chips, printing the pod-visible half
-of the north-star metric: time from process start to the first completed
-optimizer step (BASELINE.json: schedule-to-first-step < 60 s).
+and trains the selected workload under pjit over the gang's chips, printing
+the pod-visible half of the north-star metric: time from process start to
+the first completed optimizer step (BASELINE.json: schedule-to-first-step
+< 60 s).
+
+Every workload family the framework places is launchable here — the full
+SURVEY §2.2 stack, not just the DP sample:
+
+    --model resnet50    data-parallel ResNet-50 over ("data",)      [config 4]
+    --model lm          transformer LM, Megatron TP + sequence-parallel
+                        activations over ("data", "model")          [--tp]
+    --model lm-cp       context-parallel LM: ring/ulysses attention
+                        over ("data", "seq") for long context       [--cp]
+    --model moe         MoE transformer, expert parallelism over
+                        ("data", "expert")                          [--ep]
+    --model pp          GPipe-pipelined LM over ("pipe",)           [--microbatches]
 
 Single-worker mode (JAX_NUM_PROCESSES absent or 1) skips the distributed
 rendezvous, so the same image serves BASELINE configs 2-5.
 
     python -m kubegpu_tpu.models.worker --steps 20 --batch-per-chip 32
+    python -m kubegpu_tpu.models.worker --model lm --tp 4 --seq 1024
+    python -m kubegpu_tpu.models.worker --model lm-cp --cp 4 --seq 8192
 """
 
 from __future__ import annotations
@@ -29,6 +43,9 @@ import os
 import time
 
 log = logging.getLogger("kubegpu_tpu.worker")
+
+RESNET_MODELS = ("resnet50", "resnet50-unrolled", "resnet-tiny")
+LM_MODELS = ("lm", "lm-cp", "moe")
 
 
 def initialize_distributed() -> None:
@@ -46,19 +63,385 @@ def initialize_distributed() -> None:
     )
 
 
+def _worker_id() -> int:
+    return int(
+        os.environ.get("JAX_PROCESS_ID", os.environ.get("TPU_WORKER_ID", "0")) or 0
+    )
+
+
+def _split_mesh(n: int, parallel: int, parallel_axis: str):
+    """(data, parallel) axis sizes for n devices with `parallel`-way model/
+    expert/seq parallelism; parallel=0 means 'all devices'."""
+    p = parallel or n
+    if n % p != 0:
+        raise SystemExit(
+            f"--{parallel_axis} {p} does not divide the device count {n}"
+        )
+    return n // p, p
+
+
+class _CheckpointHooks:
+    """Optional Orbax checkpoint/resume for TrainState-shaped workloads,
+    namespaced per model variant so different param layouts never collide."""
+
+    def __init__(self, args, state):
+        import jax
+
+        from kubegpu_tpu.models.checkpoint import (
+            make_manager,
+            restore_checkpoint,
+            save_checkpoint,
+        )
+
+        self._save = save_checkpoint
+        root = os.path.abspath(args.ckpt_dir)
+        try:
+            legacy = sorted(
+                d for d in os.listdir(root)
+                if d.isdigit() and os.path.isdir(os.path.join(root, d))
+            )
+        except OSError:
+            legacy = []
+        if legacy:
+            # checkpoints written before per-model namespacing live at the
+            # root; their param layout may not match this model variant, so
+            # they are NOT restored — but silence here would look like a
+            # silent restart from step 0
+            log.warning(
+                "ignoring legacy checkpoints at %s (steps %s); checkpoints "
+                "now live under %s — restore manually if the layouts match",
+                root, ",".join(legacy), os.path.join(root, args.model),
+            )
+        self.mgr = make_manager(os.path.join(root, args.model))
+        self.start_step = 0
+        self.last_saved = -1
+        restored = restore_checkpoint(self.mgr, state)
+        self.state = state
+        if restored is not None:
+            self.state = restored
+            self.start_step = int(jax.device_get(restored.step))
+            print(f"RESUMED step={self.start_step}", flush=True)
+
+    def maybe_save(self, state, done: int, every: int) -> float:
+        if every <= 0 or done % every != 0:
+            return 0.0
+        ts = time.monotonic()
+        self.last_saved = self._save(self.mgr, state)
+        return time.monotonic() - ts
+
+    def finish(self, state) -> None:
+        import jax
+
+        final_step = int(jax.device_get(state.step))
+        if final_step != self.last_saved:  # orbax raises on duplicate saves
+            self._save(self.mgr, state)
+        self.mgr.wait_until_finished()
+        print(f"CHECKPOINT_SAVED step={final_step}", flush=True)
+
+
+def _measured_loop(args, t0, run_once, state, batches, per_step_items, unit,
+                   ckpt=None) -> int:
+    """The measurement protocol, shared by every runner: first step timed
+    from process start (FIRST_STEP_DONE — the pod-visible half of the north
+    star), then a steps-1 steady loop with optional checkpoint saves and a
+    throughput line.  ``run_once(state, batch_or_None) -> (state, loss)``;
+    ``batches=None`` means the resident (constant-batch) mode.  Syncs are
+    scalar VALUE readbacks — float(loss) — because block_until_ready can
+    return early on tunnelled backends."""
+    start_step = 0
+    if ckpt is not None:
+        state, start_step = ckpt.state, ckpt.start_step
+
+    state, loss = run_once(state, next(batches) if batches is not None else None)
+    loss_v = float(loss)  # forces the step to completion
+    first_step_s = time.monotonic() - t0
+    print(
+        f"FIRST_STEP_DONE seconds={first_step_s:.2f} loss={loss_v:.4f}",
+        flush=True,
+    )
+
+    t1 = time.monotonic()
+    save_s = 0.0
+    done = start_step + 1
+    for _ in range(args.steps - 1):
+        state, loss = run_once(
+            state, next(batches) if batches is not None else None
+        )
+        done += 1
+        if ckpt is not None:
+            save_s += ckpt.maybe_save(state, done, args.ckpt_every)
+    loss_v = float(loss)  # forces the whole chain
+    dt = time.monotonic() - t1 - save_s
+    if args.steps > 1:
+        rate = per_step_items * (args.steps - 1) / dt
+        print(f"steady_state {unit}={rate:.1f} loss={loss_v:.4f}", flush=True)
+    if ckpt is not None:
+        ckpt.finish(state)
+    return 0
+
+
+def _make_batches(args, source, sharding, resident_batch):
+    """--data dispatch shared by the runners: synthetic = device-resident
+    pool, stream = double-buffered prefetch, resident = one constant batch
+    (returns batches=None).  ``resident_batch()`` builds the constant."""
+    from kubegpu_tpu.models.data import device_pool_batches, prefetch_to_device
+
+    if args.data == "synthetic":
+        batches = device_pool_batches(source, sharding, pool=max(args.data_pool, 1))
+        return batches, next(batches)
+    if args.data == "stream":
+        batches = prefetch_to_device(source, sharding, depth=2)
+        return batches, next(batches)
+    return None, resident_batch()
+
+
+def _run_resnet(args, t0: float) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from kubegpu_tpu.models import (
+        ResNet,
+        ResNet50,
+        ScanResNet50,
+        create_train_state,
+        make_resnet_train_step,
+        place_resnet,
+    )
+    from kubegpu_tpu.models.data import synthetic_image_batches
+    from kubegpu_tpu.parallel import device_mesh
+    from kubegpu_tpu.parallel.sharding import batch_sharding
+
+    n = jax.device_count()
+    mesh = device_mesh({"data": n})
+    classes = args.num_classes
+    if args.model == "resnet50":
+        model = ScanResNet50(num_classes=classes)
+        size = args.image_size
+    elif args.model == "resnet50-unrolled":
+        model = ResNet50(num_classes=classes)
+        size = args.image_size
+    else:  # CI-sized twin, same code path
+        # the data source must draw labels from the SAME label space as the
+        # model head — out-of-range labels make take_along_axis poison the
+        # loss with garbage/NaN
+        classes = 10
+        model = ResNet(stage_sizes=(1, 1, 1, 1), num_filters=8, num_classes=classes)
+        size = 32
+
+    batch = args.batch_per_chip * n
+    rng = jax.random.PRNGKey(0)
+    # input pipeline: each process generates ONLY its local rows of the
+    # global batch (put_global assembles the global array), seeded by the
+    # same id chain the rendezvous uses so gang workers draw disjoint
+    # streams however the env named them (pure DP: every chip is its own
+    # data shard, so per-process streams never need to agree)
+    local_batch = args.batch_per_chip * jax.local_device_count()
+    source = synthetic_image_batches(
+        local_batch, size=size, num_classes=classes, worker_id=_worker_id(),
+    )
+    batches, (images, labels) = _make_batches(
+        args, source, batch_sharding(mesh),
+        lambda: (
+            jnp.ones((batch, size, size, 3), jnp.float32),
+            jnp.zeros((batch,), jnp.int32),
+        ),
+    )
+    state = create_train_state(model, rng, images)
+    state, images, labels = place_resnet(state, (images, labels), mesh)
+    step = make_resnet_train_step(mesh)
+    const = (images, labels)
+
+    def run_once(state, b):
+        im, lb = b if b is not None else const
+        return step(state, im, lb)
+
+    ckpt = _CheckpointHooks(args, state) if args.ckpt_dir else None
+    return _measured_loop(
+        args, t0, run_once, state, batches, batch, "images_per_sec", ckpt
+    )
+
+
+def _run_lm_family(args, t0: float) -> int:
+    """lm (TP+SP), lm-cp (context parallel), moe (expert parallel)."""
+    import jax
+
+    from kubegpu_tpu.models import (
+        MoeTransformerLM,
+        TransformerLM,
+        create_train_state,
+        make_lm_train_step,
+        make_moe_train_step,
+        place_cp_lm,
+        place_lm,
+        place_moe,
+    )
+    from kubegpu_tpu.models.data import (
+        put_global,
+        synthetic_token_batches_for_mesh,
+    )
+    from kubegpu_tpu.parallel import device_mesh
+    from kubegpu_tpu.parallel.sharding import batch_sharding
+
+    n = jax.device_count()
+    if args.model == "lm":
+        dp, tp = _split_mesh(n, args.tp, "tp")
+        mesh = device_mesh({"data": dp, "model": tp})
+        if args.heads % tp:
+            raise SystemExit(f"--heads {args.heads} not divisible by tp={tp}")
+        model = TransformerLM(
+            vocab_size=args.vocab, num_layers=args.layers, num_heads=args.heads,
+            hidden=args.hidden, max_seq=args.seq + 1,
+            sequence_parallel=True, attn_impl=args.attn_impl,
+        )
+        place, make_step = place_lm, make_lm_train_step
+    elif args.model == "lm-cp":
+        dp, cp = _split_mesh(n, args.cp, "cp")
+        mesh = device_mesh({"data": dp, "seq": cp})
+        if args.seq % cp:
+            raise SystemExit(f"--seq {args.seq} not divisible by cp={cp}")
+        model = TransformerLM(
+            vocab_size=args.vocab, num_layers=args.layers, num_heads=args.heads,
+            hidden=args.hidden, max_seq=args.seq + 1,
+            context_parallel=True,
+            attn_impl=args.attn_impl if args.attn_impl != "flash" else "ring",
+        )
+        place, make_step = place_cp_lm, make_lm_train_step
+    else:  # moe
+        dp, ep = _split_mesh(n, args.ep, "ep")
+        mesh = device_mesh({"data": dp, "expert": ep})
+        model = MoeTransformerLM(
+            vocab_size=args.vocab, num_layers=args.layers, num_heads=args.heads,
+            hidden=args.hidden, num_experts=args.num_experts or ep,
+            capacity_factor=2.0, max_seq=args.seq + 1,
+        )
+        place, make_step = place_moe, make_moe_train_step
+
+    batch = max(args.batch_per_chip * mesh.shape.get("data", 1), 1)
+    # per-DATA-SHARD seeding: processes replicating one shard (tp/cp axes)
+    # draw byte-identical rows, distinct shards draw disjoint streams —
+    # anything else silently stitches divergent "replicas" into the global
+    # array and the tp/cp collectives mix activations from different inputs
+    source = synthetic_token_batches_for_mesh(
+        batch, args.seq + 1, args.vocab, mesh
+    )
+    batches, tokens = _make_batches(
+        args, source, batch_sharding(mesh),
+        lambda: put_global(next(source), batch_sharding(mesh)),
+    )
+    state = create_train_state(model, jax.random.PRNGKey(0), tokens[:, :-1])
+    state, tokens = place(state, tokens, mesh)
+    step = make_step(mesh)
+
+    def run_once(state, b):
+        out = step(state, tokens if b is None else b)
+        return out[0], out[1]  # moe returns (state, loss, aux)
+
+    ckpt = _CheckpointHooks(args, state) if args.ckpt_dir else None
+    return _measured_loop(
+        args, t0, run_once, state, batches, batch * args.seq,
+        "tokens_per_sec", ckpt,
+    )
+
+
+def _run_pp(args, t0: float) -> int:
+    """GPipe-pipelined LM over a ("pipe",) mesh; params/opt are a raw
+    pytree (no TrainState), so checkpointing is declined explicitly."""
+    import jax
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubegpu_tpu.models import (
+        init_pipeline_lm,
+        make_pipeline_lm_train_step,
+        place_pipeline_lm,
+    )
+    from kubegpu_tpu.models.data import put_global, synthetic_token_batches
+    from kubegpu_tpu.parallel import device_mesh
+
+    if args.ckpt_dir:
+        log.warning("--ckpt-dir is not supported for --model pp; ignoring")
+    n = jax.device_count()
+    stages = args.pp_stages or n
+    if n % stages:
+        raise SystemExit(f"--pp-stages {stages} does not divide {n} devices")
+    if jax.process_count() > 1 and stages != n:
+        # a sub-mesh would leave some gang processes with no addressable
+        # mesh devices, wedging their put_global and the collective steps
+        raise SystemExit(
+            f"--pp-stages {stages} != device count {n}: in a multi-process "
+            "gang the pipeline must span every device"
+        )
+    mesh = device_mesh({"pipe": stages}, devices=jax.devices()[:stages])
+    batch = max(args.batch_per_chip, 1) * max(args.microbatches, 1)
+    params = init_pipeline_lm(
+        jax.random.PRNGKey(0), vocab_size=args.vocab, num_stages=stages,
+        layers_per_stage=args.layers, hidden=args.hidden, max_seq=args.seq + 1,
+    )
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt = tx.init(params)
+    sharding = NamedSharding(mesh, P())
+    # tokens are REPLICATED over the pipe mesh: every process must draw the
+    # byte-identical stream, so the worker id must NOT enter the seed
+    source = synthetic_token_batches(batch, args.seq + 1, args.vocab)
+    batches, tokens = _make_batches(
+        args, source, sharding, lambda: put_global(next(source), sharding)
+    )
+    params, opt, tokens = place_pipeline_lm(params, opt, tokens, mesh)
+    step = make_pipeline_lm_train_step(
+        mesh, tx, num_heads=args.heads, num_microbatches=args.microbatches
+    )
+    const = tokens
+
+    def run_once(state, b):
+        params, opt = state
+        params, opt, loss = step(params, opt, const if b is None else b)
+        return (params, opt), loss
+
+    return _measured_loop(
+        args, t0, run_once, (params, opt), batches, batch * args.seq,
+        "tokens_per_sec", None,
+    )
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
         "--model",
         default="resnet50",
-        choices=["resnet50", "resnet50-unrolled", "resnet-tiny"],
+        choices=list(RESNET_MODELS) + list(LM_MODELS) + ["pp"],
         help="resnet50 = scan-rolled flagship (fast compile); "
-        "resnet50-unrolled = plain per-block variant",
+        "resnet50-unrolled = plain per-block variant; lm = TP+SP "
+        "transformer; lm-cp = context-parallel LM (ring/ulysses); "
+        "moe = expert-parallel MoE; pp = GPipe-pipelined LM",
     )
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--batch-per-chip", type=int, default=32)
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--num-classes", type=int, default=1000)
+    # LM-family shape knobs (worker-pod sized defaults; heads must divide
+    # by --tp, --seq by --cp)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--layers", type=int, default=4,
+                    help="LM layers (pp: layers PER STAGE)")
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--hidden", type=int, default=512)
+    ap.add_argument("--seq", type=int, default=1024,
+                    help="tokens per sample (the LM trains on seq+1 windows)")
+    ap.add_argument("--attn-impl", default="flash",
+                    choices=["einsum", "flash", "ring", "ulysses"],
+                    help="lm-cp: ring (default) or ulysses")
+    ap.add_argument("--tp", type=int, default=0,
+                    help="lm: tensor-parallel size (0 = all devices)")
+    ap.add_argument("--cp", type=int, default=0,
+                    help="lm-cp: context-parallel size (0 = all devices)")
+    ap.add_argument("--ep", type=int, default=0,
+                    help="moe: expert-parallel size (0 = all devices)")
+    ap.add_argument("--num-experts", type=int, default=0,
+                    help="moe: expert count (0 = one per ep shard)")
+    ap.add_argument("--pp-stages", type=int, default=0,
+                    help="pp: pipeline stages (0 = all devices)")
+    ap.add_argument("--microbatches", type=int, default=4,
+                    help="pp: GPipe microbatches per step")
     ap.add_argument(
         "--ckpt-dir",
         default=os.environ.get("KUBEGPU_CKPT_DIR", ""),
@@ -98,7 +481,6 @@ def main(argv=None) -> int:
     initialize_distributed()
 
     import jax
-    import jax.numpy as jnp
 
     if args.compile_cache:
         jax.config.update(
@@ -106,140 +488,19 @@ def main(argv=None) -> int:
         )
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
 
-    from kubegpu_tpu.models import (
-        ResNet,
-        ResNet50,
-        ScanResNet50,
-        create_train_state,
-        make_resnet_train_step,
-        place_resnet,
-    )
-    from kubegpu_tpu.parallel import device_mesh
-
-    n = jax.device_count()
     log.info(
-        "devices: %d global / %d local (%s), visible_chips=%s",
-        n,
+        "devices: %d global / %d local (%s), visible_chips=%s, model=%s",
+        jax.device_count(),
         jax.local_device_count(),
         jax.devices()[0].platform,
         os.environ.get("TPU_VISIBLE_CHIPS", "<unset>"),
+        args.model,
     )
-    mesh = device_mesh({"data": n})
-    if args.model == "resnet50":
-        model = ScanResNet50(num_classes=args.num_classes)
-        size = args.image_size
-    elif args.model == "resnet50-unrolled":
-        model = ResNet50(num_classes=args.num_classes)
-        size = args.image_size
-    else:  # CI-sized twin, same code path
-        model = ResNet(stage_sizes=(1, 1, 1, 1), num_filters=8, num_classes=10)
-        size = 32
-
-    from kubegpu_tpu.models.data import (
-        device_pool_batches,
-        prefetch_to_device,
-        synthetic_image_batches,
-    )
-    from kubegpu_tpu.parallel.sharding import batch_sharding
-
-    batch = args.batch_per_chip * n
-    rng = jax.random.PRNGKey(0)
-    # input pipeline: each process generates ONLY its local rows of the
-    # global batch (put_global assembles the global array), seeded by the
-    # same id chain the rendezvous uses so gang workers draw disjoint
-    # streams however the env named them
-    worker_id = int(
-        os.environ.get("JAX_PROCESS_ID", os.environ.get("TPU_WORKER_ID", "0"))
-        or 0
-    )
-    local_batch = args.batch_per_chip * jax.local_device_count()
-    source = synthetic_image_batches(
-        local_batch, size=size, num_classes=args.num_classes, worker_id=worker_id
-    )
-    if args.data == "synthetic":
-        batches = device_pool_batches(
-            source, batch_sharding(mesh), pool=max(args.data_pool, 1)
-        )
-        images, labels = next(batches)
-    elif args.data == "stream":
-        batches = prefetch_to_device(source, batch_sharding(mesh), depth=2)
-        images, labels = next(batches)
-    else:  # resident: one constant device batch, no pipeline
-        images = jnp.ones((batch, size, size, 3), jnp.float32)
-        labels = jnp.zeros((batch,), jnp.int32)
-        batches = None
-    state = create_train_state(model, rng, images)
-    state, images, labels = place_resnet(state, (images, labels), mesh)
-    step = make_resnet_train_step(mesh)
-
-    mgr = None
-    start_step = 0
-    save_checkpoint = None
-    if args.ckpt_dir:
-        from kubegpu_tpu.models.checkpoint import (
-            make_manager,
-            restore_checkpoint,
-            save_checkpoint,
-        )
-
-        root = os.path.abspath(args.ckpt_dir)
-        try:
-            legacy = sorted(
-                d for d in os.listdir(root)
-                if d.isdigit() and os.path.isdir(os.path.join(root, d))
-            )
-        except OSError:
-            legacy = []
-        if legacy:
-            # checkpoints written before per-model namespacing live at the
-            # root; their param layout may not match this model variant, so
-            # they are NOT restored — but silence here would look like a
-            # silent restart from step 0
-            log.warning(
-                "ignoring legacy checkpoints at %s (steps %s); checkpoints "
-                "now live under %s — restore manually if the layouts match",
-                root, ",".join(legacy), os.path.join(root, args.model),
-            )
-        mgr = make_manager(os.path.join(root, args.model))
-        restored = restore_checkpoint(mgr, state)
-        if restored is not None:
-            state = restored
-            start_step = int(jax.device_get(state.step))
-            print(f"RESUMED step={start_step}", flush=True)
-
-    state, loss = step(state, images, labels)
-    jax.block_until_ready(loss)
-    first_step_s = time.monotonic() - t0
-    # the string the e2e latency probe (and a human) greps for
-    print(f"FIRST_STEP_DONE seconds={first_step_s:.2f} loss={float(loss):.4f}", flush=True)
-
-    t1 = time.monotonic()
-    save_s = 0.0
-    done = start_step + 1
-    last_saved = -1
-    for _ in range(args.steps - 1):
-        if batches is not None:
-            images, labels = next(batches)  # prefetched: already on device
-        state, loss = step(state, images, labels)
-        done += 1
-        if mgr is not None and args.ckpt_every > 0 and done % args.ckpt_every == 0:
-            # periodic crash-recovery saves; excluded from the throughput
-            # metric so checkpointed and plain runs stay comparable
-            ts = time.monotonic()
-            last_saved = save_checkpoint(mgr, state)
-            save_s += time.monotonic() - ts
-    jax.block_until_ready(loss)
-    dt = time.monotonic() - t1 - save_s
-    if args.steps > 1:
-        ips = batch * (args.steps - 1) / dt
-        print(f"steady_state images_per_sec={ips:.1f} loss={float(loss):.4f}", flush=True)
-    if mgr is not None:
-        final_step = int(jax.device_get(state.step))
-        if final_step != last_saved:  # orbax raises on duplicate-step saves
-            save_checkpoint(mgr, state)
-        mgr.wait_until_finished()
-        print(f"CHECKPOINT_SAVED step={final_step}", flush=True)
-    return 0
+    if args.model in RESNET_MODELS:
+        return _run_resnet(args, t0)
+    if args.model in LM_MODELS:
+        return _run_lm_family(args, t0)
+    return _run_pp(args, t0)
 
 
 if __name__ == "__main__":

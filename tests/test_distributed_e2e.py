@@ -102,3 +102,39 @@ def test_two_process_worker_trains_data_parallel():
     """), timeout=300.0)
     for o in outs:
         assert "FIRST_STEP_DONE" in o
+
+
+LM_ARGS = [
+    "--model", "lm", "--tp", "2", "--steps", "2", "--batch-per-chip", "2",
+    "--vocab", "64", "--layers", "1", "--heads", "2", "--hidden", "16",
+    "--seq", "32", "--data-pool", "1",
+]
+
+
+def test_two_process_tp_lm_matches_single_process_loss():
+    """TP gang data integrity: with dp=1 the token batch is REPLICATED
+    across the two single-device processes, so both must feed byte-identical
+    rows into make_array_from_process_local_data — divergent streams would
+    silently stitch different 'replicas' and the TP psum would mix
+    activations from different inputs.  The discriminator: the gang's first
+    -step loss must equal a single-process run of the same config."""
+    import re
+
+    script = textwrap.dedent("""
+        from kubegpu_tpu.models import worker
+        rc = worker.main(%r)
+        assert rc == 0
+    """ % (LM_ARGS,))
+    gang = run_gang(script, timeout=300.0)
+    solo = spawn(script, {"XLA_FLAGS": "--xla_force_host_platform_device_count=2"})
+    out, err = solo.communicate(timeout=300.0)
+    assert solo.returncode == 0, err[-2000:]
+
+    def first_loss(text):
+        m = re.search(r"FIRST_STEP_DONE seconds=\S+ loss=(\S+)", text)
+        assert m, text
+        return float(m.group(1))
+
+    ref = first_loss(out)
+    for o in gang:
+        assert abs(first_loss(o) - ref) < 1e-4, (first_loss(o), ref)

@@ -77,9 +77,14 @@ def schedule_over_http(server, api, pod_objs):
     return out
 
 
-def test_north_star_sample_full_stack_over_wire(stack):
+@pytest.mark.parametrize(
+    "sample,svc", [("jax-resnet.yaml", "jax-resnet"), ("jax-lm-tp.yaml", "jax-lm-tp")]
+)
+def test_north_star_sample_full_stack_over_wire(stack, sample, svc):
+    # jax-resnet = the DP north star; jax-lm-tp = a non-ResNet workload
+    # (TP/SP LM) through the identical extender→CRI→worker-env path
     api, fs, server = stack
-    pods = [d for d in yaml.safe_load_all((SAMPLES / "jax-resnet.yaml").read_text())
+    pods = [d for d in yaml.safe_load_all((SAMPLES / sample).read_text())
             if d and d.get("kind") == "Pod"]
     assigned = schedule_over_http(server, api, pods)
 
@@ -112,7 +117,7 @@ def test_north_star_sample_full_stack_over_wire(stack):
                 assert env["TPU_VISIBLE_CHIPS"]
                 assert env["JAX_NUM_PROCESSES"] == "4"
                 assert env["TPU_WORKER_ID"] == env["JAX_PROCESS_ID"]
-                assert f"{name}.jax-resnet.default.svc" in env["TPU_WORKER_HOSTNAMES"]
+                assert f"{name}.{svc}.default.svc" in env["TPU_WORKER_HOSTNAMES"]
                 # device nodes rode along with the env
                 assert pw.get_all(config, 8), "no devices injected"
         finally:

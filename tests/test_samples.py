@@ -63,6 +63,8 @@ def test_sample_dir_covers_all_graded_configs():
     assert sample_files() == [
         "cpu-pod.yaml",
         "four-chip.yaml",
+        "jax-lm-cp.yaml",
+        "jax-lm-tp.yaml",
         "jax-multislice.yaml",
         "jax-resnet.yaml",
         "multi-tenant.yaml",
@@ -136,6 +138,42 @@ def test_jax_resnet_sample_gang_schedules_contiguously():
         # headless-service DNS names from the manifest's subdomain
         assert ".jax-resnet.default.svc" in inj.env["JAX_COORDINATOR_ADDRESS"]
     assert len(tables) == 1  # every member derived the identical worker table
+
+
+@pytest.mark.parametrize(
+    "fname,gang,expect_flag",
+    [
+        ("jax-lm-tp.yaml", "jax-lm-tp", "lm"),
+        ("jax-lm-cp.yaml", "jax-lm-cp", "lm-cp"),
+    ],
+)
+def test_lm_sample_gang_schedules_with_worker_mode(fname, gang, expect_flag):
+    """The non-ResNet workload samples (SURVEY §2.2 TP/SP + CP): the gang
+    lands ICI-contiguous and the manifest launches the matching worker
+    mode."""
+    api, sched, providers = make_cluster()
+    pods = load_pods(fname)
+    assert len(pods) == 4
+    # the pod command actually selects the right workload family
+    for obj in pods:
+        cmd = obj["spec"]["containers"][0]["command"]
+        assert cmd[cmd.index("--model") + 1] == expect_flag, cmd
+    assigned = schedule_all(api, sched, pods)
+    union = set()
+    for name, a in assigned.items():
+        assert a is not None, f"{name} unassigned"
+        union.update(c.coords for c in a.all_chips())
+    assert len(union) == 4
+    assert is_contiguous_submesh(union, MESH)
+    # injection: the same gang env contract the worker's mesh bringing-up
+    # consumes (jax.distributed + per-mode axis split over 4 processes)
+    name, a = sorted(assigned.items())[0]
+    daemon = ShimDaemon(api, providers[a.node])
+    pod = api.get_pod("default", name)
+    inj = daemon.decide("default", name, "worker",
+                        pod["metadata"].get("annotations") or {}, a.node)
+    assert inj.env["JAX_NUM_PROCESSES"] == "4"
+    assert f".{gang}.default.svc" in inj.env["JAX_COORDINATOR_ADDRESS"]
 
 
 def test_multi_tenant_sample_both_gangs_fit():
