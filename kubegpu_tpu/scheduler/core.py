@@ -18,6 +18,7 @@ Flow per verb:
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -82,6 +83,11 @@ class Scheduler:
         self._absent_chip_strikes: Dict[tuple, Tuple[int, str]] = {}
         # (pod key, node) -> consecutive resyncs the node was missing
         self._missing_node_strikes: Dict[tuple, int] = {}
+        # serializes the failure-detector entry points: the resync thread
+        # and the node-watch thread both mutate the strike maps and run the
+        # eviction sweep — unserialized, the watch can resize a dict mid-
+        # iteration or double-evict one victim
+        self._lifecycle_lock = threading.Lock()
 
     # -- filter -----------------------------------------------------------
     def filter(self, pod_obj: dict, node_names: List[str]) -> FilterResult:
@@ -491,11 +497,15 @@ class Scheduler:
     # -- lifecycle events -------------------------------------------------
     def resync(self) -> None:
         """Periodic resync (ExtenderServer loop): rebuild the cache from the
-        API server, then sweep for assignments referencing died chips —
-        without a node-watch this loop IS the failure detector, so the
-        sweep must live here or chip-death eviction never fires in a
-        deployed server.  One snapshot indexed by host keeps the sweep
-        O(assignments), not O(nodes x assignments)."""
+        API server, then sweep for assignments referencing died chips — the
+        consistency backstop behind the node watch (and the only failure
+        detector when the API server offers no watch).  One snapshot
+        indexed by host keeps the sweep O(assignments), not
+        O(nodes x assignments)."""
+        with self._lifecycle_lock:
+            self._resync_locked()
+
+    def _resync_locked(self) -> None:
         self.cache.refresh()
         if not self.evict_on_chip_failure:
             return
@@ -562,9 +572,10 @@ class Scheduler:
         self.groups.on_pod_deleted(pod)
 
     def on_node_updated(self, node_obj: dict) -> None:
-        self.cache.update_node(node_obj)
-        if self.evict_on_chip_failure:
-            self._evict_on_dead_chips(node_obj)
+        with self._lifecycle_lock:
+            self.cache.update_node(node_obj)
+            if self.evict_on_chip_failure:
+                self._evict_on_dead_chips(node_obj)
 
     def _evict_pod(self, key: str) -> None:
         """The one eviction sequence (preemption AND health eviction):

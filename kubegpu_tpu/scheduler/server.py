@@ -191,17 +191,25 @@ def _debug_state(sched: Scheduler) -> dict:
 
 
 class ExtenderServer:
-    """Owns the HTTP server + a cache resync loop."""
+    """Owns the HTTP server + a node watch + a cache resync loop.
+
+    The watch is the fast path of failure detection: the advertiser's node
+    patch lands as an event and chip-death eviction fires immediately
+    instead of waiting for the next resync tick.  The periodic resync stays
+    as the consistency backstop (watch-stream drops, missed events, the
+    orphaned-node sweep)."""
 
     def __init__(
         self,
         sched: Scheduler,
         listen: Tuple[str, int] = ("127.0.0.1", 12345),
         resync_interval_s: float = 30.0,
+        watch: bool = True,
     ) -> None:
         self.sched = sched
         self.httpd = ThreadingHTTPServer(listen, make_handler(sched))
         self.resync_interval_s = resync_interval_s
+        self.watch = watch
         self._stop = threading.Event()
         self._threads = []
 
@@ -217,6 +225,10 @@ class ExtenderServer:
         r = threading.Thread(target=self._resync_loop, daemon=True)
         r.start()
         self._threads.append(r)
+        if self.watch:
+            w = threading.Thread(target=self._watch_loop, daemon=True)
+            w.start()
+            self._threads.append(w)
 
     def _resync_loop(self) -> None:
         while not self._stop.wait(self.resync_interval_s):
@@ -225,6 +237,23 @@ class ExtenderServer:
                 self.sched.resync()
             except Exception:  # noqa: BLE001
                 log.exception("cache resync failed; keeping stale cache")
+
+    def _watch_loop(self) -> None:
+        def handler(event: str, obj: dict) -> None:
+            try:
+                if event == "node-updated":
+                    self.sched.on_node_updated(obj)
+                # node-deleted: left to resync's orphan sweep, which owns
+                # the absence-grace bookkeeping (one LIST blip ≠ node loss)
+            except Exception:  # noqa: BLE001
+                log.exception("node watch handler failed for %s", event)
+
+        try:
+            self.sched.api.watch_nodes(handler, self._stop)
+        except NotImplementedError:
+            log.info("api server has no node watch; relying on periodic resync")
+        except Exception:  # noqa: BLE001
+            log.exception("node watch died; relying on periodic resync")
 
     def stop(self) -> None:
         self._stop.set()

@@ -126,10 +126,10 @@ def test_north_star_sample_full_stack_over_wire(stack, sample, svc):
             upstream.stop(0)
 
 
-def test_chip_death_evicts_via_live_resync_loop():
-    # failure detection through the DEPLOYED path: no direct
-    # on_node_updated call — the running server's periodic resync sweep
-    # must notice the died chip and evict the pod holding it
+def _assert_chip_death_evicts(resync_interval_s, watch, fail_msg):
+    """Shared harness for the two deployed failure-detection paths: place a
+    pod over the wire, kill its chip, run the advertiser's health cycle,
+    and require the RUNNING server (no direct calls) to evict the pod."""
     import time
 
     api = InMemoryApiServer()
@@ -138,7 +138,7 @@ def test_chip_death_evicts_via_live_resync_loop():
     for a in advs.values():
         a.advertise_once()
     server = ExtenderServer(Scheduler(api), listen=("127.0.0.1", 0),
-                            resync_interval_s=0.2)
+                            resync_interval_s=resync_interval_s, watch=watch)
     server.start()
     try:
         obj = {
@@ -153,17 +153,32 @@ def test_chip_death_evicts_via_live_resync_loop():
         fs.kill_chip(ref.coords)
         advs[ref.host].advertise_once()  # the DaemonSet's health cycle
         deadline = time.monotonic() + 5.0
-        gone = False
         while time.monotonic() < deadline:
             try:
                 api.get_pod("default", "victim")
             except Exception:  # noqa: BLE001 - NotFound
-                gone = True
-                break
-            time.sleep(0.1)
-        assert gone, "resync sweep did not evict the pod on the dead chip"
+                return
+            time.sleep(0.05)
+        raise AssertionError(fail_msg)
     finally:
         server.stop()
+
+
+def test_chip_death_evicts_via_live_resync_loop():
+    # failure detection through the periodic resync sweep ALONE: the watch
+    # fast path is disabled so only the 0.2s resync tick can evict
+    _assert_chip_death_evicts(
+        0.2, watch=False, fail_msg="resync sweep did not evict the pod"
+    )
+
+
+def test_chip_death_evicts_via_node_watch_event():
+    # the event-driven fast path ALONE: resync is parked far in the future,
+    # so only the node WATCH can deliver the advertiser's health patch
+    _assert_chip_death_evicts(
+        3600.0, watch=True,
+        fail_msg="node-update event did not trigger eviction",
+    )
 
 
 def test_two_gangs_race_over_threaded_http(stack):
