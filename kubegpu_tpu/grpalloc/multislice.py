@@ -102,10 +102,41 @@ def fit_gang_multislice(
     )
 
 
+def _refit_chunk_exact_hole(
+    view: SliceView,
+    chunk: Sequence[PodInfo],
+    requests: Dict[str, TpuRequest],
+    occupied: frozenset,
+) -> Optional[Tuple[float, Dict[str, Assignment]]]:
+    """Exact-hole refit: place the replacement chunk so the gang's union on
+    this slice is a rectangle again.
+
+    The gang's surviving members hold ``occupied``; enumerate rectangles of
+    volume |occupied| + chunk chips that CONTAIN every occupied coord and
+    whose remainder is free, then bin-pack the replacements into that
+    remainder (the hole).  Best-scored such rectangle wins — usually the
+    gang's original one, if the dead member's coords are still free.
+    Returns None when no union-restoring rectangle exists (hole stolen,
+    geometry changed); the caller falls back to the best-score refit."""
+    need = sum(requests[p.key].total_chips for p in chunk)
+    if not occupied or need == 0:
+        return None
+    avail = view.free | occupied
+    for s, _, coords in _candidate_rectangles(len(occupied) + need, view, avail):
+        if not occupied <= coords:
+            continue
+        hole = frozenset(coords - occupied)
+        packed = _pack_rectangle(view, chunk, requests, hole)
+        if packed is not None:
+            return s, packed
+    return None
+
+
 def fit_gang_into_layout(
     views: Dict[str, SliceView],
     pods: Sequence[PodInfo],
     scheduled_by_slice: Dict[str, int],
+    occupied_by_slice: Optional[Dict[str, frozenset]] = None,
 ) -> MultisliceResult:
     """Place replacement members of a PARTIALLY-BOUND gang back into the
     gang's existing slice layout.
@@ -118,9 +149,14 @@ def fit_gang_into_layout(
     (equal per-slice population of CHIP members — the invariant planning
     established; ``scheduled_by_slice`` only ever counts chip-holding
     members, so the math here counts chip members too and zero-chip
-    members ride along unconstrained).  The per-slice refit places into the
-    freed chips via fit_gang — the scorer's anti-fragmentation term pulls
-    the replacement toward the hole the dead member left."""
+    members ride along unconstrained).
+
+    When ``occupied_by_slice`` supplies the surviving members' chip coords,
+    the refit first tries the EXACT-HOLE path (_refit_chunk_exact_hole):
+    the replacement goes into the dead member's freed coords — or any
+    placement restoring a rectangular union — so the gang keeps the ICI
+    property it was sold.  Best-score refit via fit_gang remains the
+    fallback (hole stolen by another tenant, slice reshaped)."""
     slices = sorted(scheduled_by_slice)
     missing = [s for s in slices if s not in views]
     if missing:
@@ -128,11 +164,13 @@ def fit_gang_into_layout(
             success=False,
             reason=f"gang's existing slice(s) {missing} no longer advertised",
         )
+    requests = {p.key: TpuRequest.from_pod(p) for p in pods}
     chip_pods = sorted(
-        (p for p in pods if TpuRequest.from_pod(p).total_chips > 0),
+        (p for p in pods if requests[p.key].total_chips > 0),
         key=lambda p: p.key,
     )
-    zero_pods = [p for p in pods if TpuRequest.from_pod(p).total_chips == 0]
+    zero_pods = [p for p in pods if requests[p.key].total_chips == 0]
+    occupied_by_slice = occupied_by_slice or {}
 
     def _with_zeros(res: MultisliceResult) -> MultisliceResult:
         if res.success:
@@ -140,18 +178,31 @@ def fit_gang_into_layout(
                 res.per_pod[p.key] = Assignment(node="", slice_id=slices[0])
         return res
 
+    def _refit(sid: str, chunk: Sequence[PodInfo]):
+        """(score, per_pod) on slice `sid`, exact-hole first; or GangResult
+        -like failure reason."""
+        hole = _refit_chunk_exact_hole(
+            views[sid], chunk, requests,
+            frozenset(occupied_by_slice.get(sid) or ()),
+        )
+        if hole is not None:
+            return hole
+        g = fit_gang(views[sid], chunk)
+        if not g.success:
+            return g.reason
+        return g.score, dict(g.per_pod)
+
     if len(slices) == 1:
-        g = fit_gang(views[slices[0]], chip_pods)
+        r = _refit(slices[0], chip_pods)
+        if isinstance(r, str):
+            return MultisliceResult(
+                success=False,
+                reason=f"cannot rejoin gang's slice {slices[0]}: {r}",
+            )
+        score, per_pod = r
         return _with_zeros(
             MultisliceResult(
-                success=g.success,
-                reason=(
-                    "" if g.success
-                    else f"cannot rejoin gang's slice {slices[0]}: {g.reason}"
-                ),
-                score=g.score,
-                per_pod=dict(g.per_pod),
-                slice_ids=slices,
+                success=True, score=score, per_pod=per_pod, slice_ids=slices
             )
         )
     total_chip_members = sum(scheduled_by_slice.values()) + len(chip_pods)
@@ -178,14 +229,15 @@ def fit_gang_into_layout(
         i += deficit
         if not chunk:
             continue
-        g = fit_gang(views[sid], chunk)
-        if not g.success:
+        r = _refit(sid, chunk)
+        if isinstance(r, str):
             return MultisliceResult(
                 success=False,
-                reason=f"cannot rejoin gang's slice {sid}: {g.reason}",
+                reason=f"cannot rejoin gang's slice {sid}: {r}",
             )
-        merged.update(g.per_pod)
-        total += g.score
+        score, per_pod = r
+        merged.update(per_pod)
+        total += score
     if i != len(chip_pods):
         return MultisliceResult(
             success=False,

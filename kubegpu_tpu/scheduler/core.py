@@ -244,15 +244,13 @@ class Scheduler:
         members = self._members_for_preemption(pod)
         if members is None:
             return False
-        allowed_slices = self._restrict_to_layout(pod, allowed_slices)
+        layout, occupied = self._layout_occupancy(pod)
+        allowed_slices = self._restrict_to_layout(pod, allowed_slices, layout)
         if not allowed_slices:
             return False
-        pods_raw = self.api.list_pods()
-        with self.cache.lock:
-            units = collect_units(pods_raw, self.cache.assignments_snapshot())
-            decision = find_victims(
-                self.cache.views(), units, members, pod.priority, allowed_slices
-            )
+        decision, _ = self._find_victim_decision(
+            pod, members, allowed_slices, layout, occupied
+        )
         if decision is None or not decision.victims:
             return False
         for u in decision.victims:
@@ -273,30 +271,101 @@ class Scheduler:
         )
         return True
 
-    def _restrict_to_layout(self, pod: PodInfo, allowed: Optional[set]):
+    def _layout_occupancy(self, pod: PodInfo):
+        """The gang's anchored-refit inputs, computed ONCE per preemption
+        attempt and outside any lock (it LISTs pods)."""
+        if not pod.pod_group:
+            return {}, {}
+        return self.groups.layout_and_occupancy_of(pod)
+
+    def _restrict_to_layout(self, pod: PodInfo, allowed: Optional[set],
+                            layout: Dict[str, int]):
         """Align eviction simulation with anchored re-planning: a
         partially-bound gang can only use its existing slice layout
         (podgroup.fit_gang_into_layout), so victims elsewhere would die for
-        zero benefit.  Single-slice layouts restrict the search to that
-        slice; multi-slice layouts need joint cross-slice deficits that the
-        per-slice victim search cannot model, so preemption is declined
-        (None with an empty set => caller gives up)."""
+        zero benefit.  The search is restricted to the layout's slices —
+        one or many; multi-slice layouts take the joint cross-slice victim
+        search in _find_victim_decision."""
         if pod.slice_selector is not None:
             allowed = (
                 set(pod.slice_selector)
                 if allowed is None
                 else allowed & pod.slice_selector
             )
-        if not pod.pod_group:
-            return allowed
-        layout = self.groups.layout_of(pod)
         if not layout:
             return allowed
-        if len(layout) > 1:
-            return set()
         if allowed is None:
             return set(layout)
         return allowed & set(layout)
+
+    def _find_victim_decision(self, pod: PodInfo, members, allowed,
+                              layout, occupied):
+        """Victim search shaped to how the gang would actually re-place:
+
+        - anchored MULTI-slice gang → joint cross-slice search judged by
+          the same fit_gang_into_layout call try_plan will make;
+        - everything else → the per-slice find_victims;
+        - fresh multislice-opted gang that no single slice can host even
+          with eviction → joint search judged by fit_gang_multislice.
+
+        Returns (decision, assignments snapshot) from ONE cache-lock
+        acquisition so callers map victims to nodes from the same state
+        the decision was computed against."""
+        from kubegpu_tpu.grpalloc.multislice import (
+            fit_gang_into_layout,
+            fit_gang_multislice,
+        )
+        from kubegpu_tpu.scheduler.preemption import find_victims_joint
+
+        pods_raw = self.api.list_pods()
+        selector = pod.slice_selector
+        with self.cache.lock:
+            assignments = self.cache.assignments_snapshot()
+            units = collect_units(pods_raw, assignments)
+            views = self.cache.views()
+            if len(layout) > 1:
+                def fits_layout(trial):
+                    # mirror try_plan exactly: views are filtered by the
+                    # pod's slice selector BEFORE the anchored refit, so a
+                    # recreated member whose selector excludes a layout
+                    # slice fails here too — never evict for a re-plan
+                    # that is guaranteed to refuse
+                    tv = {
+                        sid: v
+                        for sid, v in trial.items()
+                        if selector is None or sid in selector
+                    }
+                    return fit_gang_into_layout(
+                        tv, members, layout, occupied
+                    ).success
+
+                return (
+                    find_victims_joint(
+                        views, units, pod.priority, fits_layout, allowed
+                    ),
+                    assignments,
+                )
+            decision = find_victims(views, units, members, pod.priority, allowed)
+            if decision is not None:
+                return decision, assignments
+            if pod.allow_multislice and not layout:
+                def fits_ms(trial):
+                    tv = {
+                        sid: v
+                        for sid, v in trial.items()
+                        if allowed is None or sid in allowed
+                    }
+                    return fit_gang_multislice(
+                        tv, members, allow_multislice=True
+                    ).success
+
+                return (
+                    find_victims_joint(
+                        views, units, pod.priority, fits_ms, allowed
+                    ),
+                    assignments,
+                )
+            return None, assignments
 
     def preemption_victims(
         self, pod_obj: dict, candidate_nodes: Optional[List[str]] = None
@@ -316,16 +385,13 @@ class Scheduler:
         )
         if candidate_nodes is not None and not allowed:
             return {}
-        allowed = self._restrict_to_layout(pod, allowed)
+        layout, occupied = self._layout_occupancy(pod)
+        allowed = self._restrict_to_layout(pod, allowed, layout)
         if allowed is not None and not allowed:
             return {}
-        pods_raw = self.api.list_pods()
-        with self.cache.lock:
-            assignments = self.cache.assignments_snapshot()
-            units = collect_units(pods_raw, assignments)
-            decision = find_victims(
-                self.cache.views(), units, members, pod.priority, allowed
-            )
+        decision, assignments = self._find_victim_decision(
+            pod, members, allowed, layout, occupied
+        )
         if decision is None:
             return {}
         by_node: Dict[str, List[str]] = {}

@@ -27,6 +27,22 @@ from kubegpu_tpu.types.info import Assignment, PodInfo, TpuRequest
 log = logging.getLogger(__name__)
 
 
+def fold_layout(sched_slices, sched_coords):
+    """(layout counts, occupied coords per slice) from a gang's scheduled
+    members — the ONE aggregation both planning (try_plan) and preemption
+    simulation (layout_and_occupancy_of) consume, so they can never
+    drift."""
+    layout: Dict[str, int] = {}
+    occupied: Dict[str, frozenset] = {}
+    for key, sid in sched_slices.items():
+        if not sid:
+            continue
+        layout[sid] = layout.get(sid, 0) + 1
+        if key in sched_coords:
+            occupied[sid] = occupied.get(sid, frozenset()) | sched_coords[key]
+    return layout, occupied
+
+
 @dataclass
 class GangPlan:
     group: str                       # namespace/groupname
@@ -97,7 +113,7 @@ class PodGroupRegistry:
         the remainder instead of deadlocking on its own bound members."""
         gk = self.group_key(pod)
         assert gk is not None
-        pending, scheduled, sched_slices = self._gather_members(pod)
+        pending, scheduled, sched_slices, sched_coords = self._gather_members(pod)
         with self._lock:
             existing = self.plan_for(pod, now=now)
             if existing:
@@ -136,10 +152,7 @@ class PodGroupRegistry:
                                 f"slice-selector {sorted(pod.slice_selector)}"
                             )
                         )
-                layout: Dict[str, int] = {}
-                for sid in sched_slices.values():
-                    if sid:
-                        layout[sid] = layout.get(sid, 0) + 1
+                layout, occupied = fold_layout(sched_slices, sched_coords)
                 # Anchored-refit math assumes every scheduled CHIP member is
                 # counted in the layout.  A member whose slice cannot be
                 # recovered (assignment annotation cleared mid-eviction, no
@@ -168,8 +181,10 @@ class PodGroupRegistry:
                 if layout:
                     # partially-bound gang: replacements must rejoin the
                     # existing slice layout — the running siblings'
-                    # rendezvous/megascale env is already baked in
-                    g = fit_gang_into_layout(views, members, layout)
+                    # rendezvous/megascale env is already baked in — and,
+                    # where the survivors' coords are recoverable, restore
+                    # the gang's rectangular union (exact-hole refit)
+                    g = fit_gang_into_layout(views, members, layout, occupied)
                 else:
                     g = fit_gang_multislice(
                         views, members, allow_multislice=pod.allow_multislice
@@ -225,17 +240,20 @@ class PodGroupRegistry:
         consults this so eviction simulation can never free chips on a
         slice an anchored re-plan (try_plan's fit_gang_into_layout path)
         would refuse to use."""
-        _, _, sched_slices = self._gather_members(pod)
-        out: Dict[str, int] = {}
-        for sid in sched_slices.values():
-            if sid:
-                out[sid] = out.get(sid, 0) + 1
-        return out
+        return self.layout_and_occupancy_of(pod)[0]
+
+    def layout_and_occupancy_of(self, pod: PodInfo):
+        """(layout counts, occupied coords per slice) of the pod's gang —
+        the full anchored-refit inputs, so preemption can simulate exactly
+        the fit_gang_into_layout call try_plan will make after eviction.
+        Performs a (blocking) pod LIST; call it OUTSIDE the cache lock."""
+        _, _, sched_slices, sched_coords = self._gather_members(pod)
+        return fold_layout(sched_slices, sched_coords)
 
     def planned_members(self, pod: PodInfo) -> Optional[List[PodInfo]]:
         """The member set try_plan would plan for this pod right now (used
         by preemption simulation so it can never diverge from planning)."""
-        pending, scheduled, _ = self._gather_members(pod)
+        pending, scheduled, _, _ = self._gather_members(pod)
         if len(pending) + len(scheduled) < pod.pod_group_size:
             return None
         return self._select_members(pod, pending, scheduled)
@@ -251,6 +269,7 @@ class PodGroupRegistry:
         scheduled = {}
         seen = {}
         slices = {}
+        coords = {}
         for obj in self.cache.api.list_pods(namespace=pod.namespace):
             try:
                 p = annotations.pod_from_k8s(obj)
@@ -276,17 +295,27 @@ class PodGroupRegistry:
                 a = annotations.assignment_from_pod(obj)
                 if a is not None and a.slice_id and a.all_chips():
                     slices[p.key] = a.slice_id
+                    coords[p.key] = frozenset(c.coords for c in a.all_chips())
         seen.setdefault(pod.key, pod)
         for key, p in seen.items():
             ca = self.cache.assignment_of(key)
             if ca is not None and ca.slice_id and ca.all_chips():
                 slices.setdefault(key, ca.slice_id)
+                coords.setdefault(
+                    key, frozenset(c.coords for c in ca.all_chips())
+                )
             if p.node_name or (key != pod.key and ca is not None):
                 scheduled[key] = p
             else:
                 pending[key] = p
         sched_slices = {k: slices.get(k) for k in scheduled}
-        return list(pending.values()), list(scheduled.values()), sched_slices
+        sched_coords = {k: coords[k] for k in scheduled if k in coords}
+        return (
+            list(pending.values()),
+            list(scheduled.values()),
+            sched_slices,
+            sched_coords,
+        )
 
     def mark_committed(self, pod_key: str, group_key: str) -> None:
         with self._lock:

@@ -141,3 +141,70 @@ def find_victims(
         ):
             best = decision
     return best
+
+
+def find_victims_joint(
+    views: Dict[str, SliceView],
+    units: Sequence[VictimUnit],
+    incoming_priority: int,
+    fits,
+    allowed_slices: Optional[Set[str]] = None,
+) -> Optional[PreemptionDecision]:
+    """Joint CROSS-SLICE victim search for layout-shaped requests — the
+    per-slice :func:`find_victims` cannot model a gang that needs chips
+    freed on several slices at once (a fresh multislice gang, or an
+    anchored multislice gang refilling per-slice deficits).
+
+    ``fits(trial_views) -> bool`` judges a hypothetical eviction (e.g.
+    ``fit_gang_into_layout(...).success``); victims accumulate least-
+    valuable-first across ALL allowed slices until it holds, then the set
+    is minimized most-valuable-first.  A victim unit may itself span
+    slices (multislice gangs evict whole)."""
+    def trial(freed_by_slice: Dict[str, Set[Tuple[int, ...]]]):
+        return {
+            sid: (
+                dataclasses.replace(
+                    v, used=frozenset(v.used - freed_by_slice[sid])
+                )
+                if sid in freed_by_slice
+                else v
+            )
+            for sid, v in views.items()
+        }
+
+    def freeable(u: VictimUnit) -> Dict[str, Set[Tuple[int, ...]]]:
+        return {
+            sid: set(cs)
+            for sid, cs in u.coords_by_slice.items()
+            if allowed_slices is None or sid in allowed_slices
+        }
+
+    candidates = sorted(
+        (
+            u
+            for u in units
+            if u.priority < incoming_priority and freeable(u)
+        ),
+        key=lambda u: (u.priority, u.total_chips, u.unit_id),
+    )
+    if fits(trial({})):
+        return PreemptionDecision(slice_id="", victims=[])
+    freed: Dict[str, Set[Tuple[int, ...]]] = {}
+    chosen: List[VictimUnit] = []
+    for u in candidates:
+        chosen.append(u)
+        for sid, cs in freeable(u).items():
+            freed.setdefault(sid, set()).update(cs)
+        if fits(trial(freed)):
+            break
+    else:
+        return None
+    # minimize: drop most-valuable-first any unit not actually needed
+    for u in sorted(chosen, key=lambda u: (-u.priority, -u.total_chips)):
+        trial_freed = {sid: set(cs) for sid, cs in freed.items()}
+        for sid, cs in freeable(u).items():
+            trial_freed[sid] -= cs
+        if fits(trial(trial_freed)):
+            chosen.remove(u)
+            freed = trial_freed
+    return PreemptionDecision(slice_id="", victims=chosen)

@@ -576,6 +576,122 @@ def test_layout_refit_counts_chip_members_only():
     assert res.per_pod["default/zz-coord"].all_chips() == []
 
 
+def test_exact_hole_refit_restores_rectangular_union():
+    """VERDICT r1 #5: a replacement must prefer the dead member's freed
+    coords so the gang's union stays rectangular — best-score refit alone
+    provably does not (documented by the fit_gang probe below)."""
+    from kubegpu_tpu.grpalloc.allocator import fit_gang
+    from kubegpu_tpu.grpalloc.multislice import fit_gang_into_layout
+
+    views = build_slice_views(make_nodes("sa").values())
+    v = views["sa"]
+    occupied = frozenset({(0, 0), (0, 1), (1, 0)})  # survivors of a 2x2 gang
+    v.used = occupied  # the dead member's (1, 1) is free again
+
+    # the old path (plain best-score fit_gang) picks a non-hole chip:
+    g = fit_gang(v, gang(4, 1)[3:])
+    old_pick = {c.coords for c in g.per_pod["default/w3"].all_chips()}
+    assert not is_contiguous_submesh(old_pick | occupied, (4, 4))
+
+    res = fit_gang_into_layout(views, gang(4, 1)[3:], {"sa": 3}, {"sa": occupied})
+    assert res.success, res.reason
+    new_pick = {c.coords for c in res.per_pod["default/w3"].all_chips()}
+    assert new_pick == {(1, 1)}
+    assert is_contiguous_submesh(new_pick | occupied, (4, 4))
+
+
+def test_exact_hole_refit_falls_back_when_hole_taken():
+    from kubegpu_tpu.grpalloc.multislice import fit_gang_into_layout
+
+    views = build_slice_views(make_nodes("sa").values())
+    v = views["sa"]
+    occupied = frozenset({(0, 0), (0, 1), (1, 0)})
+    v.used = occupied | {(1, 1)}  # another tenant stole the hole
+    res = fit_gang_into_layout(views, gang(4, 1)[3:], {"sa": 3}, {"sa": occupied})
+    assert res.success, res.reason  # best-score fallback still places it
+    pick = {c.coords for c in res.per_pod["default/w3"].all_chips()}
+    assert pick and (1, 1) not in pick
+
+
+def test_exact_hole_refit_multislice_deficit():
+    # gang 4+4 over two slices; one sb member (2 chips at (0,0),(0,1)... )
+    # died — the sb replacement must restore sb's rectangle
+    from kubegpu_tpu.grpalloc.multislice import fit_gang_into_layout
+
+    views = two_slice_views()
+    sa_occ = frozenset({(0, 0), (0, 1), (1, 0), (1, 1),
+                        (2, 0), (2, 1), (3, 0), (3, 1)})  # 4 members x 2
+    sb_occ = frozenset({(0, 0), (0, 1), (1, 0), (1, 1), (2, 0), (2, 1)})
+    views["sa"].used = sa_occ
+    views["sb"].used = sb_occ  # (3, 0), (3, 1) freed by the dead member
+    pending = gang(8, 2, multislice=True)[7:]
+    res = fit_gang_into_layout(
+        views, pending, {"sa": 4, "sb": 3},
+        {"sa": sa_occ, "sb": sb_occ},
+    )
+    assert res.success, res.reason
+    pick = {c.coords for c in res.per_pod["default/w7"].all_chips()}
+    assert res.per_pod["default/w7"].slice_id == "sb"
+    assert pick == {(3, 0), (3, 1)}
+    assert is_contiguous_submesh(pick | sb_occ, (4, 4))
+
+
+def test_replacement_pod_reclaims_dead_members_chips_end_to_end():
+    """Scheduler-level: delete one member of a bound gang, recreate it, and
+    the anchored re-plan hands the replacement EXACTLY the freed coords —
+    the gang's rectangle survives member churn."""
+    api = InMemoryApiServer()
+    fs = FakeSlice(slice_id="v5e-16", mesh_shape=(4, 4), host_block=(2, 2))
+    for prov in fs.providers().values():
+        Advertiser(prov, api).advertise_once()
+    sched = Scheduler(api, metrics=Metrics())
+    sched.cache.refresh()
+    pods = [
+        {
+            "metadata": {
+                "name": f"w{i}", "namespace": "default",
+                "annotations": {
+                    annotations.POD_GROUP: "g",
+                    annotations.POD_GROUP_SIZE: "4",
+                },
+            },
+            "spec": {"containers": [
+                {"name": "m", "resources": {"limits": {RES_TPU: "1"}}}]},
+        }
+        for i in range(4)
+    ]
+    for obj in pods:
+        api.create_pod(obj)
+    schedule_all(api, sched, pods)
+    before = {
+        name: {c.coords for c in
+               annotations.assignment_from_pod(api.get_pod("default", name)).all_chips()}
+        for name in ("w0", "w1", "w2", "w3")
+    }
+    union_before = set().union(*before.values())
+    assert is_contiguous_submesh(union_before, (4, 4))
+
+    # the member dies (controller will recreate it)
+    victim = api.get_pod("default", "w2")
+    api.delete_pod("default", "w2")
+    sched.on_pod_deleted(victim)
+    api.create_pod({
+        "metadata": {
+            "name": "w2", "namespace": "default",
+            "annotations": {
+                annotations.POD_GROUP: "g",
+                annotations.POD_GROUP_SIZE: "4",
+            },
+        },
+        "spec": {"containers": [
+            {"name": "m", "resources": {"limits": {RES_TPU: "1"}}}]},
+    })
+    schedule_all(api, sched, [api.get_pod("default", "w2")])
+    after = {c.coords for c in
+             annotations.assignment_from_pod(api.get_pod("default", "w2")).all_chips()}
+    assert after == before["w2"], (after, before["w2"])
+
+
 def test_malformed_pending_sibling_keeps_gang_waiting():
     # a PENDING member with an unparseable quantity can never pass its own
     # strict filter — the gang must wait, not plan around it as a 0-chip

@@ -205,3 +205,121 @@ def test_find_victims_minimal_set():
     )
     assert d is not None and len(d.victims) == 1
     assert d.victims[0].priority == 1  # cheapest victim chosen
+
+
+# -- multislice preemption (joint cross-slice victim search) ----------------
+
+def two_slice_cluster():
+    from kubegpu_tpu.plugins import Advertiser, FakeSlice
+    from kubegpu_tpu.utils import InMemoryApiServer
+
+    api = InMemoryApiServer()
+    slices = {}
+    for sid in ("sa", "sb"):
+        fs = FakeSlice(slice_id=sid, mesh_shape=(4, 4), host_block=(2, 2))
+        slices[sid] = fs
+        for prov in fs.providers().values():
+            Advertiser(prov, api).advertise_once()
+    return api, slices
+
+
+def ms_pod(name, chips, group, size, priority=0):
+    o = pod_obj(name, chips, group=group, group_size=size)
+    o["metadata"]["annotations"][annotations.POD_MULTISLICE] = "true"
+    if priority:
+        o["metadata"]["annotations"][annotations.POD_PRIORITY] = str(priority)
+    return o
+
+
+def schedule_all_pods(sched, api, objs):
+    for o in objs:
+        name = o["metadata"]["name"]
+        r = sched.filter(o, nodes_of(api))
+        assert r.nodes, f"{name}: {r.failed}"
+        err = sched.bind("default", name, r.nodes[0])
+        assert err is None, err
+
+
+def test_fresh_multislice_gang_preempts_on_both_slices():
+    """VERDICT r1 #6: a 2-slice gang preempts lower-priority units on BOTH
+    its slices — the per-slice victim search cannot model this (the gang
+    needs 16 chips per slice; each slice holds an 8-chip squatter)."""
+    api, _ = two_slice_cluster()
+    sched = make_sched(api)
+    # low-priority squatters: 8 of 16 chips on each slice — the incoming
+    # gang needs all 16 of both, so eviction must hit both slices at once
+    for sid_tag in ("a", "b"):
+        objs = [
+            ms_pod(f"{sid_tag}{i}", 4, group=f"tenant-{sid_tag}", size=2,
+                   priority=1)
+            for i in range(2)
+        ]
+        for o in objs:
+            # pin each squatter gang to its own slice so the setup is
+            # deterministic (they are single-slice gangs)
+            o["metadata"]["annotations"][annotations.POD_SLICE_SELECTOR] = (
+                "sa" if sid_tag == "a" else "sb"
+            )
+            del o["metadata"]["annotations"][annotations.POD_MULTISLICE]
+            api.create_pod(o)
+        schedule_all_pods(sched, api, objs)
+
+    # incoming: 8 x 4 chips = 32 > any slice; needs ALL chips of both
+    big = [ms_pod(f"m{i}", 4, group="big", size=8, priority=5) for i in range(8)]
+    for o in big:
+        api.create_pod(o)
+    schedule_all_pods(sched, api, big)
+
+    assert sched.metrics.get("kubegpu_preemptions_total") >= 1
+    # both squatter gangs were evicted whole
+    left = {p["metadata"]["name"] for p in api.list_pods()}
+    assert not any(n.startswith(("a", "b")) for n in left), left
+    per_slice = {}
+    for i in range(8):
+        a = annotations.assignment_from_pod(api.get_pod("default", f"m{i}"))
+        assert a is not None and len(a.all_chips()) == 4
+        per_slice.setdefault(a.slice_id, set()).update(
+            c.coords for c in a.all_chips()
+        )
+    assert set(per_slice) == {"sa", "sb"}
+    assert all(len(v) == 16 for v in per_slice.values())
+
+
+def test_anchored_multislice_gang_replacement_preempts_squatter():
+    """A partially-bound 2-slice gang whose dead member's chips were grabbed
+    by a lower-priority pod: the anchored re-plan must preempt the squatter
+    on exactly the deficit slice (previously declined outright)."""
+    api, _ = two_slice_cluster()
+    sched = make_sched(api)
+    gang = [ms_pod(f"m{i}", 4, group="big", size=8, priority=5) for i in range(8)]
+    for o in gang:
+        api.create_pod(o)
+    schedule_all_pods(sched, api, gang)
+    layouts = {}
+    for i in range(8):
+        a = annotations.assignment_from_pod(api.get_pod("default", f"m{i}"))
+        layouts[f"m{i}"] = (a.slice_id, {c.coords for c in a.all_chips()})
+
+    # one member dies; a low-priority squatter grabs its freed chips
+    victim_name = "m7"
+    dead_slice, dead_coords = layouts[victim_name]
+    dead = api.get_pod("default", victim_name)
+    api.delete_pod("default", victim_name)
+    sched.on_pod_deleted(dead)
+    squatter = pod_obj("squat", 4)
+    squatter["metadata"]["annotations"][annotations.POD_PRIORITY] = "1"
+    api.create_pod(squatter)
+    schedule_all_pods(sched, api, [squatter])
+    sq = annotations.assignment_from_pod(api.get_pod("default", "squat"))
+    assert sq.slice_id == dead_slice  # it took the only free chips
+
+    # the replacement member arrives; anchored re-plan must evict the
+    # squatter and reclaim the dead member's exact coords
+    repl = ms_pod(victim_name, 4, group="big", size=8, priority=5)
+    api.create_pod(repl)
+    schedule_all_pods(sched, api, [repl])
+    with pytest.raises(Exception):
+        api.get_pod("default", "squat")  # evicted
+    a = annotations.assignment_from_pod(api.get_pod("default", victim_name))
+    assert a.slice_id == dead_slice
+    assert {c.coords for c in a.all_chips()} == dead_coords
