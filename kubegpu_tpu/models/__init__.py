@@ -26,6 +26,7 @@ from kubegpu_tpu.models.moe import MoEMLP, MoeBlock, MoeTransformerLM
 # as a submodule where needed.
 from kubegpu_tpu.models.pipeline_lm import (
     init_pipeline_lm,
+    to_circular_layout,
     make_pipeline_lm_train_step,
     pipeline_lm_logits,
     place_pipeline_lm,
@@ -66,6 +67,7 @@ __all__ = [
     "MoeBlock",
     "MoeTransformerLM",
     "init_pipeline_lm",
+    "to_circular_layout",
     "make_pipeline_lm_train_step",
     "pipeline_lm_logits",
     "place_pipeline_lm",

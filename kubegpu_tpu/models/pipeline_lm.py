@@ -104,6 +104,24 @@ def init_pipeline_lm(
     }
 
 
+def to_circular_layout(params: Dict[str, Any], num_devices: int) -> Dict[str, Any]:
+    """Re-stack blocks [S_total, K, ...] → [V, P, K, ...] for the circular
+    schedule: global stage ``s = v*P + p`` lands at index [v, p], so a
+    row-major flatten restores stage order (the sequential oracle relies on
+    this)."""
+    s_total = jax.tree_util.tree_leaves(params["blocks"])[0].shape[0]
+    if s_total % num_devices:
+        raise ValueError(
+            f"{s_total} stages do not split over {num_devices} devices"
+        )
+    out = dict(params)
+    out["blocks"] = jax.tree.map(
+        lambda a: a.reshape((s_total // num_devices, num_devices) + a.shape[1:]),
+        params["blocks"],
+    )
+    return out
+
+
 def stage_apply(stage_params, x, num_heads: int):
     """Apply this stage's K stacked layers via scan-over-layers."""
 
@@ -127,26 +145,37 @@ def pipeline_lm_logits(
     num_heads: int,
     num_microbatches: int,
     axis: str = PIPE_AXIS,
+    num_rounds: int = 1,
 ):
     """Forward through the pipelined block stack; batch must divide into
-    ``num_microbatches`` equal microbatches."""
+    ``num_microbatches`` equal microbatches.  ``num_rounds > 1`` selects
+    the circular schedule and expects blocks in the [V, P, K, ...] layout
+    (:func:`to_circular_layout`)."""
     b, t = tokens.shape
     if b % num_microbatches != 0:
         raise ValueError(f"batch {b} not divisible by {num_microbatches} microbatches")
     x = params["embed"][tokens] + params["pos"][:t][None]
     stream = x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
-    run = pipeline_apply(partial(stage_apply, num_heads=num_heads), mesh, axis)
+    run = pipeline_apply(
+        partial(stage_apply, num_heads=num_heads), mesh, axis,
+        num_rounds=num_rounds,
+    )
     out = run(params["blocks"], stream)
     return _head(params, out.reshape(b, t, -1))
 
 
 def sequential_lm_logits(params, tokens, *, num_heads: int):
     """Same math with no pipelining (the correctness oracle): flatten the
-    [S, K] stage dims and scan every layer in order on the full batch."""
+    [S, K] (or circular [V, P, K]) stage dims — row-major restores global
+    stage order in both layouts — and scan every layer in order on the
+    full batch."""
     b, t = tokens.shape
     x = params["embed"][tokens] + params["pos"][:t][None]
+    # leading stage dims = everything before each leaf's payload; the 1-dim
+    # ln scale tells us how many there are (2 for gpipe, 3 for circular)
+    lead = params["blocks"]["ln1_scale"].ndim - 1
     flat = jax.tree.map(
-        lambda a: a.reshape((-1,) + a.shape[2:]), params["blocks"]
+        lambda a: a.reshape((-1,) + a.shape[lead:]), params["blocks"]
     )
     x = stage_apply(flat, x, num_heads)
     return _head(params, x)
@@ -156,16 +185,19 @@ def sequential_lm_logits(params, tokens, *, num_heads: int):
 # Training (DP-free pure PP step; compose with DP by adding a "data" axis)
 # ---------------------------------------------------------------------------
 
-def place_pipeline_lm(params, opt_state, tokens, mesh: Mesh, axis: str = PIPE_AXIS):
-    """Blocks (and their mirrored optimizer moments) sharded stage-major
-    over "pipe"; everything else replicated.  Optax moment pytrees mirror
-    the param tree, so one path rule — "under a 'blocks' key" — shards
-    both consistently."""
+def place_pipeline_lm(params, opt_state, tokens, mesh: Mesh, axis: str = PIPE_AXIS,
+                      num_rounds: int = 1):
+    """Blocks (and their mirrored optimizer moments) sharded over "pipe" —
+    the stage dim for GPipe, the device dim of the circular [V, P, ...]
+    layout; everything else replicated.  Optax moment pytrees mirror the
+    param tree, so one path rule — "under a 'blocks' key" — shards both
+    consistently."""
+    blocks_spec = P(axis) if num_rounds == 1 else P(None, axis)
 
     def shardings_for(tree):
         def spec(path, _leaf):
             pipelined = any(getattr(k, "key", None) == "blocks" for k in path)
-            return NamedSharding(mesh, P(axis) if pipelined else P())
+            return NamedSharding(mesh, blocks_spec if pipelined else P())
 
         return jax.tree_util.tree_map_with_path(spec, tree)
 
@@ -182,6 +214,7 @@ def make_pipeline_lm_train_step(
     num_heads: int,
     num_microbatches: int,
     axis: str = PIPE_AXIS,
+    num_rounds: int = 1,
     donate: bool = True,
 ):
     from kubegpu_tpu.models.train import cross_entropy
@@ -194,6 +227,7 @@ def make_pipeline_lm_train_step(
             num_heads=num_heads,
             num_microbatches=num_microbatches,
             axis=axis,
+            num_rounds=num_rounds,
         )
         return cross_entropy(logits, tokens[:, 1:])
 

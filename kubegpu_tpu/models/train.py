@@ -18,7 +18,9 @@ import optax
 from jax.sharding import Mesh
 
 from kubegpu_tpu.parallel.sharding import (
+    MODEL_AXIS,
     MOE_EP_RULES,
+    MOE_EP_TP_RULES,
     TRANSFORMER_TP_RULES,
     batch_sharding,
     current_mesh,
@@ -179,9 +181,12 @@ def make_moe_train_step(mesh: Mesh, aux_weight: float = 0.01, donate: bool = Tru
 
 
 def place_moe(state: TrainState, tokens, mesh: Mesh):
-    """EP placement per MOE_EP_RULES (params AND mirrored optimizer
-    moments); batch sharded over "data"."""
-    state = jax.device_put(state, state_shardings(state, mesh, MOE_EP_RULES))
+    """EP placement (params AND mirrored optimizer moments); batch sharded
+    over "data".  A mesh carrying a "model" axis takes the EP x TP rules:
+    expert FFNs Megatron-sharded inside their expert shard, attention/
+    embed/head TP-sharded."""
+    rules = MOE_EP_TP_RULES if MODEL_AXIS in mesh.axis_names else MOE_EP_RULES
+    state = jax.device_put(state, state_shardings(state, mesh, rules))
     tokens = jax.device_put(tokens, batch_sharding(mesh))
     return state, tokens
 

@@ -306,9 +306,17 @@ def _run_lm_family(args, t0: float) -> int:
             attn_impl=args.attn_impl if args.attn_impl != "flash" else "ring",
         )
         place, make_step = place_cp_lm, make_lm_train_step
-    else:  # moe
-        dp, ep = _split_mesh(n, args.ep, "ep")
-        mesh = device_mesh({"data": dp, "expert": ep})
+    else:  # moe — EP, optionally x TP (--tp shards each expert's FFN too)
+        tp = max(args.tp, 1)
+        if n % tp:
+            raise SystemExit(f"--tp {tp} does not divide the device count {n}")
+        dp, ep = _split_mesh(n // tp, args.ep, "ep")
+        axes = {"data": dp, "expert": ep}
+        if tp > 1:
+            if args.heads % tp:
+                raise SystemExit(f"--heads {args.heads} not divisible by tp={tp}")
+            axes["model"] = tp
+        mesh = device_mesh(axes)
         model = MoeTransformerLM(
             vocab_size=args.vocab, num_layers=args.layers, num_heads=args.heads,
             hidden=args.hidden, num_experts=args.num_experts or ep,
@@ -371,12 +379,23 @@ def _run_pp(args, t0: float) -> int:
             f"--pp-stages {stages} != device count {n}: in a multi-process "
             "gang the pipeline must span every device"
         )
+    rounds = max(args.pp_rounds, 1)
+    if rounds > 1 and args.microbatches < stages:
+        raise SystemExit(
+            f"--pp-rounds {rounds} (circular schedule) needs "
+            f"--microbatches >= stages ({args.microbatches} < {stages})"
+        )
     mesh = device_mesh({"pipe": stages}, devices=jax.devices()[:stages])
     batch = max(args.batch_per_chip, 1) * max(args.microbatches, 1)
     params = init_pipeline_lm(
-        jax.random.PRNGKey(0), vocab_size=args.vocab, num_stages=stages,
-        layers_per_stage=args.layers, hidden=args.hidden, max_seq=args.seq + 1,
+        jax.random.PRNGKey(0), vocab_size=args.vocab,
+        num_stages=stages * rounds, layers_per_stage=args.layers,
+        hidden=args.hidden, max_seq=args.seq + 1,
     )
+    if rounds > 1:
+        from kubegpu_tpu.models import to_circular_layout
+
+        params = to_circular_layout(params, stages)
     tx = optax.sgd(0.1, momentum=0.9)
     opt = tx.init(params)
     sharding = NamedSharding(mesh, P())
@@ -386,9 +405,12 @@ def _run_pp(args, t0: float) -> int:
     batches, tokens = _make_batches(
         args, source, sharding, lambda: put_global(next(source), sharding)
     )
-    params, opt, tokens = place_pipeline_lm(params, opt, tokens, mesh)
+    params, opt, tokens = place_pipeline_lm(
+        params, opt, tokens, mesh, num_rounds=rounds
+    )
     step = make_pipeline_lm_train_step(
-        mesh, tx, num_heads=args.heads, num_microbatches=args.microbatches
+        mesh, tx, num_heads=args.heads, num_microbatches=args.microbatches,
+        num_rounds=rounds,
     )
     const = tokens
 
@@ -440,8 +462,12 @@ def main(argv=None) -> int:
                     help="moe: expert count (0 = one per ep shard)")
     ap.add_argument("--pp-stages", type=int, default=0,
                     help="pp: pipeline stages (0 = all devices)")
+    ap.add_argument("--pp-rounds", type=int, default=1,
+                    help="pp: rounds of the circular/interleaved schedule "
+                    "(1 = GPipe; V>1 holds V stage slices per device and "
+                    "divides the pipeline bubble by ~V)")
     ap.add_argument("--microbatches", type=int, default=4,
-                    help="pp: GPipe microbatches per step")
+                    help="pp: microbatches per step (circular needs >= stages)")
     ap.add_argument(
         "--ckpt-dir",
         default=os.environ.get("KUBEGPU_CKPT_DIR", ""),

@@ -1,23 +1,34 @@
-"""GPipe-style pipeline parallelism: shard_map + ppermute over a "pipe" axis.
+"""Pipeline parallelism: shard_map + ppermute over a "pipe" axis.
 
 The fourth parallelism mode the placement layer serves (with DP/TP/SP/EP/CP):
 stages are laid out along one mesh axis so stage-boundary activations hop
 exactly one ICI link per tick (``ppermute`` with a +1 shift), never crossing
 the mesh — the reason grpalloc hands out *contiguous* sub-meshes.
 
-TPU-first schedule (NOT a torch-style per-rank send/recv loop):
+TPU-first schedules (NOT a torch-style per-rank send/recv loop):
 
-- SPMD: every device runs the SAME jitted scan of ``M + S - 1`` ticks; at
-  tick ``t`` the device holding stage ``s`` processes microbatch ``t - s``
-  (bubble ticks compute garbage that is masked out — static shapes, no
-  data-dependent control flow, one XLA program).
-- Stage params are stacked on a leading [S] dim sharded over "pipe"; the
-  per-device body sees its own stage's slice.  Activations advance with a
-  single collective-permute per tick; the last stage accumulates its results
-  into an output buffer that a final ``psum`` broadcasts ring-wide.
+- **GPipe** (``num_rounds=1``): every device runs the SAME jitted scan of
+  ``M + P - 1`` ticks; at tick ``t`` the device holding stage ``s``
+  processes microbatch ``t - s`` (bubble ticks compute garbage that is
+  masked out — static shapes, no data-dependent control flow, one XLA
+  program).  Bubble fraction ``(P-1)/(M+P-1)``.
+- **Circular / interleaved** (``num_rounds=V > 1``, the Megatron
+  interleaved-1F1B / praxis circular recipe): each device holds V
+  round-interleaved stage slices — global stage ``s = v*P + p`` lives on
+  device ``p`` — and every microbatch makes V trips around the ring (the
+  last→first edge carries the wrap).  Per-tick work shrinks by V while the
+  warmup/cooldown stays ``P-1`` ticks, so the bubble fraction drops to
+  ``(P-1)/(V*M + P - 1)`` — V× less idle hardware for the same total
+  layer count.  Requires ``M >= P`` (a wrapped microbatch re-enters device
+  0 only after the stream ahead of it has drained past).
+- Stage params are stacked on a leading [S] dim (GPipe) or [V, P] dims
+  (circular) with the device dim sharded over "pipe"; the per-device body
+  sees its own slice(s).  Activations advance with a single
+  collective-permute per tick; the final stage accumulates results into an
+  output buffer that a last ``psum`` broadcasts ring-wide.
 - Fully differentiable: scan + ppermute + where all have transposes, so
   ``jax.grad`` of a loss over :func:`pipeline_apply` yields the standard
-  GPipe backward schedule (XLA reverses the permutes).
+  pipelined backward schedule (XLA reverses the permutes).
 """
 
 from __future__ import annotations
@@ -32,20 +43,34 @@ from jax.sharding import Mesh, PartitionSpec as P
 PIPE_AXIS = "pipe"
 
 
+def bubble_fraction(num_micro: int, num_stages: int, num_rounds: int = 1) -> float:
+    """Idle fraction of the pipeline schedule: (P-1)/(V*M + P - 1)."""
+    return (num_stages - 1) / (num_rounds * num_micro + num_stages - 1)
+
+
 def pipeline_apply(
     stage_fn: Callable[[Any, jax.Array], jax.Array],
     mesh: Mesh,
     axis: str = PIPE_AXIS,
+    num_rounds: int = 1,
 ) -> Callable[[Any, jax.Array], jax.Array]:
     """Build a pipelined application of ``stage_fn`` over ``mesh[axis]``.
 
     ``stage_fn(stage_params, x) -> y`` must preserve ``x``'s shape (the
     transformer-block contract).  The returned callable maps
-    ``(stacked_params, stream)`` → outputs, where stacked_params leaves have
-    a leading [S] stage dim (sharded over ``axis``) and ``stream`` is
-    [M, microbatch...] (replicated).  Output has stream's shape.
+    ``(stacked_params, stream)`` → outputs, where ``stream`` is
+    [M, microbatch...] (replicated) and stacked_params leaves carry
+
+    - ``num_rounds == 1`` (GPipe): a leading [P] stage dim, sharded over
+      ``axis``;
+    - ``num_rounds == V > 1`` (circular): leading [V, P] dims — global
+      stage ``v*P + p`` at index [v, p] — with the SECOND dim sharded.
+
+    Output has stream's shape.
     """
     num_stages = mesh.shape[axis]
+    if num_rounds > 1:
+        return _circular_apply(stage_fn, mesh, axis, num_rounds)
 
     def check_stage_dim(stacked_params):
         for path, leaf in jax.tree_util.tree_flatten_with_path(stacked_params)[0]:
@@ -104,6 +129,102 @@ def pipeline_apply(
 
     def run(stacked_params, stream):
         check_stage_dim(stacked_params)
+        return mapped(stacked_params, stream)
+
+    return run
+
+
+def _circular_apply(stage_fn, mesh: Mesh, axis: str, num_rounds: int):
+    """The circular / interleaved schedule (see module docstring).
+
+    Tick algebra: item (microbatch m, round v) is processed by device p at
+    tick ``t = v*M + m + p`` — unique per (t, p), so every device does at
+    most one unit of work per tick.  Round v completes at device P-1 at
+    tick ``v*M + m + P - 1``; the wrap hop delivers it to device 0 at the
+    next tick, where it waits in a slot buffer until its round-(v+1) tick
+    ``(v+1)*M + m`` (possible iff M >= P).  Total ticks ``V*M + P - 1``."""
+    num_dev = mesh.shape[axis]
+    V = num_rounds
+
+    def check_dims(stacked_params):
+        for path, leaf in jax.tree_util.tree_flatten_with_path(stacked_params)[0]:
+            if leaf.shape[:2] != (V, num_dev):
+                raise ValueError(
+                    f"circular stacked param {jax.tree_util.keystr(path)} "
+                    f"must lead with [num_rounds={V}, devices={num_dev}], "
+                    f"got {leaf.shape[:2]}"
+                )
+
+    def per_device(params_local, stream):
+        # params_local leaves are [V, 1, ...] — this device's V round slices
+        rounds_params = jax.tree.map(lambda a: a[:, 0], params_local)
+        sidx = lax.axis_index(axis)
+        num_micro = stream.shape[0]
+        ticks = V * num_micro + num_dev - 1
+
+        def tick(carry, t):
+            recv, buf, out_buf = carry
+            # wrap arrivals: device 0's incoming item at tick t is
+            # (m=(t-P) mod M, round (t-P)//M + 1-to-be); bank it first so
+            # the M == P case (read in the same tick) sees it
+            m_in = jnp.mod(t - num_dev, num_micro)
+            prev_slot = lax.dynamic_index_in_dim(buf, m_in, 0, keepdims=False)
+            bank = (sidx == 0) & (t >= num_dev)
+            buf = lax.dynamic_update_index_in_dim(
+                buf, jnp.where(bank, recv, prev_slot), m_in, 0
+            )
+
+            s_step = t - sidx
+            m = jnp.mod(s_step, num_micro)
+            v = jnp.clip(s_step // num_micro, 0, V - 1)
+            live = (s_step >= 0) & (s_step < V * num_micro)
+
+            feed = lax.dynamic_index_in_dim(stream, m, 0, keepdims=False)
+            banked = lax.dynamic_index_in_dim(buf, m, 0, keepdims=False)
+            x = jnp.where(sidx == 0, jnp.where(v == 0, feed, banked), recv)
+            params_v = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, v, 0, keepdims=False),
+                rounds_params,
+            )
+            y = stage_fn(params_v, x)
+            # one ICI hop; the last->first edge carries the round wrap
+            sent = lax.ppermute(
+                y, axis, [(i, (i + 1) % num_dev) for i in range(num_dev)]
+            )
+            # final stage of the final round retires microbatch m
+            do_write = (sidx == num_dev - 1) & (v == V - 1) & live
+            prev_out = lax.dynamic_index_in_dim(out_buf, m, 0, keepdims=False)
+            out_buf = lax.dynamic_update_index_in_dim(
+                out_buf, jnp.where(do_write, y, prev_out), m, 0
+            )
+            return (sent, buf, out_buf), None
+
+        recv0, buf0, out0 = (
+            lax.pcast(z, (axis,), to="varying")
+            for z in (
+                jnp.zeros_like(stream[0]),
+                jnp.zeros_like(stream),
+                jnp.zeros_like(stream),
+            )
+        )
+        (_, _, out_buf), _ = lax.scan(tick, (recv0, buf0, out0), jnp.arange(ticks))
+        return lax.psum(
+            jnp.where(sidx == num_dev - 1, out_buf, jnp.zeros_like(out_buf)),
+            axis,
+        )
+
+    mapped = jax.shard_map(
+        per_device, mesh=mesh, in_specs=(P(None, axis), P()), out_specs=P()
+    )
+
+    def run(stacked_params, stream):
+        check_dims(stacked_params)
+        if stream.shape[0] < num_dev:
+            raise ValueError(
+                f"circular schedule needs microbatches >= devices "
+                f"({stream.shape[0]} < {num_dev}): a wrapped microbatch "
+                f"re-enters device 0 only after the stream ahead drains"
+            )
         return mapped(stacked_params, stream)
 
     return run

@@ -102,6 +102,20 @@ MOE_EP_RULES: Tuple[Tuple[str, P], ...] = (
 )
 
 
+# EP x TP composition: each expert's FFN kernels are ALSO Megatron-sharded
+# over "model" inside the expert shard (column-parallel w_up output dim,
+# row-parallel w_down input dim — one psum per expert MLP), and the
+# attention/embed/head params take the transformer TP rules.  The expert
+# rules must precede the generic ones so `.*w_up$` wins over any broader
+# pattern.  Serves (data, expert, model) meshes; on expert-only meshes use
+# MOE_EP_RULES (place_moe dispatches).
+MOE_EP_TP_RULES: Tuple[Tuple[str, P], ...] = (
+    (r".*moe_mlp/w_up$", P(EXPERT_AXIS, None, MODEL_AXIS)),
+    (r".*moe_mlp/w_down$", P(EXPERT_AXIS, MODEL_AXIS, None)),
+    (r".*router/kernel$", P()),
+) + TRANSFORMER_TP_RULES
+
+
 def spec_for_param(path: str, rules: Tuple[Tuple[str, P], ...]) -> P:
     for pattern, spec in rules:
         if re.match(pattern, path):

@@ -234,14 +234,6 @@ class PodGroupRegistry:
             return None
         return members
 
-    def layout_of(self, pod: PodInfo) -> Dict[str, int]:
-        """The pod's gang's existing slice layout: slice_id -> count of
-        already-placed CHIP members (empty for fresh gangs).  Preemption
-        consults this so eviction simulation can never free chips on a
-        slice an anchored re-plan (try_plan's fit_gang_into_layout path)
-        would refuse to use."""
-        return self.layout_and_occupancy_of(pod)[0]
-
     def layout_and_occupancy_of(self, pod: PodInfo):
         """(layout counts, occupied coords per slice) of the pod's gang —
         the full anchored-refit inputs, so preemption can simulate exactly

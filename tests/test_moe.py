@@ -112,6 +112,42 @@ def test_moe_ep_sharded_step_matches_single_device():
                                rtol=1e-4)
 
 
+def test_moe_ep_tp_sharded_step_matches_single_device():
+    """EP x TP composition (VERDICT r1 #7): one step on a
+    (data=2, expert=2, model=2) mesh — expert FFNs Megatron-sharded inside
+    their expert shard, attention TP-sharded — must reproduce the
+    single-device loss from the same init."""
+    model = MoeTransformerLM(
+        vocab_size=64, num_layers=2, num_heads=2, hidden=16,
+        num_experts=2, capacity_factor=4.0, max_seq=32, dtype=jnp.float32,
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (4, 17), 0, 64)
+    rng = jax.random.PRNGKey(1)
+
+    mesh = device_mesh({"data": 2, "expert": 2, "model": 2})
+    state = create_train_state(model, rng, tokens[:, :-1])
+    state, ptokens = place_moe(state, tokens, mesh)
+    # the EP x TP rules actually landed on the state
+    from kubegpu_tpu.parallel import MOE_EP_TP_RULES
+    from kubegpu_tpu.parallel.sharding import spec_for_param as sfp
+    from jax.sharding import PartitionSpec as P
+
+    assert sfp("params/layer0/moe_mlp/w_up", MOE_EP_TP_RULES) == P("expert", None, "model")
+    assert sfp("params/layer0/moe_mlp/w_down", MOE_EP_TP_RULES) == P("expert", "model", None)
+    assert sfp("params/layer0/attn/q_proj/kernel", MOE_EP_TP_RULES) == P(None, "model")
+    step = make_moe_train_step(mesh, donate=False)
+    _, loss_sharded, aux_sharded = step(state, ptokens)
+
+    mesh1 = device_mesh({"data": 1, "expert": 1}, devices=jax.devices()[:1])
+    state1 = create_train_state(model, rng, tokens[:, :-1])
+    state1, tokens1 = place_moe(state1, tokens, mesh1)
+    step1 = make_moe_train_step(mesh1, donate=False)
+    _, loss_single, aux_single = step1(state1, tokens1)
+
+    np.testing.assert_allclose(float(loss_sharded), float(loss_single), rtol=1e-4)
+    np.testing.assert_allclose(float(aux_sharded), float(aux_single), rtol=1e-4)
+
+
 def test_moe_train_step_learns_and_router_gets_gradient():
     model = MoeTransformerLM(
         vocab_size=32, num_layers=1, num_heads=2, hidden=16,

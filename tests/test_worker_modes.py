@@ -39,9 +39,12 @@ def run_worker(capsys, argv):
         ["--model", "lm-cp", "--cp", "4", "--attn-impl", "ring"],
         ["--model", "lm-cp", "--cp", "4", "--attn-impl", "ulysses"],
         ["--model", "moe", "--ep", "4"],
+        ["--model", "moe", "--ep", "2", "--tp", "2"],
         ["--model", "pp", "--microbatches", "2"],
+        ["--model", "pp", "--pp-rounds", "2", "--microbatches", "8"],
     ],
-    ids=["resnet-tiny", "lm-tp", "lm-cp-ring", "lm-cp-ulysses", "moe", "pp"],
+    ids=["resnet-tiny", "lm-tp", "lm-cp-ring", "lm-cp-ulysses", "moe",
+         "moe-ep-tp", "pp", "pp-circular"],
 )
 def test_worker_mode_trains(capsys, argv):
     out = run_worker(capsys, argv)
