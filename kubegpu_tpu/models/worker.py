@@ -453,7 +453,9 @@ def main(argv=None) -> int:
                     choices=["einsum", "flash", "ring", "ulysses"],
                     help="lm-cp: ring (default) or ulysses")
     ap.add_argument("--tp", type=int, default=0,
-                    help="lm: tensor-parallel size (0 = all devices)")
+                    help="tensor-parallel size — lm: 0 = all devices; "
+                    "moe: 0 = no TP (EP only), N > 1 Megatron-shards each "
+                    "expert's FFN over N devices")
     ap.add_argument("--cp", type=int, default=0,
                     help="lm-cp: context-parallel size (0 = all devices)")
     ap.add_argument("--ep", type=int, default=0,

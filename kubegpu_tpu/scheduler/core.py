@@ -50,6 +50,29 @@ class FilterResult:
     capacity_failure: bool = False
 
 
+def _scale_scores(raw: List[Tuple[str, Optional[float]]]) -> List[Tuple[str, int]]:
+    """Map grpalloc's 0-100 scores onto the extender's 0-10 rank-preserving
+    PER CANDIDATE SET: min-max stretch the fitting nodes across 1..10, so
+    the aspect/anti-fragmentation distinctions (weights 15/25 of the raw
+    score) survive the 11-bucket quantization instead of vanishing in a
+    global round(/10).  Non-fitting nodes score 0, strictly below every
+    fitting node; the best fitting node always scores 10."""
+    fitting = [s for _, s in raw if s is not None]
+    if not fitting:
+        return [(n, 0) for n, _ in raw]
+    lo, hi = min(fitting), max(fitting)
+    span = hi - lo
+
+    def scale(s: Optional[float]) -> int:
+        if s is None:
+            return 0
+        if span <= 0:
+            return 10
+        return 1 + round(9 * (s - lo) / span)
+
+    return [(n, scale(s)) for n, s in raw]
+
+
 class Scheduler:
     def __init__(
         self,
@@ -423,16 +446,16 @@ class Scheduler:
                 target = plan.per_pod[pod.key].node if plan else None
                 return [(n, 10 if n == target else 0) for n in node_names]
             views = self.cache.views()
-            out = []
+            raw = []
             for name in node_names:
                 node = self.cache.node(name)
                 if node is None:
-                    out.append((name, 0))
+                    raw.append((name, None))
                     continue
                 view = views.get(node.slice_id) if node.slice_id else None
                 fit = plugin.fit(node, pod, view)
-                out.append((name, round(fit.score / 10) if fit.fits else 0))
-            return out
+                raw.append((name, fit.score if fit.fits else None))
+            return _scale_scores(raw)
         finally:
             self.metrics.inc("kubegpu_prioritize_total")
             self.metrics.observe("kubegpu_prioritize_seconds", time.monotonic() - t0)

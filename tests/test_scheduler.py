@@ -682,3 +682,47 @@ def test_http_malformed_extended_resource_rejected_like_tpu(http_server):
         {"name": "m", "resources": {"limits": {"example.com/npu": "2k"}}}]}}
     flt = _post(addr, "/filter", {"Pod": bad, "NodeNames": nodes_of(api)})
     assert "unparseable pod" in flt["Error"]
+
+
+# -- prioritize score fidelity (VERDICT r1 #10) -----------------------------
+
+def test_scale_scores_rank_preserving_and_stretched():
+    from kubegpu_tpu.scheduler.core import _scale_scores
+
+    # distinct raw scores must stay distinct after quantization (when the
+    # candidate set has <= 10 fitting nodes) — round(/10) provably merged
+    # scores 71 and 78 into one bucket
+    raw = [("a", 78.0), ("b", 71.0), ("c", 45.0), ("d", None)]
+    out = dict(_scale_scores(raw))
+    assert out["d"] == 0
+    assert out["a"] == 10                      # best always 10
+    assert out["c"] == 1                       # worst fitting always 1
+    assert 1 < out["b"] < 10
+    assert out["a"] > out["b"] > out["c"] > out["d"]
+    # ties stay ties; all-fitting-equal -> all 10
+    assert dict(_scale_scores([("x", 50.0), ("y", 50.0)])) == {"x": 10, "y": 10}
+    assert dict(_scale_scores([("x", None)])) == {"x": 0}
+    assert _scale_scores([]) == []
+
+
+def test_prioritize_distinguishes_placements_round_would_merge():
+    """Integration: two hosts whose raw grpalloc scores differ by less than
+    a round(/10) bucket must still get different extender scores."""
+    api, fs, _ = fake_cluster()
+    sched = make_sched(api)
+    # occupy one host's block partially so its anti-frag score differs
+    filler = pod_obj("filler", 1)
+    api.create_pod(filler)
+    r = sched.filter(filler, nodes_of(api))
+    assert sched.bind("default", "filler", r.nodes[0]) is None
+
+    obj = pod_obj("probe", 2)
+    api.create_pod(obj)
+    r = sched.filter(obj, nodes_of(api))
+    scores = dict(sched.prioritize(obj, r.nodes))
+    assert max(scores.values()) == 10
+    assert min(scores.values()) >= 1  # every fitting node beats non-fitting
+    # the candidate set is stretched: unless every raw score ties, at least
+    # two distinct extender scores exist
+    raw = set(scores.values())
+    assert len(raw) >= 2, scores
