@@ -34,22 +34,43 @@ def _layer_norm(x, scale, bias, eps=1e-5):
     return (x - mu) * lax.rsqrt(var + eps) * scale + bias
 
 
-def block_apply(p: Dict[str, jax.Array], x: jax.Array, num_heads: int) -> jax.Array:
-    """One pre-LN block: causal attention + gelu MLP, shape-preserving."""
+def block_apply(p: Dict[str, jax.Array], x: jax.Array, num_heads: int,
+                model_axis: str = None) -> jax.Array:
+    """One pre-LN block: causal attention + gelu MLP, shape-preserving.
+
+    With ``model_axis`` (PP x TP, called inside shard_map), the kernels are
+    the LOCAL Megatron shards — wq/wk/wv/w1 column-parallel (local output
+    dim), wo/w2 row-parallel (local input dim) — and the block performs the
+    two standard psums itself; head count adapts to the local q width."""
     b, s, d = x.shape
+    if d % num_heads:
+        raise ValueError(f"hidden {d} not divisible by {num_heads} heads")
     hd = d // num_heads
     y = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
-    q = (y @ p["wq"]).reshape(b, s, num_heads, hd)
-    k = (y @ p["wk"]).reshape(b, s, num_heads, hd)
-    v = (y @ p["wv"]).reshape(b, s, num_heads, hd)
+    q = y @ p["wq"]
+    if q.shape[-1] % hd:
+        raise ValueError(
+            f"local q width {q.shape[-1]} does not split into whole "
+            f"{hd}-wide heads (TP degree must divide {num_heads})"
+        )
+    local_heads = q.shape[-1] // hd  # num_heads/tp under TP, num_heads solo
+    q = q.reshape(b, s, local_heads, hd)
+    k = (y @ p["wk"]).reshape(b, s, local_heads, hd)
+    v = (y @ p["wv"]).reshape(b, s, local_heads, hd)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(hd).astype(x.dtype)
     mask = jnp.tril(jnp.ones((s, s), dtype=bool))
     scores = jnp.where(mask[None, None], scores, jnp.finfo(x.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
-    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, d)
-    x = x + attn @ p["wo"]
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, local_heads * hd)
+    out = attn @ p["wo"]
+    if model_axis is not None:
+        out = lax.psum(out, model_axis)  # row-parallel reduce
+    x = x + out
     y = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
-    return x + jax.nn.gelu(y @ p["w1"]) @ p["w2"]
+    m = jax.nn.gelu(y @ p["w1"]) @ p["w2"]
+    if model_axis is not None:
+        m = lax.psum(m, model_axis)
+    return x + m
 
 
 def _init_block(rng, hidden: int, mlp_ratio: int, dtype) -> Dict[str, jax.Array]:
@@ -122,14 +143,27 @@ def to_circular_layout(params: Dict[str, Any], num_devices: int) -> Dict[str, An
     return out
 
 
-def stage_apply(stage_params, x, num_heads: int):
+def stage_apply(stage_params, x, num_heads: int, model_axis: str = None):
     """Apply this stage's K stacked layers via scan-over-layers."""
 
     def body(h, layer_p):
-        return block_apply(layer_p, h, num_heads), None
+        return block_apply(layer_p, h, num_heads, model_axis), None
 
     x, _ = lax.scan(body, x, stage_params)
     return x
+
+
+def _blocks_tp_specs(axis: str, model_axis: str) -> Dict[str, P]:
+    """Per-leaf PartitionSpecs for [S, K, ...] block stacks on a
+    (pipe, model) mesh: stage dim over pipe; column-parallel kernels shard
+    their output dim, row-parallel their input dim, norms replicate."""
+    col = P(axis, None, None, model_axis)
+    row = P(axis, None, model_axis, None)
+    vec = P(axis, None, None)
+    return {
+        "ln1_scale": vec, "ln1_bias": vec, "ln2_scale": vec, "ln2_bias": vec,
+        "wq": col, "wk": col, "wv": col, "wo": row, "w1": col, "w2": row,
+    }
 
 
 def _head(params, x):
@@ -146,19 +180,26 @@ def pipeline_lm_logits(
     num_microbatches: int,
     axis: str = PIPE_AXIS,
     num_rounds: int = 1,
+    model_axis: str = None,
 ):
     """Forward through the pipelined block stack; batch must divide into
     ``num_microbatches`` equal microbatches.  ``num_rounds > 1`` selects
     the circular schedule and expects blocks in the [V, P, K, ...] layout
-    (:func:`to_circular_layout`)."""
+    (:func:`to_circular_layout`).  ``model_axis`` composes PP with
+    Megatron TP on a (pipe, model) mesh (GPipe schedule only)."""
+    if model_axis is not None and num_rounds > 1:
+        raise ValueError("PP x TP composes with the GPipe schedule only")
     b, t = tokens.shape
     if b % num_microbatches != 0:
         raise ValueError(f"batch {b} not divisible by {num_microbatches} microbatches")
     x = params["embed"][tokens] + params["pos"][:t][None]
     stream = x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
     run = pipeline_apply(
-        partial(stage_apply, num_heads=num_heads), mesh, axis,
-        num_rounds=num_rounds,
+        partial(stage_apply, num_heads=num_heads, model_axis=model_axis),
+        mesh, axis, num_rounds=num_rounds,
+        params_specs=(
+            None if model_axis is None else _blocks_tp_specs(axis, model_axis)
+        ),
     )
     out = run(params["blocks"], stream)
     return _head(params, out.reshape(b, t, -1))
@@ -186,18 +227,28 @@ def sequential_lm_logits(params, tokens, *, num_heads: int):
 # ---------------------------------------------------------------------------
 
 def place_pipeline_lm(params, opt_state, tokens, mesh: Mesh, axis: str = PIPE_AXIS,
-                      num_rounds: int = 1):
+                      num_rounds: int = 1, model_axis: str = None):
     """Blocks (and their mirrored optimizer moments) sharded over "pipe" —
     the stage dim for GPipe, the device dim of the circular [V, P, ...]
-    layout; everything else replicated.  Optax moment pytrees mirror the
-    param tree, so one path rule — "under a 'blocks' key" — shards both
+    layout — and, with ``model_axis``, each stage's kernels additionally
+    Megatron-sharded; everything else replicated.  Optax moment pytrees
+    mirror the param tree, so the same path rules shard both
     consistently."""
+    if model_axis is not None and num_rounds > 1:
+        raise ValueError("PP x TP composes with the GPipe schedule only")
     blocks_spec = P(axis) if num_rounds == 1 else P(None, axis)
+    tp_specs = (
+        _blocks_tp_specs(axis, model_axis) if model_axis is not None else None
+    )
 
     def shardings_for(tree):
         def spec(path, _leaf):
-            pipelined = any(getattr(k, "key", None) == "blocks" for k in path)
-            return NamedSharding(mesh, blocks_spec if pipelined else P())
+            keys = [getattr(k, "key", None) for k in path]
+            if "blocks" not in keys:
+                return NamedSharding(mesh, P())
+            if tp_specs is not None:
+                return NamedSharding(mesh, tp_specs[keys[-1]])
+            return NamedSharding(mesh, blocks_spec)
 
         return jax.tree_util.tree_map_with_path(spec, tree)
 
@@ -215,6 +266,7 @@ def make_pipeline_lm_train_step(
     num_microbatches: int,
     axis: str = PIPE_AXIS,
     num_rounds: int = 1,
+    model_axis: str = None,
     donate: bool = True,
 ):
     from kubegpu_tpu.models.train import cross_entropy
@@ -228,6 +280,7 @@ def make_pipeline_lm_train_step(
             num_microbatches=num_microbatches,
             axis=axis,
             num_rounds=num_rounds,
+            model_axis=model_axis,
         )
         return cross_entropy(logits, tokens[:, 1:])
 

@@ -53,6 +53,7 @@ def pipeline_apply(
     mesh: Mesh,
     axis: str = PIPE_AXIS,
     num_rounds: int = 1,
+    params_specs: Any = None,
 ) -> Callable[[Any, jax.Array], jax.Array]:
     """Build a pipelined application of ``stage_fn`` over ``mesh[axis]``.
 
@@ -66,10 +67,23 @@ def pipeline_apply(
     - ``num_rounds == V > 1`` (circular): leading [V, P] dims — global
       stage ``v*P + p`` at index [v, p] — with the SECOND dim sharded.
 
-    Output has stream's shape.
+    PP x TP composition: on a mesh with further axes (e.g. "model"),
+    shard_map maps over them too — pass ``params_specs`` (a pytree of
+    PartitionSpecs matching stacked_params) to also shard each stage's
+    weights over those axes, and have ``stage_fn`` perform its own
+    collectives (e.g. a Megatron psum over "model"); its output must be
+    replicated over the non-pipe axes.  Output has stream's shape.
     """
     num_stages = mesh.shape[axis]
     if num_rounds > 1:
+        if params_specs is not None:
+            # dropping the specs would replicate TP-style weights over the
+            # model axis and the stage_fn's psums would silently scale
+            # every output by the TP degree
+            raise ValueError(
+                "params_specs (PP x TP) composes with the GPipe schedule "
+                "only; the circular schedule does not take custom specs"
+            )
         return _circular_apply(stage_fn, mesh, axis, num_rounds)
 
     def check_stage_dim(stacked_params):
@@ -124,7 +138,9 @@ def pipeline_apply(
         )
 
     mapped = jax.shard_map(
-        per_device, mesh=mesh, in_specs=(P(axis), P()), out_specs=P()
+        per_device, mesh=mesh,
+        in_specs=(P(axis) if params_specs is None else params_specs, P()),
+        out_specs=P(),
     )
 
     def run(stacked_params, stream):
