@@ -151,6 +151,11 @@ class TransformerLM(nn.Module):
     # blocks; attention crosses shards via attn_impl="ring"/"ulysses"
     context_parallel: bool = False
     attn_impl: str = "einsum"
+    # rematerialize each block in the backward (jax.checkpoint): activation
+    # memory drops from O(layers x seq) to O(seq) + one extra forward of
+    # FLOPs — the standard TPU trade for long context, composing with
+    # CP's O(seq/ring) attention
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens):
@@ -164,8 +169,9 @@ class TransformerLM(nn.Module):
         x = x + pos
         if self.context_parallel:
             x = constrain_ctx_sharded(x)
+        block_cls = nn.remat(Block) if self.remat else Block
         for i in range(self.num_layers):
-            x = Block(
+            x = block_cls(
                 self.num_heads,
                 dtype=self.dtype,
                 sequence_parallel=self.sequence_parallel,

@@ -292,6 +292,7 @@ def _run_lm_family(args, t0: float) -> int:
             vocab_size=args.vocab, num_layers=args.layers, num_heads=args.heads,
             hidden=args.hidden, max_seq=args.seq + 1,
             sequence_parallel=True, attn_impl=args.attn_impl,
+            remat=args.remat,
         )
         place, make_step = place_lm, make_lm_train_step
     elif args.model == "lm-cp":
@@ -304,6 +305,7 @@ def _run_lm_family(args, t0: float) -> int:
             hidden=args.hidden, max_seq=args.seq + 1,
             context_parallel=True,
             attn_impl=args.attn_impl if args.attn_impl != "flash" else "ring",
+            remat=args.remat,
         )
         place, make_step = place_cp_lm, make_lm_train_step
     else:  # moe — EP, optionally x TP (--tp shards each expert's FFN too)
@@ -452,6 +454,11 @@ def main(argv=None) -> int:
     ap.add_argument("--attn-impl", default="flash",
                     choices=["einsum", "flash", "ring", "ulysses"],
                     help="lm-cp: ring (default) or ulysses")
+    ap.add_argument("--remat", action="store_true",
+                    help="lm/lm-cp: rematerialize blocks in the backward "
+                    "(activation memory O(seq) instead of O(layers x seq) "
+                    "for one extra forward of FLOPs — the long-context "
+                    "memory knob, composes with CP)")
     ap.add_argument("--tp", type=int, default=0,
                     help="tensor-parallel size — lm: 0 = all devices; "
                     "moe: 0 = no TP (EP only), N > 1 Megatron-shards each "

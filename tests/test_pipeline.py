@@ -290,10 +290,16 @@ def test_pp_tp_matches_sequential():
 
     gp = jax.grad(loss_p)(params)
     gs = jax.grad(loss_s)(params)
-    for k in gs["blocks"]:
+    # the FULL tree, embed/pos/head included: their cotangents cross the
+    # shard_map replication boundary, exactly where a TP-degree scaling
+    # bug would hide while blocks grads stay exact
+    for (kp, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(gp),
+        jax.tree_util.tree_leaves_with_path(gs),
+    ):
         np.testing.assert_allclose(
-            np.asarray(gp["blocks"][k]), np.asarray(gs["blocks"][k]),
-            rtol=5e-4, atol=5e-4,
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4,
+            err_msg=jax.tree_util.keystr(kp),
         )
 
 
