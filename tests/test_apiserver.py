@@ -77,6 +77,7 @@ class StubState:
         self.pods = {}          # "ns/name" -> obj
         self.requests = []      # (method, path, content_type, auth)
         self.watch_events = []  # [{"type": ..., "object": ...}]
+        self.watch_poll_s = 0.0  # >0: long-poll for NEW events this long
         self.lock = threading.Lock()
 
 
@@ -112,13 +113,23 @@ def make_stub_handler(state: StubState):
             self.wfile.write(body)
 
         def _stream_watch(self):
+            import time as _time
+
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.end_headers()
-            for evt in list(state.watch_events):
-                self.wfile.write(json.dumps(evt).encode() + b"\n")
-                self.wfile.flush()
-            # stream ends (k8s watch timeout); client is expected to re-watch
+            sent = 0
+            deadline = _time.monotonic() + state.watch_poll_s
+            while True:
+                with state.lock:
+                    pending = state.watch_events[sent:]
+                for evt in pending:
+                    self.wfile.write(json.dumps(evt).encode() + b"\n")
+                    self.wfile.flush()
+                sent += len(pending)
+                if _time.monotonic() >= deadline:
+                    break  # k8s watch timeout; client re-watches
+                _time.sleep(0.05)
 
         def do_GET(self):
             self._record()
@@ -183,18 +194,29 @@ def make_stub_handler(state: StubState):
             parts = self.path.strip("/").split("/")
             body = self._body()
             if parts[:3] == ["api", "v1", "nodes"] and len(parts) in (4, 5):
-                name = parts[3]
-                node = state.nodes.setdefault(name, {"metadata": {"name": name}})
-                if len(parts) == 5 and parts[4] == "status":
-                    status = node.setdefault("status", {})
-                    for k in ("capacity", "allocatable"):
-                        status.setdefault(k, {}).update(
-                            body.get("status", {}).get(k, {})
-                        )
-                else:
-                    node.setdefault("metadata", {}).setdefault(
-                        "annotations", {}
-                    ).update(body.get("metadata", {}).get("annotations", {}))
+                # mutate AND snapshot under the lock: handler threads are
+                # concurrent (ThreadingHTTPServer), and a torn snapshot
+                # would stream a half-updated node to the watch client
+                with state.lock:
+                    name = parts[3]
+                    node = state.nodes.setdefault(
+                        name, {"metadata": {"name": name}}
+                    )
+                    if len(parts) == 5 and parts[4] == "status":
+                        status = node.setdefault("status", {})
+                        for k in ("capacity", "allocatable"):
+                            status.setdefault(k, {}).update(
+                                body.get("status", {}).get(k, {})
+                            )
+                    else:
+                        node.setdefault("metadata", {}).setdefault(
+                            "annotations", {}
+                        ).update(body.get("metadata", {}).get("annotations", {}))
+                    # node mutations become watch events, like a real API
+                    # server's MODIFIED notifications
+                    state.watch_events.append(
+                        {"type": "MODIFIED", "object": json.loads(json.dumps(node))}
+                    )
                 return self._send(200, node)
             if parts[:3] == ["api", "v1", "namespaces"] and len(parts) == 6:
                 pod = state.pods.get(f"{parts[3]}/{parts[5]}")
@@ -313,6 +335,62 @@ def test_watch_nodes_streams_events_and_reconnects(stub):
         ("node-updated", "h0"),
         ("node-deleted", "h1"),
     ]
+
+
+def test_extender_daemon_watch_eviction_through_rest_client(stub):
+    """The deployed shape end to end: the ExtenderServer DAEMON (watch
+    thread + resync backstop) runs against the REAL REST client over the
+    stub TLS API server.  Advertise → schedule → the advertiser's health
+    patch lands as a watch MODIFIED event → chip-death eviction DELETEs
+    the pod through the wire, with resync parked so only the watch can
+    have fired it."""
+    import time
+
+    from kubegpu_tpu.plugins import Advertiser, FakeSlice
+    from kubegpu_tpu.scheduler import Scheduler
+    from kubegpu_tpu.scheduler.server import ExtenderServer
+    from kubegpu_tpu.types import annotations
+
+    api, state = stub
+    state.watch_poll_s = 3.0  # real long-poll: new events stream live
+    fs = FakeSlice(slice_id="s0", mesh_shape=(2, 2), host_block=(2, 2))
+    advs = {h: Advertiser(p, api) for h, p in fs.providers().items()}
+    for a in advs.values():
+        a.advertise_once()
+
+    server = ExtenderServer(Scheduler(api), listen=("127.0.0.1", 0),
+                            resync_interval_s=3600.0)
+    server.start()
+    try:
+        obj = {
+            "metadata": {"name": "victim", "namespace": "default",
+                         "annotations": {}},
+            "spec": {"containers": [
+                {"name": "main",
+                 "resources": {"limits": {"google.com/tpu": "1"}}}]},
+        }
+        api.create_pod(obj)
+        nodes = sorted(n["metadata"]["name"] for n in api.list_nodes())
+        r = server.sched.filter(obj, nodes)
+        assert r.nodes, r.failed
+        assert server.sched.bind("default", "victim", r.nodes[0]) is None
+        assignment = annotations.assignment_from_pod(
+            api.get_pod("default", "victim")
+        )
+        ref = assignment.all_chips()[0]
+
+        fs.kill_chip(ref.coords)
+        advs[ref.host].advertise_once()  # PATCH → MODIFIED watch event
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if "default/victim" not in state.pods:
+                break
+            time.sleep(0.1)
+        assert "default/victim" not in state.pods, (
+            "watch event over the REST wire did not evict the pod"
+        )
+    finally:
+        server.stop()
 
 
 def test_full_control_plane_through_rest_client(stub):
