@@ -406,6 +406,18 @@ def main() -> None:
         f"v5e-256 (64 nodes) extender latency (warm, min of 3): "
         f"filter {t_filter * 1e3:.1f} ms, prioritize {t_prio * 1e3:.1f} ms"
     )
+    # whole-slice gang planning (the most expensive single verb): 64 pods
+    # x 4 chips = all 256 chips, planned once when the first member filters
+    gang_pods = [
+        make_pod(f"gw{i:02d}", 4, group="gang-scale", size=64) for i in range(64)
+    ]
+    for obj in gang_pods:
+        big_api.create_pod(obj)
+    t0g = time.perf_counter()
+    rg = big_sched.filter(gang_pods[0], big_nodes)
+    t_gang = time.perf_counter() - t0g
+    assert rg.nodes, rg.failed
+    log(f"v5e-256 whole-slice 64-pod gang plan (first filter): {t_gang * 1e3:.1f} ms")
 
     # ---- north star: 4-pod DP ResNet-50 gang, creation -> first step ----
     api = InMemoryApiServer()

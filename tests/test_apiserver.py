@@ -214,10 +214,12 @@ def make_stub_handler(state: StubState):
                         ).update(body.get("metadata", {}).get("annotations", {}))
                     # node mutations become watch events, like a real API
                     # server's MODIFIED notifications
+                    snapshot = json.loads(json.dumps(node))
                     state.watch_events.append(
-                        {"type": "MODIFIED", "object": json.loads(json.dumps(node))}
+                        {"type": "MODIFIED", "object": snapshot}
                     )
-                return self._send(200, node)
+                # respond with the locked-in snapshot, not the live dict
+                return self._send(200, snapshot)
             if parts[:3] == ["api", "v1", "namespaces"] and len(parts) == 6:
                 pod = state.pods.get(f"{parts[3]}/{parts[5]}")
                 if pod is None:
