@@ -158,6 +158,10 @@ class MoeTransformerLM(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     sequence_parallel: bool = False
     attn_impl: str = "einsum"
+    # rematerialize blocks in the backward (jax.checkpoint): the same
+    # long-context memory knob as TransformerLM.remat; the sown aux_loss
+    # intermediates survive nn.remat
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens):
@@ -170,7 +174,7 @@ class MoeTransformerLM(nn.Module):
         )
         x = x + pos
         block = partial(
-            MoeBlock,
+            nn.remat(MoeBlock) if self.remat else MoeBlock,
             num_heads=self.num_heads,
             num_experts=self.num_experts,
             capacity_factor=self.capacity_factor,

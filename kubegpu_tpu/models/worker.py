@@ -322,7 +322,7 @@ def _run_lm_family(args, t0: float) -> int:
         model = MoeTransformerLM(
             vocab_size=args.vocab, num_layers=args.layers, num_heads=args.heads,
             hidden=args.hidden, num_experts=args.num_experts or ep,
-            capacity_factor=2.0, max_seq=args.seq + 1,
+            capacity_factor=2.0, max_seq=args.seq + 1, remat=args.remat,
         )
         place, make_step = place_moe, make_moe_train_step
 
@@ -455,7 +455,7 @@ def main(argv=None) -> int:
                     choices=["einsum", "flash", "ring", "ulysses"],
                     help="lm-cp: ring (default) or ulysses")
     ap.add_argument("--remat", action="store_true",
-                    help="lm/lm-cp: rematerialize blocks in the backward "
+                    help="lm/lm-cp/moe: rematerialize blocks in the backward "
                     "(activation memory O(seq) instead of O(layers x seq) "
                     "for one extra forward of FLOPs — the long-context "
                     "memory knob, composes with CP)")

@@ -173,3 +173,28 @@ def test_moe_train_step_learns_and_router_gets_gradient():
         losses.append(float(loss))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0], losses
+
+
+def test_moe_remat_grads_match_plain():
+    """remat=True must be a pure memory/FLOPs trade for the MoE LM too:
+    gradients (and the sown aux loss path) identical to the plain model."""
+    kw = dict(vocab_size=64, num_layers=2, num_heads=2, hidden=16,
+              num_experts=2, capacity_factor=4.0, max_seq=32,
+              dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 17), 0, 64)
+    m = MoeTransformerLM(**kw)
+    m_r = MoeTransformerLM(remat=True, **kw)
+
+    from kubegpu_tpu.models.train import moe_loss
+
+    state = create_train_state(m, jax.random.PRNGKey(1), tokens[:, :-1])
+    state_r = state.replace(apply_fn=m_r.apply)
+
+    def loss(st):
+        return lambda p: moe_loss(st, p, tokens, 0.01)[0]
+
+    g = jax.grad(loss(state))(state.params)
+    gr = jax.grad(loss(state_r))(state_r.params)
+    for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(gr)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
