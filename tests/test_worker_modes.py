@@ -63,6 +63,24 @@ def test_worker_resident_mode_runs_constant_batch(capsys):
     run_worker(capsys, ["--model", "lm", "--tp", "4", "--data", "resident"])
 
 
+@pytest.mark.parametrize(
+    "argv",
+    [["--model", "resnet-tiny"], ["--model", "lm", "--tp", "4"]],
+    ids=["resnet-tiny", "lm-tp"],
+)
+def test_worker_checkpoint_resume(capsys, tmp_path, argv):
+    """The pod-restart story at the worker surface: a second invocation
+    with the same --ckpt-dir restores the saved step and says so on
+    stdout (the line a human/probe greps for)."""
+    ck = ["--ckpt-dir", str(tmp_path), "--ckpt-every", "2"]
+    out1 = run_worker(capsys, argv + ck)
+    assert "CHECKPOINT_SAVED step=2" in out1
+    assert "RESUMED" not in out1
+    out2 = run_worker(capsys, argv + ck)
+    assert "RESUMED step=2" in out2
+    assert "CHECKPOINT_SAVED step=4" in out2
+
+
 def test_mesh_token_source_seeds_per_data_shard():
     """Single-process view of the gang data contract: shards draw disjoint
     streams, and the rows for a given shard do not depend on how many
