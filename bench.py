@@ -249,11 +249,16 @@ def steady_state_lm(extra: dict) -> None:
     batch = int(os.environ.get("BENCH_LM_BATCH", "16"))
     seq = int(os.environ.get("BENCH_LM_SEQ", "1024"))
     vocab = 32768
+    hidden = int(os.environ.get("BENCH_LM_HIDDEN", "4096"))
+    # heads derive from hidden (d128, the flash kernel's native lane width)
+    # unless overridden, so resizing one knob cannot silently change the
+    # head geometry
+    heads = int(os.environ.get("BENCH_LM_HEADS", str(max(hidden // 128, 1))))
+    layers = int(os.environ.get("BENCH_LM_LAYERS", "4"))
+    if hidden % heads:
+        raise SystemExit(f"BENCH_LM_HIDDEN {hidden} not divisible by {heads} heads")
     model = TransformerLM(
-        vocab_size=vocab,
-        num_layers=int(os.environ.get("BENCH_LM_LAYERS", "4")),
-        num_heads=int(os.environ.get("BENCH_LM_HEADS", "32")),
-        hidden=int(os.environ.get("BENCH_LM_HIDDEN", "4096")),
+        vocab_size=vocab, num_layers=layers, num_heads=heads, hidden=hidden,
         max_seq=seq + 1, attn_impl="flash",
     )
     rng = jax.random.PRNGKey(0)
@@ -278,7 +283,8 @@ def steady_state_lm(extra: dict) -> None:
     mfu = flops / dt / (V5E_PEAK_FLOPS * mesh.size)
     tok_s = batch * seq / dt
     log(
-        f"steady-state LM ({n_params / 1e6:.0f}M params, flash attn) "
+        f"steady-state LM ({n_params / 1e6:.0f}M params, h{hidden} "
+        f"L{layers} heads{heads}, flash attn) "
         f"b{batch} s{seq}: {dt * 1e3:.2f} ms/step, {tok_s:.0f} tok/s, "
         f"{flops / 1e12:.2f} TFLOP/step -> MFU {mfu * 100:.1f}% "
         f"(compile {t_compile:.1f} s)"
