@@ -405,11 +405,17 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
-                    block_k: int = 128, interpret: Optional[bool] = None):
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
+                    block_k: int = 1024, interpret: Optional[bool] = None):
     """Flash attention, BSHD.  O(seq) memory in BOTH directions: the
     forward keeps only out + logsumexp; the backward recomputes scores
-    blockwise in its own Pallas kernels."""
+    blockwise in its own Pallas kernels.
+
+    Default blocks are large (512x1024): measured on v5e, fwd+bwd at
+    seq 1024/d128 runs 2.6x faster than 128x128 blocks (60.5 -> 23.9 ms
+    for b16 h32) — small blocks pay grid overhead and starve the MXU;
+    the VMEM residency at d<=128 stays a few MB.  Shorter sequences
+    clamp via min(block, seq) as always."""
     out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
     return out
 
@@ -524,11 +530,15 @@ def _ring_attention_einsum(q, k, v, axis_name: str, causal: bool = True):
 def _ring_block_sizes(s_loc: int) -> Optional[tuple]:
     """Pallas block sizes for a ring shard, or None when the shard can't be
     tiled without padding (padding inside the ring would corrupt the global
-    position bookkeeping — those shapes take the einsum fallback)."""
-    if s_loc <= 128:
+    position bookkeeping — those shapes take the einsum fallback).  Prefers
+    large blocks (same v5e measurement as flash_attention's defaults)."""
+    if s_loc <= 128 or (s_loc <= 512 and s_loc % 128 == 0):
+        # single whole-shard block: array-equal block dims are always
+        # mosaic-legal, and one big block beats tiling at these sizes
         return s_loc, s_loc
-    if s_loc % 128 == 0:
-        return 128, 128
+    for b in (512, 256, 128):
+        if s_loc % b == 0:
+            return b, b
     return None
 
 
@@ -696,9 +706,10 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
     uses global positions); comms 2·(ring-1) neighbour exchanges riding ICI.
     ``impl="flash"`` (default) runs each block through the Pallas flash
     kernel and a re-rotating custom VJP — O(s_loc) memory in BOTH
-    directions; shard shapes the kernel can't tile (s_loc > 128 and not a
-    multiple of 128) fall back to ``impl="einsum"`` (O(s_loc^2) transient,
-    still O(seq/ring) resident)."""
+    directions; shard shapes the kernel can't tile (s_loc > 128 that is
+    not a multiple of 128 — see _ring_block_sizes) fall back to
+    ``impl="einsum"`` (O(s_loc^2) transient, still O(seq/ring)
+    resident)."""
     if impl == "flash":
         bs = _ring_block_sizes(q.shape[1])
         if bs is not None and q.shape == k.shape:
