@@ -190,19 +190,28 @@ def _single_node_view(node: NodeInfo) -> SliceView:
 # SURVEY.md §2 #2): mutate the node's used-tree; SliceViews are derived.
 # ---------------------------------------------------------------------------
 
-def take_pod_resources(node: NodeInfo, assignment: Assignment) -> None:
+def take_pod_resources(node: NodeInfo, assignment: Assignment,
+                       skip_missing: bool = False) -> None:
     """Commit an assignment against the node's used-tree.
 
     Validates-then-mutates: raises ValueError (with NO state change) if any
     chip is already taken — a second take of the same chips is a bind race
     or a retry bug, and surfacing it here keeps the cache consistent
-    (SURVEY.md §7 hard part (c): serialize/detect bind races)."""
+    (SURVEY.md §7 hard part (c): serialize/detect bind races).
+
+    ``skip_missing=True`` (cache replay/re-apply paths): chips absent from
+    the node's current advertisement are skipped instead of raising — the
+    record stays trackable so the absent-chip strike detector can evict its
+    pod, and return_pod_resources symmetrically skips missing indices, so
+    the charge/return pair stays balanced."""
     by_idx = {ch.device_index: ch for ch in node.chips}
     mine = [r for r in assignment.all_chips() if r.host == node.name]
     chips = []
     for ref in mine:
         ch = by_idx.get(ref.device_index)
         if ch is None:
+            if skip_missing:
+                continue
             raise KeyError(f"node {node.name} has no chip index {ref.device_index}")
         if node.used.get(node.chip_path(ch)) > 0:
             raise ValueError(

@@ -23,7 +23,7 @@ from kubegpu_tpu.grpalloc import (
 )
 from kubegpu_tpu.types import annotations
 from kubegpu_tpu.types.info import Assignment, NodeInfo
-from kubegpu_tpu.utils.apiserver import ApiServer
+from kubegpu_tpu.utils.apiserver import ApiServer, NotFound
 
 log = logging.getLogger(__name__)
 
@@ -46,24 +46,44 @@ class ClusterCache:
         # node (dead advertiser, deregistered VM) is precisely the case
         # where no future advertisement will ever evict the pod.
         self._orphaned: Dict[str, str] = {}
+        # pod key -> node name, for records whose chips CONFLICTED at the
+        # last refresh (another record holds the charge).  Usually a
+        # transient race that the next refresh clears; if it persists, two
+        # live annotations claim one chip — a pathological durable state
+        # the scheduler's conflict sweep resolves by evicting the uncharged
+        # claimant after a grace window.  Tracked so the pod is never
+        # invisible to every detector while bound+annotated.
+        self._conflicted: Dict[str, str] = {}
 
     # -- building ---------------------------------------------------------
     def refresh(self) -> None:
-        """Full rebuild from API-server state (startup + resync): decode
-        node annotations, then replay every scheduled pod's assignment
+        """Reconcile with API-server state (startup + resync): rebuild the
+        NODE views from fresh advertisements, keep the LIVE in-memory
+        assignments (re-charged onto the fresh nodes), and use the pod LIST
+        only to NOMINATE divergence candidates — each confirmed with a
+        fresh per-pod GET before anything is adopted or removed.
+
+        Why not rebuild assignments from the LIST (the obvious design, and
+        round 1's): the LISTs happen BEFORE the lock (a slow API server
+        must not stall every verb), so the snapshot is stale against
+        concurrent binds/deletes, and every rebuild-from-snapshot variant
+        the threaded soak was pointed at leaked a double-allocation — a
+        committed bind wiped because its annotation post-dated the LIST, a
+        deleted pod's ghost replayed over the new owner's chips, a ghost
+        eviction returning chips the real owner charged.  Reconciliation
+        kills the class: stale data can never displace live memory, and
+        nothing enters or leaves the assignment map without a
+        fresh-as-of-now GET agreeing.  Races that remain only make chips
+        look USED one cycle too long (the safe direction) — the next
+        refresh converges.
+
+        On a cold start the memory is empty, every annotated pod is a
+        nominee, and the GET-confirmed adoptions ARE the restart replay
         (SURVEY.md §3.5 — what makes restarts safe with no database)."""
         nodes_raw = self.api.list_nodes()
         pods_raw = self.api.list_pods()
         with self._lock:
-            prev_assumed = {
-                k: self._assignments[k]
-                for k in self._assumed
-                if k in self._assignments
-            }
             self._nodes = {}
-            self._assignments = {}
-            self._assumed = set()
-            self._orphaned = {}
             for obj in nodes_raw:
                 try:
                     node = annotations.node_from_k8s(obj)
@@ -71,47 +91,121 @@ class ClusterCache:
                     log.exception("ignoring undecodable node annotation")
                     continue
                 self._nodes[node.name] = node
-            live_keys = set()
+            # re-charge LIVE memory onto the fresh nodes (never wiped by
+            # stale snapshot data); _carry routes vanished-node records to
+            # _orphaned and conflicting records to _conflicted
+            prev = self._assignments
+            prev_assumed = set(self._assumed)
+            self._assignments = {}
+            self._assumed = set()
+            self._orphaned = {}
+            self._conflicted = {}
+            for key, a in prev.items():
+                self._carry(key, a, key in prev_assumed)
+            # nominate divergences vs the (stale) snapshot
+            listed: Dict[str, Optional[Assignment]] = {}
             for obj in pods_raw:
                 meta = obj.get("metadata", {})
                 key = f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
-                live_keys.add(key)
                 try:
-                    a = annotations.assignment_from_pod(obj)
+                    listed[key] = annotations.assignment_from_pod(obj)
                 except Exception:  # noqa: BLE001
                     log.exception("ignoring undecodable pod assignment")
-                    continue
-                if a is None:
-                    continue
-                self._replay(key, a)
-            # carry over in-flight reservations whose pods still exist and
-            # have not become durable yet
-            for key, a in prev_assumed.items():
-                if key in self._assignments or key not in live_keys:
-                    continue
-                try:
-                    node = self._nodes.get(a.node)
-                    if node is None:
-                        raise KeyError(f"unknown node {a.node}")
-                    take_pod_resources(node, a)
-                    self._assignments[key] = a
-                    self._assumed.add(key)
-                except (ValueError, KeyError) as e:
-                    log.warning("dropping stale reservation for %s: %s", key, e)
+                    listed[key] = None
+            adopt = sorted(
+                key
+                for key, a in listed.items()
+                if a is not None
+                and (a.all_chips() or a.grouped)
+                and key not in self._assignments
+                and key not in self._orphaned
+            )
+            # memory entries the snapshot does not back: confirmed ones
+            # whose annotation is unlisted/cleared (deletion or eviction
+            # may have won a race), assumed ones whose pod is unlisted
+            # (may simply post-date the LIST — existence decides)
+            drop_check = {
+                key: (key in self._assumed)
+                for key in sorted(self._assignments)
+                if (key in self._assumed and key not in listed)
+                or (key not in self._assumed and listed.get(key) is None)
+            }
+            prev_ids = {k: self._assignments[k] for k in drop_check}
+        if not adopt and not drop_check:
+            return
+        # fresh-as-of-now confirmation, UNLOCKED (network; see docstring)
+        adopt_now: Dict[str, Assignment] = {}
+        for key in adopt:
+            cur = self._get_current_assignment(key)
+            if isinstance(cur, Assignment):
+                adopt_now[key] = cur
+        remove_now: Dict[str, Optional[Assignment]] = {}
+        for key, assumed in drop_check.items():
+            cur = self._get_current_assignment(key)
+            if cur == "gone":
+                remove_now[key] = None  # pod positively deleted
+            elif assumed or cur == "unknown":
+                continue  # in-flight pod exists / transient error: keep
+            elif not isinstance(cur, Assignment):
+                remove_now[key] = None  # annotation cleared: eviction won
+            elif (
+                annotations.encode_assignment(cur)
+                != annotations.encode_assignment(prev_ids[key])
+            ):
+                remove_now[key] = cur  # re-planned meanwhile: adopt truth
+        if not adopt_now and not remove_now:
+            return
+        with self._lock:
+            for key, cur in remove_now.items():
+                if self._assignments.get(key) is not prev_ids.get(key):
+                    continue  # replaced meanwhile (new bind/plan): theirs wins
+                self.remove_pod(key)
+                if cur is not None:
+                    self._carry(key, cur, False)
+            for key, cur in adopt_now.items():
+                if key in self._assignments or key in self._orphaned:
+                    continue  # bound/planned meanwhile: memory is fresher
+                self._carry(key, cur, False)
 
-    def _replay(self, key: str, a: Assignment) -> None:
+    def _get_current_assignment(self, key: str):
+        """Fresh GET verdict for one pod: an Assignment (live annotation),
+        None (exists, no device annotation), "gone" (NotFound), or
+        "unknown" (transient error / undecodable — treat conservatively)."""
+        ns, name = key.split("/", 1)
+        try:
+            obj = self.api.get_pod(ns, name)
+        except NotFound:
+            return "gone"
+        except Exception:  # noqa: BLE001
+            return "unknown"
+        try:
+            cur = annotations.assignment_from_pod(obj)
+        except Exception:  # noqa: BLE001
+            return "unknown"
+        if cur is not None and (cur.all_chips() or cur.grouped):
+            return cur
+        return None
+
+    def _carry(self, key: str, a: Assignment, assumed: bool) -> bool:
+        """Charge one adopted/kept assignment (lock held)."""
         node = self._nodes.get(a.node)
         if node is None:
-            log.warning("assignment for %s names unknown node %s", key, a.node)
-            self._orphaned[key] = a.node
-            return
+            # node vanished from the LIST: hand the record to the orphan
+            # sweep (grace-window eviction owns this case), nothing to charge
+            self._orphaned.setdefault(key, a.node)
+            return False
         try:
-            take_pod_resources(node, a)
-        except (ValueError, KeyError) as e:
-            # chips vanished or double-booked while we were away; keep the
-            # pod's record but do not corrupt the tree
-            log.warning("replay of %s partially failed: %s", key, e)
-        self._assignments[key] = a
+            take_pod_resources(node, a, skip_missing=True)
+            self._assignments[key] = a
+            if assumed:
+                self._assumed.add(key)
+            return True
+        except ValueError as e:
+            # another record holds the charge: track for the conflict
+            # sweep instead of letting the pod vanish from every detector
+            log.warning("uncharged conflicting record for %s: %s", key, e)
+            self._conflicted.setdefault(key, a.node)
+            return False
 
     def update_node(self, obj: dict) -> None:
         """Apply a node watch event: re-decode and re-apply the assignments
@@ -126,8 +220,8 @@ class ClusterCache:
             for key, a in self._assignments.items():
                 if a.node == node.name:
                     try:
-                        take_pod_resources(node, a)
-                    except (ValueError, KeyError) as e:
+                        take_pod_resources(node, a, skip_missing=True)
+                    except ValueError as e:
                         log.warning("re-apply of %s on %s: %s", key, node.name, e)
 
     def remove_pod(self, key: str) -> None:
@@ -190,6 +284,12 @@ class ClusterCache:
         """pod key -> vanished node name, as of the last refresh()."""
         with self._lock:
             return dict(self._orphaned)
+
+    def conflicted_assignments(self) -> Dict[str, str]:
+        """pod key -> node name of records whose chips another record holds
+        (uncharged, tracked for the scheduler's conflict sweep)."""
+        with self._lock:
+            return dict(self._conflicted)
 
     @property
     def lock(self) -> threading.RLock:

@@ -106,6 +106,8 @@ class Scheduler:
         self._absent_chip_strikes: Dict[tuple, Tuple[int, str]] = {}
         # (pod key, node) -> consecutive resyncs the node was missing
         self._missing_node_strikes: Dict[tuple, int] = {}
+        # pod key -> consecutive resyncs its record stayed conflict-dropped
+        self._conflict_strikes: Dict[str, int] = {}
         # serializes the failure-detector entry points: the resync thread
         # and the node-watch thread both mutate the strike maps and run the
         # eviction sweep — unserialized, the watch can resize a dict mid-
@@ -585,17 +587,24 @@ class Scheduler:
 
     # -- lifecycle events -------------------------------------------------
     def resync(self) -> None:
-        """Periodic resync (ExtenderServer loop): rebuild the cache from the
-        API server, then sweep for assignments referencing died chips — the
-        consistency backstop behind the node watch (and the only failure
-        detector when the API server offers no watch).  One snapshot
-        indexed by host keeps the sweep O(assignments), not
-        O(nodes x assignments)."""
+        """Periodic resync (ExtenderServer loop): reconcile the cache with
+        the API server, then sweep for assignments referencing died chips —
+        the consistency backstop behind the node watch (and the only
+        failure detector when the API server offers no watch).  One
+        snapshot indexed by host keeps the sweep O(assignments), not
+        O(nodes x assignments).
+
+        The refresh runs OUTSIDE the lifecycle lock: it issues per-pod
+        confirmation GETs (network), and holding the lock across them
+        would stall the node-watch fast path — the very evictions the
+        watch exists to accelerate — behind API-server round-trips.
+        refresh() has its own locking and tolerates concurrent watch
+        updates."""
+        self.cache.refresh()
         with self._lifecycle_lock:
             self._resync_locked()
 
     def _resync_locked(self) -> None:
-        self.cache.refresh()
         if not self.evict_on_chip_failure:
             return
         by_host: Dict[str, list] = {}
@@ -650,6 +659,30 @@ class Scheduler:
                 "evicted %s: its node %s is no longer advertised "
                 "(%d consecutive resyncs)",
                 key, host, strikes,
+            )
+        # Conflict sweep: a record whose chips ANOTHER record holds is
+        # usually a transient race the next refresh clears, but if it
+        # persists, two live annotations claim one chip — resolve by
+        # evicting the uncharged claimant (its controller reschedules it
+        # onto chips it can actually hold) after the same grace window.
+        conflicted = self.cache.conflicted_assignments()
+        self._conflict_strikes = {
+            k: v for k, v in self._conflict_strikes.items() if k in conflicted
+        }
+        for key in sorted(conflicted):
+            strikes = self._conflict_strikes.get(key, 0) + 1
+            self._conflict_strikes[key] = strikes
+            if strikes < self.absent_grace:
+                continue
+            del self._conflict_strikes[key]
+            self._drop_gang_plan_of(key)
+            self._evict_pod(key)
+            self.metrics.inc("kubegpu_health_evictions_total")
+            log.warning(
+                "evicted %s: its annotated chips are held by another "
+                "assignment (%d consecutive resyncs) — durable "
+                "double-annotation resolved toward the charged owner",
+                key, strikes,
             )
 
     def on_pod_deleted(self, pod_obj: dict) -> None:

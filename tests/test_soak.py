@@ -275,3 +275,76 @@ class Soak:
 @pytest.mark.parametrize("seed", [0, 1, 2, 3])
 def test_control_plane_soak(seed):
     Soak(seed).run(120)
+
+
+def test_control_plane_soak_threaded():
+    """Concurrent chaos (SURVEY §5.2's go-test-race analog): four threads —
+    two racing schedule sweeps, one pod creator/deleter, one chip
+    killer/reviver firing watch-style on_node_updated — hammer one
+    Scheduler for a fixed op budget; invariants are checked at quiescence.
+    Exercises the cache lock + lifecycle lock interplay the single-threaded
+    soak cannot."""
+    import threading
+    import time
+
+    s = Soak(99)
+    # steady workload to fight over
+    for _ in range(6):
+        s.op_create_gang()
+    for _ in range(8):
+        s.op_create_pod()
+    stop = threading.Event()
+    errors = []
+
+    def guard(fn):
+        def run():
+            try:
+                while not stop.is_set():
+                    fn()
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+                stop.set()
+        return run
+
+    def sweeps():
+        s.op_schedule_sweep()
+
+    rng = random.Random(7)
+
+    def churn():
+        if rng.random() < 0.5:
+            s.op_create_pod()
+        else:
+            s.op_delete_pod()
+
+    def chaos():
+        if rng.random() < 0.5:
+            s.op_kill_chip()
+        else:
+            s.op_revive_chip()
+        # watch-style delivery: push the fresh node objects straight into
+        # the scheduler from this thread, racing the sweeps
+        for obj in s.api.list_nodes():
+            s.sched.on_node_updated(obj)
+
+    threads = [
+        threading.Thread(target=guard(sweeps)),
+        threading.Thread(target=guard(sweeps)),
+        threading.Thread(target=guard(churn)),
+        threading.Thread(target=guard(chaos)),
+    ]
+    for t in threads:
+        t.start()
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 6.0 and not stop.is_set():
+        time.sleep(0.1)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive(), "soak thread wedged (deadlock?)"
+    assert not errors, errors
+
+    # quiesce, then the full invariant check
+    s.op_resync()
+    s.op_schedule_sweep()
+    s.check("threaded soak (seed 99)")
