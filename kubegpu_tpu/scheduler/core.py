@@ -32,7 +32,7 @@ from kubegpu_tpu.scheduler.plugins import (
 from kubegpu_tpu.scheduler.podgroup import PodGroupRegistry
 from kubegpu_tpu.scheduler.preemption import collect_units, find_victims
 from kubegpu_tpu.types import annotations
-from kubegpu_tpu.types.info import Assignment, PodInfo
+from kubegpu_tpu.types.info import Assignment, PodInfo, TpuRequest
 from kubegpu_tpu.types.topology import is_contiguous_submesh
 from kubegpu_tpu.utils.apiserver import ApiServer, Conflict, NotFound
 from kubegpu_tpu.utils.metrics import Metrics, default_metrics
@@ -83,6 +83,7 @@ class Scheduler:
         plugins: Optional[PluginRegistry] = None,
         evict_on_chip_failure: bool = True,
         absent_grace: int = 2,
+        stranded_grace: int = 5,
     ) -> None:
         self.api = api
         self.cache = cache or ClusterCache(api)
@@ -102,12 +103,22 @@ class Scheduler:
         # signal); a merely-ABSENT chip or a vanished node must stay
         # absent/vanished for `absent_grace` consecutive observations first.
         self.absent_grace = max(1, absent_grace)
+        # Incomplete-gang rollback: a gang holding SOME bound members but
+        # not all for this many consecutive resyncs leaks capacity (its
+        # pending members may never fit again once other tenants take the
+        # chips) — all-or-nothing applies to the admission OUTCOME, so the
+        # bound members are rolled back and the controller re-admits the
+        # whole gang atomically.  Longer than the plan TTL (120 s / 30 s
+        # resyncs) so an actively-binding gang is never rolled back.
+        self.stranded_grace = max(1, stranded_grace)
         # (pod key, node, device_index) -> (strikes, advertisement fingerprint)
         self._absent_chip_strikes: Dict[tuple, Tuple[int, str]] = {}
         # (pod key, node) -> consecutive resyncs the node was missing
         self._missing_node_strikes: Dict[tuple, int] = {}
         # pod key -> consecutive resyncs its record stayed conflict-dropped
         self._conflict_strikes: Dict[str, int] = {}
+        # gang key -> (consecutive no-progress resyncs, bound-member set)
+        self._stranded_strikes: Dict[str, tuple] = {}
         # serializes the failure-detector entry points: the resync thread
         # and the node-watch thread both mutate the strike maps and run the
         # eviction sweep — unserialized, the watch can resize a dict mid-
@@ -509,6 +520,23 @@ class Scheduler:
                     f"gang plan places {key} on {assignment.node}, "
                     f"but bind requested {node_name}"
                 )
+            # The plan's reservation can be GONE by now: a chip-death
+            # eviction of this (unbound) member released it between
+            # planning and bind.  Annotating without a live charge writes a
+            # durable claim on chips another pod may legitimately take —
+            # double-allocation (found by the gang-churn chaos soak).
+            # Re-acquire or refuse.
+            with self.cache.lock:
+                if self.cache.assignment_of(key) is None:
+                    try:
+                        self.cache.assume(key, assignment)
+                        reserved_here = True
+                    except (ValueError, KeyError) as e:
+                        self.metrics.inc("kubegpu_bind_conflicts_total")
+                        return (
+                            f"gang reservation for {key} was released and "
+                            f"cannot be reacquired (re-run filter): {e}"
+                        )
         else:
             with self.cache.lock:
                 node = self.cache.node(node_name)
@@ -594,17 +622,19 @@ class Scheduler:
         snapshot indexed by host keeps the sweep O(assignments), not
         O(nodes x assignments).
 
-        The refresh runs OUTSIDE the lifecycle lock: it issues per-pod
-        confirmation GETs (network), and holding the lock across them
-        would stall the node-watch fast path — the very evictions the
-        watch exists to accelerate — behind API-server round-trips.
-        refresh() has its own locking and tolerates concurrent watch
-        updates."""
+        The refresh AND the sweep's LISTs run OUTSIDE the lifecycle lock:
+        they are network I/O, and holding the lock across them would stall
+        the node-watch fast path — the very evictions the watch exists to
+        accelerate — behind API-server round-trips.  refresh() has its own
+        locking and tolerates concurrent watch updates."""
         self.cache.refresh()
+        nodes_raw = self.api.list_nodes()
+        pods_raw = self.api.list_pods()
         with self._lifecycle_lock:
-            self._resync_locked()
+            self._resync_locked(nodes_raw)
+            self._sweep_stranded_gangs(pods_raw)
 
-    def _resync_locked(self) -> None:
+    def _resync_locked(self, nodes_raw: List[dict]) -> None:
         if not self.evict_on_chip_failure:
             return
         by_host: Dict[str, list] = {}
@@ -621,7 +651,6 @@ class Scheduler:
         self._absent_chip_strikes = {
             k: v for k, v in self._absent_chip_strikes.items() if k in valid
         }
-        nodes_raw = self.api.list_nodes()
         live = {(obj.get("metadata") or {}).get("name", "") for obj in nodes_raw}
         for obj in nodes_raw:
             name = (obj.get("metadata") or {}).get("name", "")
@@ -683,6 +712,65 @@ class Scheduler:
                 "assignment (%d consecutive resyncs) — durable "
                 "double-annotation resolved toward the charged owner",
                 key, strikes,
+            )
+
+    def _sweep_stranded_gangs(self, pods_raw: List[dict]) -> None:
+        """Incomplete-gang rollback (all-or-nothing applies to the
+        admission OUTCOME, not just planning): a gang that keeps SOME
+        members bound but not all for `stranded_grace` consecutive resyncs
+        WITHOUT PROGRESS is leaking capacity — mid-admission disruption
+        (chip death, preemption of a sibling) stranded it, and other
+        tenants may have taken the chips its pending members need,
+        possibly forever.  Roll the bound members back; the controller
+        recreates them and the whole gang re-admits atomically when
+        capacity allows.
+
+        Strikes count only STALLED partiality: they reset whenever the
+        bound set changes (admission converging, replacements landing)
+        and never accrue while a live plan covers the gang (members are
+        actively binding).  Runs regardless of evict_on_chip_failure —
+        capacity-leak rollback is not a chip-health feature."""
+        gangs: Dict[str, Dict[str, object]] = {}
+        for obj in pods_raw:
+            try:
+                p = annotations.pod_from_k8s(obj, strict=False)
+            except Exception:  # noqa: BLE001
+                continue
+            if not p.pod_group or TpuRequest.from_pod(p).total_chips == 0:
+                continue
+            gk = f"{p.namespace}/{p.pod_group}"
+            g = gangs.setdefault(gk, {"size": p.pod_group_size, "bound": []})
+            if p.node_name:
+                g["bound"].append(p.key)
+        stranded = {
+            gk: tuple(sorted(g["bound"]))
+            for gk, g in gangs.items()
+            if 0 < len(g["bound"]) < g["size"]
+        }
+        self._stranded_strikes = {
+            k: v for k, v in self._stranded_strikes.items() if k in stranded
+        }
+        for gk, bound in sorted(stranded.items()):
+            if self.groups.has_live_plan(gk):
+                self._stranded_strikes.pop(gk, None)
+                continue  # actively binding: not stalled
+            strikes, prev_bound = self._stranded_strikes.get(gk, (0, bound))
+            if prev_bound != bound:
+                strikes = 0  # progress (or churn): restart the window
+            strikes += 1
+            self._stranded_strikes[gk] = (strikes, bound)
+            if strikes < self.stranded_grace:
+                continue
+            del self._stranded_strikes[gk]
+            self.groups.drop_plan(gk)
+            for key in bound:
+                self._evict_pod(key)
+            self.metrics.inc("kubegpu_stranded_gang_rollbacks_total")
+            log.warning(
+                "rolled back incomplete gang %s (%d/%d bound for %d "
+                "consecutive resyncs without progress): freeing its chips "
+                "so the whole gang can re-admit atomically",
+                gk, len(bound), gangs[gk]["size"], strikes,
             )
 
     def on_pod_deleted(self, pod_obj: dict) -> None:

@@ -102,6 +102,20 @@ class PodGroupRegistry:
                     if key not in plan.committed:
                         self.cache.forget(key)
 
+    def has_live_plan(self, gk: str, now: Optional[float] = None) -> bool:
+        """True iff an unexpired plan covers the gang — members are still
+        actively binding (the stranded-gang sweep must not count these
+        resyncs as stalled)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            plan = self._plans.get(gk)
+            if plan is None:
+                return False
+            if now - plan.created > self.plan_ttl_s and len(plan.committed) < len(plan.per_pod):
+                self._expire(gk, plan)
+                return False
+            return True
+
     def try_plan(self, pod: PodInfo, now: Optional[float] = None) -> "PlanOutcome":
         """Gather the group, fit it, reserve it.  Called from filter when no
         live plan covers the pod.

@@ -48,7 +48,9 @@ class Soak:
             for h, p in fs.providers().items():
                 self.advs[h] = Advertiser(p, self.api)
                 self.advs[h].advertise_once()
-        self.sched = Scheduler(self.api, metrics=Metrics())
+        # short stranded-gang grace so the quiescence rounds can observe
+        # the rollback (production default is 5 x 30 s resyncs)
+        self.sched = Scheduler(self.api, metrics=Metrics(), stranded_grace=2)
         self.sched.resync()
         self.n = 0
         self.ops = []
@@ -200,7 +202,7 @@ class Soak:
         return "resync"
 
     # -- invariants --------------------------------------------------------
-    def check(self, trace):
+    def check(self, trace, liveness: bool = True):
         live = {}
         for obj in self.api.list_pods():
             a = annotations.assignment_from_pod(obj)
@@ -238,7 +240,11 @@ class Soak:
             bound = [o for o in objs if (o.get("spec") or {}).get("nodeName")]
             if len(bound) == size:
                 self.ever_full.add(g)
-            if g not in self.ever_full:
+            if liveness and g not in self.ever_full and len(objs) == size:
+                # judge admission atomicity only when the full membership
+                # exists: missing members mean the "controller" (the soak's
+                # recreate op) hasn't restored them, and the scheduler
+                # cannot be expected to complete a gang it cannot see
                 assert len(bound) == 0, (
                     f"I3 gang {g} partially admitted {len(bound)}/{size} "
                     f"without ever being full\n" + trace
@@ -312,10 +318,15 @@ def test_control_plane_soak_threaded():
     rng = random.Random(7)
 
     def churn():
-        if rng.random() < 0.5:
+        r = rng.random()
+        if r < 0.35:
             s.op_create_pod()
-        else:
+        elif r < 0.6:
             s.op_delete_pod()
+        elif r < 0.8:
+            s.op_create_gang()
+        else:
+            s.op_recreate_member()
 
     def chaos():
         if rng.random() < 0.5:
@@ -344,7 +355,30 @@ def test_control_plane_soak_threaded():
         assert not t.is_alive(), "soak thread wedged (deadlock?)"
     assert not errors, errors
 
-    # quiesce, then the full invariant check
-    s.op_resync()
-    s.op_schedule_sweep()
-    s.check("threaded soak (seed 99)")
+    # quiesce: restore ALL hardware first — a gang caught by mid-admission
+    # chip death is legitimately partial until capacity returns (anchored
+    # re-plan heals it) — then let scheduling and the sweeps settle
+    for sid, coords in sorted(s.dead):
+        s.slices[sid].revive_chip(coords)
+    s.dead.clear()
+    for a in s.advs.values():
+        a.advertise_once()
+    # Safety (I1/I2/I4) must hold at EVERY settle round; admission
+    # atomicity (I3) is a LIVENESS property under the stranded-gang
+    # rollback (grace 2 counted over no-progress resyncs; rollback →
+    # recreate → re-admit takes several rounds) — require it to converge
+    # within a bounded number of rounds.
+    last_err = None
+    for _ in range(25):
+        s.op_recreate_member()
+        s.op_resync()
+        s.op_schedule_sweep()
+        s.check("threaded soak (seed 99), safety", liveness=False)
+        try:
+            s.check("threaded soak (seed 99)")
+            last_err = None
+            break
+        except AssertionError as e:
+            last_err = e
+    if last_err is not None:
+        raise last_err
