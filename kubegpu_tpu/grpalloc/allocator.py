@@ -57,7 +57,16 @@ class GangResult:
 # only see its own host's chips — same constraint the reference had).
 # ---------------------------------------------------------------------------
 
-@functools.lru_cache(maxsize=8192)
+def clear_fit_caches() -> None:
+    """Drop the subset-search memo.  The cache keys are pure values so
+    entries can never go STALE — but each one pins whole-slice coord sets,
+    so a long-lived scheduler calls this on every cache refresh to bound
+    retention to one resync period (the memo's win is de-duplicating the
+    repeated evaluations WITHIN a gang-packing burst, not across days)."""
+    _best_subset_cached.cache_clear()
+
+
+@functools.lru_cache(maxsize=2048)
 def _best_subset_cached(
     avail: FrozenSet[Coord],
     n: int,

@@ -28,6 +28,18 @@ from kubegpu_tpu.utils.apiserver import ApiServer, NotFound
 log = logging.getLogger(__name__)
 
 
+def _live_assignment(obj: dict) -> Optional[Assignment]:
+    """A pod's assignment FOR CHARGING purposes: terminal-phase pods
+    (Succeeded/Failed) hold nothing — their containers are done and will
+    never run again, so their chips are free the moment the phase lands,
+    annotation lingering or not (standard kube-scheduler accounting).
+    The annotation itself is left in place: it is history, not a claim."""
+    phase = ((obj.get("status") or {}).get("phase") or "")
+    if phase in ("Succeeded", "Failed"):
+        return None
+    return annotations.assignment_from_pod(obj)
+
+
 class ClusterCache:
     def __init__(self, api: ApiServer) -> None:
         self.api = api
@@ -80,6 +92,9 @@ class ClusterCache:
         On a cold start the memory is empty, every annotated pod is a
         nominee, and the GET-confirmed adoptions ARE the restart replay
         (SURVEY.md §3.5 — what makes restarts safe with no database)."""
+        from kubegpu_tpu.grpalloc.allocator import clear_fit_caches
+
+        clear_fit_caches()  # bound memo retention to one resync period
         nodes_raw = self.api.list_nodes()
         pods_raw = self.api.list_pods()
         with self._lock:
@@ -108,7 +123,7 @@ class ClusterCache:
                 meta = obj.get("metadata", {})
                 key = f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
                 try:
-                    listed[key] = annotations.assignment_from_pod(obj)
+                    listed[key] = _live_assignment(obj)
                 except Exception:  # noqa: BLE001
                     log.exception("ignoring undecodable pod assignment")
                     listed[key] = None
@@ -179,7 +194,7 @@ class ClusterCache:
         except Exception:  # noqa: BLE001
             return "unknown"
         try:
-            cur = annotations.assignment_from_pod(obj)
+            cur = _live_assignment(obj)
         except Exception:  # noqa: BLE001
             return "unknown"
         if cur is not None and (cur.all_chips() or cur.grouped):
@@ -225,9 +240,13 @@ class ClusterCache:
                         log.warning("re-apply of %s on %s: %s", key, node.name, e)
 
     def remove_pod(self, key: str) -> None:
-        """Pod deleted/finished: return its chips."""
+        """Pod deleted/finished: return its chips — and drop it from EVERY
+        detector (a pod deleted while orphan- or conflict-tracked must not
+        keep accruing strikes toward evicting an already-deleted pod)."""
         with self._lock:
             self._assumed.discard(key)
+            self._orphaned.pop(key, None)
+            self._conflicted.pop(key, None)
             a = self._assignments.pop(key, None)
             if a is None:
                 return

@@ -73,6 +73,21 @@ def _scale_scores(raw: List[Tuple[str, Optional[float]]]) -> List[Tuple[str, int
     return [(n, scale(s)) for n, s in raw]
 
 
+def _consensus_size(sizes: List[int]) -> int:
+    """Gang cardinality by member consensus: the most common declared
+    pod-group-size wins; ties break toward the SMALLER size (the direction
+    that avoids rolling back a healthy gang).  Never last-write-wins — one
+    recreated member with a stale annotation must not move the
+    denominator the stranded-gang rollback is judged against."""
+    if not sizes:
+        return 0
+    counts: Dict[int, int] = {}
+    for s in sizes:
+        counts[s] = counts.get(s, 0) + 1
+    top = max(counts.values())
+    return min(s for s, c in counts.items() if c == top)
+
+
 class Scheduler:
     def __init__(
         self,
@@ -635,8 +650,32 @@ class Scheduler:
             self._sweep_stranded_gangs(pods_raw)
 
     def _resync_locked(self, nodes_raw: List[dict]) -> None:
-        if not self.evict_on_chip_failure:
-            return
+        if self.evict_on_chip_failure:
+            self._sweep_chip_health(nodes_raw)
+        # The conflict sweep runs REGARDLESS of evict_on_chip_failure —
+        # durable double-annotation is an accounting pathology, not a
+        # chip-health event (same reasoning as the stranded-gang sweep).
+        conflicted = self.cache.conflicted_assignments()
+        self._conflict_strikes = {
+            k: v for k, v in self._conflict_strikes.items() if k in conflicted
+        }
+        for key in sorted(conflicted):
+            strikes = self._conflict_strikes.get(key, 0) + 1
+            self._conflict_strikes[key] = strikes
+            if strikes < self.absent_grace:
+                continue
+            del self._conflict_strikes[key]
+            self._drop_gang_plan_of(key)
+            self._evict_pod(key)
+            self.metrics.inc("kubegpu_health_evictions_total")
+            log.warning(
+                "evicted %s: its annotated chips are held by another "
+                "assignment (%d consecutive resyncs) — durable "
+                "double-annotation resolved toward the charged owner",
+                key, strikes,
+            )
+
+    def _sweep_chip_health(self, nodes_raw: List[dict]) -> None:
         by_host: Dict[str, list] = {}
         for key, a in self.cache.assignments_snapshot().items():
             for r in a.all_chips():
@@ -689,31 +728,6 @@ class Scheduler:
                 "(%d consecutive resyncs)",
                 key, host, strikes,
             )
-        # Conflict sweep: a record whose chips ANOTHER record holds is
-        # usually a transient race the next refresh clears, but if it
-        # persists, two live annotations claim one chip — resolve by
-        # evicting the uncharged claimant (its controller reschedules it
-        # onto chips it can actually hold) after the same grace window.
-        conflicted = self.cache.conflicted_assignments()
-        self._conflict_strikes = {
-            k: v for k, v in self._conflict_strikes.items() if k in conflicted
-        }
-        for key in sorted(conflicted):
-            strikes = self._conflict_strikes.get(key, 0) + 1
-            self._conflict_strikes[key] = strikes
-            if strikes < self.absent_grace:
-                continue
-            del self._conflict_strikes[key]
-            self._drop_gang_plan_of(key)
-            self._evict_pod(key)
-            self.metrics.inc("kubegpu_health_evictions_total")
-            log.warning(
-                "evicted %s: its annotated chips are held by another "
-                "assignment (%d consecutive resyncs) — durable "
-                "double-annotation resolved toward the charged owner",
-                key, strikes,
-            )
-
     def _sweep_stranded_gangs(self, pods_raw: List[dict]) -> None:
         """Incomplete-gang rollback (all-or-nothing applies to the
         admission OUTCOME, not just planning): a gang that keeps SOME
@@ -729,8 +743,31 @@ class Scheduler:
         bound set changes (admission converging, replacements landing)
         and never accrue while a live plan covers the gang (members are
         actively binding).  Runs regardless of evict_on_chip_failure —
-        capacity-leak rollback is not a chip-health feature."""
-        gangs: Dict[str, Dict[str, object]] = {}
+        capacity-leak rollback is not a chip-health feature.
+
+        Because rollback deletes running pods, the partiality verdict is
+        hardened against three ways a HEALTHY gang can look partial:
+
+        - **Succeeded members** (e.g. garbage-collected one at a time by a
+          TTL controller): their work is done and no replacement is owed,
+          so they leave the bound set AND shrink the denominator —
+          including after GC deletes them (the sweep remembers every
+          member it ever saw Succeeded, per `_gang_done`).  Their chips
+          are already uncharged: the cache treats terminal-phase pods as
+          holding nothing (ClusterCache.refresh).
+        - **Terminating members**: they hold spec.nodeName but are
+          leaving, so they never count as bound — the gang still owes a
+          replacement, so the denominator keeps them.  Their stale
+          assignment annotations are evicted along with a rollback so a
+          rolled-back gang frees ALL its chips.  (Failed members are
+          excluded from bound the same way; their chips are uncharged by
+          the terminal-phase rule, no eviction needed.)
+        - **Size disagreement** (a recreated member carrying a stale
+          pod-group-size annotation): the denominator is the CONSENSUS of
+          the members' declared sizes — most common wins, ties toward the
+          smaller (the direction that avoids rolling back a healthy
+          gang) — never last-write-wins."""
+        gangs: Dict[str, Dict[str, list]] = {}
         for obj in pods_raw:
             try:
                 p = annotations.pod_from_k8s(obj, strict=False)
@@ -739,14 +776,38 @@ class Scheduler:
             if not p.pod_group or TpuRequest.from_pod(p).total_chips == 0:
                 continue
             gk = f"{p.namespace}/{p.pod_group}"
-            g = gangs.setdefault(gk, {"size": p.pod_group_size, "bound": []})
+            g = gangs.setdefault(
+                gk, {"sizes": [], "bound": [], "releasable": []}
+            )
+            if p.phase == "Succeeded":
+                # remembered in the registry (shared with the planner, so
+                # sweep denominator and re-plan requirement never diverge)
+                # because a TTL controller may GC the pod before the next
+                # resync — and a vanished Succeeded member must KEEP
+                # shrinking the denominator
+                self.groups.note_done(gk, p.key)
+                continue
+            # a name reused by a live recreation must not double-count
+            # (once as bound, once as remembered-done)
+            self.groups.note_live(gk, p.key)
+            g["sizes"].append(p.pod_group_size)
+            if p.terminal or p.terminating:
+                if p.terminating and not p.terminal and (
+                    annotations.assignment_from_pod(p.annotations) is not None
+                ):
+                    g["releasable"].append(p.key)
+                continue
             if p.node_name:
                 g["bound"].append(p.key)
-        stranded = {
-            gk: tuple(sorted(g["bound"]))
-            for gk, g in gangs.items()
-            if 0 < len(g["bound"]) < g["size"]
-        }
+        # forget completed-member memory for gangs no longer listed at all
+        # (fully GC'd): nothing is left to judge, and a later gang reusing
+        # the name must start clean
+        self.groups.prune_done(gangs)
+        stranded = {}
+        for gk, g in gangs.items():
+            size = _consensus_size(g["sizes"]) - self.groups.done_count(gk)
+            if 0 < len(g["bound"]) < size:
+                stranded[gk] = tuple(sorted(g["bound"]))
         self._stranded_strikes = {
             k: v for k, v in self._stranded_strikes.items() if k in stranded
         }
@@ -763,20 +824,41 @@ class Scheduler:
                 continue
             del self._stranded_strikes[gk]
             self.groups.drop_plan(gk)
-            for key in bound:
+            for key in (*bound, *sorted(gangs[gk]["releasable"])):
                 self._evict_pod(key)
             self.metrics.inc("kubegpu_stranded_gang_rollbacks_total")
             log.warning(
-                "rolled back incomplete gang %s (%d/%d bound for %d "
-                "consecutive resyncs without progress): freeing its chips "
-                "so the whole gang can re-admit atomically",
-                gk, len(bound), gangs[gk]["size"], strikes,
+                "rolled back incomplete gang %s (%d bound of %d outstanding "
+                "for %d consecutive resyncs without progress): freeing its "
+                "chips so the whole gang can re-admit atomically",
+                gk, len(bound),
+                _consensus_size(gangs[gk]["sizes"])
+                - self.groups.done_count(gk),
+                strikes,
             )
 
     def on_pod_deleted(self, pod_obj: dict) -> None:
+        # lenient parse, like every LIST-path consumer: a malformed device
+        # quantity must not make a DELETED event invisible — dropping it
+        # would leak the pod's chips and keep its gang plan live until the
+        # next resync, exactly the wait the pod watch exists to remove
         try:
-            pod = annotations.pod_from_k8s(pod_obj)
+            pod = annotations.pod_from_k8s(pod_obj, strict=False)
         except Exception:  # noqa: BLE001
+            return
+        # Stale-event guard: the watch delivers by NAME, and a controller
+        # can recreate the name before a delayed DELETED event drains.
+        # Acting on it then would free the RECREATED pod's live
+        # reservation (double-allocation).  Only a fresh NotFound proves
+        # the name is really gone; on existence or transient error, skip —
+        # chips merely look used one resync longer (the safe direction,
+        # same discipline as the cache's GET-confirmed reconciliation).
+        try:
+            self.api.get_pod(pod.namespace, pod.name)
+            return  # exists (recreated or not-yet-deleted): stale event
+        except NotFound:
+            pass
+        except Exception:  # noqa: BLE001 - transient: resync will converge
             return
         self.cache.remove_pod(pod.key)
         self.groups.on_pod_deleted(pod)

@@ -58,6 +58,39 @@ class PodGroupRegistry:
         self.plan_ttl_s = plan_ttl_s
         self._lock = threading.RLock()
         self._plans: Dict[str, GangPlan] = {}
+        # gang key -> member keys ever seen Succeeded.  Completed members
+        # owe no replacement, so they shrink BOTH the planner's "all
+        # members created" requirement and the stranded sweep's
+        # denominator — and the memory must survive their GC deletion
+        # (a TTL controller removes them between LISTs).  One shared
+        # record keeps planner and sweep from ever disagreeing on gang
+        # arithmetic.  (Lost on scheduler restart; the no-progress grace
+        # window is the remaining protection then.)
+        self._done: Dict[str, Set[str]] = {}
+
+    # -- completed-member memory ------------------------------------------
+    def note_done(self, gk: str, member_key: str) -> None:
+        with self._lock:
+            self._done.setdefault(gk, set()).add(member_key)
+
+    def note_live(self, gk: str, member_key: str) -> None:
+        """A live pod reusing a remembered name must not double-count
+        (once live, once as remembered-done)."""
+        with self._lock:
+            if gk in self._done:
+                self._done[gk].discard(member_key)
+
+    def done_count(self, gk: str) -> int:
+        with self._lock:
+            return len(self._done.get(gk, ()))
+
+    def prune_done(self, live_gangs) -> None:
+        """Forget gangs no longer listed at all (fully GC'd): nothing is
+        left to judge, and a later gang reusing the name starts clean."""
+        with self._lock:
+            self._done = {
+                gk: v for gk, v in self._done.items() if gk in live_gangs
+            }
 
     @staticmethod
     def group_key(pod: PodInfo) -> Optional[str]:
@@ -132,14 +165,20 @@ class PodGroupRegistry:
             existing = self.plan_for(pod, now=now)
             if existing:
                 return PlanOutcome(plan=existing)
-            if len(pending) + len(scheduled) < pod.pod_group_size:
+            # Succeeded members owe no replacement: the outstanding size is
+            # the declared size minus every member ever seen Succeeded —
+            # matching the stranded sweep's denominator, so a gang the
+            # sweep judges healthy can always re-plan its remainder.
+            outstanding = pod.pod_group_size - self.done_count(gk)
+            if len(pending) + len(scheduled) < outstanding:
                 return PlanOutcome(
                     reason=(
                         f"gang {gk}: waiting for members "
-                        f"({len(pending) + len(scheduled)}/{pod.pod_group_size} created)"
+                        f"({len(pending) + len(scheduled)}/{outstanding} "
+                        "outstanding created)"
                     )
                 )
-            members = self._select_members(pod, pending, scheduled)
+            members = self._select_members(pod, pending, scheduled, outstanding)
             if members is None:
                 # deterministic membership: first N by name; this pod lost
                 return PlanOutcome(
@@ -238,11 +277,13 @@ class PodGroupRegistry:
             return PlanOutcome(plan=plan)
 
     @staticmethod
-    def _select_members(pod: PodInfo, pending, scheduled) -> Optional[List[PodInfo]]:
+    def _select_members(
+        pod: PodInfo, pending, scheduled, outstanding: int
+    ) -> Optional[List[PodInfo]]:
         """The single source of truth for which pending pods a plan covers:
-        first (group_size - already_scheduled) by name; None if this pod is
-        not among them."""
-        want = pod.pod_group_size - len(scheduled)
+        first (outstanding - already_scheduled) by name; None if this pod
+        is not among them."""
+        want = outstanding - len(scheduled)
         members = sorted(pending, key=lambda p: p.key)[:want]
         if pod.key not in {p.key for p in members}:
             return None
@@ -260,9 +301,10 @@ class PodGroupRegistry:
         """The member set try_plan would plan for this pod right now (used
         by preemption simulation so it can never diverge from planning)."""
         pending, scheduled, _, _ = self._gather_members(pod)
-        if len(pending) + len(scheduled) < pod.pod_group_size:
+        outstanding = pod.pod_group_size - self.done_count(self.group_key(pod))
+        if len(pending) + len(scheduled) < outstanding:
             return None
-        return self._select_members(pod, pending, scheduled)
+        return self._select_members(pod, pending, scheduled, outstanding)
 
     def _gather_members(self, pod: PodInfo):
         """Group members split into (pending, already_scheduled), plus each
@@ -296,12 +338,23 @@ class PodGroupRegistry:
                 )
                 if not placed:
                     continue
-            if p.pod_group == pod.pod_group:
-                seen[p.key] = p
-                a = annotations.assignment_from_pod(obj)
-                if a is not None and a.slice_id and a.all_chips():
-                    slices[p.key] = a.slice_id
-                    coords[p.key] = frozenset(c.coords for c in a.all_chips())
+            if p.pod_group != pod.pod_group:
+                continue
+            if p.terminal:
+                # Succeeded/Failed: uncharged (ClusterCache treats terminal
+                # pods as holding nothing) — neither a pending member to
+                # plan nor a scheduled member anchoring slices.  Succeeded
+                # additionally shrinks the outstanding size (work done, no
+                # replacement owed); Failed still owes one.
+                if p.phase == "Succeeded":
+                    self.note_done(self.group_key(pod), p.key)
+                continue
+            self.note_live(self.group_key(pod), p.key)
+            seen[p.key] = p
+            a = annotations.assignment_from_pod(obj)
+            if a is not None and a.slice_id and a.all_chips():
+                slices[p.key] = a.slice_id
+                coords[p.key] = frozenset(c.coords for c in a.all_chips())
         seen.setdefault(pod.key, pod)
         for key, p in seen.items():
             ca = self.cache.assignment_of(key)

@@ -191,12 +191,16 @@ def _debug_state(sched: Scheduler) -> dict:
 
 
 class ExtenderServer:
-    """Owns the HTTP server + a node watch + a cache resync loop.
+    """Owns the HTTP server + node/pod watches + a cache resync loop.
 
-    The watch is the fast path of failure detection: the advertiser's node
-    patch lands as an event and chip-death eviction fires immediately
-    instead of waiting for the next resync tick.  The periodic resync stays
-    as the consistency backstop (watch-stream drops, missed events, the
+    The node watch is the fast path of failure detection: the advertiser's
+    node patch lands as an event and chip-death eviction fires immediately
+    instead of waiting for the next resync tick.  The pod watch is the fast
+    path of gang lifecycle: a deleted member invalidates its gang plan and
+    frees its chips the moment the DELETED event lands, instead of waiting
+    out the plan TTL or the next resync LIST (SURVEY.md §3.5 — the
+    reference ran BOTH informers).  The periodic resync stays as the
+    consistency backstop (watch-stream drops, missed events, the
     orphaned-node sweep)."""
 
     def __init__(
@@ -229,6 +233,9 @@ class ExtenderServer:
             w = threading.Thread(target=self._watch_loop, daemon=True)
             w.start()
             self._threads.append(w)
+            p = threading.Thread(target=self._pod_watch_loop, daemon=True)
+            p.start()
+            self._threads.append(p)
 
     def _resync_loop(self) -> None:
         while not self._stop.wait(self.resync_interval_s):
@@ -255,8 +262,30 @@ class ExtenderServer:
         except Exception:  # noqa: BLE001
             log.exception("node watch died; relying on periodic resync")
 
+    def _pod_watch_loop(self) -> None:
+        def handler(event: str, obj: dict) -> None:
+            try:
+                if event == "pod-deleted":
+                    self.sched.on_pod_deleted(obj)
+                # pod-created needs no action here: planning happens in
+                # filter, which kube-scheduler re-drives for pending pods —
+                # and the deletion path above has already freed whatever a
+                # fresh plan needs.  pod-updated is reconciled by resync.
+            except Exception:  # noqa: BLE001
+                log.exception("pod watch handler failed for %s", event)
+
+        try:
+            self.sched.api.watch_pods(handler, self._stop)
+        except NotImplementedError:
+            log.info("api server has no pod watch; relying on plan TTL + resync")
+        except Exception:  # noqa: BLE001
+            log.exception("pod watch died; relying on plan TTL + resync")
+
     def stop(self) -> None:
         self._stop.set()
+        close = getattr(self.sched.api, "close_watches", None)
+        if close is not None:
+            close()  # unblock watch threads from quiet-window socket reads
         self.httpd.shutdown()
         self.httpd.server_close()
 

@@ -170,6 +170,8 @@ def pod_from_k8s(obj: dict, strict: bool = True) -> PodInfo:
         labels=dict(meta.get("labels") or {}),
         node_name=spec.get("nodeName"),
         subdomain=spec.get("subdomain"),
+        phase=str((obj.get("status") or {}).get("phase") or ""),
+        deletion_timestamp=meta.get("deletionTimestamp"),
     )
     pod.pod_group = ann.get(POD_GROUP)
     try:

@@ -111,6 +111,22 @@ class PodInfo:
     allow_multislice: bool = False
     # tenant pinning: slice ids placement may use (None = any slice)
     slice_selector: Optional[frozenset] = None
+    # Lifecycle (status.phase / metadata.deletionTimestamp): the stranded-
+    # gang sweep must not count Terminating victims or garbage-collected
+    # Succeeded members as "bound" capacity holders.
+    phase: str = ""
+    deletion_timestamp: Optional[str] = None
+
+    @property
+    def terminal(self) -> bool:
+        """Succeeded/Failed: the pod's chips are released; it will never
+        run again (its containers are done)."""
+        return self.phase in ("Succeeded", "Failed")
+
+    @property
+    def terminating(self) -> bool:
+        """Graceful deletion in progress (deletionTimestamp set)."""
+        return self.deletion_timestamp is not None
 
     @property
     def key(self) -> str:

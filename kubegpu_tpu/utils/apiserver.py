@@ -13,9 +13,11 @@ The in-memory fake is also the engine of the simulated e2e benchmark.
 from __future__ import annotations
 
 import copy
+import http.client
 import json
 import logging
 import os
+import socket
 import ssl
 import threading
 import urllib.request
@@ -76,6 +78,14 @@ class ApiServer:
         their reconnect loop; callers just spawn this on a thread.  The
         event-driven half of failure detection: chip-death eviction fires
         from the advertiser's patch instead of waiting for a resync tick."""
+        raise NotImplementedError
+
+    def watch_pods(self, handler: Callable[[str, dict], None],
+                   stop, timeout_s: int = 30) -> None:
+        """Block delivering pod events — handler("pod-created"|"pod-updated"
+        |"pod-deleted", obj) — until `stop` is set.  The second informer the
+        reference ran (SURVEY.md §3.5): pod deletion is the load-bearing
+        event (gang-plan invalidation without waiting for TTL/resync)."""
         raise NotImplementedError
 
 
@@ -203,13 +213,29 @@ class InMemoryApiServer(ApiServer):
                     stop, timeout_s: int = 30) -> None:
         """Observer-backed watch with the same contract as the real client:
         events queue up under mutation and drain on this thread."""
+        self._drain_events(
+            handler, stop,
+            {"node-updated": "node-updated", "node-deleted": "node-deleted"},
+        )
+
+    def watch_pods(self, handler: Callable[[str, dict], None],
+                   stop, timeout_s: int = 30) -> None:
+        self._drain_events(
+            handler, stop,
+            # pod-bound is a spec mutation: the wire client would see it as
+            # MODIFIED, so deliver it as an update
+            {"pod-created": "pod-created", "pod-updated": "pod-updated",
+             "pod-bound": "pod-updated", "pod-deleted": "pod-deleted"},
+        )
+
+    def _drain_events(self, handler, stop, event_map: Dict[str, str]) -> None:
         import queue
 
         q: "queue.Queue" = queue.Queue()
 
         def obs(event: str, obj: dict) -> None:
-            if event in ("node-updated", "node-deleted"):
-                q.put((event, obj))
+            if event in event_map:
+                q.put((event_map[event], obj))
 
         self.observe(obs)
         try:
@@ -244,6 +270,9 @@ class KubeApiServer(ApiServer):
         self._ctx = ssl.create_default_context(
             cafile=self.CA if os.path.exists(self.CA) else None
         )
+        # live watch streams, so close_watches() can unblock their readers
+        self._watch_lock = threading.Lock()
+        self._watch_conns: set = set()
 
     def _token(self) -> str:
         try:
@@ -338,10 +367,55 @@ class KubeApiServer(ApiServer):
         exponentially with a warning: a permanently-failing watch (e.g.
         RBAC missing the watch verb) must be visible to the operator, who
         is otherwise silently down to the slow resync path."""
+        self._watch(
+            "/api/v1/nodes", handler, stop, timeout_s,
+            # ADDED folds into node-updated: a new node is just a cache
+            # update, and reconnect-replays must be idempotent anyway
+            {"ADDED": "node-updated", "MODIFIED": "node-updated",
+             "DELETED": "node-deleted"},
+        )
+
+    def watch_pods(self, handler: Callable[[str, dict], None],
+                   stop, timeout_s: int = 30) -> None:
+        """Same stream discipline over /api/v1/pods — the second informer
+        the reference ran (SURVEY.md §3.5 "client-go informers: nodes,
+        pods").  DELETED is the load-bearing event: a deleted gang member
+        invalidates its plan immediately instead of waiting for plan-TTL
+        expiry or the next resync LIST."""
+        self._watch(
+            "/api/v1/pods", handler, stop, timeout_s,
+            {"ADDED": "pod-created", "MODIFIED": "pod-updated",
+             "DELETED": "pod-deleted"},
+        )
+
+    def close_watches(self) -> None:
+        """Prompt shutdown: shut down any live watch streams' sockets so
+        their reader threads unblock immediately instead of waiting out a
+        quiet window (up to timeout_s+5 s).  ``shutdown(SHUT_RDWR)`` is
+        the load-bearing call — closing a file descriptor does NOT wake a
+        thread already blocked in recv().  Callers set `stop` FIRST; the
+        watch loop treats the resulting read error as a normal stream
+        drop and then observes stop."""
+        with self._watch_lock:
+            conns = list(self._watch_conns)
+        for resp in conns:
+            try:
+                # http.client.HTTPResponse → fp (buffered) → raw SocketIO
+                sock = resp.fp.raw._sock  # noqa: SLF001
+                sock.shutdown(socket.SHUT_RDWR)
+            except Exception:  # noqa: BLE001 - already closed/racing
+                pass
+            try:
+                resp.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _watch(self, base_path: str, handler: Callable[[str, dict], None],
+               stop, timeout_s: int, event_map: Dict[str, str]) -> None:
         rv: Optional[str] = None
         backoff = 1.0
         while not stop.is_set():
-            path = f"/api/v1/nodes?watch=true&timeoutSeconds={timeout_s}"
+            path = f"{base_path}?watch=true&timeoutSeconds={timeout_s}"
             if rv:
                 path += f"&resourceVersion={rv}"
             req = urllib.request.Request(self.base + path)
@@ -351,42 +425,54 @@ class KubeApiServer(ApiServer):
                 with urllib.request.urlopen(
                     req, context=self._ctx, timeout=timeout_s + 5
                 ) as resp:
-                    backoff = 1.0  # stream established
-                    for line in resp:
-                        if stop.is_set():
-                            return
-                        line = line.strip()
-                        if not line:
-                            continue
-                        try:
-                            evt = json.loads(line)
-                        except json.JSONDecodeError:
-                            continue  # partial line at stream close
-                        etype = evt.get("type", "")
-                        obj = evt.get("object") or {}
-                        new_rv = (obj.get("metadata") or {}).get("resourceVersion")
-                        if new_rv:
-                            rv = new_rv
-                        if etype in ("ADDED", "MODIFIED"):
-                            handler("node-updated", obj)
-                        elif etype == "DELETED":
-                            handler("node-deleted", obj)
-                        elif etype == "ERROR":
-                            # 410 Gone as a stream event: the resourceVersion
-                            # is too old; restart from scratch
-                            rv = None
+                    with self._watch_lock:
+                        self._watch_conns.add(resp)
+                    try:
+                        backoff = 1.0  # stream established
+                        for line in resp:
+                            if stop.is_set():
+                                return
+                            line = line.strip()
+                            if not line:
+                                continue
+                            try:
+                                evt = json.loads(line)
+                            except json.JSONDecodeError:
+                                continue  # partial line at stream close
+                            etype = evt.get("type", "")
+                            obj = evt.get("object") or {}
+                            new_rv = (obj.get("metadata") or {}).get(
+                                "resourceVersion"
+                            )
+                            if new_rv:
+                                rv = new_rv
+                            if etype in event_map:
+                                handler(event_map[etype], obj)
+                            elif etype == "ERROR":
+                                # 410 Gone as a stream event: the
+                                # resourceVersion is too old; restart fresh
+                                rv = None
+                    finally:
+                        with self._watch_lock:
+                            self._watch_conns.discard(resp)
             except urllib.error.HTTPError as e:
                 if e.code == 410:  # Gone: stale resourceVersion
                     rv = None
                     continue
-                log.warning("node watch request failed (HTTP %s); retrying "
-                            "in %.0fs", e.code, backoff)
+                log.warning("%s watch request failed (HTTP %s); retrying "
+                            "in %.0fs", base_path, e.code, backoff)
                 if stop.wait(backoff):
                     return
                 backoff = min(backoff * 2, 30.0)
-            except (OSError, urllib.error.URLError) as e:
-                log.warning("node watch stream dropped (%s); retrying in "
-                            "%.0fs", e, backoff)
+            except (OSError, urllib.error.URLError, ValueError,
+                    http.client.HTTPException) as e:
+                # ValueError/HTTPException: a close_watches() racing the
+                # read surfaces as "I/O operation on closed file" — a
+                # normal stream drop, not a crash
+                if stop.is_set():
+                    return  # close_watches() during shutdown
+                log.warning("%s watch stream dropped (%s); retrying in "
+                            "%.0fs", base_path, e, backoff)
                 if stop.wait(backoff):
                     return
                 backoff = min(backoff * 2, 30.0)

@@ -76,7 +76,8 @@ class StubState:
         self.nodes = {}
         self.pods = {}          # "ns/name" -> obj
         self.requests = []      # (method, path, content_type, auth)
-        self.watch_events = []  # [{"type": ..., "object": ...}]
+        self.watch_events = []  # node events [{"type": ..., "object": ...}]
+        self.pod_watch_events = []  # pod events, same shape
         self.watch_poll_s = 0.0  # >0: long-poll for NEW events this long
         self.lock = threading.Lock()
 
@@ -112,7 +113,7 @@ def make_stub_handler(state: StubState):
             self.end_headers()
             self.wfile.write(body)
 
-        def _stream_watch(self):
+        def _stream_watch(self, events=None):
             import time as _time
 
             self.send_response(200)
@@ -122,7 +123,9 @@ def make_stub_handler(state: StubState):
             deadline = _time.monotonic() + state.watch_poll_s
             while True:
                 with state.lock:
-                    pending = state.watch_events[sent:]
+                    pending = (
+                        state.watch_events if events is None else events
+                    )[sent:]
                 for evt in pending:
                     self.wfile.write(json.dumps(evt).encode() + b"\n")
                     self.wfile.flush()
@@ -147,6 +150,8 @@ def make_stub_handler(state: StubState):
                     else self._send(404, {"reason": "NotFound"})
                 )
             if url.path == "/api/v1/pods":
+                if "watch=true" in (url.query or ""):
+                    return self._stream_watch(state.pod_watch_events)
                 return self._send(200, {"items": list(state.pods.values())})
             if parts[:3] == ["api", "v1", "namespaces"] and len(parts) == 5:
                 return self._send(200, {
@@ -185,7 +190,11 @@ def make_stub_handler(state: StubState):
                 if key in state.pods:
                     return self._send(409, {"reason": "AlreadyExists"})
                 body.setdefault("metadata", {}).setdefault("namespace", ns)
-                state.pods[key] = body
+                with state.lock:
+                    state.pods[key] = body
+                    state.pod_watch_events.append(
+                        {"type": "ADDED", "object": json.loads(json.dumps(body))}
+                    )
                 return self._send(201, body)
             self._send(404, {"reason": "NotFound"})
 
@@ -224,9 +233,14 @@ def make_stub_handler(state: StubState):
                 pod = state.pods.get(f"{parts[3]}/{parts[5]}")
                 if pod is None:
                     return self._send(404, {"reason": "NotFound"})
-                pod.setdefault("metadata", {}).setdefault(
-                    "annotations", {}
-                ).update(body.get("metadata", {}).get("annotations", {}))
+                with state.lock:
+                    pod.setdefault("metadata", {}).setdefault(
+                        "annotations", {}
+                    ).update(body.get("metadata", {}).get("annotations", {}))
+                    state.pod_watch_events.append(
+                        {"type": "MODIFIED",
+                         "object": json.loads(json.dumps(pod))}
+                    )
                 return self._send(200, pod)
             self._send(404, {"reason": "NotFound"})
 
@@ -237,7 +251,12 @@ def make_stub_handler(state: StubState):
                 key = f"{parts[3]}/{parts[5]}"
                 if key not in state.pods:
                     return self._send(404, {"reason": "NotFound"})
-                del state.pods[key]
+                with state.lock:
+                    snapshot = json.loads(json.dumps(state.pods[key]))
+                    del state.pods[key]
+                    state.pod_watch_events.append(
+                        {"type": "DELETED", "object": snapshot}
+                    )
                 return self._send(200, {})
             self._send(404, {"reason": "NotFound"})
 
@@ -391,6 +410,77 @@ def test_extender_daemon_watch_eviction_through_rest_client(stub):
         assert "default/victim" not in state.pods, (
             "watch event over the REST wire did not evict the pod"
         )
+    finally:
+        server.stop()
+
+
+def test_pod_watch_invalidates_gang_plan_without_ttl(stub):
+    """VERDICT r2 #2 done-condition: deleting a pending gang member over
+    the wire triggers plan invalidation in <1 s with the plan TTL cranked
+    to HOURS — proving the gang lifecycle is event-driven (pod watch), not
+    TTL/resync-pull.  A replacement member then re-plans successfully."""
+    import time
+
+    from kubegpu_tpu.plugins import Advertiser, FakeSlice
+    from kubegpu_tpu.scheduler import Scheduler
+    from kubegpu_tpu.scheduler.server import ExtenderServer
+    from kubegpu_tpu.types import annotations
+
+    api, state = stub
+    state.watch_poll_s = 3.0  # live long-poll stream
+    fs = FakeSlice(slice_id="s0", mesh_shape=(2, 4), host_block=(2, 2))
+    for prov in fs.providers().values():
+        Advertiser(prov, api).advertise_once()
+
+    server = ExtenderServer(
+        Scheduler(api, gang_plan_ttl_s=3600.0),  # hours: TTL cannot fire
+        listen=("127.0.0.1", 0),
+        resync_interval_s=3600.0,                # resync cannot fire either
+    )
+    server.start()
+    try:
+        def gang_pod(name):
+            return {
+                "metadata": {
+                    "name": name, "namespace": "default",
+                    "annotations": {
+                        annotations.POD_GROUP: "ring",
+                        annotations.POD_GROUP_SIZE: "2",
+                    },
+                },
+                "spec": {"containers": [
+                    {"name": "main",
+                     "resources": {"limits": {"google.com/tpu": "2"}}}]},
+            }
+
+        api.create_pod(gang_pod("g-a"))
+        api.create_pod(gang_pod("g-b"))
+        nodes = sorted(n["metadata"]["name"] for n in api.list_nodes())
+        r = server.sched.filter(gang_pod("g-a"), nodes)
+        assert r.nodes, r.failed
+        assert server.sched.groups.has_live_plan("default/ring")
+        assert server.sched.cache.assignment_of("default/g-b") is not None
+
+        t0 = time.monotonic()
+        api.delete_pod("default", "g-b")  # wire DELETE → watch DELETED event
+        deadline = t0 + 10.0
+        while time.monotonic() < deadline:
+            if not server.sched.groups.has_live_plan("default/ring"):
+                break
+            time.sleep(0.02)
+        elapsed = time.monotonic() - t0
+        assert not server.sched.groups.has_live_plan("default/ring"), (
+            "pod DELETED event did not invalidate the gang plan"
+        )
+        assert elapsed < 1.0, f"plan invalidation took {elapsed:.2f}s"
+        # the dead member's reservation was returned, not leaked
+        assert server.sched.cache.assignment_of("default/g-b") is None
+
+        # a replacement member re-plans the gang on the freed chips
+        api.create_pod(gang_pod("g-c"))
+        r2 = server.sched.filter(gang_pod("g-c"), nodes)
+        assert r2.nodes, r2.failed
+        assert server.sched.groups.has_live_plan("default/ring")
     finally:
         server.stop()
 

@@ -587,9 +587,289 @@ def test_pod_delete_returns_chips():
     api.create_pod(obj)
     r = sched.filter(obj, nodes_of(api))
     assert sched.bind("default", "tmp", r.nodes[0]) is None
+    api.delete_pod("default", "tmp")
     sched.on_pod_deleted(obj)
     view = next(iter(sched.cache.views().values()))
     assert len(view.free) == 16
+
+
+# -- stranded-gang rollback hardening ---------------------------------------
+#
+# Rollback deletes running pods, so the partiality verdict must survive the
+# three ways a HEALTHY gang can look partial (VERDICT r2 weak #4 / next #7,
+# ADVICE r2 low #1): terminal-phase members, Terminating victims, and
+# stale pod-group-size annotations.
+
+def bind_gang(api, sched, group, names, chips=2, size=None):
+    size = size or len(names)
+    for name in names:
+        obj = pod_obj(name, chips, group=group, group_size=size)
+        api.create_pod(obj)
+    for name in names:
+        obj = api.get_pod("default", name)
+        r = sched.filter(obj, nodes_of(api))
+        assert r.nodes, (name, r.failed)
+        assert sched.bind("default", name, r.nodes[0]) is None
+
+
+def set_pod_status(api, name, phase=None, deleting=False, ns="default"):
+    """Directly mutate stored pod state the InMemory API has no verb for."""
+    with api._lock:
+        pod = api._pods[f"{ns}/{name}"]
+        if phase:
+            pod["status"] = {"phase": phase}
+        if deleting:
+            pod["metadata"]["deletionTimestamp"] = "2026-07-30T00:00:00Z"
+
+
+def test_succeeded_members_gc_one_at_a_time_is_not_a_stranded_gang():
+    """ADVICE r2 low #1 scenario: a fully-Succeeded gang whose members a
+    TTL controller garbage-collects one at a time must NOT be judged
+    0 < bound < size and 'rolled back' (deleting the surviving completed
+    pods)."""
+    api, _, _ = fake_cluster()
+    sched = make_sched(api, stranded_grace=2)
+    bind_gang(api, sched, "done-gang", ["d-a", "d-b"])
+    set_pod_status(api, "d-a", phase="Succeeded")
+    set_pod_status(api, "d-b", phase="Succeeded")
+    api.delete_pod("default", "d-a")  # GC'd first; d-b still listed+bound
+    sched.on_pod_deleted(pod_obj("d-a", 2, group="done-gang", group_size=2))
+    for _ in range(4):
+        sched.resync()
+    api.get_pod("default", "d-b")  # still exists — no rollback
+    assert sched.metrics.get("kubegpu_stranded_gang_rollbacks_total") in (0, None)
+
+
+def test_mixed_succeeded_and_running_gang_not_rolled_back():
+    """Succeeded members shrink the denominator: a gang whose coordinator
+    finished while its workers run is complete, not stranded."""
+    api, _, _ = fake_cluster()
+    sched = make_sched(api, stranded_grace=2)
+    bind_gang(api, sched, "mix", ["m-a", "m-b", "m-c", "m-d"])
+    set_pod_status(api, "m-a", phase="Succeeded")
+    set_pod_status(api, "m-b", phase="Succeeded")
+    for _ in range(4):
+        sched.resync()
+    for name in ("m-a", "m-b", "m-c", "m-d"):
+        api.get_pod("default", name)
+    assert sched.metrics.get("kubegpu_stranded_gang_rollbacks_total") in (0, None)
+
+
+def test_stale_size_annotation_does_not_rollback_healthy_gang():
+    """Consensus denominator (VERDICT r2 next #7): one recreated member
+    carrying a stale larger pod-group-size must not move the denominator
+    and get a fully-bound healthy gang rolled back."""
+    api, _, _ = fake_cluster()
+    sched = make_sched(api, stranded_grace=2)
+    bind_gang(api, sched, "g", ["h-a", "h-b"])
+    # stale straggler: same group, pending, claims size 3
+    api.create_pod(pod_obj("h-stale", 2, group="g", group_size=3))
+    for _ in range(4):
+        sched.resync()
+    api.get_pod("default", "h-a")
+    api.get_pod("default", "h-b")
+    assert sched.metrics.get("kubegpu_stranded_gang_rollbacks_total") in (0, None)
+
+
+def test_terminating_victim_does_not_mask_stranded_gang():
+    """A member stuck Terminating holds spec.nodeName but is leaving: it
+    must not count as bound, or a gang that lost it would look complete
+    forever and leak its chips."""
+    api, _, _ = fake_cluster()
+    sched = make_sched(api, stranded_grace=2)
+    bind_gang(api, sched, "t", ["t-a", "t-b"])
+    set_pod_status(api, "t-b", deleting=True)
+    for _ in range(3):
+        sched.resync()
+    assert sched.metrics.get("kubegpu_stranded_gang_rollbacks_total") == 1
+    # rollback freed EVERYTHING: the live member, and the Terminating
+    # member's stale assignment annotation (releasable sweep)
+    with pytest.raises(Exception):
+        api.get_pod("default", "t-a")
+    view = next(iter(sched.cache.views().values()))
+    assert len(view.free) == 16
+
+
+def test_genuine_stranded_gang_still_rolled_back():
+    """Regression guard: the hardening must not blunt the sweep — a gang
+    with one bound member and one that never arrived still rolls back
+    after stranded_grace no-progress resyncs."""
+    api, _, _ = fake_cluster()
+    sched = make_sched(api, stranded_grace=2)
+    bind_gang(api, sched, "s", ["s-a", "s-b"])
+    # s-b vanishes without the watch seeing it (hard kill + missed event):
+    # the gang is 1/2 bound with no plan and no replacement in sight
+    api.delete_pod("default", "s-b")
+    sched.cache.remove_pod("default/s-b")
+    for _ in range(3):
+        sched.resync()
+    assert sched.metrics.get("kubegpu_stranded_gang_rollbacks_total") == 1
+    with pytest.raises(Exception):
+        api.get_pod("default", "s-a")
+
+
+def test_gcd_succeeded_members_keep_shrinking_denominator():
+    """Once a member is SEEN Succeeded, the sweep remembers it: the TTL
+    controller deleting it between resyncs must not resurrect the partial
+    verdict and roll back the still-running siblings."""
+    api, _, _ = fake_cluster()
+    sched = make_sched(api, stranded_grace=2)
+    bind_gang(api, sched, "gc", ["gc-a", "gc-b", "gc-c", "gc-d"])
+    set_pod_status(api, "gc-a", phase="Succeeded")
+    set_pod_status(api, "gc-b", phase="Succeeded")
+    sched.resync()  # sweep observes the Succeeded phases
+    for name in ("gc-a", "gc-b"):
+        obj = api.get_pod("default", name)
+        api.delete_pod("default", name)  # TTL-controller GC
+        sched.on_pod_deleted(obj)
+    for _ in range(4):
+        sched.resync()
+    api.get_pod("default", "gc-c")  # running members untouched
+    api.get_pod("default", "gc-d")
+    assert sched.metrics.get("kubegpu_stranded_gang_rollbacks_total") in (0, None)
+
+
+def test_terminal_phase_pod_holds_no_chips():
+    """kube-scheduler accounting: a Succeeded/Failed pod's chips are free
+    the moment the phase lands, annotation lingering or not — so a
+    shrunken gang (or anyone) can re-admit on them without waiting for
+    pod GC."""
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    obj = pod_obj("done", 4)
+    api.create_pod(obj)
+    r = sched.filter(obj, nodes_of(api))
+    assert sched.bind("default", "done", r.nodes[0]) is None
+    view = next(iter(sched.cache.views().values()))
+    assert len(view.free) == 12
+    set_pod_status(api, "done", phase="Succeeded")
+    sched.cache.refresh()
+    view = next(iter(sched.cache.views().values()))
+    assert len(view.free) == 16
+    # the annotation is history, not a claim — it is left in place
+    a = annotations.assignment_from_pod(api.get_pod("default", "done"))
+    assert a is not None
+
+
+def test_pod_deleted_event_survives_malformed_extended_resource():
+    """The watch fast path must parse leniently: a DELETED event for a pod
+    with an unparseable extended-resource quantity still frees its chips
+    and drops its gang plan (strict parsing would silently drop the event
+    and reintroduce the TTL wait)."""
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    obj = pod_obj("messy", 4)
+    api.create_pod(obj)
+    r = sched.filter(obj, nodes_of(api))
+    assert sched.bind("default", "messy", r.nodes[0]) is None
+    gone = api.get_pod("default", "messy")
+    gone["spec"]["containers"][0]["resources"]["limits"]["vendor.com/dev"] = "1Gi"
+    api.delete_pod("default", "messy")
+    sched.on_pod_deleted(gone)
+    view = next(iter(sched.cache.views().values()))
+    assert len(view.free) == 16
+
+
+def test_replacement_plans_while_sibling_succeeded():
+    """Planner/sweep arithmetic must agree: a gang with one Succeeded
+    member and one dead member re-plans the replacement against the
+    OUTSTANDING size (declared minus completed) — it must not wait
+    forever for a 4th member that already finished."""
+    api, _, _ = fake_cluster()
+    sched = make_sched(api, stranded_grace=2)
+    bind_gang(api, sched, "rp", ["rp-a", "rp-b", "rp-c", "rp-d"])
+    set_pod_status(api, "rp-a", phase="Succeeded")
+    sched.resync()  # observe the completion
+    # rp-d dies and is recreated by its controller
+    dead = api.get_pod("default", "rp-d")
+    api.delete_pod("default", "rp-d")
+    sched.on_pod_deleted(dead)
+    fresh = pod_obj("rp-d", 2, group="rp", group_size=4)
+    api.create_pod(fresh)
+    r = sched.filter(fresh, nodes_of(api))
+    assert r.nodes, r.failed  # plans 1 replacement vs outstanding 3, not 4
+    assert sched.bind("default", "rp-d", r.nodes[0]) is None
+    # and the sweep agrees the gang is whole: no rollback ever fires
+    for _ in range(4):
+        sched.resync()
+    assert sched.metrics.get("kubegpu_stranded_gang_rollbacks_total") in (0, None)
+
+
+def test_stale_deleted_event_for_recreated_name_is_ignored():
+    """The watch delivers by name: a delayed DELETED event must not free
+    the chips of a same-named RECREATED pod that has since bound (the
+    double-allocation the GET-confirm guard exists to stop)."""
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    obj = pod_obj("phoenix", 4)
+    api.create_pod(obj)
+    r = sched.filter(obj, nodes_of(api))
+    assert sched.bind("default", "phoenix", r.nodes[0]) is None
+    old = api.get_pod("default", "phoenix")
+    api.delete_pod("default", "phoenix")
+    sched.on_pod_deleted(old)
+    # controller recreates the name; it schedules and binds again
+    api.create_pod(pod_obj("phoenix", 4))
+    r2 = sched.filter(pod_obj("phoenix", 4), nodes_of(api))
+    assert sched.bind("default", "phoenix", r2.nodes[0]) is None
+    view = next(iter(sched.cache.views().values()))
+    assert len(view.free) == 12
+    # the OLD pod's DELETED event finally drains — and must be a no-op
+    sched.on_pod_deleted(old)
+    view = next(iter(sched.cache.views().values()))
+    assert len(view.free) == 12, "stale DELETED freed the recreated pod's chips"
+
+
+# -- conflict sweep gating + detector cleanup (ADVICE r2 lows #2, #3) --------
+
+def make_conflict(api, sched):
+    """Two live annotations claiming one chip set: bind 'owner' normally,
+    then plant 'thief' with a copy of its assignment annotation."""
+    obj = pod_obj("owner", 2)
+    api.create_pod(obj)
+    r = sched.filter(obj, nodes_of(api))
+    assert sched.bind("default", "owner", r.nodes[0]) is None
+    bound = api.get_pod("default", "owner")
+    thief = pod_obj("thief", 2)
+    thief["metadata"]["annotations"][annotations.POD_ASSIGNMENT] = (
+        bound["metadata"]["annotations"][annotations.POD_ASSIGNMENT]
+    )
+    thief["spec"]["nodeName"] = bound["spec"]["nodeName"]
+    api.create_pod(thief)
+    sched.cache.refresh()
+    assert "default/thief" in sched.cache.conflicted_assignments()
+
+
+def test_conflict_sweep_runs_with_chip_eviction_disabled():
+    """ADVICE r2 low #2: disabling chip-health eviction must not silently
+    disable durable double-annotation resolution."""
+    api, _, _ = fake_cluster()
+    sched = make_sched(api, evict_on_chip_failure=False, absent_grace=2)
+    make_conflict(api, sched)
+    sched.resync()  # strike 1
+    sched.resync()  # strike 2: evict the uncharged claimant
+    with pytest.raises(Exception):
+        api.get_pod("default", "thief")
+    api.get_pod("default", "owner")  # charged owner untouched
+
+
+def test_remove_pod_clears_conflict_and_orphan_tracking():
+    """ADVICE r2 low #3: a pod deleted while conflict-tracked must leave
+    every detector immediately — no strikes toward evicting a ghost."""
+    api, _, _ = fake_cluster()
+    sched = make_sched(api, absent_grace=2)
+    make_conflict(api, sched)
+    sched.cache.remove_pod("default/thief")
+    assert "default/thief" not in sched.cache.conflicted_assignments()
+    # orphan path: vanish a node, then remove its pod
+    sched.cache.refresh()
+    assert "default/thief" in sched.cache.conflicted_assignments()
+    victim_node = api.get_pod("default", "owner")["spec"]["nodeName"]
+    api.delete_node(victim_node)
+    sched.cache.refresh()
+    assert "default/owner" in sched.cache.orphaned_assignments()
+    sched.cache.remove_pod("default/owner")
+    assert "default/owner" not in sched.cache.orphaned_assignments()
 
 
 # -- HTTP wire --------------------------------------------------------------
