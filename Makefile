@@ -3,13 +3,18 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-all native bench dryrun image clean
+.PHONY: test test-mid test-slow test-all native bench dryrun image clean
 
-# fast half: control plane + wire protocols, seconds (default pytest run)
+# fast half: control plane + wire protocols, ~1 min (default pytest run)
 test: native
 	$(PY) -m pytest tests/ -x -q
 
-# slow half: JAX compile-heavy workload tests on the 8-dev CPU mesh (~15 min)
+# mid tier: the workload stack minus the multi-minute process-spawning /
+# compile-exhaustive tests — the "re-verify models+parallelism" loop
+test-mid:
+	$(PY) -m pytest tests/ -x -q -m "slow and not exhaustive"
+
+# everything marked slow, including the exhaustive tier (~25-30 min)
 test-slow:
 	$(PY) -m pytest tests/ -x -q -m slow
 

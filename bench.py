@@ -297,6 +297,80 @@ def steady_state_lm(extra: dict) -> None:
     extra["lm_mfu"] = round(mfu, 4)
 
 
+def steady_state_longctx(extra: dict) -> None:
+    """Long-context flagship (VERDICT r2 next #6): the 545M LM at seq 16k,
+    single chip, flash attention + block remat — the O(seq) memory claim
+    measured where it matters.  The flash kernel keeps attention memory at
+    O(block), remat keeps residuals at O(1) blocks, so seq 16384 with a
+    32k-vocab head fits one v5e chip's HBM."""
+    import os
+    import time
+
+    import jax
+
+    from kubegpu_tpu.models import TransformerLM, create_train_state
+    from kubegpu_tpu.models.data import device_pool_batches, synthetic_token_batches
+    from kubegpu_tpu.models.train import make_lm_train_step
+    from kubegpu_tpu.parallel import device_mesh
+    from kubegpu_tpu.parallel.sharding import batch_sharding, replicated
+
+    seq = int(os.environ.get("BENCH_LONGCTX_SEQ", "16384"))
+    if seq <= 0:
+        return
+    # deliberately a SINGLE-chip measurement (the O(seq) memory claim per
+    # chip): a b1 batch cannot shard over a multi-chip host's data axis
+    mesh = device_mesh({"data": 1}, devices=jax.local_devices()[:1])
+    batch, vocab, hidden, layers = 1, 32768, 2048, 8
+    model = TransformerLM(
+        vocab_size=vocab, num_layers=layers, num_heads=hidden // 128,
+        hidden=hidden, max_seq=seq + 1, attn_impl="flash", remat=True,
+    )
+    rng = jax.random.PRNGKey(0)
+    tokens_src = synthetic_token_batches(batch, seq + 1, vocab)
+    state = create_train_state(model, rng, next(tokens_src))
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+    state = jax.device_put(state, replicated(mesh))
+    step = make_lm_train_step(mesh)
+    pool = device_pool_batches(tokens_src, batch_sharding(mesh), pool=2)
+    t = time.perf_counter()
+    compiled = step.lower(state, next(pool)).compile()
+    t_compile = time.perf_counter() - t
+    flops = _xla_flops(compiled)
+
+    def run(state, tokens):
+        return compiled(state, tokens)
+
+    state, _ = _steady_loop(run, state, pool, 2)   # warmup
+    state, dt = _steady_loop(run, state, pool, 10)
+    mfu = flops / dt / (V5E_PEAK_FLOPS * mesh.size)
+    tok_s = batch * seq / dt
+    # HBM headroom: what the live buffers actually occupy
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        hbm_gb = stats.get("bytes_in_use", 0) / 2**30
+        hbm_cap = stats.get("bytes_limit", 0) / 2**30
+    except Exception:  # noqa: BLE001 - backend without memory_stats
+        hbm_gb = hbm_cap = 0.0
+    hbm_note = (
+        f"HBM {hbm_gb:.1f}/{hbm_cap:.1f} GiB"
+        if hbm_cap
+        else "HBM stats unavailable through this backend"
+    )
+    log(
+        f"long-context LM ({n_params / 1e6:.0f}M, h{hidden} L{layers}, "
+        f"flash+remat) b{batch} s{seq}: {dt * 1e3:.0f} ms/step, "
+        f"{tok_s:.0f} tok/s, MFU {mfu * 100:.1f}% (XLA-visible FLOPs only "
+        f"— flash attention excluded, ~{seq / 1e3:.0f}k seq makes that "
+        f"material), {hbm_note} (compile {t_compile:.1f} s)"
+    )
+    extra["longctx_seq"] = seq
+    extra["longctx_ms_per_step"] = round(dt * 1e3, 1)
+    extra["longctx_tok_s"] = round(tok_s)
+    extra["longctx_mfu_xla_visible"] = round(mfu, 4)
+    if hbm_cap:
+        extra["longctx_hbm_gib"] = round(hbm_gb, 2)
+
+
 def tpu_kernel_smoke(extra: dict) -> None:
     """Mosaic compile-check of the Pallas kernels on the REAL chip, under
     shard_map: CPU interpret mode cannot catch mosaic lowering rejections
@@ -358,45 +432,19 @@ def tpu_kernel_smoke(extra: dict) -> None:
     extra["tpu_kernels"] = "ok"
 
 
-def main() -> None:
-    import os
+def control_plane_probes() -> dict:
+    """Extender verb latency at v5e-256 scale, in-process AND over the
+    wire, plus the whole-slice gang plan (the reference's hot loop,
+    SURVEY.md §3.1; the native C++ rectangle scan is picked up
+    automatically when native/ is built)."""
+    import urllib.request
 
-    import jax
-
-    # persistent compilation cache: the production configuration (a warmed
-    # cluster/node pool reuses compiled programs across job launches, which
-    # is exactly what the schedule-to-first-step path looks like after the
-    # first job of an image version)
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
-    cache_warm = os.path.isdir(cache_dir) and bool(os.listdir(cache_dir))
-    log(f"compilation cache: {'WARM' if cache_warm else 'COLD'} ({cache_dir})")
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    # only cache expensive programs: writing hundreds of tiny entries costs
-    # more wall-clock than recompiling them
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
-
-    import jax.numpy as jnp
-
-    from kubegpu_tpu.crishim import ShimDaemon
-    from kubegpu_tpu.models import (
-        ScanResNet50,
-        create_train_state,
-        make_resnet_train_step,
-        place_resnet,
-    )
-    from kubegpu_tpu.parallel import device_mesh
     from kubegpu_tpu.plugins import Advertiser, FakeSlice
     from kubegpu_tpu.scheduler import Scheduler
-    from kubegpu_tpu.types import RES_TPU, annotations
+    from kubegpu_tpu.scheduler.server import ExtenderServer
     from kubegpu_tpu.utils import InMemoryApiServer
     from kubegpu_tpu.utils.metrics import Metrics
 
-    rate = contiguous_rate()
-    log(f"ICI-contiguous placement rate across graded configs: {rate:.2f}")
-
-    # ---- control-plane scale: extender verb latency on a v5e-256 --------
-    # (the reference's hot loop, SURVEY.md §3.1; the native C++ rectangle
-    # scan is picked up automatically when native/ is built)
     big_api = InMemoryApiServer()
     big = FakeSlice(slice_id="v5e-256", mesh_shape=(16, 16), host_block=(2, 2))
     for prov in big.providers().values():
@@ -418,6 +466,47 @@ def main() -> None:
         f"v5e-256 (64 nodes) extender latency (warm, min of 3): "
         f"filter {t_filter * 1e3:.1f} ms, prioritize {t_prio * 1e3:.1f} ms"
     )
+    # ... and over the WIRE: the same verbs through a live ExtenderServer —
+    # HTTP socket + JSON codec included, the latency kube-scheduler
+    # actually observes (VERDICT r2 weak #3: the in-process number omits
+    # the wire)
+    wire_srv = ExtenderServer(big_sched, listen=("127.0.0.1", 0), watch=False)
+    wire_srv.start()
+    try:
+        addr = wire_srv.address
+
+        def wire_post(path, payload):
+            req = urllib.request.Request(
+                f"http://{addr[0]}:{addr[1]}{path}",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read())
+
+        args = {"Pod": obj, "NodeNames": big_nodes}
+        rw = wire_post("/filter", args)  # warmup (socket + codec paths)
+        assert rw.get("NodeNames"), rw
+        t_filter_wire = min(
+            _timed(lambda: wire_post("/filter", args)) for _ in range(3)
+        )
+        t_prio_wire = min(
+            _timed(
+                lambda: wire_post(
+                    "/prioritize", {"Pod": obj, "NodeNames": rw["NodeNames"]}
+                )
+            )
+            for _ in range(3)
+        )
+        log(
+            f"v5e-256 (64 nodes) extender latency OVER THE WIRE "
+            f"(HTTP+JSON, min of 3): filter {t_filter_wire * 1e3:.1f} ms, "
+            f"prioritize {t_prio_wire * 1e3:.1f} ms "
+            f"(codec+socket overhead: "
+            f"{(t_filter_wire - t_filter) * 1e3:.1f} ms)"
+        )
+    finally:
+        wire_srv.stop()
     # whole-slice gang planning (the most expensive single verb): 64 pods
     # x 4 chips = all 256 chips, planned once when the first member filters
     gang_pods = [
@@ -430,6 +519,75 @@ def main() -> None:
     t_gang = time.perf_counter() - t0g
     assert rg.nodes, rg.failed
     log(f"v5e-256 whole-slice 64-pod gang plan (first filter): {t_gang * 1e3:.1f} ms")
+
+    # multislice megascale shape: a 128-pod gang spanning BOTH slices of a
+    # 2x-v5e-256 pod farm (512 chips planned atomically across DCN)
+    ms_api = InMemoryApiServer()
+    for suffix in ("a", "b"):
+        ms_fs = FakeSlice(
+            slice_id=f"v5e-256-{suffix}", mesh_shape=(16, 16), host_block=(2, 2)
+        )
+        for prov in ms_fs.providers().values():
+            Advertiser(prov, ms_api).advertise_once()
+    ms_sched = Scheduler(ms_api, metrics=Metrics())
+    ms_sched.cache.refresh()
+    ms_nodes = sorted(n["metadata"]["name"] for n in ms_api.list_nodes())
+    ms_pods = [
+        make_pod(f"mw{i:03d}", 4, group="mega", size=128) for i in range(128)
+    ]
+    from kubegpu_tpu.types import annotations as _ann
+
+    for p in ms_pods:
+        p["metadata"]["annotations"][_ann.POD_MULTISLICE] = "true"
+        ms_api.create_pod(p)
+    t0m = time.perf_counter()
+    rm = ms_sched.filter(ms_pods[0], ms_nodes)
+    t_mega = time.perf_counter() - t0m
+    assert rm.nodes, rm.failed
+    log(
+        f"2x-v5e-256 multislice 128-pod/512-chip gang plan (first filter): "
+        f"{t_mega * 1e3:.1f} ms"
+    )
+    return {
+        "filter_ms_v5e256": round(t_filter * 1e3, 2),
+        "filter_wire_ms_v5e256": round(t_filter_wire * 1e3, 2),
+        "prioritize_ms_v5e256": round(t_prio * 1e3, 2),
+        "prioritize_wire_ms_v5e256": round(t_prio_wire * 1e3, 2),
+        "gang_plan_ms_v5e256": round(t_gang * 1e3, 2),
+        "multislice_gang_plan_ms_2x256": round(t_mega * 1e3, 2),
+    }
+
+
+def first_step_probe() -> dict:
+    """The timed north-star path, self-contained for one process: simulate
+    the control plane (schedule + inject), then bring up JAX with the
+    injected env and run the first real training step on the accelerator.
+
+    Run in a fresh subprocess per sample (main() drives this via
+    --first-step-probe) so 'cold' means a truly cold process + compilation
+    cache, and warm samples are independent min-of-N draws (VERDICT r2
+    next #3: cold AND warm in the driver JSON, de-noised)."""
+    import os
+
+    import jax
+
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+
+    import jax.numpy as jnp
+
+    from kubegpu_tpu.crishim import ShimDaemon
+    from kubegpu_tpu.models import (
+        ScanResNet50,
+        create_train_state,
+        make_resnet_train_step,
+        place_resnet,
+    )
+    from kubegpu_tpu.parallel import device_mesh
+    from kubegpu_tpu.plugins import Advertiser, FakeSlice
+    from kubegpu_tpu.scheduler import Scheduler
+    from kubegpu_tpu.types import RES_TPU, annotations
+    from kubegpu_tpu.utils import InMemoryApiServer
+    from kubegpu_tpu.utils.metrics import Metrics
 
     # ---- north star: 4-pod DP ResNet-50 gang, creation -> first step ----
     api = InMemoryApiServer()
@@ -506,12 +664,49 @@ def main() -> None:
     labels = jnp.zeros((per_worker_batch,), jnp.int32)
     t_a = time.perf_counter()
     log(f"  [backend init + host batch: {t_a - t_inject:.2f} s]")
-    state = create_train_state(model, rng, images)
+    # Overlap the two big compiles on the cold critical path: the train
+    # step AOT-lowers from AVALS (shapes + shardings, no data), so its
+    # compile runs on a thread WHILE the init program compiles and runs.
+    # One shared tx instance: TrainState's static fields must compare
+    # equal between the aval tree and the real state or the AOT call
+    # rejects the treedef.
+    import concurrent.futures as _cf
+
+    import optax as _optax
+
+    from kubegpu_tpu.models.train import train_state_shape
+    from kubegpu_tpu.parallel.sharding import batch_sharding, replicated
+
+    tx = _optax.sgd(0.1, momentum=0.9, nesterov=True)
+    step = make_resnet_train_step(mesh)
+    rep, bsh = replicated(mesh), batch_sharding(mesh)
+    # init with a BATCH-1 sample: param/batch-stat shapes are
+    # batch-independent, and the init program (flax init runs the forward)
+    # compiles and executes several times faster at b1 — the train step
+    # still lowers for the real batch via avals below
+    init_sample = images[:1]
+    shapes = train_state_shape(model, rng, init_sample, tx=tx)
+    state_avals = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=rep), shapes
+    )
+    img_aval = jax.ShapeDtypeStruct(images.shape, images.dtype, sharding=bsh)
+    lab_aval = jax.ShapeDtypeStruct(labels.shape, labels.dtype, sharding=bsh)
+    pool = _cf.ThreadPoolExecutor(1)
+    step_future = pool.submit(
+        lambda: step.lower(state_avals, img_aval, lab_aval).compile()
+    )
+    state = create_train_state(model, rng, init_sample, tx=tx)
     jax.block_until_ready(state.params)
     t_b = time.perf_counter()
     log(f"  [state init (jit _init compile+run): {t_b - t_a:.2f} s]")
     state, images, labels = place_resnet(state, (images, labels), mesh)
-    step = make_resnet_train_step(mesh)
+    compiled_step = step_future.result()
+    t_c = time.perf_counter()
+    log(f"  [step compile (overlapped with init): +{t_c - t_b:.2f} s]")
+
+    def step(state, images, labels):  # noqa: F811 - AOT executable
+        return compiled_step(state, images, labels)
+
     state, loss = step(state, images, labels)
     loss_value = float(loss)  # blocks until the step completes
     log(f"  [train step (compile+run): {time.perf_counter() - t_b:.2f} s]")
@@ -532,12 +727,87 @@ def main() -> None:
     dt = (t_loop - t_first) / n_steady
     log(f"steady-state step: {dt * 1e3:.2f} ms ({per_worker_batch / dt:.0f} img/s/worker)")
 
-    total = t_first - t0
+    return {
+        "total": round(t_first - t0, 3),
+        "schedule_ms": round((t_sched - t0) * 1e3, 1),
+        "inject_ms": round((t_inject - t_sched) * 1e3, 1),
+        "first_step_s": round(t_first - t_inject, 2),
+        "steady_ms": round(dt * 1e3, 2),
+        "loss": round(loss_value, 4),
+    }
+
+
+def _run_probe(cache_dir: str, label: str) -> dict:
+    """One north-star sample in a fresh subprocess with the given
+    compilation-cache dir; stderr streams through, stdout carries the JSON."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+    log(f"--- first-step probe [{label}] (cache: {cache_dir}) ---")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--first-step-probe"],
+        env=env, stdout=subprocess.PIPE, timeout=900,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(f"first-step probe [{label}] failed rc={proc.returncode}")
+    out = proc.stdout.decode().strip().splitlines()
+    return json.loads(out[-1])
+
+
+def main() -> None:
+    import os
+    import tempfile
+
+    if "--first-step-probe" in sys.argv:
+        print(json.dumps(first_step_probe()))
+        return
+
+    # persistent compilation cache: the production configuration (a warmed
+    # cluster/node pool reuses compiled programs across job launches, which
+    # is exactly what the schedule-to-first-step path looks like after the
+    # first job of an image version)
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    cache_warm = os.path.isdir(cache_dir) and bool(os.listdir(cache_dir))
+    log(f"compilation cache: {'WARM' if cache_warm else 'COLD'} ({cache_dir})")
+
+    rate = contiguous_rate()
+    log(f"ICI-contiguous placement rate across graded configs: {rate:.2f}")
+    extra = {"contiguous_rate": rate}
+    extra.update(control_plane_probes())
+
+    # ---- north star, cold AND warm (each in its own subprocess) ---------
+    # cold: a throwaway cache dir — the path a fresh deployment pays.
+    # warm: min of 2 against the persistent cache — de-noised (the tunnel
+    # alone swings seconds between runs; one sample cannot distinguish a
+    # regression from noise).
+    with tempfile.TemporaryDirectory(prefix="jaxcache-cold-") as cold_dir:
+        cold = _run_probe(cold_dir, "cold")
+    warm_samples = [_run_probe(cache_dir, f"warm{i + 1}") for i in range(2)]
+    warm = min(warm_samples, key=lambda d: d["total"])
+    log(
+        f"schedule->first-step: cold {cold['total']:.2f} s, "
+        f"warm {[d['total'] for d in warm_samples]} -> min {warm['total']:.2f} s"
+    )
+    extra["first_step_cold_s"] = cold["total"]
+    extra["first_step_warm_samples_s"] = [d["total"] for d in warm_samples]
+    extra["schedule_to_first_step_latency_cold"] = cold["total"]
+    extra["schedule_to_first_step_latency_warm"] = warm["total"]
+    total = warm["total"]
 
     # ---- steady-state perf: throughput + MFU as first-class metrics -----
-    extra = {"cache": "warm" if cache_warm else "cold"}
+    # (parent process touches the accelerator only AFTER the probe
+    # subprocesses exited — one chip, one client at a time)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+    extra["cache"] = "warm" if cache_warm else "cold"
     steady_state_resnet(extra)
     steady_state_lm(extra)
+    steady_state_longctx(extra)
     tpu_kernel_smoke(extra)
 
     target = 60.0  # BASELINE.json north star: first step in < 60 s

@@ -1,0 +1,85 @@
+"""Compilation-cache prewarm for the north-star path.
+
+Run once per node/image rollout (init container or DaemonSet post-start
+hook) with ``JAX_COMPILATION_CACHE_DIR`` pointed at a host-path volume:
+compiles the flagship programs (ScanResNet-50 init + train step at the
+sample's per-worker shapes) into the persistent XLA cache, so the FIRST
+real job on the node takes the warm schedule→first-step path (~22 s
+measured) instead of the cold one (~36 s).  bench.py's warm probe measures
+exactly this configuration.
+
+XLA cache keys include the device topology, and workers run with
+TPU_VISIBLE_CHIPS restricted to their allocation — so prewarm must
+compile under the SAME visibility a worker will have.  Pass
+``--chips-per-worker`` (e.g. 1 for the north-star sample's 1-chip pods)
+to restrict this process before backend init; run once per chip-count
+shape your pods use.
+
+    JAX_COMPILATION_CACHE_DIR=/var/cache/kubegpu-tpu-xla \
+        python -m deploy.prewarm --batch 32 --chips-per-worker 1
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch", type=int, default=32, help="per-worker batch")
+    ap.add_argument("--classes", type=int, default=1000)
+    ap.add_argument(
+        "--chips-per-worker",
+        type=int,
+        default=0,
+        help="restrict TPU_VISIBLE_CHIPS to this many chips so the cache "
+        "key matches a worker pod's restricted visibility (0 = all chips)",
+    )
+    args = ap.parse_args(argv)
+
+    if args.chips_per_worker > 0:
+        os.environ.setdefault(
+            "TPU_VISIBLE_CHIPS",
+            ",".join(str(i) for i in range(args.chips_per_worker)),
+        )
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+
+    from kubegpu_tpu.models import ScanResNet50, create_train_state
+    from kubegpu_tpu.models.train import make_resnet_train_step, train_state_shape
+    from kubegpu_tpu.parallel import device_mesh
+    from kubegpu_tpu.parallel.sharding import batch_sharding, replicated
+
+    mesh = device_mesh({"data": jax.local_device_count()})
+    model = ScanResNet50(num_classes=args.classes)
+    rng = jax.random.PRNGKey(0)
+    images = jnp.ones((args.batch, 224, 224, 3), jnp.float32)
+    labels = jnp.zeros((args.batch,), jnp.int32)
+    tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
+
+    t0 = time.perf_counter()
+    # the same two programs the first step of a real job needs, keyed the
+    # same way (shapes + shardings), so the cache hits are exact
+    state = create_train_state(model, rng, images[:1], tx=tx)
+    shapes = train_state_shape(model, rng, images[:1], tx=tx)
+    rep, bsh = replicated(mesh), batch_sharding(mesh)
+    state_avals = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=rep), shapes
+    )
+    img_aval = jax.ShapeDtypeStruct(images.shape, images.dtype, sharding=bsh)
+    lab_aval = jax.ShapeDtypeStruct(labels.shape, labels.dtype, sharding=bsh)
+    step = make_resnet_train_step(mesh)
+    step.lower(state_avals, img_aval, lab_aval).compile()
+    jax.block_until_ready(state.params)
+    print(f"prewarm done in {time.perf_counter() - t0:.1f} s "
+          f"(init + train step b{args.batch} compiled into the cache)")
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 EXPERT_AXIS = "expert"
+# multislice: the outermost axis of a hybrid_device_mesh spans slices over
+# DCN; data parallelism composes over it (the scaling-book layering: DP on
+# the slow outer transport, TP/CP/EP inside each slice's ICI)
+DCN_AXIS = "dcn"
 # context parallelism: the sequence dim of activations AND the ring/all-to-all
 # axis of ops.attention's CP kernels — distinct from Megatron SP, which
 # re-shards the residual over MODEL_AXIS between blocks
@@ -55,13 +59,30 @@ def batch_spec() -> P:
     return P(DATA_AXIS)
 
 
+def batch_axes(mesh: Optional[Mesh]) -> Optional[Any]:
+    """The axis (or axis tuple) the batch dim shards over on this mesh:
+    ("dcn", "data") on hybrid multislice meshes — DP composes across
+    slices — else whichever of the two is present, else None."""
+    names = mesh.axis_names if mesh is not None else ()
+    has_dcn, has_data = DCN_AXIS in names, DATA_AXIS in names
+    if has_dcn and has_data:
+        return (DCN_AXIS, DATA_AXIS)
+    if has_data:
+        return DATA_AXIS
+    if has_dcn:
+        return DCN_AXIS
+    return None
+
+
 def replicated_spec() -> P:
     return P()
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Leading-dim batch sharding (works for inputs and labels alike)."""
-    return NamedSharding(mesh, batch_spec())
+    """Leading-dim batch sharding (works for inputs and labels alike).
+    On a hybrid multislice mesh the batch shards over ("dcn", "data") so
+    data parallelism rides DCN across slices."""
+    return NamedSharding(mesh, P(batch_axes(mesh)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -161,13 +182,14 @@ def param_shardings(
 
 def constrain_seq_sharded(x: jax.Array) -> jax.Array:
     """Sequence-parallel residual/LN activations: [batch, seq, hidden]
-    sharded (data, model, None).  No-op outside a ``current_mesh`` context
+    sharded (data, model, None) — batch composing over "dcn" on hybrid
+    multislice meshes.  No-op outside a ``current_mesh`` context
     (single-device paths)."""
     mesh = get_current_mesh()
     if mesh is None or MODEL_AXIS not in mesh.axis_names:
         return x
     return jax.lax.with_sharding_constraint(
-        x, NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS, None))
+        x, NamedSharding(mesh, P(batch_axes(mesh), MODEL_AXIS, None))
     )
 
 
@@ -175,13 +197,13 @@ def constrain_ctx_sharded(x: jax.Array) -> jax.Array:
     """Context-parallel activations: [batch, seq, ...] sharded
     (data, seq, None...) — every per-token op (embed, LN, MLP) then runs on
     1/seq of the sequence; only attention needs cross-shard communication
-    (ops.attention ring/ulysses).  No-op without a ``current_mesh`` carrying
-    the axis."""
+    (ops.attention ring/ulysses).  Batch composes over "dcn" on hybrid
+    multislice meshes (DP across slices, the ring inside one slice's ICI).
+    No-op without a ``current_mesh`` carrying the axis."""
     mesh = get_current_mesh()
     if mesh is None or SEQ_AXIS not in mesh.axis_names:
         return x
-    data = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
-    spec = P(data, SEQ_AXIS, *([None] * (x.ndim - 2)))
+    spec = P(batch_axes(mesh), SEQ_AXIS, *([None] * (x.ndim - 2)))
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
@@ -189,18 +211,20 @@ def constrain_batch_sharded(x: jax.Array) -> jax.Array:
     mesh = get_current_mesh()
     if mesh is None:
         return x
-    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(DATA_AXIS)))
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(batch_axes(mesh)))
+    )
 
 
 def constrain_expert_grouped(x: jax.Array) -> jax.Array:
     """Grouped dispatched expert tensors [groups(batch), E, capacity, ...]:
-    groups over "data", expert dim over "expert".  Pinning this sharding is
-    what makes GSPMD lower the dispatch einsum to an all-to-all instead of
-    gathering all tokens everywhere.  No-op outside a ``current_mesh``
-    context or on expert-less meshes."""
+    groups over "data" (x "dcn" on hybrid meshes), expert dim over
+    "expert".  Pinning this sharding is what makes GSPMD lower the
+    dispatch einsum to an all-to-all instead of gathering all tokens
+    everywhere.  No-op outside a ``current_mesh`` context or on
+    expert-less meshes."""
     mesh = get_current_mesh()
     if mesh is None or EXPERT_AXIS not in mesh.axis_names:
         return x
-    data = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
-    spec = P(data, EXPERT_AXIS, *([None] * (x.ndim - 2)))
+    spec = P(batch_axes(mesh), EXPERT_AXIS, *([None] * (x.ndim - 2)))
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

@@ -99,6 +99,7 @@ class Scheduler:
         evict_on_chip_failure: bool = True,
         absent_grace: int = 2,
         stranded_grace: int = 5,
+        active_preemption: bool = True,
     ) -> None:
         self.api = api
         self.cache = cache or ClusterCache(api)
@@ -111,6 +112,14 @@ class Scheduler:
         # evicted so its controller recreates it and it re-schedules onto
         # healthy chips (gang members rejoin their gang's slice layout)
         self.evict_on_chip_failure = evict_on_chip_failure
+        # True: filter evicts lower-priority victims itself and re-plans in
+        # the same verb (fastest admission).  False: filter only REPORTS
+        # capacity failure and nominations flow through the advisory
+        # /preemption verb — kube-scheduler performs the evictions (the
+        # classic extender division of labor its preemptVerb config exists
+        # for).  Both are deployed modes; deploy/device-scheduler.yaml
+        # documents the flag.
+        self.active_preemption = active_preemption
         # Eviction is irreversible, but "chip absent from an advertisement"
         # and "node missing from a LIST" are not — a restarting advertiser
         # or one truncated enumeration must not destroy a healthy running
@@ -189,7 +198,11 @@ class Scheduler:
             outcome = self.groups.plan_for(pod) or None
             if outcome is None:
                 planned = self.groups.try_plan(pod)
-                if planned.plan is None and planned.capacity_failure:
+                if (
+                    planned.plan is None
+                    and planned.capacity_failure
+                    and self.active_preemption
+                ):
                     # multi-tenant path (BASELINE config 5): evict strictly
                     # lower-priority units, then re-plan once
                     if self._attempt_preemption(pod, self._slices_of(node_names)):
@@ -232,7 +245,12 @@ class Scheduler:
             return FilterResult(nodes=nodes, failed=failed)
 
         result = self._filter_plain(pod, plugin, node_names)
-        if not result.nodes and result.capacity_failure and plugin.name == "tpu":
+        if (
+            not result.nodes
+            and result.capacity_failure
+            and plugin.name == "tpu"
+            and self.active_preemption
+        ):
             # preemption reasons in chip units; generic devices don't preempt
             if self._attempt_preemption(pod, self._slices_of(node_names)):
                 result = self._filter_plain(pod, plugin, node_names)

@@ -348,6 +348,13 @@ def main(argv=None) -> None:
         help="load an extra DeviceSchedulerPlugin (SURVEY.md §3.5 plugin "
         "loading); FACTORY defaults to create_device_scheduler_plugin",
     )
+    ap.add_argument(
+        "--no-active-preemption",
+        action="store_true",
+        help="do not evict victims inside filter; only nominate them via "
+        "the advisory /preemption verb (kube-scheduler performs the "
+        "evictions — the classic extender division of labor)",
+    )
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
@@ -365,7 +372,12 @@ def main(argv=None) -> None:
         registry.load(spec)
     host, _, port = args.listen.rpartition(":")
     server = ExtenderServer(
-        Scheduler(api, plugins=registry), listen=(host or "127.0.0.1", int(port)),
+        Scheduler(
+            api,
+            plugins=registry,
+            active_preemption=not args.no_active_preemption,
+        ),
+        listen=(host or "127.0.0.1", int(port)),
         resync_interval_s=args.resync_interval,
     )
     server.start()

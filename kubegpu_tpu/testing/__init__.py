@@ -1,0 +1,13 @@
+"""Test doubles with production fidelity (the reference's transferable
+test strategy, SURVEY.md §4: every cluster dependency behind an interface
+with a fake).  FakeKubeScheduler is the highest-fidelity one: it consumes
+the REAL deploy/scheduler-config.yaml and drives the extender with the
+genuine kube-scheduler wire shapes."""
+
+from kubegpu_tpu.testing.fake_kube_scheduler import (
+    ExtenderConfig,
+    FakeKubeScheduler,
+    load_scheduler_config,
+)
+
+__all__ = ["ExtenderConfig", "FakeKubeScheduler", "load_scheduler_config"]

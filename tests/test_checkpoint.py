@@ -34,6 +34,7 @@ def _tiny_setup(mesh, seed=0):
     return state, images, labels
 
 
+@pytest.mark.exhaustive
 def test_restore_none_when_empty(tmp_path):
     mesh = device_mesh({"data": 2}, devices=jax.devices()[:2])
     state, _, _ = _tiny_setup(mesh)
@@ -41,6 +42,7 @@ def test_restore_none_when_empty(tmp_path):
     assert restore_checkpoint(mgr, state) is None
 
 
+@pytest.mark.exhaustive
 def test_save_restore_roundtrip_resumes_at_step(tmp_path):
     mesh = device_mesh({"data": 2}, devices=jax.devices()[:2])
     state, images, labels = _tiny_setup(mesh)
@@ -128,6 +130,7 @@ def test_tp_sharded_lm_checkpoint_roundtrip(tmp_path):
     assert out.shape == (1, 7)
 
 
+@pytest.mark.exhaustive
 def test_restore_onto_different_mesh_shardings(tmp_path):
     """A rescheduled gang may land on a different sub-mesh: save from a
     2-device mesh, restore into a 4-device template — arrays must land in

@@ -55,6 +55,7 @@ def test_greedy_generate_matches_full_forward_argmax():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
 
 
+@pytest.mark.exhaustive
 def test_greedy_generate_matches_training_argmax_at_bf16():
     # default-dtype checkpoints: decode numerics mirror the training
     # attention exactly (bf16 scores, finfo-min mask, fp32 softmax), so

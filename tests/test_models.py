@@ -102,6 +102,7 @@ def test_resnet_forward_shapes_and_dtypes():
     assert logits.dtype == jnp.float32  # head stays fp32
 
 
+@pytest.mark.exhaustive
 def test_resnet_dp_train_step_runs_and_learns():
     mesh = device_mesh({"data": -1})
     model = tiny_resnet()
@@ -223,6 +224,7 @@ def test_prefetch_finite_iterator_drains_fully():
     assert [int(l[0]) for _, l in out] == [0, 1, 2, 3, 4]
 
 
+@pytest.mark.exhaustive
 def test_worker_main_smoke(capsys):
     from kubegpu_tpu.models import worker
 
@@ -270,7 +272,10 @@ def test_lm_train_step_tp_sp():
 
 # -- context-parallel LM (long context: ring/ulysses inside the model) ------
 
-@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+@pytest.mark.parametrize(
+    "impl",
+    [pytest.param("ring", marks=pytest.mark.exhaustive), "ulysses"],
+)
 def test_cp_lm_matches_single_device(impl):
     from kubegpu_tpu.models import place_cp_lm
     from kubegpu_tpu.models.train import lm_loss
@@ -316,7 +321,10 @@ def test_cp_lm_activations_are_seq_sharded():
     assert logits.sharding.spec[:2] == ("data", "seq")
 
 
-@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+@pytest.mark.parametrize(
+    "impl",
+    ["ring", pytest.param("ulysses", marks=pytest.mark.exhaustive)],
+)
 def test_3d_dp_tp_cp_lm_matches_single_device(impl):
     # the full composition: batch over "data", heads/kernels over "model"
     # (Megatron TP), sequence over "seq" (CP) — one mesh, one jit
@@ -399,6 +407,7 @@ def test_device_pool_short_source_cycles_and_empty_raises():
         next(device_pool_batches(iter([]), batch_sharding(mesh), pool=2))
 
 
+@pytest.mark.exhaustive
 def test_lm_tp_matches_single_device():
     # correctness of the sharded compute: TP loss == unsharded loss
     model = tiny_lm(tp=2, sp=True)

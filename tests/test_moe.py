@@ -84,6 +84,7 @@ def test_moe_ep_rules_shard_expert_dim_only():
     assert spec_for_param("layer0/moe_mlp/router/kernel", rules) == ()
 
 
+@pytest.mark.exhaustive
 def test_moe_ep_sharded_step_matches_single_device():
     """One DP x EP train step on a (data=2, expert=4) mesh must produce the
     same loss as the unsharded single-device step from the same init."""
@@ -148,6 +149,7 @@ def test_moe_ep_tp_sharded_step_matches_single_device():
     np.testing.assert_allclose(float(aux_sharded), float(aux_single), rtol=1e-4)
 
 
+@pytest.mark.exhaustive
 def test_moe_train_step_learns_and_router_gets_gradient():
     model = MoeTransformerLM(
         vocab_size=32, num_layers=1, num_heads=2, hidden=16,
@@ -175,6 +177,7 @@ def test_moe_train_step_learns_and_router_gets_gradient():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.exhaustive
 def test_moe_remat_grads_match_plain():
     """remat=True must be a pure memory/FLOPs trade for the MoE LM too:
     gradients (and the sown aux loss path) identical to the plain model."""

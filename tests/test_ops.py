@@ -155,6 +155,7 @@ def test_ring_attention_under_jit_with_sharded_inputs(mesh):
     assert out.sharding.spec == P(None, "sp", None, None)
 
 
+@pytest.mark.exhaustive
 def test_ring_attention_grads_finite(mesh):
     q, k, v = qkv(b=1, s=8 * 8, h=2, d=16)
 
@@ -235,6 +236,7 @@ def test_ring_einsum_fallback_for_untileable_shards(mesh):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.exhaustive
 def test_ring_flash_grads_match_reference_noncausal(mesh):
     q, k, v = qkv(b=1, s=8 * 16, h=2, d=16)
 

@@ -75,6 +75,7 @@ def test_pipeline_lm_rejects_indivisible_microbatching():
                            num_microbatches=2)
 
 
+@pytest.mark.exhaustive
 def test_pipeline_grads_match_sequential():
     """The GPipe backward schedule must produce the SAME gradients as the
     unpipelined model — including for stage 0 (gradient crosses every
@@ -174,7 +175,9 @@ def test_circular_rejects_fewer_microbatches_than_devices():
         pipeline_apply(stage_fn, mesh, num_rounds=2)({"w": w}, stream)
 
 
-@pytest.mark.parametrize("micro", [4, 6])
+@pytest.mark.parametrize(
+    "micro", [4, pytest.param(6, marks=pytest.mark.exhaustive)]
+)
 def test_circular_lm_matches_sequential(micro):
     from kubegpu_tpu.models.pipeline_lm import to_circular_layout
 
@@ -194,6 +197,7 @@ def test_circular_lm_matches_sequential(micro):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.exhaustive
 def test_circular_grads_match_sequential():
     from kubegpu_tpu.models.pipeline_lm import to_circular_layout
 
@@ -259,6 +263,7 @@ def test_circular_train_step_runs_and_bubble_shrinks():
 
 # -- PP x TP composition ----------------------------------------------------
 
+@pytest.mark.exhaustive
 def test_pp_tp_matches_sequential():
     """GPipe over "pipe" x Megatron TP over "model" on a (4, 2) mesh: each
     stage's kernels are column/row-parallel with in-stage psums; logits

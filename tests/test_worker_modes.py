@@ -34,14 +34,19 @@ def run_worker(capsys, argv):
 @pytest.mark.parametrize(
     "argv",
     [
-        ["--model", "resnet-tiny"],
+        pytest.param(["--model", "resnet-tiny"],
+                     marks=pytest.mark.exhaustive),
         ["--model", "lm", "--tp", "4"],
         ["--model", "lm-cp", "--cp", "4", "--attn-impl", "ring"],
         ["--model", "lm-cp", "--cp", "4", "--attn-impl", "ulysses"],
         ["--model", "moe", "--ep", "4"],
-        ["--model", "moe", "--ep", "2", "--tp", "2"],
-        ["--model", "pp", "--microbatches", "2"],
-        ["--model", "pp", "--pp-rounds", "2", "--microbatches", "8"],
+        pytest.param(["--model", "moe", "--ep", "2", "--tp", "2"],
+                     marks=pytest.mark.exhaustive),
+        pytest.param(["--model", "pp", "--microbatches", "2"],
+                     marks=pytest.mark.exhaustive),
+        pytest.param(["--model", "pp", "--pp-rounds", "2",
+                      "--microbatches", "8"],
+                     marks=pytest.mark.exhaustive),
     ],
     ids=["resnet-tiny", "lm-tp", "lm-cp-ring", "lm-cp-ulysses", "moe",
          "moe-ep-tp", "pp", "pp-circular"],
@@ -65,7 +70,11 @@ def test_worker_resident_mode_runs_constant_batch(capsys):
 
 @pytest.mark.parametrize(
     "argv",
-    [["--model", "resnet-tiny"], ["--model", "lm", "--tp", "4"]],
+    [
+        pytest.param(["--model", "resnet-tiny"],
+                     marks=pytest.mark.exhaustive),
+        ["--model", "lm", "--tp", "4"],
+    ],
     ids=["resnet-tiny", "lm-tp"],
 )
 def test_worker_checkpoint_resume(capsys, tmp_path, argv):
