@@ -40,9 +40,12 @@ def main(argv=None) -> None:
     args = ap.parse_args(argv)
 
     if args.chips_per_worker > 0:
-        os.environ.setdefault(
-            "TPU_VISIBLE_CHIPS",
-            ",".join(str(i) for i in range(args.chips_per_worker)),
+        # explicit flag OVERRIDES ambient env: an image/pod that already
+        # exports full-host TPU_VISIBLE_CHIPS would otherwise silently
+        # compile under the wrong visibility and never match a worker's
+        # cache key — the exact failure this flag exists to prevent
+        os.environ["TPU_VISIBLE_CHIPS"] = ",".join(
+            str(i) for i in range(args.chips_per_worker)
         )
 
     import jax

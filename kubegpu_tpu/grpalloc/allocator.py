@@ -384,6 +384,13 @@ def _scored_rectangles(
     for rect in enumerate_rectangles(
         total, mesh_shape, wrap, shapes=[shape] if shape else None
     ):
+        # O(1) pre-filter: a rect's origin is always one of its coords, so
+        # rects anchored outside `membership` can never qualify — this is
+        # the gang-packing hot path (small per-host membership scanned
+        # against whole-mesh candidate rects), where materializing every
+        # candidate's coord set dominated the 512-chip multislice plan
+        if rect.origin not in membership:
+            continue
         coords = rect.coords(mesh_shape, wrap)
         if not coords <= membership:
             continue

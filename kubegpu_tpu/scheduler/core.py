@@ -566,9 +566,16 @@ class Scheduler:
                         reserved_here = True
                     except (ValueError, KeyError) as e:
                         self.metrics.inc("kubegpu_bind_conflicts_total")
+                        # the plan is UNEXECUTABLE — its chips are durably
+                        # held elsewhere.  Drop it now: a live plan shields
+                        # the gang from both re-planning and the stranded
+                        # sweep, so keeping it would wedge the gang until
+                        # plan-TTL expiry (found by the chaos soak)
+                        self.groups.drop_plan(gk)
                         return (
                             f"gang reservation for {key} was released and "
-                            f"cannot be reacquired (re-run filter): {e}"
+                            f"cannot be reacquired (plan dropped, re-run "
+                            f"filter): {e}"
                         )
         else:
             with self.cache.lock:
@@ -663,6 +670,16 @@ class Scheduler:
         self.cache.refresh()
         nodes_raw = self.api.list_nodes()
         pods_raw = self.api.list_pods()
+        # plan reconciliation (missed-DELETED backstop): network GETs, so
+        # outside the lifecycle lock like the other I/O here
+        self.groups.reconcile(
+            {
+                f"{(o.get('metadata') or {}).get('namespace', 'default')}/"
+                f"{(o.get('metadata') or {}).get('name', '')}"
+                for o in pods_raw
+            },
+            self.api.get_pod,
+        )
         with self._lifecycle_lock:
             self._resync_locked(nodes_raw)
             self._sweep_stranded_gangs(pods_raw)

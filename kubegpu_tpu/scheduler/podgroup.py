@@ -23,6 +23,7 @@ from kubegpu_tpu.grpalloc.multislice import fit_gang_into_layout
 from kubegpu_tpu.scheduler.cache import ClusterCache
 from kubegpu_tpu.types import annotations
 from kubegpu_tpu.types.info import Assignment, PodInfo, TpuRequest
+from kubegpu_tpu.utils.apiserver import NotFound
 
 log = logging.getLogger(__name__)
 
@@ -134,6 +135,47 @@ class PodGroupRegistry:
                 for key in plan.per_pod:
                     if key not in plan.committed:
                         self.cache.forget(key)
+
+    def reconcile(self, listed_keys, get_pod) -> None:
+        """Resync backstop for MISSED DELETED events (the pod watch is the
+        fast path, but a watch race can skip one): a plan covering an
+        uncommitted member that is positively gone can never complete —
+        it only shields its gang from re-planning and holds reservations
+        until TTL.  Same discipline as the cache's reconciliation: the
+        (stale) LIST only nominates; a fresh per-pod GET confirms before
+        anything is dropped."""
+        with self._lock:
+            suspects = [
+                (gk, key, plan)
+                for gk, plan in self._plans.items()
+                for key in plan.per_pod
+                if key not in plan.committed and key not in listed_keys
+            ]
+        for gk, key, plan in suspects:
+            ns, name = key.split("/", 1)
+            try:
+                get_pod(ns, name)
+                continue  # exists — the LIST was just stale
+            except NotFound:
+                pass
+            except Exception:  # noqa: BLE001 - transient: next resync retries
+                continue
+            with self._lock:
+                # the GETs run unlocked (network): a concurrent filter may
+                # have re-planned the gang meanwhile — tearing down THAT
+                # fresh plan would spuriously roll back a healthy
+                # admission.  Only the exact plan that nominated the
+                # vanished member may be dropped.
+                cur = self._plans.get(gk)
+                if cur is not plan or key in cur.committed:
+                    continue
+                log.warning(
+                    "dropping gang plan %s: planned member %s is gone and "
+                    "its DELETED event was never seen (watch race); the "
+                    "gang re-plans its remainder",
+                    gk, key,
+                )
+                self.drop_plan(gk)
 
     def has_live_plan(self, gk: str, now: Optional[float] = None) -> bool:
         """True iff an unexpired plan covers the gang — members are still
@@ -266,6 +308,13 @@ class PodGroupRegistry:
                 per_pod={k: a for k, a in g.per_pod.items() if a.all_chips()},
                 score=g.score,
             )
+            # NOTE on overwriting an existing plan for this gang (one that
+            # did not cover this pod): its uncommitted members with LIVE
+            # reservations were counted as scheduled above and keep them —
+            # they bind via the assignment_of fallback.  Members that are
+            # GONE (missed DELETED event) are handled by reconcile() at
+            # resync with a GET-confirm; forgetting here by set-difference
+            # would free chips a mid-bind member still relies on.
             self._plans[gk] = plan
             log.info(
                 "gang %s planned on slice(s) %s score=%.1f%s",

@@ -820,6 +820,36 @@ def test_stale_deleted_event_for_recreated_name_is_ignored():
     assert len(view.free) == 12, "stale DELETED freed the recreated pod's chips"
 
 
+def test_resync_reconciles_plan_with_vanished_member():
+    """Missed-DELETED backstop (found by the chaos soak): a gang plan
+    covering a member whose deletion event was never seen would otherwise
+    shield the gang from re-planning and hold reservations until plan
+    TTL.  resync() GET-confirms the absence and drops the plan; the
+    remaining members re-plan and admit with a replacement."""
+    api, _, _ = fake_cluster()
+    sched = make_sched(api, gang_plan_ttl_s=3600.0)
+    objs = [pod_obj(f"v{i}", 4, group="van", group_size=2) for i in range(2)]
+    for o in objs:
+        api.create_pod(o)
+    r = sched.filter(objs[0], nodes_of(api))
+    assert r.nodes
+    assert sched.groups.has_live_plan("default/van")
+    # v1 vanishes WITHOUT the watch seeing it (hard kill + dropped event)
+    api.delete_pod("default", "v1")
+    sched.resync()
+    assert not sched.groups.has_live_plan("default/van")
+    # reservations returned: v0's chips are free again for the re-plan
+    assert sched.cache.assignment_of("default/v0") is None
+    assert sched.cache.assignment_of("default/v1") is None
+    # the controller recreates v1; the gang re-plans and fully admits
+    api.create_pod(pod_obj("v1", 4, group="van", group_size=2))
+    for name in ("v0", "v1"):
+        obj = api.get_pod("default", name)
+        r = sched.filter(obj, nodes_of(api))
+        assert r.nodes, r.failed
+        assert sched.bind("default", name, r.nodes[0]) is None
+
+
 # -- conflict sweep gating + detector cleanup (ADVICE r2 lows #2, #3) --------
 
 def make_conflict(api, sched):

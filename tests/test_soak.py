@@ -56,6 +56,7 @@ class Soak:
         self.ops = []
         self.dead = set()  # (slice, coords) currently killed
         self.ever_full = set()  # gangs observed fully bound at least once
+        self.deleted_history = []  # pod objects whose DELETED already fired
 
     # -- ops ---------------------------------------------------------------
     def op_create_pod(self):
@@ -132,7 +133,56 @@ class Soak:
         name = obj["metadata"]["name"]
         self.api.delete_pod("default", name)
         self.sched.on_pod_deleted(obj)
+        self.deleted_history.append(obj)
         return f"delete {name}"
+
+    def op_stale_delete_event(self):
+        """Watch pathology: a DELETED event for a pod that already left (or
+        whose name has since been recreated and re-bound) drains late.  The
+        GET-confirm guard must make it a no-op whenever the name exists —
+        double-freeing a recreated pod's chips is the I1/I2 breach this
+        hunts."""
+        if not self.deleted_history:
+            return "stale-del (noop)"
+        obj = self.rng.choice(self.deleted_history)
+        self.sched.on_pod_deleted(obj)
+        return f"stale-del {obj['metadata']['name']}"
+
+    def op_complete_pod(self):
+        """A bound pod's containers finish (Succeeded) or crash (Failed):
+        kube-scheduler accounting frees its chips at the next refresh even
+        though the annotation lingers until GC.  Gang members only complete
+        when their gang is actually RUNNING (fully bound) — a member of a
+        mid-admission gang has never started, so marking it terminal would
+        fabricate a state no real cluster produces.  Resync immediately —
+        the invariants compare cache vs annotations at quiescence."""
+        full_gangs = set()
+        by_gang: dict = {}
+        for obj in self.api.list_pods():
+            g = (obj["metadata"].get("annotations") or {}).get(annotations.POD_GROUP)
+            if g:
+                by_gang.setdefault(g, []).append(obj)
+        for g, objs in by_gang.items():
+            size = int(objs[0]["metadata"]["annotations"][annotations.POD_GROUP_SIZE])
+            if len([o for o in objs if (o.get("spec") or {}).get("nodeName")]) == size:
+                full_gangs.add(g)
+        def completable(o):
+            g = (o["metadata"].get("annotations") or {}).get(annotations.POD_GROUP)
+            return g is None or g in full_gangs
+
+        bound = [o for o in self.bound_pods() if completable(o)]
+        if not bound:
+            return "complete (noop)"
+        obj = self.rng.choice(bound)
+        name = obj["metadata"]["name"]
+        phase = self.rng.choice(["Succeeded", "Succeeded", "Failed"])
+        with self.api._lock:
+            pod = self.api._pods.get(f"default/{name}")
+            if pod is None:
+                return "complete (noop)"
+            pod["status"] = {"phase": phase}
+        self.sched.resync()
+        return f"complete {name} {phase}"
 
     def op_kill_chip(self):
         sid = self.rng.choice(list(self.slices))
@@ -205,6 +255,11 @@ class Soak:
     def check(self, trace, liveness: bool = True):
         live = {}
         for obj in self.api.list_pods():
+            phase = ((obj.get("status") or {}).get("phase") or "")
+            if phase in ("Succeeded", "Failed"):
+                # terminal pods hold nothing (ClusterCache._live_assignment)
+                # — their lingering annotations are history, not claims
+                continue
             a = annotations.assignment_from_pod(obj)
             if a is None:
                 continue
@@ -237,8 +292,17 @@ class Soak:
                 gangs.setdefault(g, []).append(obj)
         for g, objs in gangs.items():
             size = int(objs[0]["metadata"]["annotations"][annotations.POD_GROUP_SIZE])
-            bound = [o for o in objs if (o.get("spec") or {}).get("nodeName")]
-            if len(bound) == size:
+            # terminal members are neither capacity holders nor rollback
+            # targets (they hold no chips and completed their work): the
+            # partial-admission leak I3 hunts is about LIVE bound members
+            live_objs = [
+                o for o in objs
+                if ((o.get("status") or {}).get("phase") or "")
+                not in ("Succeeded", "Failed")
+            ]
+            bound = [o for o in live_objs if (o.get("spec") or {}).get("nodeName")]
+            n_done = len(objs) - len(live_objs)
+            if len(bound) == size - n_done:
                 self.ever_full.add(g)
             if liveness and g not in self.ever_full and len(objs) == size:
                 # judge admission atomicity only when the full membership
@@ -266,6 +330,8 @@ class Soak:
             (self.op_kill_chip, 1),
             (self.op_revive_chip, 1),
             (self.op_resync, 1),
+            (self.op_complete_pod, 1),
+            (self.op_stale_delete_event, 1),
         ]
         bag = [f for f, w in ops for _ in range(w)]
         for _ in range(steps):
@@ -319,14 +385,18 @@ def test_control_plane_soak_threaded():
 
     def churn():
         r = rng.random()
-        if r < 0.35:
+        if r < 0.3:
             s.op_create_pod()
-        elif r < 0.6:
+        elif r < 0.5:
             s.op_delete_pod()
-        elif r < 0.8:
+        elif r < 0.65:
             s.op_create_gang()
-        else:
+        elif r < 0.8:
             s.op_recreate_member()
+        elif r < 0.9:
+            s.op_complete_pod()
+        else:
+            s.op_stale_delete_event()
 
     def chaos():
         if rng.random() < 0.5:
@@ -370,7 +440,11 @@ def test_control_plane_soak_threaded():
     # within a bounded number of rounds.
     last_err = None
     for _ in range(25):
-        s.op_recreate_member()
+        # every controller restores ITS gang's missing members each round
+        # (one random gang per call; loop until a round makes no progress)
+        for _ in range(40):
+            if s.op_recreate_member() == "recreate (noop)":
+                break
         s.op_resync()
         s.op_schedule_sweep()
         s.check("threaded soak (seed 99), safety", liveness=False)
