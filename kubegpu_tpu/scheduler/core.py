@@ -35,6 +35,7 @@ from kubegpu_tpu.types import annotations
 from kubegpu_tpu.types.info import Assignment, PodInfo, TpuRequest
 from kubegpu_tpu.types.topology import is_contiguous_submesh
 from kubegpu_tpu.utils.apiserver import ApiServer, Conflict, NotFound
+from kubegpu_tpu.utils.events import EventRecorder
 from kubegpu_tpu.utils.metrics import Metrics, default_metrics
 
 log = logging.getLogger(__name__)
@@ -105,6 +106,10 @@ class Scheduler:
         self.cache = cache or ClusterCache(api)
         self.groups = PodGroupRegistry(self.cache, plan_ttl_s=gang_plan_ttl_s)
         self.metrics = metrics or default_metrics
+        # operator-facing decision records (kubectl describe pod), the
+        # kube-scheduler convention: best-effort, deduped, never failing
+        # a verb (utils/events.py)
+        self.events = EventRecorder(api)
         # device-type dispatch (SURVEY.md §2 #5): TPU built-in; more device
         # plugins via PluginRegistry.load (the Go-plugin .so analog)
         self.plugins = plugins or default_registry()
@@ -208,11 +213,24 @@ class Scheduler:
                     if self._attempt_preemption(pod, self._slices_of(node_names)):
                         planned = self.groups.try_plan(pod)
                 if planned.plan is None:
+                    self.events.pod_event(
+                        pod.namespace, pod.name, "GangUnschedulable",
+                        planned.reason, type_="Warning", uid=pod.uid,
+                    )
                     return FilterResult(
                         failed={n: planned.reason for n in node_names},
                         error="",
                     )
                 outcome = planned.plan
+                self.events.pod_event(
+                    pod.namespace, pod.name, "GangPlanned",
+                    (
+                        f"gang {outcome.group} planned: "
+                        f"{len(outcome.per_pod)} member(s) reserved, "
+                        f"score {outcome.score:.1f}"
+                    ),
+                    uid=pod.uid,
+                )
             planned_slice = outcome.per_pod[pod.key].slice_id
             if pod.slice_selector is not None and (
                 planned_slice is None
@@ -327,7 +345,14 @@ class Scheduler:
                 self.groups.drop_plan(u.unit_id[len("gang:"):])
         evicted = 0
         for key in decision.victim_pod_keys():
-            self._evict_pod(key)
+            self._evict_pod(
+                key,
+                reason="Preempted",
+                message=(
+                    f"preempted by higher-priority {pod.key} "
+                    f"(priority {pod.priority})"
+                ),
+            )
             evicted += 1
         self.metrics.inc("kubegpu_preemptions_total")
         self.metrics.inc("kubegpu_preempted_pods_total", evicted)
@@ -559,24 +584,30 @@ class Scheduler:
             # durable claim on chips another pod may legitimately take —
             # double-allocation (found by the gang-churn chaos soak).
             # Re-acquire or refuse.
+            reacquire_err = None
             with self.cache.lock:
                 if self.cache.assignment_of(key) is None:
                     try:
                         self.cache.assume(key, assignment)
                         reserved_here = True
                     except (ValueError, KeyError) as e:
-                        self.metrics.inc("kubegpu_bind_conflicts_total")
-                        # the plan is UNEXECUTABLE — its chips are durably
-                        # held elsewhere.  Drop it now: a live plan shields
-                        # the gang from both re-planning and the stranded
-                        # sweep, so keeping it would wedge the gang until
-                        # plan-TTL expiry (found by the chaos soak)
-                        self.groups.drop_plan(gk)
-                        return (
-                            f"gang reservation for {key} was released and "
-                            f"cannot be reacquired (plan dropped, re-run "
-                            f"filter): {e}"
-                        )
+                        reacquire_err = e
+            if reacquire_err is not None:
+                self.metrics.inc("kubegpu_bind_conflicts_total")
+                # the plan is UNEXECUTABLE — its chips are durably held
+                # elsewhere.  Drop it now: a live plan shields the gang
+                # from both re-planning and the stranded sweep, so keeping
+                # it would wedge the gang until plan-TTL expiry (found by
+                # the chaos soak).  Called OUTSIDE the cache lock:
+                # drop_plan takes groups-lock-then-cache-lock, and taking
+                # it under the cache lock would be the reverse order of
+                # every other path (ABBA deadlock).
+                self.groups.drop_plan(gk)
+                return (
+                    f"gang reservation for {key} was released and "
+                    f"cannot be reacquired (plan dropped, re-run "
+                    f"filter): {reacquire_err}"
+                )
         else:
             with self.cache.lock:
                 node = self.cache.node(node_name)
@@ -597,38 +628,63 @@ class Scheduler:
 
         # durable commit: assignment annotation first, then the binding —
         # a crash between the two leaves an annotated-unbound pod that
-        # refresh() replays correctly (state lives in the API server)
-        try:
-            if assignment is not None:
-                self.api.patch_pod_annotations(
-                    namespace,
-                    name,
-                    {annotations.POD_ASSIGNMENT: annotations.encode_assignment(assignment)},
-                )
-            self.api.bind_pod(namespace, name, node_name)
-        except (Conflict, NotFound, OSError) as e:
-            if reserved_here:
-                self.cache.forget(key)
-            if assignment is not None:
-                # clear the annotation for gang pods too: leaving it would
-                # let a later refresh() replay a ghost placement for a pod
-                # that never bound (stranding its chips)
-                try:
-                    self.api.patch_pod_annotations(
-                        namespace, name, {annotations.POD_ASSIGNMENT: ""}
-                    )
-                except Exception:  # noqa: BLE001
-                    pass
-            return f"bind of {key} to {node_name} failed: {e}"
-
-        if assignment is not None:
-            # annotation + binding both durable: refresh() now rebuilds this
-            # reservation from the API server
-            self.cache.confirm(key)
+        # refresh() replays correctly (state lives in the API server).
+        # Gang pods are marked mid-bind for the duration: a concurrent
+        # drop_plan (reconcile, sibling's bind failure) must not forget a
+        # reservation whose durable annotation is landing right now.
         if is_tpu_gang:
-            self.groups.mark_committed(key, gk)
+            self.groups.mark_binding(key)
+        try:
+            try:
+                if assignment is not None:
+                    self.api.patch_pod_annotations(
+                        namespace,
+                        name,
+                        {annotations.POD_ASSIGNMENT: annotations.encode_assignment(assignment)},
+                    )
+                self.api.bind_pod(namespace, name, node_name)
+            except (Conflict, NotFound, OSError) as e:
+                if reserved_here:
+                    self.cache.forget(key)
+                elif is_tpu_gang and self.groups.plan_for(pod) is None:
+                    # the plan vanished mid-bind (dropped/expired): this
+                    # reservation has no owner left to expire it — forget,
+                    # or the chips leak until a GET-confirmed divergence
+                    self.cache.forget(key)
+                if assignment is not None:
+                    # clear the annotation for gang pods too: leaving it
+                    # would let a later refresh() replay a ghost placement
+                    # for a pod that never bound (stranding its chips)
+                    try:
+                        self.api.patch_pod_annotations(
+                            namespace, name, {annotations.POD_ASSIGNMENT: ""}
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
+                return f"bind of {key} to {node_name} failed: {e}"
+
+            if assignment is not None:
+                # annotation + binding both durable: refresh() now rebuilds
+                # this reservation from the API server
+                self.cache.confirm(key)
+            if is_tpu_gang:
+                self.groups.mark_committed(key, gk)
+        finally:
+            if is_tpu_gang:
+                self.groups.unmark_binding(key)
         if assignment is not None:
             self._record_placement_metrics(assignment)
+            chips = assignment.all_chips()
+            if chips:
+                self.events.pod_event(
+                    namespace, name, "DeviceAssigned",
+                    (
+                        f"assigned {len(chips)} TPU chip(s) on {node_name} "
+                        f"(slice {assignment.slice_id}, coords "
+                        f"{sorted(c.coords for c in chips)})"
+                    ),
+                    uid=pod.uid,
+                )
         log.info("bound %s -> %s", key, node_name)
         return None
 
@@ -701,7 +757,15 @@ class Scheduler:
                 continue
             del self._conflict_strikes[key]
             self._drop_gang_plan_of(key)
-            self._evict_pod(key)
+            self._evict_pod(
+                key,
+                reason="AssignmentConflict",
+                message=(
+                    "annotated chips are held by another assignment "
+                    f"({strikes} consecutive resyncs): durable double-"
+                    "annotation resolved toward the charged owner"
+                ),
+            )
             self.metrics.inc("kubegpu_health_evictions_total")
             log.warning(
                 "evicted %s: its annotated chips are held by another "
@@ -756,7 +820,14 @@ class Scheduler:
                 continue
             del self._missing_node_strikes[(key, host)]
             self._drop_gang_plan_of(key)
-            self._evict_pod(key)
+            self._evict_pod(
+                key,
+                reason="NodeLost",
+                message=(
+                    f"node {host} is no longer advertised "
+                    f"({strikes} consecutive resyncs)"
+                ),
+            )
             self.metrics.inc("kubegpu_health_evictions_total")
             log.warning(
                 "evicted %s: its node %s is no longer advertised "
@@ -860,7 +931,15 @@ class Scheduler:
             del self._stranded_strikes[gk]
             self.groups.drop_plan(gk)
             for key in (*bound, *sorted(gangs[gk]["releasable"])):
-                self._evict_pod(key)
+                self._evict_pod(
+                    key,
+                    reason="GangRollback",
+                    message=(
+                        f"gang {gk} stayed partially bound for {strikes} "
+                        "resyncs without progress; rolling back so the "
+                        "whole gang can re-admit atomically"
+                    ),
+                )
             self.metrics.inc("kubegpu_stranded_gang_rollbacks_total")
             log.warning(
                 "rolled back incomplete gang %s (%d bound of %d outstanding "
@@ -904,13 +983,27 @@ class Scheduler:
             if self.evict_on_chip_failure:
                 self._evict_on_dead_chips(node_obj)
 
-    def _evict_pod(self, key: str) -> None:
+    def _evict_pod(
+        self, key: str, reason: str = "Evicted", message: str = ""
+    ) -> None:
         """The one eviction sequence (preemption AND health eviction):
         clear the assignment annotation BEFORE deleting — a victim
         lingering in Terminating (graceful deletion on a real cluster)
         must not be replayed by the next cache refresh onto chips a new
-        placement may own — then delete and release the cache entry."""
+        placement may own — then delete and release the cache entry.
+        The eviction is announced as a Warning Event first: deletion is
+        the last thing an operator can ask the pod about — and kubectl
+        describe matches events by involvedObject.uid, so the uid is
+        fetched (one GET; evictions are rare) rather than left empty."""
         ns, name = key.split("/", 1)
+        try:
+            uid = (self.api.get_pod(ns, name).get("metadata") or {}).get("uid", "")
+        except Exception:  # noqa: BLE001 - already gone / transient
+            uid = ""
+        self.events.pod_event(
+            ns, name, reason, message or "evicted by kubegpu-tpu-scheduler",
+            type_="Warning", uid=uid,
+        )
         try:
             self.api.patch_pod_annotations(
                 ns, name, {annotations.POD_ASSIGNMENT: ""}
@@ -983,7 +1076,15 @@ class Scheduler:
             # would rebind the recreated member onto the exact dead chip,
             # producing an endless evict/recreate/rebind loop
             self._drop_gang_plan_of(key)
-            self._evict_pod(key)
+            self._evict_pod(
+                key,
+                reason="ChipFailure",
+                message=(
+                    f"assigned TPU chip(s) on {node.name} died "
+                    f"(dead device indices: {sorted(dead)}); controller "
+                    "recreates onto healthy chips"
+                ),
+            )
             self.metrics.inc("kubegpu_health_evictions_total")
             log.warning(
                 "evicted %s: its chip(s) on %s died (dead=%s)",

@@ -59,6 +59,12 @@ class PodGroupRegistry:
         self.plan_ttl_s = plan_ttl_s
         self._lock = threading.RLock()
         self._plans: Dict[str, GangPlan] = {}
+        # keys whose bind verb is between reservation-check and durable
+        # commit RIGHT NOW: drop_plan must not forget their reservations —
+        # the in-flight bind will land a durable annotation, and freeing
+        # the chips under it would let another pod double-claim them for
+        # a conflict-sweep-length window
+        self._binding: Set[str] = set()
         # gang key -> member keys ever seen Succeeded.  Completed members
         # owe no replacement, so they shrink BOTH the planner's "all
         # members created" requirement and the stranded sweep's
@@ -128,12 +134,23 @@ class PodGroupRegistry:
                 self.cache.forget(key)
         del self._plans[gk]
 
+    def mark_binding(self, key: str) -> None:
+        with self._lock:
+            self._binding.add(key)
+
+    def unmark_binding(self, key: str) -> None:
+        with self._lock:
+            self._binding.discard(key)
+
     def drop_plan(self, gk: str) -> None:
         with self._lock:
             plan = self._plans.pop(gk, None)
             if plan:
                 for key in plan.per_pod:
-                    if key not in plan.committed:
+                    if key not in plan.committed and key not in self._binding:
+                        # mid-bind members keep their reservation: their
+                        # bind confirms it (success) or forgets it itself
+                        # (failure path handles the planless case)
                         self.cache.forget(key)
 
     def reconcile(self, listed_keys, get_pod) -> None:

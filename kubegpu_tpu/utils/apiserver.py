@@ -70,7 +70,11 @@ class ApiServer:
     def bind_pod(self, namespace: str, name: str, node: str) -> None:
         raise NotImplementedError
 
-    # events
+    # v1 Events (operator-facing decision records; best-effort)
+    def create_event(self, obj: dict) -> None:
+        raise NotImplementedError
+
+    # watches
     def watch_nodes(self, handler: Callable[[str, dict], None],
                     stop, timeout_s: int = 30) -> None:
         """Block delivering node events — handler("node-updated"|"node-deleted",
@@ -99,6 +103,7 @@ class InMemoryApiServer(ApiServer):
         self._lock = threading.RLock()
         self._nodes: Dict[str, dict] = {}
         self._pods: Dict[str, dict] = {}
+        self._events: List[dict] = []
         self._observers: List[Callable[[str, dict], None]] = []
 
     # -- helpers ----------------------------------------------------------
@@ -208,6 +213,19 @@ class InMemoryApiServer(ApiServer):
                 raise Conflict(f"pod {k} already bound to {spec['nodeName']}")
             spec["nodeName"] = node
             self._emit("pod-bound", self._pods[k])
+
+    def create_event(self, obj: dict) -> None:
+        with self._lock:
+            self._events.append(copy.deepcopy(obj))
+
+    def list_events(self, namespace: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            return [
+                copy.deepcopy(e)
+                for e in self._events
+                if namespace is None
+                or e.get("metadata", {}).get("namespace") == namespace
+            ]
 
     def watch_nodes(self, handler: Callable[[str, dict], None],
                     stop, timeout_s: int = 30) -> None:
@@ -343,6 +361,10 @@ class KubeApiServer(ApiServer):
             {"metadata": {"annotations": ann}},
             content_type="application/merge-patch+json",
         )
+
+    def create_event(self, obj: dict) -> None:
+        ns = obj.get("metadata", {}).get("namespace", "default")
+        self._req("POST", f"/api/v1/namespaces/{ns}/events", obj)
 
     def bind_pod(self, namespace: str, name: str, node: str) -> None:
         self._req(

@@ -76,6 +76,7 @@ class StubState:
         self.nodes = {}
         self.pods = {}          # "ns/name" -> obj
         self.requests = []      # (method, path, content_type, auth)
+        self.events = []        # POSTed v1 Events
         self.watch_events = []  # node events [{"type": ..., "object": ...}]
         self.pod_watch_events = []  # pod events, same shape
         self.watch_poll_s = 0.0  # >0: long-poll for NEW events this long
@@ -183,6 +184,10 @@ def make_stub_handler(state: StubState):
                     return self._send(409, {"reason": "AlreadyBound"})
                 pod["spec"]["nodeName"] = body.get("target", {}).get("name", "")
                 return self._send(201, {})
+            if len(parts) == 5 and parts[4] == "events":
+                with state.lock:
+                    state.events.append(body)
+                return self._send(201, body)
             if len(parts) == 5 and parts[4] == "pods":
                 ns = parts[3]
                 name = body.get("metadata", {}).get("name", "")
@@ -410,6 +415,12 @@ def test_extender_daemon_watch_eviction_through_rest_client(stub):
         assert "default/victim" not in state.pods, (
             "watch event over the REST wire did not evict the pod"
         )
+        # the eviction explained itself: a ChipFailure Warning Event was
+        # POSTed through the same REST client
+        chip_events = [e for e in state.events if e.get("reason") == "ChipFailure"]
+        assert chip_events, [e.get("reason") for e in state.events]
+        assert chip_events[0]["involvedObject"]["name"] == "victim"
+        assert chip_events[0]["type"] == "Warning"
     finally:
         server.stop()
 

@@ -1,0 +1,138 @@
+"""v1 Event emission: the operator-facing record of scheduler decisions
+(kubectl-describe parity with kube-scheduler's Scheduled/FailedScheduling/
+Preempted convention)."""
+
+import pytest
+
+from kubegpu_tpu.plugins import Advertiser, FakeSlice
+from kubegpu_tpu.scheduler import Scheduler
+from kubegpu_tpu.types import RES_TPU, annotations
+from kubegpu_tpu.utils import InMemoryApiServer
+from kubegpu_tpu.utils.events import EventRecorder
+from kubegpu_tpu.utils.metrics import Metrics
+
+
+def fake_cluster(mesh=(4, 4)):
+    api = InMemoryApiServer()
+    fs = FakeSlice(slice_id="s0", mesh_shape=mesh, host_block=(2, 2))
+    advs = {h: Advertiser(p, api) for h, p in fs.providers().items()}
+    for a in advs.values():
+        a.advertise_once()
+    sched = Scheduler(api, metrics=Metrics())
+    sched.cache.refresh()
+    return api, fs, advs, sched
+
+
+def pod_obj(name, chips, group=None, size=1, priority=0):
+    ann = {}
+    if group:
+        ann[annotations.POD_GROUP] = group
+        ann[annotations.POD_GROUP_SIZE] = str(size)
+    if priority:
+        ann[annotations.POD_PRIORITY] = str(priority)
+    return {
+        "metadata": {"name": name, "namespace": "default",
+                     "uid": f"uid-{name}", "annotations": ann},
+        "spec": {"containers": [
+            {"name": "m", "resources": {"limits": {RES_TPU: str(chips)}}}]},
+    }
+
+
+def reasons(api, name=None):
+    return [
+        e["reason"]
+        for e in api.list_events()
+        if name is None or e["involvedObject"]["name"] == name
+    ]
+
+
+def schedule(api, sched, obj):
+    nodes = sorted(n["metadata"]["name"] for n in api.list_nodes())
+    r = sched.filter(obj, nodes)
+    assert r.nodes, r.failed
+    assert sched.bind("default", obj["metadata"]["name"], r.nodes[0]) is None
+
+
+def test_gang_schedule_emits_planned_and_assigned():
+    api, _, _, sched = fake_cluster()
+    for i in range(2):
+        api.create_pod(pod_obj(f"g{i}", 4, group="ring", size=2))
+    for i in range(2):
+        schedule(api, sched, api.get_pod("default", f"g{i}"))
+    assert "GangPlanned" in reasons(api, "g0")  # first member planned it
+    for i in range(2):
+        assert "DeviceAssigned" in reasons(api, f"g{i}")
+    assigned = [e for e in api.list_events() if e["reason"] == "DeviceAssigned"]
+    assert all(e["type"] == "Normal" for e in assigned)
+    assert "4 TPU chip(s)" in assigned[0]["message"]
+    assert assigned[0]["involvedObject"]["uid"] == "uid-g0"
+    assert assigned[0]["source"]["component"] == "kubegpu-tpu-scheduler"
+
+
+def test_unschedulable_gang_emits_warning_once():
+    api, _, _, sched = fake_cluster()
+    obj = pod_obj("w0", 4, group="big", size=9)  # member count can't arrive
+    api.create_pod(obj)
+    nodes = sorted(n["metadata"]["name"] for n in api.list_nodes())
+    for _ in range(5):  # kube-scheduler retries; dedup must absorb them
+        assert not sched.filter(obj, nodes).nodes
+    warnings = [e for e in api.list_events() if e["reason"] == "GangUnschedulable"]
+    assert len(warnings) == 1
+    assert warnings[0]["type"] == "Warning"
+    assert "waiting for members" in warnings[0]["message"]
+
+
+def test_preemption_and_chip_failure_emit_warnings():
+    api, fs, advs, sched = fake_cluster()
+    victim = pod_obj("victim", 4, priority=1)
+    api.create_pod(victim)
+    schedule(api, sched, victim)
+    # fill the rest so the vip needs a preemption
+    for i in range(3):
+        filler = pod_obj(f"f{i}", 4, priority=1)
+        api.create_pod(filler)
+        schedule(api, sched, filler)
+    vip = pod_obj("vip", 4, priority=9)
+    api.create_pod(vip)
+    schedule(api, sched, vip)
+    pre = [e for e in api.list_events() if e["reason"] == "Preempted"]
+    assert len(pre) == 1 and pre[0]["type"] == "Warning"
+    assert "default/vip" in pre[0]["message"]
+
+    # now kill a chip under the vip and resync: ChipFailure eviction event
+    a = annotations.assignment_from_pod(api.get_pod("default", "vip"))
+    fs.kill_chip(a.all_chips()[0].coords)
+    for adv in advs.values():
+        adv.advertise_once()
+    sched.resync()
+    chip = [e for e in api.list_events() if e["reason"] == "ChipFailure"]
+    assert len(chip) == 1
+    assert chip[0]["involvedObject"]["name"] == "vip"
+
+
+def test_recorder_swallows_api_failures():
+    class ExplodingApi:
+        def create_event(self, obj):
+            raise OSError("api down")
+
+    rec = EventRecorder(ExplodingApi())
+    rec.pod_event("default", "p", "Reason", "msg")  # must not raise
+
+    class NoEventsApi:
+        def create_event(self, obj):
+            raise NotImplementedError
+
+    EventRecorder(NoEventsApi()).pod_event("default", "p", "Reason", "msg")
+
+
+def test_dedup_expires_and_reemits():
+    api = InMemoryApiServer()
+    rec = EventRecorder(api, dedup_s=0.0)
+    rec.pod_event("default", "p", "R", "m")
+    rec.pod_event("default", "p", "R", "m")
+    assert len(api.list_events()) == 2  # zero window: every emission lands
+    rec2 = EventRecorder(api, dedup_s=300.0)
+    rec2.pod_event("default", "q", "R", "m")
+    rec2.pod_event("default", "q", "R", "m")
+    assert len([e for e in api.list_events()
+                if e["involvedObject"]["name"] == "q"]) == 1

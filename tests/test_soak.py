@@ -271,17 +271,35 @@ class Soak:
                 )
                 live[key] = obj["metadata"]["name"]
 
-        # I2: cache used == annotations' union, per slice
+        # I2: cache used == annotations' union, per slice — except chips
+        # reserved by IN-FLIGHT (assumed) admissions, which are cache-only
+        # BY DESIGN until their bind writes the durable annotation (gang
+        # plans reserve every member up front; a member whose bind hits a
+        # transient failure retries next sweep).  Anything cache-only and
+        # NOT assumed is real drift; anything annotated and uncharged is
+        # always drift.
         views = self.sched.cache.views()
         ann_used = {}
         for (sid, coords), _ in live.items():
             ann_used.setdefault(sid, set()).add(coords)
+        assumed_used: dict = {}
+        for key in list(self.sched.cache._assumed):
+            a = self.sched.cache.assignment_of(key)
+            if a is not None:
+                assumed_used.setdefault(a.slice_id, set()).update(
+                    c.coords for c in a.all_chips()
+                )
         for sid, v in views.items():
             cache_used = set(v.used)
-            assert cache_used == ann_used.get(sid, set()), (
-                f"I2 cache/annotation drift on {sid}: "
-                f"cache-only={cache_used - ann_used.get(sid, set())} "
-                f"ann-only={ann_used.get(sid, set()) - cache_used}\n" + trace
+            cache_only = cache_used - ann_used.get(sid, set())
+            assert cache_only <= assumed_used.get(sid, set()), (
+                f"I2 unexplained cache-only chips on {sid}: "
+                f"{cache_only - assumed_used.get(sid, set())} "
+                f"(assumed={assumed_used.get(sid, set())})\n" + trace
+            )
+            ann_only = ann_used.get(sid, set()) - cache_used
+            assert not ann_only, (
+                f"I2 annotated-but-uncharged chips on {sid}: {ann_only}\n" + trace
             )
 
         # I3: atomic admission — a gang never goes 0 → partially bound
