@@ -299,6 +299,11 @@ class ClusterCache:
         with self._lock:
             return dict(self._assignments)
 
+    def assumed_keys(self) -> List[str]:
+        """Locked snapshot of in-flight (reserved, unconfirmed) pod keys."""
+        with self._lock:
+            return sorted(self._assumed)
+
     def orphaned_assignments(self) -> Dict[str, str]:
         """pod key -> vanished node name, as of the last refresh()."""
         with self._lock:

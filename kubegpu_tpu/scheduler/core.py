@@ -583,7 +583,11 @@ class Scheduler:
             # planning and bind.  Annotating without a live charge writes a
             # durable claim on chips another pod may legitimately take —
             # double-allocation (found by the gang-churn chaos soak).
-            # Re-acquire or refuse.
+            # Re-acquire or refuse.  Mark mid-bind BEFORE the check: a
+            # concurrent drop_plan landing between the check and the mark
+            # could otherwise forget the very reservation the durable
+            # commit below relies on (TOCTOU).
+            self.groups.mark_binding(key)
             reacquire_err = None
             with self.cache.lock:
                 if self.cache.assignment_of(key) is None:
@@ -593,6 +597,7 @@ class Scheduler:
                     except (ValueError, KeyError) as e:
                         reacquire_err = e
             if reacquire_err is not None:
+                self.groups.unmark_binding(key)
                 self.metrics.inc("kubegpu_bind_conflicts_total")
                 # the plan is UNEXECUTABLE — its chips are durably held
                 # elsewhere.  Drop it now: a live plan shields the gang
@@ -629,9 +634,10 @@ class Scheduler:
         # durable commit: assignment annotation first, then the binding —
         # a crash between the two leaves an annotated-unbound pod that
         # refresh() replays correctly (state lives in the API server).
-        # Gang pods are marked mid-bind for the duration: a concurrent
-        # drop_plan (reconcile, sibling's bind failure) must not forget a
-        # reservation whose durable annotation is landing right now.
+        # Gang pods are marked mid-bind for the duration (set above,
+        # idempotent here): a concurrent drop_plan (reconcile, sibling's
+        # bind failure) must not forget a reservation whose durable
+        # annotation is landing right now.
         if is_tpu_gang:
             self.groups.mark_binding(key)
         try:

@@ -130,9 +130,24 @@ class PodGroupRegistry:
             len(plan.per_pod),
         )
         for key in plan.per_pod:
-            if key not in plan.committed:
+            if key not in plan.committed and key not in self._binding:
+                # same mid-bind guard as drop_plan: a member whose durable
+                # commit is in flight keeps its reservation (its bind
+                # confirms or forgets it)
                 self.cache.forget(key)
         del self._plans[gk]
+
+    def plans_snapshot(self) -> Dict[str, dict]:
+        """Locked, JSON-ready view of the in-flight plans (observability)."""
+        with self._lock:
+            return {
+                gk: {
+                    "members": sorted(p.per_pod),
+                    "committed": sorted(p.committed),
+                    "score": round(p.score, 1),
+                }
+                for gk, p in self._plans.items()
+            }
 
     def mark_binding(self, key: str) -> None:
         with self._lock:

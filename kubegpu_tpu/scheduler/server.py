@@ -187,6 +187,10 @@ def _debug_state(sched: Scheduler) -> dict:
             }
             for sid, v in views.items()
         },
+        # in-flight gang admissions (plans reserve every member up front);
+        # locked snapshot accessors — handler threads race the verbs
+        "gang_plans": sched.groups.plans_snapshot(),
+        "assumed": sched.cache.assumed_keys(),
     }
 
 

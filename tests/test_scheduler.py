@@ -1036,3 +1036,65 @@ def test_prioritize_distinguishes_placements_round_would_merge():
     # two distinct extender scores exist
     raw = set(scores.values())
     assert len(raw) >= 2, scores
+
+
+# -- operator status CLI ------------------------------------------------------
+
+def test_status_cli_renders_live_extender(capsys):
+    """The kubectl-get-style surface: status.py against a live extender
+    shows slice occupancy, in-flight gang plans, and headline counters."""
+    from kubegpu_tpu.scheduler import status
+
+    api, _, _ = fake_cluster()
+    sched = Scheduler(api, metrics=Metrics())
+    srv = ExtenderServer(sched, listen=("127.0.0.1", 0))
+    srv.start()
+    try:
+        url = f"http://{srv.address[0]}:{srv.address[1]}"
+        # one bound pod + one planned-but-unbound gang member in flight
+        obj = pod_obj("solo", 4)
+        api.create_pod(obj)
+        r = sched.filter(obj, nodes_of(api))
+        assert sched.bind("default", "solo", r.nodes[0]) is None
+        for i in range(2):
+            api.create_pod(pod_obj(f"s{i}", 2, group="st", group_size=2))
+        rg = sched.filter(api.get_pod("default", "s0"), nodes_of(api))
+        assert rg.nodes
+
+        assert status.main(["--url", url]) == 0
+        out = capsys.readouterr().out
+        assert "slice s0" in out and "mesh 4x4" in out
+        assert "#" in out and "." in out            # occupancy map
+        assert "default/st" in out                   # in-flight plan
+        assert "placements_total" in out             # headline counter
+        assert status.main(["--url", url, "--json"]) == 0
+        parsed = json.loads(capsys.readouterr().out)
+        assert parsed["gang_plans"]["default/st"]["committed"] == []
+    finally:
+        srv.stop()
+
+
+def test_status_cli_unreachable_is_clean_error(capsys):
+    from kubegpu_tpu.scheduler import status
+
+    assert status.main(["--url", "http://127.0.0.1:1", "--timeout", "0.5"]) == 1
+    assert "cannot reach" in capsys.readouterr().err
+
+
+def test_status_render_slice_3d():
+    """v4/v5p 3D topologies render one 2D map per z-layer, not garbage."""
+    from kubegpu_tpu.scheduler.status import render_slice
+
+    out = render_slice("v4", {
+        "mesh": [2, 2, 2],
+        "used": [[0, 0, 0], [1, 0, 0]],
+        "free": [[0, 1, 0], [1, 1, 0], [0, 0, 1], [1, 0, 1], [0, 1, 1], [1, 1, 1]],
+        "hosts": ["h0"],
+    })
+    assert "mesh 2x2x2" in out
+    assert "z=0:" in out and "z=1:" in out
+    map_rows = [
+        ln for ln in out.splitlines() if ln.startswith("    ") and " " in ln.strip()
+    ]
+    assert map_rows and all("x" not in ln for ln in map_rows), map_rows
+    assert sum(ln.count("#") for ln in map_rows) == 2  # exactly the used pair
