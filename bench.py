@@ -371,6 +371,75 @@ def steady_state_longctx(extra: dict) -> None:
         extra["longctx_hbm_gib"] = round(hbm_gb, 2)
 
 
+def steady_state_decode(extra: dict) -> None:
+    """Inference serving: KV-cached greedy decode of the 1.08B flagship
+    (models/decoding.py — prefill in one causal pass, then a lax.scan of
+    single-token steps against the cache, all ONE compiled program).
+    Decode is memory-bound (every step streams the full parameter set), so
+    params serve in bf16 — the standard inference precision; tok/s is the
+    serving-side twin of the training MFU rows."""
+    import os
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubegpu_tpu.models import TransformerLM
+    from kubegpu_tpu.models.decoding import greedy_generate
+
+    batch = int(os.environ.get("BENCH_DECODE_BATCH", "8"))
+    prompt_len, steps, max_seq = 128, 256, 512
+    vocab, hidden, layers = 32768, 4096, 4
+    heads = hidden // 128
+    model = TransformerLM(
+        vocab_size=vocab, num_layers=layers, num_heads=heads, hidden=hidden,
+        max_seq=max_seq,
+    )
+    rng = jax.random.PRNGKey(0)
+    prompt = jax.random.randint(rng, (batch, prompt_len), 0, vocab, jnp.int32)
+
+    # params only, straight to bf16 in one jitted program: a TrainState
+    # would also materialize fp32 momentum — 4.3 GB an inference bench
+    # never touches
+    def _init_bf16(rng, x):
+        p = model.init(rng, x)["params"]
+        return jax.tree.map(
+            lambda v: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v,
+            p,
+        )
+
+    params = jax.jit(_init_bf16)(rng, prompt)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+
+    fn = jax.jit(
+        lambda p, tokens: greedy_generate(
+            p, tokens, steps, vocab_size=vocab, num_layers=layers,
+            num_heads=heads, hidden=hidden, max_seq=max_seq,
+        )
+    )
+    t = time.perf_counter()
+    out = fn(params, prompt)
+    int(out[0, -1])  # value readback forces the whole program
+    t_first = time.perf_counter() - t
+    n = 3
+    t = time.perf_counter()
+    for _ in range(n):
+        out = fn(params, prompt)
+    int(out[0, -1])
+    dt = (time.perf_counter() - t) / n
+    tok_s = batch * steps / dt
+    log(
+        f"serving decode ({n_params / 1e6:.0f}M bf16, KV cache): "
+        f"b{batch}, prefill {prompt_len} + {steps} steps in {dt * 1e3:.0f} ms "
+        f"-> {tok_s:.0f} tok/s decoded ({dt / steps * 1e3:.2f} ms/step incl. "
+        f"prefill; first call {t_first:.1f} s with compile)"
+    )
+    extra["decode_b"] = batch
+    extra["decode_steps"] = steps
+    extra["decode_tok_s"] = round(tok_s)
+    extra["decode_ms_per_call"] = round(dt * 1e3, 1)
+
+
 def tpu_kernel_smoke(extra: dict) -> None:
     """Mosaic compile-check of the Pallas kernels on the REAL chip, under
     shard_map: CPU interpret mode cannot catch mosaic lowering rejections
@@ -664,49 +733,19 @@ def first_step_probe() -> dict:
     labels = jnp.zeros((per_worker_batch,), jnp.int32)
     t_a = time.perf_counter()
     log(f"  [backend init + host batch: {t_a - t_inject:.2f} s]")
-    # Overlap the two big compiles on the cold critical path: the train
-    # step AOT-lowers from AVALS (shapes + shardings, no data), so its
-    # compile runs on a thread WHILE the init program compiles and runs.
-    # One shared tx instance: TrainState's static fields must compare
-    # equal between the aval tree and the real state or the AOT call
-    # rejects the treedef.
-    import concurrent.futures as _cf
-
-    import optax as _optax
-
-    from kubegpu_tpu.models.train import train_state_shape
-    from kubegpu_tpu.parallel.sharding import batch_sharding, replicated
-
-    tx = _optax.sgd(0.1, momentum=0.9, nesterov=True)
-    step = make_resnet_train_step(mesh)
-    rep, bsh = replicated(mesh), batch_sharding(mesh)
     # init with a BATCH-1 sample: param/batch-stat shapes are
     # batch-independent, and the init program (flax init runs the forward)
-    # compiles and executes several times faster at b1 — the train step
-    # still lowers for the real batch via avals below
-    init_sample = images[:1]
-    shapes = train_state_shape(model, rng, init_sample, tx=tx)
-    state_avals = jax.tree.map(
-        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=rep), shapes
-    )
-    img_aval = jax.ShapeDtypeStruct(images.shape, images.dtype, sharding=bsh)
-    lab_aval = jax.ShapeDtypeStruct(labels.shape, labels.dtype, sharding=bsh)
-    pool = _cf.ThreadPoolExecutor(1)
-    step_future = pool.submit(
-        lambda: step.lower(state_avals, img_aval, lab_aval).compile()
-    )
-    state = create_train_state(model, rng, init_sample, tx=tx)
+    # compiles and executes several times faster at b1.  The step compiles
+    # SEQUENTIALLY on its first call — measured r3: overlapping it on a
+    # thread makes cold WORSE on this backend (concurrent compiles
+    # serialize/contend: init 9→25 s, and AOT .compile() defers the real
+    # compile to first execute anyway).
+    state = create_train_state(model, rng, images[:1])
     jax.block_until_ready(state.params)
     t_b = time.perf_counter()
-    log(f"  [state init (jit _init compile+run): {t_b - t_a:.2f} s]")
+    log(f"  [state init (jit _init compile+run, b1): {t_b - t_a:.2f} s]")
     state, images, labels = place_resnet(state, (images, labels), mesh)
-    compiled_step = step_future.result()
-    t_c = time.perf_counter()
-    log(f"  [step compile (overlapped with init): +{t_c - t_b:.2f} s]")
-
-    def step(state, images, labels):  # noqa: F811 - AOT executable
-        return compiled_step(state, images, labels)
-
+    step = make_resnet_train_step(mesh)
     state, loss = step(state, images, labels)
     loss_value = float(loss)  # blocks until the step completes
     log(f"  [train step (compile+run): {time.perf_counter() - t_b:.2f} s]")
@@ -808,6 +847,7 @@ def main() -> None:
     steady_state_resnet(extra)
     steady_state_lm(extra)
     steady_state_longctx(extra)
+    steady_state_decode(extra)
     tpu_kernel_smoke(extra)
 
     target = 60.0  # BASELINE.json north star: first step in < 60 s

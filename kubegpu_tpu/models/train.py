@@ -49,7 +49,20 @@ class TrainState(flax.struct.PyTreeNode):
         )
 
 
-def _make_init(model, tx):
+def create_train_state(
+    model, rng, sample_input, tx: Optional[optax.GradientTransformation] = None
+) -> TrainState:
+    tx = tx or optax.sgd(0.1, momentum=0.9, nesterov=True)
+
+    # jit the whole init: eager flax init dispatches one tiny op per
+    # parameter, which is pathologically slow on remote/tunnelled
+    # accelerators (measured ~15x slower than one compiled program for
+    # ResNet-50 on a tunnelled v5e chip).  sample_input is a traced
+    # argument, not a closure capture — baking a real batch in as a
+    # constant would bloat the program and key caches on its values.
+    # (Init with the SMALLEST batch that traces — param shapes are
+    # batch-independent and the init program compiles ~2x faster at b1;
+    # bench.py's cold probe relies on this.)
     def _init(rng, x):
         variables = model.init(rng, x)
         params = variables["params"]
@@ -62,33 +75,7 @@ def _make_init(model, tx):
             tx=tx,
         )
 
-    return _init
-
-
-def create_train_state(
-    model, rng, sample_input, tx: Optional[optax.GradientTransformation] = None
-) -> TrainState:
-    tx = tx or optax.sgd(0.1, momentum=0.9, nesterov=True)
-
-    # jit the whole init: eager flax init dispatches one tiny op per
-    # parameter, which is pathologically slow on remote/tunnelled
-    # accelerators (measured ~15x slower than one compiled program for
-    # ResNet-50 on a tunnelled v5e chip).  sample_input is a traced
-    # argument, not a closure capture — baking a real batch in as a
-    # constant would bloat the program and key caches on its values.
-    return jax.jit(_make_init(model, tx))(rng, sample_input)
-
-
-def train_state_shape(
-    model, rng, sample_input, tx: Optional[optax.GradientTransformation] = None
-) -> TrainState:
-    """The TrainState's shape/dtype tree WITHOUT compiling or running the
-    init — jax.eval_shape over the same _init create_train_state jits.
-    Lets a cold start AOT-compile the train step (from avals) CONCURRENTLY
-    with the init compile instead of serializing the two biggest
-    compilations on the first-step critical path."""
-    tx = tx or optax.sgd(0.1, momentum=0.9, nesterov=True)
-    return jax.eval_shape(_make_init(model, tx), rng, sample_input)
+    return jax.jit(_init)(rng, sample_input)
 
 
 def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:

@@ -74,7 +74,9 @@ def main(argv=None) -> int:
 
     try:
         state = fetch(url, "/state", args.timeout)
-    except OSError as e:
+    except (OSError, ValueError) as e:
+        # ValueError covers JSONDecodeError: a 200 from something that is
+        # not an extender (proxy error page, wrong port) exits cleanly too
         print(f"cannot reach extender at {url}: {e}", file=sys.stderr)
         return 1
 
@@ -84,7 +86,7 @@ def main(argv=None) -> int:
 
     try:
         metrics_text = fetch(url, "/metrics", args.timeout)
-    except OSError:
+    except (OSError, ValueError):
         metrics_text = ""  # render what we have; counters are optional
 
     print(f"extender {url}  nodes={len(state.get('nodes', []))}")
