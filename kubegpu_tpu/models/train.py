@@ -49,6 +49,22 @@ class TrainState(flax.struct.PyTreeNode):
         )
 
 
+def _make_init(model, tx):
+    def _init(rng, x):
+        variables = model.init(rng, x)
+        params = variables["params"]
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=variables.get("batch_stats", {}),
+            opt_state=tx.init(params),
+            apply_fn=model.apply,
+            tx=tx,
+        )
+
+    return _init
+
+
 def create_train_state(
     model, rng, sample_input, tx: Optional[optax.GradientTransformation] = None
 ) -> TrainState:
@@ -63,19 +79,18 @@ def create_train_state(
     # (Init with the SMALLEST batch that traces — param shapes are
     # batch-independent and the init program compiles ~2x faster at b1;
     # bench.py's cold probe relies on this.)
-    def _init(rng, x):
-        variables = model.init(rng, x)
-        params = variables["params"]
-        return TrainState(
-            step=jnp.zeros((), jnp.int32),
-            params=params,
-            batch_stats=variables.get("batch_stats", {}),
-            opt_state=tx.init(params),
-            apply_fn=model.apply,
-            tx=tx,
-        )
+    return jax.jit(_make_init(model, tx))(rng, sample_input)
 
-    return jax.jit(_init)(rng, sample_input)
+
+def train_state_template(
+    model, rng, sample_input, tx: Optional[optax.GradientTransformation] = None
+) -> TrainState:
+    """Abstract TrainState (ShapeDtypeStruct leaves — NO device memory,
+    no compile): the restore template for serving paths that load a
+    training checkpoint without ever materializing a fresh init or its
+    optimizer state on device (`worker --model decode`)."""
+    tx = tx or optax.sgd(0.1, momentum=0.9, nesterov=True)
+    return jax.eval_shape(_make_init(model, tx), rng, sample_input)
 
 
 def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:

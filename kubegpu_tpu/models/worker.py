@@ -26,6 +26,8 @@ SURVEY §2.2 stack, not just the DP sample:
     --model moe         MoE transformer, expert parallelism over
                         ("data", "expert")                          [--ep]
     --model pp          GPipe-pipelined LM over ("pipe",)           [--microbatches]
+    --model decode      SERVING: KV-cached greedy decode of the lm
+                        family's checkpoints (models/decoding.py)   [--prompt-len]
 
 Single-worker mode (JAX_NUM_PROCESSES absent or 1) skips the distributed
 rendezvous, so the same image serves BASELINE configs 2-5.
@@ -427,16 +429,118 @@ def _run_pp(args, t0: float) -> int:
     )
 
 
+def _run_decode(args, t0: float) -> int:
+    """Serving mode: KV-cached greedy decode (models/decoding.py) of the
+    lm family's param contract.  With --ckpt-dir it restores the TRAINED
+    lm checkpoint (written by `--model lm` under <dir>/lm; the decode
+    model shares its parameter names, so training output serves directly)
+    and serves it in bf16; without, it serves fresh init weights (a pure
+    throughput probe).  Single-chip by design: serving replicas scale out
+    as pods, the way the scheduler places them."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubegpu_tpu.models import TransformerLM, create_train_state
+    from kubegpu_tpu.models.decoding import greedy_generate
+    from kubegpu_tpu.models.train import train_state_template
+
+    max_seq = args.seq + 1  # the lm family trains seq+1 windows; pos_embed
+    # (and therefore any restored checkpoint) is sized to it
+    if args.prompt_len + args.steps > max_seq:
+        raise SystemExit(
+            f"--prompt-len {args.prompt_len} + --steps {args.steps} exceeds "
+            f"the cache size --seq+1 = {max_seq}"
+        )
+    model = TransformerLM(
+        vocab_size=args.vocab, num_layers=args.layers, num_heads=args.heads,
+        hidden=args.hidden, max_seq=max_seq,
+    )
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.ones((1, 8), jnp.int32)
+    params32 = None
+    if args.ckpt_dir:
+        from kubegpu_tpu.models.checkpoint import make_manager, restore_checkpoint
+
+        mgr = make_manager(os.path.join(os.path.abspath(args.ckpt_dir), "lm"))
+        # restore into an ABSTRACT template (eval_shape): serving must not
+        # pay a fresh init, and the checkpoint's params land directly.
+        # (The full state tree is restored — orbax's StandardRestore needs
+        # structural match, so the optimizer moments cost restore I/O they
+        # never serve; an export-for-serving step could halve that later.)
+        restored = restore_checkpoint(mgr, train_state_template(model, rng, sample))
+        if restored is not None:
+            params32 = restored.params
+            print(
+                f"RESTORED_FOR_SERVING step={int(jax.device_get(restored.step))}",
+                flush=True,
+            )
+            del restored  # drop step/moments promptly
+        else:
+            log.warning("no lm checkpoint under %s; serving fresh weights",
+                        args.ckpt_dir)
+    if params32 is None:
+        params32 = create_train_state(model, rng, sample).params
+    params = jax.tree.map(
+        lambda v: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v,
+        params32,
+    )
+    del params32
+
+    batch = args.batch_per_chip
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, args.prompt_len), 0, args.vocab, jnp.int32
+    )
+    fn = jax.jit(
+        lambda p, t: greedy_generate(
+            p, t, args.steps, vocab_size=args.vocab, num_layers=args.layers,
+            num_heads=args.heads, hidden=args.hidden, max_seq=max_seq,
+        )
+    )
+    out = fn(params, prompt)
+    int(out[0, -1])  # value readback forces the program
+    print(f"FIRST_DECODE_DONE seconds={time.monotonic() - t0:.2f}", flush=True)
+    n = 3
+    ts = time.monotonic()
+    for _ in range(n):
+        out = fn(params, prompt)
+    int(out[0, -1])
+    dt = (time.monotonic() - ts) / n
+    print(
+        f"DECODE_DONE tokens_per_sec={batch * args.steps / dt:.1f} "
+        f"ms_per_call={dt * 1e3:.1f}",
+        flush=True,
+    )
+    if args.serve:
+        # replica mode (samples/jax-decode.yaml): keep serving until the
+        # pod is deleted; one throughput line per report interval
+        calls = 0
+        ts = time.monotonic()
+        while True:
+            out = fn(params, prompt)
+            int(out[0, -1])
+            calls += 1
+            if calls % 50 == 0:
+                now = time.monotonic()
+                print(
+                    f"SERVING tokens_per_sec="
+                    f"{50 * batch * args.steps / (now - ts):.1f}",
+                    flush=True,
+                )
+                ts = now
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
         "--model",
         default="resnet50",
-        choices=list(RESNET_MODELS) + list(LM_MODELS) + ["pp"],
+        choices=list(RESNET_MODELS) + list(LM_MODELS) + ["pp", "decode"],
         help="resnet50 = scan-rolled flagship (fast compile); "
         "resnet50-unrolled = plain per-block variant; lm = TP+SP "
         "transformer; lm-cp = context-parallel LM (ring/ulysses); "
-        "moe = expert-parallel MoE; pp = GPipe-pipelined LM",
+        "moe = expert-parallel MoE; pp = GPipe-pipelined LM; "
+        "decode = KV-cached greedy serving of lm checkpoints",
     )
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--batch-per-chip", type=int, default=32)
@@ -477,6 +581,12 @@ def main(argv=None) -> int:
                     "divides the pipeline bubble by ~V)")
     ap.add_argument("--microbatches", type=int, default=4,
                     help="pp: microbatches per step (circular needs >= stages)")
+    ap.add_argument("--serve", action="store_true",
+                    help="decode: loop forever as a serving replica "
+                    "(default: benchmark a few calls and exit)")
+    ap.add_argument("--prompt-len", type=int, default=32,
+                    help="decode: prompt tokens per request (prompt-len + "
+                    "--steps must fit --seq + 1, the lm family's cache size)")
     ap.add_argument(
         "--ckpt-dir",
         default=os.environ.get("KUBEGPU_CKPT_DIR", ""),
@@ -535,6 +645,8 @@ def main(argv=None) -> int:
         return _run_resnet(args, t0)
     if args.model in LM_MODELS:
         return _run_lm_family(args, t0)
+    if args.model == "decode":
+        return _run_decode(args, t0)
     return _run_pp(args, t0)
 
 

@@ -63,6 +63,7 @@ def test_sample_dir_covers_all_graded_configs():
     assert sample_files() == [
         "cpu-pod.yaml",
         "four-chip.yaml",
+        "jax-decode.yaml",
         "jax-lm-cp.yaml",
         "jax-lm-tp.yaml",
         "jax-multislice.yaml",
@@ -174,6 +175,26 @@ def test_lm_sample_gang_schedules_with_worker_mode(fname, gang, expect_flag):
                         pod["metadata"].get("annotations") or {}, a.node)
     assert inj.env["JAX_NUM_PROCESSES"] == "4"
     assert f".{gang}.default.svc" in inj.env["JAX_COORDINATOR_ADDRESS"]
+
+
+def test_jax_decode_sample_schedules_and_maps_to_worker_serve_mode():
+    """The serving replica spec: schedules on one chip through the real
+    control plane, and its command is the worker's decode --serve mode
+    with a request that fits its own cache size."""
+    api, sched, _ = make_cluster()
+    pods = load_pods("jax-decode.yaml")
+    assert len(pods) == 1
+    assigned = schedule_all(api, sched, pods)
+    a = assigned["jax-decode"]
+    assert a is not None and len(a.all_chips()) == 1
+    cmd = pods[0]["spec"]["containers"][0]["command"]
+    assert "--model=decode" in cmd and "--serve" in cmd
+    flags = dict(
+        f.removeprefix("--").split("=", 1) for f in cmd if "=" in f
+    )
+    # prompt + steps must fit the cache (seq+1) or the worker exits
+    assert int(flags["prompt-len"]) + int(flags["steps"]) <= int(flags["seq"]) + 1
+    assert pods[0]["spec"]["restartPolicy"] == "Always"  # serving replica
 
 
 def test_multi_tenant_sample_both_gangs_fit():

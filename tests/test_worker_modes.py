@@ -111,3 +111,45 @@ def test_mesh_token_source_seeds_per_data_shard():
     mesh_tp = device_mesh({"data": 1, "model": 8})
     rep = next(synthetic_token_batches_for_mesh(2, 16, 97, mesh_tp))
     np.testing.assert_array_equal(rep, shards[0])
+
+
+@pytest.mark.exhaustive
+def test_train_then_serve_decode_restores_checkpoint(capsys, tmp_path):
+    """The training->serving handoff at the CLI surface: `--model lm`
+    trains and checkpoints; `--model decode` restores that checkpoint
+    (shared param contract) and serves KV-cached greedy decode."""
+    # run_worker appends TINY (which wins in argparse), so the checkpoint
+    # is written with TINY's shapes — the decode call must match them
+    run_worker(capsys, [
+        "--model", "lm", "--tp", "4",
+        "--ckpt-dir", str(tmp_path), "--ckpt-every", "2",
+    ])
+    rc = worker.main([
+        "--model", "decode", "--steps", "8", "--batch-per-chip", "2",
+        "--vocab", "128", "--layers", "1", "--heads", "8", "--hidden", "32",
+        "--seq", "64", "--prompt-len", "4", "--ckpt-dir", str(tmp_path),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "RESTORED_FOR_SERVING step=2" in out
+    assert "DECODE_DONE tokens_per_sec=" in out
+
+
+def test_decode_mode_serves_fresh_weights_without_ckpt(capsys):
+    rc = worker.main([
+        "--model", "decode", "--steps", "4", "--batch-per-chip", "2",
+        "--vocab", "64", "--layers", "1", "--heads", "2", "--hidden", "16",
+        "--seq", "16", "--prompt-len", "4",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "DECODE_DONE" in out and "RESTORED_FOR_SERVING" not in out
+
+
+def test_decode_rejects_oversized_request():
+    with pytest.raises(SystemExit):
+        worker.main([
+            "--model", "decode", "--steps", "64", "--seq", "16",
+            "--prompt-len", "4", "--vocab", "64", "--layers", "1",
+            "--heads", "2", "--hidden", "16",
+        ])
