@@ -89,6 +89,20 @@ def _consensus_size(sizes: List[int]) -> int:
     return min(s for s, c in counts.items() if c == top)
 
 
+def _consensus_str(values: List[str]) -> str:
+    """Most-common string, ties toward the lexicographically smaller —
+    the incarnation-id analog of _consensus_size (one member carrying a
+    stale pod-group-uid must not move which incarnation the gang is
+    judged as)."""
+    if not values:
+        return ""
+    counts: Dict[str, int] = {}
+    for v in values:
+        counts[v] = counts.get(v, 0) + 1
+    top = max(counts.values())
+    return min(v for v, c in counts.items() if c == top)
+
+
 class Scheduler:
     def __init__(
         self,
@@ -340,6 +354,9 @@ class Scheduler:
         )
         if decision is None or not decision.victims:
             return False
+        uid_by_key = {
+            k: u.uids.get(k) for u in decision.victims for k in u.pod_keys
+        }
         for u in decision.victims:
             if u.unit_id.startswith("gang:"):
                 self.groups.drop_plan(u.unit_id[len("gang:"):])
@@ -352,6 +369,7 @@ class Scheduler:
                     f"preempted by higher-priority {pod.key} "
                     f"(priority {pod.priority})"
                 ),
+                uid=uid_by_key.get(key),
             )
             evicted += 1
         self.metrics.inc("kubegpu_preemptions_total")
@@ -560,87 +578,109 @@ class Scheduler:
         gk = self.groups.group_key(pod)
         is_tpu_gang = self._is_tpu_gang(pod) and gk is not None
 
-        if plugin is None:
-            assignment = None  # plain bind, no device commitment
-        elif is_tpu_gang:
-            plan = self.groups.plan_for(pod)
-            if plan is not None and pod.key in plan.per_pod:
-                assignment = plan.per_pod[pod.key]
-            else:
-                # plan may have been dropped (fully committed) while the
-                # scheduler retries this bind: fall back to the live
-                # reservation
-                assignment = self.cache.assignment_of(key)
-                if assignment is None:
-                    return f"gang pod {key} has no live plan (re-run filter)"
-            if assignment.node != node_name:
-                return (
-                    f"gang plan places {key} on {assignment.node}, "
-                    f"but bind requested {node_name}"
-                )
-            # The plan's reservation can be GONE by now: a chip-death
-            # eviction of this (unbound) member released it between
-            # planning and bind.  Annotating without a live charge writes a
-            # durable claim on chips another pod may legitimately take —
-            # double-allocation (found by the gang-churn chaos soak).
-            # Re-acquire or refuse.  Mark mid-bind BEFORE the check: a
-            # concurrent drop_plan landing between the check and the mark
-            # could otherwise forget the very reservation the durable
-            # commit below relies on (TOCTOU).
+        # Gang pods are marked mid-bind for the WHOLE verb — from before
+        # the reservation check through the durable commit: a concurrent
+        # drop_plan (reconcile, sibling's bind failure) must not forget a
+        # reservation this bind is about to rely on (TOCTOU), and an
+        # unexpected exception ANYWHERE in between must not leave the key
+        # marked forever (which would shield its reservation from
+        # drop_plan/expiry and leak its chips until GET-confirmed
+        # divergence).  Hence one try/finally around everything from the
+        # first mark.
+        if is_tpu_gang:
             self.groups.mark_binding(key)
-            reacquire_err = None
-            with self.cache.lock:
-                if self.cache.assignment_of(key) is None:
+        try:
+            if plugin is None:
+                assignment = None  # plain bind, no device commitment
+            elif is_tpu_gang:
+                plan = self.groups.plan_for(pod)
+                if plan is not None and pod.key in plan.per_pod:
+                    assignment = plan.per_pod[pod.key]
+                else:
+                    # plan may have been dropped (fully committed) while the
+                    # scheduler retries this bind: fall back to the live
+                    # reservation
+                    assignment = self.cache.assignment_of(key)
+                    if assignment is None:
+                        return f"gang pod {key} has no live plan (re-run filter)"
+                if assignment.node != node_name:
+                    # Mid-bind marking cuts both ways: a drop_plan/expiry
+                    # racing this verb skipped the marked key when it freed
+                    # the plan's other reservations.  If the plan is gone
+                    # NOW and the reservation is still merely assumed,
+                    # nothing else will ever free it (no plan owner, no
+                    # TTL) — forget it before erroring out, or the chips
+                    # stay charged until GET-confirmed divergence.  A
+                    # still-live plan keeps ownership: its expiry/drop
+                    # frees the key once the finally below unmarks it.
+                    if (
+                        self.groups.plan_for(pod) is None
+                        and key in self.cache.assumed_keys()
+                    ):
+                        self.cache.forget(key)
+                    return (
+                        f"gang plan places {key} on {assignment.node}, "
+                        f"but bind requested {node_name}"
+                    )
+                # The plan's reservation can be GONE by now: a chip-death
+                # eviction of this (unbound) member released it between
+                # planning and bind.  Annotating without a live charge
+                # writes a durable claim on chips another pod may
+                # legitimately take — double-allocation (found by the
+                # gang-churn chaos soak).  Re-acquire or refuse.  (The key
+                # is already marked mid-bind, so a drop_plan landing
+                # between this check and the commit cannot forget the
+                # reservation.)
+                reacquire_err = None
+                with self.cache.lock:
+                    if self.cache.assignment_of(key) is None:
+                        try:
+                            self.cache.assume(key, assignment)
+                            reserved_here = True
+                        except (ValueError, KeyError) as e:
+                            reacquire_err = e
+                if reacquire_err is not None:
+                    self.metrics.inc("kubegpu_bind_conflicts_total")
+                    # the plan is UNEXECUTABLE — its chips are durably held
+                    # elsewhere.  Drop it now: a live plan shields the gang
+                    # from both re-planning and the stranded sweep, so
+                    # keeping it would wedge the gang until plan-TTL expiry
+                    # (found by the chaos soak).  Called OUTSIDE the cache
+                    # lock: drop_plan takes groups-lock-then-cache-lock,
+                    # and taking it under the cache lock would be the
+                    # reverse order of every other path (ABBA deadlock).
+                    # The finally below unmarks mid-bind AFTER this drop,
+                    # so the drop itself cannot free the reservation — the
+                    # planless-forget in the commit-failure path never
+                    # applies here (nothing was durably written).
+                    self.groups.drop_plan(gk)
+                    return (
+                        f"gang reservation for {key} was released and "
+                        f"cannot be reacquired (plan dropped, re-run "
+                        f"filter): {reacquire_err}"
+                    )
+            else:
+                with self.cache.lock:
+                    node = self.cache.node(node_name)
+                    if node is None:
+                        return f"unknown node {node_name}"
+                    view = self.cache.views().get(node.slice_id) if node.slice_id else None
+                    fit = plugin.fit(node, pod, view)
+                    if not fit.fits:
+                        self.metrics.inc("kubegpu_bind_conflicts_total")
+                        return f"no longer fits on {node_name}: {fit.reason}"
+                    assignment = fit.assignment
                     try:
                         self.cache.assume(key, assignment)
                         reserved_here = True
                     except (ValueError, KeyError) as e:
-                        reacquire_err = e
-            if reacquire_err is not None:
-                self.groups.unmark_binding(key)
-                self.metrics.inc("kubegpu_bind_conflicts_total")
-                # the plan is UNEXECUTABLE — its chips are durably held
-                # elsewhere.  Drop it now: a live plan shields the gang
-                # from both re-planning and the stranded sweep, so keeping
-                # it would wedge the gang until plan-TTL expiry (found by
-                # the chaos soak).  Called OUTSIDE the cache lock:
-                # drop_plan takes groups-lock-then-cache-lock, and taking
-                # it under the cache lock would be the reverse order of
-                # every other path (ABBA deadlock).
-                self.groups.drop_plan(gk)
-                return (
-                    f"gang reservation for {key} was released and "
-                    f"cannot be reacquired (plan dropped, re-run "
-                    f"filter): {reacquire_err}"
-                )
-        else:
-            with self.cache.lock:
-                node = self.cache.node(node_name)
-                if node is None:
-                    return f"unknown node {node_name}"
-                view = self.cache.views().get(node.slice_id) if node.slice_id else None
-                fit = plugin.fit(node, pod, view)
-                if not fit.fits:
-                    self.metrics.inc("kubegpu_bind_conflicts_total")
-                    return f"no longer fits on {node_name}: {fit.reason}"
-                assignment = fit.assignment
-                try:
-                    self.cache.assume(key, assignment)
-                    reserved_here = True
-                except (ValueError, KeyError) as e:
-                    self.metrics.inc("kubegpu_bind_conflicts_total")
-                    return f"reservation race on {node_name}: {e}"
+                        self.metrics.inc("kubegpu_bind_conflicts_total")
+                        return f"reservation race on {node_name}: {e}"
 
-        # durable commit: assignment annotation first, then the binding —
-        # a crash between the two leaves an annotated-unbound pod that
-        # refresh() replays correctly (state lives in the API server).
-        # Gang pods are marked mid-bind for the duration (set above,
-        # idempotent here): a concurrent drop_plan (reconcile, sibling's
-        # bind failure) must not forget a reservation whose durable
-        # annotation is landing right now.
-        if is_tpu_gang:
-            self.groups.mark_binding(key)
-        try:
+            # durable commit: assignment annotation first, then the
+            # binding — a crash between the two leaves an annotated-unbound
+            # pod that refresh() replays correctly (state lives in the API
+            # server).
             try:
                 if assignment is not None:
                     self.api.patch_pod_annotations(
@@ -889,15 +929,19 @@ class Scheduler:
                 continue
             gk = f"{p.namespace}/{p.pod_group}"
             g = gangs.setdefault(
-                gk, {"sizes": [], "bound": [], "releasable": []}
+                gk, {"sizes": [], "bound": [], "releasable": [], "live": 0,
+                     "uids": {}, "incarnations": []}
             )
+            g["uids"][p.key] = p.uid
             if p.phase == "Succeeded":
                 # remembered in the registry (shared with the planner, so
                 # sweep denominator and re-plan requirement never diverge)
                 # because a TTL controller may GC the pod before the next
                 # resync — and a vanished Succeeded member must KEEP
-                # shrinking the denominator
-                self.groups.note_done(gk, p.key)
+                # shrinking the denominator.  Scoped to the member's own
+                # incarnation: an old run's completions never shrink a new
+                # run's denominator.
+                self.groups.note_done(gk, p.key, p.pod_group_uid)
                 continue
             # a name reused by a live recreation must not double-count
             # (once as bound, once as remembered-done)
@@ -909,6 +953,8 @@ class Scheduler:
                 ):
                     g["releasable"].append(p.key)
                 continue
+            g["live"] += 1
+            g["incarnations"].append(p.pod_group_uid)
             if p.node_name:
                 g["bound"].append(p.key)
         # forget completed-member memory for gangs no longer listed at all
@@ -916,10 +962,33 @@ class Scheduler:
         # the name must start clean
         self.groups.prune_done(gangs)
         stranded = {}
+        outstanding = {}
         for gk, g in gangs.items():
-            size = _consensus_size(g["sizes"]) - self.groups.done_count(gk)
+            # shared formula with the planner (gang_arithmetic), judged
+            # against the LIVE members' incarnation (consensus, like the
+            # size): an old run's remembered completions must not shrink a
+            # new run's denominator
+            inc = _consensus_str(g["incarnations"])
+            size, suspect = self.groups.gang_arithmetic(
+                gk, _consensus_size(g["sizes"]), g["live"], inc
+            )
+            if suspect:
+                # over-subscribed arithmetic (gang name reused without
+                # pod-group-uid, or stray extra members): whose gang the
+                # bound members belong to is ambiguous, and rollback
+                # DELETES running pods — decline to judge.  The planner's
+                # full-size fallback still lets the new run form; worst
+                # case is a capacity leak an operator can see, never a
+                # healthy gang destroyed.
+                log.warning(
+                    "gang %s: completed-member arithmetic over-subscribed "
+                    "(name reused without %s?); skipping stranded-gang "
+                    "judgment", gk, annotations.POD_GROUP_UID,
+                )
+                continue
             if 0 < len(g["bound"]) < size:
                 stranded[gk] = tuple(sorted(g["bound"]))
+                outstanding[gk] = size
         self._stranded_strikes = {
             k: v for k, v in self._stranded_strikes.items() if k in stranded
         }
@@ -945,16 +1014,14 @@ class Scheduler:
                         "resyncs without progress; rolling back so the "
                         "whole gang can re-admit atomically"
                     ),
+                    uid=gangs[gk]["uids"].get(key),
                 )
             self.metrics.inc("kubegpu_stranded_gang_rollbacks_total")
             log.warning(
                 "rolled back incomplete gang %s (%d bound of %d outstanding "
                 "for %d consecutive resyncs without progress): freeing its "
                 "chips so the whole gang can re-admit atomically",
-                gk, len(bound),
-                _consensus_size(gangs[gk]["sizes"])
-                - self.groups.done_count(gk),
-                strikes,
+                gk, len(bound), outstanding[gk], strikes,
             )
 
     def on_pod_deleted(self, pod_obj: dict) -> None:
@@ -990,37 +1057,50 @@ class Scheduler:
                 self._evict_on_dead_chips(node_obj)
 
     def _evict_pod(
-        self, key: str, reason: str = "Evicted", message: str = ""
+        self,
+        key: str,
+        reason: str = "Evicted",
+        message: str = "",
+        uid: Optional[str] = None,
     ) -> None:
         """The one eviction sequence (preemption AND health eviction):
         clear the assignment annotation BEFORE deleting — a victim
         lingering in Terminating (graceful deletion on a real cluster)
         must not be replayed by the next cache refresh onto chips a new
         placement may own — then delete and release the cache entry.
-        The eviction is announced as a Warning Event first: deletion is
-        the last thing an operator can ask the pod about — and kubectl
-        describe matches events by involvedObject.uid, so the uid is
-        fetched (one GET; evictions are rare) rather than left empty."""
+        The Warning Event (kubectl describe matches it by
+        involvedObject.uid) is emitted AFTER the delete call so it records
+        what actually happened — a failed or moot delete must not leave a
+        record claiming the pod was evicted.  Callers that already hold
+        the uid (preemption victims, sweep-parsed pods) thread it in;
+        otherwise one GET fetches it before the pod vanishes (evictions
+        are rare)."""
         ns, name = key.split("/", 1)
-        try:
-            uid = (self.api.get_pod(ns, name).get("metadata") or {}).get("uid", "")
-        except Exception:  # noqa: BLE001 - already gone / transient
-            uid = ""
-        self.events.pod_event(
-            ns, name, reason, message or "evicted by kubegpu-tpu-scheduler",
-            type_="Warning", uid=uid,
-        )
+        if uid is None:
+            try:
+                uid = (self.api.get_pod(ns, name).get("metadata") or {}).get(
+                    "uid", ""
+                )
+            except Exception:  # noqa: BLE001 - already gone / transient
+                uid = ""
         try:
             self.api.patch_pod_annotations(
                 ns, name, {annotations.POD_ASSIGNMENT: ""}
             )
         except (NotFound, OSError):
             pass
+        deleted = True
         try:
             self.api.delete_pod(ns, name)
         except NotFound:
-            pass
+            deleted = False  # already gone: nothing evicted, no record owed
         self.cache.remove_pod(key)
+        if deleted:
+            self.events.pod_event(
+                ns, name, reason,
+                message or "evicted by kubegpu-tpu-scheduler",
+                type_="Warning", uid=uid,
+            )
 
     def _evict_on_dead_chips(self, node_obj: dict, host_refs=None) -> None:
         """Failure detection → elastic recovery (SURVEY.md §5.3): when the

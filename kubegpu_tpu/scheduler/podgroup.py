@@ -16,7 +16,7 @@ import logging
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 from kubegpu_tpu.grpalloc import fit_gang_multislice
 from kubegpu_tpu.grpalloc.multislice import fit_gang_into_layout
@@ -65,31 +65,68 @@ class PodGroupRegistry:
         # the chips under it would let another pod double-claim them for
         # a conflict-sweep-length window
         self._binding: Set[str] = set()
-        # gang key -> member keys ever seen Succeeded.  Completed members
-        # owe no replacement, so they shrink BOTH the planner's "all
-        # members created" requirement and the stranded sweep's
-        # denominator — and the memory must survive their GC deletion
-        # (a TTL controller removes them between LISTs).  One shared
-        # record keeps planner and sweep from ever disagreeing on gang
-        # arithmetic.  (Lost on scheduler restart; the no-progress grace
-        # window is the remaining protection then.)
-        self._done: Dict[str, Set[str]] = {}
+        # gang key -> incarnation id -> member keys ever seen Succeeded.
+        # Completed members owe no replacement, so they shrink BOTH the
+        # planner's "all members created" requirement and the stranded
+        # sweep's denominator — and the memory must survive their GC
+        # deletion (a TTL controller removes them between LISTs).  One
+        # shared record keeps planner and sweep from ever disagreeing on
+        # gang arithmetic.  Scoped by the POD_GROUP_UID annotation
+        # (incarnation id, e.g. the owning Job's UID; "" when unset): a
+        # NEW run reusing a gang name starts clean instead of inheriting
+        # the old run's done count (ADVICE r3 medium — inherited memory
+        # pinned outstanding at 0 and wedged the gang until restart).
+        # (Lost on scheduler restart; the no-progress grace window is the
+        # remaining protection then.)
+        self._done: Dict[str, Dict[str, Set[str]]] = {}
 
     # -- completed-member memory ------------------------------------------
-    def note_done(self, gk: str, member_key: str) -> None:
+    def note_done(self, gk: str, member_key: str, incarnation: str = "") -> None:
         with self._lock:
-            self._done.setdefault(gk, set()).add(member_key)
+            self._done.setdefault(gk, {}).setdefault(incarnation, set()).add(
+                member_key
+            )
 
     def note_live(self, gk: str, member_key: str) -> None:
         """A live pod reusing a remembered name must not double-count
-        (once live, once as remembered-done)."""
+        (once live, once as remembered-done) — in ANY incarnation: a
+        recreated name supersedes every older memory of it."""
         with self._lock:
-            if gk in self._done:
-                self._done[gk].discard(member_key)
+            for members in self._done.get(gk, {}).values():
+                members.discard(member_key)
 
-    def done_count(self, gk: str) -> int:
+    def done_count(self, gk: str, incarnation: str = "") -> int:
         with self._lock:
-            return len(self._done.get(gk, ()))
+            return len(self._done.get(gk, {}).get(incarnation, ()))
+
+    def gang_arithmetic(
+        self, gk: str, size: int, n_live: int, incarnation: str = ""
+    ) -> Tuple[int, bool]:
+        """(outstanding, suspect) — the ONE formula the planner
+        (try_plan/planned_members) and the stranded-gang sweep share, so
+        their gang arithmetic can never diverge: outstanding = the
+        declared size minus every member of THIS incarnation remembered
+        Succeeded (work done, no replacement owed).
+
+        `suspect` flags over-subscription: MORE live (non-terminal)
+        members than the arithmetic leaves room for.  With incarnation
+        ids that only happens in pathological flows; without them it is
+        the signature of a gang name reused by a new run while the old
+        run's Succeeded pods are still listed or remembered — the done
+        memory belongs to the old run, and judging the new one by it
+        would pin outstanding at 0 and make _select_members reject every
+        member forever (the gang wedges until scheduler restart).  On
+        suspicion the planner falls back to the FULL declared size (a
+        forming run must WAIT for all members, never plan a premature
+        sub-gang), and the sweep declines to roll anything back (the
+        arithmetic is ambiguous; deleting running pods on ambiguity is
+        the one unacceptable direction)."""
+        done = self.done_count(gk, incarnation)
+        out = size - done
+        suspect = n_live > out
+        if suspect:
+            out = size
+        return out, suspect
 
     def prune_done(self, live_gangs) -> None:
         """Forget gangs no longer listed at all (fully GC'd): nothing is
@@ -240,10 +277,17 @@ class PodGroupRegistry:
             if existing:
                 return PlanOutcome(plan=existing)
             # Succeeded members owe no replacement: the outstanding size is
-            # the declared size minus every member ever seen Succeeded —
-            # matching the stranded sweep's denominator, so a gang the
-            # sweep judges healthy can always re-plan its remainder.
-            outstanding = pod.pod_group_size - self.done_count(gk)
+            # the declared size minus every member of this incarnation ever
+            # seen Succeeded — matching the stranded sweep's denominator,
+            # so a gang the sweep judges healthy can always re-plan its
+            # remainder.  (gang_arithmetic also guards gang-name reuse by
+            # a new run, which would otherwise wedge at outstanding=0.)
+            outstanding, _ = self.gang_arithmetic(
+                gk,
+                pod.pod_group_size,
+                len(pending) + len(scheduled),
+                pod.pod_group_uid,
+            )
             if len(pending) + len(scheduled) < outstanding:
                 return PlanOutcome(
                     reason=(
@@ -382,7 +426,12 @@ class PodGroupRegistry:
         """The member set try_plan would plan for this pod right now (used
         by preemption simulation so it can never diverge from planning)."""
         pending, scheduled, _, _ = self._gather_members(pod)
-        outstanding = pod.pod_group_size - self.done_count(self.group_key(pod))
+        outstanding, _ = self.gang_arithmetic(
+            self.group_key(pod),
+            pod.pod_group_size,
+            len(pending) + len(scheduled),
+            pod.pod_group_uid,
+        )
         if len(pending) + len(scheduled) < outstanding:
             return None
         return self._select_members(pod, pending, scheduled, outstanding)
@@ -428,7 +477,9 @@ class PodGroupRegistry:
                 # additionally shrinks the outstanding size (work done, no
                 # replacement owed); Failed still owes one.
                 if p.phase == "Succeeded":
-                    self.note_done(self.group_key(pod), p.key)
+                    self.note_done(
+                        self.group_key(pod), p.key, p.pod_group_uid
+                    )
                 continue
             self.note_live(self.group_key(pod), p.key)
             seen[p.key] = p

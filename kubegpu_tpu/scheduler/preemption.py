@@ -38,6 +38,9 @@ class VictimUnit:
     priority: int
     pod_keys: List[str] = field(default_factory=list)
     coords_by_slice: Dict[str, Set[Tuple[int, ...]]] = field(default_factory=dict)
+    # pod key -> uid, threaded through to eviction events so they attach
+    # without a per-victim GET round-trip
+    uids: Dict[str, str] = field(default_factory=dict)
 
     @property
     def total_chips(self) -> int:
@@ -77,6 +80,7 @@ def collect_units(pods_raw: Sequence[dict], assignments: Dict[str, Assignment]) 
         # a unit is as valuable as its most valuable member
         u.priority = max(u.priority, pod.priority)
         u.pod_keys.append(pod.key)
+        u.uids[pod.key] = pod.uid
         if a.slice_id:
             u.coords_by_slice.setdefault(a.slice_id, set()).update(
                 c.coords for c in a.all_chips()

@@ -31,6 +31,15 @@ NODE_GROUPED_CAPACITY = f"{PREFIX}/grouped-capacity"
 # Pod side (written by users / controllers).
 POD_GROUP = f"{PREFIX}/pod-group"               # gang name
 POD_GROUP_SIZE = f"{PREFIX}/pod-group-size"     # gang cardinality
+POD_GROUP_UID = f"{PREFIX}/pod-group-uid"       # gang incarnation id (e.g.
+                                                # the owning Job's UID).
+                                                # Optional but recommended:
+                                                # scopes completed-member
+                                                # memory, so a NEW run
+                                                # reusing a gang name starts
+                                                # its arithmetic clean even
+                                                # while the old run's
+                                                # Succeeded pods linger
 POD_CONTIGUOUS = f"{PREFIX}/contiguous"         # "true"/"false", default true
 POD_PRIORITY = f"{PREFIX}/priority"             # int, for preemption
 POD_MULTISLICE = f"{PREFIX}/multislice"         # "true" lets a gang span
@@ -174,6 +183,7 @@ def pod_from_k8s(obj: dict, strict: bool = True) -> PodInfo:
         deletion_timestamp=meta.get("deletionTimestamp"),
     )
     pod.pod_group = ann.get(POD_GROUP)
+    pod.pod_group_uid = ann.get(POD_GROUP_UID, "")
     try:
         pod.pod_group_size = int(ann.get(POD_GROUP_SIZE, "1"))
     except ValueError:

@@ -105,6 +105,9 @@ class PodInfo:
     # Gang metadata (parsed from annotations by scheduler.podgroup).
     pod_group: Optional[str] = None
     pod_group_size: int = 1
+    # gang incarnation id (POD_GROUP_UID annotation, e.g. the owning Job's
+    # UID); "" when unset — scopes completed-member memory per incarnation
+    pod_group_uid: str = ""
     require_contiguous: bool = True
     # opt-in: the gang may span DCN-connected slices when no single slice
     # fits it (grpalloc.multislice)

@@ -26,6 +26,25 @@ from typing import Callable, Dict, List, Optional
 log = logging.getLogger(__name__)
 
 
+def _response_socket(resp) -> Optional[socket.socket]:
+    """The underlying socket of an http.client.HTTPResponse, or None.
+
+    The chain (resp.fp buffered reader → .raw SocketIO → ._sock) is a
+    CPython implementation detail; resolved defensively via getattr and
+    type-checked so an interpreter upgrade that breaks it returns None
+    instead of raising.  test_apiserver pins this helper against a LIVE
+    response so a broken chain fails the suite loudly — close_watches'
+    prompt-shutdown guarantee (shutdown(SHUT_RDWR) is what wakes a reader
+    blocked in recv; plain close() does not) must never silently degrade."""
+    raw = getattr(getattr(resp, "fp", None), "raw", None)
+    if raw is None and isinstance(getattr(resp, "fp", None), socket.SocketIO):
+        raw = resp.fp  # unbuffered response (rare, but cheap to cover)
+    if not isinstance(raw, socket.SocketIO):
+        return None
+    sock = getattr(raw, "_sock", None)
+    return sock if isinstance(sock, (socket.socket, ssl.SSLSocket)) else None
+
+
 class Conflict(Exception):
     """Optimistic-concurrency conflict (e.g. binding an already-bound pod)."""
 
@@ -74,6 +93,19 @@ class ApiServer:
     def create_event(self, obj: dict) -> None:
         raise NotImplementedError
 
+    # coordination.k8s.io/v1 Leases (leader election).  update_lease is a
+    # full PUT carrying the read object's resourceVersion: the API server's
+    # optimistic concurrency turns it into compare-and-swap (Conflict on a
+    # stale version) — the primitive leader election is built on.
+    def get_lease(self, namespace: str, name: str) -> dict:
+        raise NotImplementedError
+
+    def create_lease(self, obj: dict) -> dict:
+        raise NotImplementedError
+
+    def update_lease(self, namespace: str, name: str, obj: dict) -> dict:
+        raise NotImplementedError
+
     # watches
     def watch_nodes(self, handler: Callable[[str, dict], None],
                     stop, timeout_s: int = 30) -> None:
@@ -104,6 +136,7 @@ class InMemoryApiServer(ApiServer):
         self._nodes: Dict[str, dict] = {}
         self._pods: Dict[str, dict] = {}
         self._events: List[dict] = []
+        self._leases: Dict[str, dict] = {}
         self._observers: List[Callable[[str, dict], None]] = []
 
     # -- helpers ----------------------------------------------------------
@@ -217,6 +250,45 @@ class InMemoryApiServer(ApiServer):
     def create_event(self, obj: dict) -> None:
         with self._lock:
             self._events.append(copy.deepcopy(obj))
+
+    # -- leases (optimistic-concurrency semantics of the real API server) --
+    def get_lease(self, namespace: str, name: str) -> dict:
+        with self._lock:
+            k = self._key(namespace, name)
+            if k not in self._leases:
+                raise NotFound(f"lease {k}")
+            return copy.deepcopy(self._leases[k])
+
+    def create_lease(self, obj: dict) -> dict:
+        with self._lock:
+            meta = obj.setdefault("metadata", {})
+            meta.setdefault("namespace", "default")
+            k = self._key(meta["namespace"], meta.get("name", ""))
+            if k in self._leases:
+                raise Conflict(f"lease {k} exists")
+            stored = copy.deepcopy(obj)
+            stored["metadata"]["resourceVersion"] = "1"
+            self._leases[k] = stored
+            return copy.deepcopy(stored)
+
+    def update_lease(self, namespace: str, name: str, obj: dict) -> dict:
+        with self._lock:
+            k = self._key(namespace, name)
+            if k not in self._leases:
+                raise NotFound(f"lease {k}")
+            current_rv = self._leases[k]["metadata"].get("resourceVersion")
+            sent_rv = (obj.get("metadata") or {}).get("resourceVersion")
+            if sent_rv != current_rv:
+                # the CAS the whole election rests on: a racing writer's
+                # stale read loses with Conflict, exactly like a real API
+                # server's optimistic concurrency
+                raise Conflict(
+                    f"lease {k}: resourceVersion {sent_rv} != {current_rv}"
+                )
+            stored = copy.deepcopy(obj)
+            stored["metadata"]["resourceVersion"] = str(int(current_rv) + 1)
+            self._leases[k] = stored
+            return copy.deepcopy(stored)
 
     def list_events(self, namespace: Optional[str] = None) -> List[dict]:
         with self._lock:
@@ -366,6 +438,21 @@ class KubeApiServer(ApiServer):
         ns = obj.get("metadata", {}).get("namespace", "default")
         self._req("POST", f"/api/v1/namespaces/{ns}/events", obj)
 
+    LEASES = "/apis/coordination.k8s.io/v1/namespaces"
+
+    def get_lease(self, namespace: str, name: str) -> dict:
+        return self._req("GET", f"{self.LEASES}/{namespace}/leases/{name}")
+
+    def create_lease(self, obj: dict) -> dict:
+        ns = obj.get("metadata", {}).get("namespace", "default")
+        return self._req("POST", f"{self.LEASES}/{ns}/leases", obj)
+
+    def update_lease(self, namespace: str, name: str, obj: dict) -> dict:
+        # full PUT with the read resourceVersion: the API server CAS-es it
+        return self._req(
+            "PUT", f"{self.LEASES}/{namespace}/leases/{name}", obj
+        )
+
     def bind_pod(self, namespace: str, name: str, node: str) -> None:
         self._req(
             "POST",
@@ -422,9 +509,9 @@ class KubeApiServer(ApiServer):
             conns = list(self._watch_conns)
         for resp in conns:
             try:
-                # http.client.HTTPResponse → fp (buffered) → raw SocketIO
-                sock = resp.fp.raw._sock  # noqa: SLF001
-                sock.shutdown(socket.SHUT_RDWR)
+                sock = _response_socket(resp)
+                if sock is not None:
+                    sock.shutdown(socket.SHUT_RDWR)
             except Exception:  # noqa: BLE001 - already closed/racing
                 pass
             try:

@@ -62,12 +62,17 @@ class EventRecorder:
         # name suffix = wall-clock nanoseconds (kube-scheduler's own
         # convention): unique across restarts and HA replicas, where a
         # resettable counter would collide with a live same-named event
-        # and the 409 would silently swallow the new emission
+        # and the 409 would silently swallow the new emission.  The pod-
+        # name prefix is truncated so the whole event name stays within
+        # the DNS-1123 subdomain limit (253) — a real API server 422s
+        # over-long names and the best-effort swallow would silently drop
+        # the record exactly for long-named pods.
+        suffix = f".{time.time_ns():x}"
         obj = {
             "apiVersion": "v1",
             "kind": "Event",
             "metadata": {
-                "name": f"{name}.{time.time_ns():x}",
+                "name": f"{name[: 253 - len(suffix)]}{suffix}",
                 "namespace": namespace,
             },
             "involvedObject": {

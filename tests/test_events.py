@@ -136,3 +136,21 @@ def test_dedup_expires_and_reemits():
     rec2.pod_event("default", "q", "R", "m")
     assert len([e for e in api.list_events()
                 if e["involvedObject"]["name"] == "q"]) == 1
+
+
+def test_long_pod_name_event_stays_within_dns1123():
+    """ADVICE r3 low: event names are pod name + nanosecond suffix; for
+    pod names near the 253-char DNS-1123 limit the suffix pushed the name
+    over and a real API server 422s — silently dropping the record
+    exactly for long-named pods.  The prefix is truncated instead."""
+    api = InMemoryApiServer()
+    rec = EventRecorder(api)
+    long_name = "p" * 253  # at the subdomain limit already
+    rec.pod_event("default", long_name, "Tested", "msg", uid="u1")
+    events = api.list_events()
+    assert len(events) == 1
+    ev_name = events[0]["metadata"]["name"]
+    assert len(ev_name) <= 253
+    # still unique-suffixed and still attributable to the pod
+    assert "." in ev_name and ev_name.startswith("p" * 100)
+    assert events[0]["involvedObject"]["name"] == long_name

@@ -29,11 +29,13 @@ def make_sched(api, **kw) -> Scheduler:
     return s
 
 
-def pod_obj(name, chips, ns="default", group=None, group_size=None, contiguous=True, uid=None):
+def pod_obj(name, chips, ns="default", group=None, group_size=None, contiguous=True, uid=None, group_uid=None):
     ann = {}
     if group:
         ann[annotations.POD_GROUP] = group
         ann[annotations.POD_GROUP_SIZE] = str(group_size or 1)
+        if group_uid:
+            ann[annotations.POD_GROUP_UID] = group_uid
     if not contiguous:
         ann[annotations.POD_CONTIGUOUS] = "false"
     return {
@@ -1098,3 +1100,131 @@ def test_status_render_slice_3d():
     ]
     assert map_rows and all("x" not in ln for ln in map_rows), map_rows
     assert sum(ln.count("#") for ln in map_rows) == 2  # exactly the used pair
+
+
+def test_gang_name_reuse_after_success_not_wedged():
+    """ADVICE r3 medium: a NEW generation of pods created under a reused
+    gang name, while the previous generation's Succeeded pods are still
+    listed, must schedule.  Remembered-done arithmetic would otherwise pin
+    outstanding at 0 and _select_members would reject every new member —
+    the gang permanently unschedulable until scheduler restart."""
+    api, _, _ = fake_cluster()
+    sched = make_sched(api, stranded_grace=2)
+    bind_gang(api, sched, "job", ["gen1-a", "gen1-b"])
+    set_pod_status(api, "gen1-a", phase="Succeeded")
+    set_pod_status(api, "gen1-b", phase="Succeeded")
+    sched.resync()  # the sweep remembers both members Succeeded
+    assert sched.groups.done_count("default/job") == 2
+    # second generation reuses the gang name with fresh pod names
+    objs = [pod_obj(f"gen2-{s}", 2, group="job", group_size=2) for s in "ab"]
+    for o in objs:
+        api.create_pod(o)
+    for o in objs:
+        name = o["metadata"]["name"]
+        r = sched.filter(o, nodes_of(api))
+        assert r.nodes, (name, r.failed)
+        assert sched.bind("default", name, r.nodes[0]) is None
+    # and the sweep judges the new generation healthy (no rollback)
+    for _ in range(4):
+        sched.resync()
+    api.get_pod("default", "gen2-a")
+    api.get_pod("default", "gen2-b")
+    assert sched.metrics.get("kubegpu_stranded_gang_rollbacks_total") in (0, None)
+
+
+def bind_gang_uid(api, sched, group, names, group_uid, chips=2):
+    for name in names:
+        api.create_pod(pod_obj(name, chips, group=group,
+                               group_size=len(names), group_uid=group_uid))
+    for name in names:
+        obj = api.get_pod("default", name)
+        r = sched.filter(obj, nodes_of(api))
+        assert r.nodes, (name, r.failed)
+        assert sched.bind("default", name, r.nodes[0]) is None
+
+
+def test_gang_name_reuse_with_uid_new_run_can_still_strand():
+    """Incarnation ids (pod-group-uid) make reuse unambiguous: a new run
+    that binds one member and loses the other is judged against the full
+    size — the old run's completions never shrink its denominator — and
+    still rolls back after stranded_grace no-progress resyncs."""
+    api, _, _ = fake_cluster()
+    sched = make_sched(api, stranded_grace=2)
+    bind_gang_uid(api, sched, "rg", ["r1-a", "r1-b"], group_uid="run-1")
+    set_pod_status(api, "r1-a", phase="Succeeded")
+    set_pod_status(api, "r1-b", phase="Succeeded")
+    sched.resync()
+    assert sched.groups.done_count("default/rg", "run-1") == 2
+    bind_gang_uid(api, sched, "rg", ["r2-a", "r2-b"], group_uid="run-2")
+    # r2-b vanishes hard (missed DELETED event): 1/2 bound, no plan
+    api.delete_pod("default", "r2-b")
+    sched.cache.remove_pod("default/r2-b")
+    for _ in range(3):
+        sched.resync()
+    assert sched.metrics.get("kubegpu_stranded_gang_rollbacks_total") == 1
+
+
+def test_reused_name_partial_success_not_rolled_back():
+    """Code-review r4 regression: a reused-name gang whose NEW run
+    partially succeeds (one member done, one still running) must not be
+    judged stranded — neither with incarnation ids (done memory scoped
+    per run) nor without (arithmetic ambiguous -> sweep declines)."""
+    for uids in (("run-1", "run-2"), (None, None)):
+        api, _, _ = fake_cluster()
+        sched = make_sched(api, stranded_grace=2)
+        if uids[0]:
+            bind_gang_uid(api, sched, "pr", ["p1-a", "p1-b"], group_uid=uids[0])
+        else:
+            bind_gang(api, sched, "pr", ["p1-a", "p1-b"])
+        set_pod_status(api, "p1-a", phase="Succeeded")
+        set_pod_status(api, "p1-b", phase="Succeeded")
+        sched.resync()
+        if uids[1]:
+            bind_gang_uid(api, sched, "pr", ["p2-a", "p2-b"], group_uid=uids[1])
+        else:
+            bind_gang(api, sched, "pr", ["p2-a", "p2-b"])
+        # the new run's first member completes; its sibling keeps running
+        set_pod_status(api, "p2-a", phase="Succeeded")
+        for _ in range(4):
+            sched.resync()
+        api.get_pod("default", "p2-b")  # survivor untouched
+        assert sched.metrics.get(
+            "kubegpu_stranded_gang_rollbacks_total"
+        ) in (0, None), f"false rollback with uids={uids}"
+
+
+def test_wrong_node_bind_with_racing_drop_plan_frees_reservation():
+    """Code-review r4 regression: bind marks the key mid-bind for the
+    whole verb, so a drop_plan racing it skips the key when freeing the
+    plan's reservations.  The early wrong-node return must then free the
+    now-ownerless (planless, still-assumed) reservation itself, or the
+    chips stay charged forever."""
+    api, _, _ = fake_cluster()
+    sched = make_sched(api)
+    objs = [pod_obj(f"wn-{s}", 2, group="wj", group_size=2) for s in "ab"]
+    for o in objs:
+        api.create_pod(o)
+    r = sched.filter(objs[0], nodes_of(api))
+    assert r.nodes, r.failed
+    planned_node = r.nodes[0]
+    wrong = next(n for n in nodes_of(api) if n != planned_node)
+    # simulate reconcile dropping the plan between bind's plan lookup and
+    # its wrong-node check (the key is already marked mid-bind there)
+    orig = sched.groups.plan_for
+
+    def racing_plan_for(pod, now=None):
+        plan = orig(pod, now=now)
+        if plan is not None:
+            sched.groups.drop_plan("default/wj")
+        return plan
+
+    sched.groups.plan_for = racing_plan_for
+    try:
+        err = sched.bind("default", "wn-a", wrong)
+    finally:
+        sched.groups.plan_for = orig
+    assert err is not None and "gang plan places" in err
+    # no reservation may survive: the plan freed wn-b, the bind freed wn-a
+    assert sched.cache.assumed_keys() == []
+    view = next(iter(sched.cache.views().values()))
+    assert len(view.free) == 16
