@@ -115,6 +115,7 @@ class Scheduler:
         absent_grace: int = 2,
         stranded_grace: int = 5,
         active_preemption: bool = True,
+        preemption_min_runtime_s: float = 0.0,
     ) -> None:
         self.api = api
         self.cache = cache or ClusterCache(api)
@@ -139,6 +140,24 @@ class Scheduler:
         # for).  Both are deployed modes; deploy/device-scheduler.yaml
         # documents the flag.
         self.active_preemption = active_preemption
+        # Anti-starvation min-runtime shield: a unit (pod or whole gang)
+        # whose newest member durably bound within this window is
+        # NON-preemptible — two higher-priority tenants alternately
+        # preempting a low-priority gang would otherwise starve it
+        # forever; the shield guarantees every admission this much
+        # runtime, bounding starvation to admission-rate x window.  The
+        # bind time rides the assignment annotation, so the shield
+        # survives scheduler restarts.  0 disables (every admitted unit
+        # is immediately preemptible — the pre-r4 behavior).
+        self.preemption_min_runtime_s = preemption_min_runtime_s
+        # HA fencing re-check (set by ExtenderServer when leader election
+        # is on): consulted immediately before bind's durable annotation
+        # write, so a verb that slipped through the gate as the lease
+        # window closed aborts instead of committing over a promoted
+        # standby's allocations.  A Lease is not a true fencing token — a
+        # PATCH already in flight can still land late; the conflict
+        # sweep's AssignmentConflict eviction resolves that residue.
+        self.serving_gate = None
         # Eviction is irreversible, but "chip absent from an advertisement"
         # and "node missing from a LIST" are not — a restarting advertiser
         # or one truncated enumeration must not destroy a healthy running
@@ -433,7 +452,7 @@ class Scheduler:
         selector = pod.slice_selector
         with self.cache.lock:
             assignments = self.cache.assignments_snapshot()
-            units = collect_units(pods_raw, assignments)
+            units = self._shield_fresh(collect_units(pods_raw, assignments))
             views = self.cache.views()
             if len(layout) > 1:
                 def fits_layout(trial):
@@ -478,6 +497,27 @@ class Scheduler:
                     assignments,
                 )
             return None, assignments
+
+    def _shield_fresh(self, units):
+        """Anti-starvation: drop units still inside their min-runtime
+        window from the victim candidate set (both preemption modes flow
+        through _find_victim_decision, so active filter-evictions and the
+        advisory /preemption verb honor the same shield)."""
+        if self.preemption_min_runtime_s <= 0:
+            return units
+        now = time.time()
+        out = []
+        for u in units:
+            age = now - u.last_bound_at
+            if u.last_bound_at and age < self.preemption_min_runtime_s:
+                log.info(
+                    "unit %s shielded from preemption (admitted %.0fs ago, "
+                    "min runtime %.0fs)",
+                    u.unit_id, age, self.preemption_min_runtime_s,
+                )
+                continue
+            out.append(u)
+        return out
 
     def preemption_victims(
         self, pod_obj: dict, candidate_nodes: Optional[List[str]] = None
@@ -681,8 +721,26 @@ class Scheduler:
             # binding — a crash between the two leaves an annotated-unbound
             # pod that refresh() replays correctly (state lives in the API
             # server).
+            if self.serving_gate is not None and not self.serving_gate():
+                # leadership lapsed since the HTTP gate: a promoted
+                # standby may already own these chips — abort BEFORE
+                # anything durable is written and roll the local
+                # reservation back (nothing to clear in the API server)
+                if reserved_here or (
+                    is_tpu_gang and self.groups.plan_for(pod) is None
+                ):
+                    self.cache.forget(key)
+                self.metrics.inc("kubegpu_bind_conflicts_total")
+                return (
+                    f"lost leadership before committing {key}; bind "
+                    "aborted (kube-scheduler retries against the leader)"
+                )
             try:
                 if assignment is not None:
+                    # stamped on the SHARED object (plan/cache hold the
+                    # same one) so cache and annotation agree; drives the
+                    # preemption min-runtime shield across restarts
+                    assignment.bound_at = time.time()
                     self.api.patch_pod_annotations(
                         namespace,
                         name,

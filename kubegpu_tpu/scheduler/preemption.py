@@ -41,6 +41,11 @@ class VictimUnit:
     # pod key -> uid, threaded through to eviction events so they attach
     # without a per-victim GET round-trip
     uids: Dict[str, str] = field(default_factory=dict)
+    # newest member's durable-bind time (epoch s, from the assignment
+    # annotation — survives scheduler restarts); 0.0 when unknown.  Drives
+    # the min-runtime anti-starvation shield: a gang's admission completes
+    # with its LAST member, so the shield window starts there.
+    last_bound_at: float = 0.0
 
     @property
     def total_chips(self) -> int:
@@ -81,6 +86,7 @@ def collect_units(pods_raw: Sequence[dict], assignments: Dict[str, Assignment]) 
         u.priority = max(u.priority, pod.priority)
         u.pod_keys.append(pod.key)
         u.uids[pod.key] = pod.uid
+        u.last_bound_at = max(u.last_bound_at, a.bound_at)
         if a.slice_id:
             u.coords_by_slice.setdefault(a.slice_id, set()).update(
                 c.coords for c in a.all_chips()

@@ -48,6 +48,15 @@ class ExtenderConfig:
     managed_resources: List[str] = field(default_factory=list)
     ignored_resources: List[str] = field(default_factory=list)
     http_timeout_s: float = 30.0
+    # ExtenderTLSConfig (enableHTTPS/tlsConfig in the scheduler config):
+    # the upstream HTTPExtender honors these same fields
+    enable_https: bool = False
+    tls_ca_file: str = ""
+    tls_insecure: bool = False
+    # not part of the upstream schema (a real kube-scheduler would reject
+    # unknown config keys): set programmatically to exercise the
+    # extender's optional bearer-token gate on /bind and /preemption
+    auth_token_file: str = ""
 
     def is_interested(self, pod_obj: dict) -> bool:
         """Upstream HTTPExtender.IsInterested: any container requesting any
@@ -89,6 +98,7 @@ def load_scheduler_config(path: str) -> List[ExtenderConfig]:
     out = []
     for e in doc.get("extenders", []) or []:
         managed = e.get("managedResources", []) or []
+        tls = e.get("tlsConfig") or {}
         out.append(
             ExtenderConfig(
                 url_prefix=e["urlPrefix"].rstrip("/"),
@@ -103,6 +113,9 @@ def load_scheduler_config(path: str) -> List[ExtenderConfig]:
                     m["name"] for m in managed if m.get("ignoredByScheduler")
                 ],
                 http_timeout_s=_parse_timeout(e.get("httpTimeout", "30s")),
+                enable_https=bool(e.get("enableHTTPS", False)),
+                tls_ca_file=tls.get("caFile", "") or "",
+                tls_insecure=bool(tls.get("insecure", False)),
             )
         )
     return out
@@ -120,12 +133,30 @@ class FakeKubeScheduler:
 
     # -- wire ------------------------------------------------------------
     def _post(self, ext: ExtenderConfig, verb: str, payload: dict):
+        headers = {"Content-Type": "application/json"}
+        if ext.auth_token_file:
+            with open(ext.auth_token_file) as f:
+                headers["Authorization"] = f"Bearer {f.read().strip()}"
         req = urllib.request.Request(
             f"{ext.url_prefix}/{verb}",
             data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
+            headers=headers,
         )
-        with urllib.request.urlopen(req, timeout=ext.http_timeout_s) as resp:
+        ctx = None
+        if ext.url_prefix.startswith("https"):
+            import ssl
+
+            if ext.tls_insecure:
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            else:
+                ctx = ssl.create_default_context(
+                    cafile=ext.tls_ca_file or None
+                )
+        with urllib.request.urlopen(
+            req, timeout=ext.http_timeout_s, context=ctx
+        ) as resp:
             return json.loads(resp.read())
 
     # -- core loop -------------------------------------------------------

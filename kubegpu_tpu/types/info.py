@@ -179,6 +179,10 @@ class Assignment:
     # Non-chip device bindings from a generic DeviceSchedulerPlugin
     # (SURVEY.md §2 #5): container -> [(concrete resource path, qty)].
     grouped: Dict[str, List[Tuple[str, int]]] = field(default_factory=dict)
+    # epoch seconds of the durable bind commit; rides the annotation so
+    # the preemption min-runtime shield (anti-starvation) survives
+    # scheduler restarts.  0.0 = unknown (legacy annotation): unshielded.
+    bound_at: float = 0.0
 
     def all_chips(self) -> List[ChipRef]:
         out: List[ChipRef] = []
@@ -207,6 +211,8 @@ class Assignment:
             d["grouped"] = {
                 c: [[p, q] for p, q in pairs] for c, pairs in self.grouped.items()
             }
+        if self.bound_at:
+            d["bound_at"] = self.bound_at
         return d
 
     @staticmethod
@@ -223,6 +229,7 @@ class Assignment:
                 c: [(str(p), int(q)) for p, q in pairs]
                 for c, pairs in d.get("grouped", {}).items()
             },
+            bound_at=float(d.get("bound_at", 0.0) or 0.0),
         )
 
 

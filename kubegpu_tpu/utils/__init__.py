@@ -7,5 +7,13 @@ from kubegpu_tpu.utils.apiserver import (
     KubeApiServer,
     NotFound,
 )
+from kubegpu_tpu.utils.leaderelection import LeaderElector
 
-__all__ = ["ApiServer", "Conflict", "InMemoryApiServer", "KubeApiServer", "NotFound"]
+__all__ = [
+    "ApiServer",
+    "Conflict",
+    "InMemoryApiServer",
+    "KubeApiServer",
+    "LeaderElector",
+    "NotFound",
+]

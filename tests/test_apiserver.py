@@ -75,6 +75,7 @@ class StubState:
     def __init__(self):
         self.nodes = {}
         self.pods = {}          # "ns/name" -> obj
+        self.leases = {}        # "ns/name" -> obj (rv-CAS'd like the real one)
         self.requests = []      # (method, path, content_type, auth)
         self.events = []        # POSTed v1 Events
         self.watch_events = []  # node events [{"type": ..., "object": ...}]
@@ -135,10 +136,47 @@ def make_stub_handler(state: StubState):
                     break  # k8s watch timeout; client re-watches
                 _time.sleep(0.05)
 
+        def _lease_parts(self, parts):
+            """('ns', 'name'|None) if this is a coordination.k8s.io lease
+            path, else None."""
+            if parts[:4] == ["apis", "coordination.k8s.io", "v1", "namespaces"]:
+                if len(parts) == 7 and parts[5] == "leases":
+                    return parts[4], parts[6]
+                if len(parts) == 6 and parts[5] == "leases":
+                    return parts[4], None
+            return None
+
+        def do_PUT(self):
+            self._record()
+            parts = self.path.strip("/").split("/")
+            lp = self._lease_parts(parts)
+            if lp and lp[1]:
+                key = f"{lp[0]}/{lp[1]}"
+                body = self._body()
+                with state.lock:
+                    cur = state.leases.get(key)
+                    if cur is None:
+                        return self._send(404, {"reason": "NotFound"})
+                    cur_rv = cur["metadata"].get("resourceVersion")
+                    if (body.get("metadata") or {}).get("resourceVersion") != cur_rv:
+                        return self._send(409, {"reason": "Conflict"})
+                    body["metadata"]["resourceVersion"] = str(int(cur_rv) + 1)
+                    state.leases[key] = body
+                return self._send(200, body)
+            self._send(404, {"reason": "NotFound"})
+
         def do_GET(self):
             self._record()
             url = urllib.parse.urlparse(self.path)
             parts = url.path.strip("/").split("/")
+            lp = self._lease_parts(parts)
+            if lp and lp[1]:
+                lease = state.leases.get(f"{lp[0]}/{lp[1]}")
+                return (
+                    self._send(200, lease)
+                    if lease
+                    else self._send(404, {"reason": "NotFound"})
+                )
             if url.path == "/api/v1/nodes":
                 if "watch=true" in (url.query or ""):
                     return self._stream_watch()
@@ -174,6 +212,17 @@ def make_stub_handler(state: StubState):
             self._record()
             parts = self.path.strip("/").split("/")
             body = self._body()
+            lp = self._lease_parts(parts)
+            if lp and lp[1] is None:
+                ns = lp[0]
+                name = (body.get("metadata") or {}).get("name", "")
+                key = f"{ns}/{name}"
+                with state.lock:
+                    if key in state.leases:
+                        return self._send(409, {"reason": "AlreadyExists"})
+                    body.setdefault("metadata", {})["resourceVersion"] = "1"
+                    state.leases[key] = body
+                return self._send(201, body)
             # pods/{name}/binding subresource
             if len(parts) == 7 and parts[-1] == "binding":
                 key = f"{parts[3]}/{parts[5]}"
@@ -572,3 +621,43 @@ def test_response_socket_chain_is_live(stub):
     finally:
         stop.set()
         t.join(timeout=5.0)
+
+
+def test_lease_verbs_over_the_wire_with_cas(stub):
+    """KubeApiServer's coordination.k8s.io Lease verbs against the TLS
+    stub: create (POST), read (GET), CAS update (PUT with resourceVersion,
+    409 -> Conflict on a stale version) — then a real LeaderElector
+    acquiring and renewing THROUGH the REST client."""
+    from kubegpu_tpu.utils.leaderelection import LeaderElector
+
+    api, state = stub
+    with pytest.raises(NotFound):
+        api.get_lease("kube-system", "ha")
+    obj = {
+        "apiVersion": "coordination.k8s.io/v1",
+        "kind": "Lease",
+        "metadata": {"name": "ha", "namespace": "kube-system"},
+        "spec": {"holderIdentity": "x", "leaseDurationSeconds": 15},
+    }
+    created = api.create_lease(obj)
+    assert created["metadata"]["resourceVersion"] == "1"
+    with pytest.raises(Conflict):
+        api.create_lease(obj)
+    lease = api.get_lease("kube-system", "ha")
+    lease["spec"]["holderIdentity"] = "y"
+    api.update_lease("kube-system", "ha", lease)
+    with pytest.raises(Conflict):
+        # same (now stale) resourceVersion again: the CAS must reject
+        api.update_lease("kube-system", "ha", lease)
+    # a real elector drives acquire-then-renew over the wire (the existing
+    # holder "y" never renewed a timestamp, so its lease reads as stale)
+    elector = LeaderElector(api, "replica-1", name="ha",
+                            lease_duration_s=15.0, renew_period_s=5.0)
+    assert elector.try_acquire_or_renew() == "ok"
+    assert elector.try_acquire_or_renew() == "ok"  # renew
+    stored = api.get_lease("kube-system", "ha")
+    assert stored["spec"]["holderIdentity"] == "replica-1"
+    assert stored["spec"]["leaseTransitions"] == 1
+    # every request carried the bearer token
+    lease_reqs = [r for r in state.requests if "leases" in r[1]]
+    assert lease_reqs and all(r[3] == "Bearer sekret-token" for r in lease_reqs)

@@ -46,19 +46,35 @@ def make_pod(name, chips, group=None, size=1, priority=0):
 
 
 @pytest.fixture()
-def cluster():
+def cluster(tmp_path):
+    """The deployed shape: the extender serves HTTPS (the config's
+    enableHTTPS) and the fake kube-scheduler verifies it against the
+    signing CA via tlsConfig — the production scheduler-config.yaml is
+    consumed as-is, with only the cluster-local host and CA paths
+    retargeted at the live server and freshly-minted cert."""
+    from kubegpu_tpu.testing.tlsutil import make_self_signed
+
     api = InMemoryApiServer()
     fs = FakeSlice(slice_id="s0", mesh_shape=(4, 4), host_block=(2, 2))
     for host, prov in fs.providers().items():
         Advertiser(prov, api).advertise_once()
-    srv = ExtenderServer(Scheduler(api, metrics=Metrics()), listen=("127.0.0.1", 0))
+    cert, key = make_self_signed(str(tmp_path))
+    srv = ExtenderServer(
+        Scheduler(api, metrics=Metrics()),
+        listen=("127.0.0.1", 0),
+        tls_cert=cert,
+        tls_key=key,
+    )
     srv.start()
     exts = load_scheduler_config(CONFIG)
-    # the production file points at cluster DNS; retarget ONLY the host at
-    # the live server — every other knob (verbs, weight, managedResources,
-    # nodeCacheCapable, timeout) is used exactly as deployed
+    # the production file points at cluster DNS and in-cluster CA paths;
+    # retarget ONLY those at the live server — every other knob (verbs,
+    # weight, managedResources, nodeCacheCapable, timeout, enableHTTPS)
+    # is used exactly as deployed
     for e in exts:
-        e.url_prefix = f"http://{srv.address[0]}:{srv.address[1]}"
+        assert e.enable_https, "deployed config must say enableHTTPS"
+        e.url_prefix = f"https://{srv.address[0]}:{srv.address[1]}"
+        e.tls_ca_file = cert
     ksched = FakeKubeScheduler(api, exts)
     yield api, srv, ksched
     srv.stop()
@@ -76,6 +92,8 @@ def test_config_file_carries_the_deployed_contract():
     assert e.node_cache_capable is True
     assert e.weight == 10
     assert e.http_timeout_s == 10.0
+    assert e.enable_https is True
+    assert e.tls_ca_file.endswith("ca.crt")
 
 
 def test_passthrough_pod_never_touches_extender(cluster):
@@ -174,5 +192,82 @@ def test_preemption_verb_evicts_and_admits_high_priority():
         survivors = {p["metadata"]["name"] for p in api.list_pods()}
         assert "vip" in survivors
         assert len([s for s in survivors if s.startswith("low")]) == 3
+    finally:
+        srv.stop()
+
+
+def test_bearer_token_gates_privileged_verbs(tmp_path):
+    """Optional authn hardening: with --auth-token-file, /bind and
+    /preemption refuse 401 without the bearer token and work with it,
+    while /filter and /prioritize (read-only advice) stay open — all over
+    HTTPS, driven through the conformance client."""
+    import json as _json
+    import ssl
+    import urllib.error
+    import urllib.request
+
+    from kubegpu_tpu.testing import ExtenderConfig
+    from kubegpu_tpu.testing.tlsutil import make_self_signed
+
+    api = InMemoryApiServer()
+    fs = FakeSlice(slice_id="s0", mesh_shape=(4, 4), host_block=(2, 2))
+    for host, prov in fs.providers().items():
+        Advertiser(prov, api).advertise_once()
+    cert, key = make_self_signed(str(tmp_path))
+    token_file = tmp_path / "token"
+    token_file.write_text("sekret\n")
+    srv = ExtenderServer(
+        Scheduler(api, metrics=Metrics()),
+        listen=("127.0.0.1", 0),
+        tls_cert=cert,
+        tls_key=key,
+        auth_token="sekret",
+    )
+    srv.start()
+    try:
+        base = f"https://{srv.address[0]}:{srv.address[1]}"
+        ctx = ssl.create_default_context(cafile=cert)
+        pod = make_pod("p0", 1)
+        api.create_pod(pod)
+        nodes = sorted(n["metadata"]["name"] for n in api.list_nodes())
+
+        def raw_post(path, payload, auth=None):
+            headers = {"Content-Type": "application/json"}
+            if auth:
+                headers["Authorization"] = auth
+            req = urllib.request.Request(
+                base + path, data=_json.dumps(payload).encode(), headers=headers
+            )
+            with urllib.request.urlopen(req, timeout=10, context=ctx) as r:
+                return r.status, _json.loads(r.read())
+
+        # read-only advice stays open without a token
+        code, body = raw_post("/filter", {"Pod": pod, "NodeNames": nodes})
+        assert code == 200 and body["NodeNames"]
+        target = body["NodeNames"][0]
+        # privileged verbs 401 without the token...
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            raw_post("/bind", {"PodNamespace": "default", "PodName": "p0",
+                               "Node": target})
+        assert ei.value.code == 401
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            raw_post("/preemption", {"Pod": pod})
+        assert ei.value.code == 401
+        # ...and a wrong token is refused too
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            raw_post("/bind", {"PodNamespace": "default", "PodName": "p0",
+                               "Node": target}, auth="Bearer wrong")
+        assert ei.value.code == 401
+        # the conformance client with auth_token_file set binds fine
+        ext = ExtenderConfig(
+            url_prefix=base, filter_verb="filter", prioritize_verb="prioritize",
+            bind_verb="bind", preempt_verb="preemption", weight=1,
+            node_cache_capable=True, managed_resources=[RES_TPU],
+            tls_ca_file=cert, auth_token_file=str(token_file),
+        )
+        ksched = FakeKubeScheduler(api, [ext])
+        bound = ksched.run_until_settled()
+        assert bound == {"default/p0": bound["default/p0"]}
+        assert api.get_pod("default", "p0")["spec"]["nodeName"]
     finally:
         srv.stop()
