@@ -23,6 +23,67 @@ import jax
 import jax.numpy as jnp
 
 
+class QuantDense(nn.Module):
+    """Weight-only int8 Dense for the decode path.
+
+    Decode is memory-bound: every step streams the full parameter set
+    from HBM, so halving the bytes per weight (int8 vs bf16) is a direct
+    bandwidth win.  Per-OUTPUT-channel symmetric scales (the standard
+    weight-only recipe — one scale per column keeps the quantization
+    error inside each output feature); the dequant ``int8 -> dtype *
+    scale`` fuses into the matmul's weight load on TPU, so the bf16
+    weight never materializes in HBM.  Activations stay bf16 — no
+    calibration needed, quality measured in bench.py against the bf16
+    path."""
+
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        w8 = self.param(
+            "kernel_int8",
+            nn.initializers.zeros_init(),
+            (x.shape[-1], self.features),
+            jnp.int8,
+        )
+        scale = self.param(
+            "qscale", nn.initializers.ones_init(), (self.features,), jnp.float32
+        )
+        w = w8.astype(self.dtype) * scale.astype(self.dtype)[None, :]
+        return jnp.dot(x.astype(self.dtype), w)
+
+
+def quantize_params_int8(params):
+    """Training/bf16 decode params -> the QuantDense layout: every Dense
+    kernel (a ``{"kernel": 2D}`` module) becomes per-output-channel int8 +
+    fp32 scales; embeddings and LayerNorms pass through untouched (their
+    HBM traffic is negligible and LN is precision-sensitive)."""
+
+    def walk(tree):
+        out = {}
+        for k, v in tree.items():
+            if (
+                isinstance(v, dict)
+                and set(v) == {"kernel"}
+                and getattr(v["kernel"], "ndim", 0) == 2
+            ):
+                w = jnp.asarray(v["kernel"], jnp.float32)
+                scale = jnp.max(jnp.abs(w), axis=0) / 127.0
+                scale = jnp.where(scale == 0, 1.0, scale)
+                out[k] = {
+                    "kernel_int8": jnp.round(w / scale[None, :]).astype(jnp.int8),
+                    "qscale": scale,
+                }
+            elif isinstance(v, dict):
+                out[k] = walk(v)
+            else:
+                out[k] = v
+        return out
+
+    return walk(params)
+
+
 class DecodeAttention(nn.Module):
     """Chunked attention against a running KV cache: x may be one token
     (a decode step) or the whole prompt (prefill in ONE causal pass — L
@@ -32,6 +93,7 @@ class DecodeAttention(nn.Module):
     num_heads: int
     max_seq: int
     dtype: jnp.dtype = jnp.bfloat16
+    quant: bool = False
 
     @nn.compact
     def __call__(self, x, cache_k, cache_v, pos):
@@ -40,7 +102,11 @@ class DecodeAttention(nn.Module):
         b, L, d = x.shape
         h = self.num_heads
         hd = d // h
-        dense = partial(nn.Dense, use_bias=False, dtype=self.dtype)
+        dense = (
+            partial(QuantDense, dtype=self.dtype)
+            if self.quant
+            else partial(nn.Dense, use_bias=False, dtype=self.dtype)
+        )
         q = dense(d, name="q_proj")(x).reshape(b, L, h, hd)
         k = dense(d, name="k_proj")(x).reshape(b, L, h, hd)
         v = dense(d, name="v_proj")(x).reshape(b, L, h, hd)
@@ -71,21 +137,25 @@ class DecodeBlock(nn.Module):
     max_seq: int
     mlp_ratio: int = 4
     dtype: jnp.dtype = jnp.bfloat16
+    quant: bool = False
 
     @nn.compact
     def __call__(self, x, cache_k, cache_v, pos):
         d = x.shape[-1]
+        dense = (
+            partial(QuantDense, dtype=self.dtype)
+            if self.quant
+            else partial(nn.Dense, use_bias=False, dtype=self.dtype)
+        )
         y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
         attn_out, cache_k, cache_v = DecodeAttention(
-            self.num_heads, self.max_seq, self.dtype, name="attn"
+            self.num_heads, self.max_seq, self.dtype, self.quant, name="attn"
         )(y, cache_k, cache_v, pos)
         x = x + attn_out
         y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
-        y = nn.Dense(
-            d * self.mlp_ratio, use_bias=False, dtype=self.dtype, name="mlp_up"
-        )(y)
+        y = dense(d * self.mlp_ratio, name="mlp_up")(y)
         y = nn.gelu(y)
-        y = nn.Dense(d, use_bias=False, dtype=self.dtype, name="mlp_down")(y)
+        y = dense(d, name="mlp_down")(y)
         return x + y, cache_k, cache_v
 
 
@@ -101,6 +171,7 @@ class DecodeLM(nn.Module):
     hidden: int = 512
     max_seq: int = 2048
     dtype: jnp.dtype = jnp.bfloat16
+    quant: bool = False  # weight-only int8 (QuantDense param layout)
 
     @nn.compact
     def __call__(self, tokens, caches, pos):
@@ -116,13 +187,21 @@ class DecodeLM(nn.Module):
         for i in range(self.num_layers):
             ck, cv = caches[i]
             x, ck, cv = DecodeBlock(
-                self.num_heads, self.max_seq, dtype=self.dtype, name=f"layer{i}"
+                self.num_heads, self.max_seq, dtype=self.dtype,
+                quant=self.quant, name=f"layer{i}"
             )(x, ck, cv, pos)
             new_caches.append((ck, cv))
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
-        logits = nn.Dense(
-            self.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head"
-        )(x)
+        # the head is the single largest weight read per step (hidden x
+        # vocab); int8 it too, accumulating in fp32 like the bf16 path
+        if self.quant:
+            logits = QuantDense(
+                self.vocab_size, dtype=jnp.float32, name="lm_head"
+            )(x)
+        else:
+            logits = nn.Dense(
+                self.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head"
+            )(x)
         return logits[:, -1], new_caches
 
 
@@ -152,6 +231,7 @@ def generate(
     temperature: float = 0.0,
     top_k: int = 0,
     rng: jax.Array | None = None,
+    quant: bool = False,
 ) -> jax.Array:
     """Decode: prefill the whole prompt in one causal pass (filling every
     K/V cache row), then scan `num_steps` generation steps — all one
@@ -172,7 +252,7 @@ def generate(
         raise ValueError("sampling (temperature > 0) needs an rng key")
     model = DecodeLM(
         vocab_size=vocab_size, num_layers=num_layers, num_heads=num_heads,
-        hidden=hidden, max_seq=max_seq, dtype=dtype,
+        hidden=hidden, max_seq=max_seq, dtype=dtype, quant=quant,
     )
     caches = init_caches(b, num_layers, num_heads, hidden, max_seq, dtype)
 

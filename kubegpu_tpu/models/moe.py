@@ -82,6 +82,15 @@ class MoEMLP(nn.Module):
         imask = mask.astype(jnp.int32)
         position = jnp.cumsum(imask, axis=1) * imask                # [b, s, e]
         keep = ((position > 0) & (position <= capacity)).astype(jnp.float32)
+        # Token-drop rate (VERDICT r3 weak #7): static capacity drops
+        # overflow tokens SILENTLY (their residual branch contributes
+        # zero), so a misconfigured capacity_factor degrades quality with
+        # no signal.  Sown per layer; the train step averages it into a
+        # step metric and the worker/bench surface it.
+        self.sow(
+            "intermediates", "drop_rate",
+            1.0 - jnp.sum(keep) / (b * s),
+        )
         slot = jnp.maximum(position - 1, 0)                         # 0-based
         dispatch = keep[..., None] * jax.nn.one_hot(
             slot, capacity, dtype=jnp.float32
@@ -111,6 +120,32 @@ class MoEMLP(nn.Module):
             "bsec,becd->bsd", combine, expert_out.astype(jnp.float32)
         )
         return out.astype(x.dtype)
+
+
+def moe_router_stats(model, params, tokens):
+    """(aux_loss, drop_rate) means over layers from one forward's sown
+    intermediates — the operator-facing routing health metrics (a
+    capacity_factor too low for the token distribution shows up here as a
+    rising drop rate, NOT in the loss curve until quality already
+    suffered).  Jit-safe; bench.py and the worker report these."""
+    _, mutated = model.apply({"params": params}, tokens, mutable=["intermediates"])
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        mutated.get("intermediates", {})
+    )
+
+    def mean_of(name):
+        leaves = [
+            leaf
+            for path, leaf in flat
+            if any(getattr(k, "key", None) == name for k in path)
+        ]
+        return (
+            sum(jnp.mean(x) for x in leaves) / max(len(leaves), 1)
+            if leaves
+            else jnp.zeros(())
+        )
+
+    return mean_of("aux_loss"), mean_of("drop_rate")
 
 
 class MoeBlock(nn.Module):

@@ -426,6 +426,14 @@ class ExtenderServer:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.elector is not None:
+            # release the lease NOW, synchronously — the elector thread is
+            # a daemon and may be killed at interpreter exit before its
+            # own run()-exit release runs; without this a rolling update
+            # leaves the dead pod's holderIdentity on the lease and the
+            # standby waits out the whole observation window (a leaderless
+            # stretch where every verb 503s)
+            self.elector.release()
         close = getattr(self.sched.api, "close_watches", None)
         if close is not None:
             close()  # unblock watch threads from quiet-window socket reads
@@ -593,10 +601,18 @@ def main(argv=None) -> None:
         "https" if server.tls else "http",
         *server.address,
     )
+    # SIGTERM is the deployed shutdown path (kubelet, rolling updates):
+    # without a handler Python dies without unwinding, the lease release
+    # never runs, and the standby waits out the full lease window
+    import signal
+
+    shutdown = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: shutdown.set())
     try:
-        threading.Event().wait()
+        shutdown.wait()
     except KeyboardInterrupt:
-        server.stop()
+        pass
+    server.stop()
 
 
 if __name__ == "__main__":

@@ -117,3 +117,38 @@ def test_greedy_generate_is_jittable_one_program():
     out = f(params, prompt)
     assert out.shape == (1, 8)
     assert out.dtype == jnp.int32
+
+
+def test_int8_quant_decode_tracks_bf16_choices():
+    """Weight-only int8 decode (VERDICT r3 #3a): quantize_params_int8 of
+    the same checkpoint generates through the QuantDense path and must
+    track the full-precision generation closely (identical here at fp32
+    activations on a tiny model; bench.py measures the quality delta on
+    the flagship)."""
+    from kubegpu_tpu.models.decoding import quantize_params_int8
+
+    params = trained_params()
+    prompt = (jnp.arange(2 * 5, dtype=jnp.int32) % CFG["vocab_size"]).reshape(2, 5)
+    steps = 6
+    ref = greedy_generate(params, prompt, steps, dtype=jnp.float32, **CFG)
+    qparams = quantize_params_int8(params)
+    # every Dense kernel became int8+scale; embeds/LNs untouched
+    leaves = jax.tree_util.tree_flatten_with_path(qparams)[0]
+    kinds = {"int8": 0, "scale": 0, "other": 0}
+    for path, leaf in leaves:
+        names = [getattr(k, "key", "") for k in path]
+        if "kernel_int8" in names:
+            assert leaf.dtype == jnp.int8
+            kinds["int8"] += 1
+        elif "qscale" in names:
+            kinds["scale"] += 1
+        else:
+            kinds["other"] += 1
+    # q/k/v/o + up/down per layer (x2 layers) + lm_head = 13 quant kernels
+    assert kinds["int8"] == kinds["scale"] == 13, kinds
+    out = greedy_generate(
+        qparams, prompt, steps, dtype=jnp.float32, quant=True, **CFG
+    )
+    ref_np, out_np = np.asarray(ref), np.asarray(out)
+    match = (ref_np[:, 5:] == out_np[:, 5:]).mean()
+    assert match >= 0.75, f"int8 decode diverged: token match {match:.2f}"

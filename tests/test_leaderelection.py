@@ -446,3 +446,23 @@ def test_tls_stalled_client_does_not_block_other_requests(tmp_path):
             mute.close()
     finally:
         srv.stop()
+
+
+def test_stop_releases_lease_synchronously():
+    """Code-review r4: stop() must release the lease ITSELF — the elector
+    thread is a daemon and can die at interpreter exit before its own
+    release runs; the deployed SIGTERM path routes through stop(), so the
+    holder must be cleared by the time stop() returns (no leaderless
+    lease-window wait for the standby)."""
+    api = fake_cluster()
+    r1 = make_replica(api, "replica-1")
+    r1.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not r1.elector.is_leader():
+            time.sleep(0.02)
+        assert r1.elector.is_leader()
+    finally:
+        r1.stop()
+    lease = api.get_lease("kube-system", "extender-ha")
+    assert lease["spec"]["holderIdentity"] == "", lease["spec"]

@@ -70,11 +70,15 @@ def test_moe_aux_loss_sown_and_near_one_when_balanced():
     x = jax.random.normal(jax.random.PRNGKey(0), (4, 16, d), jnp.float32)
     params = layer.init(jax.random.PRNGKey(1), x)["params"]
     _, mut = layer.apply({"params": params}, x, mutable=["intermediates"])
-    (aux,) = jax.tree_util.tree_leaves(mut["intermediates"])
+    inter = mut["intermediates"]
+    (aux,) = jax.tree_util.tree_leaves(inter["aux_loss"])
     aux = float(aux)
     # Switch aux loss is exactly 1.0 at perfect balance; a freshly
     # initialized (near-uniform) router should sit close to it.
     assert 0.8 < aux < 2.0, aux
+    # the drop-rate diagnostic is sown alongside and is a valid fraction
+    (drop,) = jax.tree_util.tree_leaves(inter["drop_rate"])
+    assert 0.0 <= float(drop) <= 1.0, drop
 
 
 def test_moe_ep_rules_shard_expert_dim_only():
