@@ -18,7 +18,9 @@ from kubegpu_tpu.models.decoding import (
     generate,
     greedy_generate,
     init_caches,
+    quantize_params_int8,
 )
+from kubegpu_tpu.models.serving import ContinuousBatcher
 from kubegpu_tpu.models.transformer import TransformerLM
 from kubegpu_tpu.models.moe import MoEMLP, MoeBlock, MoeTransformerLM
 # NOTE: kubegpu_tpu.models.checkpoint is deliberately NOT imported here —
@@ -60,7 +62,9 @@ __all__ = [
     "synthetic_image_batches",
     "DecodeLM",
     "generate",
+    "ContinuousBatcher",
     "greedy_generate",
+    "quantize_params_int8",
     "init_caches",
     "TransformerLM",
     "MoEMLP",

@@ -97,8 +97,10 @@ class DecodeAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, cache_k, cache_v, pos):
-        # x: (b, L, d); cache_*: (b, max_seq, h, hd); pos: () int32 — the
-        # cache row of x's FIRST token
+        # x: (b, L, d); cache_*: (b, max_seq, h, hd); pos: the cache row of
+        # x's FIRST token — () int32 (all sequences aligned, the plain
+        # generate() path) or (b,) int32 (per-slot positions, continuous
+        # batching: every slot may sit at a different depth)
         b, L, d = x.shape
         h = self.num_heads
         hd = d // h
@@ -110,8 +112,18 @@ class DecodeAttention(nn.Module):
         q = dense(d, name="q_proj")(x).reshape(b, L, h, hd)
         k = dense(d, name="k_proj")(x).reshape(b, L, h, hd)
         v = dense(d, name="v_proj")(x).reshape(b, L, h, hd)
-        cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, pos, 0, 0))
-        cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, pos, 0, 0))
+        if jnp.ndim(pos) == 0:
+            cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, pos, 0, 0))
+            cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, pos, 0, 0))
+        else:
+            # per-slot writes: each batch row lands at ITS OWN position
+            upd = jax.vmap(
+                lambda c, new, p: jax.lax.dynamic_update_slice(
+                    c, new, (p, 0, 0)
+                )
+            )
+            cache_k = upd(cache_k, k, pos)
+            cache_v = upd(cache_v, v, pos)
         # numerics MIRROR the training model's einsum attention (scores in
         # model dtype, finfo-min mask, fp32 softmax, dtype matmul with V):
         # greedy decode must reproduce the training forward's argmax, and
@@ -119,8 +131,10 @@ class DecodeAttention(nn.Module):
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, cache_k) / jnp.sqrt(
             hd
         ).astype(self.dtype)
-        # causal over global positions: chunk row i sits at pos+i
-        rows = pos + jnp.arange(L)[None, None, :, None]
+        # causal over global positions: chunk row i sits at pos+i (per
+        # slot when pos is a vector)
+        pos_b = jnp.atleast_1d(pos)  # (1,) broadcasts; (b,) is per-slot
+        rows = pos_b[:, None, None, None] + jnp.arange(L)[None, None, :, None]
         cols = jnp.arange(self.max_seq)[None, None, None, :]
         scores = jnp.where(
             cols <= rows, scores, jnp.finfo(self.dtype).min
@@ -176,13 +190,15 @@ class DecodeLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, caches, pos):
         # tokens: (b, L) int32; caches: [(k, v)] per layer; pos: () int32
+        # (aligned) or (b,) int32 (per-slot, continuous batching)
         b, L = tokens.shape
         x = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype, name="embed")(
             tokens
         )
+        pos_rows = jnp.atleast_1d(pos)[:, None] + jnp.arange(L)[None, :]
         x = x + nn.Embed(
             self.max_seq, self.hidden, dtype=self.dtype, name="pos_embed"
-        )((pos + jnp.arange(L))[None, :])
+        )(pos_rows)
         new_caches = []
         for i in range(self.num_layers):
             ck, cv = caches[i]

@@ -1,0 +1,231 @@
+"""Slot-based continuous batching: the serving loop over the KV-cached LM.
+
+Static batching (``generate``) admits B prompts together and runs until
+the LAST one finishes — every finished (or short) sequence wastes its slot
+for the remainder of the batch.  Continuous batching keeps the batch FULL:
+the moment a slot's sequence completes, the next queued prompt is
+prefilled into that slot while the other slots keep decoding.  This is the
+standard production serving shape (Orca/vLLM's insight, minus paging —
+the cache here is a dense per-slot buffer, the right first shape for TPU
+where static layouts compile once).
+
+TPU-first structure: exactly TWO compiled programs regardless of traffic —
+
+- ``step``: one token for every slot at its own depth (the per-slot
+  ``pos`` vector path through ``DecodeLM``);
+- ``admit``: prefill ONE prompt (fixed padded length, length-masked) on a
+  fresh b=1 cache and splice the result into the shared cache at a traced
+  slot index (``dynamic_update_slice`` on the batch axis).
+
+Both have static shapes, so arbitrary arrival patterns never recompile.
+The host-side loop (``ContinuousBatcher``) is pure orchestration: admit,
+step, collect, retire.
+
+Reference anchor: SURVEY.md §2.2 — serving is a scheduled workload; the
+framework's job is handing it well-placed chips, and this module is the
+workload-side twin of the decode sample (`samples/jax-decode.yaml`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubegpu_tpu.models.decoding import DecodeLM, init_caches
+
+
+@dataclass
+class _Slot:
+    seq_id: int = -1          # index into the submitted prompt list
+    remaining: int = 0        # new tokens still owed
+    active: bool = False
+    tokens: List[int] = field(default_factory=list)
+
+
+class ContinuousBatcher:
+    """Greedy continuous-batching decoder over a fixed slot count.
+
+    ``prompt_pad``: every admitted prompt is right-padded to this length
+    (shorter prompts are length-masked via their slot position — padding
+    rows are never attended because the slot's ``pos`` only advances by
+    the REAL length).  One padded shape = one compiled admit program.
+    """
+
+    def __init__(
+        self,
+        params,
+        *,
+        vocab_size: int,
+        num_layers: int,
+        num_heads: int,
+        hidden: int,
+        max_seq: int,
+        slots: int = 8,
+        prompt_pad: int = 128,
+        eos_id: Optional[int] = None,
+        dtype=jnp.bfloat16,
+        quant: bool = False,
+    ) -> None:
+        self.params = params
+        self.slots = slots
+        self.prompt_pad = prompt_pad
+        self.max_seq = max_seq
+        self.eos_id = eos_id
+        cfg = dict(
+            vocab_size=vocab_size, num_layers=num_layers,
+            num_heads=num_heads, hidden=hidden, max_seq=max_seq,
+            dtype=dtype, quant=quant,
+        )
+        self.model = DecodeLM(**cfg)
+        self.num_layers = num_layers
+        self.caches = init_caches(
+            slots, num_layers, num_heads, hidden, max_seq, dtype
+        )
+        self.pos = jnp.zeros((slots,), jnp.int32)
+        self._slots = [_Slot() for _ in range(slots)]
+
+        def step(params, caches, last_tokens, pos):
+            # one decode step for EVERY slot at its own depth; inactive
+            # slots compute garbage that the host never collects
+            logits, caches = self.model.apply(
+                {"params": params}, last_tokens[:, None], caches, pos
+            )
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), caches
+
+        def admit(params, caches, pos, prompt_row, prompt_len, slot):
+            # prefill ONE padded prompt on a fresh b=1 cache, then splice
+            # that cache into the shared one at `slot` (batch-axis
+            # dynamic_update_slice); the first generated token is the
+            # argmax at the REAL last prompt row (padding is masked by
+            # taking logits at prompt_len-1, and later attention never
+            # reads past the slot's pos)
+            fresh = init_caches(
+                1, num_layers, num_heads, hidden, max_seq, dtype
+            )
+            _, fresh = self.model.apply(
+                {"params": params}, prompt_row[None, :], fresh,
+                jnp.zeros((), jnp.int32),
+            )
+            # re-run the last REAL row? No: one causal pass already filled
+            # every row; the last real row's logits live at prompt_len-1,
+            # which the full-chunk forward does not return (it returns the
+            # final PADDED row).  One extra single-token pass at the real
+            # depth reads the filled cache and yields the right logits.
+            last_real = jax.lax.dynamic_slice(
+                prompt_row, (prompt_len - 1,), (1,)
+            )
+            logits, fresh = self.model.apply(
+                {"params": params}, last_real[None, :], fresh,
+                (prompt_len - 1)[None],
+            )
+            first_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+            new_caches = []
+            for (ck, cv), (fk, fv) in zip(caches, fresh):
+                new_caches.append((
+                    jax.lax.dynamic_update_slice(ck, fk, (slot, 0, 0, 0)),
+                    jax.lax.dynamic_update_slice(cv, fv, (slot, 0, 0, 0)),
+                ))
+            pos = pos.at[slot].set(prompt_len)
+            return first_tok, new_caches, pos
+
+        self._step = jax.jit(step, donate_argnums=(1,))
+        self._admit = jax.jit(admit, donate_argnums=(1,))
+        self._last_tokens = jnp.zeros((slots,), jnp.int32)
+
+    # -- host-side orchestration -------------------------------------------
+    def _admit_one(self, slot_idx: int, seq_id: int, prompt: np.ndarray,
+                   max_new: int) -> None:
+        plen = int(prompt.shape[0])
+        if plen > self.prompt_pad:
+            raise ValueError(
+                f"prompt length {plen} exceeds prompt_pad {self.prompt_pad}"
+            )
+        if plen + max_new > self.max_seq:
+            raise ValueError(
+                f"prompt {plen} + max_new {max_new} exceeds max_seq "
+                f"{self.max_seq}"
+            )
+        row = np.zeros((self.prompt_pad,), np.int32)
+        row[:plen] = prompt
+        first_tok, self.caches, self.pos = self._admit(
+            self.params, self.caches, self.pos,
+            jnp.asarray(row), jnp.int32(plen), jnp.int32(slot_idx),
+        )
+        s = self._slots[slot_idx]
+        s.seq_id, s.active = seq_id, True
+        s.tokens = [int(first_tok)]
+        s.remaining = max_new - 1
+        self._last_tokens = self._last_tokens.at[slot_idx].set(first_tok)
+        if self.eos_id is not None and s.tokens[-1] == self.eos_id:
+            s.remaining = 0
+        if s.remaining <= 0:
+            s.active = False
+
+    def run(
+        self,
+        prompts: List[np.ndarray],
+        max_new_tokens: List[int],
+    ) -> Dict[int, List[int]]:
+        """Serve every prompt to completion; returns {seq_id: generated
+        tokens}.  ``stats['steps']`` afterwards holds the number of step
+        programs executed (the efficiency measure vs static batching)."""
+        assert len(prompts) == len(max_new_tokens)
+        queue = list(range(len(prompts)))
+        done: Dict[int, List[int]] = {}
+        self.stats = {"steps": 0, "admits": 0}
+
+        def retire_and_admit():
+            # sweep until a full pass makes no progress: an admit can
+            # complete INSTANTLY (max_new=1, or the first token is EOS),
+            # and its freed slot must serve the next queued prompt in the
+            # same pass — or a 1-slot batcher strands the queue
+            progress = True
+            while progress:
+                progress = False
+                for i, s in enumerate(self._slots):
+                    if s.seq_id >= 0 and not s.active:
+                        done[s.seq_id] = s.tokens
+                        s.seq_id = -1
+                        progress = True
+                    if s.seq_id < 0 and queue:
+                        nxt = queue.pop(0)
+                        self._admit_one(
+                            i, nxt, prompts[nxt], max_new_tokens[nxt]
+                        )
+                        self.stats["admits"] += 1
+                        progress = True
+
+        retire_and_admit()
+        while any(s.active for s in self._slots):
+            toks, self.caches = self._step(
+                self.params, self.caches, self._last_tokens, self.pos
+            )
+            self.stats["steps"] += 1
+            toks_host = np.asarray(toks)
+            # every slot active at step time wrote a cache row: advance
+            # their positions in ONE vectorized update (a per-slot .at
+            # loop would dispatch `slots` tiny device ops per step)
+            advanced = np.array(
+                [s.active for s in self._slots], np.int32
+            )
+            self.pos = self.pos + jnp.asarray(advanced)
+            for i, s in enumerate(self._slots):
+                if not s.active:
+                    continue
+                t = int(toks_host[i])
+                s.tokens.append(t)
+                s.remaining -= 1
+                if s.remaining <= 0 or (
+                    self.eos_id is not None and t == self.eos_id
+                ):
+                    s.active = False
+            self._last_tokens = toks
+            retire_and_admit()
+        # every slot is retired here: retire_and_admit sweeps
+        # unconditionally and runs last in each iteration, so the loop
+        # cannot exit with a finished-but-unretired slot
+        return done

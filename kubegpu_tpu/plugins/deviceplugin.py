@@ -28,7 +28,6 @@ from __future__ import annotations
 import logging
 import os
 import threading
-import time
 from typing import Dict, Iterable, List, Optional, Sequence
 
 import grpc
@@ -194,6 +193,12 @@ class DevicePluginServer:
     def start(self) -> None:
         from concurrent import futures
 
+        if self._server is not None:
+            # re-serve (kubelet restart churn): a started grpc.Server is
+            # never garbage-collected until stopped — overwriting the
+            # reference would leak its poller thread + executor per restart
+            self._server.stop(0.2).wait()
+            self._server = None
         if os.path.exists(self.socket_path):
             os.unlink(self.socket_path)  # stale socket from a previous run
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
@@ -219,6 +224,64 @@ class DevicePluginServer:
                 timeout=5.0,
             )
         log.info("registered %s with kubelet at %s", self.resource_name, target)
+
+    def serve_forever(
+        self,
+        stop: threading.Event,
+        kubelet_socket: Optional[str] = None,
+        watch_interval_s: float = 2.0,
+    ) -> None:
+        """The DaemonSet serve loop, re-registration churn included —
+        kubelet owns the plugin contract's lifecycle and a plugin that
+        only registers once silently falls out of the allocatable set on
+        the first kubelet restart:
+
+        - our own socket vanishing = kubelet restarted and wiped
+          ``/var/lib/kubelet/device-plugins`` → re-serve + re-register
+          (the fsnotify trigger the NVIDIA plugin watches);
+        - the kubelet socket's INODE changing = kubelet restarted without
+          wiping the dir (containerized kubelets recreate their socket) →
+          our old registration died with the old process → re-register;
+        - registration failures (kubelet briefly down mid-restart) are
+          logged and retried next tick, never fatal.
+        """
+        kubelet_path = kubelet_socket or os.path.join(
+            self.socket_dir, KUBELET_SOCKET
+        )
+        last_ident: Optional[tuple] = None
+        registered = False
+        while not stop.is_set():
+            if not os.path.exists(self.socket_path):
+                log.warning(
+                    "plugin socket vanished (kubelet restart); re-serving"
+                )
+                try:
+                    self.start()
+                except Exception as e:  # noqa: BLE001 - bind can fail
+                    # transiently while kubelet recreates the plugin dir;
+                    # the same never-fatal rule as registration
+                    log.warning("re-serve failed (%s); retrying", e)
+                    stop.wait(watch_interval_s)
+                    continue
+                registered = False
+            try:
+                st = os.stat(kubelet_path)
+                # inode + mtime: tmpfs recycles inode numbers, so a
+                # recreated socket can reuse its predecessor's — the
+                # creation timestamp disambiguates
+                ident: Optional[tuple] = (st.st_ino, st.st_mtime_ns)
+            except OSError:
+                ident = None  # kubelet down/mid-restart: wait for it
+                registered = False
+            if ident is not None and (not registered or ident != last_ident):
+                try:
+                    self.register_with_kubelet(kubelet_path)
+                    registered = True
+                    last_ident = ident
+                except Exception as e:  # noqa: BLE001 - retry next tick
+                    log.warning("kubelet registration failed (%s); retrying", e)
+                    registered = False
+            stop.wait(watch_interval_s)
 
     # -- device inventory -------------------------------------------------
     def _fragment(self) -> Optional[HostFragment]:
@@ -393,15 +456,11 @@ def main(argv=None) -> None:
         poll_interval_s=args.poll_interval,
     )
     plugin.start()
-    plugin.register_with_kubelet()
+    stop = threading.Event()
     try:
-        while True:
-            time.sleep(2.0)
-            if not os.path.exists(plugin.socket_path):
-                log.warning("plugin socket vanished (kubelet restart); re-serving")
-                plugin.start()
-                plugin.register_with_kubelet()
+        plugin.serve_forever(stop)
     except KeyboardInterrupt:
+        stop.set()
         plugin.stop()
 
 
