@@ -70,6 +70,12 @@ class ContinuousBatcher:
         dtype=jnp.bfloat16,
         quant: bool = False,
     ) -> None:
+        if prompt_pad > max_seq:
+            raise ValueError(
+                f"prompt_pad ({prompt_pad}) exceeds max_seq ({max_seq}): "
+                "the admit prefill could not fit its padded chunk in the "
+                "cache"
+            )
         self.params = params
         self.slots = slots
         self.prompt_pad = prompt_pad
@@ -139,6 +145,12 @@ class ContinuousBatcher:
     # -- host-side orchestration -------------------------------------------
     def _admit_one(self, slot_idx: int, seq_id: int, prompt: np.ndarray,
                    max_new: int) -> None:
+        if max_new <= 0:
+            # match generate(num_steps=0): nothing owed, nothing emitted —
+            # the admit program would still produce a first token
+            s = self._slots[slot_idx]
+            s.seq_id, s.active, s.tokens, s.remaining = seq_id, False, [], 0
+            return
         plen = int(prompt.shape[0])
         if plen > self.prompt_pad:
             raise ValueError(

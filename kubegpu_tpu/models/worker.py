@@ -485,6 +485,14 @@ def _run_decode(args, t0: float) -> int:
         params32,
     )
     del params32
+    if args.int8:
+        # weight-only int8 serving: half the HBM bytes per decode step
+        # (decode streams the full parameter set every step); quality
+        # deltas are measured in bench.py — greedy argmax tracks bf16
+        from kubegpu_tpu.models.decoding import quantize_params_int8
+
+        params = jax.jit(quantize_params_int8)(params)
+        print("SERVING_INT8 weight-only per-output-channel", flush=True)
 
     batch = args.batch_per_chip
     prompt = jax.random.randint(
@@ -494,6 +502,7 @@ def _run_decode(args, t0: float) -> int:
         lambda p, t: greedy_generate(
             p, t, args.steps, vocab_size=args.vocab, num_layers=args.layers,
             num_heads=args.heads, hidden=args.hidden, max_seq=max_seq,
+            quant=args.int8,
         )
     )
     out = fn(params, prompt)
@@ -587,6 +596,9 @@ def main(argv=None) -> int:
     ap.add_argument("--prompt-len", type=int, default=32,
                     help="decode: prompt tokens per request (prompt-len + "
                     "--steps must fit --seq + 1, the lm family's cache size)")
+    ap.add_argument("--int8", action="store_true",
+                    help="decode: serve weight-only int8 (per-output-"
+                    "channel scales; halves the per-step parameter stream)")
     ap.add_argument(
         "--ckpt-dir",
         default=os.environ.get("KUBEGPU_CKPT_DIR", ""),

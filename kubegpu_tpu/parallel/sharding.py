@@ -104,6 +104,15 @@ TRANSFORMER_TP_RULES: Tuple[Tuple[str, P], ...] = (
     (r".*mlp_up/kernel$", P(None, MODEL_AXIS)),
     (r".*mlp_down/kernel$", P(MODEL_AXIS, None)),
     (r".*lm_head/kernel$", P(None, MODEL_AXIS)),
+    # int8 serving layout (models/decoding.py QuantDense): kernel_int8
+    # shards exactly like its bf16 twin; the per-OUTPUT-channel qscale
+    # follows the kernel's output dim — sharded where the output dim is
+    # sharded (column-parallel), replicated where the INPUT dim is
+    # (row-parallel: every shard scales full output columns)
+    (r".*(q_proj|k_proj|v_proj|mlp_up|lm_head)/kernel_int8$", P(None, MODEL_AXIS)),
+    (r".*(o_proj|mlp_down)/kernel_int8$", P(MODEL_AXIS, None)),
+    (r".*(q_proj|k_proj|v_proj|mlp_up|lm_head)/qscale$", P(MODEL_AXIS)),
+    (r".*(o_proj|mlp_down)/qscale$", P()),
     (r".*bias$", P()),
     (r".*scale$", P()),
 )

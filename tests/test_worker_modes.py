@@ -146,6 +146,17 @@ def test_decode_mode_serves_fresh_weights_without_ckpt(capsys):
     assert "DECODE_DONE" in out and "RESTORED_FOR_SERVING" not in out
 
 
+def test_decode_mode_serves_int8(capsys):
+    rc = worker.main([
+        "--model", "decode", "--steps", "4", "--batch-per-chip", "2",
+        "--vocab", "64", "--layers", "1", "--heads", "2", "--hidden", "16",
+        "--seq", "16", "--prompt-len", "4", "--int8",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "SERVING_INT8" in out and "DECODE_DONE" in out
+
+
 def test_decode_rejects_oversized_request():
     with pytest.raises(SystemExit):
         worker.main([
