@@ -21,6 +21,7 @@ from kubegpu_tpu.models.decoding import (
     quantize_params_int8,
 )
 from kubegpu_tpu.models.serving import ContinuousBatcher
+from kubegpu_tpu.models.speculative import speculative_generate
 from kubegpu_tpu.models.transformer import TransformerLM
 from kubegpu_tpu.models.moe import MoEMLP, MoeBlock, MoeTransformerLM
 # NOTE: kubegpu_tpu.models.checkpoint is deliberately NOT imported here —
@@ -65,6 +66,7 @@ __all__ = [
     "ContinuousBatcher",
     "greedy_generate",
     "quantize_params_int8",
+    "speculative_generate",
     "init_caches",
     "TransformerLM",
     "MoEMLP",

@@ -186,6 +186,11 @@ class DecodeLM(nn.Module):
     max_seq: int = 2048
     dtype: jnp.dtype = jnp.bfloat16
     quant: bool = False  # weight-only int8 (QuantDense param layout)
+    # return logits for EVERY chunk row, not just the last — speculative
+    # verification scores all k+1 positions from one forward.  Default
+    # stays last-row-only: XLA then elides the unused rows' head matmul
+    # behind the slice, which matters at prefill (L x vocab).
+    all_logits: bool = False
 
     @nn.compact
     def __call__(self, tokens, caches, pos):
@@ -218,7 +223,7 @@ class DecodeLM(nn.Module):
             logits = nn.Dense(
                 self.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head"
             )(x)
-        return logits[:, -1], new_caches
+        return (logits if self.all_logits else logits[:, -1]), new_caches
 
 
 def init_caches(batch: int, num_layers: int, num_heads: int, hidden: int,

@@ -1,0 +1,194 @@
+"""Speculative decoding: draft-proposed, target-verified greedy generation.
+
+A small DRAFT model proposes ``k`` tokens autoregressively; the TARGET
+model scores all of them in ONE chunked forward against its KV cache and
+accepts the longest prefix matching its own greedy choices, emitting one
+extra token either way (its argmax at the first divergence, or the bonus
+token after a fully-accepted block).  Greedy speculative decoding is
+LOSSLESS: the emitted sequence equals the target's plain greedy decode
+exactly, for ANY draft — the draft only changes how many target forwards
+the sequence costs (``ceil(steps/(k+1))`` with a perfect draft, up to
+``steps`` iterations with a useless one; every iteration emits at least
+one token, so termination is unconditional).
+
+TPU-first shape: ONE compiled program — a ``lax.while_loop`` whose body
+is (a ``scan`` of k draft steps) + (one target chunk forward of k+1
+rows) + vectorized accept/emit bookkeeping.  Static shapes throughout;
+per-ROW divergence (each batch row accepts a different count) rides the
+per-slot position support in ``DecodeLM``.  No cache rollback exists or
+is needed: positions only advance over the accepted prefix, and the next
+iteration's chunk overwrites every stale row before any causal mask can
+expose it — the same overwrite-before-visible property the continuous
+batcher's padded admits rely on.  The emit buffer needs no masking
+either: an iteration's junk tail sits at rows the NEXT block's write
+covers entirely (its start is this block's emit end and both spans are
+k+1 long), and a finishing row's junk lands at indices >= num_steps,
+outside the final slice.
+
+Reference anchor: SURVEY.md §2.2 — serving is a scheduled workload; this
+is the third serving execution strategy beside plain KV decode
+(models/decoding.py) and continuous batching (models/serving.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kubegpu_tpu.models.decoding import DecodeLM, init_caches
+
+
+def speculative_generate(
+    target_params,
+    draft_params,
+    prompt: jax.Array,
+    num_steps: int,
+    *,
+    k: int = 4,
+    vocab_size: int,
+    num_layers: int,
+    num_heads: int,
+    hidden: int,
+    max_seq: int,
+    draft_num_layers: int,
+    draft_num_heads: int,
+    draft_hidden: int,
+    dtype=jnp.bfloat16,
+    quant: bool = False,
+):
+    """Greedy speculative decode; returns ``(tokens, target_calls)``.
+
+    ``tokens`` is ``(b, prompt_len + num_steps)`` — identical to
+    ``greedy_generate(target_params, ...)``.  ``target_calls`` counts
+    verify iterations, the cost measure a draft is judged by.  The draft
+    shares the target's vocab/max_seq with its own depth/width."""
+    b, prompt_len = prompt.shape
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if num_steps < 1:
+        raise ValueError(f"num_steps must be >= 1, got {num_steps}")
+    # the last iteration may write one full speculative block past the
+    # budget; the caches must hold those rows even though the output is
+    # sliced to num_steps
+    if prompt_len + num_steps + k + 1 > max_seq:
+        raise ValueError(
+            f"prompt ({prompt_len}) + steps ({num_steps}) + k+1 ({k + 1}) "
+            f"exceeds max_seq ({max_seq}); speculative blocks would clamp"
+        )
+    target = DecodeLM(
+        vocab_size=vocab_size, num_layers=num_layers, num_heads=num_heads,
+        hidden=hidden, max_seq=max_seq, dtype=dtype, quant=quant,
+        all_logits=True,
+    )
+    draft = DecodeLM(
+        vocab_size=vocab_size, num_layers=draft_num_layers,
+        num_heads=draft_num_heads, hidden=draft_hidden, max_seq=max_seq,
+        dtype=dtype,
+    )
+    t_caches = init_caches(b, num_layers, num_heads, hidden, max_seq, dtype)
+    d_caches = init_caches(
+        b, draft_num_layers, draft_num_heads, draft_hidden, max_seq, dtype
+    )
+
+    def t_apply(tokens, caches, pos):
+        return target.apply({"params": target_params}, tokens, caches, pos)
+
+    def d_apply(tokens, caches, pos):
+        return draft.apply({"params": draft_params}, tokens, caches, pos)
+
+    # prefill BOTH models on the whole prompt (one causal pass each); the
+    # target's final-row logits seed the first token exactly like plain
+    # greedy decode
+    zero = jnp.zeros((), jnp.int32)
+    t_logits, t_caches = t_apply(prompt, t_caches, zero)
+    _, d_caches = d_apply(prompt, d_caches, zero)
+    first_tok = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)  # (b,)
+
+    buf_len = num_steps + k + 1  # room for the final over-budget block
+    out0 = jnp.zeros((b, buf_len), jnp.int32).at[:, 0].set(first_tok)
+
+    row_ids = jnp.arange(b)
+
+    state = {
+        "t_caches": t_caches,
+        "d_caches": d_caches,
+        "out": out0,
+        # tokens emitted per row; the newest one is emitted but not yet
+        # CONSUMED (its k/v enters the caches with the next chunk), so
+        # the next write row is prompt_len + n - 1
+        "n": jnp.ones((b,), jnp.int32),
+        "calls": jnp.zeros((), jnp.int32),
+    }
+
+    def cond(st):
+        return jnp.min(st["n"]) < num_steps
+
+    def body(st):
+        n = st["n"]
+        pos = prompt_len + n - 1                      # (b,) per-row depth
+        last = st["out"][row_ids, n - 1]              # newest emitted token
+
+        # ---- draft: k autoregressive single-token proposals ------------
+        # k+1 scan steps, not k: the extra step's PROPOSAL is discarded,
+        # but its cache write is load-bearing — it consumes p_k, so row
+        # pos+k is written.  A k-step scan would leave that row zero
+        # forever after a fully-accepted block (the draft never consumes
+        # p_k), and every later proposal would attend a hole.
+        def d_step(carry, _):
+            caches, tok, p = carry
+            logits, caches = d_apply(tok[:, None], caches, p)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (caches, nxt, p + 1), nxt
+
+        (d_caches, _, _), proposed = jax.lax.scan(
+            d_step, (st["d_caches"], last, pos), None, length=k + 1
+        )
+        proposals = proposed.T[:, :k]                 # (b, k)
+
+        # ---- target: ONE chunk forward over [last, p_1..p_k] -----------
+        chunk = jnp.concatenate([last[:, None], proposals], axis=1)
+        logits_all, t_caches = t_apply(chunk, st["t_caches"], pos)
+        # logits_all[:, i] = target's next-token dist after consuming
+        # chunk[:, :i+1] (= last, p_1..p_i); its greedy choices:
+        choices = jnp.argmax(logits_all, axis=-1).astype(jnp.int32)  # (b, k+1)
+
+        # ---- accept the longest matching prefix ------------------------
+        # match[i] = (p_{i+1} == choices[i]); accepted = first mismatch
+        # index = number of accepted proposals (k if all match — the
+        # appended False guarantees argmin finds it)
+        match = proposals == choices[:, :k]
+        accepted = jnp.argmin(
+            jnp.concatenate([match, jnp.zeros((b, 1), bool)], axis=1)
+            .astype(jnp.int32),
+            axis=1,
+        )
+        emit_len = accepted + 1
+        # the emitted block IS `choices`: for i < accepted the proposal
+        # matched choices[i] by the definition of `accepted`, and at the
+        # divergence (or bonus) position the target's own choice is what
+        # greedy emits; the tail past emit_len is junk the NEXT block's
+        # write fully overwrites
+        block = choices
+
+        out = jax.vmap(
+            lambda row, blk, start: jax.lax.dynamic_update_slice(
+                row, blk, (start,)
+            )
+        )(st["out"], block, n)
+        # rows past their budget emit nothing and stay frozen (their
+        # compute this iteration is discarded junk)
+        done = n >= num_steps
+        emit_len = jnp.where(done, 0, emit_len)
+        out = jnp.where(done[:, None], st["out"], out)
+
+        return {
+            "t_caches": t_caches,
+            "d_caches": d_caches,
+            "out": out,
+            "n": n + emit_len,
+            "calls": st["calls"] + 1,
+        }
+
+    state = jax.lax.while_loop(cond, body, state)
+    tokens = jnp.concatenate([prompt, state["out"][:, :num_steps]], axis=1)
+    return tokens, state["calls"]

@@ -295,3 +295,68 @@ def test_continuous_batching_zero_budget_and_bad_config():
     got = cb.run(prompts, [0, 3])
     assert got[0] == []
     assert len(got[1]) == 3
+
+
+def test_speculative_decode_is_lossless_for_any_draft():
+    """Greedy speculative decoding (models/speculative.py) must emit
+    EXACTLY the target's plain greedy sequence — for a draft that knows
+    nothing about the target (independent random init), for a draft that
+    IS the target (perfect acceptance), and across k values.  The
+    target-call count shows the mechanism: a perfect draft costs
+    ~steps/(k+1) verify iterations, a hopeless one at most steps."""
+    import numpy as np
+
+    from kubegpu_tpu.models.speculative import speculative_generate
+
+    params = trained_params()
+    prompt = (jnp.arange(2 * 5, dtype=jnp.int32) % CFG["vocab_size"]).reshape(2, 5)
+    steps = 10
+    ref = np.asarray(
+        greedy_generate(params, prompt, steps, dtype=jnp.float32, **CFG)
+    )
+
+    # independent draft: smaller model, different seed
+    draft_cfg = dict(vocab_size=CFG["vocab_size"], num_layers=1, num_heads=2,
+                     hidden=16, max_seq=CFG["max_seq"])
+    draft = TransformerLM(dtype=jnp.float32, **draft_cfg)
+    draft_params = draft.init(
+        jax.random.PRNGKey(7), jnp.ones((2, 8), jnp.int32)
+    )["params"]
+    for k in (1, 3):
+        out, calls = speculative_generate(
+            params, draft_params, prompt, steps, k=k, dtype=jnp.float32,
+            **CFG, draft_num_layers=1, draft_num_heads=2, draft_hidden=16,
+        )
+        np.testing.assert_array_equal(np.asarray(out), ref), k
+        assert 1 <= int(calls) <= steps
+
+    # perfect draft (the target itself): every proposal accepted, so the
+    # verify count collapses toward steps/(k+1)
+    out, calls = speculative_generate(
+        params, params, prompt, steps, k=4, dtype=jnp.float32, **CFG,
+        draft_num_layers=CFG["num_layers"], draft_num_heads=CFG["num_heads"],
+        draft_hidden=CFG["hidden"],
+    )
+    np.testing.assert_array_equal(np.asarray(out), ref)
+    assert int(calls) <= -(-steps // 5) + 1, int(calls)  # ceil(10/5)=2 (+1 slack)
+
+
+def test_speculative_decode_validates_shapes():
+    import pytest as _pytest
+
+    from kubegpu_tpu.models.speculative import speculative_generate
+
+    params = trained_params()
+    prompt = jnp.ones((1, 5), jnp.int32)
+    with _pytest.raises(ValueError, match="exceeds max_seq"):
+        speculative_generate(
+            params, params, prompt, 30, k=4, dtype=jnp.float32, **CFG,
+            draft_num_layers=CFG["num_layers"],
+            draft_num_heads=CFG["num_heads"], draft_hidden=CFG["hidden"],
+        )
+    with _pytest.raises(ValueError, match="k must"):
+        speculative_generate(
+            params, params, prompt, 4, k=0, dtype=jnp.float32, **CFG,
+            draft_num_layers=CFG["num_layers"],
+            draft_num_heads=CFG["num_heads"], draft_hidden=CFG["hidden"],
+        )
