@@ -1173,11 +1173,16 @@ def main() -> None:
         log(f"--- prewarm (deploy/prewarm.py, fresh cache {pw_dir}) ---")
         t0_pw = time.perf_counter()
         try:
+            # capture the prewarm's stdout: OUR stdout is the driver's
+            # single-JSON-line contract, and an inherited child print
+            # would pollute it
             pw = subprocess.run(
                 [sys.executable, "-m", "deploy.prewarm", "--batch", "32"],
                 env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
-                timeout=900,
+                timeout=900, stdout=subprocess.PIPE,
             )
+            for line in pw.stdout.decode().strip().splitlines():
+                log(f"  [prewarm] {line}")
             ok = pw.returncode == 0
         except (subprocess.TimeoutExpired, OSError) as e:
             log(f"prewarm FAILED ({e})")

@@ -327,7 +327,7 @@ def test_speculative_decode_is_lossless_for_any_draft():
             params, draft_params, prompt, steps, k=k, dtype=jnp.float32,
             **CFG, draft_num_layers=1, draft_num_heads=2, draft_hidden=16,
         )
-        np.testing.assert_array_equal(np.asarray(out), ref), k
+        np.testing.assert_array_equal(np.asarray(out), ref, err_msg=f"k={k}")
         assert 1 <= int(calls) <= steps
 
     # perfect draft (the target itself): every proposal accepted, so the
