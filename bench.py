@@ -816,6 +816,28 @@ def tpu_kernel_smoke(extra: dict) -> None:
 
     assert grads_finite(lambda q, k, v: flash_attention(q, k, v, True))
     e_flash = err(flash_attention(q, k, v, True))
+    # paged decode attention: mosaic must accept the scalar-prefetched
+    # page-table BlockSpecs and match the gathered dense oracle
+    from kubegpu_tpu.ops import paged_decode_attention, reference_paged_attention
+
+    pq = jax.random.normal(ks[0], (4, 8, 128), jnp.bfloat16)
+    kp = jax.random.normal(ks[1], (16, 8, 128, 128), jnp.bfloat16) * 0.3
+    vp = jax.random.normal(ks[2], (16, 8, 128, 128), jnp.bfloat16) * 0.3
+    import numpy as _np
+
+    _rs = _np.random.RandomState(0)
+    table = jnp.asarray(
+        _np.stack([_rs.choice(16, 4, replace=False) for _ in range(4)]),
+        jnp.int32,
+    )
+    lengths = jnp.asarray([1, 130, 256, 512], jnp.int32)
+    pout = jax.jit(paged_decode_attention)(pq, kp, vp, table, lengths)
+    pref = reference_paged_attention(
+        pq.astype(jnp.float32), kp.astype(jnp.float32),
+        vp.astype(jnp.float32), table, lengths,
+    )
+    e_paged = float(jnp.max(jnp.abs(pout.astype(jnp.float32) - pref)))
+    assert e_paged < 0.05, e_paged
     # every local device: with >1 chip the ring's ppermute rotation and
     # ulysses' all_to_all lower as REAL ICI collectives, not identities
     devs = jax.devices()
@@ -831,8 +853,8 @@ def tpu_kernel_smoke(extra: dict) -> None:
     assert max(e_flash, e_ring, e_uly) < 0.05, (e_flash, e_ring, e_uly)
     log(
         f"tpu kernel smoke (mosaic, shard_map x{len(devs)}): flash fwd+bwd ok, "
-        f"ring/ulysses fwd+bwd ok, max err "
-        f"{e_flash:.4f}/{e_ring:.4f}/{e_uly:.4f} (bf16)"
+        f"ring/ulysses fwd+bwd ok, paged decode ok, max err "
+        f"{e_flash:.4f}/{e_ring:.4f}/{e_uly:.4f}/{e_paged:.4f} (bf16)"
     )
     extra["tpu_kernels"] = "ok"
 

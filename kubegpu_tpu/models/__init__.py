@@ -20,6 +20,7 @@ from kubegpu_tpu.models.decoding import (
     init_caches,
     quantize_params_int8,
 )
+from kubegpu_tpu.models.paging import PagedContinuousBatcher, PagedDecodeLM
 from kubegpu_tpu.models.serving import ContinuousBatcher
 from kubegpu_tpu.models.speculative import speculative_generate
 from kubegpu_tpu.models.transformer import TransformerLM
@@ -64,6 +65,8 @@ __all__ = [
     "DecodeLM",
     "generate",
     "ContinuousBatcher",
+    "PagedContinuousBatcher",
+    "PagedDecodeLM",
     "greedy_generate",
     "quantize_params_int8",
     "speculative_generate",

@@ -8,9 +8,15 @@ from kubegpu_tpu.ops.attention import (
     ulysses_attention,
     ulysses_attention_sharded,
 )
+from kubegpu_tpu.ops.paged_attention import (
+    paged_decode_attention,
+    reference_paged_attention,
+)
 
 __all__ = [
     "flash_attention",
+    "paged_decode_attention",
+    "reference_paged_attention",
     "reference_attention",
     "ring_attention",
     "ring_attention_sharded",

@@ -1,0 +1,167 @@
+"""Paged decode attention: one query token per slot over a shared KV pool.
+
+The dense per-slot KV cache reserves ``max_seq`` rows per slot whether a
+sequence uses them or not; serving mixes of short and long sequences
+waste most of that HBM.  Paging shares one pool of fixed-size PAGES
+across every slot: a per-slot PAGE TABLE maps logical cache pages to
+physical pool pages, and attention walks the table (vLLM's PagedAttention,
+built TPU-first).
+
+Kernel shape: ``pltpu.PrefetchScalarGridSpec`` with the page table and
+per-slot lengths as scalar-prefetch operands — BlockSpec index_maps read
+the TABLE to pick which physical K/V page each grid step DMAs, so the
+gather rides the normal pallas pipeline (no in-kernel dynamic indexing of
+HBM).  Grid = (slot, logical page); the page dim is the sequential
+innermost axis carrying the online-softmax state in VMEM scratch —
+exactly the flash kernel's recipe (ops/attention.py) with pages instead
+of contiguous K blocks.  Pages past a slot's length are masked (and the
+table's tail entries just point at page 0, fetched-but-ignored).
+
+Layouts: pool pages are (heads, page_size, head_dim) — heads OUTERMOST,
+so every in-kernel contraction is an elementwise-multiply + reduction
+over a natural tile dim ((page_size, hd) tiles per head) and no
+transposes or batched dots reach mosaic (which rejects dot_general batch
+dims).  Decode attention at one token per slot is bandwidth-bound, so
+the VPU formulation costs nothing against the MXU one.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float("-inf")
+
+
+def reference_paged_attention(q, k_pool, v_pool, page_table, lengths):
+    """Oracle: gather every slot's pages dense, run masked softmax
+    attention.  q (b, h, hd); pools (pages, h, page, hd); (b, h, hd) out;
+    f32 math like the kernel."""
+    b, h, hd = q.shape
+    n_pages = page_table.shape[1]
+    page = k_pool.shape[2]
+    # (b, n_pages, h, page, hd) -> (b, h, S, hd)
+    k = jnp.moveaxis(k_pool[page_table], 1, 2).reshape(b, h, n_pages * page, hd)
+    v = jnp.moveaxis(v_pool[page_table], 1, 2).reshape(b, h, n_pages * page, hd)
+    scores = jnp.einsum(
+        "bhd,bhsd->bhs", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) / math.sqrt(hd)
+    cols = jnp.arange(n_pages * page)[None, None, :]
+    scores = jnp.where(cols < lengths[:, None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhs,bhsd->bhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_ref, l_ref, acc_ref, *, sm_scale: float, page: int):
+    """One (slot, logical-page) grid step: fold this page into the slot's
+    running softmax state.  The page dim is sequential, so m/l/acc
+    scratch persists across it for a fixed slot."""
+    b_i = pl.program_id(0)
+    p_i = pl.program_id(1)
+    n_p = pl.num_programs(1)
+
+    @pl.when(p_i == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    length = len_ref[b_i]
+
+    # pages at/after the slot's length hold nothing attendable: skip the
+    # compute (their DMA still happened; the mask would zero them anyway)
+    @pl.when(p_i * page < length)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)               # (h, hd)
+        k = k_ref[0].astype(jnp.float32)               # (h, page, hd)
+        v = v_ref[0].astype(jnp.float32)
+        # per-head scores without transposes or batched dots (mosaic
+        # rejects dot_general batch dims): broadcast-multiply and reduce
+        # the MINOR hd lanes -> (h, page)
+        scores = jnp.sum(q[:, None, :] * k, axis=-1) * sm_scale
+        cols = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1) + p_i * page
+        scores = jnp.where(cols < length, scores, NEG_INF)
+
+        m_prev = m_ref[:, :1]                           # (h, 1)
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(scores - shift)                     # (h, page)
+        correction = jnp.where(
+            jnp.isfinite(m_prev), jnp.exp(m_prev - shift), 0.0
+        )
+        l_ref[:] = jnp.broadcast_to(
+            correction * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True),
+            l_ref.shape,
+        )
+        # weighted V: (h, page, 1) * (h, page, hd) summed over page
+        acc_ref[:] = acc_ref[:] * correction + jnp.sum(
+            p[:, :, None] * v, axis=1
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(p_i == n_p - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        denom = jnp.where(l == 0.0, 1.0, l)             # length-0 slot -> 0s
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    page_table: jax.Array,
+    lengths: jax.Array,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Single-token attention over paged KV for every slot.
+
+    q: (b, h, hd); k_pool/v_pool: (n_pool_pages, h, page_size, hd);
+    page_table: (b, n_pages) int32 physical page ids (tail entries may
+    point anywhere valid — masked); lengths: (b,) int32 attendable rows.
+    Returns (b, h, hd) in q's dtype."""
+    b, h, hd = q.shape
+    _, hp, page, hdp = k_pool.shape
+    assert (hp, hdp) == (h, hd), (k_pool.shape, q.shape)
+    n_pages = page_table.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,   # page_table, lengths
+        grid=(b, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, h, hd), lambda b_i, p_i, tbl, ln: (b_i, 0, 0)),
+            pl.BlockSpec(
+                (1, h, page, hd),
+                lambda b_i, p_i, tbl, ln: (tbl[b_i, p_i], 0, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, h, page, hd),
+                lambda b_i, p_i, tbl, ln: (tbl[b_i, p_i], 0, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, h, hd), lambda b_i, p_i, tbl, ln: (b_i, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((h, 128), jnp.float32),   # running max (lane-replicated)
+            pltpu.VMEM((h, 128), jnp.float32),   # running denominator
+            pltpu.VMEM((h, hd), jnp.float32),    # running numerator
+        ],
+    )
+    return pl.pallas_call(
+        partial(_paged_kernel, sm_scale=1.0 / math.sqrt(hd), page=page),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, hd), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32), q, k_pool, v_pool)
