@@ -523,31 +523,20 @@ def steady_state_decode(extra: dict) -> None:
     extra["decode_int8_token_agreement"] = round(match, 4)
 
 
-def serving_continuous_batching(extra: dict) -> None:
-    """Continuous batching vs static batching on the 1.08B flagship
-    (models/serving.py): a queue of prompts with VARYING token budgets
-    served through fixed slots.  The hardware-independent win is the step
-    count — static batching runs every batch to its LONGEST member, so
-    short sequences burn slot-steps; continuous batching refills slots the
-    moment they free.  Wall-clock here is tunnel-RTT-bound (the host loop
-    reads one token vector per step; a co-located server pays the ~2 ms
-    step, not the ~100 ms round trip), so the step ratio is the headline
-    and wall tok/s is reported for completeness."""
-    import os
-    import time
-
+def _serving_traffic():
+    """The ONE traffic recipe both serving-batcher rows measure — the
+    paged-vs-dense comparison is only like-for-like because they share
+    this function: the 1.08B flagship's bf16 params and a 16-prompt
+    mixed-budget queue."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from kubegpu_tpu.models import TransformerLM
-    from kubegpu_tpu.models.serving import ContinuousBatcher
 
-    if os.environ.get("BENCH_CB", "1") == "0":
-        return
     vocab, hidden, layers = 32768, 4096, 4
     heads = hidden // 128
-    slots, prompt_pad, max_seq = 8, 128, 512
+    prompt_pad, max_seq = 128, 512
     model = TransformerLM(
         vocab_size=vocab, num_layers=layers, num_heads=heads, hidden=hidden,
         max_seq=max_seq,
@@ -568,10 +557,33 @@ def serving_continuous_batching(extra: dict) -> None:
         rs.randint(0, vocab, size=rs.randint(16, prompt_pad), dtype=np.int32)
         for _ in budgets
     ]
-    cb = ContinuousBatcher(
-        params, vocab_size=vocab, num_layers=layers, num_heads=heads,
-        hidden=hidden, max_seq=max_seq, slots=slots, prompt_pad=prompt_pad,
+    cfg = dict(
+        vocab_size=vocab, num_layers=layers, num_heads=heads, hidden=hidden,
+        max_seq=max_seq, slots=8, prompt_pad=prompt_pad,
     )
+    return params, prompts, budgets, cfg
+
+
+def serving_continuous_batching(extra: dict) -> None:
+    """Continuous batching vs static batching on the 1.08B flagship
+    (models/serving.py): a queue of prompts with VARYING token budgets
+    served through fixed slots.  The hardware-independent win is the step
+    count — static batching runs every batch to its LONGEST member, so
+    short sequences burn slot-steps; continuous batching refills slots the
+    moment they free.  Wall-clock here is tunnel-RTT-bound (the host loop
+    reads one token vector per step; a co-located server pays the ~2 ms
+    step, not the ~100 ms round trip), so the step ratio is the headline
+    and wall tok/s is reported for completeness."""
+    import os
+    import time
+
+    from kubegpu_tpu.models.serving import ContinuousBatcher
+
+    if os.environ.get("BENCH_CB", "1") == "0":
+        return
+    params, prompts, budgets, cfg = _serving_traffic()
+    slots = cfg["slots"]
+    cb = ContinuousBatcher(params, **cfg)
     t0 = time.perf_counter()
     out = cb.run(prompts, budgets)
     dt = time.perf_counter() - t0
@@ -596,6 +608,50 @@ def serving_continuous_batching(extra: dict) -> None:
     extra["cb_static_steps"] = static_steps
     extra["cb_step_efficiency"] = round(ratio, 3)
     extra["cb_wall_s"] = round(dt, 1)
+
+
+def serving_paged(extra: dict) -> None:
+    """Paged continuous batching on the 1.08B flagship: the same traffic
+    mix as the dense CB row served from a shared page pool sized to the
+    MIX (not slots x max_seq) — the row reports the measured cache-HBM
+    ratio alongside throughput.  Wall-clock is tunnel-RTT-bound like the
+    dense row; the steps/admits and memory numbers are the signal."""
+    import os
+    import time
+
+    from kubegpu_tpu.models.paging import PagedContinuousBatcher
+
+    if os.environ.get("BENCH_PAGED", "1") == "0":
+        return
+    params, prompts, budgets, cfg = _serving_traffic()
+    slots, max_seq, page = cfg["slots"], cfg["max_seq"], 128
+    # pool sized to the mix: worst concurrent need is 8 slots x
+    # ceil((128+256)/128)=3 pages + the dump page
+    pool_pages = slots * 3 + 1
+    cb = PagedContinuousBatcher(
+        params, **cfg, page_size=page, pool_pages=pool_pages,
+    )
+    t0 = time.perf_counter()
+    out = cb.run(prompts, budgets)
+    dt = time.perf_counter() - t0
+    total = sum(len(v) for v in out.values())
+    paged_rows = pool_pages * page
+    dense_rows = slots * max_seq
+    log(
+        f"paged continuous batching (1.08B bf16, {slots} slots, page {page}, "
+        f"pool {pool_pages} pages): {total} tokens in {cb.stats['steps']} "
+        f"steps + {cb.stats['admits']} admits, peak {cb.stats['peak_pages']} "
+        f"pages; cache HBM {paged_rows} rows vs dense-slot {dense_rows} "
+        f"({dense_rows / paged_rows:.2f}x saved); wall {dt:.1f} s "
+        f"({total / dt:.0f} tok/s through the tunnel's per-step RTT)"
+    )
+    extra["paged_tokens"] = total
+    extra["paged_steps"] = cb.stats["steps"]
+    extra["paged_peak_pages"] = cb.stats["peak_pages"]
+    extra["paged_pool_rows"] = paged_rows
+    extra["paged_dense_rows"] = dense_rows
+    extra["paged_hbm_ratio"] = round(dense_rows / paged_rows, 3)
+    extra["paged_wall_s"] = round(dt, 1)
 
 
 def steady_state_moe(extra: dict) -> None:
@@ -1249,6 +1305,7 @@ def main() -> None:
     steady_state_longctx(extra)
     steady_state_decode(extra)
     serving_continuous_batching(extra)
+    serving_paged(extra)
     steady_state_moe(extra)
     pipeline_bubble_row(extra)
     tpu_kernel_smoke(extra)

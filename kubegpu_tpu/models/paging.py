@@ -14,10 +14,12 @@ across all slots (vLLM's core idea, built TPU-first):
 
 Numerics: the paged kernel accumulates scores/softmax in f32 (the flash
 kernel's discipline), while the dense ``DecodeAttention`` scores in the
-model dtype to mirror training.  At fp32 the paths agree exactly (the
-batcher's token-exactness tests run there); at bf16, near-tied logits
-may round to a different argmax than the dense path — the same caveat
-flash-vs-einsum attention carries in training.
+model dtype to mirror training.  At fp32 the paths agree to rounding
+(online vs one-shot softmax reassociate differently; the batcher's
+token-exactness tests verify argmax-exact behavior on their configs);
+at bf16, near-tied logits may round to a different argmax than the
+dense path — the same caveat flash-vs-einsum attention carries in
+training.
 - ``PagedContinuousBatcher``: the serving loop.  Admits prefill DENSELY
   (one b=1 causal pass — prefill is compute-bound and pages buy nothing
   there), then scatter the used rows into freshly-allocated pages and
@@ -401,8 +403,11 @@ class PagedContinuousBatcher:
                                 self.pool_pages - len(self.free_pages),
                             )
                             progress = True
-                        # else: pool full — every later prompt waits too
-                        # (FIFO), so stop trying this pass
+                        # else: pool full for the FIFO head — the loop
+                        # deliberately CONTINUES so this pass's later
+                        # retirements can free pages and re-trigger the
+                        # head's admission on the next sweep iteration
+                        # (later prompts wait behind the head either way)
 
         retire_and_admit()
         if queue and not any(s.active for s in self._seqs):
