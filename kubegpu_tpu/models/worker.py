@@ -429,6 +429,76 @@ def _run_pp(args, t0: float) -> int:
     )
 
 
+def _run_decode_batched(args, params, max_seq: int, t0: float) -> int:
+    """--serving continuous|paged: serve a mixed-length queue through the
+    slot-based batchers.  One "request wave" = slots x 2 prompts with
+    budgets cycling 1/4..1x --steps; --serve replays waves forever (the
+    replica loop), else one wave is timed and reported."""
+    import numpy as np
+
+    common = dict(
+        vocab_size=args.vocab, num_layers=args.layers, num_heads=args.heads,
+        hidden=args.hidden, max_seq=max_seq,
+        slots=args.batch_per_chip, prompt_pad=args.prompt_len,
+    )
+    if args.serving == "continuous":
+        from kubegpu_tpu.models.serving import ContinuousBatcher
+
+        cb = ContinuousBatcher(params, **common, quant=args.int8)
+    else:
+        from kubegpu_tpu.models.paging import PagedContinuousBatcher
+
+        # page must divide prompt_pad (whole-page admit scatter): 128 when
+        # it divides, else one page spans the whole prompt pad
+        page = 128 if args.prompt_len % 128 == 0 else args.prompt_len
+        slots = args.batch_per_chip
+        pool = slots * -(-(args.prompt_len + args.steps) // page) + 1
+        cb = PagedContinuousBatcher(
+            params, **common, quant=args.int8, page_size=page,
+            pool_pages=pool,
+        )
+
+    rng = np.random.RandomState(0)
+    n_req = args.batch_per_chip * 2
+    budgets = [
+        max(args.steps * (1 + i % 4) // 4, 1) for i in range(n_req)
+    ]
+
+    def wave():
+        prompts = [
+            rng.randint(
+                0, args.vocab, size=rng.randint(1, args.prompt_len + 1),
+                dtype=np.int32,
+            )
+            for _ in range(n_req)
+        ]
+        tw = time.monotonic()
+        out = cb.run(prompts, budgets)
+        dt = time.monotonic() - tw
+        total = sum(len(v) for v in out.values())
+        return total, dt
+
+    wave()  # warmup: the first wave pays the step/admit compiles
+    print(
+        f"FIRST_DECODE_DONE seconds={time.monotonic() - t0:.2f}", flush=True
+    )
+    # the timed wave runs warm, like the static path's post-warmup timing
+    total, dt = wave()
+    print(
+        f"DECODE_DONE tokens_per_sec={total / dt:.1f} serving={args.serving} "
+        f"requests={n_req} steps={cb.stats['steps']} "
+        f"admits={cb.stats['admits']}",
+        flush=True,
+    )
+    if args.serve:
+        while True:
+            total, dt = wave()
+            print(
+                f"SERVING tokens_per_sec={total / dt:.1f}", flush=True
+            )
+    return 0
+
+
 def _run_decode(args, t0: float) -> int:
     """Serving mode: KV-cached greedy decode (models/decoding.py) of the
     lm family's param contract.  With --ckpt-dir it restores the TRAINED
@@ -495,6 +565,8 @@ def _run_decode(args, t0: float) -> int:
         print("SERVING_INT8 weight-only per-output-channel", flush=True)
 
     batch = args.batch_per_chip
+    if args.serving != "static":
+        return _run_decode_batched(args, params, max_seq, t0)
     prompt = jax.random.randint(
         jax.random.PRNGKey(1), (batch, args.prompt_len), 0, args.vocab, jnp.int32
     )
@@ -599,6 +671,12 @@ def main(argv=None) -> int:
     ap.add_argument("--int8", action="store_true",
                     help="decode: serve weight-only int8 (per-output-"
                     "channel scales; halves the per-step parameter stream)")
+    ap.add_argument("--serving", choices=["static", "continuous", "paged"],
+                    default="static",
+                    help="decode execution strategy: static = aligned-batch "
+                    "greedy (default); continuous = slot-based continuous "
+                    "batching (models/serving.py); paged = continuous "
+                    "batching over a shared KV page pool (models/paging.py)")
     ap.add_argument(
         "--ckpt-dir",
         default=os.environ.get("KUBEGPU_CKPT_DIR", ""),

@@ -164,3 +164,18 @@ def test_decode_rejects_oversized_request():
             "--prompt-len", "4", "--vocab", "64", "--layers", "1",
             "--heads", "2", "--hidden", "16",
         ])
+
+
+@pytest.mark.parametrize("serving", ["continuous", "paged"])
+def test_decode_mode_serves_batched_strategies(capsys, serving):
+    """--serving continuous|paged: the slot batchers behind the worker CLI
+    serve a mixed wave and report throughput/steps/admits."""
+    rc = worker.main([
+        "--model", "decode", "--steps", "4", "--batch-per-chip", "2",
+        "--vocab", "64", "--layers", "1", "--heads", "2", "--hidden", "16",
+        "--seq", "16", "--prompt-len", "4", "--serving", serving,
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert f"serving={serving}" in out and "DECODE_DONE" in out
+    assert "admits=4" in out  # 2 slots x 2 = 4 requests through the wave
