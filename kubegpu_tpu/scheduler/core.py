@@ -181,6 +181,9 @@ class Scheduler:
         self._conflict_strikes: Dict[str, int] = {}
         # gang key -> (consecutive no-progress resyncs, bound-member set)
         self._stranded_strikes: Dict[str, tuple] = {}
+        # gangs already warned about over-subscribed done arithmetic: the
+        # condition persists across resyncs and must not log every tick
+        self._suspect_warned: set = set()
         # serializes the failure-detector entry points: the resync thread
         # and the node-watch thread both mutate the strike maps and run the
         # eviction sweep — unserialized, the watch can resize a dict mid-
@@ -1019,6 +1022,7 @@ class Scheduler:
         # (fully GC'd): nothing is left to judge, and a later gang reusing
         # the name must start clean
         self.groups.prune_done(gangs)
+        self._suspect_warned &= set(gangs)
         stranded = {}
         outstanding = {}
         for gk, g in gangs.items():
@@ -1037,13 +1041,18 @@ class Scheduler:
                 # DELETES running pods — decline to judge.  The planner's
                 # full-size fallback still lets the new run form; worst
                 # case is a capacity leak an operator can see, never a
-                # healthy gang destroyed.
-                log.warning(
-                    "gang %s: completed-member arithmetic over-subscribed "
-                    "(name reused without %s?); skipping stranded-gang "
-                    "judgment", gk, annotations.POD_GROUP_UID,
-                )
+                # healthy gang destroyed.  Warned once per episode — the
+                # condition persists across resyncs.
+                if gk not in self._suspect_warned:
+                    self._suspect_warned.add(gk)
+                    log.warning(
+                        "gang %s: completed-member arithmetic over-"
+                        "subscribed (name reused without %s?); skipping "
+                        "stranded-gang judgment while it persists",
+                        gk, annotations.POD_GROUP_UID,
+                    )
                 continue
+            self._suspect_warned.discard(gk)
             if 0 < len(g["bound"]) < size:
                 stranded[gk] = tuple(sorted(g["bound"]))
                 outstanding[gk] = size
