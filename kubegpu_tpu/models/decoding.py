@@ -318,6 +318,27 @@ def generate(
 NEG_INF_LOGIT = -1e9  # large-negative in f32; -inf breaks categorical's gumbel
 
 
+def pick_tokens(logits, temps, keys, top_k: int = 0):
+    """Per-SLOT token choice for the serving batchers: row i samples from
+    ``softmax(logits_i / temps_i)`` when ``temps_i > 0`` (optionally
+    top_k-truncated) and takes the greedy argmax otherwise — mixed
+    greedy/sampled batches in one fixed-shape program.
+
+    logits (b, vocab) f32; temps (b,) f32; keys (b, 2) uint32 (per-slot
+    PRNG keys — each slot's stream is independent of its neighbors');
+    ``top_k`` is static (0 = no truncation)."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    safe_t = jnp.where(temps > 0.0, temps, 1.0)
+    scaled = logits / safe_t[:, None]
+    if top_k > 0:
+        kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+        scaled = jnp.where(scaled >= kth, scaled, NEG_INF_LOGIT)
+    sampled = jax.vmap(
+        lambda key, row: jax.random.categorical(key, row)
+    )(keys, scaled).astype(jnp.int32)
+    return jnp.where(temps > 0.0, sampled, greedy)
+
+
 def greedy_generate(params, prompt, num_steps, **kw) -> jax.Array:
     """Greedy decode (temperature 0) — see :func:`generate`."""
     return generate(params, prompt, num_steps, temperature=0.0, **kw)

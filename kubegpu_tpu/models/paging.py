@@ -190,6 +190,8 @@ class PagedContinuousBatcher:
         eos_id: Optional[int] = None,
         dtype=jnp.bfloat16,
         quant: bool = False,
+        top_k: int = 0,
+        seed: int = 0,
     ) -> None:
         if prompt_pad > max_seq:
             raise ValueError(
@@ -244,16 +246,31 @@ class PagedContinuousBatcher:
         self.pos = np.zeros((slots,), np.int32)  # rows already consumed
         self._seqs = [_Seq() for _ in range(slots)]
         self._last = np.zeros((slots,), np.int32)
+        # per-request sampling state (the dense batcher's exact recipe:
+        # fold_in(fold_in(seed, seq_id), nth-token) keys, 0 = greedy)
+        if top_k > vocab_size:
+            raise ValueError(
+                f"top_k ({top_k}) exceeds vocab_size ({vocab_size})"
+            )
+        self.top_k = top_k
+        self._root_key = jax.random.PRNGKey(seed)
+        # device-resident, admission-updated (the dense batcher's pattern)
+        self._temps = jnp.zeros((slots,), jnp.float32)
+        self._base_keys = jnp.zeros((slots, 2), jnp.uint32)
 
-        def step(params, pools, last_tokens, table, pos):
+        from kubegpu_tpu.models.decoding import pick_tokens
+
+        def step(params, pools, last_tokens, table, pos, temps, base_keys,
+                 counts):
             logits, pools = self.model.apply(
                 {"params": params}, last_tokens[:, None], pools, table, pos
             )
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), pools
+            keys = jax.vmap(jax.random.fold_in)(base_keys, counts)
+            return pick_tokens(logits, temps, keys, self.top_k), pools
 
         self._step = jax.jit(step, donate_argnums=(1,))
 
-        def prefill(params, prompt_row, prompt_len):
+        def prefill(params, prompt_row, prompt_len, temp, key):
             # dense b=1 prefill (padded, causal) + one single-token pass at
             # the real depth for the first generated token — the dense
             # batcher's exact admit recipe.  The dense twin's pos-embed
@@ -276,7 +293,7 @@ class PagedContinuousBatcher:
                 {"params": params}, last_real[None, :], caches,
                 (prompt_len - 1)[None],
             )
-            first = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+            first = pick_tokens(logits, temp[None], key[None], self.top_k)[0]
             # (layer, k/v, prompt_pad rows) densely; host scatters pages
             return first, caches
 
@@ -313,7 +330,7 @@ class PagedContinuousBatcher:
 
     # -- admission ---------------------------------------------------------
     def _try_admit(self, slot: int, seq_id: int, prompt: np.ndarray,
-                   max_new: int) -> bool:
+                   max_new: int, temperature: float = 0.0) -> bool:
         plen = int(prompt.shape[0])
         if plen > self.prompt_pad:
             raise ValueError(
@@ -339,8 +356,12 @@ class PagedContinuousBatcher:
         pages = [self.free_pages.pop() for _ in range(need)]
         row = np.zeros((self.prompt_pad,), np.int32)
         row[:plen] = prompt
+        base_key = jax.random.fold_in(self._root_key, seq_id)
+        self._temps = self._temps.at[slot].set(temperature)
+        self._base_keys = self._base_keys.at[slot].set(base_key)
         first, dense_caches = self._prefill(
-            self.params, jnp.asarray(row), jnp.int32(plen)
+            self.params, jnp.asarray(row), jnp.int32(plen),
+            jnp.float32(temperature), jax.random.fold_in(base_key, 0),
         )
         # scatter every page the PROMPT touches (rows past it are masked);
         # later pages only ever receive decode-step writes.  phys ids are
@@ -367,9 +388,14 @@ class PagedContinuousBatcher:
 
     # -- the serve loop ----------------------------------------------------
     def run(
-        self, prompts: List[np.ndarray], max_new_tokens: List[int]
+        self,
+        prompts: List[np.ndarray],
+        max_new_tokens: List[int],
+        temperatures: Optional[List[float]] = None,
     ) -> Dict[int, List[int]]:
         assert len(prompts) == len(max_new_tokens)
+        temps = temperatures or [0.0] * len(prompts)
+        assert len(temps) == len(prompts)
         queue = list(range(len(prompts)))
         done: Dict[int, List[int]] = {}
         self.stats = {"steps": 0, "admits": 0, "peak_pages": 0}
@@ -394,7 +420,8 @@ class PagedContinuousBatcher:
                     if s.seq_id < 0 and queue:
                         nxt = queue[0]
                         if self._try_admit(
-                            i, nxt, prompts[nxt], max_new_tokens[nxt]
+                            i, nxt, prompts[nxt], max_new_tokens[nxt],
+                            temps[nxt],
                         ):
                             queue.pop(0)
                             self.stats["admits"] += 1
@@ -416,9 +443,13 @@ class PagedContinuousBatcher:
                 "live — pool_pages too small for the traffic"
             )
         while any(s.active for s in self._seqs):
+            counts = np.array(
+                [len(sq.tokens) for sq in self._seqs], np.int32
+            )
             toks, self.pools = self._step(
                 self.params, self.pools, jnp.asarray(self._last),
                 jnp.asarray(self.tables), jnp.asarray(self.pos),
+                self._temps, self._base_keys, jnp.asarray(counts),
             )
             self.stats["steps"] += 1
             toks_host = np.asarray(toks)

@@ -69,6 +69,8 @@ class ContinuousBatcher:
         eos_id: Optional[int] = None,
         dtype=jnp.bfloat16,
         quant: bool = False,
+        top_k: int = 0,
+        seed: int = 0,
     ) -> None:
         if prompt_pad > max_seq:
             raise ValueError(
@@ -81,6 +83,21 @@ class ContinuousBatcher:
         self.prompt_pad = prompt_pad
         self.max_seq = max_seq
         self.eos_id = eos_id
+        # per-request sampling: each request carries a temperature (0 =
+        # greedy); keys derive deterministically as fold_in(fold_in(seed,
+        # seq_id), step) so slot reuse and neighbors never perturb a
+        # sequence's stream.  top_k is static program structure (one
+        # truncation width per batcher).
+        if top_k > vocab_size:
+            raise ValueError(
+                f"top_k ({top_k}) exceeds vocab_size ({vocab_size})"
+            )
+        self.top_k = top_k
+        self._root_key = jax.random.PRNGKey(seed)
+        # device-resident (updated only at admission): the hot step loop
+        # must not re-upload unchanged sampling state every token
+        self._temps = jnp.zeros((slots,), jnp.float32)
+        self._base_keys = jnp.zeros((slots, 2), jnp.uint32)
         cfg = dict(
             vocab_size=vocab_size, num_layers=num_layers,
             num_heads=num_heads, hidden=hidden, max_seq=max_seq,
@@ -94,15 +111,22 @@ class ContinuousBatcher:
         self.pos = jnp.zeros((slots,), jnp.int32)
         self._slots = [_Slot() for _ in range(slots)]
 
-        def step(params, caches, last_tokens, pos):
+        from kubegpu_tpu.models.decoding import pick_tokens
+
+        def step(params, caches, last_tokens, pos, temps, base_keys, counts):
             # one decode step for EVERY slot at its own depth; inactive
-            # slots compute garbage that the host never collects
+            # slots compute garbage that the host never collects.  counts
+            # = tokens already emitted per slot: a sequence's nth sample
+            # always draws from fold_in(its base key, n), so neighbors
+            # and slot scheduling never perturb its stream
             logits, caches = self.model.apply(
                 {"params": params}, last_tokens[:, None], caches, pos
             )
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), caches
+            keys = jax.vmap(jax.random.fold_in)(base_keys, counts)
+            return pick_tokens(logits, temps, keys, self.top_k), caches
 
-        def admit(params, caches, pos, prompt_row, prompt_len, slot):
+        def admit(params, caches, pos, prompt_row, prompt_len, slot, temp,
+                  key):
             # prefill ONE padded prompt on a fresh b=1 cache, then splice
             # that cache into the shared one at `slot` (batch-axis
             # dynamic_update_slice); the first generated token is the
@@ -128,7 +152,9 @@ class ContinuousBatcher:
                 {"params": params}, last_real[None, :], fresh,
                 (prompt_len - 1)[None],
             )
-            first_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+            first_tok = pick_tokens(
+                logits, temp[None], key[None], self.top_k
+            )[0]
             new_caches = []
             for (ck, cv), (fk, fv) in zip(caches, fresh):
                 new_caches.append((
@@ -144,7 +170,7 @@ class ContinuousBatcher:
 
     # -- host-side orchestration -------------------------------------------
     def _admit_one(self, slot_idx: int, seq_id: int, prompt: np.ndarray,
-                   max_new: int) -> None:
+                   max_new: int, temperature: float = 0.0) -> None:
         if max_new <= 0:
             # match generate(num_steps=0): nothing owed, nothing emitted —
             # the admit program would still produce a first token
@@ -163,9 +189,13 @@ class ContinuousBatcher:
             )
         row = np.zeros((self.prompt_pad,), np.int32)
         row[:plen] = prompt
+        base_key = jax.random.fold_in(self._root_key, seq_id)
+        self._temps = self._temps.at[slot_idx].set(temperature)
+        self._base_keys = self._base_keys.at[slot_idx].set(base_key)
         first_tok, self.caches, self.pos = self._admit(
             self.params, self.caches, self.pos,
             jnp.asarray(row), jnp.int32(plen), jnp.int32(slot_idx),
+            jnp.float32(temperature), jax.random.fold_in(base_key, 0),
         )
         s = self._slots[slot_idx]
         s.seq_id, s.active = seq_id, True
@@ -181,11 +211,17 @@ class ContinuousBatcher:
         self,
         prompts: List[np.ndarray],
         max_new_tokens: List[int],
+        temperatures: Optional[List[float]] = None,
     ) -> Dict[int, List[int]]:
         """Serve every prompt to completion; returns {seq_id: generated
         tokens}.  ``stats['steps']`` afterwards holds the number of step
-        programs executed (the efficiency measure vs static batching)."""
+        programs executed (the efficiency measure vs static batching).
+        ``temperatures`` is per-request (0/None = greedy; >0 samples from
+        softmax(logits/T), truncated to the batcher's ``top_k``) — mixed
+        greedy/sampled requests share the batch."""
         assert len(prompts) == len(max_new_tokens)
+        temps = temperatures or [0.0] * len(prompts)
+        assert len(temps) == len(prompts)
         queue = list(range(len(prompts)))
         done: Dict[int, List[int]] = {}
         self.stats = {"steps": 0, "admits": 0}
@@ -206,15 +242,20 @@ class ContinuousBatcher:
                     if s.seq_id < 0 and queue:
                         nxt = queue.pop(0)
                         self._admit_one(
-                            i, nxt, prompts[nxt], max_new_tokens[nxt]
+                            i, nxt, prompts[nxt], max_new_tokens[nxt],
+                            temps[nxt],
                         )
                         self.stats["admits"] += 1
                         progress = True
 
         retire_and_admit()
         while any(s.active for s in self._slots):
+            counts = np.array(
+                [len(s.tokens) for s in self._slots], np.int32
+            )
             toks, self.caches = self._step(
-                self.params, self.caches, self._last_tokens, self.pos
+                self.params, self.caches, self._last_tokens, self.pos,
+                self._temps, self._base_keys, jnp.asarray(counts),
             )
             self.stats["steps"] += 1
             toks_host = np.asarray(toks)
