@@ -341,16 +341,21 @@ class PagedContinuousBatcher:
                 f"prompt {plen} + max_new {max_new} exceeds max_seq "
                 f"{self.max_seq}"
             )
+        s = self._seqs[slot]
+        if max_new <= 0:
+            # no-op admit BEFORE the pool-capacity check: a zero-budget
+            # request allocates zero pages, and the dense batcher admits
+            # the same input as a no-op — the two must agree on every
+            # input (their shared contract; see
+            # test_batchers_agree_on_oversized_prompt_with_zero_budget)
+            s.seq_id, s.active, s.tokens, s.remaining = seq_id, False, [], 0
+            return True
         need = self._pages_for(plen, max_new)
         if need > self.pool_pages - 1:  # page 0 is the dump page
             raise ValueError(
                 f"request needs {need} pages; the pool has "
                 f"{self.pool_pages - 1} allocatable"
             )
-        s = self._seqs[slot]
-        if max_new <= 0:
-            s.seq_id, s.active, s.tokens, s.remaining = seq_id, False, [], 0
-            return True
         if need > len(self.free_pages):
             return False  # defer until retirements free pages
         pages = [self.free_pages.pop() for _ in range(need)]

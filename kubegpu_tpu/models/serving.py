@@ -171,12 +171,10 @@ class ContinuousBatcher:
     # -- host-side orchestration -------------------------------------------
     def _admit_one(self, slot_idx: int, seq_id: int, prompt: np.ndarray,
                    max_new: int, temperature: float = 0.0) -> None:
-        if max_new <= 0:
-            # match generate(num_steps=0): nothing owed, nothing emitted —
-            # the admit program would still produce a first token
-            s = self._slots[slot_idx]
-            s.seq_id, s.active, s.tokens, s.remaining = seq_id, False, [], 0
-            return
+        # validate BEFORE the max_new<=0 short-circuit so an oversized
+        # prompt is rejected regardless of max_new — the paged batcher
+        # (_try_admit) validates in this order and the two must agree on
+        # the same input (ADVICE r4)
         plen = int(prompt.shape[0])
         if plen > self.prompt_pad:
             raise ValueError(
@@ -187,6 +185,12 @@ class ContinuousBatcher:
                 f"prompt {plen} + max_new {max_new} exceeds max_seq "
                 f"{self.max_seq}"
             )
+        if max_new <= 0:
+            # match generate(num_steps=0): nothing owed, nothing emitted —
+            # the admit program would still produce a first token
+            s = self._slots[slot_idx]
+            s.seq_id, s.active, s.tokens, s.remaining = seq_id, False, [], 0
+            return
         row = np.zeros((self.prompt_pad,), np.int32)
         row[:plen] = prompt
         base_key = jax.random.fold_in(self._root_key, seq_id)

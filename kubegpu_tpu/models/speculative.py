@@ -125,8 +125,16 @@ def speculative_generate(
 
     def body(st):
         n = st["n"]
-        pos = prompt_len + n - 1                      # (b,) per-row depth
-        last = st["out"][row_ids, n - 1]              # newest emitted token
+        # Done rows (n can reach num_steps+k after a fully-accepted final
+        # block) keep executing junk iterations while other rows finish.
+        # Clamp their read/write depth to the last real position so every
+        # cache write provably stays within the max_seq guard's budget —
+        # without this the safety of their out-of-range writes would rest
+        # on dynamic_update_slice index clamping folding the chunk back
+        # into the row's own (frozen, per-batch-row) cache (ADVICE r4).
+        n_eff = jnp.minimum(n, num_steps)
+        pos = prompt_len + n_eff - 1                  # (b,) per-row depth
+        last = st["out"][row_ids, n_eff - 1]          # newest emitted token
 
         # ---- draft: k autoregressive single-token proposals ------------
         # k+1 scan steps, not k: the extra step's PROPOSAL is discarded,
@@ -174,7 +182,7 @@ def speculative_generate(
             lambda row, blk, start: jax.lax.dynamic_update_slice(
                 row, blk, (start,)
             )
-        )(st["out"], block, n)
+        )(st["out"], block, n_eff)
         # rows past their budget emit nothing and stay frozen (their
         # compute this iteration is discarded junk)
         done = n >= num_steps

@@ -89,18 +89,8 @@ def _consensus_size(sizes: List[int]) -> int:
     return min(s for s, c in counts.items() if c == top)
 
 
-def _consensus_str(values: List[str]) -> str:
-    """Most-common string, ties toward the lexicographically smaller —
-    the incarnation-id analog of _consensus_size (one member carrying a
-    stale pod-group-uid must not move which incarnation the gang is
-    judged as)."""
-    if not values:
-        return ""
-    counts: Dict[str, int] = {}
-    for v in values:
-        counts[v] = counts.get(v, 0) + 1
-    top = max(counts.values())
-    return min(v for v, c in counts.items() if c == top)
+# incarnation consensus (_consensus_size's string analog) lives in
+# podgroup.gang_arithmetic now, applied inside the one shared formula
 
 
 class Scheduler:
@@ -1027,12 +1017,11 @@ class Scheduler:
         outstanding = {}
         for gk, g in gangs.items():
             # shared formula with the planner (gang_arithmetic), judged
-            # against the LIVE members' incarnation (consensus, like the
-            # size): an old run's remembered completions must not shrink a
-            # new run's denominator
-            inc = _consensus_str(g["incarnations"])
+            # against the LIVE members' incarnation (consensus, computed
+            # inside gang_arithmetic like the planner's): an old run's
+            # remembered completions must not shrink a new run's denominator
             size, suspect = self.groups.gang_arithmetic(
-                gk, _consensus_size(g["sizes"]), g["live"], inc
+                gk, _consensus_size(g["sizes"]), g["live"], g["incarnations"]
             )
             if suspect:
                 # over-subscribed arithmetic (gang name reused without

@@ -28,6 +28,37 @@ from kubegpu_tpu.utils.apiserver import NotFound
 log = logging.getLogger(__name__)
 
 
+def consensus_str(values: List[str]) -> str:
+    """Most-common string, ties toward the lexicographically smaller —
+    one member carrying a stale pod-group-uid must not move which
+    incarnation the gang is judged as.  Lives here (not core) because
+    gang_arithmetic applies it for BOTH the planner and the stranded
+    sweep — the incarnation is derived identically at every call site."""
+    if not values:
+        return ""
+    counts: Dict[str, int] = {}
+    for v in values:
+        counts[v] = counts.get(v, 0) + 1
+    top = max(counts.values())
+    return min(v for v, c in counts.items() if c == top)
+
+
+def _incarnations_of(pod: "PodInfo", pending, scheduled) -> List[str]:
+    """The uid list the planner hands gang_arithmetic — NON-terminating
+    members only, mirroring the stranded sweep's ``g["incarnations"]``
+    (which skips terminal AND terminating pods before recording a uid).
+    During a name-reuse transition the old run's members are exactly the
+    terminating ones; including them could flip the consensus to the old
+    incarnation and shrink the new run's denominator by the old run's
+    completions.  Falls back to the triggering pod's own uid when every
+    gathered member is terminating (the sweep would not judge such a gang
+    at all, so no divergence is possible there)."""
+    uids = [
+        p.pod_group_uid for p in pending + scheduled if not p.terminating
+    ]
+    return uids or [pod.pod_group_uid]
+
+
 def fold_layout(sched_slices, sched_coords):
     """(layout counts, occupied coords per slice) from a gang's scheduled
     members — the ONE aggregation both planning (try_plan) and preemption
@@ -100,13 +131,21 @@ class PodGroupRegistry:
             return len(self._done.get(gk, {}).get(incarnation, ()))
 
     def gang_arithmetic(
-        self, gk: str, size: int, n_live: int, incarnation: str = ""
+        self, gk: str, size: int, n_live: int, incarnations: List[str]
     ) -> Tuple[int, bool]:
         """(outstanding, suspect) — the ONE formula the planner
         (try_plan/planned_members) and the stranded-gang sweep share, so
         their gang arithmetic can never diverge: outstanding = the
         declared size minus every member of THIS incarnation remembered
         Succeeded (work done, no replacement owed).
+
+        ``incarnations`` is the live members' pod-group-uids; the judged
+        incarnation is their consensus, computed HERE so planner and
+        sweep cannot derive it differently (ADVICE r4: the planner used
+        to pass the triggering pod's own uid while the sweep took the
+        consensus over all live members — with mixed-uid members during
+        a name-reuse transition the two could judge different
+        incarnations and disagree).
 
         `suspect` flags over-subscription: MORE live (non-terminal)
         members than the arithmetic leaves room for.  With incarnation
@@ -121,7 +160,7 @@ class PodGroupRegistry:
         sub-gang), and the sweep declines to roll anything back (the
         arithmetic is ambiguous; deleting running pods on ambiguity is
         the one unacceptable direction)."""
-        done = self.done_count(gk, incarnation)
+        done = self.done_count(gk, consensus_str(incarnations))
         out = size - done
         suspect = n_live > out
         if suspect:
@@ -286,7 +325,7 @@ class PodGroupRegistry:
                 gk,
                 pod.pod_group_size,
                 len(pending) + len(scheduled),
-                pod.pod_group_uid,
+                _incarnations_of(pod, pending, scheduled),
             )
             if len(pending) + len(scheduled) < outstanding:
                 return PlanOutcome(
@@ -430,7 +469,7 @@ class PodGroupRegistry:
             self.group_key(pod),
             pod.pod_group_size,
             len(pending) + len(scheduled),
-            pod.pod_group_uid,
+            _incarnations_of(pod, pending, scheduled),
         )
         if len(pending) + len(scheduled) < outstanding:
             return None

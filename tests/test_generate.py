@@ -407,6 +407,40 @@ def test_continuous_batching_mixed_sampling():
         assert k1[i] == base[i]
 
 
+def test_batchers_agree_on_oversized_prompt_with_zero_budget():
+    """An oversized prompt must be rejected regardless of max_new: the
+    dense batcher used to short-circuit on max_new<=0 BEFORE validating
+    prompt length while the paged one validated first, so the same bad
+    input silently succeeded on one and raised on the other (ADVICE r4)."""
+    import numpy as np
+
+    from kubegpu_tpu.models.paging import PagedContinuousBatcher
+    from kubegpu_tpu.models.serving import ContinuousBatcher
+
+    params = trained_params()
+    too_long = np.arange(9, dtype=np.int32)  # prompt_pad is 8
+    dense = ContinuousBatcher(
+        params, slots=1, prompt_pad=8, dtype=jnp.float32, **CFG
+    )
+    with pytest.raises(ValueError, match="prompt_pad"):
+        dense.run([too_long], [0])
+    paged = PagedContinuousBatcher(
+        params, slots=1, prompt_pad=8, page_size=8, pool_pages=8,
+        dtype=jnp.float32, **CFG
+    )
+    with pytest.raises(ValueError, match="prompt_pad"):
+        paged.run([too_long], [0])
+    # ...and a VALID zero-budget request is a no-op on both, even when the
+    # paged pool could never hold it WITH a budget (zero pages needed)
+    tight = PagedContinuousBatcher(
+        params, slots=1, prompt_pad=8, page_size=2, pool_pages=3,
+        dtype=jnp.float32, **CFG
+    )
+    fits_nothing = np.arange(6, dtype=np.int32)  # needs 3 pages; 2 allocatable
+    assert tight.run([fits_nothing], [0]) == {0: []}
+    assert dense.run([fits_nothing], [0]) == {0: []}
+
+
 def test_paged_batcher_mixed_sampling_matches_dense_batcher():
     """The paged batcher's sampling recipe mirrors the dense one exactly:
     same seed + traffic -> same sampled tokens through both (fp32)."""
