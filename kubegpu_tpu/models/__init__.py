@@ -22,6 +22,7 @@ from kubegpu_tpu.models.decoding import (
 )
 from kubegpu_tpu.models.paging import PagedContinuousBatcher, PagedDecodeLM
 from kubegpu_tpu.models.serving import ContinuousBatcher
+from kubegpu_tpu.models.spec_serving import SpeculativeContinuousBatcher
 from kubegpu_tpu.models.speculative import speculative_generate
 from kubegpu_tpu.models.transformer import TransformerLM
 from kubegpu_tpu.models.moe import MoEMLP, MoeBlock, MoeTransformerLM
@@ -65,6 +66,7 @@ __all__ = [
     "DecodeLM",
     "generate",
     "ContinuousBatcher",
+    "SpeculativeContinuousBatcher",
     "PagedContinuousBatcher",
     "PagedDecodeLM",
     "greedy_generate",

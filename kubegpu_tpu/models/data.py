@@ -54,6 +54,46 @@ def synthetic_token_batches(
         yield rng.integers(0, vocab_size, size=(batch, seq_len), dtype=np.int32)
 
 
+def structured_token_batches(
+    batch: int,
+    seq_len: int,
+    vocab_size: int = 32000,
+    seed: int = 0,
+    worker_id: int = 0,
+    branch_probs: Tuple[float, ...] = (0.7, 0.2, 0.1),
+) -> Iterator[np.ndarray]:
+    """LEARNABLE synthetic text: each next token is one of three fixed
+    affine successors of the current token, drawn with peaked
+    ``branch_probs``.  Uniform-random streams (:func:`synthetic_token_batches`)
+    are fine for throughput benches but unlearnable — a model trained on
+    them keeps flat logits, so greedy ties make quality metrics
+    (int8 agreement, speculative acceptance) uninformative floors.  This
+    stream has per-token entropy H(branch_probs) (~0.80 nats at the
+    default, ppl ~2.2), and the argmax successor is a deterministic
+    function of the current token — a trained model's greedy choices
+    become decisive, which is exactly what quality evals need.
+
+    The three successor maps ``t -> (a_i * t + b_i) mod vocab`` derive
+    from ``seed`` ONLY (not ``worker_id``), so every data-parallel worker
+    and every held-out eval stream samples the same language; workers
+    draw disjoint trajectories through it."""
+    rng = np.random.default_rng(np.random.SeedSequence([seed, worker_id, 7]))
+    maps = np.random.default_rng(np.random.SeedSequence([seed, 104729]))
+    a = (maps.integers(1, vocab_size, size=3) | 1).astype(np.int64)
+    b = maps.integers(0, vocab_size, size=3).astype(np.int64)
+    probs = np.asarray(branch_probs, np.float64)
+    probs = probs / probs.sum()
+    k = len(probs)
+    while True:
+        toks = np.empty((batch, seq_len), np.int64)
+        toks[:, 0] = rng.integers(0, vocab_size, size=batch)
+        choice = rng.choice(k, size=(batch, seq_len - 1), p=probs)
+        for t in range(1, seq_len):
+            c = choice[:, t - 1]
+            toks[:, t] = (a[c] * toks[:, t - 1] + b[c]) % vocab_size
+        yield toks.astype(np.int32)
+
+
 def synthetic_token_batches_for_mesh(
     batch: int,
     seq_len: int,

@@ -54,6 +54,17 @@ class QuantDense(nn.Module):
         return jnp.dot(x.astype(self.dtype), w)
 
 
+def bf16_cast(params):
+    """fp32 leaves -> bf16, the serving precision: the ONE cast policy
+    shared by the worker's restore path, the speculative draft init, and
+    every bench row that builds serving params — a divergent copy would
+    silently change serving numerics."""
+    return jax.tree.map(
+        lambda v: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v,
+        params,
+    )
+
+
 def quantize_params_int8(params):
     """Training/bf16 decode params -> the QuantDense layout: every Dense
     kernel (a ``{"kernel": 2D}`` module) becomes per-output-channel int8 +

@@ -40,12 +40,122 @@ from kubegpu_tpu.parallel.sharding import (
 
 
 class MoEMLP(nn.Module):
-    """Switch-style top-1 MoE feed-forward layer with static capacity."""
+    """MoE feed-forward layer with static capacity and selectable router.
+
+    ``router_type``:
+
+    - ``"top1"`` — Switch routing: each token to its argmax expert, with
+      the Switch load-balancing aux loss.  Overflow past capacity drops.
+    - ``"top2"`` — GShard-style: each token to its top TWO experts with
+      renormalized gates; second choices claim capacity slots AFTER first
+      choices (priority routing), so a token only goes fully unprocessed
+      when BOTH its experts overflow — the token-drop rate falls roughly
+      quadratically vs top-1 at the same imbalance.
+    - ``"expert_choice"`` — experts pick their top-``capacity`` tokens
+      (Zhou et al. 2022): capacity overflow is impossible by
+      construction and no aux loss is needed (balance is structural);
+      the residual risk is tokens NO expert picks, surfaced as the drop
+      rate.  Caveat: an expert's top-k spans the whole row, so token
+      i's gate depends on later tokens — fine for the encoder-style /
+      perf-bench uses it ships for, NOT causally safe for
+      autoregressive LM training losses.
+
+    ``fast_dispatch`` runs the dispatch/combine einsums in the module
+    dtype (bf16) with fp32 accumulation (``preferred_element_type``)
+    instead of full fp32.  One-hot dispatch entries are exact in bf16;
+    combine gate weights round to bf16's 8-bit mantissa (~0.4% worst
+    case) — measured as the cheap end of the routing-overhead attack
+    (VERDICT r4 next #4: the MXU runs bf16 ~4x fp32)."""
 
     num_experts: int
     capacity_factor: float = 2.0
     mlp_ratio: int = 4
     dtype: jnp.dtype = jnp.bfloat16
+    router_type: str = "top1"
+    fast_dispatch: bool = True
+
+    def _route_top1(self, gates, capacity):
+        b, s, e = gates.shape
+        expert_index = jnp.argmax(gates, axis=-1)                   # [b, s]
+        mask = jax.nn.one_hot(expert_index, e, dtype=jnp.float32)   # [b, s, e]
+        gate = jnp.sum(gates * mask, axis=-1)                       # [b, s]
+
+        # Switch aux loss (their eq. 4): e * Σ_i fraction_routed_i *
+        # mean_prob_i, = 1.0 at perfect balance.
+        density = jnp.mean(mask, axis=(0, 1))
+        density_proxy = jnp.mean(gates, axis=(0, 1))
+        aux = e * jnp.sum(density * density_proxy)
+
+        # Position of each token within its expert's per-group capacity
+        # (1-based along the row); tokens past capacity are dropped.
+        # Integer cumsum: fp32 would silently merge slots past 2^24.
+        imask = mask.astype(jnp.int32)
+        position = jnp.cumsum(imask, axis=1) * imask                # [b, s, e]
+        keep = ((position > 0) & (position <= capacity)).astype(jnp.float32)
+        drop = 1.0 - jnp.sum(keep) / (b * s)
+        slot = jnp.maximum(position - 1, 0)                         # 0-based
+        dispatch = keep[..., None] * jax.nn.one_hot(
+            slot, capacity, dtype=jnp.float32
+        )                                                           # [b, s, e, c]
+        combine = dispatch * gate[..., None, None]
+        return dispatch, combine, aux, drop
+
+    def _route_top2(self, gates, capacity):
+        b, s, e = gates.shape
+        idx1 = jnp.argmax(gates, axis=-1)
+        m1 = jax.nn.one_hot(idx1, e, dtype=jnp.float32)
+        g1 = jnp.sum(gates * m1, axis=-1)
+        idx2 = jnp.argmax(gates * (1.0 - m1), axis=-1)
+        m2 = jax.nn.one_hot(idx2, e, dtype=jnp.float32)
+        g2 = jnp.sum(gates * m2, axis=-1)
+        denom = g1 + g2 + 1e-9                                      # renorm
+        g1, g2 = g1 / denom, g2 / denom
+
+        # aux loss judged on FIRST choices (the Switch formula): the
+        # balancing pressure targets where the mass is
+        density = jnp.mean(m1, axis=(0, 1))
+        density_proxy = jnp.mean(gates, axis=(0, 1))
+        aux = e * jnp.sum(density * density_proxy)
+
+        # priority slots: first choices claim capacity, second choices
+        # fill what remains — disjoint by construction (second positions
+        # start past the expert's first-choice count)
+        im1, im2 = m1.astype(jnp.int32), m2.astype(jnp.int32)
+        pos1 = jnp.cumsum(im1, axis=1) * im1
+        used1 = jnp.sum(im1, axis=1)                                # [b, e]
+        pos2 = (jnp.cumsum(im2, axis=1) + used1[:, None, :]) * im2
+        keep1 = ((pos1 > 0) & (pos1 <= capacity)).astype(jnp.float32)
+        keep2 = ((pos2 > 0) & (pos2 <= capacity)).astype(jnp.float32)
+        # drop rate = tokens with NO surviving expert (the
+        # quality-relevant event; a lost second choice still leaves the
+        # token processed)
+        covered = jnp.clip(
+            jnp.sum(keep1, axis=-1) + jnp.sum(keep2, axis=-1), 0.0, 1.0
+        )
+        drop = 1.0 - jnp.mean(covered)
+        d1 = keep1[..., None] * jax.nn.one_hot(
+            jnp.maximum(pos1 - 1, 0), capacity, dtype=jnp.float32
+        )
+        d2 = keep2[..., None] * jax.nn.one_hot(
+            jnp.maximum(pos2 - 1, 0), capacity, dtype=jnp.float32
+        )
+        dispatch = d1 + d2
+        combine = d1 * g1[..., None, None] + d2 * g2[..., None, None]
+        return dispatch, combine, aux, drop
+
+    def _route_expert_choice(self, gates, capacity):
+        b, s, e = gates.shape
+        scores = gates.transpose(0, 2, 1)                           # [b, e, s]
+        vals, idx = jax.lax.top_k(scores, capacity)                 # [b, e, c]
+        dispatch = jax.nn.one_hot(idx, s, dtype=jnp.float32)        # [b, e, c, s]
+        dispatch = dispatch.transpose(0, 3, 1, 2)                   # [b, s, e, c]
+        combine = dispatch * vals[:, None, :, :]
+        # balance is structural; the aux slot still sows so the train
+        # step's weighting is router-agnostic
+        aux = jnp.ones(())
+        covered = jnp.clip(jnp.sum(dispatch, axis=(2, 3)), 0.0, 1.0)
+        drop = 1.0 - jnp.mean(covered)
+        return dispatch, combine, aux, drop
 
     @nn.compact
     def __call__(self, x):
@@ -65,42 +175,36 @@ class MoEMLP(nn.Module):
             e, use_bias=False, dtype=jnp.float32, name="router"
         )(x.astype(jnp.float32))
         gates = jax.nn.softmax(router_logits, axis=-1)              # [b, s, e]
-        expert_index = jnp.argmax(gates, axis=-1)                   # [b, s]
-        mask = jax.nn.one_hot(expert_index, e, dtype=jnp.float32)   # [b, s, e]
-        gate = jnp.sum(gates * mask, axis=-1)                       # [b, s]
-
-        # Switch aux loss (their eq. 4): e * Σ_i fraction_routed_i * mean_prob_i,
-        # = 1.0 at perfect balance; the train step adds aux_weight * this.
-        density = jnp.mean(mask, axis=(0, 1))
-        density_proxy = jnp.mean(gates, axis=(0, 1))
-        aux = e * jnp.sum(density * density_proxy)
+        route = {
+            "top1": self._route_top1,
+            "top2": self._route_top2,
+            "expert_choice": self._route_expert_choice,
+        }.get(self.router_type)
+        if route is None:
+            raise ValueError(
+                f"unknown router_type {self.router_type!r}; expected "
+                "top1 | top2 | expert_choice"
+            )
+        dispatch, combine, aux, drop = route(gates, capacity)
         self.sow("intermediates", "aux_loss", aux)
-
-        # Position of each token within its expert's per-group capacity
-        # (1-based along the row); tokens past capacity are dropped.
-        # Integer cumsum: fp32 would silently merge slots past 2^24.
-        imask = mask.astype(jnp.int32)
-        position = jnp.cumsum(imask, axis=1) * imask                # [b, s, e]
-        keep = ((position > 0) & (position <= capacity)).astype(jnp.float32)
         # Token-drop rate (VERDICT r3 weak #7): static capacity drops
         # overflow tokens SILENTLY (their residual branch contributes
         # zero), so a misconfigured capacity_factor degrades quality with
         # no signal.  Sown per layer; the train step averages it into a
         # step metric and the worker/bench surface it.
-        self.sow(
-            "intermediates", "drop_rate",
-            1.0 - jnp.sum(keep) / (b * s),
-        )
-        slot = jnp.maximum(position - 1, 0)                         # 0-based
-        dispatch = keep[..., None] * jax.nn.one_hot(
-            slot, capacity, dtype=jnp.float32
-        )                                                           # [b, s, e, c]
-        combine = dispatch * gate[..., None, None]
+        self.sow("intermediates", "drop_rate", drop)
 
         # Dispatch → [b, e, c, d]; expert dim sharded (the all-to-all).
-        expert_in = jnp.einsum(
-            "bsec,bsd->becd", dispatch, x.astype(jnp.float32)
-        )
+        if self.fast_dispatch:
+            expert_in = jnp.einsum(
+                "bsec,bsd->becd", dispatch.astype(self.dtype),
+                x.astype(self.dtype),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            expert_in = jnp.einsum(
+                "bsec,bsd->becd", dispatch, x.astype(jnp.float32)
+            )
         expert_in = constrain_expert_grouped(expert_in.astype(self.dtype))
 
         stacked_init = nn.initializers.variance_scaling(
@@ -116,9 +220,15 @@ class MoEMLP(nn.Module):
         expert_out = constrain_expert_grouped(expert_out)
 
         # Combine (the return all-to-all); fp32 accumulation of the weighted sum.
-        out = jnp.einsum(
-            "bsec,becd->bsd", combine, expert_out.astype(jnp.float32)
-        )
+        if self.fast_dispatch:
+            out = jnp.einsum(
+                "bsec,becd->bsd", combine.astype(self.dtype), expert_out,
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            out = jnp.einsum(
+                "bsec,becd->bsd", combine, expert_out.astype(jnp.float32)
+            )
         return out.astype(x.dtype)
 
 
@@ -158,6 +268,8 @@ class MoeBlock(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     sequence_parallel: bool = False
     attn_impl: str = "einsum"
+    router_type: str = "top1"
+    fast_dispatch: bool = True
 
     @nn.compact
     def __call__(self, x):
@@ -173,6 +285,8 @@ class MoeBlock(nn.Module):
             capacity_factor=self.capacity_factor,
             mlp_ratio=self.mlp_ratio,
             dtype=self.dtype,
+            router_type=self.router_type,
+            fast_dispatch=self.fast_dispatch,
             name="moe_mlp",
         )(y)
         if self.sequence_parallel:
@@ -193,6 +307,8 @@ class MoeTransformerLM(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     sequence_parallel: bool = False
     attn_impl: str = "einsum"
+    router_type: str = "top1"
+    fast_dispatch: bool = True
     # rematerialize blocks in the backward (jax.checkpoint): the same
     # long-context memory knob as TransformerLM.remat; the sown aux_loss
     # intermediates survive nn.remat
@@ -216,6 +332,8 @@ class MoeTransformerLM(nn.Module):
             dtype=self.dtype,
             sequence_parallel=self.sequence_parallel,
             attn_impl=self.attn_impl,
+            router_type=self.router_type,
+            fast_dispatch=self.fast_dispatch,
         )
         for i in range(self.num_layers):
             x = block(name=f"layer{i}")(x)

@@ -1,0 +1,342 @@
+"""Speculative continuous batching: draft-verified decode inside the slot
+batcher.
+
+`models/serving.py` emits ONE token per slot per step program;
+`models/speculative.py` emits ~1+accept*k tokens per target call but only
+for an aligned batch that starts and stops together.  Production serving
+wants both: slots that refill the moment a sequence retires AND multi-token
+steps.  The trick is that per-slot divergence is already the batcher's
+normal state — each slot has its own depth (`pos` vector) — so a
+speculative step generalizes cleanly: every slot drafts k proposals at its
+own depth, one target call verifies all slots' chunks, and each slot
+accepts its own prefix length.  The host appends a VARIABLE number of
+tokens per slot per step; a slot that keeps rejecting still advances one
+token per step (the target's own choice), so the batcher never does worse
+than one-token stepping on target calls.
+
+TPU-first structure: still exactly TWO compiled programs —
+
+- ``step``: k+1 draft single-token passes (a ``lax.scan``) + ONE target
+  verify over the (b, k+1) chunk, per-slot accept arithmetic on device;
+  returns the emitted block, per-slot emit lengths, and the next `last`
+  token so the host never gathers.
+- ``admit``: prefill one padded prompt through BOTH models on fresh b=1
+  caches and splice both into the shared slot caches.
+
+Greedy-only by design: lossless speculative SAMPLING needs per-position
+rejection sampling against the target distribution (a different program
+and a different acceptance rule); greedy verification is exact prefix
+matching and keeps the batcher token-identical to `greedy_generate`.
+``run`` rejects non-zero temperatures rather than silently degrading.
+
+Cache-depth invariant: a step writes rows [pos, pos+k] in both models'
+caches (rejected rows are junk that the NEXT step's chunk — or the next
+admission's full-slot splice — overwrites; attention never reads past the
+slot's committed depth).  Admission therefore requires
+``plen + max_new + k <= max_seq``: k rows of headroom beyond the dense
+batchers' bound, asserted up front instead of relying on scatter clamping.
+
+Reference anchor: SURVEY.md §2.2 serving workloads; VERDICT r4 next #2b
+(compose speculative decoding with a batcher).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubegpu_tpu.models.decoding import DecodeLM, init_caches
+
+
+@dataclass
+class _Slot:
+    seq_id: int = -1
+    remaining: int = 0
+    active: bool = False
+    tokens: List[int] = field(default_factory=list)
+
+
+class SpeculativeContinuousBatcher:
+    """Greedy continuous batching with per-slot speculative decoding.
+
+    ``draft_*`` size the proposal model (its params are ``draft_params``);
+    ``k`` is the speculation depth.  Output is token-identical to
+    ``ContinuousBatcher`` (and so to per-sequence ``greedy_generate``)
+    for ANY draft — the draft only changes how many target calls that
+    output costs (``stats['steps']``)."""
+
+    def __init__(
+        self,
+        params,
+        draft_params,
+        *,
+        vocab_size: int,
+        num_layers: int,
+        num_heads: int,
+        hidden: int,
+        max_seq: int,
+        draft_num_layers: int,
+        draft_num_heads: int,
+        draft_hidden: int,
+        k: int = 4,
+        slots: int = 8,
+        prompt_pad: int = 128,
+        eos_id: Optional[int] = None,
+        dtype=jnp.bfloat16,
+        quant: bool = False,
+    ) -> None:
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        if prompt_pad > max_seq:
+            raise ValueError(
+                f"prompt_pad ({prompt_pad}) exceeds max_seq ({max_seq})"
+            )
+        self.params = params
+        self.draft_params = draft_params
+        self.k = k
+        self.slots = slots
+        self.prompt_pad = prompt_pad
+        self.max_seq = max_seq
+        self.eos_id = eos_id
+        self.model = DecodeLM(
+            vocab_size=vocab_size, num_layers=num_layers,
+            num_heads=num_heads, hidden=hidden, max_seq=max_seq,
+            dtype=dtype, quant=quant, all_logits=True,
+        )
+        self.draft = DecodeLM(
+            vocab_size=vocab_size, num_layers=draft_num_layers,
+            num_heads=draft_num_heads, hidden=draft_hidden,
+            max_seq=max_seq, dtype=dtype,
+        )
+        self.caches = init_caches(
+            slots, num_layers, num_heads, hidden, max_seq, dtype
+        )
+        self.d_caches = init_caches(
+            slots, draft_num_layers, draft_num_heads, draft_hidden, max_seq,
+            dtype,
+        )
+        self.pos = jnp.zeros((slots,), jnp.int32)
+        self._slots = [_Slot() for _ in range(slots)]
+        self._last_tokens = jnp.zeros((slots,), jnp.int32)
+        row_ids = jnp.arange(slots)
+
+        def step(tparams, dparams, t_caches, d_caches, last, pos):
+            # Retired slots keep stepping at a frozen pos until their next
+            # admission; clamp so even their junk writes (rows
+            # [pos, pos+k]) stay in range — never rely on scatter index
+            # clamping (ADVICE r4 on speculative_generate).  Active slots
+            # are unaffected: the admission headroom guard keeps their
+            # pos strictly below this ceiling.
+            pos = jnp.minimum(pos, self.max_seq - (self.k + 1))
+
+            # ---- draft: k proposals per slot at its own depth ----------
+            # k+1 scan steps: the extra step's proposal is discarded but
+            # its cache write consumes p_k (same load-bearing extra step
+            # as speculative_generate — a k-step scan would leave row
+            # pos+k a hole after a fully-accepted block)
+            def d_step(carry, _):
+                caches, tok, p = carry
+                logits, caches = self.draft.apply(
+                    {"params": dparams}, tok[:, None], caches, p
+                )
+                # draft runs with all_logits=False: logits are (b, vocab)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (caches, nxt, p + 1), nxt
+
+            (d_caches, _, _), proposed = jax.lax.scan(
+                d_step, (d_caches, last, pos), None, length=self.k + 1
+            )
+            proposals = proposed.T[:, : self.k]              # (b, k)
+
+            # ---- target: ONE verify chunk over [last, p_1..p_k] --------
+            chunk = jnp.concatenate([last[:, None], proposals], axis=1)
+            logits_all, t_caches = self.model.apply(
+                {"params": tparams}, chunk, t_caches, pos
+            )
+            choices = jnp.argmax(logits_all, axis=-1).astype(jnp.int32)
+
+            # ---- longest matching prefix per slot ----------------------
+            match = proposals == choices[:, : self.k]
+            accepted = jnp.argmin(
+                jnp.concatenate(
+                    [match, jnp.zeros((self.slots, 1), bool)], axis=1
+                ).astype(jnp.int32),
+                axis=1,
+            )
+            emit_len = accepted + 1                           # (b,)
+            next_last = choices[row_ids, emit_len - 1]        # (b,)
+            return choices, emit_len, next_last, t_caches, d_caches
+
+        def admit(tparams, dparams, t_caches, d_caches, pos, prompt_row,
+                  prompt_len, slot):
+            # prefill BOTH models on the padded prompt with fresh b=1
+            # caches, splice both into the shared slot caches; the first
+            # token is the target's argmax at the REAL last prompt row
+            fresh_t = init_caches(
+                1, num_layers, num_heads, hidden, max_seq, dtype
+            )
+            _, fresh_t = self.model.apply(
+                {"params": tparams}, prompt_row[None, :], fresh_t,
+                jnp.zeros((), jnp.int32),
+            )
+            last_real = jax.lax.dynamic_slice(
+                prompt_row, (prompt_len - 1,), (1,)
+            )
+            logits, fresh_t = self.model.apply(
+                {"params": tparams}, last_real[None, :], fresh_t,
+                (prompt_len - 1)[None],
+            )
+            first_tok = jnp.argmax(logits[0, -1]).astype(jnp.int32)
+            fresh_d = init_caches(
+                1, draft_num_layers, draft_num_heads, draft_hidden, max_seq,
+                dtype,
+            )
+            _, fresh_d = self.draft.apply(
+                {"params": dparams}, prompt_row[None, :], fresh_d,
+                jnp.zeros((), jnp.int32),
+            )
+            _, fresh_d = self.draft.apply(
+                {"params": dparams}, last_real[None, :], fresh_d,
+                (prompt_len - 1)[None],
+            )
+            new_t, new_d = [], []
+            for (ck, cv), (fk, fv) in zip(t_caches, fresh_t):
+                new_t.append((
+                    jax.lax.dynamic_update_slice(ck, fk, (slot, 0, 0, 0)),
+                    jax.lax.dynamic_update_slice(cv, fv, (slot, 0, 0, 0)),
+                ))
+            for (ck, cv), (fk, fv) in zip(d_caches, fresh_d):
+                new_d.append((
+                    jax.lax.dynamic_update_slice(ck, fk, (slot, 0, 0, 0)),
+                    jax.lax.dynamic_update_slice(cv, fv, (slot, 0, 0, 0)),
+                ))
+            pos = pos.at[slot].set(prompt_len)
+            return first_tok, new_t, new_d, pos
+
+        self._step = jax.jit(step, donate_argnums=(2, 3))
+        self._admit = jax.jit(admit, donate_argnums=(2, 3))
+
+    # -- host-side orchestration -------------------------------------------
+    def _admit_one(self, slot_idx: int, seq_id: int, prompt: np.ndarray,
+                   max_new: int) -> None:
+        plen = int(prompt.shape[0])
+        if plen > self.prompt_pad:
+            raise ValueError(
+                f"prompt length {plen} exceeds prompt_pad {self.prompt_pad}"
+            )
+        if plen + max_new > self.max_seq:
+            raise ValueError(
+                f"prompt {plen} + max_new {max_new} exceeds max_seq "
+                f"{self.max_seq}"
+            )
+        if max_new <= 0:
+            s = self._slots[slot_idx]
+            s.seq_id, s.active, s.tokens, s.remaining = seq_id, False, [], 0
+            return
+        # k rows of write headroom beyond the dense batchers' bound (a
+        # speculative step writes rows [pos, pos+k]); asserted here so
+        # cache safety never rests on scatter index clamping
+        if plen + max_new + self.k > self.max_seq:
+            raise ValueError(
+                f"prompt {plen} + max_new {max_new} + k {self.k} exceeds "
+                f"max_seq {self.max_seq}: the speculative batcher needs k "
+                "rows of cache headroom"
+            )
+        row = np.zeros((self.prompt_pad,), np.int32)
+        row[:plen] = prompt
+        first_tok, self.caches, self.d_caches, self.pos = self._admit(
+            self.params, self.draft_params, self.caches, self.d_caches,
+            self.pos, jnp.asarray(row), jnp.int32(plen), jnp.int32(slot_idx),
+        )
+        s = self._slots[slot_idx]
+        s.seq_id, s.active = seq_id, True
+        s.tokens = [int(first_tok)]
+        s.remaining = max_new - 1
+        self._last_tokens = self._last_tokens.at[slot_idx].set(first_tok)
+        if self.eos_id is not None and s.tokens[-1] == self.eos_id:
+            s.remaining = 0
+        if s.remaining <= 0:
+            s.active = False
+
+    def run(
+        self,
+        prompts: List[np.ndarray],
+        max_new_tokens: List[int],
+        temperatures: Optional[List[float]] = None,
+    ) -> Dict[int, List[int]]:
+        """Serve every prompt to completion (greedy); returns {seq_id:
+        generated tokens}.  ``stats['steps']`` counts target verify
+        programs, ``stats['tokens']`` total emitted tokens — their ratio
+        is the speculative win over one-token stepping."""
+        if temperatures is not None and any(t for t in temperatures):
+            raise ValueError(
+                "SpeculativeContinuousBatcher is greedy-only: lossless "
+                "speculative sampling needs per-position rejection "
+                "sampling, a different verification rule (see module "
+                "docstring)"
+            )
+        assert len(prompts) == len(max_new_tokens)
+        queue = list(range(len(prompts)))
+        done: Dict[int, List[int]] = {}
+        self.stats = {"steps": 0, "admits": 0, "tokens": 0}
+
+        def retire_and_admit():
+            progress = True
+            while progress:
+                progress = False
+                for i, s in enumerate(self._slots):
+                    if s.seq_id >= 0 and not s.active:
+                        done[s.seq_id] = s.tokens
+                        s.seq_id = -1
+                        progress = True
+                    if s.seq_id < 0 and queue:
+                        nxt = queue.pop(0)
+                        self._admit_one(
+                            i, nxt, prompts[nxt], max_new_tokens[nxt]
+                        )
+                        self.stats["admits"] += 1
+                        progress = True
+
+        retire_and_admit()
+        while any(s.active for s in self._slots):
+            block, emit_len, next_last, self.caches, self.d_caches = (
+                self._step(
+                    self.params, self.draft_params, self.caches,
+                    self.d_caches, self._last_tokens, self.pos,
+                )
+            )
+            self.stats["steps"] += 1
+            block_h = np.asarray(block)
+            emit_h = np.asarray(emit_len)
+            active = np.array([s.active for s in self._slots], bool)
+            # inactive slots' junk writes advanced nothing: freeze their
+            # pos (their cache rows are fully replaced at next admission)
+            self.pos = self.pos + jnp.asarray(
+                np.where(active, emit_h, 0).astype(np.int32)
+            )
+            self._last_tokens = next_last
+            for i, s in enumerate(self._slots):
+                if not s.active:
+                    continue
+                emitted = list(block_h[i, : emit_h[i]])
+                # budget cap: the device may have emitted past the
+                # slot's remaining budget; the surplus is junk (the slot
+                # retires here, and admission resets its cache wholesale)
+                emitted = emitted[: s.remaining]
+                if self.eos_id is not None and self.eos_id in emitted:
+                    emitted = emitted[: emitted.index(self.eos_id) + 1]
+                s.tokens.extend(int(t) for t in emitted)
+                s.remaining -= len(emitted)
+                self.stats["tokens"] += len(emitted)
+                if s.remaining <= 0 or (
+                    self.eos_id is not None
+                    and emitted
+                    and emitted[-1] == self.eos_id
+                ):
+                    s.active = False
+            retire_and_admit()
+        return done

@@ -445,6 +445,47 @@ def _run_decode_batched(args, params, max_seq: int, t0: float) -> int:
         from kubegpu_tpu.models.serving import ContinuousBatcher
 
         cb = ContinuousBatcher(params, **common, quant=args.int8)
+    elif args.serving == "speculative":
+        import jax
+        import jax.numpy as jnp
+
+        from kubegpu_tpu.models import TransformerLM
+        from kubegpu_tpu.models.spec_serving import SpeculativeContinuousBatcher
+
+        if args.prompt_len + args.steps + args.spec_k > max_seq:
+            raise SystemExit(
+                f"--prompt-len {args.prompt_len} + --steps {args.steps} + "
+                f"--spec-k {args.spec_k} exceeds --seq+1 = {max_seq}: the "
+                "speculative batcher needs k rows of cache headroom"
+            )
+        # the draft: a shrunk twin, fresh-init by default — output is
+        # token-identical to the dense batcher for ANY draft (greedy
+        # verification); a TRAINED draft is what turns the correctness
+        # into a speedup (see bench.py trained_quality)
+        d_hidden = args.draft_hidden or max(args.hidden // 4, 128)
+        d_heads = max(d_hidden // 128, 1)
+        if d_hidden % d_heads:
+            # fail crisply like the other CLI geometry checks, not with a
+            # reshape traceback from inside jax tracing
+            raise SystemExit(
+                f"--draft-hidden {d_hidden} not divisible by its derived "
+                f"head count {d_heads} (heads are d_hidden//128; pick a "
+                "multiple of 128)"
+            )
+        from kubegpu_tpu.models.decoding import bf16_cast
+
+        draft = TransformerLM(
+            vocab_size=args.vocab, num_layers=args.draft_layers,
+            num_heads=d_heads, hidden=d_hidden, max_seq=max_seq,
+        )
+        dparams = jax.jit(
+            lambda r, x: bf16_cast(draft.init(r, x)["params"])
+        )(jax.random.PRNGKey(7), jnp.ones((1, 8), jnp.int32))
+        cb = SpeculativeContinuousBatcher(
+            params, dparams, **common, quant=args.int8, k=args.spec_k,
+            draft_num_layers=args.draft_layers, draft_num_heads=d_heads,
+            draft_hidden=d_hidden,
+        )
     else:
         from kubegpu_tpu.models.paging import PagedContinuousBatcher
 
@@ -550,10 +591,9 @@ def _run_decode(args, t0: float) -> int:
                         args.ckpt_dir)
     if params32 is None:
         params32 = create_train_state(model, rng, sample).params
-    params = jax.tree.map(
-        lambda v: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v,
-        params32,
-    )
+    from kubegpu_tpu.models.decoding import bf16_cast
+
+    params = bf16_cast(params32)
     del params32
     if args.int8:
         # weight-only int8 serving: half the HBM bytes per decode step
@@ -671,12 +711,21 @@ def main(argv=None) -> int:
     ap.add_argument("--int8", action="store_true",
                     help="decode: serve weight-only int8 (per-output-"
                     "channel scales; halves the per-step parameter stream)")
-    ap.add_argument("--serving", choices=["static", "continuous", "paged"],
+    ap.add_argument("--serving",
+                    choices=["static", "continuous", "paged", "speculative"],
                     default="static",
                     help="decode execution strategy: static = aligned-batch "
                     "greedy (default); continuous = slot-based continuous "
                     "batching (models/serving.py); paged = continuous "
-                    "batching over a shared KV page pool (models/paging.py)")
+                    "batching over a shared KV page pool (models/paging.py); "
+                    "speculative = draft-verified continuous batching "
+                    "(models/spec_serving.py, greedy-only)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="speculative: proposals per verify chunk")
+    ap.add_argument("--draft-layers", type=int, default=1,
+                    help="speculative: draft depth")
+    ap.add_argument("--draft-hidden", type=int, default=0,
+                    help="speculative: draft width (0 = hidden/4)")
     ap.add_argument(
         "--ckpt-dir",
         default=os.environ.get("KUBEGPU_CKPT_DIR", ""),

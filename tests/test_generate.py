@@ -441,6 +441,92 @@ def test_batchers_agree_on_oversized_prompt_with_zero_budget():
     assert dense.run([fits_nothing], [0]) == {0: []}
 
 
+def test_speculative_batcher_matches_greedy_for_any_draft():
+    """The speculative continuous batcher must emit EXACTLY the
+    per-sequence greedy tokens for ANY draft — a hopeless one (independent
+    random init: the all-reject path, one token per verify) and a perfect
+    one (the target itself: the all-accept path).  The draft only moves
+    ``stats['steps']``; slot reuse (5 sequences through 2 slots) exercises
+    variable per-slot emission and mid-stream re-admission."""
+    import numpy as np
+
+    from kubegpu_tpu.models.spec_serving import SpeculativeContinuousBatcher
+
+    params = trained_params()
+    rng = np.random.RandomState(11)
+    prompts = [
+        np.array(rng.randint(0, CFG["vocab_size"], size=n), dtype=np.int32)
+        for n in (3, 5, 7, 4, 6)
+    ]
+    budgets = [6, 3, 5, 7, 4]
+    expected = {}
+    for i, (p, n) in enumerate(zip(prompts, budgets)):
+        out = greedy_generate(
+            params, jnp.asarray(p)[None, :], n, dtype=jnp.float32, **CFG
+        )
+        expected[i] = list(np.asarray(out)[0, len(p):])
+
+    draft_cfg = dict(num_layers=1, num_heads=2, hidden=16)
+    draft = TransformerLM(
+        vocab_size=CFG["vocab_size"], max_seq=CFG["max_seq"],
+        dtype=jnp.float32, **draft_cfg,
+    )
+    draft_params = draft.init(
+        jax.random.PRNGKey(7), jnp.ones((2, 8), jnp.int32)
+    )["params"]
+    hopeless = SpeculativeContinuousBatcher(
+        params, draft_params, slots=2, prompt_pad=8, k=3,
+        draft_num_layers=1, draft_num_heads=2, draft_hidden=16,
+        dtype=jnp.float32, **CFG,
+    )
+    got = hopeless.run(prompts, budgets)
+    for i in expected:
+        assert got[i] == expected[i], (i, got[i], expected[i])
+    assert hopeless.stats["admits"] == 5
+    assert hopeless.stats["tokens"] >= sum(
+        b - 1 for b in budgets
+    )  # first tokens come from admit, the rest from steps
+
+    perfect = SpeculativeContinuousBatcher(
+        params, params, slots=2, prompt_pad=8, k=3,
+        draft_num_layers=CFG["num_layers"],
+        draft_num_heads=CFG["num_heads"], draft_hidden=CFG["hidden"],
+        dtype=jnp.float32, **CFG,
+    )
+    got2 = perfect.run(prompts, budgets)
+    for i in expected:
+        assert got2[i] == expected[i], (i, got2[i], expected[i])
+    # a perfect draft accepts every proposal: step-tokens per verify
+    # approach k+1, so the verify count drops below the hopeless one
+    assert perfect.stats["steps"] < hopeless.stats["steps"]
+
+
+def test_speculative_batcher_guards():
+    """Greedy-only and k-headroom contracts fail loudly, and the
+    validation ORDER matches the dense batchers on shared inputs."""
+    import numpy as np
+
+    from kubegpu_tpu.models.spec_serving import SpeculativeContinuousBatcher
+
+    params = trained_params()
+    sb = SpeculativeContinuousBatcher(
+        params, params, slots=1, prompt_pad=8, k=4,
+        draft_num_layers=CFG["num_layers"],
+        draft_num_heads=CFG["num_heads"], draft_hidden=CFG["hidden"],
+        dtype=jnp.float32, **CFG,
+    )
+    with pytest.raises(ValueError, match="greedy-only"):
+        sb.run([np.array([1, 2], np.int32)], [2], temperatures=[1.0])
+    with pytest.raises(ValueError, match="prompt_pad"):
+        sb.run([np.arange(9, dtype=np.int32)], [0])
+    # max_seq 32: prompt 8 + max_new 22 fits the dense bound but not the
+    # k=4 headroom
+    with pytest.raises(ValueError, match="headroom"):
+        sb.run([np.arange(8, dtype=np.int32)], [22])
+    # zero-budget no-op agrees with the dense batchers
+    assert sb.run([np.array([1, 2, 3], np.int32)], [0]) == {0: []}
+
+
 def test_paged_batcher_mixed_sampling_matches_dense_batcher():
     """The paged batcher's sampling recipe mirrors the dense one exactly:
     same seed + traffic -> same sampled tokens through both (fp32)."""

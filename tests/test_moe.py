@@ -81,6 +81,86 @@ def test_moe_aux_loss_sown_and_near_one_when_balanced():
     assert 0.0 <= float(drop) <= 1.0, drop
 
 
+def test_moe_top2_matches_dense_mlp_with_identical_experts():
+    """Top-2 renormalized gates sum to 1, so with every expert holding the
+    SAME weights and capacity ample, the output must equal dense_mlp(x)
+    EXACTLY — no gate factor at all (the two-way split cancels)."""
+    e, d, ratio = 4, 16, 2
+    layer = MoEMLP(num_experts=e, capacity_factor=float(e), mlp_ratio=ratio,
+                   dtype=jnp.float32, router_type="top2")
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, d), jnp.float32)
+    params = layer.init(jax.random.PRNGKey(1), x)["params"]
+    w1 = jax.random.normal(jax.random.PRNGKey(2), (d, d * ratio)) * 0.1
+    w2 = jax.random.normal(jax.random.PRNGKey(3), (d * ratio, d)) * 0.1
+    params = dict(params)
+    params["w_up"] = jnp.broadcast_to(w1, (e,) + w1.shape)
+    params["w_down"] = jnp.broadcast_to(w2, (e,) + w2.shape)
+    out = layer.apply({"params": params}, x)
+    xf = x.reshape(-1, d)
+    expected = (jax.nn.gelu(xf @ w1) @ w2).reshape(x.shape)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_moe_top2_drops_fewer_tokens_than_top1_under_imbalance():
+    """A router that sends EVERY token to expert 0 first overflows top-1
+    at capacity_factor 1 (75% of tokens dropped with e=4); top-2's second
+    choices spread over the remaining experts and recover most of them.
+    The drop metric is TOKEN drop (no surviving expert), the
+    quality-relevant event."""
+    e, d, s = 4, 16, 32
+    x = jnp.abs(jax.random.normal(jax.random.PRNGKey(0), (2, s, d))) + 0.1
+
+    def drop_of(router_type):
+        layer = MoEMLP(num_experts=e, capacity_factor=1.0, mlp_ratio=2,
+                       dtype=jnp.float32, router_type=router_type)
+        params = layer.init(jax.random.PRNGKey(1), x)["params"]
+        params = dict(params)
+        # column 0 dominates: all-positive activations x a large positive
+        # first column => expert 0 is every token's first choice; the
+        # runner-up stays data-dependent, so second choices spread
+        kernel = np.asarray(params["router"]["kernel"]).copy()
+        kernel[:, 0] = 5.0
+        params["router"] = {"kernel": jnp.asarray(kernel)}
+        _, mut = layer.apply({"params": params}, x, mutable=["intermediates"])
+        (drop,) = jax.tree_util.tree_leaves(mut["intermediates"]["drop_rate"])
+        return float(drop)
+
+    d1, d2 = drop_of("top1"), drop_of("top2")
+    assert d1 > 0.7, f"top1 should overflow hard here, got {d1}"
+    # second choices are data-dependent and may themselves concentrate,
+    # so the guarantee is a material reduction, not elimination
+    assert d2 < d1 - 0.2, f"top2 token-drop {d2} not well below top1 {d1}"
+
+
+def test_moe_expert_choice_is_dropless_by_construction():
+    """Expert-choice: every expert fills exactly `capacity` slots, so
+    capacity overflow cannot exist; the sown drop rate counts only tokens
+    NO expert picked, which at capacity_factor >= num_experts (total
+    slots >= tokens e-fold) stays small; aux loss is structurally 1."""
+    e, d, s = 4, 16, 32
+    layer = MoEMLP(num_experts=e, capacity_factor=float(e), mlp_ratio=2,
+                   dtype=jnp.float32, router_type="expert_choice")
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, s, d), jnp.float32)
+    params = layer.init(jax.random.PRNGKey(1), x)["params"]
+    out, mut = layer.apply({"params": params}, x, mutable=["intermediates"])
+    assert out.shape == x.shape
+    inter = mut["intermediates"]
+    (drop,) = jax.tree_util.tree_leaves(inter["drop_rate"])
+    (aux,) = jax.tree_util.tree_leaves(inter["aux_loss"])
+    # capacity = s here (cf = e), so every token is picked by its best
+    # expert: structurally zero drops at this configuration
+    assert float(drop) == 0.0, drop
+    assert float(aux) == 1.0, aux
+
+
+def test_moe_router_type_validated():
+    layer = MoEMLP(num_experts=2, dtype=jnp.float32, router_type="topk")
+    x = jnp.ones((1, 4, 8), jnp.float32)
+    with pytest.raises(ValueError, match="router_type"):
+        layer.init(jax.random.PRNGKey(0), x)
+
+
 def test_moe_ep_rules_shard_expert_dim_only():
     rules = MOE_EP_RULES
     assert spec_for_param("layer0/moe_mlp/w_up", rules)[0] == "expert"
@@ -89,12 +169,16 @@ def test_moe_ep_rules_shard_expert_dim_only():
 
 
 @pytest.mark.exhaustive
-def test_moe_ep_sharded_step_matches_single_device():
+@pytest.mark.parametrize("router_type", ["top1", "top2", "expert_choice"])
+def test_moe_ep_sharded_step_matches_single_device(router_type):
     """One DP x EP train step on a (data=2, expert=4) mesh must produce the
-    same loss as the unsharded single-device step from the same init."""
+    same loss as the unsharded single-device step from the same init —
+    for EVERY router: the routers only change the dispatch/combine
+    tensors, never the sharding contract."""
     model = MoeTransformerLM(
         vocab_size=64, num_layers=2, num_heads=2, hidden=16,
         num_experts=4, capacity_factor=4.0, max_seq=32, dtype=jnp.float32,
+        router_type=router_type,
     )
     tokens = jax.random.randint(jax.random.PRNGKey(0), (4, 17), 0, 64)
     rng = jax.random.PRNGKey(1)

@@ -166,7 +166,7 @@ def test_decode_rejects_oversized_request():
         ])
 
 
-@pytest.mark.parametrize("serving", ["continuous", "paged"])
+@pytest.mark.parametrize("serving", ["continuous", "paged", "speculative"])
 def test_decode_mode_serves_batched_strategies(capsys, serving):
     """--serving continuous|paged: the slot batchers behind the worker CLI
     serve a mixed wave and report throughput/steps/admits."""
