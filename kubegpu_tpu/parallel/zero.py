@@ -24,10 +24,9 @@ VERDICT r4 next #8.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Tuple
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubegpu_tpu.parallel.sharding import (

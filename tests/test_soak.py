@@ -1,366 +1,12 @@
-"""Control-plane soak: randomized op sequences against hard invariants.
-
-The reference's concurrency surface was `go test -race` over the cluster
-cache (SURVEY §5.2); this is the stateful analog — hundreds of seeded
-random operations (pods, gangs, binds, deletions, chip deaths/revivals,
-resyncs, preemption triggers) on a 2-slice cluster, with the system's core
-guarantees re-checked after every step:
-
-  I1  no chip is ever assigned to two live pods;
-  I2  the scheduler cache's used-set equals the union of live assignment
-      annotations (the annotations ARE the durable state — drift means
-      replay after a restart would diverge);
-  I3  gang admission is atomic: a gang that was NEVER fully bound has zero
-      bound members at quiescence (no partial initial placement).  A gang
-      that WAS fully bound may be transiently partial — member churn
-      (eviction, deletion) with replacements rejoining one by one is the
-      designed elastic-recovery path;
-  I4  every live assignment references only currently-advertised chips,
-      once eviction has had its chance to run (explicit Unhealthy evicts
-      on the resync that sees it).
-
-Deterministic per seed; failures print the op log for replay.
-"""
+"""Seeded + threaded control-plane soaks over the shared Soak harness
+(kubegpu_tpu/testing/soak.py); the deterministic-interleaving variant lives
+in tests/test_soak_deterministic.py."""
 
 import random
 
 import pytest
 
-from kubegpu_tpu.plugins import Advertiser, FakeSlice
-from kubegpu_tpu.scheduler import Scheduler
-from kubegpu_tpu.types import RES_TPU, annotations, is_contiguous_submesh
-from kubegpu_tpu.utils import InMemoryApiServer
-from kubegpu_tpu.utils.metrics import Metrics
-
-MESH = (4, 4)
-
-
-class Soak:
-    def __init__(self, seed: int):
-        self.rng = random.Random(seed)
-        self.api = InMemoryApiServer()
-        self.slices = {
-            sid: FakeSlice(slice_id=sid, mesh_shape=MESH, host_block=(2, 2))
-            for sid in ("sa", "sb")
-        }
-        self.advs = {}
-        for fs in self.slices.values():
-            for h, p in fs.providers().items():
-                self.advs[h] = Advertiser(p, self.api)
-                self.advs[h].advertise_once()
-        # short stranded-gang grace so the quiescence rounds can observe
-        # the rollback (production default is 5 x 30 s resyncs)
-        self.sched = Scheduler(self.api, metrics=Metrics(), stranded_grace=2)
-        self.sched.resync()
-        self.n = 0
-        self.ops = []
-        self.dead = set()  # (slice, coords) currently killed
-        self.ever_full = set()  # gangs observed fully bound at least once
-        self.deleted_history = []  # pod objects whose DELETED already fired
-
-    # -- ops ---------------------------------------------------------------
-    def op_create_pod(self):
-        name = f"p{self.n}"
-        self.n += 1
-        chips = self.rng.choice([1, 1, 2, 4])
-        prio = self.rng.choice([0, 0, 0, 1, 5])
-        ann = {}
-        if prio:
-            ann[annotations.POD_PRIORITY] = str(prio)
-        self.api.create_pod({
-            "metadata": {"name": name, "namespace": "default",
-                         "annotations": ann},
-            "spec": {"containers": [
-                {"name": "m", "resources": {"limits": {RES_TPU: str(chips)}}}]},
-        })
-        return f"create {name} x{chips} prio={prio}"
-
-    def op_create_gang(self):
-        size = self.rng.choice([2, 3, 4])
-        chips = self.rng.choice([1, 2, 4])
-        gid = f"g{self.n}"
-        prio = self.rng.choice([0, 0, 2, 6])
-        multi = self.rng.random() < 0.3
-        for i in range(size):
-            ann = {
-                annotations.POD_GROUP: gid,
-                annotations.POD_GROUP_SIZE: str(size),
-            }
-            if prio:
-                ann[annotations.POD_PRIORITY] = str(prio)
-            if multi:
-                ann[annotations.POD_MULTISLICE] = "true"
-            self.api.create_pod({
-                "metadata": {"name": f"{gid}w{i}", "namespace": "default",
-                             "annotations": ann},
-                "spec": {"containers": [
-                    {"name": "m",
-                     "resources": {"limits": {RES_TPU: str(chips)}}}]},
-            })
-        self.n += 1
-        return f"gang {gid} {size}x{chips} prio={prio} ms={multi}"
-
-    def pending_pods(self):
-        return [
-            p for p in self.api.list_pods()
-            if not (p.get("spec") or {}).get("nodeName")
-        ]
-
-    def bound_pods(self):
-        return [
-            p for p in self.api.list_pods()
-            if (p.get("spec") or {}).get("nodeName")
-        ]
-
-    def op_schedule_sweep(self):
-        """kube-scheduler's loop: filter+bind every pending pod once."""
-        nodes = sorted(n["metadata"]["name"] for n in self.api.list_nodes())
-        done = 0
-        for obj in sorted(self.pending_pods(), key=lambda o: o["metadata"]["name"]):
-            name = obj["metadata"]["name"]
-            r = self.sched.filter(obj, nodes)
-            if not r.nodes:
-                continue
-            if self.sched.bind("default", name, r.nodes[0]) is None:
-                done += 1
-        return f"schedule sweep bound={done}"
-
-    def op_delete_pod(self):
-        bound = self.bound_pods()
-        if not bound:
-            return "delete (noop)"
-        obj = self.rng.choice(bound)
-        name = obj["metadata"]["name"]
-        self.api.delete_pod("default", name)
-        self.sched.on_pod_deleted(obj)
-        self.deleted_history.append(obj)
-        return f"delete {name}"
-
-    def op_stale_delete_event(self):
-        """Watch pathology: a DELETED event for a pod that already left (or
-        whose name has since been recreated and re-bound) drains late.  The
-        GET-confirm guard must make it a no-op whenever the name exists —
-        double-freeing a recreated pod's chips is the I1/I2 breach this
-        hunts."""
-        if not self.deleted_history:
-            return "stale-del (noop)"
-        obj = self.rng.choice(self.deleted_history)
-        self.sched.on_pod_deleted(obj)
-        return f"stale-del {obj['metadata']['name']}"
-
-    def op_complete_pod(self):
-        """A bound pod's containers finish (Succeeded) or crash (Failed):
-        kube-scheduler accounting frees its chips at the next refresh even
-        though the annotation lingers until GC.  Gang members only complete
-        when their gang is actually RUNNING (fully bound) — a member of a
-        mid-admission gang has never started, so marking it terminal would
-        fabricate a state no real cluster produces.  Resync immediately —
-        the invariants compare cache vs annotations at quiescence."""
-        full_gangs = set()
-        by_gang: dict = {}
-        for obj in self.api.list_pods():
-            g = (obj["metadata"].get("annotations") or {}).get(annotations.POD_GROUP)
-            if g:
-                by_gang.setdefault(g, []).append(obj)
-        for g, objs in by_gang.items():
-            size = int(objs[0]["metadata"]["annotations"][annotations.POD_GROUP_SIZE])
-            if len([o for o in objs if (o.get("spec") or {}).get("nodeName")]) == size:
-                full_gangs.add(g)
-        def completable(o):
-            g = (o["metadata"].get("annotations") or {}).get(annotations.POD_GROUP)
-            return g is None or g in full_gangs
-
-        bound = [o for o in self.bound_pods() if completable(o)]
-        if not bound:
-            return "complete (noop)"
-        obj = self.rng.choice(bound)
-        name = obj["metadata"]["name"]
-        phase = self.rng.choice(["Succeeded", "Succeeded", "Failed"])
-        with self.api._lock:
-            pod = self.api._pods.get(f"default/{name}")
-            if pod is None:
-                return "complete (noop)"
-            pod["status"] = {"phase": phase}
-        self.sched.resync()
-        return f"complete {name} {phase}"
-
-    def op_kill_chip(self):
-        sid = self.rng.choice(list(self.slices))
-        coords = (self.rng.randrange(MESH[0]), self.rng.randrange(MESH[1]))
-        self.slices[sid].kill_chip(coords)
-        self.dead.add((sid, coords))
-        for a in self.advs.values():
-            a.advertise_once()
-        self.sched.resync()
-        return f"kill {sid}{coords}"
-
-    def op_revive_chip(self):
-        if not self.dead:
-            return "revive (noop)"
-        sid, coords = self.rng.choice(sorted(self.dead))
-        self.slices[sid].revive_chip(coords)
-        self.dead.discard((sid, coords))
-        for a in self.advs.values():
-            a.advertise_once()
-        self.sched.resync()
-        return f"revive {sid}{coords}"
-
-    def op_recreate_member(self):
-        """Controller behavior: a deleted gang member comes back — the
-        anchored re-plan (exact-hole refit, layout preemption) must rejoin
-        it without disturbing siblings."""
-        by_gang = {}
-        for obj in self.api.list_pods():
-            ann = obj["metadata"].get("annotations") or {}
-            g = ann.get(annotations.POD_GROUP)
-            if g:
-                by_gang.setdefault(g, []).append(obj)
-        candidates = []
-        for g, objs in by_gang.items():
-            size = int(objs[0]["metadata"]["annotations"][annotations.POD_GROUP_SIZE])
-            if len(objs) < size:
-                have = {o["metadata"]["name"] for o in objs}
-                template = objs[0]
-                for i in range(size):
-                    name = f"{g}w{i}"
-                    if name not in have:
-                        candidates.append((name, template))
-        if not candidates:
-            return "recreate (noop)"
-        # controller semantics: recreate EVERY missing member of one gang
-        gang = self.rng.choice(sorted({c[0].rsplit("w", 1)[0] for c in candidates}))
-        made = []
-        for name, template in sorted(candidates, key=lambda c: c[0]):
-            if not name.startswith(gang + "w"):
-                continue
-            ann = dict(template["metadata"]["annotations"])
-            ann.pop(annotations.POD_ASSIGNMENT, None)
-            self.api.create_pod({
-                "metadata": {"name": name, "namespace": "default",
-                             "annotations": ann},
-                "spec": {"containers": [
-                    {"name": "m", "resources": dict(
-                        template["spec"]["containers"][0]["resources"])}]},
-            })
-            made.append(name)
-        return f"recreate {','.join(made)}"
-
-    def op_resync(self):
-        for a in self.advs.values():
-            a.advertise_once()
-        self.sched.resync()
-        return "resync"
-
-    # -- invariants --------------------------------------------------------
-    def check(self, trace, liveness: bool = True):
-        live = {}
-        for obj in self.api.list_pods():
-            phase = ((obj.get("status") or {}).get("phase") or "")
-            if phase in ("Succeeded", "Failed"):
-                # terminal pods hold nothing (ClusterCache._live_assignment)
-                # — their lingering annotations are history, not claims
-                continue
-            a = annotations.assignment_from_pod(obj)
-            if a is None:
-                continue
-            for c in a.all_chips():
-                key = (a.slice_id, c.coords)
-                assert key not in live, (
-                    f"I1 chip {key} double-assigned to {live[key]} and "
-                    f"{obj['metadata']['name']}\n" + trace
-                )
-                live[key] = obj["metadata"]["name"]
-
-        # I2: cache used == annotations' union, per slice — except chips
-        # reserved by IN-FLIGHT (assumed) admissions, which are cache-only
-        # BY DESIGN until their bind writes the durable annotation (gang
-        # plans reserve every member up front; a member whose bind hits a
-        # transient failure retries next sweep).  Anything cache-only and
-        # NOT assumed is real drift; anything annotated and uncharged is
-        # always drift.
-        views = self.sched.cache.views()
-        ann_used = {}
-        for (sid, coords), _ in live.items():
-            ann_used.setdefault(sid, set()).add(coords)
-        assumed_used: dict = {}
-        for key in list(self.sched.cache._assumed):
-            a = self.sched.cache.assignment_of(key)
-            if a is not None:
-                assumed_used.setdefault(a.slice_id, set()).update(
-                    c.coords for c in a.all_chips()
-                )
-        for sid, v in views.items():
-            cache_used = set(v.used)
-            cache_only = cache_used - ann_used.get(sid, set())
-            assert cache_only <= assumed_used.get(sid, set()), (
-                f"I2 unexplained cache-only chips on {sid}: "
-                f"{cache_only - assumed_used.get(sid, set())} "
-                f"(assumed={assumed_used.get(sid, set())})\n" + trace
-            )
-            ann_only = ann_used.get(sid, set()) - cache_used
-            assert not ann_only, (
-                f"I2 annotated-but-uncharged chips on {sid}: {ann_only}\n" + trace
-            )
-
-        # I3: atomic admission — a gang never goes 0 → partially bound
-        gangs = {}
-        for obj in self.api.list_pods():
-            g = (obj["metadata"].get("annotations") or {}).get(annotations.POD_GROUP)
-            if g:
-                gangs.setdefault(g, []).append(obj)
-        for g, objs in gangs.items():
-            size = int(objs[0]["metadata"]["annotations"][annotations.POD_GROUP_SIZE])
-            # terminal members are neither capacity holders nor rollback
-            # targets (they hold no chips and completed their work): the
-            # partial-admission leak I3 hunts is about LIVE bound members
-            live_objs = [
-                o for o in objs
-                if ((o.get("status") or {}).get("phase") or "")
-                not in ("Succeeded", "Failed")
-            ]
-            bound = [o for o in live_objs if (o.get("spec") or {}).get("nodeName")]
-            n_done = len(objs) - len(live_objs)
-            if len(bound) == size - n_done:
-                self.ever_full.add(g)
-            if liveness and g not in self.ever_full and len(objs) == size:
-                # judge admission atomicity only when the full membership
-                # exists: missing members mean the "controller" (the soak's
-                # recreate op) hasn't restored them, and the scheduler
-                # cannot be expected to complete a gang it cannot see
-                assert len(bound) == 0, (
-                    f"I3 gang {g} partially admitted {len(bound)}/{size} "
-                    f"without ever being full\n" + trace
-                )
-
-        # I4: no live assignment on a dead chip (resync ran after kills)
-        for (sid, coords), name in live.items():
-            assert (sid, coords) not in self.dead, (
-                f"I4 {name} still assigned dead chip {sid}{coords}\n" + trace
-            )
-
-    def run(self, steps: int):
-        ops = [
-            (self.op_create_pod, 3),
-            (self.op_create_gang, 2),
-            (self.op_schedule_sweep, 5),
-            (self.op_delete_pod, 2),
-            (self.op_recreate_member, 2),
-            (self.op_kill_chip, 1),
-            (self.op_revive_chip, 1),
-            (self.op_resync, 1),
-            (self.op_complete_pod, 1),
-            (self.op_stale_delete_event, 1),
-        ]
-        bag = [f for f, w in ops for _ in range(w)]
-        for _ in range(steps):
-            f = self.rng.choice(bag)
-            self.ops.append(f())
-            # always settle scheduling + eviction before invariants: the
-            # invariants hold at quiescence, not mid-operation
-            self.ops.append(self.op_schedule_sweep())
-            trace = "\n".join(self.ops[-30:])
-            self.check(trace)
-
+from kubegpu_tpu.testing.soak import Soak, settle_and_check
 
 @pytest.mark.parametrize("seed", [0, 1, 2, 3])
 def test_control_plane_soak(seed):
@@ -457,34 +103,4 @@ def test_control_plane_soak_threaded(rep):
         sys.setswitchinterval(prev_switch)
     assert not errors, errors
 
-    # quiesce: restore ALL hardware first — a gang caught by mid-admission
-    # chip death is legitimately partial until capacity returns (anchored
-    # re-plan heals it) — then let scheduling and the sweeps settle
-    for sid, coords in sorted(s.dead):
-        s.slices[sid].revive_chip(coords)
-    s.dead.clear()
-    for a in s.advs.values():
-        a.advertise_once()
-    # Safety (I1/I2/I4) must hold at EVERY settle round; admission
-    # atomicity (I3) is a LIVENESS property under the stranded-gang
-    # rollback (grace 2 counted over no-progress resyncs; rollback →
-    # recreate → re-admit takes several rounds) — require it to converge
-    # within a bounded number of rounds.
-    last_err = None
-    for _ in range(25):
-        # every controller restores ITS gang's missing members each round
-        # (one random gang per call; loop until a round makes no progress)
-        for _ in range(40):
-            if s.op_recreate_member() == "recreate (noop)":
-                break
-        s.op_resync()
-        s.op_schedule_sweep()
-        s.check(f"threaded soak (seed {99 + rep}), safety", liveness=False)
-        try:
-            s.check(f"threaded soak (seed {99 + rep})")
-            last_err = None
-            break
-        except AssertionError as e:
-            last_err = e
-    if last_err is not None:
-        raise last_err
+    settle_and_check(s, f"threaded soak (seed {99 + rep})")
