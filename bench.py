@@ -573,7 +573,7 @@ def trained_quality(extra: dict) -> None:
     heads = hidden // 128
     seq = 512
     batch = int(os.environ.get("BENCH_TRAIN_BATCH", "16"))
-    n_steps = int(os.environ.get("BENCH_TRAIN_STEPS", "400"))
+    n_steps = max(1, int(os.environ.get("BENCH_TRAIN_STEPS", "400")))
     d_hidden, d_layers, d_heads = 1024, 1, 8
     mesh = device_mesh({"data": jax.local_device_count()})
     rng = jax.random.PRNGKey(0)
@@ -781,8 +781,9 @@ def trained_quality(extra: dict) -> None:
     # (VERDICT r4 next #2b) — same trained weights, a 16-prompt
     # mixed-budget queue through 8 slots: the dense continuous batcher
     # pays one step program per token per occupancy; the speculative one
-    # verifies k+1-token chunks per slot per program.  Token-identical
-    # output is asserted, so the step ratio is a pure cost win.
+    # verifies k+1-token chunks per slot per program.  Token agreement is
+    # checked and reported (the CPU fp32 oracle in tests is exact; on-chip
+    # bf16 sees the same chunk-shape tie-flips as the plain spec rows).
     from kubegpu_tpu.models.serving import ContinuousBatcher
     from kubegpu_tpu.models.spec_serving import SpeculativeContinuousBatcher
 
@@ -1072,11 +1073,15 @@ def paged_longctx_row(extra: dict) -> None:
 
     q0 = jax.random.normal(kq[2], (b, h, hd), jnp.bfloat16)
 
-    def per_op(fn, *ops):
+    def per_op(fn, *ops, short=8, long_=64):
         # operands are jit ARGUMENTS, never closure constants: a captured
         # 134 MB dense cache would be inlined into the HLO and blow the
-        # remote compile service's request-size limit (HTTP 413, observed)
-        short, long_ = 8, 64
+        # remote compile service's request-size limit (HTTP 413, observed).
+        # Each length timed min-of-3: the tunnel swings single wall
+        # timings by ±ms, which fabricated a NEGATIVE marginal for the
+        # post-DMA-elision paged op (~30 us/step x 56 steps ~ the noise)
+        # until the scan difference was made long enough to dominate it —
+        # callers pick (short, long_) so the difference is >= ~10 ms.
         rs_ = {}
         for n in (short, long_):
 
@@ -1090,12 +1095,18 @@ def paged_longctx_row(extra: dict) -> None:
                 return q
 
             np.asarray(run(q0, *ops))               # compile + warm
-            t0 = time.perf_counter()
-            np.asarray(run(q0, *ops))
-            rs_[n] = time.perf_counter() - t0
+            samples = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                np.asarray(run(q0, *ops))
+                samples.append(time.perf_counter() - t0)
+            rs_[n] = min(samples)
         return (rs_[long_] - rs_[short]) / (long_ - short)
 
-    t_paged = per_op(paged_decode_attention, k_pool, v_pool, table, lengths)
+    t_paged = per_op(
+        paged_decode_attention, k_pool, v_pool, table, lengths,
+        short=64, long_=512,
+    )
     t_dense = per_op(dense_att, kd, vd, lengths)
     log(
         f"decode-attention kernel @fill {fill}/{max_seq}: paged "
@@ -1173,12 +1184,12 @@ def steady_state_moe(extra: dict) -> None:
     # top2 and expert-choice, each with its token-drop rate.  The shipped
     # default is whichever hits <5% drop at this config with the best
     # step time.
-    def moe_row(router_type, fast, label):
+    def moe_row(router_type, fast, label, dispatch_impl="einsum"):
         moe = MoeTransformerLM(
             vocab_size=vocab, num_layers=layers, num_heads=heads,
             hidden=hidden, num_experts=experts, capacity_factor=2.0,
             max_seq=seq + 1, attn_impl="flash", router_type=router_type,
-            fast_dispatch=fast,
+            fast_dispatch=fast, dispatch_impl=dispatch_impl,
         )
         moe_state, dt, n_moe, flops = run_model(
             moe, make_moe_train_step, {"data": 1, "expert": 1}
@@ -1200,10 +1211,22 @@ def steady_state_moe(extra: dict) -> None:
     dt_ec, drop_ec, _ = moe_row(
         "expert_choice", True, "expert-choice fast-dispatch"
     )
+    # Index-form dispatch (VERDICT r4 weak #6 attack #2): the dense
+    # one-hot einsums are O(cf·s²·d) MACs — s² of zero-multiplies; the
+    # scatter/gather form is O(s·cf·d) data movement.
+    dt_g1, _, mfu_g1 = moe_row("top1", True, "top1 gather-dispatch", "gather")
+    dt_g2, _, _ = moe_row("top2", True, "top2 gather-dispatch", "gather")
+    extra["moe_gather_ms_per_step"] = round(dt_g1 * 1e3, 2)
+    extra["moe_gather_mfu"] = round(mfu_g1, 4)
+    extra["moe_top2_gather_ms_per_step"] = round(dt_g2 * 1e3, 2)
     log(
         f"MoE summary: dense twin {dt_dense * 1e3:.1f} ms | fast-dispatch "
         f"saves {(dt_slow - dt_moe) * 1e3:.1f} ms/step "
-        f"({(dt_slow / dt_moe - 1) * 100:.0f}% of the top1 step) | drops: "
+        f"({(dt_slow / dt_moe - 1) * 100:.0f}% of the top1 step) | "
+        f"gather-dispatch {dt_g1 * 1e3:.1f} ms "
+        f"({(dt_moe / dt_g1 - 1) * 100:+.0f}% vs einsum; routing overhead "
+        f"{(dt_g1 / dt_dense - 1) * 100:+.0f}% vs einsum's "
+        f"{(dt_moe / dt_dense - 1) * 100:+.0f}%) | drops: "
         f"top1 {drop * 100:.1f}% / top2 {drop_top2 * 100:.1f}% / "
         f"expert-choice {drop_ec * 100:.1f}%"
     )
@@ -1953,12 +1976,12 @@ def main() -> None:
         "first_step_prewarmed_s",
         "resnet_mfu",
         "lm_mfu",
-        "longctx_true_mfu",
+        "longctx_mfu",
         "decode_tok_s",
         "decode_int8_tok_s",
         "spec_tok_s_b1",
         "spec_accept_rate",
-        "serving_step_efficiency",
+        "cb_step_efficiency",
         "paged_hbm_ratio_2048",
         "moe_mfu",
         "moe_drop_rate",

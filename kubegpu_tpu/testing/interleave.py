@@ -144,13 +144,16 @@ class ILock:
             # plain path: unmanaged thread, or teardown after a failure.
             # Bounded waits during teardown — unwinding tasks release via
             # their context managers, but never hang the suite on them.
+            # Elapsed time is measured on the REAL clock (waits can return
+            # early on every release's notify_all; counting iterations
+            # would fabricate timeouts under notify traffic).
             with iv._mon:
-                waited = 0.0
+                start = _REAL_MONOTONIC()
                 while not self._can_take(key):
                     if not blocking or timeout == 0:
                         return False
                     iv._mon.wait(timeout=1.0)
-                    waited += 1.0
+                    waited = _REAL_MONOTONIC() - start
                     if iv._abort and waited > 5:
                         # abandoned by an unwound task: seize it — teardown
                         # consistency is moot once the test has failed
@@ -178,16 +181,11 @@ class ILock:
                 # as infinite waits in deadlock reports.
                 return False
         iv._park_blocked(task, self)
-        with iv._mon:
-            # the controller only reschedules a blocked task once its lock
-            # is takable, and nothing else has run since
-            assert self._can_take(task), (
-                f"scheduler invariant: woke {task.name} but {self.name} "
-                f"is held by {self.owner}"
-            )
-            self.owner = task
-            self.count += 1
-            return True
+        # The controller PRE-GRANTED the lock (owner/count set under the
+        # monitor) before waking us — an unmanaged plain-path acquirer
+        # sharing the monitor can therefore never steal it in the window
+        # between the runnability check and this wake-up.
+        return True
 
     def release(self) -> None:
         iv = self._iv
@@ -462,6 +460,16 @@ class Interleaver:
                     else:
                         chosen = runnable[self._rng.randrange(len(runnable))]
                     self.schedule.append(chosen.name)
+                    if chosen.state == "blocked" and chosen.waiting is not None:
+                        # grant the lock NOW, under the monitor: between this
+                        # decision and the task's wake-up, an unmanaged
+                        # thread in the plain-path acquire loop could
+                        # otherwise take it and invalidate the scheduling
+                        lk = chosen.waiting
+                        if lk.owner is None:
+                            lk.owner, lk.count = chosen, 1
+                        else:  # reentrant re-acquire by its own holder
+                            lk.count += 1
                     self._current = chosen
                     self._mon.notify_all()
         finally:

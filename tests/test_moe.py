@@ -169,16 +169,27 @@ def test_moe_ep_rules_shard_expert_dim_only():
 
 
 @pytest.mark.exhaustive
-@pytest.mark.parametrize("router_type", ["top1", "top2", "expert_choice"])
-def test_moe_ep_sharded_step_matches_single_device(router_type):
+@pytest.mark.parametrize(
+    "router_type,dispatch_impl",
+    [
+        ("top1", "einsum"),
+        ("top2", "einsum"),
+        ("expert_choice", "einsum"),
+        ("top1", "gather"),
+        ("top2", "gather"),
+    ],
+)
+def test_moe_ep_sharded_step_matches_single_device(router_type, dispatch_impl):
     """One DP x EP train step on a (data=2, expert=4) mesh must produce the
     same loss as the unsharded single-device step from the same init —
-    for EVERY router: the routers only change the dispatch/combine
-    tensors, never the sharding contract."""
+    for EVERY router AND both dispatch implementations: routing only
+    changes the dispatch/combine arithmetic, never the sharding contract
+    (the gather path's [b, e, c, d] tensor crosses the expert axis the
+    same way the einsum's does)."""
     model = MoeTransformerLM(
         vocab_size=64, num_layers=2, num_heads=2, hidden=16,
         num_experts=4, capacity_factor=4.0, max_seq=32, dtype=jnp.float32,
-        router_type=router_type,
+        router_type=router_type, dispatch_impl=dispatch_impl,
     )
     tokens = jax.random.randint(jax.random.PRNGKey(0), (4, 17), 0, 64)
     rng = jax.random.PRNGKey(1)
@@ -289,3 +300,67 @@ def test_moe_remat_grads_match_plain():
     for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(gr)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("router_type", ["top1", "top2"])
+def test_moe_gather_dispatch_matches_einsum(router_type):
+    """Index-form (scatter/gather) dispatch is the SAME arithmetic as the
+    dense one-hot einsums, minus the O(s^2) zero-multiplies: outputs,
+    sown routing metrics, and gradients must match to fp32 tolerance —
+    including under real capacity overflow (capacity_factor 1.0 forces
+    drops, so the dropped-token scatter path is exercised too)."""
+    e, d = 4, 16
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 12, d), jnp.float32)
+    kw = dict(num_experts=e, capacity_factor=1.0, mlp_ratio=2,
+              dtype=jnp.float32, router_type=router_type,
+              fast_dispatch=False)
+    dense = MoEMLP(dispatch_impl="einsum", **kw)
+    gather = MoEMLP(dispatch_impl="gather", **kw)
+    params = dense.init(jax.random.PRNGKey(1), x)["params"]
+
+    out_d, mut_d = dense.apply({"params": params}, x, mutable=["intermediates"])
+    out_g, mut_g = gather.apply({"params": params}, x, mutable=["intermediates"])
+    np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_g),
+                               rtol=1e-6, atol=1e-6)
+    for key in ("aux_loss", "drop_rate"):
+        (a,) = jax.tree_util.tree_leaves(mut_d["intermediates"][key])
+        (b,) = jax.tree_util.tree_leaves(mut_g["intermediates"][key])
+        np.testing.assert_allclose(float(a), float(b), rtol=1e-6, atol=1e-7)
+
+    def grads(layer):
+        def f(p):
+            return jnp.sum(layer.apply({"params": p}, x) ** 2)
+        return jax.grad(f)(params)
+
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
+        ),
+        grads(dense),
+        grads(gather),
+    )
+
+
+def test_moe_gather_dispatch_ec_falls_back_to_dense():
+    """expert_choice + gather runs the dense path (its combine scatter-adds
+    duplicate token targets, which IS the one-hot einsum) — outputs match
+    the einsum config exactly rather than erroring."""
+    e, d = 4, 16
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, d), jnp.float32)
+    kw = dict(num_experts=e, capacity_factor=2.0, dtype=jnp.float32,
+              router_type="expert_choice", fast_dispatch=False)
+    a = MoEMLP(dispatch_impl="einsum", **kw)
+    b = MoEMLP(dispatch_impl="gather", **kw)
+    params = a.init(jax.random.PRNGKey(1), x)["params"]
+    np.testing.assert_allclose(
+        np.asarray(a.apply({"params": params}, x)),
+        np.asarray(b.apply({"params": params}, x)),
+        rtol=0, atol=0,
+    )
+
+
+def test_moe_dispatch_impl_validated():
+    layer = MoEMLP(num_experts=2, dtype=jnp.float32, dispatch_impl="sorted")
+    x = jnp.zeros((1, 4, 8), jnp.float32)
+    with pytest.raises(ValueError, match="dispatch_impl"):
+        layer.init(jax.random.PRNGKey(0), x)
