@@ -27,7 +27,8 @@ from kubegpu_tpu.types.info import Assignment, ChipRef, NodeInfo, PodInfo, TpuRe
 from kubegpu_tpu.types.resource import ResourcePath, ResourceTree
 from kubegpu_tpu.types.topology import (
     Coord,
-    enumerate_rectangles,
+    Submesh,
+    factor_shapes,
     is_contiguous_submesh,
 )
 
@@ -381,21 +382,34 @@ def _scored_rectangles(
             return native
     score_ctx = membership if scoring_free is None else scoring_free
     candidates = []
-    for rect in enumerate_rectangles(
-        total, mesh_shape, wrap, shapes=[shape] if shape else None
-    ):
-        # O(1) pre-filter: a rect's origin is always one of its coords, so
-        # rects anchored outside `membership` can never qualify — this is
-        # the gang-packing hot path (small per-host membership scanned
-        # against whole-mesh candidate rects), where materializing every
-        # candidate's coord set dominated the 512-chip multislice plan
-        if rect.origin not in membership:
+    # A qualifying rect's origin is always one of its coords, so only
+    # membership-anchored origins can ever qualify: iterate THOSE directly
+    # instead of every whole-mesh origin (identical candidate set to the
+    # enumerate_rectangles scan with the origin pre-filter, but the gang
+    # hot path — small per-host membership against a 16x16 mesh — does
+    # |membership| x |shapes| work instead of |mesh| x |shapes|, measured
+    # ~4x on the churn row's binds/sec).  Origin validity matches
+    # enumerate_rectangles exactly: a dim wraps only when the torus wraps
+    # AND the shape doesn't span it (full-extent dims pin origin 0).
+    ndims = len(mesh_shape)
+    shapes = [shape] if shape else factor_shapes(total, ndims)
+    origins = sorted(membership)
+    for shp in shapes:
+        if any(shp[d] > mesh_shape[d] for d in range(ndims)):
             continue
-        coords = rect.coords(mesh_shape, wrap)
-        if not coords <= membership:
-            continue
-        s = placement_score(coords, score_ctx, mesh_shape, wrap)
-        candidates.append((s, sorted(coords), coords))
+        for origin in origins:
+            if any(
+                origin[d] + shp[d] > mesh_shape[d]
+                and not (wrap[d] and shp[d] < mesh_shape[d])
+                for d in range(ndims)
+            ):
+                continue
+            rect = Submesh(origin=origin, shape=shp)
+            coords = rect.coords(mesh_shape, wrap)
+            if not coords <= membership:
+                continue
+            s = placement_score(coords, score_ctx, mesh_shape, wrap)
+            candidates.append((s, sorted(coords), coords))
     # deterministic: score desc, then lexicographic coords
     candidates.sort(key=lambda t: (-t[0], t[1]))
     return candidates

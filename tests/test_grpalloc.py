@@ -370,3 +370,57 @@ def test_slice_view_skips_wrap_disagreement():
     # rogue host excluded; its 4 chips missing from the view
     assert len(v.chips) == 12
     assert rogue.name not in v.by_host
+
+
+def test_scored_rectangles_membership_origin_scan_matches_enumeration():
+    """The gang-path Python scan iterates membership-anchored origins
+    (allocator._scored_rectangles); it must produce the IDENTICAL
+    candidate list — same rects, same scores, same order, tie-breaks
+    included — as the defining whole-mesh enumeration with the origin
+    pre-filter, across wrap configs, ragged memberships, distinct scoring
+    contexts, and the multislice fixed-shape restriction."""
+    import random as _r
+
+    from kubegpu_tpu.grpalloc.allocator import _scored_rectangles
+    from kubegpu_tpu.grpalloc.scoring import placement_score
+    from kubegpu_tpu.types.topology import enumerate_rectangles
+
+    def oracle(n, mesh, wrap, membership, scoring, shape=None):
+        out = []
+        for rect in enumerate_rectangles(
+            n, mesh, wrap, shapes=[shape] if shape else None
+        ):
+            if rect.origin not in membership:
+                continue
+            coords = rect.coords(mesh, wrap)
+            if not coords <= membership:
+                continue
+            s = placement_score(coords, scoring, mesh, wrap)
+            out.append((s, sorted(coords), coords))
+        out.sort(key=lambda t: (-t[0], t[1]))
+        return out
+
+    rng = _r.Random(3)
+    for mesh, wrap in [
+        ((4, 4), (False, False)),
+        ((4, 4), (True, True)),
+        ((8, 4), (True, False)),
+    ]:
+        coords_all = [(x, y) for x in range(mesh[0]) for y in range(mesh[1])]
+        for _ in range(6):
+            membership = frozenset(
+                rng.sample(coords_all, rng.randrange(1, len(coords_all)))
+            )
+            scoring = (
+                frozenset(rng.sample(coords_all, len(coords_all) // 2))
+                | membership
+            )
+            for n in (1, 2, 4):
+                got = _scored_rectangles(
+                    n, mesh, wrap, membership, scoring_free=scoring
+                )
+                assert got == oracle(n, mesh, wrap, membership, scoring)
+            got = _scored_rectangles(
+                4, mesh, wrap, membership, scoring_free=scoring, shape=(2, 2)
+            )
+            assert got == oracle(4, mesh, wrap, membership, scoring, (2, 2))

@@ -25,7 +25,11 @@ def built_lib():
         try:
             subprocess.run(["make", "-C", NATIVE_DIR], check=True, capture_output=True)
         except (OSError, subprocess.CalledProcessError) as e:
-            pytest.skip(f"native shim not buildable here: {e}")
+            detail = getattr(e, "stderr", b"") or b""
+            pytest.skip(
+                "native shim not buildable here: "
+                f"{e} [{detail[-300:].decode(errors='replace')}]"
+            )
     if native.load() is None:
         pytest.skip("libtpu_discovery.so not loadable")
 

@@ -29,7 +29,11 @@ def built_lib():
         try:
             subprocess.run(["make", "-C", NATIVE_DIR], check=True, capture_output=True)
         except (OSError, subprocess.CalledProcessError) as e:
-            pytest.skip(f"native core not buildable here: {e}")
+            detail = getattr(e, "stderr", b"") or b""
+            pytest.skip(
+                "native core not buildable here: "
+                f"{e} [{detail[-300:].decode(errors='replace')}]"
+            )
     if native_core.load() is None:
         pytest.skip("libgrpalloc_core.so not loadable")
 

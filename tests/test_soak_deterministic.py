@@ -194,3 +194,12 @@ def test_replay_divergence_is_reported():
         iv.task("t1", t1)
         with pytest.raises(ReplayDivergenceError):
             iv.run()
+
+
+@pytest.mark.exhaustive
+@pytest.mark.parametrize("seed", range(4, 20))
+def test_deterministic_soak_seed_sweep(seed):
+    """Wider schedule exploration (exhaustive tier): 16 more seeds through
+    the full chaos mix — every one must settle to an invariant-clean
+    state, and every one is replayable by construction."""
+    _run_soak(seed)
