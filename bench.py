@@ -716,6 +716,21 @@ def trained_quality(extra: dict) -> None:
         max_seq=max_seq, draft_num_layers=d_layers, draft_num_heads=d_heads,
         draft_hidden=d_hidden,
     )
+    def _time(fn, *args):
+        out = fn(*args)
+        # warm with a VALUE readback: block_until_ready can return
+        # before execution (and even compilation) finishes on this
+        # backend, which once leaked a ~140 s in-flight cold compile
+        # into the timed region (plain b8 read 21 tok/s)
+        jax.tree.map(np.asarray, out)
+        n = 3
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(*args)
+        jax.tree.map(np.asarray, out)
+        return out, (time.perf_counter() - t0) / n
+
+    plain_tok_s_b1 = None
     for b in (1, 8):
         prompt = jnp.asarray(next(ev_src)[:b, :plen])
         plain_fn = jax.jit(lambda p, t: greedy_generate(p, t, steps, **kw))
@@ -724,20 +739,6 @@ def trained_quality(extra: dict) -> None:
                 tp, dp, t, steps, k=k, **spec_kw
             )
         )
-
-        def _time(fn, *args):
-            out = fn(*args)
-            # warm with a VALUE readback: block_until_ready can return
-            # before execution (and even compilation) finishes on this
-            # backend, which once leaked a ~140 s in-flight cold compile
-            # into the timed region (plain b8 read 21 tok/s)
-            jax.tree.map(np.asarray, out)
-            n = 3
-            t0 = time.perf_counter()
-            for _ in range(n):
-                out = fn(*args)
-            jax.tree.map(np.asarray, out)
-            return out, (time.perf_counter() - t0) / n
 
         plain_out, plain_dt = _time(plain_fn, tparams, prompt)
         (spec_out, calls), spec_dt = _time(spec_fn, tparams, dparams, prompt)
@@ -774,8 +775,42 @@ def trained_quality(extra: dict) -> None:
         if b == 1:
             extra["spec_accept_rate"] = round(accept, 4)
             extra["spec_tokens_per_call"] = round(tokens_per_call, 3)
+            plain_tok_s_b1 = plain_tok_s
         extra[f"spec_lossless_b{b}"] = lossless
         extra[f"spec_token_agreement_b{b}"] = round(agree, 4)
+
+    # ---- spec x int8 compose: quantized target under draft verification -
+    # (the two serving accelerations stack: the draft stays bf16 — the
+    # cheap model needs no quantization — while every verify chunk rides
+    # the halved weight bytes; lossless vs plain INT8 greedy by the CPU
+    # oracle in tests/test_generate.py)
+    assert plain_tok_s_b1 is not None, "b1 must stay in the batch sweep"
+    prompt1 = jnp.asarray(next(ev_src)[:1, :plen])
+    plain_q_fn = jax.jit(
+        lambda p, t: greedy_generate(p, t, steps, quant=True, **kw)
+    )
+    spec_q_fn = jax.jit(
+        lambda tp, dp, t: speculative_generate(
+            tp, dp, t, steps, k=k, quant=True, **spec_kw
+        )
+    )
+    pq_out, pq_dt = _time(plain_q_fn, qparams, prompt1)
+    (sq_out, sq_calls), sq_dt = _time(spec_q_fn, qparams, dparams, prompt1)
+    sq_tok_s = steps / sq_dt
+    pq_tok_s = steps / pq_dt
+    sq_agree = float(
+        (np.asarray(sq_out)[:, plen:] == np.asarray(pq_out)[:, plen:]).mean()
+    )
+    log(
+        f"trained-quality: spec x int8 b1 k{k}: {int(sq_calls)} target "
+        f"calls, {sq_tok_s:.0f} tok/s vs plain-int8 {pq_tok_s:.0f} tok/s "
+        f"({sq_tok_s / pq_tok_s:.2f}x; vs plain-bf16 "
+        f"{sq_tok_s / plain_tok_s_b1:.2f}x), agreement {sq_agree * 100:.1f}%"
+    )
+    extra["spec_int8_tok_s_b1"] = round(sq_tok_s)
+    extra["spec_int8_speedup_vs_int8_b1"] = round(sq_tok_s / pq_tok_s, 3)
+    extra["spec_int8_speedup_vs_bf16_b1"] = round(sq_tok_s / plain_tok_s_b1, 3)
+    extra["spec_int8_token_agreement_b1"] = round(sq_agree, 4)
 
     # ---- speculative serving: the batcher path that speculates ----------
     # (VERDICT r4 next #2b) — same trained weights, a 16-prompt
@@ -1980,6 +2015,7 @@ def main() -> None:
         "decode_tok_s",
         "decode_int8_tok_s",
         "spec_tok_s_b1",
+        "spec_int8_tok_s_b1",
         "spec_accept_rate",
         "cb_step_efficiency",
         "paged_hbm_ratio_2048",

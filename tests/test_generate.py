@@ -552,3 +552,41 @@ def test_paged_batcher_mixed_sampling_matches_dense_batcher():
     ).run(prompts, budgets, temperatures=temps)
     for i in dense:
         assert paged[i] == dense[i], (i, paged[i], dense[i])
+
+
+def test_speculative_decode_composes_with_int8_target():
+    """Spec x int8: a weight-only-quantized TARGET under draft
+    verification must emit EXACTLY plain int8 greedy's sequence (the
+    draft stays bf16/fp32 — the cheap model needs no quantization).  The
+    losslessness proof carries over unchanged because verification
+    compares the target's own logits, quantized or not."""
+    import numpy as np
+
+    from kubegpu_tpu.models.decoding import quantize_params_int8
+    from kubegpu_tpu.models.speculative import speculative_generate
+
+    params = trained_params()
+    qparams = quantize_params_int8(params)
+    prompt = (jnp.arange(2 * 5, dtype=jnp.int32) % CFG["vocab_size"]).reshape(2, 5)
+    steps = 10
+    # plain int8 greedy consumes qparams — the oracle sequence
+    ref_q = np.asarray(
+        greedy_generate(
+            qparams, prompt, steps, dtype=jnp.float32, quant=True, **CFG
+        )
+    )
+    draft_cfg = dict(num_layers=1, num_heads=2, hidden=16)
+    draft = TransformerLM(
+        dtype=jnp.float32, vocab_size=CFG["vocab_size"], max_seq=CFG["max_seq"],
+        **draft_cfg,
+    )
+    draft_params = draft.init(
+        jax.random.PRNGKey(7), jnp.ones((2, 8), jnp.int32)
+    )["params"]
+    out, calls = speculative_generate(
+        qparams, draft_params, prompt, steps, k=3, dtype=jnp.float32,
+        quant=True, **CFG, draft_num_layers=1, draft_num_heads=2,
+        draft_hidden=16,
+    )
+    np.testing.assert_array_equal(np.asarray(out), ref_q)
+    assert 1 <= int(calls) <= steps
