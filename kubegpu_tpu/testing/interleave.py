@@ -472,6 +472,11 @@ class Interleaver:
                             lk.count += 1
                     self._current = chosen
                     self._mon.notify_all()
+        except (DeadlockError, WedgedError, ReplayDivergenceError) as e:
+            # every abnormal controller exit carries the replayable
+            # schedule — seeds alone do not survive RNG-implementation
+            # drift, the recorded decision list does
+            raise type(e)(f"{e}{self._dump_schedule()}") from None
         finally:
             with self._mon:
                 self._abort = first_error is not None or any(
@@ -485,6 +490,27 @@ class Interleaver:
         if first_error is not None:
             raise AssertionError(
                 f"task failed under seed {self.seed} after "
-                f"{len(self.schedule)} decisions (schedule is replayable via "
-                f"Interleaver(schedule=...))"
+                f"{len(self.schedule)} decisions{self._dump_schedule()}"
             ) from first_error
+
+    def _dump_schedule(self) -> str:
+        """Persist the decision list so a failure report IS a reproduction
+        (schedules run to thousands of entries — too long for a message).
+        Returns a replay hint naming the file, or a fallback hint if the
+        dump itself cannot be written — never raises (an unwritable TMPDIR
+        must not eat the original failure)."""
+        import json
+        import tempfile
+
+        try:
+            fd, path = tempfile.mkstemp(
+                prefix=f"interleave-seed{self.seed}-", suffix=".json"
+            )
+            with open(fd, "w") as f:
+                json.dump(self.schedule, f)
+            return (
+                "; replay with "
+                f"Interleaver(schedule=json.load(open({path!r})))"
+            )
+        except OSError as e:
+            return f"; schedule dump failed ({e}) — replay via seed"

@@ -20,6 +20,7 @@ found or constructed — exactly reproducible.
 """
 
 import json
+import os
 import random
 
 import pytest
@@ -32,6 +33,15 @@ from kubegpu_tpu.testing.interleave import (
 )
 from kubegpu_tpu.testing.soak import Soak, settle_and_check
 from kubegpu_tpu.types import annotations
+
+
+def _unlink_dump(msg: str) -> None:
+    """Remove the schedule dump an abnormal-exit test deliberately caused."""
+    import re
+
+    m = re.search(r"open\('([^']+)'\)", msg)
+    if m:
+        os.unlink(m.group(1))
 
 
 def _snapshot(s: Soak) -> str:
@@ -177,6 +187,7 @@ def test_deadlock_detected_deterministically():
             iv.run()
     msg = str(exc.value)
     assert "t1" in msg and "t2" in msg
+    _unlink_dump(msg)
 
 
 def test_replay_divergence_is_reported():
@@ -192,8 +203,9 @@ def test_replay_divergence_is_reported():
                 pass
 
         iv.task("t1", t1)
-        with pytest.raises(ReplayDivergenceError):
+        with pytest.raises(ReplayDivergenceError) as exc:
             iv.run()
+    _unlink_dump(str(exc.value))
 
 
 @pytest.mark.exhaustive
@@ -203,3 +215,30 @@ def test_deterministic_soak_seed_sweep(seed):
     the full chaos mix — every one must settle to an invariant-clean
     state, and every one is replayable by construction."""
     _run_soak(seed)
+
+
+def test_failed_run_dumps_replayable_schedule():
+    """A task failure persists the decision list to disk and names the
+    file in the error — the failure report IS the reproduction."""
+    import re
+    import threading
+
+    iv = Interleaver(seed=5)
+    with iv.activate():
+        lk = threading.Lock()
+
+        def t1():
+            with lk:
+                pass
+            raise RuntimeError("boom")
+
+        iv.task("t1", t1)
+        with pytest.raises(AssertionError, match="replay with") as exc:
+            iv.run()
+    path = re.search(r"open\('([^']+)'\)", str(exc.value)).group(1)
+    try:
+        with open(path) as f:
+            sched = json.load(f)
+    finally:
+        os.unlink(path)
+    assert sched == iv.schedule and sched
