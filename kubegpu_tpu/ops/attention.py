@@ -405,17 +405,21 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 1024,
                     block_k: int = 1024, interpret: Optional[bool] = None):
     """Flash attention, BSHD.  O(seq) memory in BOTH directions: the
     forward keeps only out + logsumexp; the backward recomputes scores
     blockwise in its own Pallas kernels.
 
-    Default blocks are large (512x1024): measured on v5e, fwd+bwd at
-    seq 1024/d128 runs 2.6x faster than 128x128 blocks (60.5 -> 23.9 ms
-    for b16 h32) — small blocks pay grid overhead and starve the MXU;
-    the VMEM residency at d<=128 stays a few MB.  Shorter sequences
-    clamp via min(block, seq) as always."""
+    Default blocks are large (1024x1024).  Small blocks pay grid overhead
+    and starve the MXU: the r3 sweep measured 128x128 at 2.6x slower
+    than 512x1024 (60.5 vs 23.9 ms, b16 h32 s1024 d128 fwd+bwd, on the
+    r3-era backward kernels).  The r5 sweep — after the backward-kernel
+    improvements that brought that config to ~8 ms — moved block_q
+    512 -> 1024 for another measured win at BOTH scales (b16 h32 s1024:
+    ~8 -> 5.8 ms; b1 h16 s16384: ~30 -> 24.9 ms per fwd+bwd; 2048-wide
+    blocks fail to compile — VMEM).  The VMEM residency at d<=128 stays
+    a few MB; shorter sequences clamp via min(block, seq) as always."""
     out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
     return out
 
