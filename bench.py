@@ -1112,28 +1112,39 @@ def paged_longctx_row(extra: dict) -> None:
         # operands are jit ARGUMENTS, never closure constants: a captured
         # 134 MB dense cache would be inlined into the HLO and blow the
         # remote compile service's request-size limit (HTTP 413, observed).
-        # Each length timed min-of-3: the tunnel swings single wall
-        # timings by ±ms, which fabricated a NEGATIVE marginal for the
-        # post-DMA-elision paged op (~30 us/step x 56 steps ~ the noise)
-        # until the scan difference was made long enough to dominate it —
-        # callers pick (short, long_) so the difference is >= ~10 ms.
+        # Each length timed min-of-3 against tunnel jitter, with a SALT
+        # uniquifying every call: the backend result-caches repeated
+        # identical (executable, args) executions, and a cached sample
+        # wins the min() and fabricates a negative marginal (observed for
+        # BOTH ops at different times).  Long scan differences (callers
+        # pick short/long_ so the difference is >= ~10 ms) keep the
+        # marginal above the residual noise.  Caveat (r5, PARITY): the
+        # DENSE op's marginal is form-sensitive — 390..1200 us/step
+        # depending on output form and scan length (XLA hoists the
+        # loop-invariant fp32 casts differently) — while the paged
+        # kernel holds ~120-130 us across all forms; quote the paged
+        # advantage conservatively as >= ~3x (vs the fastest dense
+        # form), not the single-run ratio.
         rs_ = {}
         for n in (short, long_):
 
             @partial(jax.jit, static_argnames=("steps",))
-            def run(q0, *ops, steps=n):
+            def run(q0, salt, *ops, steps=n):
                 def body(q, _):
                     o = fn(q, *ops)
                     return (o + jnp.bfloat16(1e-3)), None
 
-                q, _ = jax.lax.scan(body, q0, None, length=steps)
-                return q
+                q, _ = jax.lax.scan(
+                    body, q0 + salt.astype(q0.dtype), None, length=steps
+                )
+                return jnp.sum(q.astype(jnp.float32))
 
-            np.asarray(run(q0, *ops))               # compile + warm
+            np.asarray(run(q0, jnp.float32(0.0), *ops))  # compile + warm
             samples = []
-            for _ in range(3):
+            for i in range(3):
+                salt = jnp.float32(1e-6 * (n + i + 1))
                 t0 = time.perf_counter()
-                np.asarray(run(q0, *ops))
+                np.asarray(run(q0, salt, *ops))
                 samples.append(time.perf_counter() - t0)
             rs_[n] = min(samples)
         return (rs_[long_] - rs_[short]) / (long_ - short)

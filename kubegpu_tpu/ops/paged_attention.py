@@ -21,8 +21,10 @@ same block, so dead pages cost neither bandwidth nor compute — the
 kernel's HBM traffic is O(live pages), which is the entire point of
 paging.  Measured (v5e-1, r5, 8 slots x 32 heads, 3 of 16 pages live):
 without the elision the kernel streamed the same bytes as the dense
-cache and ran 0.56x dense; with it, 130 us vs dense 374 us — 2.9x
-FASTER, tracking the occupancy ratio minus fixed per-step overheads.
+cache and ran 0.56x dense; with it the kernel holds a stable 117-132 us
+across every harness form while the dense twin measures 390-1,200 us
+depending on microbench program form — i.e. >= 2.9x faster against the
+FASTEST dense measurement (PARITY r5 has the form-sensitivity notes).
 
 Layouts: pool pages are (heads, page_size, head_dim) — heads OUTERMOST,
 so every in-kernel contraction is an elementwise-multiply + reduction
