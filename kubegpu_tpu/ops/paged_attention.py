@@ -86,7 +86,8 @@ def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     length = len_ref[b_i]
 
     # pages at/after the slot's length hold nothing attendable: skip the
-    # compute (their DMA still happened; the mask would zero them anyway)
+    # compute (their DMA was elided too — kv_map aliases them to the last
+    # live page, so the block ref holds stale-but-unread data)
     @pl.when(p_i * page < length)
     def _compute():
         q = q_ref[0].astype(jnp.float32)               # (h, hd)
