@@ -16,6 +16,10 @@ A ground-up, TPU-first rebuild of the capabilities of KnifeeOneOne/KubeGPU
                      preemption, restart replay.
 - L3 ``crishim``   — CRI proxy + env/device injection (TPU_VISIBLE_CHIPS and
                      the JAX multi-host rendezvous contract).
+- L5 ``gateway``   — cluster serving front door: replica discovery from the
+                     same annotations the scheduler writes, bounded fair
+                     admission, load-aware routing, deadline/retry/hedge
+                     failover onto the continuous-batched decode replicas.
 - ``parallel``     — hands scheduled JAX workloads an ICI-contiguous sub-mesh
                      as a ``jax.sharding.Mesh``; DP/TP/SP sharding helpers.
 - ``models``/``ops`` — reference JAX workloads (the payloads the samples

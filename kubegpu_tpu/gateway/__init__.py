@@ -1,0 +1,58 @@
+"""L5 serving gateway: the cluster-level inference front door.
+
+Bridges the two halves of the serving stack: the control plane places
+decode replicas on ICI-contiguous chips (scheduler/grpalloc/crishim) and
+the data plane decodes inside one replica (models/serving.py); the
+gateway routes cluster traffic TO those replicas — discovery from the
+same annotations the scheduler writes, bounded fair admission, load-aware
+routing, and deadline/retry/hedge failover.  Same architecture split as
+the scheduler: pure core + thin HTTP codec, every cluster dependency
+behind ``ApiServer``, every data-plane dependency behind
+``ReplicaClient`` — the whole subsystem runs in-memory under test.
+"""
+
+from kubegpu_tpu.gateway.client import (
+    Attempt,
+    AttemptResult,
+    InMemoryReplicaClient,
+    ReplicaClient,
+    SimBatcher,
+)
+from kubegpu_tpu.gateway.core import (
+    Gateway,
+    GatewayRequest,
+    GatewayResult,
+    PendingRequest,
+)
+from kubegpu_tpu.gateway.failover import Dispatcher, FailoverPolicy
+from kubegpu_tpu.gateway.queue import AdmissionQueue, QueueClosed, QueueFull
+from kubegpu_tpu.gateway.registry import ReplicaInfo, ReplicaRegistry
+from kubegpu_tpu.gateway.router import (
+    LeastOutstandingRouter,
+    Router,
+    SessionAffinityRouter,
+)
+from kubegpu_tpu.gateway.server import GatewayServer
+
+__all__ = [
+    "AdmissionQueue",
+    "Attempt",
+    "AttemptResult",
+    "Dispatcher",
+    "FailoverPolicy",
+    "Gateway",
+    "GatewayRequest",
+    "GatewayResult",
+    "GatewayServer",
+    "InMemoryReplicaClient",
+    "LeastOutstandingRouter",
+    "PendingRequest",
+    "QueueClosed",
+    "QueueFull",
+    "ReplicaClient",
+    "ReplicaInfo",
+    "ReplicaRegistry",
+    "Router",
+    "SessionAffinityRouter",
+    "SimBatcher",
+]

@@ -1,0 +1,341 @@
+"""ReplicaClient: the gateway's only data-plane dependency.
+
+Mirrors the ApiServer pattern (SURVEY.md §4: every cluster dependency
+behind an interface with an in-memory fake): the gateway dispatches
+decode work through this interface and never opens a socket itself.
+
+``InMemoryReplicaClient`` is the fake — one worker thread per replica
+driving a batcher through the incremental serving API
+(``submit``/``serve_step``/``cancel``), so e2e tests exercise the REAL
+queue → route → admit → decode → retire path.  The batcher is duck-typed:
+a real ``models.serving.ContinuousBatcher`` (true JAX decode, the e2e
+wiring the tentpole demands) or a ``SimBatcher`` (pure-python token
+mill) both fit — the soak and the 200-request acceptance test use the
+latter so chaos runs stay fast and deterministic.
+
+Failure model: ``fail_replica`` is the pod's process dying with its
+chips — every in-flight attempt errors (the moral equivalent of a
+connection reset), new submissions are refused.  ``sync_live`` wires
+that to the registry's live-set subscription, so a chip death observed
+by the control plane kills the data-plane connection the same cycle.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, FrozenSet, List, Optional
+
+
+@dataclass
+class AttemptResult:
+    ok: bool
+    tokens: List[int] = field(default_factory=list)
+    error: str = ""
+
+
+class Attempt:
+    """Handle for one dispatch of one request to one replica.  Resolves
+    exactly once; ``finish`` after the first call is a no-op (a cancelled
+    attempt racing its own completion must not flap the result)."""
+
+    def __init__(self, replica: str, request_id: str) -> None:
+        self.replica = replica
+        self.request_id = request_id
+        self.cancelled = False
+        self._done = threading.Event()
+        self._result: Optional[AttemptResult] = None
+        self._lock = threading.Lock()
+
+    def finish(self, result: AttemptResult) -> bool:
+        with self._lock:
+            if self._result is not None:
+                return False
+            self._result = result
+        self._done.set()
+        return True
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self) -> Optional[AttemptResult]:
+        return self._result
+
+
+class ReplicaClient:
+    def submit(self, replica_key: str, request) -> Attempt:
+        """Dispatch one request; never blocks on decode (returns a handle).
+        An unreachable replica resolves the handle immediately with an
+        error — connection refusal is a RESULT, not an exception."""
+        raise NotImplementedError
+
+    def cancel(self, attempt: Attempt) -> None:
+        """Best-effort: stop decoding the attempt's request (hedge loser,
+        expired deadline).  The attempt resolves with an error if it had
+        not already finished."""
+        raise NotImplementedError
+
+    def ready(self) -> bool:
+        """Can this client plausibly reach replicas at all?  /readyz ANDs
+        this with replica discovery — a gateway whose data plane is a
+        dead end must never join a Service, however many replicas the
+        registry sees."""
+        return True
+
+
+# ---------------------------------------------------------------------------
+# SimBatcher: pure-python stand-in with the ContinuousBatcher serving API
+# ---------------------------------------------------------------------------
+
+class SimBatcher:
+    """Deterministic token mill with the incremental serving API
+    (submit/serve_step/cancel/has_work) but no JAX: token *i* of request
+    *seq* is ``(seq * 31 + i) % vocab``, one token per serve_step per
+    active sequence, ``slots`` sequences decode concurrently.  Lets soak
+    and scale tests drive thousands of requests through the real gateway
+    machinery in milliseconds."""
+
+    def __init__(self, slots: int = 8, vocab: int = 256) -> None:
+        self.slots = slots
+        self.vocab = vocab
+        self._pending: deque = deque()
+        self._active: Dict[int, tuple] = {}  # seq -> (tokens, max_new)
+        self.stats = {"steps": 0, "admits": 0}
+
+    def submit(self, seq_id: int, prompt, max_new: int,
+               temperature: float = 0.0) -> None:
+        if seq_id < 0:
+            raise ValueError(f"seq_id must be >= 0, got {seq_id}")
+        self._pending.append((seq_id, int(max_new)))
+
+    def cancel(self, seq_id: int) -> bool:
+        for i, (sid, _) in enumerate(self._pending):
+            if sid == seq_id:
+                del self._pending[i]
+                return True
+        return self._active.pop(seq_id, None) is not None
+
+    def has_work(self) -> bool:
+        return bool(self._pending) or bool(self._active)
+
+    def serve_step(self) -> Dict[int, List[int]]:
+        finished: Dict[int, List[int]] = {}
+        while self._pending and len(self._active) < self.slots:
+            seq, max_new = self._pending.popleft()
+            self.stats["admits"] += 1
+            if max_new <= 0:
+                finished[seq] = []
+            else:
+                self._active[seq] = ([], max_new)
+        if self._active:
+            self.stats["steps"] += 1
+            for seq in list(self._active):
+                tokens, max_new = self._active[seq]
+                tokens.append((seq * 31 + len(tokens)) % self.vocab)
+                if len(tokens) >= max_new:
+                    finished[seq] = tokens
+                    del self._active[seq]
+        return finished
+
+
+# ---------------------------------------------------------------------------
+# InMemoryReplicaClient: per-replica worker threads over duck-typed batchers
+# ---------------------------------------------------------------------------
+
+class _ReplicaWorker:
+    def __init__(self, key: str, batcher, step_delay_s: float) -> None:
+        self.key = key
+        self.batcher = batcher
+        self.step_delay_s = step_delay_s
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.inbox: deque = deque()          # (attempt, request)
+        self.cancels: List[Attempt] = []
+        self.alive = True
+        self.by_seq: Dict[int, Attempt] = {}
+        self._next_seq = 0
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self) -> None:
+        while True:
+            with self.cond:
+                while (self.alive and not self.inbox and not self.cancels
+                       and not self.batcher.has_work()):
+                    self.cond.wait(0.05)
+                if not self.alive:
+                    dead = list(self.by_seq.values())
+                    dead += [a for a, _ in self.inbox]
+                    self.by_seq.clear()
+                    self.inbox.clear()
+                    break
+                while self.inbox:
+                    attempt, req = self.inbox.popleft()
+                    seq = self._next_seq
+                    self._next_seq += 1
+                    try:
+                        self.batcher.submit(
+                            seq, req.prompt, req.max_new_tokens,
+                            getattr(req, "temperature", 0.0),
+                        )
+                        self.by_seq[seq] = attempt
+                    except Exception as e:  # noqa: BLE001 - bad request
+                        attempt.finish(AttemptResult(False, error=str(e)))
+                for attempt in self.cancels:
+                    for seq, a in list(self.by_seq.items()):
+                        if a is attempt:
+                            self.batcher.cancel(seq)
+                            del self.by_seq[seq]
+                    attempt.finish(
+                        AttemptResult(False, error="cancelled")
+                    )
+                self.cancels.clear()
+            # decode OUTSIDE the lock: a slow step (real JAX dispatch)
+            # must not block submission/cancel delivery
+            finished = self.batcher.serve_step()
+            for seq, tokens in finished.items():
+                attempt = self.by_seq.pop(seq, None)
+                if attempt is not None:
+                    attempt.finish(AttemptResult(True, tokens=list(tokens)))
+            if self.step_delay_s:
+                time.sleep(self.step_delay_s)
+        # crashed: every in-flight attempt sees a connection reset
+        for attempt in dead:
+            attempt.finish(
+                AttemptResult(False, error=f"replica {self.key} died")
+            )
+
+    def submit(self, attempt: Attempt, request) -> None:
+        with self.cond:
+            if not self.alive:
+                attempt.finish(AttemptResult(
+                    False, error=f"replica {self.key} unreachable"
+                ))
+                return
+            self.inbox.append((attempt, request))
+            self.cond.notify()
+
+    def cancel(self, attempt: Attempt) -> None:
+        with self.cond:
+            self.cancels.append(attempt)
+            self.cond.notify()
+
+    def kill(self) -> None:
+        with self.cond:
+            self.alive = False
+            self.cond.notify()
+
+
+class InMemoryReplicaClient(ReplicaClient):
+    def __init__(
+        self,
+        batcher_factory: Optional[Callable[[str], object]] = None,
+        step_delay_s: float = 0.0,
+    ) -> None:
+        """``batcher_factory``: builds a fresh batcher for a replica key —
+        used by ``add_replica`` when no batcher is passed, and by
+        ``sync_live`` to model a pod restarting with a cold cache after
+        its chips come back."""
+        self.batcher_factory = batcher_factory
+        self.step_delay_s = step_delay_s
+        self._lock = threading.Lock()
+        self._workers: Dict[str, _ReplicaWorker] = {}
+        # request_id -> completed decode deliveries (soak's wasted-hedge
+        # and exactly-once accounting reads this)
+        self.decodes: Dict[str, int] = {}
+
+    # -- replica lifecycle -------------------------------------------------
+    def add_replica(self, key: str, batcher=None,
+                    step_delay_s: Optional[float] = None) -> None:
+        if batcher is None:
+            if self.batcher_factory is None:
+                raise ValueError("no batcher and no batcher_factory")
+            batcher = self.batcher_factory(key)
+        with self._lock:
+            old = self._workers.get(key)
+            self._workers[key] = _ReplicaWorker(
+                key, batcher,
+                self.step_delay_s if step_delay_s is None else step_delay_s,
+            )
+        if old is not None:
+            old.kill()
+
+    def fail_replica(self, key: str) -> None:
+        with self._lock:
+            worker = self._workers.pop(key, None)
+        if worker is not None:
+            worker.kill()
+
+    def set_step_delay(self, key: str, delay_s: float) -> None:
+        """Straggler injection: slow one replica's decode loop down."""
+        with self._lock:
+            worker = self._workers.get(key)
+        if worker is not None:
+            worker.step_delay_s = delay_s
+
+    def sync_live(self, live: FrozenSet[str]) -> None:
+        """Registry subscription hook: a replica leaving the live set is
+        its process dying (in-flight work errors out); one re-entering
+        restarts cold via the factory."""
+        with self._lock:
+            known = set(self._workers)
+        for key in known - set(live):
+            self.fail_replica(key)
+        if self.batcher_factory is not None:
+            for key in set(live) - known:
+                self.add_replica(key)
+
+    def replicas(self) -> List[str]:
+        with self._lock:
+            return sorted(self._workers)
+
+    def ready(self) -> bool:
+        with self._lock:
+            return bool(self._workers) or self.batcher_factory is not None
+
+    def stop(self) -> None:
+        with self._lock:
+            workers = list(self._workers.values())
+            self._workers.clear()
+        for w in workers:
+            w.kill()
+
+    # -- ReplicaClient -----------------------------------------------------
+    def submit(self, replica_key: str, request) -> Attempt:
+        attempt = Attempt(replica_key, request.request_id)
+        with self._lock:
+            worker = self._workers.get(replica_key)
+        if worker is None:
+            attempt.finish(AttemptResult(
+                False, error=f"replica {replica_key} unreachable"
+            ))
+            return attempt
+        _original_finish = attempt.finish
+
+        def counting_finish(result: AttemptResult) -> bool:
+            first = _original_finish(result)
+            if first and result.ok:
+                with self._lock:
+                    self.decodes[request.request_id] = (
+                        self.decodes.get(request.request_id, 0) + 1
+                    )
+            return first
+
+        attempt.finish = counting_finish  # type: ignore[method-assign]
+        worker.submit(attempt, request)
+        return attempt
+
+    def cancel(self, attempt: Attempt) -> None:
+        attempt.cancelled = True
+        with self._lock:
+            worker = self._workers.get(attempt.replica)
+        if worker is not None:
+            worker.cancel(attempt)
+        else:
+            attempt.finish(AttemptResult(False, error="cancelled"))

@@ -1,0 +1,291 @@
+"""Gateway core: admit → route → dispatch → deliver, no sockets.
+
+The cluster front door as pure orchestration (the scheduler split applied
+to the data plane: this module is to ``gateway/server.py`` what
+``scheduler/core.py`` is to ``scheduler/server.py``).  A request's life:
+
+    submit() ── bounded AdmissionQueue (QueueFull → "rejected", HTTP 429)
+             ── dispatcher thread picks it up (queue-wait histogram)
+             ── Dispatcher drives route → attempt → hedge/retry (failover)
+             ── exactly-once result recording (duplicate deliveries from
+                hedge races are counted and DROPPED, never surfaced)
+
+Every admitted request reaches exactly one terminal result — "ok",
+"error" or "timeout" — and a refused one is rejected explicitly at
+submission.  That accounting IS the soak invariant I5; the gateway
+enforces it structurally (single-writer result slot) rather than hoping
+the failover logic never races.
+
+Latency metrics: responses are unary (the ReplicaClient returns the full
+completion), so time-to-first-token and time-to-last-token coincide;
+``gateway_ttft_seconds`` records enqueue → response.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubegpu_tpu.gateway.client import ReplicaClient
+from kubegpu_tpu.gateway.failover import Dispatcher, FailoverPolicy
+from kubegpu_tpu.gateway.queue import AdmissionQueue, QueueClosed, QueueFull
+from kubegpu_tpu.gateway.registry import ReplicaRegistry
+from kubegpu_tpu.gateway.router import LeastOutstandingRouter, Router
+from kubegpu_tpu.utils.metrics import Metrics, default_metrics
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class GatewayRequest:
+    prompt: list
+    max_new_tokens: int
+    request_id: str = ""
+    tenant: str = ""
+    session: Optional[str] = None
+    temperature: float = 0.0
+    deadline_s: Optional[float] = None   # per-request override
+    enqueued_at: float = 0.0             # stamped by submit()
+
+    def __post_init__(self) -> None:
+        if not self.request_id:
+            self.request_id = uuid.uuid4().hex
+
+
+@dataclass
+class GatewayResult:
+    request_id: str
+    status: str                          # ok | rejected | error | timeout
+    tokens: List[int] = field(default_factory=list)
+    replica: str = ""
+    error: str = ""
+    attempts: int = 0
+    hedged: bool = False
+    queue_wait_s: float = 0.0
+    total_s: float = 0.0
+
+
+class PendingRequest:
+    """Caller-side handle: resolves exactly once."""
+
+    def __init__(self, request_id: str) -> None:
+        self.request_id = request_id
+        self._done = threading.Event()
+        self._result: Optional[GatewayResult] = None
+
+    def _resolve(self, result: GatewayResult) -> None:
+        self._result = result
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def result(self) -> Optional[GatewayResult]:
+        return self._result
+
+
+class Gateway:
+    def __init__(
+        self,
+        registry: ReplicaRegistry,
+        client: ReplicaClient,
+        router: Optional[Router] = None,
+        queue: Optional[AdmissionQueue] = None,
+        policy: Optional[FailoverPolicy] = None,
+        metrics: Optional[Metrics] = None,
+        dispatchers: int = 4,
+        max_results: int = 65536,
+    ) -> None:
+        self.registry = registry
+        self.client = client
+        self.queue = queue or AdmissionQueue()
+        self.metrics = metrics or default_metrics
+        self.dispatcher = Dispatcher(
+            client,
+            router or LeastOutstandingRouter(),
+            policy or FailoverPolicy(),
+            metrics=self.metrics,
+        )
+        self.n_dispatchers = dispatchers
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._lock = threading.Lock()
+        self._pending: Dict[str, PendingRequest] = {}
+        # FIFO-bounded: a long-lived gateway must not retain every result
+        # (token lists included) for the life of the process
+        self._results: "OrderedDict[str, GatewayResult]" = OrderedDict()
+        self.max_results = max_results
+        self._in_flight = 0
+        # drain accounting: submitted counts BEFORE submit() returns and
+        # resolved counts at _record(), so "submitted == resolved" has no
+        # window where a dequeued-but-uncounted request looks quiescent
+        # (queue depth and _in_flight alone have exactly that gap)
+        self._n_submitted = 0
+        self._n_resolved = 0
+        # per-replica completed-request counts (the routing-balance
+        # acceptance check reads this)
+        self.completed_by_replica: Dict[str, int] = {}
+        registry.subscribe(self._on_live_change)
+        # seed the gauge from the current set: the subscription only fires
+        # on CHANGE, and the registry may already be refreshed
+        self._on_live_change(registry.live_keys())
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        for i in range(self.n_dispatchers):
+            t = threading.Thread(
+                target=self._dispatch_loop, name=f"gw-dispatch-{i}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.close()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        # still-queued requests and any stragglers a wedged dispatcher
+        # left behind resolve with an explicit shutdown error — a client
+        # blocked in submit_and_wait must not wait out its whole deadline
+        # for an answer that can never come.  (_record drops duplicates,
+        # so racing a late dispatcher result is safe.)
+        while True:
+            request = self.queue.get(timeout=0)
+            if request is None:
+                break
+            self._record(GatewayResult(
+                request.request_id, "error", error="gateway shutting down",
+            ))
+        with self._lock:
+            leftovers = list(self._pending)
+        for rid in leftovers:
+            self._record(GatewayResult(
+                rid, "error", error="gateway shutting down",
+            ))
+
+    # -- submission (the HTTP handler's surface) ---------------------------
+    def submit(self, request: GatewayRequest) -> PendingRequest:
+        """Admit or refuse NOW.  Refusal still resolves the handle — with
+        an explicit "rejected" result — so callers have ONE code path."""
+        pending = PendingRequest(request.request_id)
+        with self._lock:
+            if (request.request_id in self._pending
+                    or request.request_id in self._results):
+                raise ValueError(
+                    f"duplicate request_id {request.request_id}"
+                )
+            self._pending[request.request_id] = pending
+            self._n_submitted += 1
+        request.enqueued_at = time.monotonic()
+        try:
+            self.queue.put(request)
+        except (QueueFull, QueueClosed) as e:
+            self.metrics.inc("gateway_requests_total", outcome="rejected")
+            self._record(GatewayResult(
+                request.request_id, "rejected", error=str(e),
+            ))
+            return pending
+        self.metrics.set_gauge("gateway_queue_depth", self.queue.depth())
+        return pending
+
+    def submit_and_wait(self, request: GatewayRequest,
+                        timeout: Optional[float] = None) -> GatewayResult:
+        pending = self.submit(request)
+        deadline = timeout or (
+            (request.deadline_s or self.dispatcher.policy.deadline_s) + 5.0
+        )
+        if not pending.wait(deadline):
+            return GatewayResult(
+                request.request_id, "timeout",
+                error="gateway did not resolve in time",
+            )
+        return pending.result()
+
+    # -- dispatch ----------------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            request = self.queue.get(timeout=0.05)
+            self.metrics.set_gauge("gateway_queue_depth", self.queue.depth())
+            if request is None:
+                continue
+            with self._lock:
+                self._in_flight += 1
+            try:
+                started = time.monotonic()
+                queue_wait = started - request.enqueued_at
+                self.metrics.observe("gateway_queue_wait_seconds", queue_wait)
+                outcome = self.dispatcher.dispatch(
+                    request, self.registry.live
+                )
+                total = time.monotonic() - request.enqueued_at
+                if outcome.status == "ok":
+                    self.metrics.observe("gateway_ttft_seconds", total)
+                self.metrics.inc(
+                    "gateway_requests_total", outcome=outcome.status
+                )
+                self._record(GatewayResult(
+                    request.request_id, outcome.status,
+                    tokens=outcome.tokens, replica=outcome.replica,
+                    error=outcome.error, attempts=outcome.attempts,
+                    hedged=outcome.hedged, queue_wait_s=queue_wait,
+                    total_s=total,
+                ))
+            except Exception as e:  # noqa: BLE001 - dispatcher must survive
+                log.exception("dispatch failed for %s", request.request_id)
+                self._record(GatewayResult(
+                    request.request_id, "error",
+                    error=f"internal dispatch error: {e}",
+                ))
+            finally:
+                with self._lock:
+                    self._in_flight -= 1
+
+    # -- exactly-once delivery --------------------------------------------
+    def _record(self, result: GatewayResult) -> None:
+        with self._lock:
+            if result.request_id in self._results:
+                # a hedge race or a stale retry produced a second terminal
+                # result: count it, DROP it — the first answer stands
+                self.metrics.inc("gateway_duplicate_results_total")
+                return
+            self._results[result.request_id] = result
+            while len(self._results) > self.max_results:
+                self._results.popitem(last=False)
+            pending = self._pending.pop(result.request_id, None)
+            self._n_resolved += 1
+            if result.status == "ok" and result.replica:
+                self.completed_by_replica[result.replica] = (
+                    self.completed_by_replica.get(result.replica, 0) + 1
+                )
+        if pending is not None:
+            pending._resolve(result)
+
+    # -- views -------------------------------------------------------------
+    def results(self) -> Dict[str, GatewayResult]:
+        with self._lock:
+            return dict(self._results)
+
+    def in_flight(self) -> int:
+        with self._lock:
+            return self._in_flight
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Wait for quiescence: every submitted request has a terminal
+        result.  (Counter equality, not queue-depth + in-flight: those two
+        have a window where a dequeued request is counted by neither.)"""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._n_submitted == self._n_resolved:
+                    return True
+            time.sleep(0.01)
+        return False
+
+    def _on_live_change(self, live) -> None:
+        self.metrics.set_gauge("gateway_live_replicas", len(live))

@@ -1,0 +1,276 @@
+"""Failover: deadlines, retry-with-budget, hedged dispatch.
+
+One request's dispatch lifecycle, run on a gateway dispatcher thread:
+
+    pick primary → submit → (straggling? submit ONE hedge elsewhere)
+                 → first completion wins, losers cancelled
+                 → error? retry on a different replica, if budget allows
+                 → deadline passed? cancel everything, fail explicitly
+
+Both hedges and retries are BUDGETED (the classic retry-budget shape:
+issued extra attempts may not exceed ``ratio`` of requests seen, with a
+small burst floor) — without the budget, a brown-out replica turns every
+request into 2-3 requests and the gateway amplifies its own overload
+into a full outage.  The winner's result is delivered exactly once; a
+hedge loser's completion is cancelled and discarded, never surfaced —
+the soak's I5 invariant (served exactly once) leans on this.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from kubegpu_tpu.gateway.client import Attempt, ReplicaClient
+from kubegpu_tpu.gateway.registry import ReplicaInfo
+from kubegpu_tpu.gateway.router import Router
+from kubegpu_tpu.utils.metrics import Metrics
+
+_POLL_S = 0.002  # attempt-completion poll; decode steps are >> this
+
+
+@dataclass
+class FailoverPolicy:
+    deadline_s: float = 30.0        # end-to-end cap per request
+    hedge_after_s: float = 1.0      # straggler threshold before hedging
+    max_attempts: int = 3           # primary + retries (hedges NOT counted)
+    retry_budget_ratio: float = 0.2  # retries ≤ ratio · requests + floor
+    hedge_budget_ratio: float = 0.1  # hedges  ≤ ratio · requests + floor
+    budget_floor: int = 10           # burst allowance while requests ≈ 0
+
+
+class _Budget:
+    """issued ≤ ratio · seen + floor — cheap, lock-protected, monotonic."""
+
+    def __init__(self, ratio: float, floor: int) -> None:
+        self.ratio = ratio
+        self.floor = floor
+        self.seen = 0
+        self.issued = 0
+        self._lock = threading.Lock()
+
+    def observe(self) -> None:
+        with self._lock:
+            self.seen += 1
+
+    def try_spend(self) -> bool:
+        with self._lock:
+            if self.issued < self.ratio * self.seen + self.floor:
+                self.issued += 1
+                return True
+            return False
+
+
+class Dispatcher:
+    """Drives one request through attempts against the ReplicaClient.
+
+    Shared across gateway dispatcher threads: the budgets and outstanding
+    counts are global on purpose (a per-thread budget would defeat the
+    amplification bound).
+    """
+
+    def __init__(
+        self,
+        client: ReplicaClient,
+        router: Router,
+        policy: Optional[FailoverPolicy] = None,
+        metrics: Optional[Metrics] = None,
+        outstanding=None,
+        outstanding_lock: Optional[threading.Lock] = None,
+    ) -> None:
+        self.client = client
+        self.router = router
+        self.policy = policy or FailoverPolicy()
+        self.metrics = metrics
+        self.retry_budget = _Budget(
+            self.policy.retry_budget_ratio, self.policy.budget_floor
+        )
+        self.hedge_budget = _Budget(
+            self.policy.hedge_budget_ratio, self.policy.budget_floor
+        )
+        # replica key -> in-flight count, shared with the router's callers
+        self.outstanding = outstanding if outstanding is not None else {}
+        self._out_lock = outstanding_lock or threading.Lock()
+
+    # -- outstanding bookkeeping ------------------------------------------
+    def _inc(self, key: str) -> None:
+        with self._out_lock:
+            self.outstanding[key] = self.outstanding.get(key, 0) + 1
+
+    def _dec(self, key: str) -> None:
+        with self._out_lock:
+            n = self.outstanding.get(key, 1) - 1
+            if n <= 0:
+                self.outstanding.pop(key, None)
+            else:
+                self.outstanding[key] = n
+
+    def _submit(self, replica: ReplicaInfo, request) -> Attempt:
+        self._inc(replica.key)
+        return self.client.submit(replica.key, request)
+
+    def _settle(self, attempt: Attempt) -> None:
+        self._dec(attempt.replica)
+
+    # -- the dispatch loop -------------------------------------------------
+    def dispatch(
+        self,
+        request,
+        live: Callable[[], List[ReplicaInfo]],
+    ) -> "DispatchOutcome":
+        """Run one request to a terminal outcome.  ``live`` re-reads the
+        registry so retries see post-failure membership.
+
+        The deadline is anchored at ENQUEUE (request.enqueued_at), not at
+        dequeue: "end-to-end budget" includes queue wait, and the caller's
+        submit_and_wait times out relative to submission — a request that
+        aged out in the queue must fail fast here, not burn a replica
+        decoding an answer its client already abandoned."""
+        policy = self.policy
+        self.retry_budget.observe()
+        self.hedge_budget.observe()
+        start = getattr(request, "enqueued_at", 0.0) or time.monotonic()
+        deadline = start + (
+            getattr(request, "deadline_s", None) or policy.deadline_s
+        )
+        tried = set()
+        attempts: List[Attempt] = []
+        n_attempts = 0
+        hedged = False
+        hedge_at: Optional[float] = None
+        last_error = "no live replicas"
+
+        def pick_target() -> Optional[ReplicaInfo]:
+            # prefer a replica this request hasn't touched; fall back to
+            # re-trying one (it may have recovered) rather than failing
+            replicas = live()
+            target = self.router.pick(
+                request, replicas, self.outstanding, frozenset(tried)
+            )
+            if target is None and tried:
+                target = self.router.pick(
+                    request, replicas, self.outstanding, frozenset()
+                )
+            return target
+
+        while True:
+            now = time.monotonic()
+            if now >= deadline:
+                for a in attempts:
+                    if not a.done:
+                        self.client.cancel(a)
+                    self._settle(a)
+                if self.metrics:
+                    self.metrics.inc("gateway_deadline_exceeded_total")
+                return DispatchOutcome(
+                    "timeout", error=f"deadline exceeded after "
+                    f"{n_attempts} attempt(s): {last_error}",
+                    attempts=n_attempts, hedged=hedged,
+                )
+
+            if not attempts:
+                # nothing in flight — the ONE place attempts are opened
+                # (first admission and every post-failure re-admission),
+                # so the budget rules live in one place: charged only for
+                # re-admissions, and only once a concrete replica exists
+                # (a cluster mid-failover may have zero live replicas for
+                # a cycle; polling while nobody is routable must not
+                # drain the budget — the request waits, bounded by the
+                # deadline above).
+                if n_attempts > 0 and n_attempts >= policy.max_attempts:
+                    if self.metrics:
+                        self.metrics.inc("gateway_failures_total")
+                    return DispatchOutcome(
+                        "error", error=f"{n_attempts} attempt(s) failed: "
+                        f"{last_error}",
+                        attempts=n_attempts, hedged=hedged,
+                    )
+                candidate = pick_target()
+                if candidate is None:
+                    time.sleep(_POLL_S * 5)
+                    continue
+                if n_attempts > 0:
+                    if not self.retry_budget.try_spend():
+                        if self.metrics:
+                            self.metrics.inc(
+                                "gateway_retry_budget_exhausted_total"
+                            )
+                        return DispatchOutcome(
+                            "error", error="retry budget exhausted: "
+                            + last_error,
+                            attempts=n_attempts, hedged=hedged,
+                        )
+                    if self.metrics:
+                        self.metrics.inc("gateway_retries_total")
+                tried.add(candidate.key)
+                attempts.append(self._submit(candidate, request))
+                n_attempts += 1
+                hedge_at = time.monotonic() + policy.hedge_after_s
+                continue
+
+            winner = None
+            for a in attempts:
+                if a.wait(_POLL_S / max(len(attempts), 1)):
+                    winner = a
+                    break
+            if winner is not None:
+                res = winner.result()
+                if res is not None and res.ok and not winner.cancelled:
+                    for a in attempts:
+                        if a is not winner:
+                            if not a.done:
+                                self.client.cancel(a)
+                            if self.metrics:
+                                self.metrics.inc("gateway_hedge_wasted_total")
+                        self._settle(a)
+                    return DispatchOutcome(
+                        "ok", tokens=res.tokens, replica=winner.replica,
+                        attempts=n_attempts, hedged=hedged,
+                    )
+                # failed (replica died / refused / cancelled): drop it;
+                # if nothing else is in flight the empty-attempts branch
+                # above owns the (budgeted) re-admission
+                last_error = res.error if res else "unknown"
+                attempts.remove(winner)
+                self._settle(winner)
+                continue
+
+            # no completion yet: is the in-flight attempt straggling?
+            # Pick the hedge target BEFORE spending the budget — a token
+            # burned with nowhere to hedge to throttles future hedges
+            # without ever issuing one.
+            if (
+                not hedged
+                and len(attempts) == 1
+                and hedge_at is not None
+                and now >= hedge_at
+            ):
+                target = self.router.pick(
+                    request, live(), self.outstanding, frozenset(tried)
+                )
+                if target is None:
+                    hedge_at = None  # nowhere to hedge to; stop trying
+                elif self.hedge_budget.try_spend():
+                    tried.add(target.key)
+                    attempts.append(self._submit(target, request))
+                    hedged = True
+                    if self.metrics:
+                        self.metrics.inc("gateway_hedges_total")
+                else:
+                    hedge_at = None  # budget denied; stop re-checking
+
+
+@dataclass
+class DispatchOutcome:
+    status: str                      # "ok" | "error" | "timeout"
+    tokens: List[int] = None         # type: ignore[assignment]
+    replica: str = ""
+    error: str = ""
+    attempts: int = 0
+    hedged: bool = False
+
+    def __post_init__(self) -> None:
+        if self.tokens is None:
+            self.tokens = []

@@ -1,0 +1,233 @@
+"""Replica discovery: which decode replicas exist and which are routable.
+
+The control plane already publishes everything discovery needs — the bind
+writes each replica pod's chip assignment into its annotations, and the
+advertiser re-publishes per-chip health into node annotations every cycle
+(SURVEY.md §1: annotations ARE the durable state).  The registry is a pure
+read-side join of the two:
+
+    replica is LIVE  ⇔  pod carries kubegpu-tpu/serving-group
+                     ∧  pod is bound with an assignment annotation
+                     ∧  pod is not terminal / terminating
+                     ∧  every assigned chip is currently advertised healthy
+
+so a chip death drains its replica in the SAME advertise cycle the
+scheduler sees it — no separate health prober, no lag between "scheduler
+evicts the pod" and "gateway stops routing to it".  Watches (node + pod)
+make the drain event-driven; a periodic refresh is the consistency
+backstop, exactly the scheduler's informer/resync split.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, FrozenSet, List, Optional
+
+from kubegpu_tpu.types import annotations
+from kubegpu_tpu.types.topology import Coord
+from kubegpu_tpu.utils.apiserver import ApiServer
+
+log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class ReplicaInfo:
+    """One routable decode replica (a bound serving pod)."""
+
+    key: str                      # "namespace/pod" — the routing identity
+    pod: str
+    namespace: str
+    group: str
+    node: str
+    slice_id: Optional[str]
+    coords: FrozenSet[Coord] = field(default_factory=frozenset)
+    healthy: bool = True
+    reason: str = ""              # why not healthy (operator-facing)
+
+
+class ReplicaRegistry:
+    """Read-side join of pod assignments and advertiser health.
+
+    ``subscribe`` observers fire on every live-set CHANGE with the new
+    frozenset of live replica keys — the gateway uses this to fail over
+    in-flight work the moment a replica drains, and the in-memory replica
+    client uses it to model the pod's process dying with its chips.
+    """
+
+    def __init__(self, api: ApiServer, group: Optional[str] = None) -> None:
+        self.api = api
+        self.group = group  # None = every serving group
+        self._lock = threading.Lock()
+        # serializes whole refresh cycles (LIST → join → swap): the watch
+        # handlers and the periodic loop both call refresh(), and an older
+        # cycle's snapshot must not land AFTER a newer one resurrected —
+        # briefly — a replica the newer cycle had drained
+        self._refresh_lock = threading.Lock()
+        self._replicas: Dict[str, ReplicaInfo] = {}
+        self._observers: List[Callable[[FrozenSet[str]], None]] = []
+        self._last_live: FrozenSet[str] = frozenset()
+        # watch-event coalescing: with a refresher thread running, event
+        # handlers set this flag instead of refreshing inline — an
+        # advertise cycle over N nodes folds into ~one refresh, not N
+        # serialized full LISTs
+        self._dirty = threading.Event()
+        self._refresher_running = False
+
+    # -- discovery ---------------------------------------------------------
+    def refresh(self) -> None:
+        """Full LIST resync: rebuild the replica table from pod + node
+        annotations.  Cheap (one pod LIST + one node LIST) and idempotent;
+        the watch handlers call it too, so event and resync paths cannot
+        diverge."""
+        with self._refresh_lock:
+            self._refresh_locked()
+
+    def _refresh_locked(self) -> None:
+        chip_health: Dict[tuple, bool] = {}
+        advertised_slices = set()
+        for node_obj in self.api.list_nodes():
+            info = annotations.node_from_k8s(node_obj)
+            if info.slice_id is None:
+                continue
+            advertised_slices.add(info.slice_id)
+            for ch in info.chips:
+                chip_health[(info.slice_id, ch.coords)] = ch.healthy
+
+        replicas: Dict[str, ReplicaInfo] = {}
+        for obj in self.api.list_pods():
+            meta = obj.get("metadata", {}) or {}
+            ann = dict(meta.get("annotations") or {})
+            group = ann.get(annotations.POD_SERVING_GROUP)
+            if not group or (self.group is not None and group != self.group):
+                continue
+            ns = meta.get("namespace", "default")
+            name = meta.get("name", "")
+            key = f"{ns}/{name}"
+            node = (obj.get("spec") or {}).get("nodeName") or ""
+            a = annotations.assignment_from_pod(obj)
+            phase = ((obj.get("status") or {}).get("phase") or "")
+            healthy, reason = True, ""
+            coords: FrozenSet[Coord] = frozenset()
+            slice_id = None
+            if not node or a is None:
+                healthy, reason = False, "unscheduled (no assignment yet)"
+            elif phase in ("Succeeded", "Failed"):
+                healthy, reason = False, f"terminal ({phase})"
+            elif meta.get("deletionTimestamp"):
+                healthy, reason = False, "terminating"
+            else:
+                slice_id = a.slice_id
+                coords = frozenset(c.coords for c in a.all_chips())
+                if slice_id is not None and slice_id not in advertised_slices:
+                    healthy, reason = False, f"slice {slice_id} not advertised"
+                else:
+                    dead = sorted(
+                        c for c in coords
+                        if not chip_health.get((slice_id, c), False)
+                    )
+                    if dead:
+                        healthy, reason = False, f"dead chips {dead}"
+            replicas[key] = ReplicaInfo(
+                key=key, pod=name, namespace=ns, group=group, node=node,
+                slice_id=slice_id, coords=coords, healthy=healthy,
+                reason=reason,
+            )
+
+        with self._lock:
+            self._replicas = replicas
+            live = frozenset(k for k, r in replicas.items() if r.healthy)
+            changed = live != self._last_live
+            self._last_live = live
+            observers = list(self._observers)
+        if changed:
+            for fn in observers:
+                try:
+                    fn(live)
+                except Exception:  # noqa: BLE001 - observers are best-effort
+                    log.exception("replica-set observer failed")
+
+    # -- views -------------------------------------------------------------
+    def live(self) -> List[ReplicaInfo]:
+        """Routable replicas, name-sorted for deterministic iteration."""
+        with self._lock:
+            return sorted(
+                (r for r in self._replicas.values() if r.healthy),
+                key=lambda r: r.key,
+            )
+
+    def all(self) -> List[ReplicaInfo]:
+        with self._lock:
+            return sorted(self._replicas.values(), key=lambda r: r.key)
+
+    def get(self, key: str) -> Optional[ReplicaInfo]:
+        with self._lock:
+            return self._replicas.get(key)
+
+    def live_keys(self) -> FrozenSet[str]:
+        with self._lock:
+            return self._last_live
+
+    def subscribe(self, fn: Callable[[FrozenSet[str]], None]) -> None:
+        with self._lock:
+            self._observers.append(fn)
+
+    # -- event plumbing ----------------------------------------------------
+    def _request_refresh(self) -> None:
+        """Refresh now, or mark dirty for the coalescing refresher if one
+        is running (start_watches) — a burst of events then costs one
+        refresh instead of one per event."""
+        if self._refresher_running:
+            self._dirty.set()
+        else:
+            self.refresh()
+
+    def on_pod_event(self, event: str, obj: dict) -> None:
+        ann = ((obj.get("metadata") or {}).get("annotations") or {})
+        if annotations.POD_SERVING_GROUP in ann:
+            self._request_refresh()
+
+    def on_node_event(self, event: str, obj: dict) -> None:
+        # only advertiser-annotated nodes affect replica health
+        ann = ((obj.get("metadata") or {}).get("annotations") or {})
+        if annotations.NODE_TOPOLOGY in ann or event == "node-deleted":
+            self._request_refresh()
+
+    def start_watches(self, stop: threading.Event) -> List[threading.Thread]:
+        """Spawn the node + pod watch threads (the event-driven drain
+        path) plus the coalescing refresher that serves their dirty
+        flags; callers keep the periodic refresh as backstop."""
+        self._refresher_running = True
+
+        def refresher():
+            while not stop.is_set():
+                if not self._dirty.wait(0.05):
+                    continue
+                self._dirty.clear()
+                try:
+                    self.refresh()
+                except Exception:  # noqa: BLE001
+                    log.exception("coalesced refresh failed; will retry")
+
+        threads = []
+        for target in (
+            lambda: self.api.watch_nodes(self.on_node_event, stop),
+            lambda: self.api.watch_pods(self.on_pod_event, stop),
+            refresher,
+        ):
+            t = threading.Thread(target=self._guard(target), daemon=True)
+            t.start()
+            threads.append(t)
+        return threads
+
+    @staticmethod
+    def _guard(target):
+        def run():
+            try:
+                target()
+            except NotImplementedError:
+                log.info("api server has no watch; relying on refresh loop")
+            except Exception:  # noqa: BLE001
+                log.exception("registry watch died; relying on refresh loop")
+        return run

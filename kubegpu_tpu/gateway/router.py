@@ -1,0 +1,142 @@
+"""Routing policies: pick a replica for a request.
+
+Pluggable (the scheduler's plugin-registry spirit applied to the data
+plane): a ``Router`` sees the live replica set, the gateway's outstanding
+per-replica request counts, and an exclude set (replicas this request
+already failed or hedged on), and returns one replica or None.
+
+- ``LeastOutstandingRouter`` (default): classic least-outstanding-requests.
+  Queue depth at the replica is the best cheap congestion signal a gateway
+  has (better than round-robin under heterogeneous request cost, no
+  replica-side cooperation needed).  Ties break by ICI slice locality —
+  prefer the slice the session's KV history lives on (a same-slice re-route
+  after failover keeps any future KV migration on ICI instead of DCN),
+  then by mesh distance within the slice, then by name for determinism.
+- ``SessionAffinityRouter``: sticky session → replica mapping for KV
+  reuse (a replica that served a session's earlier turns still holds the
+  conversation's cache pages).  Falls back to least-outstanding — with the
+  dead replica's slice as the locality hint — when the pinned replica
+  drains, and re-pins to the new choice.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, FrozenSet, List, Mapping, Optional
+
+from kubegpu_tpu.gateway.registry import ReplicaInfo
+from kubegpu_tpu.types.topology import coords_bounding_box
+
+
+class Router:
+    def pick(
+        self,
+        request,
+        replicas: List[ReplicaInfo],
+        outstanding: Mapping[str, int],
+        exclude: FrozenSet[str] = frozenset(),
+    ) -> Optional[ReplicaInfo]:
+        raise NotImplementedError
+
+
+def _mesh_distance(a: ReplicaInfo, b: ReplicaInfo) -> int:
+    """Manhattan distance between the two replicas' chip-block origins —
+    the ICI hop-count proxy the contiguity scorer uses; only meaningful
+    within one slice."""
+    if not a.coords or not b.coords:
+        return 0
+    ao, _ = coords_bounding_box(a.coords)
+    bo, _ = coords_bounding_box(b.coords)
+    return sum(abs(x - y) for x, y in zip(ao, bo))
+
+
+class LeastOutstandingRouter(Router):
+    def pick(self, request, replicas, outstanding, exclude=frozenset()):
+        candidates = [r for r in replicas if r.key not in exclude]
+        if not candidates:
+            return None
+        hint_slice = getattr(request, "preferred_slice", None)
+        hint_replica = getattr(request, "preferred_replica", None)
+        anchor = next(
+            (r for r in replicas if r.key == hint_replica), None
+        )
+
+        def rank(r: ReplicaInfo):
+            # smaller is better on every component; name last makes the
+            # whole ordering total and deterministic
+            return (
+                outstanding.get(r.key, 0),
+                0 if (hint_slice and r.slice_id == hint_slice) else 1,
+                _mesh_distance(r, anchor) if (
+                    anchor is not None and r.slice_id == anchor.slice_id
+                ) else 0,
+                r.key,
+            )
+
+        return min(candidates, key=rank)
+
+
+class SessionAffinityRouter(Router):
+    """Sticky sessions over a fallback router (LeastOutstanding default).
+
+    The pin map is bounded: entries for sessions nobody re-requests age
+    out FIFO past ``max_sessions`` — an affinity table must not grow with
+    total session history.
+    """
+
+    def __init__(self, fallback: Optional[Router] = None,
+                 max_sessions: int = 65536) -> None:
+        self.fallback = fallback or LeastOutstandingRouter()
+        self.max_sessions = max_sessions
+        self._lock = threading.Lock()
+        self._pins: Dict[str, str] = {}  # session -> replica key
+        # each pinned replica's slice, so a DEAD pin can still hint
+        # locality (the dead replica no longer appears in `replicas`).
+        # Same FIFO bound as the pins: one entry per replica key ever
+        # pinned would otherwise leak across replica pod churn
+        self._last_slices: Dict[str, Optional[str]] = {}
+
+    def pick(self, request, replicas, outstanding, exclude=frozenset()):
+        session = getattr(request, "session", None)
+        if not session:
+            return self.fallback.pick(request, replicas, outstanding, exclude)
+        with self._lock:
+            pinned = self._pins.get(session)
+        by_key = {r.key: r for r in replicas}
+        if pinned and pinned in by_key and pinned not in exclude:
+            return by_key[pinned]
+        # pinned replica drained (or first sighting): route by load, with
+        # the old pin as the locality hint so the replacement stays on the
+        # slice the session's KV lived on where possible
+        if pinned is not None:
+            request = _with_hint(request, pinned, self._slice_of(pinned))
+        choice = self.fallback.pick(request, replicas, outstanding, exclude)
+        if choice is not None:
+            with self._lock:
+                self._pins[session] = choice.key
+                self._last_slices[choice.key] = choice.slice_id
+                while len(self._pins) > self.max_sessions:
+                    self._pins.pop(next(iter(self._pins)))
+                while len(self._last_slices) > self.max_sessions:
+                    self._last_slices.pop(next(iter(self._last_slices)))
+        return choice
+
+    def _slice_of(self, replica_key: str) -> Optional[str]:
+        return self._last_slices.get(replica_key)
+
+    def forget(self, session: str) -> None:
+        with self._lock:
+            self._pins.pop(session, None)
+
+
+class _with_hint:
+    """Request view carrying a routing hint without mutating the caller's
+    request object (dispatch may retry with the original)."""
+
+    def __init__(self, request, preferred_replica, preferred_slice):
+        self._request = request
+        self.preferred_replica = preferred_replica
+        self.preferred_slice = preferred_slice
+
+    def __getattr__(self, name):
+        return getattr(self._request, name)

@@ -1,0 +1,332 @@
+"""Gateway HTTP frontend (the data-plane twin of scheduler/server.py).
+
+Thin codec over ``gateway/core.py`` — every routing/admission/failover
+behavior is testable without sockets; this module only translates HTTP:
+
+    POST /v1/generate   {"prompt": [ints], "max_new_tokens": n,
+                         "tenant": "...", "session": "...",
+                         "temperature": t, "deadline_s": s}
+        → 200 {"tokens": [...], "replica": "...", "attempts": n,
+               "hedged": bool}
+        → 429 {"error": ...}   explicit backpressure (queue full)
+        → 502 {"error": ...}   all attempts failed
+        → 504 {"error": ...}   deadline exceeded
+    GET  /healthz       liveness (the process serves)
+    GET  /readyz        readiness (≥1 live replica to route to)
+    GET  /metrics       Prometheus text (TTFT/queue-wait histograms,
+                        queue-depth/live-replica gauges)
+    GET  /state         debug dump (replicas, queue, outcome counts)
+
+Run self-hosted on a fabricated cluster for demos/tests (no k8s, no TPUs):
+    python -m kubegpu_tpu.gateway.server --fake-cluster v5e-16 --replicas 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from kubegpu_tpu.gateway.core import Gateway, GatewayRequest
+from kubegpu_tpu.gateway.queue import AdmissionQueue
+from kubegpu_tpu.gateway.registry import ReplicaRegistry
+
+log = logging.getLogger(__name__)
+
+_STATUS_HTTP = {"ok": 200, "rejected": 429, "error": 502, "timeout": 504}
+
+
+def make_handler(gateway: Gateway, registry: ReplicaRegistry):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _read_json(self) -> Optional[dict]:
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                raw = self.rfile.read(length)
+                return json.loads(raw) if raw else {}
+            except (ValueError, json.JSONDecodeError):
+                return None
+
+        def _send(self, code: int, payload,
+                  content_type="application/json") -> None:
+            body = (
+                json.dumps(payload).encode()
+                if content_type == "application/json"
+                else payload.encode()
+            )
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):
+            log.debug("http: " + fmt, *args)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, "ok", content_type="text/plain")
+            elif self.path == "/readyz":
+                # ready ⇔ at least one replica to route to AND a data
+                # plane that can reach it; either gap means a gateway in
+                # the Service would eat traffic into guaranteed 5xx
+                if not gateway.client.ready():
+                    self._send(503, "data plane not wired "
+                               "(no replica client)",
+                               content_type="text/plain")
+                elif registry.live():
+                    self._send(200, "ok", content_type="text/plain")
+                else:
+                    self._send(503, "no live replicas",
+                               content_type="text/plain")
+            elif self.path == "/metrics":
+                self._send(200, gateway.metrics.render(),
+                           content_type="text/plain")
+            elif self.path == "/state":
+                self._send(200, _debug_state(gateway, registry))
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/v1/generate":
+                self._send(404, {"error": f"no route {self.path}"})
+                return
+            body = self._read_json()
+            if body is None:
+                self._send(400, {"error": "malformed JSON body"})
+                return
+            try:
+                request = GatewayRequest(
+                    prompt=[int(t) for t in body.get("prompt") or []],
+                    max_new_tokens=int(body.get("max_new_tokens", 0)),
+                    tenant=str(body.get("tenant", "")),
+                    session=body.get("session"),
+                    temperature=float(body.get("temperature", 0.0)),
+                    deadline_s=(
+                        float(body["deadline_s"])
+                        if body.get("deadline_s") is not None else None
+                    ),
+                )
+            except (TypeError, ValueError) as e:
+                self._send(400, {"error": f"bad request: {e}"})
+                return
+            # blocking unary call: the handler thread IS the caller's
+            # connection; backpressure resolves instantly, decode blocks
+            # until the dispatcher delivers
+            result = gateway.submit_and_wait(request)
+            code = _STATUS_HTTP.get(result.status, 500)
+            payload = {
+                "request_id": result.request_id,
+                "status": result.status,
+            }
+            if result.status == "ok":
+                payload.update(
+                    tokens=result.tokens, replica=result.replica,
+                    attempts=result.attempts, hedged=result.hedged,
+                )
+            else:
+                payload["error"] = result.error
+            self._send(code, payload)
+
+    return Handler
+
+
+def _debug_state(gateway: Gateway, registry: ReplicaRegistry) -> dict:
+    outcomes: dict = {}
+    for r in gateway.results().values():
+        outcomes[r.status] = outcomes.get(r.status, 0) + 1
+    return {
+        "replicas": [
+            {
+                "key": r.key, "group": r.group, "node": r.node,
+                "slice": r.slice_id, "chips": sorted(map(list, r.coords)),
+                "healthy": r.healthy, "reason": r.reason,
+            }
+            for r in registry.all()
+        ],
+        "queue_depth": gateway.queue.depth(),
+        "in_flight": gateway.in_flight(),
+        "outstanding": dict(gateway.dispatcher.outstanding),
+        "outcomes": outcomes,
+        "completed_by_replica": dict(gateway.completed_by_replica),
+    }
+
+
+class _GatewayHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def handle_error(self, request, client_address):
+        log.debug("connection error from %s", client_address, exc_info=True)
+
+
+class GatewayServer:
+    """Owns the HTTP server + registry refresh loop + node/pod watches +
+    the gateway dispatcher pool (the ExtenderServer shape)."""
+
+    def __init__(
+        self,
+        gateway: Gateway,
+        listen: Tuple[str, int] = ("127.0.0.1", 8600),
+        refresh_interval_s: float = 10.0,
+        watch: bool = True,
+    ) -> None:
+        self.gateway = gateway
+        self.registry = gateway.registry
+        self.httpd = _GatewayHTTPServer(
+            listen, make_handler(gateway, self.registry)
+        )
+        self.refresh_interval_s = refresh_interval_s
+        self.watch = watch
+        self._stop = threading.Event()
+        self._threads = []
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.httpd.server_address[:2]
+
+    def start(self) -> None:
+        self.registry.refresh()
+        self.gateway.start()
+        t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        t.start()
+        self._threads.append(t)
+        r = threading.Thread(target=self._refresh_loop, daemon=True)
+        r.start()
+        self._threads.append(r)
+        if self.watch:
+            # event-driven drain: a chip death propagates the same cycle
+            self._threads.extend(self.registry.start_watches(self._stop))
+
+    def _refresh_loop(self) -> None:
+        while not self._stop.wait(self.refresh_interval_s):
+            try:
+                self.registry.refresh()
+            except Exception:  # noqa: BLE001
+                log.exception("registry refresh failed; keeping stale set")
+
+    def stop(self) -> None:
+        self._stop.set()
+        close = getattr(self.registry.api, "close_watches", None)
+        if close is not None:
+            close()
+        self.gateway.stop()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _build_fake_serving_cluster(preset: str, replicas: int, group: str):
+    """Fabricated cluster + scheduled decode replicas + SimBatcher-backed
+    in-memory data plane: the full serving path with zero dependencies."""
+    from kubegpu_tpu.gateway.client import InMemoryReplicaClient, SimBatcher
+    from kubegpu_tpu.scheduler import Scheduler
+    from kubegpu_tpu.scheduler.server import build_fake_cluster
+    from kubegpu_tpu.testing.fake_serving import schedule_decode_replicas
+
+    api = build_fake_cluster(preset)
+    sched = Scheduler(api)
+    sched.cache.refresh()
+    try:
+        schedule_decode_replicas(
+            api, sched, replicas, group, name_prefix=group
+        )
+    except AssertionError as e:
+        raise SystemExit(str(e))
+    registry = ReplicaRegistry(api, group=group)
+    # a realistic per-step decode latency: with instant decode the
+    # outstanding counts never build and least-outstanding degenerates to
+    # its name tiebreak — the demo should demonstrate load spreading
+    client = InMemoryReplicaClient(
+        batcher_factory=lambda key: SimBatcher(slots=8), step_delay_s=0.002
+    )
+    registry.subscribe(client.sync_live)
+    registry.refresh()
+    return api, registry, client
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--listen", default="127.0.0.1:8600")
+    ap.add_argument(
+        "--group", default="decode",
+        help="serving group to route for (kubegpu-tpu/serving-group value)",
+    )
+    ap.add_argument(
+        "--fake-cluster", metavar="PRESET",
+        help="serve a fabricated in-memory cluster + SimBatcher replicas "
+        "(e.g. v5e-16) instead of connecting to a real API server",
+    )
+    ap.add_argument("--replicas", type=int, default=3,
+                    help="replica count for --fake-cluster mode")
+    ap.add_argument("--queue-capacity", type=int, default=256)
+    ap.add_argument("--per-tenant-cap", type=int, default=None)
+    ap.add_argument("--deadline", type=float, default=30.0,
+                    help="per-request end-to-end deadline (seconds)")
+    ap.add_argument("--hedge-after", type=float, default=1.0,
+                    help="straggler threshold before a hedged dispatch")
+    ap.add_argument("--dispatchers", type=int, default=8)
+    ap.add_argument("--refresh-interval", type=float, default=10.0)
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+
+    if args.fake_cluster:
+        _, registry, client = _build_fake_serving_cluster(
+            args.fake_cluster, args.replicas, args.group
+        )
+    else:
+        from kubegpu_tpu.utils.apiserver import KubeApiServer
+
+        registry = ReplicaRegistry(KubeApiServer(), group=args.group)
+        # the real data-plane client (HTTP to replica pods) is the next
+        # growth step; until then in-cluster mode discovers replicas but
+        # cannot dispatch — client.ready() is False, so /readyz reports
+        # 503 and this instance never joins the Service (an honest
+        # NotReady beats converting traffic into guaranteed 5xx)
+        from kubegpu_tpu.gateway.client import InMemoryReplicaClient
+
+        client = InMemoryReplicaClient(batcher_factory=None)
+        log.warning(
+            "in-cluster data-plane client not implemented yet: replica "
+            "discovery and /metrics are live, but /readyz stays 503 and "
+            "no traffic will be served (use --fake-cluster for the demo "
+            "data plane)"
+        )
+    from kubegpu_tpu.gateway.failover import FailoverPolicy
+
+    gateway = Gateway(
+        registry, client,
+        queue=AdmissionQueue(args.queue_capacity, args.per_tenant_cap),
+        policy=FailoverPolicy(
+            deadline_s=args.deadline, hedge_after_s=args.hedge_after
+        ),
+        dispatchers=args.dispatchers,
+    )
+    host, _, port = args.listen.rpartition(":")
+    server = GatewayServer(
+        gateway,
+        listen=(host or "127.0.0.1", int(port)),
+        refresh_interval_s=args.refresh_interval,
+    )
+    server.start()
+    log.info("gateway listening on http://%s:%d", *server.address)
+    import signal
+
+    shutdown = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: shutdown.set())
+    try:
+        shutdown.wait()
+    except KeyboardInterrupt:
+        pass
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -28,8 +28,9 @@ workload-side twin of the decode sample (`samples/jax-decode.yaml`).
 
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -110,6 +111,10 @@ class ContinuousBatcher:
         )
         self.pos = jnp.zeros((slots,), jnp.int32)
         self._slots = [_Slot() for _ in range(slots)]
+        # incremental serving state (submit/serve_step — the gateway's
+        # replica loop); run() is a batch convenience over the same queue
+        self._pending: deque = deque()
+        self.stats = {"steps": 0, "admits": 0}
 
         from kubegpu_tpu.models.decoding import pick_tokens
 
@@ -211,49 +216,73 @@ class ContinuousBatcher:
         if s.remaining <= 0:
             s.active = False
 
-    def run(
-        self,
-        prompts: List[np.ndarray],
-        max_new_tokens: List[int],
-        temperatures: Optional[List[float]] = None,
-    ) -> Dict[int, List[int]]:
-        """Serve every prompt to completion; returns {seq_id: generated
-        tokens}.  ``stats['steps']`` afterwards holds the number of step
-        programs executed (the efficiency measure vs static batching).
-        ``temperatures`` is per-request (0/None = greedy; >0 samples from
-        softmax(logits/T), truncated to the batcher's ``top_k``) — mixed
-        greedy/sampled requests share the batch."""
-        assert len(prompts) == len(max_new_tokens)
-        temps = temperatures or [0.0] * len(prompts)
-        assert len(temps) == len(prompts)
-        queue = list(range(len(prompts)))
-        done: Dict[int, List[int]] = {}
-        self.stats = {"steps": 0, "admits": 0}
+    # -- incremental serving API (the gateway's replica loop) --------------
+    def submit(self, seq_id: int, prompt: np.ndarray, max_new: int,
+               temperature: float = 0.0) -> None:
+        """Queue one request (seq_id must be a fresh non-negative int).
+        Validates shape limits eagerly so a malformed request fails at
+        submission, never mid-serve-loop where it would take down the
+        whole batch."""
+        if seq_id < 0:
+            raise ValueError(f"seq_id must be >= 0, got {seq_id}")
+        prompt = np.asarray(prompt, np.int32)
+        plen = int(prompt.shape[0])
+        if plen > self.prompt_pad:
+            raise ValueError(
+                f"prompt length {plen} exceeds prompt_pad {self.prompt_pad}"
+            )
+        if plen + max_new > self.max_seq:
+            raise ValueError(
+                f"prompt {plen} + max_new {max_new} exceeds max_seq "
+                f"{self.max_seq}"
+            )
+        self._pending.append((seq_id, prompt, max_new, temperature))
 
-        def retire_and_admit():
-            # sweep until a full pass makes no progress: an admit can
-            # complete INSTANTLY (max_new=1, or the first token is EOS),
-            # and its freed slot must serve the next queued prompt in the
-            # same pass — or a 1-slot batcher strands the queue
-            progress = True
-            while progress:
-                progress = False
-                for i, s in enumerate(self._slots):
-                    if s.seq_id >= 0 and not s.active:
-                        done[s.seq_id] = s.tokens
-                        s.seq_id = -1
-                        progress = True
-                    if s.seq_id < 0 and queue:
-                        nxt = queue.pop(0)
-                        self._admit_one(
-                            i, nxt, prompts[nxt], max_new_tokens[nxt],
-                            temps[nxt],
-                        )
-                        self.stats["admits"] += 1
-                        progress = True
+    def cancel(self, seq_id: int) -> bool:
+        """Withdraw a request: drop it from the pending queue, or free its
+        slot mid-decode (the slot's cache rows are dead weight until the
+        next admit overwrites them).  Returns False if the request is
+        unknown — already retired, or never submitted."""
+        for i, item in enumerate(self._pending):
+            if item[0] == seq_id:
+                del self._pending[i]
+                return True
+        for s in self._slots:
+            if s.seq_id == seq_id:
+                s.seq_id, s.active, s.tokens, s.remaining = -1, False, [], 0
+                return True
+        return False
 
-        retire_and_admit()
-        while any(s.active for s in self._slots):
+    def has_work(self) -> bool:
+        return bool(self._pending) or any(s.seq_id >= 0 for s in self._slots)
+
+    def _sweep(self, finished: Dict[int, List[int]]) -> None:
+        # sweep until a full pass makes no progress: an admit can
+        # complete INSTANTLY (max_new=1, or the first token is EOS),
+        # and its freed slot must serve the next queued prompt in the
+        # same pass — or a 1-slot batcher strands the queue
+        progress = True
+        while progress:
+            progress = False
+            for i, s in enumerate(self._slots):
+                if s.seq_id >= 0 and not s.active:
+                    finished[s.seq_id] = s.tokens
+                    s.seq_id = -1
+                    progress = True
+                if s.seq_id < 0 and self._pending:
+                    seq_id, prompt, max_new, temp = self._pending.popleft()
+                    self._admit_one(i, seq_id, prompt, max_new, temp)
+                    self.stats["admits"] += 1
+                    progress = True
+
+    def serve_step(self) -> Dict[int, List[int]]:
+        """One serving iteration: retire finished slots, admit from the
+        pending queue, run ONE decode step if anything is active, retire
+        again.  Returns the requests that finished this call
+        ({seq_id: generated tokens})."""
+        finished: Dict[int, List[int]] = {}
+        self._sweep(finished)
+        if any(s.active for s in self._slots):
             counts = np.array(
                 [len(s.tokens) for s in self._slots], np.int32
             )
@@ -281,8 +310,32 @@ class ContinuousBatcher:
                 ):
                     s.active = False
             self._last_tokens = toks
-            retire_and_admit()
-        # every slot is retired here: retire_and_admit sweeps
-        # unconditionally and runs last in each iteration, so the loop
-        # cannot exit with a finished-but-unretired slot
+            self._sweep(finished)
+        return finished
+
+    def run(
+        self,
+        prompts: List[np.ndarray],
+        max_new_tokens: List[int],
+        temperatures: Optional[List[float]] = None,
+    ) -> Dict[int, List[int]]:
+        """Serve every prompt to completion; returns {seq_id: generated
+        tokens}.  ``stats['steps']`` afterwards holds the number of step
+        programs executed (the efficiency measure vs static batching).
+        ``temperatures`` is per-request (0/None = greedy; >0 samples from
+        softmax(logits/T), truncated to the batcher's ``top_k``) — mixed
+        greedy/sampled requests share the batch."""
+        assert len(prompts) == len(max_new_tokens)
+        temps = temperatures or [0.0] * len(prompts)
+        assert len(temps) == len(prompts)
+        self.stats = {"steps": 0, "admits": 0}
+        for i, (p, m, t) in enumerate(zip(prompts, max_new_tokens, temps)):
+            self.submit(i, np.asarray(p), m, t)
+        done: Dict[int, List[int]] = {}
+        done.update(self.serve_step())
+        while any(s.active for s in self._slots):
+            done.update(self.serve_step())
+        # every slot is retired here: serve_step sweeps unconditionally
+        # after each decode step, so the loop cannot exit with a
+        # finished-but-unretired slot
         return done

@@ -1,0 +1,85 @@
+"""Shared fake serving-cluster bring-up (gateway tests, soak, dry run).
+
+One builder for the scenario every gateway harness needs: a fabricated
+multi-slice cluster whose decode replicas are REALLY scheduled — created
+as pods, passed through the extender's filter, bound so the assignment
+annotation the registry discovers actually exists.  Four call sites
+(tests/test_gateway.py, GatewaySoak, __graft_entry__.dryrun_gateway, the
+gateway server's --fake-cluster mode) share it so a change to the
+bind/annotation contract lands everywhere at once.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import Optional, Sequence, Tuple
+
+from kubegpu_tpu.plugins import Advertiser, FakeSlice
+from kubegpu_tpu.scheduler import Scheduler
+from kubegpu_tpu.types import RES_TPU, annotations
+from kubegpu_tpu.utils import InMemoryApiServer
+
+
+def schedule_decode_replicas(
+    api,
+    sched: Scheduler,
+    n_replicas: int,
+    group: str = "decode",
+    pin_slices: Optional[Sequence[str]] = None,
+    name_prefix: str = "dec",
+) -> list:
+    """Create + filter + bind ``n_replicas`` single-chip serving pods
+    through the real control plane; returns the pod names."""
+    nodes = sorted(node["metadata"]["name"] for node in api.list_nodes())
+    names = []
+    for i in range(n_replicas):
+        name = f"{name_prefix}-{i}"
+        ann = {annotations.POD_SERVING_GROUP: group}
+        if pin_slices:
+            ann[annotations.POD_SLICE_SELECTOR] = pin_slices[i]
+        api.create_pod({
+            "metadata": {"name": name, "namespace": "default",
+                         "annotations": ann},
+            "spec": {"containers": [
+                {"name": "s", "resources": {"limits": {RES_TPU: "1"}}}]},
+        })
+        result = sched.filter(api.get_pod("default", name), nodes)
+        assert result.nodes, f"{name}: no feasible node ({result.failed})"
+        err = sched.bind("default", name, result.nodes[0])
+        assert err is None, f"{name}: bind failed: {err}"
+        names.append(name)
+    return names
+
+
+def build_fake_serving_stack(
+    n_replicas: int = 3,
+    group: str = "decode",
+    slice_ids: Sequence[str] = ("sa", "sb"),
+    mesh: Tuple[int, int] = (4, 4),
+    pin_slices: Optional[Sequence[str]] = None,
+    metrics=None,
+) -> SimpleNamespace:
+    """Fabricated multi-slice cluster with scheduled decode replicas and a
+    ReplicaRegistry over them.  Returns (api, slices, advs, sched,
+    registry) — the data-plane client and Gateway stay the caller's
+    choice (SimBatcher vs real ContinuousBatcher, policy knobs)."""
+    from kubegpu_tpu.gateway import ReplicaRegistry
+
+    api = InMemoryApiServer()
+    slices = {
+        sid: FakeSlice(slice_id=sid, mesh_shape=mesh, host_block=(2, 2))
+        for sid in slice_ids
+    }
+    advs = {}
+    for fs in slices.values():
+        for host, prov in fs.providers().items():
+            advs[host] = Advertiser(prov, api)
+            advs[host].advertise_once()
+    sched = Scheduler(api, metrics=metrics) if metrics is not None \
+        else Scheduler(api)
+    sched.cache.refresh()
+    schedule_decode_replicas(api, sched, n_replicas, group, pin_slices)
+    registry = ReplicaRegistry(api, group=group)
+    return SimpleNamespace(
+        api=api, slices=slices, advs=advs, sched=sched, registry=registry
+    )

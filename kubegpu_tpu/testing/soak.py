@@ -15,6 +15,14 @@ binds, deletions, chip deaths/revivals, resyncs, preemption triggers) on a
       bound members at quiescence (no partial initial placement);
   I4  every live assignment references only currently-advertised chips,
       once eviction has had its chance to run.
+
+``GatewaySoak`` extends the same discipline to the serving gateway —
+randomized request arrivals, replica death mid-flight, stragglers
+provoking hedged dispatch — with the data-plane invariant:
+
+  I5  after quiescence every admitted request was served exactly once or
+      rejected with explicit backpressure: one terminal result per
+      request, no hedge-duplicated delivery, nothing silently dropped.
 """
 
 import random
@@ -389,3 +397,183 @@ def settle_and_check(s: Soak, label: str, rounds: int = 25) -> None:
             last_err = e
     if last_err is not None:
         raise last_err
+
+
+# ---------------------------------------------------------------------------
+# Gateway soak (invariant I5)
+# ---------------------------------------------------------------------------
+
+class GatewaySoak:
+    """Randomized serving traffic + replica chaos against invariant I5.
+
+    Same 2-slice fabricated cluster as the control-plane soak, with
+    ``n_replicas`` single-chip decode replicas actually scheduled through
+    the real filter/bind path, a SimBatcher-backed in-memory data plane,
+    and a live Gateway (dispatcher threads, hedging armed).  The op-mix:
+    request bursts (mixed tenants/sessions, occasionally overflowing the
+    bounded queue so explicit backpressure is exercised), replica death
+    mid-flight (process + chips, via the advertiser cycle), revival,
+    and straggler injection that provokes hedged dispatch."""
+
+    def __init__(self, seed: int, n_replicas: int = 4):
+        from kubegpu_tpu.gateway import (
+            AdmissionQueue, FailoverPolicy, Gateway, InMemoryReplicaClient,
+            SimBatcher,
+        )
+        from kubegpu_tpu.testing.fake_serving import build_fake_serving_stack
+
+        self.rng = random.Random(seed)
+        stack = build_fake_serving_stack(
+            n_replicas, mesh=MESH, metrics=Metrics()
+        )
+        self.api = stack.api
+        self.slices = stack.slices
+        self.advs = stack.advs
+        self.sched = stack.sched
+        self.registry = stack.registry
+        self.client = InMemoryReplicaClient(
+            batcher_factory=lambda key: SimBatcher(slots=8),
+            step_delay_s=0.001,
+        )
+        self.registry.subscribe(self.client.sync_live)
+        self.metrics = Metrics()
+        # generous retry budget: a replica kill must cost retries, never
+        # requests — that is exactly what I5 holds the gateway to
+        self.gw = Gateway(
+            self.registry, self.client,
+            queue=AdmissionQueue(capacity=64),
+            policy=FailoverPolicy(
+                deadline_s=60.0, hedge_after_s=0.02, max_attempts=8,
+                retry_budget_ratio=1.0, budget_floor=1000,
+            ),
+            metrics=self.metrics, dispatchers=8,
+        )
+        self.registry.refresh()
+        self.gw.start()
+        self.n = 0
+        self.n_replicas = n_replicas
+        self.pendings = {}   # request_id -> PendingRequest
+        self.dead = set()    # replica keys currently killed
+        self.ops = []
+
+    # -- ops ---------------------------------------------------------------
+    def op_burst(self):
+        from kubegpu_tpu.gateway import GatewayRequest
+
+        k = self.rng.randint(4, 16)
+        accepted = 0
+        for _ in range(k):
+            rid = f"r{self.n}"
+            self.n += 1
+            p = self.gw.submit(GatewayRequest(
+                prompt=[1, 2, 3],
+                max_new_tokens=self.rng.choice([0, 2, 5, 8, 12]),
+                request_id=rid,
+                tenant=f"t{self.rng.randrange(3)}",
+                session=(f"s{self.rng.randrange(6)}"
+                         if self.rng.random() < 0.4 else None),
+            ))
+            self.pendings[rid] = p
+            accepted += 1
+        return f"burst x{k} (total {self.n})"
+
+    def _live_keys(self):
+        return [r.key for r in self.registry.live()]
+
+    def op_kill_replica(self):
+        live = self._live_keys()
+        if len(live) < 2:
+            return "kill (noop: must keep one replica)"
+        key = self.rng.choice(live)
+        self.client.fail_replica(key)       # process dies with its chips
+        rep = self.registry.get(key)
+        for coords in rep.coords:
+            self.slices[rep.slice_id].kill_chip(coords)
+        for a in self.advs.values():
+            a.advertise_once()
+        self.registry.refresh()
+        self.dead.add(key)
+        return f"kill {key}"
+
+    def op_revive_replica(self):
+        if not self.dead:
+            return "revive (noop)"
+        key = self.rng.choice(sorted(self.dead))
+        rep = self.registry.get(key)
+        for coords in rep.coords:
+            self.slices[rep.slice_id].revive_chip(coords)
+        for a in self.advs.values():
+            a.advertise_once()
+        self.registry.refresh()  # sync_live restarts the replica cold
+        self.dead.discard(key)
+        return f"revive {key}"
+
+    def op_straggle(self):
+        live = self._live_keys()
+        if not live:
+            return "straggle (noop)"
+        key = self.rng.choice(live)
+        slow = self.rng.random() < 0.6
+        self.client.set_step_delay(key, 0.03 if slow else 0.001)
+        return f"straggle {key} {'on' if slow else 'off'}"
+
+    def op_settle(self):
+        import time
+
+        time.sleep(self.rng.choice([0.005, 0.02, 0.05]))
+        return "settle"
+
+    # -- invariant ---------------------------------------------------------
+    def check(self, trace: str):
+        """I5 at quiescence (call after quiesce())."""
+        results = self.gw.results()
+        missing = set(self.pendings) - set(results)
+        assert not missing, f"I5 silently dropped: {sorted(missing)}\n{trace}"
+        extra = set(results) - set(self.pendings)
+        assert not extra, f"I5 phantom results: {sorted(extra)}\n{trace}"
+        for rid, pending in self.pendings.items():
+            assert pending.wait(0), f"I5 {rid} handle never resolved\n{trace}"
+            r = results[rid]
+            assert r.status in ("ok", "rejected"), (
+                f"I5 {rid} ended {r.status!r} ({r.error}) — a kill must "
+                f"cost retries, never requests\n{trace}"
+            )
+            if r.status == "ok":
+                assert self.client.decodes.get(rid, 0) >= 1, (
+                    f"I5 {rid} reported ok but no decode delivered\n{trace}"
+                )
+        # never duplicated by a hedge: the exactly-once recorder saw no
+        # second terminal result for any request
+        dups = self.metrics.get("gateway_duplicate_results_total")
+        assert dups == 0, f"I5 duplicate deliveries: {dups}\n{trace}"
+        assert self.gw.queue.depth() == 0 and self.gw.in_flight() == 0, (
+            f"I5 not quiescent: depth={self.gw.queue.depth()} "
+            f"in_flight={self.gw.in_flight()}\n{trace}"
+        )
+
+    def quiesce(self, timeout: float = 120.0):
+        """Restore all hardware, then wait out the in-flight work."""
+        while self.dead:
+            self.op_revive_replica()
+        for a in self.advs.values():
+            a.advertise_once()
+        self.registry.refresh()
+        assert self.gw.drain(timeout), "gateway failed to drain"
+
+    def run(self, steps: int):
+        ops = [
+            (self.op_burst, 5),
+            (self.op_kill_replica, 1),
+            (self.op_revive_replica, 1),
+            (self.op_straggle, 2),
+            (self.op_settle, 3),
+        ]
+        bag = [f for f, w in ops for _ in range(w)]
+        try:
+            for _ in range(steps):
+                self.ops.append(self.rng.choice(bag)())
+            self.quiesce()
+            self.check("\n".join(self.ops[-40:]))
+        finally:
+            self.gw.stop()
+            self.client.stop()

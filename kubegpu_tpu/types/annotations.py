@@ -49,6 +49,12 @@ POD_SLICE_SELECTOR = f"{PREFIX}/slice-selector" # comma list of slice ids the
                                                 # pod/gang may be placed on
                                                 # (tenant pinning); absent =
                                                 # any slice
+# Pod side (written by users / controllers, read by the serving gateway):
+# marks a pod as a decode replica of the named serving group.  The gateway's
+# ReplicaRegistry discovers replicas by this key and routes cluster traffic
+# to them once their assignment annotation exists and their assigned chips
+# are advertised healthy.
+POD_SERVING_GROUP = f"{PREFIX}/serving-group"
 # Pod side (written by the extender at bind, read by the CRI shim).
 POD_ASSIGNMENT = f"{PREFIX}/assignment"         # JSON: Assignment
 # Pod side (written by the extender for gang coordination/observability).

@@ -3,6 +3,10 @@
 The reference had only glog verbosity; the rebuild's north-star metrics
 (schedule-to-first-step latency, ICI-contiguous placement rate) need real
 counters.  Text exposition format only — no client library dependency.
+
+Three instrument kinds: counters (monotonic, ``inc``), gauges (set to the
+current value, ``set_gauge`` — queue depth, live replicas), and histograms
+(``observe`` — reservoir quantiles + exact count/sum).
 """
 
 from __future__ import annotations
@@ -30,12 +34,25 @@ class Metrics:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = defaultdict(float)
+        self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._histograms: Dict[str, _Histogram] = defaultdict(_Histogram)
 
     def inc(self, name: str, value: float = 1.0, **labels: str) -> None:
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             self._counters[key] += value
+
+    def set_gauge(self, name: str, value: float, **labels: str) -> None:
+        """Gauges overwrite (current level, not a running total): queue
+        depth, live-replica count — values that go down as well as up."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._gauges[key] = value
+
+    def gauge(self, name: str, **labels: str) -> float:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            return self._gauges.get(key, 0.0)
 
     def observe(self, name: str, value: float) -> None:
         with self._lock:
@@ -52,13 +69,26 @@ class Metrics:
     def render(self) -> str:
         """Prometheus text exposition."""
         out: List[str] = []
+
+        def line(name, labels, v):
+            if labels:
+                lbl = ",".join(f'{k}="{val}"' for k, val in labels)
+                out.append(f"{name}{{{lbl}}} {v}")
+            else:
+                out.append(f"{name} {v}")
+
         with self._lock:
             for (name, labels), v in sorted(self._counters.items()):
-                if labels:
-                    lbl = ",".join(f'{k}="{val}"' for k, val in labels)
-                    out.append(f"{name}{{{lbl}}} {v}")
-                else:
-                    out.append(f"{name} {v}")
+                line(name, labels, v)
+            typed = set()
+            for (name, labels), v in sorted(self._gauges.items()):
+                if name not in typed:
+                    # gauges carry an explicit TYPE line: a scraper must
+                    # not apply rate() to them the way it does to the
+                    # (untyped, counter-by-convention) names above
+                    out.append(f"# TYPE {name} gauge")
+                    typed.add(name)
+                line(name, labels, v)
             for name, h in sorted(self._histograms.items()):
                 out.append(f"{name}_count {h.count}")
                 out.append(f"{name}_sum {h.total}")

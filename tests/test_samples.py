@@ -68,6 +68,7 @@ def test_sample_dir_covers_all_graded_configs():
         "jax-lm-tp.yaml",
         "jax-multislice.yaml",
         "jax-resnet.yaml",
+        "jax-serve-gateway.yaml",
         "multi-tenant.yaml",
         "single-chip.yaml",
     ]
@@ -195,6 +196,49 @@ def test_jax_decode_sample_schedules_and_maps_to_worker_serve_mode():
     # prompt + steps must fit the cache (seq+1) or the worker exits
     assert int(flags["prompt-len"]) + int(flags["steps"]) <= int(flags["seq"]) + 1
     assert pods[0]["spec"]["restartPolicy"] == "Always"  # serving replica
+
+
+def test_jax_serve_gateway_sample_schedules_gang_and_registers():
+    """The serving-path sample: the 3-replica decode gang lands
+    ICI-contiguous through the real control plane, the gateway Deployment
+    references a real module, and the gateway's ReplicaRegistry discovers
+    exactly the bound replicas from their annotations."""
+    import importlib
+
+    from kubegpu_tpu.gateway import ReplicaRegistry
+
+    api, sched, _ = make_cluster()
+    docs = list(yaml.safe_load_all(
+        (SAMPLES / "jax-serve-gateway.yaml").read_text()
+    ))
+    pods = [d for d in docs if d and d.get("kind") == "Pod"]
+    assert len(pods) == 3
+    # the gang is a real gang (atomic capacity) AND a serving group
+    for obj in pods:
+        ann = obj["metadata"]["annotations"]
+        assert ann["kubegpu-tpu/serving-group"] == "decode"
+        assert ann["kubegpu-tpu/pod-group"] == "decode-replicas"
+    assigned = schedule_all(api, sched, pods)
+    union = set()
+    for name, a in assigned.items():
+        assert a is not None and len(a.all_chips()) == 1
+        union.update(c.coords for c in a.all_chips())
+    assert len(union) == 3
+    assert is_contiguous_submesh(union, MESH)
+
+    registry = ReplicaRegistry(api, group="decode")
+    registry.refresh()
+    assert [r.pod for r in registry.live()] == [
+        "decode-replica-0", "decode-replica-1", "decode-replica-2"
+    ]
+
+    # the gateway Deployment's entrypoint is a real module with a main()
+    deployments = [d for d in docs if d and d.get("kind") == "Deployment"]
+    assert len(deployments) == 1
+    cmd = deployments[0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert cmd[:2] == ["python", "-m"]
+    mod = importlib.import_module(cmd[2])
+    assert hasattr(mod, "main")
 
 
 def test_multi_tenant_sample_both_gangs_fit():

@@ -6,11 +6,20 @@ import random
 
 import pytest
 
-from kubegpu_tpu.testing.soak import Soak, settle_and_check
+from kubegpu_tpu.testing.soak import GatewaySoak, Soak, settle_and_check
 
 @pytest.mark.parametrize("seed", [0, 1, 2, 3])
 def test_control_plane_soak(seed):
     Soak(seed).run(120)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_gateway_soak_exactly_once_or_backpressure(seed):
+    """Invariant I5 under chaos: request bursts, mid-flight replica
+    kills, stragglers provoking hedges — at quiescence every admitted
+    request was served exactly once or rejected with explicit
+    backpressure (never hedge-duplicated, never silently dropped)."""
+    GatewaySoak(seed).run(30)
 
 
 @pytest.mark.parametrize("rep", [0, 1, 2])
