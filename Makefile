@@ -4,7 +4,7 @@
 PY ?= python
 SHELL := /bin/bash
 
-.PHONY: test tier1 test-mid test-slow test-all native bench dryrun image clean
+.PHONY: test tier1 test-mid test-slow test-all native bench bench-smoke dryrun image clean
 
 # fast half: control plane + wire protocols, ~1 min (default pytest run)
 test: native
@@ -37,6 +37,12 @@ native:
 
 bench:
 	$(PY) bench.py
+
+# CPU-only serving-path micro-bench (<60 s): TTFT/ITL p95 with chunked
+# vs monolithic prefill + prefix-cache hit rate on tiny shapes; exits
+# non-zero if chunked ITL regresses past monolithic or hits vanish
+bench-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --serve-smoke
 
 # gateway smoke runs FIRST: it has no JAX-device dependency, so it still
 # exercises the serving path in environments where the multichip dry run

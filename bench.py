@@ -907,6 +907,174 @@ def _serving_traffic():
     return params, prompts, budgets, cfg
 
 
+def serving_prefill_latency(extra: dict, tiny: bool = False) -> None:
+    """Chunked prefill + paged prefix cache: the serving hot path's
+    latency contract, measured (ISSUE 2 acceptance).
+
+    (a) ITL under long-prompt admits: 4 running sequences decode while
+    long (prompt_pad-length) prompts keep arriving.  Monolithic prefill
+    stalls every running sequence for a whole padded-prompt forward per
+    admit; chunked prefill bounds the stall to one chunk.  Both modes
+    run the SAME workload in the same process; the headline is the
+    running sequences' inter-token-latency p95, chunked vs monolithic.
+
+    (b) Prefix cache: a two-turn same-session workload through the
+    paged batcher — turn 2's prompt extends turn 1's, so its full
+    prefix pages hit the content-addressed cache.  Reports the hit rate
+    and verifies greedy token-identity against a cache-less batcher.
+
+    ``tiny=True`` (make bench-smoke) runs both on CPU-sized shapes in
+    well under a minute, so serving-path latency regressions surface
+    without the full TPU bench."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubegpu_tpu.models import TransformerLM
+    from kubegpu_tpu.models.paging import PagedContinuousBatcher
+    from kubegpu_tpu.models.serving import ContinuousBatcher
+    from kubegpu_tpu.utils.metrics import Metrics
+
+    if tiny:
+        vocab, layers, heads, hidden = 61, 2, 4, 32
+        max_seq, prompt_pad, chunk = 192, 128, 16
+        page, p_pad, t1_len = 16, 80, 50
+        dtype = jnp.float32
+        runner_budget, n_long, long_budget = 64, 8, 4
+    else:
+        vocab, layers, hidden = 32768, 4, 4096
+        heads = hidden // 128
+        max_seq, prompt_pad, chunk = 512, 256, 64
+        page, p_pad, t1_len = 64, 384, 200
+        dtype = jnp.bfloat16
+        runner_budget, n_long, long_budget = 64, 8, 4
+    model = TransformerLM(
+        vocab_size=vocab, num_layers=layers, num_heads=heads, hidden=hidden,
+        max_seq=max_seq,
+    )
+    rng = jax.random.PRNGKey(0)
+    if tiny:
+        params = model.init(rng, jnp.ones((1, 8), jnp.int32))["params"]
+    else:
+        params = jax.jit(
+            lambda r, x: _bf16_cast(model.init(r, x)["params"])
+        )(rng, jnp.ones((1, 8), jnp.int32))
+    cfg = dict(
+        vocab_size=vocab, num_layers=layers, num_heads=heads, hidden=hidden,
+        max_seq=max_seq, slots=6, prompt_pad=prompt_pad, dtype=dtype,
+    )
+    rs = np.random.RandomState(0)
+
+    def itl_probe(prefill_chunk):
+        m = Metrics()
+        cb = ContinuousBatcher(params, prefill_chunk=prefill_chunk, **cfg)
+        # warm every program (chunk/admit/step) OUTSIDE the measurement
+        # window: compile time is a one-off, not serving latency — the
+        # metrics registry attaches only after the warm drain
+        cb.submit(90, rs.randint(0, vocab, size=prompt_pad).astype(np.int32), 2)
+        while cb.has_work():
+            cb.serve_step()
+        cb.metrics = m
+        runners = list(range(4))
+        for i in runners:
+            cb.submit(
+                i, rs.randint(0, vocab, size=16).astype(np.int32),
+                runner_budget,
+            )
+        while any(len(cb._slots[i].tokens) < 1 for i in runners):
+            cb.serve_step()
+        counts = [len(cb._slots[i].tokens) for i in runners]
+        now = time.perf_counter()
+        last = [now] * 4
+        long_ids = set()
+        for j in range(n_long):
+            rid = 100 + j
+            long_ids.add(rid)
+            cb.submit(
+                rid,
+                rs.randint(0, vocab, size=prompt_pad).astype(np.int32),
+                long_budget, session_id=f"long-{j}",
+            )
+        gaps = []
+        done = {}
+        # measurement window: while any long admit is still in flight —
+        # exactly when monolithic prefill stalls the runners
+        while not long_ids <= set(done):
+            done.update(cb.serve_step())
+            now = time.perf_counter()
+            for i in runners:
+                s = cb._slots[i]  # runner i sits in slot i (FIFO admit)
+                if s.seq_id == i and len(s.tokens) > counts[i]:
+                    gaps.append(now - last[i])
+                    last[i] = now
+                    counts[i] = len(s.tokens)
+        while cb.has_work():
+            done.update(cb.serve_step())
+        gaps.sort()
+        p95 = gaps[min(len(gaps) - 1, int(0.95 * len(gaps)))]
+        ttft_p95 = m.quantile("serve_ttft_seconds", 0.95)
+        return p95, ttft_p95, cb.stats
+
+    itl_mono, _, _ = itl_probe(None)
+    itl_chunk, ttft_p95, st = itl_probe(chunk)
+    label = "tiny/CPU" if tiny else "1.08B"
+    log(
+        f"serving ITL under long-prompt admits ({label}, prompt_pad "
+        f"{prompt_pad}, chunk {chunk}): running-seq ITL p95 "
+        f"{itl_chunk * 1e3:.1f} ms chunked vs {itl_mono * 1e3:.1f} ms "
+        f"monolithic ({itl_mono / max(itl_chunk, 1e-9):.2f}x better; "
+        f"{st['prefill_chunks']} chunks); TTFT p95 {ttft_p95 * 1e3:.1f} ms"
+    )
+    if itl_chunk >= itl_mono:
+        log(
+            "serving ITL WARNING: chunked p95 not below monolithic — "
+            "hot-path regression, investigate before shipping"
+        )
+    extra["serve_itl_p95"] = round(itl_chunk * 1e3, 2)
+    extra["serve_itl_p95_monolithic"] = round(itl_mono * 1e3, 2)
+    extra["serve_itl_chunked_speedup"] = round(
+        itl_mono / max(itl_chunk, 1e-9), 3
+    )
+    extra["serve_ttft_p95"] = round(ttft_p95 * 1e3, 2)
+
+    # ---- (b) two-turn same-session prefix reuse -------------------------
+    pcfg = dict(cfg)
+    pcfg.update(prompt_pad=p_pad, page_size=page, slots=4)
+    pool = 4 * (-(-(p_pad + 64) // page)) + 9
+    pb = PagedContinuousBatcher(params, pool_pages=pool, **pcfg)
+    turn1 = [
+        rs.randint(0, vocab, size=t1_len).astype(np.int32) for _ in range(4)
+    ]
+    out1 = pb.run(turn1, [8] * 4)
+    turn2 = [
+        np.concatenate([
+            turn1[i], np.asarray(out1[i], np.int32),
+            rs.randint(0, vocab, size=5).astype(np.int32),
+        ])
+        for i in range(4)
+    ]
+    cold = PagedContinuousBatcher(
+        params, pool_pages=pool, prefix_cache=False, **pcfg
+    )
+    expected = cold.run(turn2, [8] * 4)
+    out2 = pb.run(turn2, [8] * 4)
+    identical = out2 == expected
+    hit_rate = pb.stats["prefix_hit_tokens"] / max(
+        pb.stats["prompt_tokens"], 1
+    )
+    pb.assert_page_accounting()
+    log(
+        f"paged prefix cache ({label}, page {page}): turn-2 hit rate "
+        f"{hit_rate * 100:.0f}% ({pb.stats['prefix_hit_tokens']}/"
+        f"{pb.stats['prompt_tokens']} prompt tokens skipped), greedy "
+        f"token-identical to cache-less: {identical}"
+    )
+    extra["prefix_hit_rate"] = round(hit_rate, 4)
+    extra["prefix_cache_token_identical"] = identical
+
+
 def serving_continuous_batching(extra: dict) -> None:
     """Continuous batching vs static batching on the 1.08B flagship
     (models/serving.py): a queue of prompts with VARYING token budgets
@@ -1905,6 +2073,23 @@ def main() -> None:
         print(json.dumps(first_step_probe()))
         return
 
+    if "--serve-smoke" in sys.argv:
+        # CPU-only micro-subset (make bench-smoke): the serving-path
+        # latency rows — TTFT/ITL p95 chunked-vs-monolithic and the
+        # prefix-cache hit rate — on tiny shapes, < 60 s, so hot-path
+        # regressions are caught without the full TPU bench
+        extra = {}
+        serving_prefill_latency(extra, tiny=True)
+        ok = (
+            extra["serve_itl_p95"] < extra["serve_itl_p95_monolithic"]
+            and extra["prefix_hit_rate"] > 0
+            and extra["prefix_cache_token_identical"]
+        )
+        print(json.dumps({
+            "metric": "serve_smoke", "ok": ok, "extra": extra,
+        }))
+        sys.exit(0 if ok else 1)
+
     # persistent compilation cache: the production configuration (a warmed
     # cluster/node pool reuses compiled programs across job launches, which
     # is exactly what the schedule-to-first-step path looks like after the
@@ -1995,6 +2180,7 @@ def main() -> None:
     trained_quality(extra)
     serving_continuous_batching(extra)
     serving_paged(extra)
+    serving_prefill_latency(extra)
     paged_longctx_row(extra)
     steady_state_moe(extra)
     pipeline_bubble_row(extra)
@@ -2029,6 +2215,10 @@ def main() -> None:
         "spec_int8_tok_s_b1",
         "spec_accept_rate",
         "cb_step_efficiency",
+        "serve_itl_p95",
+        "serve_itl_chunked_speedup",
+        "serve_ttft_p95",
+        "prefix_hit_rate",
         "paged_hbm_ratio_2048",
         "moe_mfu",
         "moe_drop_rate",

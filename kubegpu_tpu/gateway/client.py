@@ -109,7 +109,10 @@ class SimBatcher:
         self.stats = {"steps": 0, "admits": 0}
 
     def submit(self, seq_id: int, prompt, max_new: int,
-               temperature: float = 0.0) -> None:
+               temperature: float = 0.0,
+               session_id: Optional[str] = None) -> None:
+        # session_id is the gateway's session/prefix key; the token mill
+        # has no KV to reuse, so it only validates the widened contract
         if seq_id < 0:
             raise ValueError(f"seq_id must be >= 0, got {seq_id}")
         self._pending.append((seq_id, int(max_new)))
@@ -183,6 +186,7 @@ class _ReplicaWorker:
                         self.batcher.submit(
                             seq, req.prompt, req.max_new_tokens,
                             getattr(req, "temperature", 0.0),
+                            session_id=getattr(req, "session", None),
                         )
                         self.by_seq[seq] = attempt
                     except Exception as e:  # noqa: BLE001 - bad request

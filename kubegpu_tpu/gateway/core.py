@@ -105,6 +105,12 @@ class Gateway:
         self.client = client
         self.queue = queue or AdmissionQueue()
         self.metrics = metrics or default_metrics
+        # a metrics-capable router (SessionAffinityRouter's repin
+        # counter) that wasn't given its own registry reports into the
+        # gateway's, so /metrics shows KV-loss re-pins next to the
+        # serve_* histograms the replica batchers feed
+        if router is not None and getattr(router, "metrics", False) is None:
+            router.metrics = self.metrics
         self.dispatcher = Dispatcher(
             client,
             router or LeastOutstandingRouter(),

@@ -26,6 +26,7 @@ from typing import Dict, FrozenSet, List, Mapping, Optional
 
 from kubegpu_tpu.gateway.registry import ReplicaInfo
 from kubegpu_tpu.types.topology import coords_bounding_box
+from kubegpu_tpu.utils.metrics import Metrics
 
 
 class Router:
@@ -82,12 +83,21 @@ class SessionAffinityRouter(Router):
     The pin map is bounded: entries for sessions nobody re-requests age
     out FIFO past ``max_sessions`` — an affinity table must not grow with
     total session history.
+
+    ``metrics``: a re-pin after a pinned replica dies is a KV-loss event
+    (the session's cached prefix pages die with the replica, and the new
+    replica's ``prefix_hit_tokens`` start from zero) — counted as
+    ``gateway_session_repin_total`` so operators see affinity churn next
+    to the hit-rate it costs.  ``Gateway`` wires its own registry in when
+    the caller didn't.
     """
 
     def __init__(self, fallback: Optional[Router] = None,
-                 max_sessions: int = 65536) -> None:
+                 max_sessions: int = 65536,
+                 metrics: Optional[Metrics] = None) -> None:
         self.fallback = fallback or LeastOutstandingRouter()
         self.max_sessions = max_sessions
+        self.metrics = metrics
         self._lock = threading.Lock()
         self._pins: Dict[str, str] = {}  # session -> replica key
         # each pinned replica's slice, so a DEAD pin can still hint
@@ -112,6 +122,10 @@ class SessionAffinityRouter(Router):
             request = _with_hint(request, pinned, self._slice_of(pinned))
         choice = self.fallback.pick(request, replicas, outstanding, exclude)
         if choice is not None:
+            if pinned is not None and self.metrics is not None:
+                # the session HAD a pin and lost it: its KV history is
+                # gone wherever the old replica went
+                self.metrics.inc("gateway_session_repin_total")
             with self._lock:
                 self._pins[session] = choice.key
                 self._last_slices[choice.key] = choice.slice_id

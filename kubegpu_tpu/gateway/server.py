@@ -114,6 +114,9 @@ def make_handler(gateway: Gateway, registry: ReplicaRegistry):
             except (TypeError, ValueError) as e:
                 self._send(400, {"error": f"bad request: {e}"})
                 return
+            if not request.prompt:
+                self._send(400, {"error": "bad request: empty prompt"})
+                return
             # blocking unary call: the handler thread IS the caller's
             # connection; backpressure resolves instantly, decode blocks
             # until the dispatcher delivers
@@ -265,6 +268,13 @@ def main(argv=None) -> None:
     )
     ap.add_argument("--replicas", type=int, default=3,
                     help="replica count for --fake-cluster mode")
+    ap.add_argument(
+        "--sim-data-plane", action="store_true",
+        help="in-cluster mode: wire an in-process SimBatcher data "
+        "plane (fabricated tokens — cluster smoke only).  Default is "
+        "discovery/metrics only: /readyz stays 503 so the instance "
+        "never joins the Service",
+    )
     ap.add_argument("--queue-capacity", type=int, default=256)
     ap.add_argument("--per-tenant-cap", type=int, default=None)
     ap.add_argument("--deadline", type=float, default=30.0,
@@ -285,20 +295,42 @@ def main(argv=None) -> None:
         from kubegpu_tpu.utils.apiserver import KubeApiServer
 
         registry = ReplicaRegistry(KubeApiServer(), group=args.group)
-        # the real data-plane client (HTTP to replica pods) is the next
-        # growth step; until then in-cluster mode discovers replicas but
-        # cannot dispatch — client.ready() is False, so /readyz reports
-        # 503 and this instance never joins the Service (an honest
-        # NotReady beats converting traffic into guaranteed 5xx)
         from kubegpu_tpu.gateway.client import InMemoryReplicaClient
 
-        client = InMemoryReplicaClient(batcher_factory=None)
-        log.warning(
-            "in-cluster data-plane client not implemented yet: replica "
-            "discovery and /metrics are live, but /readyz stays 503 and "
-            "no traffic will be served (use --fake-cluster for the demo "
-            "data plane)"
-        )
+        if args.sim_data_plane:
+            # OPT-IN in-process data plane (cluster smoke tests): every
+            # replica the registry discovers gets a worker driving a
+            # local SimBatcher, so the gateway is live end to end and
+            # /readyz goes 200 the moment a replica is wired — 503
+            # again only when the registry drains to zero.  Tokens are
+            # fabricated; never expose this to real clients.  A remote
+            # HTTP data-plane client that dispatches to the replica
+            # pods themselves is the tracked next step (ROADMAP).
+            from kubegpu_tpu.gateway.client import SimBatcher
+
+            client = InMemoryReplicaClient(
+                batcher_factory=lambda key: SimBatcher(slots=8),
+                step_delay_s=0.002,
+            )
+            registry.subscribe(client.sync_live)
+            log.warning(
+                "--sim-data-plane: serving FABRICATED tokens from "
+                "in-process SimBatchers — cluster smoke only"
+            )
+        else:
+            # fail-safe default: discovery-only — no wired replicas, so
+            # /readyz stays 503 (zero live data-plane replicas) and the
+            # instance never joins the Service; an honest NotReady
+            # beats converting traffic into guaranteed 5xx (or worse,
+            # fabricated tokens)
+            client = InMemoryReplicaClient(batcher_factory=None)
+            log.warning(
+                "in-cluster data plane not wired: replica discovery "
+                "and /metrics are live, but /readyz reports 503 and no "
+                "traffic is served (--sim-data-plane wires an "
+                "in-process smoke data plane; --fake-cluster runs the "
+                "full demo)"
+            )
     from kubegpu_tpu.gateway.failover import FailoverPolicy
 
     gateway = Gateway(

@@ -20,25 +20,45 @@ token-exactness tests verify argmax-exact behavior on their configs);
 at bf16, near-tied logits may round to a different argmax than the
 dense path — the same caveat flash-vs-einsum attention carries in
 training.
-- ``PagedContinuousBatcher``: the serving loop.  Admits prefill DENSELY
-  (one b=1 causal pass — prefill is compute-bound and pages buy nothing
-  there), then scatter the used rows into freshly-allocated pages and
-  decode paged.  A sequence reserves exactly
-  ``ceil((prompt+budget)/page)`` pages, so pool capacity is sized to the
-  traffic mix, not ``slots x max_seq``.
+- ``PagedContinuousBatcher``: the serving loop.  Prompts prefill
+  CHUNKED through a persistent dense b=1 "station" cache (one page-sized
+  causal chunk per serving iteration, interleaved with decode steps so
+  running sequences' inter-token latency is bounded by one chunk + one
+  step), each completed page scattered into freshly-allocated pool
+  pages.  A sequence reserves exactly ``ceil((prompt+budget)/page)``
+  pages, so pool capacity is sized to the traffic mix, not
+  ``slots x max_seq``.
+- ``PrefixPageCache``: a content-hash → physical-page map over the pool.
+  Every FULL prompt page (its key: the hash of the whole token prefix
+  through that page — K/V of a row depends on every token before it) is
+  registered at prefill; a later request sharing the prefix acquires the
+  page (refcount++) instead of recomputing it, and its prefill starts at
+  the first miss.  Shared pages are immutable while referenced; the
+  partial tail block is always a PRIVATE page (recomputed through the
+  station — the copy-on-write discipline), so decode-step writes never
+  touch a shared page.  Retirement drops refcounts; refcount-0 pages
+  stay cached LRU and are evicted only under pool pressure.  Only
+  dense-prefill-produced pages are cached (decode-produced K/V rides a
+  different numeric path), which keeps chunked + cached decode
+  token-identical to the monolithic path.
 
 Memory math that motivates this: the dense batcher at 8 slots x 2048
 rows holds 16k rows per layer regardless of traffic; a paged pool
 serving the same mix of (128-prompt, <=256-new) requests reserves <=384
 rows per live sequence — 5x less HBM for the same slot count, or 5x the
-concurrent sequences in the same HBM.
+concurrent sequences in the same HBM.  The prefix cache stacks on top:
+a shared system prompt or a second same-session turn skips its cached
+pages' prefill compute entirely (``stats['prefix_hit_tokens']``).
 """
 
 from __future__ import annotations
 
+import hashlib
+import time
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
 
 import flax.linen as nn
 import jax
@@ -46,7 +66,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubegpu_tpu.models.decoding import DecodeLM, QuantDense, init_caches
+from kubegpu_tpu.models.serving import _observe_emit, _validate_request
 from kubegpu_tpu.ops.paged_attention import paged_decode_attention
+from kubegpu_tpu.utils.metrics import Metrics
 
 
 class PagedDecodeAttention(nn.Module):
@@ -156,23 +178,112 @@ class PagedDecodeLM(nn.Module):
         return logits[:, -1], new_pools
 
 
+class PrefixPageCache:
+    """Content-hash → physical page map with refcounts and LRU eviction.
+
+    A page is ``live`` while any sequence references it (refcount > 0);
+    at refcount 0 it stays cached — a later same-prefix request can still
+    hit it — and becomes evictable in LRU order when the pool needs
+    pages.  Host-side accounting only; the K/V bytes live in the pool.
+    """
+
+    def __init__(self) -> None:
+        self._entries: "OrderedDict[bytes, int]" = OrderedDict()
+        self._refs: Dict[int, int] = {}
+        self._key_of: Dict[int, bytes] = {}
+
+    def lookup(self, key: bytes) -> Optional[int]:
+        """Peek without taking a reference (admission feasibility)."""
+        return self._entries.get(key)
+
+    def acquire(self, key: bytes) -> Optional[int]:
+        page = self._entries.get(key)
+        if page is None:
+            return None
+        self._entries.move_to_end(key)
+        self._refs[page] += 1
+        return page
+
+    def insert(self, key: bytes, page: int) -> None:
+        """Register a freshly-prefilled page; the caller holds one ref."""
+        assert key not in self._entries, "duplicate prefix key"
+        assert page not in self._refs, "page already cached"
+        self._entries[key] = page
+        self._refs[page] = 1
+        self._key_of[page] = key
+
+    def release(self, page: int) -> None:
+        self._refs[page] -= 1
+        assert self._refs[page] >= 0, f"refcount underflow on page {page}"
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(page, 0)
+
+    def idle_count(self) -> int:
+        return sum(1 for r in self._refs.values() if r == 0)
+
+    def evict_lru(self) -> Optional[int]:
+        """Drop the least-recently-used refcount-0 entry; returns its
+        page (now unowned) or None if everything is referenced."""
+        for key, page in self._entries.items():
+            if self._refs[page] == 0:
+                del self._entries[key]
+                del self._refs[page]
+                del self._key_of[page]
+                return page
+        return None
+
+    def pages(self) -> Set[int]:
+        return set(self._refs)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
 @dataclass
 class _Seq:
     seq_id: int = -1
     remaining: int = 0
     active: bool = False
+    prefilling: bool = False     # a _PrefillJob is feeding this slot
     tokens: List[int] = field(default_factory=list)
     pages: List[int] = field(default_factory=list)  # reserved physical ids
+    shared: Set[int] = field(default_factory=set)   # cache-owned subset
+    submitted_at: float = 0.0
+    last_emit_at: float = 0.0
+
+
+@dataclass
+class _PrefillJob:
+    """One in-flight chunked admission through the prefill station."""
+
+    slot: int
+    seq_id: int
+    prompt: np.ndarray
+    plen: int
+    temperature: float
+    keys: List[bytes]        # chain hashes of sharable full prompt pages
+    pos: int                 # prompt rows already prefilled (or cached)
+    next_scatter: int        # next page index to scatter from the station
 
 
 class PagedContinuousBatcher:
-    """Continuous batching with a shared KV page pool.
+    """Continuous batching with a shared KV page pool and prefix reuse.
 
     ``pool_pages`` bounds TOTAL cache memory across all slots; each
     admitted sequence reserves exactly the pages its prompt+budget can
     touch and returns them at retirement.  Admission defers (keeps the
-    prompt queued) while the pool lacks the reservation; a request whose
-    worst case exceeds the whole pool is rejected up front."""
+    prompt queued) while the pool lacks the reservation — refcount-0
+    prefix-cache pages count as available (LRU-evicted on demand); a
+    request whose worst case exceeds the whole pool is rejected up front.
+
+    ``prefill_chunk`` (default: one page) is the prompt rows prefilled
+    per serving iteration, in page-sized device programs; must be a
+    multiple of ``page_size`` so station writes stay page-aligned.
+    ``prefix_cache=False`` disables sharing (every page private).
+    ``session_id`` on ``submit`` is advisory — sharing is content-
+    addressed, so same-session turns and cross-session shared system
+    prompts both hit without coordination."""
 
     def __init__(
         self,
@@ -187,11 +298,14 @@ class PagedContinuousBatcher:
         prompt_pad: int = 128,
         page_size: int = 128,
         pool_pages: int = 64,
+        prefill_chunk: Optional[int] = None,
+        prefix_cache: bool = True,
         eos_id: Optional[int] = None,
         dtype=jnp.bfloat16,
         quant: bool = False,
         top_k: int = 0,
         seed: int = 0,
+        metrics: Optional[Metrics] = None,
     ) -> None:
         if prompt_pad > max_seq:
             raise ValueError(
@@ -203,6 +317,17 @@ class PagedContinuousBatcher:
                 f"page_size ({page_size}): the admit scatter copies whole "
                 "pages out of the dense prefill cache"
             )
+        if prefill_chunk is None:
+            prefill_chunk = page_size
+        if prefill_chunk <= 0 or prefill_chunk % page_size:
+            raise ValueError(
+                f"prefill_chunk ({prefill_chunk}) must be a positive "
+                f"multiple of page_size ({page_size}): station writes are "
+                "page-aligned"
+            )
+        self.prefill_chunk = prefill_chunk
+        self._chunks_per_step = prefill_chunk // page_size
+        self.metrics = metrics
         self.params = params
         self.slots = slots
         self.prompt_pad = prompt_pad
@@ -240,12 +365,26 @@ class PagedContinuousBatcher:
         # k/v hits dump rows only
         self.free_pages = set(range(1, pool_pages))
         self.pool_pages = pool_pages
+        self.prefix_cache: Optional[PrefixPageCache] = (
+            PrefixPageCache() if prefix_cache else None
+        )
         # host-side tables: unused entries point at page 0 (fetched but
         # masked — the kernel never attends past a slot's length)
         self.tables = np.zeros((slots, self.max_pages), np.int32)
         self.pos = np.zeros((slots,), np.int32)  # rows already consumed
         self._seqs = [_Seq() for _ in range(slots)]
         self._last = np.zeros((slots,), np.int32)
+        # the prefill station: ONE persistent dense b=1 cache chunked
+        # prompts flow through before their pages scatter into the pool
+        self._station = init_caches(
+            1, num_layers, num_heads, hidden, prompt_pad, dtype
+        )
+        self._job: Optional[_PrefillJob] = None
+        self._pending: deque = deque()
+        # prefix keys memoized for the deferred FIFO head (see
+        # _try_begin_admit); entries die on admission or cancel
+        self._pending_keys: Dict[int, List[bytes]] = {}
+        self._reset_stats()
         # per-request sampling state (the dense batcher's exact recipe:
         # fold_in(fold_in(seed, seq_id), nth-token) keys, 0 = greedy)
         if top_k > vocab_size:
@@ -270,184 +409,394 @@ class PagedContinuousBatcher:
 
         self._step = jax.jit(step, donate_argnums=(1,))
 
-        def prefill(params, prompt_row, prompt_len, temp, key):
-            # dense b=1 prefill (padded, causal) + one single-token pass at
-            # the real depth for the first generated token — the dense
-            # batcher's exact admit recipe.  The dense twin's pos-embed
-            # table is the TARGET's, sliced to its shorter max_seq.
+        def chunk(params, station, chunk_row, start):
+            # one page-sized causal chunk through the prefill station:
+            # rows [start, start+page) of the prompt, K/V landing at the
+            # same station rows.  The dense twin's pos-embed table is the
+            # TARGET's, sliced to its shorter max_seq.  start is always
+            # page-aligned and < prompt_pad, so the write never clamps.
             params = {
                 **params,
                 "pos_embed": {
                     "embedding": params["pos_embed"]["embedding"][:prompt_pad]
                 },
             }
-            caches = init_caches(
-                1, num_layers, num_heads, hidden, prompt_pad, dtype
+            _, station = self.dense_model.apply(
+                {"params": params}, chunk_row[None, :], station, start
             )
-            _, caches = self.dense_model.apply(
-                {"params": params}, prompt_row[None, :], caches,
-                jnp.zeros((), jnp.int32),
-            )
-            last_real = jax.lax.dynamic_slice(prompt_row, (prompt_len - 1,), (1,))
-            logits, caches = self.dense_model.apply(
-                {"params": params}, last_real[None, :], caches,
-                (prompt_len - 1)[None],
-            )
-            first = pick_tokens(logits, temp[None], key[None], self.top_k)[0]
-            # (layer, k/v, prompt_pad rows) densely; host scatters pages
-            return first, caches
+            return station
 
-        self._prefill = jax.jit(prefill)
+        self._chunk = jax.jit(chunk, donate_argnums=(1,))
 
-        def write_pages(pools, dense_caches, phys_ids, n_pages):
-            # scatter the dense prefill rows page-by-page into the pool:
-            # dense cache (1, prompt_pad, h, hd) -> per page j the rows
-            # [j*page, (j+1)*page) land at pool page phys_ids[j].
-            # n_pages is static per prompt_pad (all reserved prefix pages
-            # are written; rows past the prompt are garbage the kernel
-            # masks).
+        def write_page(pools, station, phys, row):
+            # scatter ONE completed station page (rows [row, row+page))
+            # into pool page `phys`; traced scalars, so one compile
+            # serves every page of every admission
             out = []
-            for (kp, vp), (ck, cv) in zip(pools, dense_caches):
-                ck = jnp.moveaxis(ck[0], 1, 0)      # (h, prompt_pad, hd)
-                cv = jnp.moveaxis(cv[0], 1, 0)
-                for j in range(n_pages):
-                    kp = kp.at[phys_ids[j]].set(
-                        ck[:, j * page_size:(j + 1) * page_size, :]
-                    )
-                    vp = vp.at[phys_ids[j]].set(
-                        cv[:, j * page_size:(j + 1) * page_size, :]
-                    )
+            for (kp, vp), (ck, cv) in zip(pools, station):
+                h = kp.shape[1]
+                hd = kp.shape[3]
+                rk = jax.lax.dynamic_slice(
+                    ck, (0, row, 0, 0), (1, page_size, h, hd)
+                )[0]
+                rv = jax.lax.dynamic_slice(
+                    cv, (0, row, 0, 0), (1, page_size, h, hd)
+                )[0]
+                kp = kp.at[phys].set(jnp.moveaxis(rk, 0, 1))
+                vp = vp.at[phys].set(jnp.moveaxis(rv, 0, 1))
                 out.append((kp, vp))
             return out
 
-        self._write_pages = jax.jit(
-            write_pages, static_argnums=(3,), donate_argnums=(0,)
-        )
+        self._write_page = jax.jit(write_page, donate_argnums=(0,))
+
+        def gather_page(station, pools, phys, row):
+            # the reverse copy: a prefix-cache HIT page streamed back
+            # into the station so later chunks can attend its rows —
+            # bit-identical bytes, no recompute (the COW "copy")
+            out = []
+            for (ck, cv), (kp, vp) in zip(station, pools):
+                rk = jnp.moveaxis(kp[phys], 0, 1)[None]
+                rv = jnp.moveaxis(vp[phys], 0, 1)[None]
+                ck = jax.lax.dynamic_update_slice(ck, rk, (0, row, 0, 0))
+                cv = jax.lax.dynamic_update_slice(cv, rv, (0, row, 0, 0))
+                out.append((ck, cv))
+            return out
+
+        self._gather_page = jax.jit(gather_page, donate_argnums=(0,))
 
     # -- page accounting ---------------------------------------------------
     def _pages_for(self, plen: int, max_new: int) -> int:
         return -(-(plen + max_new) // self.page)
 
+    def _available_pages(self, reserved: Set[int]) -> int:
+        """Pages obtainable right now: free + evictable cache entries,
+        excluding `reserved` (hit pages this admission is about to
+        acquire must not be counted as evictable)."""
+        idle = 0
+        if self.prefix_cache is not None:
+            idle = sum(
+                1 for p in self.prefix_cache.pages()
+                if self.prefix_cache.refcount(p) == 0 and p not in reserved
+            )
+        return len(self.free_pages) + idle
+
+    def _alloc_page(self) -> int:
+        """Pop a free page, evicting the LRU idle cache entry if the
+        free list is empty.  Caller must have checked availability."""
+        if self.free_pages:
+            return self.free_pages.pop()
+        page = self.prefix_cache.evict_lru()
+        assert page is not None, "allocation past availability check"
+        return page
+
+    def _release_pages(self, s: _Seq) -> None:
+        for p in s.pages:
+            if p in s.shared:
+                self.prefix_cache.release(p)
+            else:
+                self.free_pages.add(p)
+        s.pages, s.shared = [], set()
+
+    def pages_in_use(self) -> int:
+        """Distinct pool pages held by live sequences (shared pages count
+        once); idle cache-resident pages are NOT in use."""
+        idle = (
+            self.prefix_cache.idle_count()
+            if self.prefix_cache is not None else 0
+        )
+        return self.pool_pages - 1 - len(self.free_pages) - idle
+
+    def assert_page_accounting(self) -> None:
+        """Invariant check (tests, soak): every allocatable page is
+        exactly one of free / cache-resident / privately live, and
+        refcounts equal the number of live sequences sharing each page."""
+        all_pages = set(range(1, self.pool_pages))
+        cached = (
+            self.prefix_cache.pages()
+            if self.prefix_cache is not None else set()
+        )
+        private = set()
+        refs: Dict[int, int] = {}
+        for s in self._seqs:
+            if s.seq_id < 0:
+                continue
+            for p in s.pages:
+                if p in s.shared:
+                    refs[p] = refs.get(p, 0) + 1
+                else:
+                    assert p not in private, f"page {p} doubly private"
+                    private.add(p)
+        assert not (self.free_pages & cached), "free page still cached"
+        assert not (self.free_pages & private), "free page still live"
+        assert not (private & cached), "private page in prefix cache"
+        assert self.free_pages | cached | private == all_pages, (
+            "page leak: "
+            f"{sorted(all_pages - (self.free_pages | cached | private))}"
+        )
+        for p, n in refs.items():
+            assert self.prefix_cache.refcount(p) == n, (
+                f"page {p}: refcount {self.prefix_cache.refcount(p)} != "
+                f"{n} live holders"
+            )
+        if self.prefix_cache is not None:
+            for p in cached - set(refs):
+                assert self.prefix_cache.refcount(p) == 0, (
+                    f"page {p} refcounted with no live holder"
+                )
+
     # -- admission ---------------------------------------------------------
-    def _try_admit(self, slot: int, seq_id: int, prompt: np.ndarray,
-                   max_new: int, temperature: float = 0.0) -> bool:
-        plen = int(prompt.shape[0])
-        if plen > self.prompt_pad:
-            raise ValueError(
-                f"prompt length {plen} exceeds prompt_pad {self.prompt_pad}"
-            )
-        if plen + max_new > self.max_seq:
-            raise ValueError(
-                f"prompt {plen} + max_new {max_new} exceeds max_seq "
-                f"{self.max_seq}"
-            )
-        s = self._seqs[slot]
-        if max_new <= 0:
-            # no-op admit BEFORE the pool-capacity check: a zero-budget
-            # request allocates zero pages, and the dense batcher admits
-            # the same input as a no-op — the two must agree on every
-            # input (their shared contract; see
-            # test_batchers_agree_on_oversized_prompt_with_zero_budget)
-            s.seq_id, s.active, s.tokens, s.remaining = seq_id, False, [], 0
-            return True
+    def _validate(self, prompt: np.ndarray, max_new: int) -> int:
+        # shared dense/paged contract, plus the pool-capacity check only
+        # this batcher can make
+        plen = _validate_request(prompt, max_new, self.prompt_pad,
+                                 self.max_seq)
+        if max_new > 0:
+            need = self._pages_for(plen, max_new)
+            if need > self.pool_pages - 1:  # page 0 is the dump page
+                raise ValueError(
+                    f"request needs {need} pages; the pool has "
+                    f"{self.pool_pages - 1} allocatable"
+                )
+        return plen
+
+    def _try_begin_admit(self, slot: int, seq_id: int, prompt: np.ndarray,
+                         max_new: int, temperature: float,
+                         submitted_at: float) -> bool:
+        """Reserve pages (prefix-cache hits first), gather hit pages into
+        the station, and open the prefill job.  Returns False to defer
+        (pool pressure) with no state changed."""
+        plen = self._validate(prompt, max_new)  # max_new > 0: _sweep
+        s = self._seqs[slot]                    # handles zero-budget admits
         need = self._pages_for(plen, max_new)
-        if need > self.pool_pages - 1:  # page 0 is the dump page
-            raise ValueError(
-                f"request needs {need} pages; the pool has "
-                f"{self.pool_pages - 1} allocatable"
-            )
-        if need > len(self.free_pages):
-            return False  # defer until retirements free pages
-        pages = [self.free_pages.pop() for _ in range(need)]
-        row = np.zeros((self.prompt_pad,), np.int32)
-        row[:plen] = prompt
-        base_key = jax.random.fold_in(self._root_key, seq_id)
-        self._temps = self._temps.at[slot].set(temperature)
-        self._base_keys = self._base_keys.at[slot].set(base_key)
-        first, dense_caches = self._prefill(
-            self.params, jnp.asarray(row), jnp.int32(plen),
-            jnp.float32(temperature), jax.random.fold_in(base_key, 0),
+        # sharable pages: FULL prompt pages strictly below row plen-1 —
+        # the page holding the last prompt row takes the first decode
+        # write (the re-run of row plen-1), so it must stay private
+        n_sharable = (plen - 1) // self.page
+        keys: List[bytes] = []
+        hits: List[int] = []
+        if self.prefix_cache is not None:
+            # chain the hash: one update per page, snapshot the digest at
+            # each boundary — linear in plen, same keys as hashing each
+            # prefix from scratch.  Memoized per seq_id: a head deferred
+            # on pool pressure retries every sweep, and its prompt never
+            # changes while queued (only the cheap lookups re-run).
+            keys = self._pending_keys.get(seq_id)
+            if keys is None:
+                h = hashlib.sha256()
+                keys = []
+                for j in range(n_sharable):
+                    h.update(
+                        prompt[j * self.page: (j + 1) * self.page].tobytes()
+                    )
+                    keys.append(h.copy().digest())
+                self._pending_keys[seq_id] = keys
+            for key in keys:  # probe the unbroken hit prefix
+                page = self.prefix_cache.lookup(key)
+                if page is None:
+                    break
+                hits.append(page)
+        if need - len(hits) > self._available_pages(set(hits)):
+            return False  # defer until retirements/evictions free pages
+        self._pending_keys.pop(seq_id, None)
+        for j, key in enumerate(keys[: len(hits)]):
+            acquired = self.prefix_cache.acquire(key)
+            assert acquired == hits[j]
+        fresh = [self._alloc_page() for _ in range(need - len(hits))]
+        pages = hits + fresh
+        # the slot's table stays parked on the dump page until
+        # ACTIVATION: the step program writes K/V for every slot each
+        # iteration, and a prefilling slot's garbage write must never
+        # land in a real page — least of all a shared hit page
+        s.seq_id, s.active, s.prefilling = seq_id, False, True
+        s.tokens, s.remaining = [], max_new
+        s.pages, s.shared = pages, set(hits)
+        s.submitted_at = submitted_at
+        hit_rows = len(hits) * self.page
+        self.stats["prefix_hit_tokens"] += hit_rows
+        self.stats["prompt_tokens"] += plen
+        if self.metrics is not None:
+            self.metrics.inc("serve_prefix_hit_tokens_total", hit_rows)
+            self.metrics.inc("serve_prompt_tokens_total", plen)
+        # hit rows only need station residency if chunks will run after
+        # them; a full-prefix hit (two-turn sessions) skips the copies
+        if hit_rows < plen - 1:
+            for j in range(len(hits)):
+                self._station = self._gather_page(
+                    self._station, self.pools, jnp.int32(hits[j]),
+                    jnp.int32(j * self.page),
+                )
+        self._job = _PrefillJob(
+            slot=slot, seq_id=seq_id, prompt=prompt, plen=plen,
+            temperature=temperature, keys=keys,
+            pos=hit_rows, next_scatter=len(hits),
         )
-        # scatter every page the PROMPT touches (rows past it are masked);
-        # later pages only ever receive decode-step writes.  phys ids are
-        # padded to a FIXED-length tuple so the jitted writer compiles
-        # once per prefill_pages count, not per reservation size
-        prefill_pages = min(-(-plen // self.page), len(pages))
-        phys = tuple(pages) + (0,) * (self.max_pages - len(pages))
-        self.pools = self._write_pages(
-            self.pools, dense_caches, phys, prefill_pages
+        self.stats["admits"] += 1
+        self.stats["peak_pages"] = max(
+            self.stats["peak_pages"], self.pages_in_use()
         )
-        self.tables[slot, :] = pages[0]
-        self.tables[slot, :len(pages)] = pages
-        self.pos[slot] = plen
-        self._last[slot] = int(first)
-        s.seq_id, s.active = seq_id, True
-        s.tokens = [int(first)]
-        s.remaining = max_new - 1
-        s.pages = pages
-        if self.eos_id is not None and s.tokens[-1] == self.eos_id:
-            s.remaining = 0
-        if s.remaining <= 0:
-            s.active = False
         return True
 
-    # -- the serve loop ----------------------------------------------------
-    def run(
-        self,
-        prompts: List[np.ndarray],
-        max_new_tokens: List[int],
-        temperatures: Optional[List[float]] = None,
-    ) -> Dict[int, List[int]]:
-        assert len(prompts) == len(max_new_tokens)
-        temps = temperatures or [0.0] * len(prompts)
-        assert len(temps) == len(prompts)
-        queue = list(range(len(prompts)))
-        done: Dict[int, List[int]] = {}
-        self.stats = {"steps": 0, "admits": 0, "peak_pages": 0}
-
-        def retire_and_admit():
-            progress = True
-            while progress:
-                progress = False
-                for i, s in enumerate(self._seqs):
-                    if s.seq_id >= 0 and not s.active:
-                        done[s.seq_id] = s.tokens
-                        self.free_pages.update(s.pages)
-                        s.pages = []
-                        s.seq_id = -1
-                        # park the slot on the dump page so its (inevitable,
-                        # static-shape) step writes can never touch a
-                        # reallocated page
-                        self.tables[i, :] = 0
-                        self.pos[i] = 0
-                        self._last[i] = 0
-                        progress = True
-                    if s.seq_id < 0 and queue:
-                        nxt = queue[0]
-                        if self._try_admit(
-                            i, nxt, prompts[nxt], max_new_tokens[nxt],
-                            temps[nxt],
-                        ):
-                            queue.pop(0)
-                            self.stats["admits"] += 1
-                            self.stats["peak_pages"] = max(
-                                self.stats["peak_pages"],
-                                self.pool_pages - len(self.free_pages),
-                            )
-                            progress = True
-                        # else: pool full for the FIFO head — the loop
-                        # deliberately CONTINUES so this pass's later
-                        # retirements can free pages and re-trigger the
-                        # head's admission on the next sweep iteration
-                        # (later prompts wait behind the head either way)
-
-        retire_and_admit()
-        if queue and not any(s.active for s in self._seqs):
-            raise RuntimeError(
-                "pool cannot admit the next request though no sequence is "
-                "live — pool_pages too small for the traffic"
+    # -- chunked prefill ---------------------------------------------------
+    def _scatter_ready_pages(self, job: _PrefillJob) -> None:
+        s = self._seqs[job.slot]
+        n_sharable = len(job.keys)
+        while job.next_scatter * self.page < job.pos:
+            j = job.next_scatter
+            # a page scatters once prefill has passed it (complete) or
+            # the job is flushing its partial tail (pos == plen-1)
+            if (j + 1) * self.page > job.pos and job.pos < job.plen - 1:
+                break
+            phys = s.pages[j]
+            self.pools = self._write_page(
+                self.pools, self._station, jnp.int32(phys),
+                jnp.int32(j * self.page),
             )
-        while any(s.active for s in self._seqs):
+            if (
+                self.prefix_cache is not None
+                and j < n_sharable
+                and (j + 1) * self.page <= job.pos
+                and self.prefix_cache.lookup(job.keys[j]) is None
+            ):
+                self.prefix_cache.insert(job.keys[j], phys)
+                s.shared.add(phys)
+            job.next_scatter = j + 1
+
+    def _activate(self, job: _PrefillJob) -> None:
+        # prompt rows [0, plen-1) are in pool pages; the LAST prompt
+        # token rides the ordinary step program (write row plen-1,
+        # attend <= plen-1), which emits the first generated token in
+        # the same program every other slot decodes with
+        slot, s = job.slot, self._seqs[job.slot]
+        base_key = jax.random.fold_in(self._root_key, job.seq_id)
+        self._temps = self._temps.at[slot].set(job.temperature)
+        self._base_keys = self._base_keys.at[slot].set(base_key)
+        self.tables[slot, :] = s.pages[0]
+        self.tables[slot, : len(s.pages)] = s.pages
+        self.pos[slot] = job.plen - 1
+        self._last[slot] = int(job.prompt[job.plen - 1])
+        s.prefilling, s.active = False, True
+
+    def _advance_prefill(self) -> None:
+        job = self._job
+        if job is None:
+            return
+        for _ in range(self._chunks_per_step):
+            start = job.pos
+            end = min(start + self.page, job.plen - 1)
+            if end <= start:
+                break
+            row = np.zeros((self.page,), np.int32)
+            row[: end - start] = job.prompt[start:end]
+            self._station = self._chunk(
+                self.params, self._station, jnp.asarray(row),
+                jnp.int32(start),
+            )
+            job.pos = end
+            self.stats["prefill_chunks"] += 1
+            if self.metrics is not None:
+                self.metrics.inc("serve_prefill_chunks_total")
+            self._scatter_ready_pages(job)
+        if job.pos >= job.plen - 1:
+            self._scatter_ready_pages(job)  # flush the partial tail
+            self._activate(job)
+            self._job = None
+
+    # -- incremental serving API (the gateway's replica loop) --------------
+    def submit(self, seq_id: int, prompt: np.ndarray, max_new: int,
+               temperature: float = 0.0,
+               session_id: Optional[str] = None) -> None:
+        """Queue one request.  Validates shape and worst-case pool limits
+        eagerly (a request that can never fit fails here, not mid-loop).
+        ``session_id`` is advisory: prefix sharing is content-addressed."""
+        if seq_id < 0:
+            raise ValueError(f"seq_id must be >= 0, got {seq_id}")
+        prompt = np.asarray(prompt, np.int32)
+        self._validate(prompt, max_new)
+        # a reused seq_id binds to a NEW prompt: any memoized prefix keys
+        # from a deferred-then-abandoned admission are stale now
+        self._pending_keys.pop(seq_id, None)
+        self._pending.append(
+            (seq_id, prompt, max_new, temperature, time.monotonic())
+        )
+
+    def cancel(self, seq_id: int) -> bool:
+        """Withdraw a request from the queue, mid-prefill, or mid-decode;
+        its pages go back to the pool (shared ones decref).  Returns
+        False if the request is unknown."""
+        for i, item in enumerate(self._pending):
+            if item[0] == seq_id:
+                del self._pending[i]
+                self._pending_keys.pop(seq_id, None)
+                return True
+        for i, s in enumerate(self._seqs):
+            if s.seq_id == seq_id:
+                if self._job is not None and self._job.seq_id == seq_id:
+                    self._job = None  # station contents become garbage
+                self._release_pages(s)
+                s.seq_id, s.active, s.prefilling = -1, False, False
+                s.tokens, s.remaining = [], 0
+                self.tables[i, :] = 0
+                self.pos[i] = 0
+                self._last[i] = 0
+                return True
+        return False
+
+    def has_work(self) -> bool:
+        return bool(self._pending) or any(s.seq_id >= 0 for s in self._seqs)
+
+    def _reset_stats(self) -> None:
+        self.stats = {
+            "steps": 0, "admits": 0, "peak_pages": 0, "prefill_chunks": 0,
+            "prefix_hit_tokens": 0, "prompt_tokens": 0,
+        }
+
+    def _sweep(self, finished: Dict[int, List[int]]) -> None:
+        progress = True
+        while progress:
+            progress = False
+            for i, s in enumerate(self._seqs):
+                if s.seq_id >= 0 and not s.active and not s.prefilling:
+                    finished[s.seq_id] = s.tokens
+                    self._release_pages(s)
+                    s.seq_id = -1
+                    # park the slot on the dump page so its (inevitable,
+                    # static-shape) step writes can never touch a
+                    # reallocated page
+                    self.tables[i, :] = 0
+                    self.pos[i] = 0
+                    self._last[i] = 0
+                    progress = True
+                if s.seq_id < 0 and self._pending:
+                    nxt = self._pending[0]
+                    if nxt[2] <= 0:
+                        # zero-budget no-op admit (validated at submit):
+                        # no pages, no job/slot work — the dense batcher
+                        # admits the same input as a no-op (their shared
+                        # contract)
+                        s.seq_id, s.active = nxt[0], False
+                        s.prefilling, s.tokens, s.remaining = False, [], 0
+                        self._pending.popleft()
+                        self.stats["admits"] += 1
+                        progress = True
+                        continue
+                    if self._job is not None:
+                        continue  # the station serves one admission at a time
+                    if self._try_begin_admit(i, *nxt):
+                        self._pending.popleft()
+                        progress = True
+                    # else: pool full for the FIFO head — later
+                    # retirements in this pass can free pages and
+                    # re-trigger the head's admission (later prompts
+                    # wait behind the head either way)
+
+    def serve_step(self) -> Dict[int, List[int]]:
+        """One serving iteration: retire + admit, advance the prefill
+        station by ``prefill_chunk`` rows, run ONE paged decode step if
+        anything is active, retire again."""
+        finished: Dict[int, List[int]] = {}
+        self._sweep(finished)
+        self._advance_prefill()
+        if any(s.active for s in self._seqs):
             counts = np.array(
                 [len(sq.tokens) for sq in self._seqs], np.int32
             )
@@ -463,12 +812,42 @@ class PagedContinuousBatcher:
                     continue
                 self.pos[i] += 1  # the step consumed one row for this slot
                 t = int(toks_host[i])
+                first = not s.tokens
                 s.tokens.append(t)
                 s.remaining -= 1
                 self._last[i] = t
+                _observe_emit(self.metrics, s, first=first)
                 if s.remaining <= 0 or (
                     self.eos_id is not None and t == self.eos_id
                 ):
                     s.active = False
-            retire_and_admit()
+            self._sweep(finished)
+        return finished
+
+    # -- the batch convenience loop ----------------------------------------
+    def run(
+        self,
+        prompts: List[np.ndarray],
+        max_new_tokens: List[int],
+        temperatures: Optional[List[float]] = None,
+    ) -> Dict[int, List[int]]:
+        assert len(prompts) == len(max_new_tokens)
+        temps = temperatures or [0.0] * len(prompts)
+        assert len(temps) == len(prompts)
+        self._reset_stats()
+        for i, (p, m, t) in enumerate(zip(prompts, max_new_tokens, temps)):
+            self.submit(i, np.asarray(p), m, t)
+        done: Dict[int, List[int]] = {}
+        while self.has_work():
+            done.update(self.serve_step())
+            if (
+                self._pending
+                and self._job is None
+                and not any(s.seq_id >= 0 for s in self._seqs)
+            ):
+                raise RuntimeError(
+                    "pool cannot admit the next request though no "
+                    "sequence is live — pool_pages too small for the "
+                    "traffic"
+                )
         return done

@@ -13,13 +13,25 @@ TPU-first structure: exactly TWO compiled programs regardless of traffic —
 
 - ``step``: one token for every slot at its own depth (the per-slot
   ``pos`` vector path through ``DecodeLM``);
-- ``admit``: prefill ONE prompt (fixed padded length, length-masked) on a
-  fresh b=1 cache and splice the result into the shared cache at a traced
-  slot index (``dynamic_update_slice`` on the batch axis).
+- ``chunk``: CHUNKED PREFILL — every prefilling slot advances one
+  fixed-size chunk of its prompt per serving iteration, written straight
+  into the shared cache at its own row offset (per-slot masked
+  slice-update).  Decode steps interleave between chunks, so inter-token
+  latency for RUNNING sequences stays bounded by one chunk + one step
+  regardless of how long an arriving prompt is, padding waste drops from
+  prompt_pad-per-admit to at most one chunk, and several pending admits
+  share one chunk batch.  The prompt's LAST token never prefills: it is
+  fed through the ordinary ``step`` program (write row plen-1, attend
+  <= plen-1), which yields the first generated token on the same program
+  every other slot decodes with — prefill completion IS a decode step.
 
-Both have static shapes, so arbitrary arrival patterns never recompile.
-The host-side loop (``ContinuousBatcher``) is pure orchestration: admit,
-step, collect, retire.
+``prefill_chunk=None`` selects the legacy monolithic admit (prefill ONE
+padded prompt on a fresh b=1 cache and splice it in), kept as the
+baseline bench.py measures chunked prefill against.
+
+All programs have static shapes, so arbitrary arrival patterns never
+recompile.  The host-side loop (``ContinuousBatcher``) is pure
+orchestration: admit, chunk, step, collect, retire.
 
 Reference anchor: SURVEY.md §2.2 — serving is a scheduled workload; the
 framework's job is handing it well-placed chips, and this module is the
@@ -28,15 +40,17 @@ workload-side twin of the decode sample (`samples/jax-decode.yaml`).
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from kubegpu_tpu.models.decoding import DecodeLM, init_caches
+from kubegpu_tpu.utils.metrics import Metrics
 
 
 @dataclass
@@ -45,15 +59,68 @@ class _Slot:
     remaining: int = 0        # new tokens still owed
     active: bool = False
     tokens: List[int] = field(default_factory=list)
+    # chunked-prefill state: prompt rows [0, prefill_pos) are in the
+    # cache; the slot activates (joins the step program) once
+    # prefill_pos reaches plen-1
+    prompt: Optional[np.ndarray] = None
+    prefill_pos: int = 0
+    temperature: float = 0.0
+    submitted_at: float = 0.0
+    last_emit_at: float = 0.0
+
+
+def _observe_emit(metrics, s, first: bool) -> None:
+    """Record TTFT (first token) or ITL on a slot's token emit.  Shared
+    by the dense and paged batchers so the histogram semantics (what
+    counts as "first", which interval ITL measures) cannot diverge."""
+    now = time.monotonic()
+    if metrics is not None:
+        if first:
+            metrics.observe("serve_ttft_seconds", now - s.submitted_at)
+        else:
+            metrics.observe("serve_itl_seconds", now - s.last_emit_at)
+    s.last_emit_at = now
+
+
+def _validate_request(prompt: np.ndarray, max_new: int,
+                      prompt_pad: int, max_seq: int) -> int:
+    """The dense/paged shared admission contract: both batchers must
+    accept and reject exactly the same inputs (ADVICE r4), and validate
+    BEFORE any max_new<=0 short-circuit so an oversized prompt is
+    rejected regardless of max_new."""
+    plen = int(prompt.shape[0])
+    if plen < 1:
+        raise ValueError("prompt must contain at least one token")
+    if plen > prompt_pad:
+        raise ValueError(
+            f"prompt length {plen} exceeds prompt_pad {prompt_pad}"
+        )
+    if plen + max_new > max_seq:
+        raise ValueError(
+            f"prompt {plen} + max_new {max_new} exceeds max_seq {max_seq}"
+        )
+    return plen
 
 
 class ContinuousBatcher:
     """Greedy continuous-batching decoder over a fixed slot count.
 
-    ``prompt_pad``: every admitted prompt is right-padded to this length
-    (shorter prompts are length-masked via their slot position — padding
-    rows are never attended because the slot's ``pos`` only advances by
-    the REAL length).  One padded shape = one compiled admit program.
+    ``prompt_pad``: upper bound on admissible prompt length.  Under the
+    legacy monolithic admit (``prefill_chunk=None``) every prompt is
+    right-padded to it (one padded shape = one compiled admit program);
+    under chunked prefill it is only the validation bound — padding waste
+    is at most one chunk.
+
+    ``prefill_chunk``: prompt tokens prefilled per serving iteration
+    (the ITL bound under long-prompt admits).  ``None`` = monolithic;
+    the ``"auto"`` default picks 128 when the last padded chunk fits
+    ``max_seq`` and falls back to monolithic otherwise, so the default
+    never rejects a config the monolithic batcher accepted.
+
+    ``metrics``: optional ``utils.metrics.Metrics`` registry; when given,
+    the batcher observes ``serve_ttft_seconds`` / ``serve_itl_seconds``
+    histograms and ``serve_prefill_chunks_total`` so a gateway sharing
+    the registry exposes data-plane latency next to its own.
     """
 
     def __init__(
@@ -67,11 +134,13 @@ class ContinuousBatcher:
         max_seq: int,
         slots: int = 8,
         prompt_pad: int = 128,
+        prefill_chunk: Union[int, None, str] = "auto",
         eos_id: Optional[int] = None,
         dtype=jnp.bfloat16,
         quant: bool = False,
         top_k: int = 0,
         seed: int = 0,
+        metrics: Optional[Metrics] = None,
     ) -> None:
         if prompt_pad > max_seq:
             raise ValueError(
@@ -79,6 +148,36 @@ class ContinuousBatcher:
                 "the admit prefill could not fit its padded chunk in the "
                 "cache"
             )
+        if prefill_chunk == "auto":
+            # default: chunk at 128 when the last padded chunk fits the
+            # cache, monolithic otherwise — the default must never
+            # reject a config the monolithic batcher accepted
+            c = min(128, prompt_pad)
+            fits = c * (-(-(prompt_pad - 1) // c)) <= max_seq
+            prefill_chunk = c if fits else None
+        if prefill_chunk is not None:
+            if prefill_chunk <= 0:
+                raise ValueError(
+                    f"prefill_chunk must be positive or None, got "
+                    f"{prefill_chunk}"
+                )
+            # chunk starts are multiples of the chunk size; the LAST
+            # padded chunk's write window must stay inside the cache
+            # (dynamic_update_slice clamps a spilling start backward,
+            # which would silently overwrite live history rows)
+            prefill_chunk = min(prefill_chunk, prompt_pad)
+            last_end = prefill_chunk * (
+                -(-(prompt_pad - 1) // prefill_chunk)
+            )
+            if last_end > max_seq:
+                raise ValueError(
+                    f"prefill_chunk {prefill_chunk} with prompt_pad "
+                    f"{prompt_pad} would write through row {last_end}, "
+                    f"past max_seq {max_seq}; pick a chunk size whose "
+                    "last padded chunk fits"
+                )
+        self.prefill_chunk = prefill_chunk
+        self.metrics = metrics
         self.params = params
         self.slots = slots
         self.prompt_pad = prompt_pad
@@ -114,7 +213,7 @@ class ContinuousBatcher:
         # incremental serving state (submit/serve_step — the gateway's
         # replica loop); run() is a batch convenience over the same queue
         self._pending: deque = deque()
-        self.stats = {"steps": 0, "admits": 0}
+        self._reset_stats()
 
         from kubegpu_tpu.models.decoding import pick_tokens
 
@@ -169,27 +268,60 @@ class ContinuousBatcher:
             pos = pos.at[slot].set(prompt_len)
             return first_tok, new_caches, pos
 
+        def chunk(params, caches, chunk_tokens, chunk_pos, mask):
+            # chunked prefill for EVERY slot at once: slot i writes its
+            # chunk's K/V rows at [chunk_pos[i], chunk_pos[i]+C); slots
+            # with mask[i]=False (decoding, idle) keep their rows
+            # bit-identical — the update is a per-slot slice/where/
+            # write-back over C rows, never a whole-cache select.  The
+            # chunk's logits are discarded: the first generated token
+            # comes from the ordinary step program at row plen-1.
+            C = chunk_tokens.shape[1]
+            _, new_caches = self.model.apply(
+                {"params": params}, chunk_tokens, caches, chunk_pos
+            )
+            merged = []
+            for (ok, ov), (nk, nv) in zip(caches, new_caches):
+                def keep(old, new, p, m):
+                    hd_ = old.shape[-1]
+                    h_ = old.shape[-2]
+                    prev = jax.lax.dynamic_slice(
+                        old, (p, 0, 0), (C, h_, hd_)
+                    )
+                    fresh = jax.lax.dynamic_slice(
+                        new, (p, 0, 0), (C, h_, hd_)
+                    )
+                    rows = jnp.where(m, fresh, prev)
+                    return jax.lax.dynamic_update_slice(
+                        old, rows, (p, 0, 0)
+                    )
+
+                upd = jax.vmap(keep)
+                merged.append((
+                    upd(ok, nk, chunk_pos, mask),
+                    upd(ov, nv, chunk_pos, mask),
+                ))
+            return merged
+
         self._step = jax.jit(step, donate_argnums=(1,))
         self._admit = jax.jit(admit, donate_argnums=(1,))
+        self._chunk = jax.jit(chunk, donate_argnums=(1,))
         self._last_tokens = jnp.zeros((slots,), jnp.int32)
 
     # -- host-side orchestration -------------------------------------------
+    def _validate(self, prompt: np.ndarray, max_new: int) -> int:
+        return _validate_request(prompt, max_new, self.prompt_pad,
+                                 self.max_seq)
+
+    def _reset_stats(self) -> None:
+        self.stats = {"steps": 0, "admits": 0, "prefill_chunks": 0}
+
     def _admit_one(self, slot_idx: int, seq_id: int, prompt: np.ndarray,
-                   max_new: int, temperature: float = 0.0) -> None:
-        # validate BEFORE the max_new<=0 short-circuit so an oversized
-        # prompt is rejected regardless of max_new — the paged batcher
-        # (_try_admit) validates in this order and the two must agree on
-        # the same input (ADVICE r4)
-        plen = int(prompt.shape[0])
-        if plen > self.prompt_pad:
-            raise ValueError(
-                f"prompt length {plen} exceeds prompt_pad {self.prompt_pad}"
-            )
-        if plen + max_new > self.max_seq:
-            raise ValueError(
-                f"prompt {plen} + max_new {max_new} exceeds max_seq "
-                f"{self.max_seq}"
-            )
+                   max_new: int, temperature: float = 0.0,
+                   submitted_at: float = 0.0) -> None:
+        # monolithic admit (prefill_chunk=None): one padded b=1 prefill
+        # spliced into the shared cache, first token included
+        plen = self._validate(prompt, max_new)
         if max_new <= 0:
             # match generate(num_steps=0): nothing owed, nothing emitted —
             # the admit program would still produce a first token
@@ -210,39 +342,120 @@ class ContinuousBatcher:
         s.seq_id, s.active = seq_id, True
         s.tokens = [int(first_tok)]
         s.remaining = max_new - 1
+        s.submitted_at = submitted_at
+        _observe_emit(self.metrics, s, first=True)
         self._last_tokens = self._last_tokens.at[slot_idx].set(first_tok)
         if self.eos_id is not None and s.tokens[-1] == self.eos_id:
             s.remaining = 0
         if s.remaining <= 0:
             s.active = False
 
+    def _begin_prefill(self, slot_idx: int, seq_id: int, prompt: np.ndarray,
+                       max_new: int, temperature: float,
+                       submitted_at: float) -> None:
+        # chunked admit: reserve the slot, no device work yet — chunks
+        # advance in serve_step, interleaved with decode
+        self._validate(prompt, max_new)
+        s = self._slots[slot_idx]
+        if max_new <= 0:
+            s.seq_id, s.active, s.tokens, s.remaining = seq_id, False, [], 0
+            s.prompt = None
+            return
+        s.seq_id, s.active = seq_id, False
+        s.tokens, s.remaining = [], max_new
+        s.prompt, s.prefill_pos = prompt, 0
+        s.temperature = temperature
+        s.submitted_at = submitted_at
+        # park the slot's step-write position on the LAST cache row for
+        # the duration of the prefill: the step program writes K/V for
+        # every slot each iteration (static shapes), and without parking
+        # that garbage would land inside rows a chunk already filled.
+        # Row max_seq-1 is always safe — any sequence that ever attends
+        # it writes it first (decode writes row p before reading it)
+        self.pos = self.pos.at[slot_idx].set(self.max_seq - 1)
+
+    def _activate(self, slot_idx: int) -> None:
+        # prompt rows [0, plen-1) are cached; hand the LAST prompt token
+        # to the step program, which writes row plen-1 and emits the
+        # first generated token alongside every other active slot
+        s = self._slots[slot_idx]
+        plen = int(s.prompt.shape[0])
+        base_key = jax.random.fold_in(self._root_key, s.seq_id)
+        self._temps = self._temps.at[slot_idx].set(s.temperature)
+        self._base_keys = self._base_keys.at[slot_idx].set(base_key)
+        self._last_tokens = self._last_tokens.at[slot_idx].set(
+            int(s.prompt[plen - 1])
+        )
+        self.pos = self.pos.at[slot_idx].set(plen - 1)
+        s.active = True
+        s.prompt = None
+
+    def _advance_prefill(self) -> None:
+        """One chunk program covering EVERY prefilling slot, then activate
+        the slots whose prompts are fully cached."""
+        pref = [
+            i for i, s in enumerate(self._slots)
+            if s.seq_id >= 0 and s.prompt is not None
+        ]
+        if not pref:
+            return
+        C = self.prefill_chunk
+        tokens = np.zeros((self.slots, C), np.int32)
+        cpos = np.zeros((self.slots,), np.int32)
+        mask = np.zeros((self.slots,), bool)
+        ends = {}
+        any_rows = False
+        for i in pref:
+            s = self._slots[i]
+            plen = int(s.prompt.shape[0])
+            start = s.prefill_pos
+            end = min(start + C, plen - 1)
+            ends[i] = end
+            if end > start:
+                tokens[i, : end - start] = s.prompt[start:end]
+                cpos[i] = start
+                mask[i] = True
+                any_rows = True
+        if any_rows:
+            self.caches = self._chunk(
+                self.params, self.caches, jnp.asarray(tokens),
+                jnp.asarray(cpos), jnp.asarray(mask),
+            )
+            self.stats["prefill_chunks"] += int(mask.sum())
+            if self.metrics is not None:
+                self.metrics.inc(
+                    "serve_prefill_chunks_total", float(mask.sum())
+                )
+        for i in pref:
+            s = self._slots[i]
+            s.prefill_pos = ends[i]
+            if s.prefill_pos >= int(s.prompt.shape[0]) - 1:
+                self._activate(i)
+
     # -- incremental serving API (the gateway's replica loop) --------------
     def submit(self, seq_id: int, prompt: np.ndarray, max_new: int,
-               temperature: float = 0.0) -> None:
+               temperature: float = 0.0,
+               session_id: Optional[str] = None) -> None:
         """Queue one request (seq_id must be a fresh non-negative int).
         Validates shape limits eagerly so a malformed request fails at
         submission, never mid-serve-loop where it would take down the
-        whole batch."""
+        whole batch.  ``session_id`` is the gateway's session/prefix key;
+        the dense batcher records it for operators but shares no state —
+        prefix reuse lives in the paged batcher (content-addressed, so
+        the key itself is advisory there too)."""
         if seq_id < 0:
             raise ValueError(f"seq_id must be >= 0, got {seq_id}")
         prompt = np.asarray(prompt, np.int32)
-        plen = int(prompt.shape[0])
-        if plen > self.prompt_pad:
-            raise ValueError(
-                f"prompt length {plen} exceeds prompt_pad {self.prompt_pad}"
-            )
-        if plen + max_new > self.max_seq:
-            raise ValueError(
-                f"prompt {plen} + max_new {max_new} exceeds max_seq "
-                f"{self.max_seq}"
-            )
-        self._pending.append((seq_id, prompt, max_new, temperature))
+        self._validate(prompt, max_new)
+        self._pending.append(
+            (seq_id, prompt, max_new, temperature, time.monotonic())
+        )
 
     def cancel(self, seq_id: int) -> bool:
         """Withdraw a request: drop it from the pending queue, or free its
-        slot mid-decode (the slot's cache rows are dead weight until the
-        next admit overwrites them).  Returns False if the request is
-        unknown — already retired, or never submitted."""
+        slot mid-decode or mid-prefill (the slot's cache rows are dead
+        weight until the next admit overwrites them).  Returns False if
+        the request is unknown — already retired, or never submitted."""
         for i, item in enumerate(self._pending):
             if item[0] == seq_id:
                 del self._pending[i]
@@ -250,6 +463,7 @@ class ContinuousBatcher:
         for s in self._slots:
             if s.seq_id == seq_id:
                 s.seq_id, s.active, s.tokens, s.remaining = -1, False, [], 0
+                s.prompt = None
                 return True
         return False
 
@@ -265,23 +479,32 @@ class ContinuousBatcher:
         while progress:
             progress = False
             for i, s in enumerate(self._slots):
-                if s.seq_id >= 0 and not s.active:
+                if s.seq_id >= 0 and not s.active and s.prompt is None:
                     finished[s.seq_id] = s.tokens
                     s.seq_id = -1
                     progress = True
                 if s.seq_id < 0 and self._pending:
-                    seq_id, prompt, max_new, temp = self._pending.popleft()
-                    self._admit_one(i, seq_id, prompt, max_new, temp)
+                    seq_id, prompt, max_new, temp, t0 = (
+                        self._pending.popleft()
+                    )
+                    if self.prefill_chunk is None:
+                        self._admit_one(i, seq_id, prompt, max_new, temp, t0)
+                    else:
+                        self._begin_prefill(
+                            i, seq_id, prompt, max_new, temp, t0
+                        )
                     self.stats["admits"] += 1
                     progress = True
 
     def serve_step(self) -> Dict[int, List[int]]:
         """One serving iteration: retire finished slots, admit from the
-        pending queue, run ONE decode step if anything is active, retire
-        again.  Returns the requests that finished this call
-        ({seq_id: generated tokens})."""
+        pending queue, advance every prefilling slot by ONE chunk, run
+        ONE decode step if anything is active, retire again.  Returns the
+        requests that finished this call ({seq_id: generated tokens})."""
         finished: Dict[int, List[int]] = {}
         self._sweep(finished)
+        if self.prefill_chunk is not None:
+            self._advance_prefill()
         if any(s.active for s in self._slots):
             counts = np.array(
                 [len(s.tokens) for s in self._slots], np.int32
@@ -303,8 +526,10 @@ class ContinuousBatcher:
                 if not s.active:
                     continue
                 t = int(toks_host[i])
+                first = not s.tokens
                 s.tokens.append(t)
                 s.remaining -= 1
+                _observe_emit(self.metrics, s, first=first)
                 if s.remaining <= 0 or (
                     self.eos_id is not None and t == self.eos_id
                 ):
@@ -328,14 +553,14 @@ class ContinuousBatcher:
         assert len(prompts) == len(max_new_tokens)
         temps = temperatures or [0.0] * len(prompts)
         assert len(temps) == len(prompts)
-        self.stats = {"steps": 0, "admits": 0}
+        self._reset_stats()
         for i, (p, m, t) in enumerate(zip(prompts, max_new_tokens, temps)):
             self.submit(i, np.asarray(p), m, t)
         done: Dict[int, List[int]] = {}
         done.update(self.serve_step())
-        while any(s.active for s in self._slots):
+        while self.has_work():
             done.update(self.serve_step())
         # every slot is retired here: serve_step sweeps unconditionally
-        # after each decode step, so the loop cannot exit with a
-        # finished-but-unretired slot
+        # after each decode step (and has_work covers slots still mid-
+        # prefill), so the loop cannot exit with work outstanding
         return done

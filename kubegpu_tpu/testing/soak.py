@@ -413,9 +413,16 @@ class GatewaySoak:
     request bursts (mixed tenants/sessions, occasionally overflowing the
     bounded queue so explicit backpressure is exercised), replica death
     mid-flight (process + chips, via the advertiser cycle), revival,
-    and straggler injection that provokes hedged dispatch."""
+    and straggler injection that provokes hedged dispatch.
 
-    def __init__(self, seed: int, n_replicas: int = 4):
+    ``batcher_factory`` swaps the per-replica data plane (default
+    SimBatcher).  A factory returning real paged batchers extends I5
+    with the page-accounting invariant: any surviving batcher exposing
+    ``assert_page_accounting`` is checked at quiescence — the kill/
+    revive/hedge-cancel schedule must never leak KV pool pages."""
+
+    def __init__(self, seed: int, n_replicas: int = 4,
+                 batcher_factory=None):
         from kubegpu_tpu.gateway import (
             AdmissionQueue, FailoverPolicy, Gateway, InMemoryReplicaClient,
             SimBatcher,
@@ -432,7 +439,8 @@ class GatewaySoak:
         self.sched = stack.sched
         self.registry = stack.registry
         self.client = InMemoryReplicaClient(
-            batcher_factory=lambda key: SimBatcher(slots=8),
+            batcher_factory=batcher_factory
+            or (lambda key: SimBatcher(slots=8)),
             step_delay_s=0.001,
         )
         self.registry.subscribe(self.client.sync_live)
@@ -550,6 +558,16 @@ class GatewaySoak:
             f"I5 not quiescent: depth={self.gw.queue.depth()} "
             f"in_flight={self.gw.in_flight()}\n{trace}"
         )
+        # page-accounting invariant: at quiescence every surviving
+        # replica's KV pool must balance — no page leaked by a kill,
+        # cancel, or hedge loser anywhere in the schedule (duck-typed:
+        # SimBatcher has no pool, paged batchers do)
+        with self.client._lock:
+            workers = list(self.client._workers.values())
+        for w in workers:
+            check = getattr(w.batcher, "assert_page_accounting", None)
+            if check is not None:
+                check()
 
     def quiesce(self, timeout: float = 120.0):
         """Restore all hardware, then wait out the in-flight work."""

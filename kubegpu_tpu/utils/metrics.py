@@ -66,6 +66,22 @@ class Metrics:
         with self._lock:
             return self._counters.get(key, 0.0)
 
+    def quantile(self, name: str, q: float) -> float:
+        """Reservoir quantile of a histogram (0.0 if never observed) —
+        the programmatic twin of the exposition lines, for bench rows
+        and tests that assert on latency percentiles."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None or not h.recent:
+                return 0.0
+            s = sorted(h.recent)
+            return s[min(len(s) - 1, int(q * len(s)))]
+
+    def histogram_count(self, name: str) -> int:
+        with self._lock:
+            h = self._histograms.get(name)
+            return h.count if h is not None else 0
+
     def render(self) -> str:
         """Prometheus text exposition."""
         out: List[str] = []

@@ -135,20 +135,64 @@ def test_least_outstanding_mesh_distance_tiebreak():
 
 
 def test_session_affinity_sticky_then_same_slice_failover():
-    router = SessionAffinityRouter()
+    m = Metrics()
+    router = SessionAffinityRouter(metrics=m)
     reps = [replica("a1", "sa"), replica("a2", "sa"), replica("b1", "sb")]
     first = router.pick(req(session="s1"), reps, {})
     for load in ({first.key: 5}, {first.key: 9}):
         again = router.pick(req(session="s1"), reps, load)
         assert again.key == first.key  # sticky even when loaded
+    # the initial pin is NOT a re-pin: no KV was lost
+    assert m.get("gateway_session_repin_total") == 0
     # pinned replica drains: replacement prefers the SAME slice (KV
-    # locality), and the session re-pins to it
+    # locality), the session re-pins to it, and the KV-loss event is
+    # counted — prefix_hit_tokens on the new replica start from zero
     survivors = [r for r in reps if r.key != first.key]
     moved = router.pick(req(session="s1"), survivors, {})
     assert moved.slice_id == first.slice_id
+    assert m.get("gateway_session_repin_total") == 1
     assert router.pick(req(session="s1"), survivors, {}).key == moved.key
+    assert m.get("gateway_session_repin_total") == 1  # sticky != re-pin
     # no session → pure fallback
     assert router.pick(req(), reps, {"a1": 1, "a2": 0, "b1": 1}).key == "a2"
+
+
+def test_session_repin_counts_exclusion_reroutes_too():
+    """A hedge/retry exclude set that forces a pinned session elsewhere
+    is the same KV-loss event as a death — counted identically."""
+    m = Metrics()
+    router = SessionAffinityRouter(metrics=m)
+    reps = [replica("a1", "sa"), replica("a2", "sa")]
+    first = router.pick(req(session="s2"), reps, {})
+    rerouted = router.pick(
+        req(session="s2"), reps, {}, exclude=frozenset({first.key})
+    )
+    assert rerouted.key != first.key
+    assert m.get("gateway_session_repin_total") == 1
+
+
+def test_gateway_wires_metrics_into_router():
+    """A SessionAffinityRouter handed to Gateway without its own
+    registry reports re-pins into the gateway's /metrics registry."""
+    c = make_serving_cluster(1)
+    client = InMemoryReplicaClient(batcher_factory=lambda k: SimBatcher())
+    m = Metrics()
+    router = SessionAffinityRouter()
+    gw = Gateway(c.registry, client, router=router, metrics=m, dispatchers=0)
+    try:
+        assert router.metrics is m
+        own = Metrics()
+        router2 = SessionAffinityRouter(metrics=own)
+        gw2 = Gateway(
+            c.registry, client, router=router2, metrics=m, dispatchers=0
+        )
+        try:
+            assert router2.metrics is own  # explicit registry wins
+        finally:
+            gw2.stop()
+    finally:
+        gw.stop()
+        client.stop()
 
 
 # ---------------------------------------------------------------------------
@@ -507,6 +551,67 @@ def test_gateway_http_server_end_to_end():
         client.stop()
 
 
+def test_readyz_tracks_live_replicas_not_hardcoded():
+    """/readyz with a wired data plane: 200 while >=1 live replica, 503
+    with "no live replicas" once the registry drains to zero, 200 again
+    on revival — readiness is the registry's live set, not a hardcode."""
+    import http.client
+
+    c = make_serving_cluster(1)
+    client = InMemoryReplicaClient(batcher_factory=lambda k: SimBatcher())
+    c.registry.subscribe(client.sync_live)
+    gw = Gateway(c.registry, client, metrics=Metrics(), dispatchers=1)
+    server = GatewayServer(gw, listen=("127.0.0.1", 0), watch=False)
+    server.start()
+    host, port = server.address
+    try:
+        def readyz():
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            conn.request("GET", "/readyz")
+            resp = conn.getresponse()
+            raw = resp.read()
+            conn.close()
+            return resp.status, raw.decode()
+
+        assert readyz() == (200, "ok")
+        victim = c.registry.live()[0]
+        kill_replica(c, victim)
+        status, body = readyz()
+        assert status == 503 and "no live replicas" in body
+        for coords in victim.coords:
+            c.slices[victim.slice_id].revive_chip(coords)
+        advertise_all(c)
+        c.registry.refresh()
+        assert readyz() == (200, "ok")
+    finally:
+        server.stop()
+        client.stop()
+
+
+def test_readyz_503_when_data_plane_unwired():
+    """A client that can reach nothing (no workers, no factory) keeps
+    /readyz at 503 however many replicas the registry sees — the
+    default in-cluster posture (no --sim-data-plane)."""
+    import http.client
+
+    c = make_serving_cluster(1)
+    client = InMemoryReplicaClient(batcher_factory=None)
+    gw = Gateway(c.registry, client, metrics=Metrics(), dispatchers=0)
+    server = GatewayServer(gw, listen=("127.0.0.1", 0), watch=False)
+    server.start()
+    host, port = server.address
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.request("GET", "/readyz")
+        resp = conn.getresponse()
+        assert resp.status == 503
+        assert b"data plane" in resp.read()
+        conn.close()
+    finally:
+        server.stop()
+        client.stop()
+
+
 def test_gateway_http_429_on_backpressure():
     import http.client
     import json
@@ -650,14 +755,16 @@ def test_e2e_real_continuous_batcher_matches_greedy_oracle():
         expected[i] = list(np.asarray(out)[0, len(p):])
 
     c = make_serving_cluster(2)
+    m = Metrics()  # ONE registry: gateway metrics + replica serve_* rows
     client = InMemoryReplicaClient(
         batcher_factory=lambda key: ContinuousBatcher(
-            params, slots=2, prompt_pad=8, dtype=jnp.float32, **cfg
+            params, slots=2, prompt_pad=8, dtype=jnp.float32, metrics=m,
+            **cfg
         )
     )
     c.registry.subscribe(client.sync_live)
     gw = Gateway(
-        c.registry, client, metrics=Metrics(), dispatchers=4,
+        c.registry, client, metrics=m, dispatchers=4,
         policy=FailoverPolicy(deadline_s=120.0, hedge_after_s=600.0),
     )
     c.registry.refresh()
@@ -680,6 +787,14 @@ def test_e2e_real_continuous_batcher_matches_greedy_oracle():
             )
         served = {p.result().replica for p in pendings}
         assert served  # at least one replica served; both usually did
+        # data-plane latency flows through the SAME exposition the
+        # gateway serves at /metrics: TTFT/ITL histograms and the
+        # prefill-chunk counter sit next to gateway_requests_total
+        text = m.render()
+        assert "serve_ttft_seconds_count" in text
+        assert "serve_itl_seconds_count" in text
+        assert m.get("serve_prefill_chunks_total") > 0
+        assert m.histogram_count("serve_ttft_seconds") == len(prompts)
     finally:
         gw.stop()
         client.stop()

@@ -1,0 +1,303 @@
+"""Chunked prefill + paged prefix cache: token-identity and page
+accounting.
+
+The serving hot path's two new mechanisms must be INVISIBLE in the
+output: chunked prefill (prompt sliced into fixed chunks interleaved
+with decode) and prefix-cache page sharing (content-addressed K/V reuse)
+each reproduce the monolithic-prefill greedy tokens exactly, for prompt
+lengths straddling every chunk/page boundary.  And the pool must balance
+— refcounts back to zero, every page free/cached/live — after any mix of
+finishes and cancels, including the GatewaySoak kill schedule."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubegpu_tpu.models import TransformerLM, greedy_generate
+from kubegpu_tpu.models.paging import PagedContinuousBatcher
+from kubegpu_tpu.models.serving import ContinuousBatcher
+from kubegpu_tpu.utils.metrics import Metrics
+
+pytestmark = pytest.mark.slow
+
+CFG = dict(vocab_size=61, num_layers=2, num_heads=4, hidden=32, max_seq=32)
+
+
+def trained_params():
+    model = TransformerLM(dtype=jnp.float32, **CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.ones((2, 8), jnp.int32))[
+        "params"
+    ]
+
+
+def oracle(params, prompt, n):
+    out = greedy_generate(
+        params, jnp.asarray(prompt)[None, :], n, dtype=jnp.float32, **CFG
+    )
+    return list(np.asarray(out)[0, len(prompt):])
+
+
+# ---------------------------------------------------------------------------
+# Chunked prefill: token-identical to monolithic across chunk boundaries
+# ---------------------------------------------------------------------------
+
+def test_chunked_prefill_token_identical_across_boundaries():
+    """Greedy, fixed seed: every prompt length straddling the chunk
+    boundary (below, at, just past, multiple chunks, partial tail) must
+    produce EXACTLY the monolithic-prefill tokens — and the per-sequence
+    greedy oracle's."""
+    params = trained_params()
+    rng = np.random.RandomState(0)
+    chunk = 4
+    # lengths 1..9 straddle chunk=4 at 3/4/5 and 2*chunk at 7/8/9
+    prompts = [
+        np.array(rng.randint(0, CFG["vocab_size"], size=n), np.int32)
+        for n in (1, 2, 3, 4, 5, 7, 8, 9)
+    ]
+    budgets = [5, 4, 6, 3, 5, 4, 6, 5]
+    expected = {
+        i: oracle(params, p, n)
+        for i, (p, n) in enumerate(zip(prompts, budgets))
+    }
+    mono = ContinuousBatcher(
+        params, slots=3, prompt_pad=16, prefill_chunk=None,
+        dtype=jnp.float32, **CFG,
+    ).run(prompts, budgets)
+    assert mono == expected
+    cb = ContinuousBatcher(
+        params, slots=3, prompt_pad=16, prefill_chunk=chunk,
+        dtype=jnp.float32, **CFG,
+    )
+    got = cb.run(prompts, budgets)
+    assert got == expected, {
+        i: (got[i], expected[i]) for i in expected if got[i] != expected[i]
+    }
+    # the chunk count proves chunking actually happened: sum over
+    # prompts of ceil((plen-1)/chunk)
+    want_chunks = sum(-(-(len(p) - 1) // chunk) for p in prompts)
+    assert cb.stats["prefill_chunks"] == want_chunks
+
+
+def test_chunked_prefill_bounds_work_per_step():
+    """A long prompt admitted while another sequence decodes adds at
+    most ONE chunk of prefill per serving iteration — the running
+    sequence keeps emitting every step (the ITL bound chunking buys)."""
+    params = trained_params()
+    rng = np.random.RandomState(3)
+    runner = np.array(rng.randint(0, CFG["vocab_size"], size=2), np.int32)
+    longp = np.array(rng.randint(0, CFG["vocab_size"], size=16), np.int32)
+    cb = ContinuousBatcher(
+        params, slots=2, prompt_pad=16, prefill_chunk=4,
+        dtype=jnp.float32, **CFG,
+    )
+    cb.submit(0, runner, 12)
+    cb.serve_step()  # runner active, one token out
+    assert len(cb._slots[0].tokens) == 1
+    cb.submit(1, longp, 4, session_id="s1")
+    emitted = [len(cb._slots[0].tokens)]
+    done = {}
+    while cb.has_work():
+        done.update(cb.serve_step())
+        emitted.append(len(cb._slots[0].tokens))
+    # the runner emitted on EVERY iteration until it finished (no
+    # multi-step stall while the 16-token prompt prefilled in chunks)
+    deltas = [b - a for a, b in zip(emitted, emitted[1:]) if a < 12]
+    assert all(d == 1 for d in deltas), deltas
+    assert done[0] == oracle(params, runner, 12)
+    assert done[1] == oracle(params, longp, 4)
+
+
+def test_chunked_prefill_validates_chunk_size():
+    params = trained_params()
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        ContinuousBatcher(
+            params, slots=1, prompt_pad=8, prefill_chunk=0,
+            dtype=jnp.float32, **CFG,
+        )
+    with pytest.raises(ValueError, match="multiple of page_size"):
+        PagedContinuousBatcher(
+            params, slots=1, prompt_pad=8, page_size=4, prefill_chunk=6,
+            dtype=jnp.float32, **CFG,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Paged prefix cache: sharing is invisible in the tokens
+# ---------------------------------------------------------------------------
+
+def make_paged(params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("prompt_pad", 20)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("pool_pages", 24)
+    return PagedContinuousBatcher(params, dtype=jnp.float32, **CFG, **kw)
+
+
+def test_prefix_cache_two_turn_session_token_identical():
+    """The two-turn conversation shape: turn 2's prompt extends turn 1's.
+    Turn 2 must hit the cached prefix pages (prefix_hit_tokens > 0) and
+    still emit exactly the tokens a cache-less batcher emits — for
+    second-turn lengths straddling the page boundary."""
+    params = trained_params()
+    rng = np.random.RandomState(1)
+    turn1 = np.array(rng.randint(0, CFG["vocab_size"], size=9), np.int32)
+    cb = make_paged(params)
+    out1 = cb.run([turn1], [4])[0]
+    assert out1 == oracle(params, turn1, 4)
+    assert len(cb.prefix_cache) == 2  # (9-1)//4 full pages registered
+    for extra in (1, 3, 4):  # extensions straddling the page boundary
+        turn2 = np.concatenate([
+            turn1, np.asarray(out1, np.int32),
+            np.array(rng.randint(0, CFG["vocab_size"], size=extra), np.int32),
+        ])
+        expected = oracle(params, turn2, 5)
+        cold = make_paged(params, prefix_cache=False)
+        assert cold.run([turn2], [5])[0] == expected
+        got = cb.run([turn2], [5])[0]  # run() resets stats per call
+        assert got == expected, (extra, got, expected)
+        assert cb.stats["prefix_hit_tokens"] >= 8, (
+            "turn 2 did not reuse turn 1's prompt pages"
+        )
+        cb.assert_page_accounting()
+
+
+def test_prefix_cache_concurrent_shared_system_prompt():
+    """Two live requests sharing a system-prompt prefix share physical
+    pages (refcount 2 while both run), diverge after it, and both match
+    their oracles; the pool balances afterwards."""
+    params = trained_params()
+    rng = np.random.RandomState(2)
+    system = np.array(rng.randint(0, CFG["vocab_size"], size=8), np.int32)
+    a = np.concatenate([system, np.array([3, 7], np.int32)])
+    b = np.concatenate([system, np.array([11, 5, 2], np.int32)])
+    cb = make_paged(params)
+    got = cb.run([a, b], [5, 6])
+    assert got[0] == oracle(params, a, 5)
+    assert got[1] == oracle(params, b, 6)
+    # the 2 full system pages were computed once and shared
+    assert cb.stats["prefix_hit_tokens"] >= 8
+    cb.assert_page_accounting()
+    assert all(
+        cb.prefix_cache.refcount(p) == 0 for p in cb.prefix_cache.pages()
+    )
+
+
+def test_prefix_cache_lru_eviction_recomputes_correctly():
+    """Pool pressure evicts idle cached pages LRU; a later request whose
+    prefix was evicted recomputes it and still matches the oracle."""
+    params = trained_params()
+    rng = np.random.RandomState(4)
+    prompts = [
+        np.array(rng.randint(0, CFG["vocab_size"], size=9), np.int32)
+        for _ in range(4)
+    ]
+    # pool with room for ~one live request + a couple cached pages:
+    # each needs ceil((9+4)/4) = 4 pages
+    cb = make_paged(params, slots=1, pool_pages=7)
+    exp = {i: oracle(params, p, 4) for i, p in enumerate(prompts)}
+    got = cb.run(prompts, [4, 4, 4, 4])
+    assert got == exp
+    cb.assert_page_accounting()
+    # re-serve prompt 0 (its cache entries were evicted by later admits):
+    # recompute, same tokens
+    assert cb.run([prompts[0]], [4])[0] == exp[0]
+    cb.assert_page_accounting()
+
+
+def test_page_refcounts_zero_after_random_cancel_finish_schedule():
+    """Property: a seeded random schedule of submit / serve / cancel
+    (queued, mid-prefill, mid-decode) leaves the pool balanced — every
+    page free, cached-idle, or provably-live, and every refcount equal
+    to its live holders; after draining, refcounts are all zero."""
+    params = trained_params()
+    rng = np.random.RandomState(5)
+    cb = make_paged(params, slots=3, pool_pages=16)
+    seq = 0
+    live = []
+    for _ in range(60):
+        roll = rng.rand()
+        if roll < 0.45:
+            n = rng.randint(1, 13)
+            prompt = np.array(
+                rng.randint(0, CFG["vocab_size"], size=n), np.int32
+            )
+            max_new = int(rng.randint(1, 5))
+            if n + max_new <= CFG["max_seq"] and n <= cb.prompt_pad:
+                cb.submit(seq, prompt, max_new)
+                live.append(seq)
+                seq += 1
+        elif roll < 0.65 and live:
+            victim = live.pop(rng.randint(len(live)))
+            cb.cancel(victim)
+        else:
+            done = cb.serve_step()
+            for s in done:
+                live.remove(s)
+        cb.assert_page_accounting()
+    while cb.has_work():
+        for s in cb.serve_step():
+            live.remove(s)
+    cb.assert_page_accounting()
+    assert all(
+        cb.prefix_cache.refcount(p) == 0 for p in cb.prefix_cache.pages()
+    )
+    assert not live
+
+
+def test_gateway_soak_kill_schedule_no_page_leaks():
+    """The GatewaySoak kill/revive/hedge schedule over REAL paged
+    batchers: invariant I5 plus page accounting on every surviving
+    replica at quiescence (the soak's check calls
+    assert_page_accounting on any batcher exposing it)."""
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    tiny = dict(vocab_size=61, num_layers=1, num_heads=2, hidden=16,
+                max_seq=16)
+    params = TransformerLM(dtype=jnp.float32, **tiny).init(
+        jax.random.PRNGKey(1), jnp.ones((1, 4), jnp.int32)
+    )["params"]
+    soak = GatewaySoak(
+        seed=11, n_replicas=2,
+        batcher_factory=lambda key: PagedContinuousBatcher(
+            params, slots=4, prompt_pad=4, page_size=4, pool_pages=20,
+            dtype=jnp.float32, **tiny,
+        ),
+    )
+    soak.run(steps=18)
+
+
+# ---------------------------------------------------------------------------
+# Serving metrics flow through utils.metrics
+# ---------------------------------------------------------------------------
+
+def test_serving_metrics_histograms_and_counters():
+    """Both batchers feed serve_ttft/serve_itl histograms and the
+    prefill-chunk / prefix-hit counters into a shared Metrics registry —
+    the same registry a gateway renders at /metrics."""
+    params = trained_params()
+    rng = np.random.RandomState(6)
+    prompts = [
+        np.array(rng.randint(0, CFG["vocab_size"], size=9), np.int32)
+        for _ in range(2)
+    ]
+    m = Metrics()
+    cb = ContinuousBatcher(
+        params, slots=2, prompt_pad=16, prefill_chunk=4,
+        dtype=jnp.float32, metrics=m, **CFG,
+    )
+    cb.run(prompts, [4, 4])
+    assert m.histogram_count("serve_ttft_seconds") == 2
+    assert m.histogram_count("serve_itl_seconds") == 6  # 2 x (4-1)
+    assert m.get("serve_prefill_chunks_total") == 4     # 2 x ceil(8/4)
+    assert m.quantile("serve_itl_seconds", 0.95) >= 0.0
+    pm = Metrics()
+    pb = make_paged(params, metrics=pm)
+    pb.run([prompts[0], prompts[0]], [4, 4])
+    assert pm.histogram_count("serve_ttft_seconds") == 2
+    assert pm.get("serve_prefix_hit_tokens_total") > 0
+    assert pm.get("serve_prompt_tokens_total") == 18
+    text = pm.render()
+    assert "serve_ttft_seconds_count 2" in text
+    assert "serve_prefix_hit_tokens_total" in text
