@@ -39,8 +39,10 @@ bench:
 	$(PY) bench.py
 
 # CPU-only serving-path micro-bench (<60 s): TTFT/ITL p95 with chunked
-# vs monolithic prefill + prefix-cache hit rate on tiny shapes; exits
-# non-zero if chunked ITL regresses past monolithic or hits vanish
+# vs monolithic prefill, prefix-cache hit rate, and burst TTFT p95
+# batched-station vs serial on tiny shapes; exits non-zero if chunked
+# ITL regresses past monolithic, hits vanish, the batched station's
+# burst TTFT is not strictly below serial, or tokens diverge
 bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve-smoke
 

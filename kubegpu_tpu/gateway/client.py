@@ -99,13 +99,26 @@ class SimBatcher:
     *seq* is ``(seq * 31 + i) % vocab``, one token per serve_step per
     active sequence, ``slots`` sequences decode concurrently.  Lets soak
     and scale tests drive thousands of requests through the real gateway
-    machinery in milliseconds."""
+    machinery in milliseconds.
 
-    def __init__(self, slots: int = 8, vocab: int = 256) -> None:
+    ``token_budget`` models the real batchers' token-budget step cap:
+    at most that many sequences advance a token per serve_step, rotated
+    round-robin so none starves (None = every active sequence advances,
+    the historical behavior).  Per-sequence streams stay deterministic
+    either way — token *i* depends only on (seq, i)."""
+
+    def __init__(self, slots: int = 8, vocab: int = 256,
+                 token_budget: Optional[int] = None) -> None:
+        if token_budget is not None and token_budget <= 0:
+            raise ValueError(
+                f"token_budget ({token_budget}) must be positive or None"
+            )
         self.slots = slots
         self.vocab = vocab
+        self.token_budget = token_budget
         self._pending: deque = deque()
         self._active: Dict[int, tuple] = {}  # seq -> (tokens, max_new)
+        self._rr: deque = deque()            # active seqs in budget order
         self.stats = {"steps": 0, "admits": 0}
 
     def submit(self, seq_id: int, prompt, max_new: int,
@@ -122,7 +135,12 @@ class SimBatcher:
             if sid == seq_id:
                 del self._pending[i]
                 return True
-        return self._active.pop(seq_id, None) is not None
+        if self._active.pop(seq_id, None) is None:
+            return False
+        # drop the ring entry too: a stale entry would double-count a
+        # re-submitted seq_id against the budget forever
+        self._rr.remove(seq_id)
+        return True
 
     def has_work(self) -> bool:
         return bool(self._pending) or bool(self._active)
@@ -135,15 +153,31 @@ class SimBatcher:
             if max_new <= 0:
                 finished[seq] = []
             else:
+                # a re-submitted still-active seq restarts its stream but
+                # must NOT gain a second ring entry (double budget draw)
+                if seq not in self._active:
+                    self._rr.append(seq)
                 self._active[seq] = ([], max_new)
         if self._active:
             self.stats["steps"] += 1
-            for seq in list(self._active):
+            n = len(self._active)
+            if self.token_budget is not None:
+                n = min(n, self.token_budget)
+            advanced = 0
+            for _ in range(len(self._rr)):
+                if advanced >= n:
+                    break
+                seq = self._rr.popleft()
+                if seq not in self._active:
+                    continue  # cancelled: drop its stale ring entry
+                advanced += 1
                 tokens, max_new = self._active[seq]
                 tokens.append((seq * 31 + len(tokens)) % self.vocab)
                 if len(tokens) >= max_new:
                     finished[seq] = tokens
                     del self._active[seq]
+                else:
+                    self._rr.append(seq)
         return finished
 
 

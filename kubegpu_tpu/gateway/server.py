@@ -225,7 +225,8 @@ class GatewayServer:
 # CLI
 # ---------------------------------------------------------------------------
 
-def _build_fake_serving_cluster(preset: str, replicas: int, group: str):
+def _build_fake_serving_cluster(preset: str, replicas: int, group: str,
+                                token_budget=None):
     """Fabricated cluster + scheduled decode replicas + SimBatcher-backed
     in-memory data plane: the full serving path with zero dependencies."""
     from kubegpu_tpu.gateway.client import InMemoryReplicaClient, SimBatcher
@@ -247,7 +248,10 @@ def _build_fake_serving_cluster(preset: str, replicas: int, group: str):
     # outstanding counts never build and least-outstanding degenerates to
     # its name tiebreak — the demo should demonstrate load spreading
     client = InMemoryReplicaClient(
-        batcher_factory=lambda key: SimBatcher(slots=8), step_delay_s=0.002
+        batcher_factory=lambda key: SimBatcher(
+            slots=8, token_budget=token_budget
+        ),
+        step_delay_s=0.002,
     )
     registry.subscribe(client.sync_live)
     registry.refresh()
@@ -275,6 +279,14 @@ def main(argv=None) -> None:
         "discovery/metrics only: /readyz stays 503 so the instance "
         "never joins the Service",
     )
+    ap.add_argument(
+        "--token-budget", type=int, default=None,
+        help="per-step token budget for replica batchers: bounds the "
+        "rows (decode tokens + prefill chunk rows) one serving "
+        "iteration processes.  Paged/dense batchers pack multi-"
+        "admission prefill under it; the SimBatcher data planes here "
+        "model it as a per-step advance cap.  Default: unbounded",
+    )
     ap.add_argument("--queue-capacity", type=int, default=256)
     ap.add_argument("--per-tenant-cap", type=int, default=None)
     ap.add_argument("--deadline", type=float, default=30.0,
@@ -285,11 +297,14 @@ def main(argv=None) -> None:
     ap.add_argument("--refresh-interval", type=float, default=10.0)
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
+    if args.token_budget is not None and args.token_budget <= 0:
+        ap.error(f"--token-budget must be positive, got {args.token_budget}")
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
 
     if args.fake_cluster:
         _, registry, client = _build_fake_serving_cluster(
-            args.fake_cluster, args.replicas, args.group
+            args.fake_cluster, args.replicas, args.group,
+            token_budget=args.token_budget,
         )
     else:
         from kubegpu_tpu.utils.apiserver import KubeApiServer
@@ -309,7 +324,9 @@ def main(argv=None) -> None:
             from kubegpu_tpu.gateway.client import SimBatcher
 
             client = InMemoryReplicaClient(
-                batcher_factory=lambda key: SimBatcher(slots=8),
+                batcher_factory=lambda key: SimBatcher(
+                    slots=8, token_budget=args.token_budget
+                ),
                 step_delay_s=0.002,
             )
             registry.subscribe(client.sync_live)

@@ -21,13 +21,18 @@ at bf16, near-tied logits may round to a different argmax than the
 dense path — the same caveat flash-vs-einsum attention carries in
 training.
 - ``PagedContinuousBatcher``: the serving loop.  Prompts prefill
-  CHUNKED through a persistent dense b=1 "station" cache (one page-sized
-  causal chunk per serving iteration, interleaved with decode steps so
-  running sequences' inter-token latency is bounded by one chunk + one
-  step), each completed page scattered into freshly-allocated pool
-  pages.  A sequence reserves exactly ``ceil((prompt+budget)/page)``
-  pages, so pool capacity is sized to the traffic mix, not
-  ``slots x max_seq``.
+  CHUNKED through a persistent dense MULTI-SLOT "station" cache
+  (``station_slots`` concurrent admissions, one page-sized causal chunk
+  each per serving iteration, all packed into ONE batched program
+  invocation, interleaved with decode steps so running sequences'
+  inter-token latency is bounded by one chunk + one step), each
+  completed page scattered into freshly-allocated pool pages.  A
+  ``token_budget`` bounds the rows (decode tokens + prefill chunk rows)
+  one serving iteration may process, so a burst of long prompts
+  overlaps prefill compute without starving decode — the token-budget
+  step-packing discipline of Sarathi/FlexNPU-style schedulers.  A
+  sequence reserves exactly ``ceil((prompt+budget)/page)`` pages, so
+  pool capacity is sized to the traffic mix, not ``slots x max_seq``.
 - ``PrefixPageCache``: a content-hash → physical-page map over the pool.
   Every FULL prompt page (its key: the hash of the whole token prefix
   through that page — K/V of a row depends on every token before it) is
@@ -255,9 +260,10 @@ class _Seq:
 
 @dataclass
 class _PrefillJob:
-    """One in-flight chunked admission through the prefill station."""
+    """One in-flight chunked admission through a prefill-station slot."""
 
-    slot: int
+    slot: int                # sequence slot being fed
+    station: int             # station slot holding this job's dense rows
     seq_id: int
     prompt: np.ndarray
     plen: int
@@ -265,6 +271,7 @@ class _PrefillJob:
     keys: List[bytes]        # chain hashes of sharable full prompt pages
     pos: int                 # prompt rows already prefilled (or cached)
     next_scatter: int        # next page index to scatter from the station
+    started: bool = False    # first chunk ran (prefill-wait observed)
 
 
 class PagedContinuousBatcher:
@@ -278,12 +285,26 @@ class PagedContinuousBatcher:
     request whose worst case exceeds the whole pool is rejected up front.
 
     ``prefill_chunk`` (default: one page) is the prompt rows prefilled
-    per serving iteration, in page-sized device programs; must be a
-    multiple of ``page_size`` so station writes stay page-aligned.
+    PER ADMISSION per serving iteration, in page-sized device programs;
+    must be a multiple of ``page_size`` so station writes stay
+    page-aligned.  ``station_slots`` (default: ``slots``) is how many
+    admissions prefill CONCURRENTLY — each serving iteration advances
+    every in-flight admission one chunk through a single batched,
+    shape-stable station program (``station_slots=1`` reproduces the
+    old serial station, the bench baseline).  ``token_budget`` bounds
+    the total rows one iteration may process (active decode tokens +
+    prefill chunk rows); when the decode batch leaves fewer than one
+    page of budget, one chunk still runs so prefill can never starve.
     ``prefix_cache=False`` disables sharing (every page private).
     ``session_id`` on ``submit`` is advisory — sharing is content-
     addressed, so same-session turns and cross-session shared system
-    prompts both hit without coordination."""
+    prompts both hit without coordination.  An admission whose first
+    cache-MISSED sharable page is being prefilled by an in-flight
+    admission defers, acquiring the pages as that job registers them —
+    same-prefix bursts serialize (computing a shared prefix twice in
+    parallel wastes exactly the compute the cache exists to skip); a
+    prefix the cache already resolves in full admits immediately, and
+    everything else overlaps."""
 
     def __init__(
         self,
@@ -299,6 +320,8 @@ class PagedContinuousBatcher:
         page_size: int = 128,
         pool_pages: int = 64,
         prefill_chunk: Optional[int] = None,
+        station_slots: Optional[int] = None,
+        token_budget: Optional[int] = None,
         prefix_cache: bool = True,
         eos_id: Optional[int] = None,
         dtype=jnp.bfloat16,
@@ -327,6 +350,18 @@ class PagedContinuousBatcher:
             )
         self.prefill_chunk = prefill_chunk
         self._chunks_per_step = prefill_chunk // page_size
+        if station_slots is None:
+            station_slots = slots
+        if station_slots < 1:
+            raise ValueError(
+                f"station_slots ({station_slots}) must be >= 1"
+            )
+        self.station_slots = station_slots
+        if token_budget is not None and token_budget <= 0:
+            raise ValueError(
+                f"token_budget ({token_budget}) must be positive or None"
+            )
+        self.token_budget = token_budget
         self.metrics = metrics
         self.params = params
         self.slots = slots
@@ -374,12 +409,16 @@ class PagedContinuousBatcher:
         self.pos = np.zeros((slots,), np.int32)  # rows already consumed
         self._seqs = [_Seq() for _ in range(slots)]
         self._last = np.zeros((slots,), np.int32)
-        # the prefill station: ONE persistent dense b=1 cache chunked
-        # prompts flow through before their pages scatter into the pool
+        # the prefill station: ONE persistent dense cache with
+        # station_slots rows-of-prompt_pad slots; chunked prompts flow
+        # through their own slot before their pages scatter into the
+        # pool.  _jobs is insertion-ordered (station slot -> job), so
+        # iterating it IS admission order — the FIFO the scheduler packs
+        # chunks in.
         self._station = init_caches(
-            1, num_layers, num_heads, hidden, prompt_pad, dtype
+            station_slots, num_layers, num_heads, hidden, prompt_pad, dtype
         )
-        self._job: Optional[_PrefillJob] = None
+        self._jobs: "OrderedDict[int, _PrefillJob]" = OrderedDict()
         self._pending: deque = deque()
         # prefix keys memoized for the deferred FIFO head (see
         # _try_begin_admit); entries die on admission or cancel
@@ -409,38 +448,65 @@ class PagedContinuousBatcher:
 
         self._step = jax.jit(step, donate_argnums=(1,))
 
-        def chunk(params, station, chunk_row, start):
-            # one page-sized causal chunk through the prefill station:
-            # rows [start, start+page) of the prompt, K/V landing at the
-            # same station rows.  The dense twin's pos-embed table is the
-            # TARGET's, sliced to its shorter max_seq.  start is always
-            # page-aligned and < prompt_pad, so the write never clamps.
+        def chunk(params, station, rows, starts, mask):
+            # one batched page-sized causal chunk across EVERY station
+            # slot: slot i advances rows [starts[i], starts[i]+page) of
+            # its prompt, K/V landing at the same station rows; slots
+            # with mask[i]=False (idle, or parked past their budget)
+            # keep their rows bit-identical via a per-slot masked
+            # slice/where/write-back — the dense batcher's chunk-merge
+            # discipline, so one compile serves every occupancy pattern
+            # and budget remainder.  The dense twin's pos-embed table is
+            # the TARGET's, sliced to its shorter max_seq.  starts are
+            # always page-aligned and < prompt_pad, so writes never
+            # clamp.
             params = {
                 **params,
                 "pos_embed": {
                     "embedding": params["pos_embed"]["embedding"][:prompt_pad]
                 },
             }
-            _, station = self.dense_model.apply(
-                {"params": params}, chunk_row[None, :], station, start
+            _, fresh = self.dense_model.apply(
+                {"params": params}, rows, station, starts
             )
-            return station
+            merged = []
+            for (ok, ov), (nk, nv) in zip(station, fresh):
+                def keep(old, new, p, m):
+                    h_ = old.shape[-2]
+                    hd_ = old.shape[-1]
+                    prev = jax.lax.dynamic_slice(
+                        old, (p, 0, 0), (page_size, h_, hd_)
+                    )
+                    upd = jax.lax.dynamic_slice(
+                        new, (p, 0, 0), (page_size, h_, hd_)
+                    )
+                    return jax.lax.dynamic_update_slice(
+                        old, jnp.where(m, upd, prev), (p, 0, 0)
+                    )
+
+                merge = jax.vmap(keep)
+                merged.append((
+                    merge(ok, nk, starts, mask),
+                    merge(ov, nv, starts, mask),
+                ))
+            return merged
 
         self._chunk = jax.jit(chunk, donate_argnums=(1,))
 
-        def write_page(pools, station, phys, row):
-            # scatter ONE completed station page (rows [row, row+page))
-            # into pool page `phys`; traced scalars, so one compile
-            # serves every page of every admission
+        def write_page(pools, station, slot, phys, row):
+            # scatter ONE completed station page (slot's rows
+            # [row, row+page)) into pool page `phys`; traced scalars, so
+            # one compile serves every page of every station slot of
+            # every admission
             out = []
             for (kp, vp), (ck, cv) in zip(pools, station):
                 h = kp.shape[1]
                 hd = kp.shape[3]
                 rk = jax.lax.dynamic_slice(
-                    ck, (0, row, 0, 0), (1, page_size, h, hd)
+                    ck, (slot, row, 0, 0), (1, page_size, h, hd)
                 )[0]
                 rv = jax.lax.dynamic_slice(
-                    cv, (0, row, 0, 0), (1, page_size, h, hd)
+                    cv, (slot, row, 0, 0), (1, page_size, h, hd)
                 )[0]
                 kp = kp.at[phys].set(jnp.moveaxis(rk, 0, 1))
                 vp = vp.at[phys].set(jnp.moveaxis(rv, 0, 1))
@@ -449,16 +515,17 @@ class PagedContinuousBatcher:
 
         self._write_page = jax.jit(write_page, donate_argnums=(0,))
 
-        def gather_page(station, pools, phys, row):
+        def gather_page(station, pools, slot, phys, row):
             # the reverse copy: a prefix-cache HIT page streamed back
-            # into the station so later chunks can attend its rows —
-            # bit-identical bytes, no recompute (the COW "copy")
+            # into the admission's station slot so later chunks can
+            # attend its rows — bit-identical bytes, no recompute (the
+            # COW "copy")
             out = []
             for (ck, cv), (kp, vp) in zip(station, pools):
                 rk = jnp.moveaxis(kp[phys], 0, 1)[None]
                 rv = jnp.moveaxis(vp[phys], 0, 1)[None]
-                ck = jax.lax.dynamic_update_slice(ck, rk, (0, row, 0, 0))
-                cv = jax.lax.dynamic_update_slice(cv, rv, (0, row, 0, 0))
+                ck = jax.lax.dynamic_update_slice(ck, rk, (slot, row, 0, 0))
+                cv = jax.lax.dynamic_update_slice(cv, rv, (slot, row, 0, 0))
                 out.append((ck, cv))
             return out
 
@@ -563,8 +630,9 @@ class PagedContinuousBatcher:
                          max_new: int, temperature: float,
                          submitted_at: float) -> bool:
         """Reserve pages (prefix-cache hits first), gather hit pages into
-        the station, and open the prefill job.  Returns False to defer
-        (pool pressure) with no state changed."""
+        a free station slot, and open the prefill job.  Returns False to
+        defer (pool pressure, or an in-flight admission is already
+        prefilling this prompt's shared prefix) with no state changed."""
         plen = self._validate(prompt, max_new)  # max_new > 0: _sweep
         s = self._seqs[slot]                    # handles zero-budget admits
         need = self._pages_for(plen, max_new)
@@ -595,9 +663,22 @@ class PagedContinuousBatcher:
                 if page is None:
                     break
                 hits.append(page)
+            # in-flight prefix serialization: if the first page the
+            # cache MISSED is mid-prefill by another admission, wait
+            # (its sharable pages register as each chunk scatters)
+            # instead of computing the same prefix twice in parallel —
+            # then the probe above hits those pages.  Probing first
+            # means a prefix the cache already resolves in full never
+            # defers: nothing would be recomputed, so holding the FIFO
+            # head behind the in-flight job would be a pure stall.
+            if len(hits) < len(keys):
+                missed = keys[len(hits)]
+                if any(missed in j.keys for j in self._jobs.values()):
+                    return False
         if need - len(hits) > self._available_pages(set(hits)):
             return False  # defer until retirements/evictions free pages
         self._pending_keys.pop(seq_id, None)
+        station = min(set(range(self.station_slots)) - set(self._jobs))
         for j, key in enumerate(keys[: len(hits)]):
             acquired = self.prefix_cache.acquire(key)
             assert acquired == hits[j]
@@ -622,12 +703,12 @@ class PagedContinuousBatcher:
         if hit_rows < plen - 1:
             for j in range(len(hits)):
                 self._station = self._gather_page(
-                    self._station, self.pools, jnp.int32(hits[j]),
-                    jnp.int32(j * self.page),
+                    self._station, self.pools, jnp.int32(station),
+                    jnp.int32(hits[j]), jnp.int32(j * self.page),
                 )
-        self._job = _PrefillJob(
-            slot=slot, seq_id=seq_id, prompt=prompt, plen=plen,
-            temperature=temperature, keys=keys,
+        self._jobs[station] = _PrefillJob(
+            slot=slot, station=station, seq_id=seq_id, prompt=prompt,
+            plen=plen, temperature=temperature, keys=keys,
             pos=hit_rows, next_scatter=len(hits),
         )
         self.stats["admits"] += 1
@@ -648,8 +729,8 @@ class PagedContinuousBatcher:
                 break
             phys = s.pages[j]
             self.pools = self._write_page(
-                self.pools, self._station, jnp.int32(phys),
-                jnp.int32(j * self.page),
+                self.pools, self._station, jnp.int32(job.station),
+                jnp.int32(phys), jnp.int32(j * self.page),
             )
             if (
                 self.prefix_cache is not None
@@ -676,30 +757,82 @@ class PagedContinuousBatcher:
         self._last[slot] = int(job.prompt[job.plen - 1])
         s.prefilling, s.active = False, True
 
-    def _advance_prefill(self) -> None:
-        job = self._job
-        if job is None:
-            return
-        for _ in range(self._chunks_per_step):
-            start = job.pos
-            end = min(start + self.page, job.plen - 1)
-            if end <= start:
-                break
-            row = np.zeros((self.page,), np.int32)
-            row[: end - start] = job.prompt[start:end]
-            self._station = self._chunk(
-                self.params, self._station, jnp.asarray(row),
-                jnp.int32(start),
+    def _observe_prefill_wait(self, job: _PrefillJob) -> None:
+        if self.metrics is not None:
+            self.metrics.observe(
+                "serve_prefill_wait_seconds",
+                time.monotonic() - self._seqs[job.slot].submitted_at,
             )
-            job.pos = end
-            self.stats["prefill_chunks"] += 1
-            if self.metrics is not None:
-                self.metrics.inc("serve_prefill_chunks_total")
-            self._scatter_ready_pages(job)
-        if job.pos >= job.plen - 1:
+
+    def _advance_prefill(self) -> None:
+        """The token-budget step packer: one batched station program per
+        round, each round advancing every in-flight admission (FIFO
+        order) one page-sized chunk, up to ``prefill_chunk`` rows per
+        admission and ``token_budget`` total rows (decode tokens
+        included) per serving iteration.  Slots past the budget park via
+        the program's mask — shapes never change, so occupancy and
+        budget remainders never recompile."""
+        if self._jobs:
+            if self.token_budget is None:
+                pages_left = None
+            else:
+                n_active = sum(1 for s in self._seqs if s.active)
+                # at least one chunk always runs: a saturated decode
+                # batch may taper prefill but can never starve it
+                pages_left = max(
+                    1, (self.token_budget - n_active) // self.page
+                )
+            advanced = {st: 0 for st in self._jobs}
+            while True:
+                rows = np.zeros((self.station_slots, self.page), np.int32)
+                starts = np.zeros((self.station_slots,), np.int32)
+                mask = np.zeros((self.station_slots,), bool)
+                picked = []
+                for st, job in self._jobs.items():
+                    if pages_left is not None and len(picked) >= pages_left:
+                        break
+                    if advanced[st] >= self._chunks_per_step:
+                        continue
+                    start = job.pos
+                    end = min(start + self.page, job.plen - 1)
+                    if end <= start:
+                        continue
+                    rows[st, : end - start] = job.prompt[start:end]
+                    starts[st] = start
+                    mask[st] = True
+                    picked.append((st, job, end))
+                if not picked:
+                    break
+                self._station = self._chunk(
+                    self.params, self._station, jnp.asarray(rows),
+                    jnp.asarray(starts), jnp.asarray(mask),
+                )
+                for st, job, end in picked:
+                    if not job.started:
+                        job.started = True
+                        self._observe_prefill_wait(job)
+                    job.pos = end
+                    advanced[st] += 1
+                    self.stats["prefill_chunks"] += 1
+                    if self.metrics is not None:
+                        self.metrics.inc("serve_prefill_chunks_total")
+                    self._scatter_ready_pages(job)
+                if pages_left is not None:
+                    pages_left -= len(picked)
+                    if pages_left <= 0:
+                        break
+        # completion pass: fully-cached prompts (including zero-chunk
+        # full-prefix hits) flush their partial tails and activate
+        done = [
+            st for st, j in self._jobs.items() if j.pos >= j.plen - 1
+        ]
+        for st in done:
+            job = self._jobs.pop(st)
+            if not job.started:
+                job.started = True
+                self._observe_prefill_wait(job)
             self._scatter_ready_pages(job)  # flush the partial tail
             self._activate(job)
-            self._job = None
 
     # -- incremental serving API (the gateway's replica loop) --------------
     def submit(self, seq_id: int, prompt: np.ndarray, max_new: int,
@@ -730,8 +863,11 @@ class PagedContinuousBatcher:
                 return True
         for i, s in enumerate(self._seqs):
             if s.seq_id == seq_id:
-                if self._job is not None and self._job.seq_id == seq_id:
-                    self._job = None  # station contents become garbage
+                for st, job in list(self._jobs.items()):
+                    if job.seq_id == seq_id:
+                        # the station slot's rows become garbage; the
+                        # next job there overwrites before it attends
+                        del self._jobs[st]
                 self._release_pages(s)
                 s.seq_id, s.active, s.prefilling = -1, False, False
                 s.tokens, s.remaining = [], 0
@@ -766,36 +902,52 @@ class PagedContinuousBatcher:
                     self.pos[i] = 0
                     self._last[i] = 0
                     progress = True
-                if s.seq_id < 0 and self._pending:
-                    nxt = self._pending[0]
-                    if nxt[2] <= 0:
-                        # zero-budget no-op admit (validated at submit):
-                        # no pages, no job/slot work — the dense batcher
-                        # admits the same input as a no-op (their shared
-                        # contract)
-                        s.seq_id, s.active = nxt[0], False
-                        s.prefilling, s.tokens, s.remaining = False, [], 0
-                        self._pending.popleft()
-                        self.stats["admits"] += 1
-                        progress = True
-                        continue
-                    if self._job is not None:
-                        continue  # the station serves one admission at a time
-                    if self._try_begin_admit(i, *nxt):
-                        self._pending.popleft()
-                        progress = True
-                    # else: pool full for the FIFO head — later
-                    # retirements in this pass can free pages and
-                    # re-trigger the head's admission (later prompts
-                    # wait behind the head either way)
+            # admission is strictly FIFO: requests begin in submit
+            # order, and a head that cannot begin (station full, pool
+            # pressure, in-flight shared prefix) holds everything
+            # behind it in place — deferral never re-orders.  Upstream,
+            # the gateway's AdmissionQueue already rotates tenants
+            # fairly, so per-replica arrival order IS the fair order
+            # and preserving it keeps per-tenant FIFO intact.
+            while self._pending:
+                nxt = self._pending[0]
+                free = next(
+                    (i for i, s in enumerate(self._seqs) if s.seq_id < 0),
+                    None,
+                )
+                if free is None:
+                    break
+                if nxt[2] <= 0:
+                    # zero-budget no-op admit (validated at submit):
+                    # no pages, no job/slot work — the dense batcher
+                    # admits the same input as a no-op (their shared
+                    # contract)
+                    s = self._seqs[free]
+                    s.seq_id, s.active = nxt[0], False
+                    s.prefilling, s.tokens, s.remaining = False, [], 0
+                    self._pending.popleft()
+                    self.stats["admits"] += 1
+                    progress = True
+                    continue
+                if len(self._jobs) >= self.station_slots:
+                    break  # every station slot busy: wait, in order
+                if not self._try_begin_admit(free, *nxt):
+                    break  # head deferred: hold the FIFO line
+                self._pending.popleft()
+                progress = True
 
     def serve_step(self) -> Dict[int, List[int]]:
-        """One serving iteration: retire + admit, advance the prefill
-        station by ``prefill_chunk`` rows, run ONE paged decode step if
+        """One serving iteration: retire + admit, advance every
+        in-flight admission up to ``prefill_chunk`` rows (the whole
+        pack bounded by ``token_budget``), run ONE paged decode step if
         anything is active, retire again."""
         finished: Dict[int, List[int]] = {}
         self._sweep(finished)
         self._advance_prefill()
+        if self.metrics is not None:
+            self.metrics.set_gauge(
+                "serve_station_slots_busy", float(len(self._jobs))
+            )
         if any(s.active for s in self._seqs):
             counts = np.array(
                 [len(sq.tokens) for sq in self._seqs], np.int32
@@ -842,7 +994,7 @@ class PagedContinuousBatcher:
             done.update(self.serve_step())
             if (
                 self._pending
-                and self._job is None
+                and not self._jobs
                 and not any(s.seq_id >= 0 for s in self._seqs)
             ):
                 raise RuntimeError(

@@ -67,6 +67,7 @@ class _Slot:
     temperature: float = 0.0
     submitted_at: float = 0.0
     last_emit_at: float = 0.0
+    admit_seq: int = 0        # admission order (token-budget FIFO)
 
 
 def _observe_emit(metrics, s, first: bool) -> None:
@@ -117,6 +118,14 @@ class ContinuousBatcher:
     ``max_seq`` and falls back to monolithic otherwise, so the default
     never rejects a config the monolithic batcher accepted.
 
+    ``token_budget``: optional bound on the rows one serving iteration
+    processes (active decode tokens + prefill chunk rows).  When the
+    budget leaves room for fewer chunks than there are prefilling
+    slots, the earliest-admitted slots chunk first (FIFO) and the rest
+    park until a later iteration; at least one chunk always advances so
+    prefill can never starve.  Requires chunked prefill (the monolithic
+    admit is a single unsplittable program).
+
     ``metrics``: optional ``utils.metrics.Metrics`` registry; when given,
     the batcher observes ``serve_ttft_seconds`` / ``serve_itl_seconds``
     histograms and ``serve_prefill_chunks_total`` so a gateway sharing
@@ -135,6 +144,7 @@ class ContinuousBatcher:
         slots: int = 8,
         prompt_pad: int = 128,
         prefill_chunk: Union[int, None, str] = "auto",
+        token_budget: Optional[int] = None,
         eos_id: Optional[int] = None,
         dtype=jnp.bfloat16,
         quant: bool = False,
@@ -177,6 +187,18 @@ class ContinuousBatcher:
                     "last padded chunk fits"
                 )
         self.prefill_chunk = prefill_chunk
+        if token_budget is not None:
+            if token_budget <= 0:
+                raise ValueError(
+                    f"token_budget ({token_budget}) must be positive or None"
+                )
+            if prefill_chunk is None:
+                raise ValueError(
+                    "token_budget requires chunked prefill: the "
+                    "monolithic admit is one unsplittable program"
+                )
+        self.token_budget = token_budget
+        self._admit_counter = 0
         self.metrics = metrics
         self.params = params
         self.slots = slots
@@ -366,6 +388,8 @@ class ContinuousBatcher:
         s.prompt, s.prefill_pos = prompt, 0
         s.temperature = temperature
         s.submitted_at = submitted_at
+        s.admit_seq = self._admit_counter
+        self._admit_counter += 1
         # park the slot's step-write position on the LAST cache row for
         # the duration of the prefill: the step program writes K/V for
         # every slot each iteration (static shapes), and without parking
@@ -391,8 +415,9 @@ class ContinuousBatcher:
         s.prompt = None
 
     def _advance_prefill(self) -> None:
-        """One chunk program covering EVERY prefilling slot, then activate
-        the slots whose prompts are fully cached."""
+        """One chunk program covering every prefilling slot within the
+        token budget (earliest admissions first when the budget tapers),
+        then activate the slots whose prompts are fully cached."""
         pref = [
             i for i, s in enumerate(self._slots)
             if s.seq_id >= 0 and s.prompt is not None
@@ -400,6 +425,16 @@ class ContinuousBatcher:
         if not pref:
             return
         C = self.prefill_chunk
+        if self.token_budget is None:
+            chunking = set(pref)
+        else:
+            # rows this iteration already owes decode; the remainder
+            # packs chunks FIFO by admission, floored at one chunk so
+            # prefill can never starve behind a saturated decode batch
+            n_active = sum(1 for s in self._slots if s.active)
+            allow = max(1, (self.token_budget - n_active) // C)
+            by_admit = sorted(pref, key=lambda i: self._slots[i].admit_seq)
+            chunking = set(by_admit[:allow])
         tokens = np.zeros((self.slots, C), np.int32)
         cpos = np.zeros((self.slots,), np.int32)
         mask = np.zeros((self.slots,), bool)
@@ -409,7 +444,7 @@ class ContinuousBatcher:
             s = self._slots[i]
             plen = int(s.prompt.shape[0])
             start = s.prefill_pos
-            end = min(start + C, plen - 1)
+            end = min(start + C, plen - 1) if i in chunking else start
             ends[i] = end
             if end > start:
                 tokens[i, : end - start] = s.prompt[start:end]

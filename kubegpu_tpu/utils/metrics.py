@@ -82,6 +82,14 @@ class Metrics:
             h = self._histograms.get(name)
             return h.count if h is not None else 0
 
+    def histogram_sum(self, name: str) -> float:
+        """Exact running sum of a histogram (0.0 if never observed) —
+        with ``histogram_count`` it yields the mean, e.g. mean
+        submit→first-chunk wait from ``serve_prefill_wait_seconds``."""
+        with self._lock:
+            h = self._histograms.get(name)
+            return h.total if h is not None else 0.0
+
     def render(self) -> str:
         """Prometheus text exposition."""
         out: List[str] = []

@@ -347,6 +347,50 @@ def test_sim_batcher_contract():
     assert set(done2) == {1}
 
 
+def test_sim_batcher_token_budget_step_cap():
+    """token_budget caps per-step advances (round-robin, none starves)
+    and leaves every per-sequence stream byte-identical."""
+    b = SimBatcher(slots=4, token_budget=1)
+    b.submit(0, [1], 2)
+    b.submit(1, [1], 2)
+    done = {}
+    steps = 0
+    while b.has_work():
+        done.update(b.serve_step())
+        steps += 1
+        assert steps <= 8
+    assert steps == 4  # 4 tokens owed at 1/step
+    unbounded = SimBatcher(slots=4)
+    unbounded.submit(0, [1], 2)
+    unbounded.submit(1, [1], 2)
+    done_ub = {}
+    while unbounded.has_work():
+        done_ub.update(unbounded.serve_step())
+    assert done == done_ub
+    with pytest.raises(ValueError, match="token_budget"):
+        SimBatcher(token_budget=0)
+
+
+def test_sim_batcher_cancel_resubmit_keeps_budget_fair():
+    """Cancelling an active seq must drop its budget-ring entry: a
+    resubmitted seq_id otherwise holds TWO ring slots forever, double-
+    drawing the budget while a neighbor starves."""
+    b = SimBatcher(slots=4, token_budget=2)
+    b.submit(1, [1], 9)
+    b.submit(2, [1], 9)
+    b.serve_step()
+    assert b.cancel(1)
+    b.submit(1, [1], 9)
+    b.serve_step()  # re-admits seq 1
+    b.submit(1, [1], 9)  # re-submit while ACTIVE: restart, no extra ring slot
+    for _ in range(4):
+        b.serve_step()
+        lens = {s: len(t) for s, (t, _) in b._active.items()}
+        # budget 2, two active seqs: EVERY step advances both exactly once
+        assert abs(lens[1] - lens[2]) <= 2, lens
+    assert list(b._rr).count(1) == 1, list(b._rr)
+
+
 # ---------------------------------------------------------------------------
 # Failover: retries, hedging, deadlines
 # ---------------------------------------------------------------------------

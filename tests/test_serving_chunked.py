@@ -298,6 +298,13 @@ def test_serving_metrics_histograms_and_counters():
     assert pm.histogram_count("serve_ttft_seconds") == 2
     assert pm.get("serve_prefix_hit_tokens_total") > 0
     assert pm.get("serve_prompt_tokens_total") == 18
+    # the token-budget station observes submit->first-chunk wait per
+    # admission and tracks its occupancy as a gauge
+    assert pm.histogram_count("serve_prefill_wait_seconds") == 2
+    assert pm.histogram_sum("serve_prefill_wait_seconds") >= 0.0
     text = pm.render()
     assert "serve_ttft_seconds_count 2" in text
     assert "serve_prefix_hit_tokens_total" in text
+    assert "serve_prefill_wait_seconds_count 2" in text
+    assert "# TYPE serve_station_slots_busy gauge" in text
+    assert "serve_station_slots_busy 0.0" in text  # drained at rest

@@ -1,0 +1,339 @@
+"""Token-budget batched multi-admission prefill: the widened station.
+
+The paged batcher's prefill station grew from a serial b=1 pipe to
+``station_slots`` concurrent admissions packed under a ``token_budget``
+per serving iteration.  The widening must be INVISIBLE in the output
+(greedy-token-identical to the serial station, to monolithic prefill,
+and to the per-sequence oracle, across slot counts, chunk/page
+boundaries, budgets, and prefix-cache hits), strictly FIFO in admission
+order, page-balanced under the soak's kill schedule with the station
+half-full, and compile-stable (occupancy patterns and budget remainders
+never mint new programs)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubegpu_tpu.models import TransformerLM, greedy_generate
+from kubegpu_tpu.models.paging import PagedContinuousBatcher
+from kubegpu_tpu.models.serving import ContinuousBatcher
+
+pytestmark = pytest.mark.slow
+
+CFG = dict(vocab_size=61, num_layers=2, num_heads=4, hidden=32, max_seq=32)
+
+
+def trained_params():
+    model = TransformerLM(dtype=jnp.float32, **CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.ones((2, 8), jnp.int32))[
+        "params"
+    ]
+
+
+def oracle(params, prompt, n):
+    out = greedy_generate(
+        params, jnp.asarray(prompt)[None, :], n, dtype=jnp.float32, **CFG
+    )
+    return list(np.asarray(out)[0, len(prompt):])
+
+
+def make_paged(params, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("prompt_pad", 20)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("pool_pages", 40)
+    return PagedContinuousBatcher(params, dtype=jnp.float32, **CFG, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Property: batched station ≡ serial station ≡ monolithic, across the grid
+# ---------------------------------------------------------------------------
+
+def test_batched_station_token_identical_across_slot_counts():
+    """Greedy, fixed seed: prompt lengths straddling every page boundary
+    (page=4: 3/4/5, 7/8/9, 12/13) plus a DUPLICATE prompt (an in-burst
+    prefix-cache hit) must emit exactly the per-sequence oracle's tokens
+    — which is also what the serial station (station_slots=1) and the
+    dense monolithic batcher emit — for 1, 2, and 4 station slots, with
+    and without a token budget, and for multi-page prefill_chunk."""
+    params = trained_params()
+    rng = np.random.RandomState(0)
+    lengths = (1, 3, 4, 5, 7, 8, 9, 12, 13)
+    prompts = [
+        np.array(rng.randint(0, CFG["vocab_size"], size=n), np.int32)
+        for n in lengths
+    ]
+    prompts.append(prompts[6].copy())  # duplicate: hits pages mid-burst
+    budgets = [5, 4, 6, 3, 5, 4, 6, 5, 4, 5]
+    expected = {
+        i: oracle(params, p, n)
+        for i, (p, n) in enumerate(zip(prompts, budgets))
+    }
+    mono = ContinuousBatcher(
+        params, slots=4, prompt_pad=20, prefill_chunk=None,
+        dtype=jnp.float32, **CFG,
+    ).run(prompts, budgets)
+    assert mono == expected
+    serial = make_paged(params, station_slots=1)
+    got_serial = serial.run(prompts, budgets)
+    assert got_serial == expected
+    serial.assert_page_accounting()
+    for kw in (
+        dict(station_slots=2),
+        dict(station_slots=4),
+        dict(station_slots=4, token_budget=9),
+        dict(station_slots=3, prefill_chunk=8),
+    ):
+        cb = make_paged(params, **kw)
+        got = cb.run(prompts, budgets)
+        assert got == expected, (kw, {
+            i: (got[i], expected[i])
+            for i in expected if got[i] != expected[i]
+        })
+        cb.assert_page_accounting()
+        # work is conserved: batching changes packing, not chunk count
+        assert cb.stats["prefill_chunks"] == serial.stats["prefill_chunks"]
+        # the duplicate prompt hit its twin's registered pages
+        assert cb.stats["prefix_hit_tokens"] >= 8, kw
+
+
+def test_batched_station_overlaps_admissions():
+    """The perf contract behind the identity property: with N station
+    slots, N concurrent long admits reach activation in far fewer
+    serving iterations than the serial pipe (which pays N× sequential
+    prefill) — each iteration advances every in-flight admission."""
+    params = trained_params()
+    rng = np.random.RandomState(2)
+    prompts = [
+        np.array(rng.randint(0, CFG["vocab_size"], size=17), np.int32)
+        for _ in range(4)
+    ]
+
+    def iterations_to_drain(station_slots):
+        cb = make_paged(params, station_slots=station_slots)
+        for i, p in enumerate(prompts):
+            cb.submit(i, p, 2)
+        steps = 0
+        while cb.has_work():
+            cb.serve_step()
+            steps += 1
+            assert steps < 200
+        return steps
+
+    serial, batched = iterations_to_drain(1), iterations_to_drain(4)
+    # 17-token prompts are 4 chunks each: the serial pipe pays ~4x4
+    # chunk iterations end to end, the batched station ~4 — anything
+    # under half proves the admissions overlapped
+    assert batched * 2 <= serial, (batched, serial)
+
+
+def test_fully_cached_prefix_admits_alongside_inflight_twin():
+    """A prefix the cache already resolves in FULL must never defer
+    behind an in-flight admission that merely shares its first-page
+    key: nothing would be recomputed, so serializing them is a pure
+    FIFO head-of-line stall (the defer is only for prefixes whose
+    first MISSED page is mid-prefill).  18-token prompts: 4 sharable
+    pages all cached, one private tail row still to chunk — so the
+    first twin's job is genuinely in flight when the second admits."""
+    params = trained_params()
+    rng = np.random.RandomState(5)
+    prompt = np.array(rng.randint(0, CFG["vocab_size"], size=18), np.int32)
+    cb = make_paged(params, station_slots=4)
+    cb.submit(0, prompt, 2)  # seed the cache, then retire
+    warm = {}
+    while cb.has_work():
+        warm.update(cb.serve_step())
+    order = _spy_admission_order(cb)
+    cb.submit(1, prompt, 2)
+    cb.submit(2, prompt, 2)
+    cb.serve_step()
+    # one sweep admits BOTH twins: every sharable page of seq 2 was a
+    # cache hit, so it must not wait for seq 1's job to activate
+    assert order == [1, 2], order
+    out = dict(warm)
+    while cb.has_work():
+        out.update(cb.serve_step())
+    exp = oracle(params, prompt, 2)
+    assert out == {0: exp, 1: exp, 2: exp}
+    cb.assert_page_accounting()
+
+
+# ---------------------------------------------------------------------------
+# Fairness: admission is strictly FIFO under a full station
+# ---------------------------------------------------------------------------
+
+def _spy_admission_order(cb):
+    order = []
+    orig = cb._try_begin_admit
+
+    def spy(slot, seq_id, *a, **kw):
+        ok = orig(slot, seq_id, *a, **kw)
+        if ok:
+            order.append(seq_id)
+        return ok
+
+    cb._try_begin_admit = spy
+    return order
+
+
+def test_admission_fifo_under_full_station():
+    """Six multi-chunk prompts through a 2-slot station: admissions
+    begin in exact submit order — a full station defers the queue, it
+    never re-orders it."""
+    params = trained_params()
+    rng = np.random.RandomState(3)
+    cb = make_paged(params, station_slots=2)
+    order = _spy_admission_order(cb)
+    for i in range(6):
+        cb.submit(
+            i,
+            np.array(rng.randint(0, CFG["vocab_size"], size=10), np.int32),
+            3, session_id=f"tenant-{i % 3}",
+        )
+    while cb.has_work():
+        cb.serve_step()
+    assert order == list(range(6)), order
+    cb.assert_page_accounting()
+
+
+def test_admission_fifo_head_of_line_on_pool_pressure():
+    """A head deferred on pool pressure holds the line: a smaller
+    request behind it that WOULD fit must not jump the queue."""
+    params = trained_params()
+    rng = np.random.RandomState(4)
+    # 9 allocatable pages (page=4): a long-running seq holds 5
+    # (8 prompt + 12 new = 20 rows), leaving 4
+    cb = make_paged(params, slots=3, pool_pages=10)
+    runner = np.array(rng.randint(0, CFG["vocab_size"], size=8), np.int32)
+    cb.submit(0, runner, 12)
+    while not cb._seqs[0].active:
+        cb.serve_step()
+    order = _spy_admission_order(cb)
+    big = np.array(rng.randint(0, CFG["vocab_size"], size=16), np.int32)
+    small = np.array(rng.randint(0, CFG["vocab_size"], size=4), np.int32)
+    cb.submit(1, big, 4)    # needs 5 pages: defers behind the runner
+    cb.submit(2, small, 4)  # needs 2: would fit NOW, must wait its turn
+    for _ in range(3):
+        cb.serve_step()
+        assert order == [], "queue jumped the deferred head"
+    done = {}
+    while cb.has_work():
+        done.update(cb.serve_step())
+    assert order == [1, 2]
+    assert done[1] == oracle(params, big, 4)
+    assert done[2] == oracle(params, small, 4)
+    cb.assert_page_accounting()
+
+
+# ---------------------------------------------------------------------------
+# Soak: kill schedule with the station half-full
+# ---------------------------------------------------------------------------
+
+def test_gateway_soak_kill_schedule_station_half_full():
+    """The GatewaySoak kill/revive/hedge schedule over paged batchers
+    whose stations run multi-admission (station_slots=2 of slots=4, so
+    bursts keep the station partially occupied at kill time): invariant
+    I5 plus assert_page_accounting on every surviving replica."""
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    tiny = dict(vocab_size=61, num_layers=1, num_heads=2, hidden=16,
+                max_seq=16)
+    params = TransformerLM(dtype=jnp.float32, **tiny).init(
+        jax.random.PRNGKey(1), jnp.ones((1, 4), jnp.int32)
+    )["params"]
+    soak = GatewaySoak(
+        seed=13, n_replicas=2,
+        batcher_factory=lambda key: PagedContinuousBatcher(
+            params, slots=4, prompt_pad=4, page_size=4, pool_pages=20,
+            station_slots=2, token_budget=8, dtype=jnp.float32, **tiny,
+        ),
+    )
+    soak.run(steps=18)
+
+
+# ---------------------------------------------------------------------------
+# Compile stability: occupancy and budget remainders never recompile
+# ---------------------------------------------------------------------------
+
+def test_compile_stability_fixed_jit_cache():
+    """A varied admission schedule — mixed lengths across page
+    boundaries, cache hits, cancels mid-prefill, zero-budget admits,
+    partial station occupancy, odd token-budget remainders — must leave
+    exactly ONE compiled entry per program: the packer's shapes are
+    static (station_slots × page rows, masked), so no schedule can
+    trigger a recompile storm."""
+    params = trained_params()
+    rng = np.random.RandomState(5)
+    cb = make_paged(params, station_slots=3, token_budget=11,
+                    prefill_chunk=8)
+    seq = 0
+    live = []
+    for step in range(40):
+        roll = rng.rand()
+        if roll < 0.5:
+            n = int(rng.randint(1, 14))
+            max_new = int(rng.randint(0, 5))  # zero-budget admits too
+            prompt = (
+                np.arange(n, dtype=np.int32) % 7 if roll < 0.1
+                else np.array(
+                    rng.randint(0, CFG["vocab_size"], size=n), np.int32
+                )
+            )  # the arange prompts repeat -> prefix-cache hits
+            cb.submit(seq, prompt, max_new)
+            live.append(seq)
+            seq += 1
+        elif roll < 0.6 and live:
+            cb.cancel(live.pop(rng.randint(len(live))))
+        else:
+            for s in cb.serve_step():
+                live.remove(s)
+    while cb.has_work():
+        for s in cb.serve_step():
+            live.remove(s)
+    cb.assert_page_accounting()
+    for name in ("_chunk", "_step", "_write_page"):
+        assert getattr(cb, name)._cache_size() == 1, (
+            f"{name}: {getattr(cb, name)._cache_size()} compiled entries"
+        )
+    assert cb._gather_page._cache_size() <= 1
+
+
+# ---------------------------------------------------------------------------
+# Dense batcher: token budget bounds chunk work per step, output-invisible
+# ---------------------------------------------------------------------------
+
+def test_dense_token_budget_identical_and_bounded():
+    params = trained_params()
+    rng = np.random.RandomState(6)
+    prompts = [
+        np.array(rng.randint(0, CFG["vocab_size"], size=n), np.int32)
+        for n in (9, 11, 13)
+    ]
+    budgets = [4, 3, 4]
+    expected = {
+        i: oracle(params, p, n)
+        for i, (p, n) in enumerate(zip(prompts, budgets))
+    }
+    cb = ContinuousBatcher(
+        params, slots=3, prompt_pad=16, prefill_chunk=4, token_budget=6,
+        dtype=jnp.float32, **CFG,
+    )
+    for i, (p, n) in enumerate(zip(prompts, budgets)):
+        cb.submit(i, p, n)
+    # all three slots are prefilling, but budget 6 with chunk 4 allows
+    # exactly ONE chunk per iteration — earliest admission first
+    cb.serve_step()
+    assert cb.stats["prefill_chunks"] == 1
+    assert cb._slots[0].prefill_pos == 4
+    assert cb._slots[1].prefill_pos == 0
+    got = dict()
+    while cb.has_work():
+        got.update(cb.serve_step())
+    assert got == expected
+    with pytest.raises(ValueError, match="token_budget"):
+        ContinuousBatcher(
+            params, slots=1, prompt_pad=16, prefill_chunk=None,
+            token_budget=8, dtype=jnp.float32, **CFG,
+        )
