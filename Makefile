@@ -39,10 +39,12 @@ bench:
 	$(PY) bench.py
 
 # CPU-only serving-path micro-bench (<60 s): TTFT/ITL p95 with chunked
-# vs monolithic prefill, prefix-cache hit rate, and burst TTFT p95
-# batched-station vs serial on tiny shapes; exits non-zero if chunked
-# ITL regresses past monolithic, hits vanish, the batched station's
-# burst TTFT is not strictly below serial, or tokens diverge
+# vs monolithic prefill, prefix-cache hit rate, burst TTFT p95
+# batched-station vs serial, and speculative vs plain paged decode tok/s
+# on tiny shapes; exits non-zero if chunked ITL regresses past
+# monolithic, hits vanish, the batched station's burst TTFT is not
+# strictly below serial, spec decode is not strictly above plain, or
+# tokens diverge on any of them
 bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve-smoke
 
@@ -51,7 +53,8 @@ bench-smoke:
 # cannot (e.g. a jax build without the APIs the parallel stack needs)
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
-	  $(PY) -c "import __graft_entry__ as g; g.dryrun_gateway(); g.dryrun_multichip(8)"
+	  $(PY) -c "import __graft_entry__ as g; g.dryrun_gateway(); \
+	  g.dryrun_spec_serving(); g.dryrun_multichip(8)"
 
 image:
 	docker build -f deploy/Dockerfile -t kubegpu-tpu:latest .

@@ -105,17 +105,31 @@ class SimBatcher:
     at most that many sequences advance a token per serve_step, rotated
     round-robin so none starves (None = every active sequence advances,
     the historical behavior).  Per-sequence streams stay deterministic
-    either way — token *i* depends only on (seq, i)."""
+    either way — token *i* depends only on (seq, i).
+
+    ``speculate_k`` models draft-then-verify speculative decode: an
+    advancing sequence emits a deterministic 1..k+1 tokens per step (the
+    "accepted prefix" — a function of (seq, depth) only, so streams stay
+    byte-identical to the non-speculative mill), and bills k+1 budget
+    rows against ``token_budget`` whether or not the tail was accepted —
+    exactly the paged scheduler's accounting (a speculative slot's
+    verify window is k+1 rows wide regardless of acceptance)."""
 
     def __init__(self, slots: int = 8, vocab: int = 256,
-                 token_budget: Optional[int] = None) -> None:
+                 token_budget: Optional[int] = None,
+                 speculate_k: Optional[int] = None) -> None:
         if token_budget is not None and token_budget <= 0:
             raise ValueError(
                 f"token_budget ({token_budget}) must be positive or None"
             )
+        if speculate_k is not None and speculate_k < 1:
+            raise ValueError(
+                f"speculate_k ({speculate_k}) must be >= 1 or None"
+            )
         self.slots = slots
         self.vocab = vocab
         self.token_budget = token_budget
+        self.speculate_k = speculate_k
         self._pending: deque = deque()
         self._active: Dict[int, tuple] = {}  # seq -> (tokens, max_new)
         self._rr: deque = deque()            # active seqs in budget order
@@ -162,7 +176,11 @@ class SimBatcher:
             self.stats["steps"] += 1
             n = len(self._active)
             if self.token_budget is not None:
-                n = min(n, self.token_budget)
+                # a speculative sequence bills its whole k+1-row verify
+                # window; at least one sequence always advances (the
+                # real batchers' can't-starve floor)
+                rows = (self.speculate_k or 0) + 1
+                n = min(n, max(1, self.token_budget // rows))
             advanced = 0
             for _ in range(len(self._rr)):
                 if advanced >= n:
@@ -172,7 +190,17 @@ class SimBatcher:
                     continue  # cancelled: drop its stale ring entry
                 advanced += 1
                 tokens, max_new = self._active[seq]
-                tokens.append((seq * 31 + len(tokens)) % self.vocab)
+                if self.speculate_k is None:
+                    emit = 1
+                else:
+                    # deterministic accepted-prefix length in [1, k+1],
+                    # a function of (seq, depth) only: re-running the
+                    # same request yields the same per-step emissions
+                    emit = 1 + (seq * 7 + len(tokens)) % (
+                        self.speculate_k + 1
+                    )
+                for _ in range(min(emit, max_new - len(tokens))):
+                    tokens.append((seq * 31 + len(tokens)) % self.vocab)
                 if len(tokens) >= max_new:
                     finished[seq] = tokens
                     del self._active[seq]

@@ -26,6 +26,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
@@ -226,7 +227,7 @@ class GatewayServer:
 # ---------------------------------------------------------------------------
 
 def _build_fake_serving_cluster(preset: str, replicas: int, group: str,
-                                token_budget=None):
+                                token_budget=None, speculate_k=None):
     """Fabricated cluster + scheduled decode replicas + SimBatcher-backed
     in-memory data plane: the full serving path with zero dependencies."""
     from kubegpu_tpu.gateway.client import InMemoryReplicaClient, SimBatcher
@@ -249,7 +250,7 @@ def _build_fake_serving_cluster(preset: str, replicas: int, group: str,
     # its name tiebreak — the demo should demonstrate load spreading
     client = InMemoryReplicaClient(
         batcher_factory=lambda key: SimBatcher(
-            slots=8, token_budget=token_budget
+            slots=8, token_budget=token_budget, speculate_k=speculate_k
         ),
         step_delay_s=0.002,
     )
@@ -287,6 +288,25 @@ def main(argv=None) -> None:
         "admission prefill under it; the SimBatcher data planes here "
         "model it as a per-step advance cap.  Default: unbounded",
     )
+    ap.add_argument(
+        "--speculate-k", type=int, default=None,
+        help="draft-then-verify speculation depth for replica batchers "
+        "(OFF by default).  Requires --draft-checkpoint: greedy "
+        "speculative decode is lossless for any draft, but without "
+        "trained draft weights it is pure overhead.  Real paged/dense "
+        "batchers verify k+1-token windows per program and bill k+1 "
+        "budget rows per speculative slot; the SimBatcher data planes "
+        "here model exactly that accounting",
+    )
+    ap.add_argument(
+        "--draft-checkpoint", default=None, metavar="DIR",
+        help="orbax checkpoint directory holding the draft model's "
+        "weights; required when --speculate-k is set and must exist.  "
+        "Consumed REPLICA-side (models.serving.load_draft_checkpoint / "
+        "worker --draft-ckpt-dir) once a real data plane is wired; the "
+        "in-process SimBatcher planes here model only the multi-token "
+        "step and its k+1-row budget accounting",
+    )
     ap.add_argument("--queue-capacity", type=int, default=256)
     ap.add_argument("--per-tenant-cap", type=int, default=None)
     ap.add_argument("--deadline", type=float, default=30.0,
@@ -299,12 +319,31 @@ def main(argv=None) -> None:
     args = ap.parse_args(argv)
     if args.token_budget is not None and args.token_budget <= 0:
         ap.error(f"--token-budget must be positive, got {args.token_budget}")
+    if args.speculate_k is not None:
+        # the --token-budget pattern: malformed serving knobs die at
+        # argparse time, never mid-serve-loop
+        if args.speculate_k < 1:
+            ap.error(
+                f"--speculate-k must be >= 1, got {args.speculate_k}"
+            )
+        if args.draft_checkpoint is None:
+            ap.error(
+                "--speculate-k requires --draft-checkpoint: greedy "
+                "speculation is lossless for any draft, but an untrained "
+                "draft is pure overhead — point at trained draft weights"
+            )
+        if not os.path.isdir(args.draft_checkpoint):
+            ap.error(
+                f"--draft-checkpoint {args.draft_checkpoint!r} is not a "
+                "directory: the draft restore would fail replica-side — "
+                "a typo'd path must die here, not after deployment"
+            )
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
 
     if args.fake_cluster:
         _, registry, client = _build_fake_serving_cluster(
             args.fake_cluster, args.replicas, args.group,
-            token_budget=args.token_budget,
+            token_budget=args.token_budget, speculate_k=args.speculate_k,
         )
     else:
         from kubegpu_tpu.utils.apiserver import KubeApiServer
@@ -325,7 +364,8 @@ def main(argv=None) -> None:
 
             client = InMemoryReplicaClient(
                 batcher_factory=lambda key: SimBatcher(
-                    slots=8, token_budget=args.token_budget
+                    slots=8, token_budget=args.token_budget,
+                    speculate_k=args.speculate_k,
                 ),
                 step_delay_s=0.002,
             )

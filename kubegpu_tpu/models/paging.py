@@ -61,6 +61,7 @@ from __future__ import annotations
 import hashlib
 import time
 from collections import OrderedDict, deque
+from contextlib import nullcontext as _null_ctx
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Dict, List, Optional, Set
@@ -72,15 +73,25 @@ import numpy as np
 
 from kubegpu_tpu.models.decoding import DecodeLM, QuantDense, init_caches
 from kubegpu_tpu.models.serving import _observe_emit, _validate_request
-from kubegpu_tpu.ops.paged_attention import paged_decode_attention
+from kubegpu_tpu.ops.paged_attention import (
+    paged_chunk_attention,
+    paged_decode_attention,
+)
 from kubegpu_tpu.utils.metrics import Metrics
 
 
 class PagedDecodeAttention(nn.Module):
-    """Single-token attention over a paged KV pool; parameter names match
+    """Attention over a paged KV pool; parameter names match
     ``DecodeAttention`` (q/k/v/o_proj), so the tree is checkpoint-
     compatible (``quant=True`` takes the QuantDense int8 layout like the
-    dense twin)."""
+    dense twin).
+
+    ``x`` may be one token per slot (the decode step, q-length 1 through
+    the single-query kernel) or an L-token WINDOW per slot (the
+    speculative verify chunk, q-length L through the multi-query kernel
+    with intra-window causal masking).  Either way every window row's K/V
+    is written to the slot's pages FIRST, then attention runs — row j
+    sees rows < pos+j+1, the dense twin's exact semantics."""
 
     num_heads: int
     dtype: jnp.dtype = jnp.bfloat16
@@ -88,9 +99,9 @@ class PagedDecodeAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, k_pool, v_pool, table, pos):
-        # x: (b, 1, d); pools: (P, h, page, hd); table: (b, n_pages);
-        # pos: (b,) cache row of THIS token
-        b, _, d = x.shape
+        # x: (b, L, d); pools: (P, h, page, hd); table: (b, n_pages);
+        # pos: (b,) cache row of x's FIRST token
+        b, L, d = x.shape
         h = self.num_heads
         hd = d // h
         page = k_pool.shape[2]
@@ -99,19 +110,35 @@ class PagedDecodeAttention(nn.Module):
             if self.quant
             else partial(nn.Dense, use_bias=False, dtype=self.dtype)
         )
-        q = dense(d, name="q_proj")(x).reshape(b, h, hd)
-        k = dense(d, name="k_proj")(x).reshape(b, h, hd)
-        v = dense(d, name="v_proj")(x).reshape(b, h, hd)
-        # write the new row at each slot's (physical page, offset), THEN
-        # attend over pos+1 rows so the token sees itself — the dense
-        # twin's exact semantics
+        q = dense(d, name="q_proj")(x).reshape(b, L, h, hd)
+        k = dense(d, name="k_proj")(x).reshape(b, L, h, hd)
+        v = dense(d, name="v_proj")(x).reshape(b, L, h, hd)
         rows = jnp.arange(b)
-        page_ids = table[rows, pos // page]
-        offs = pos % page
-        k_pool = k_pool.at[page_ids, :, offs, :].set(k)
-        v_pool = v_pool.at[page_ids, :, offs, :].set(v)
-        out = paged_decode_attention(q, k_pool, v_pool, table, pos + 1)
-        out = dense(d, name="o_proj")(out.reshape(b, 1, d))
+        if L == 1:
+            # the proven decode-step path, byte-for-byte: one write, the
+            # single-query kernel (non-speculative serving never changes
+            # program or numerics)
+            page_ids = table[rows, pos // page]
+            offs = pos % page
+            k_pool = k_pool.at[page_ids, :, offs, :].set(k[:, 0])
+            v_pool = v_pool.at[page_ids, :, offs, :].set(v[:, 0])
+            out = paged_decode_attention(
+                q[:, 0], k_pool, v_pool, table, pos + 1
+            )
+            out = out.reshape(b, 1, d)
+        else:
+            # speculative verify: write all L window rows (static unroll,
+            # L = k+1 is small), then ONE multi-query kernel call scores
+            # every position — rejected rows' writes are junk the next
+            # window overwrites before any mask can expose them
+            for j in range(L):
+                page_ids = table[rows, (pos + j) // page]
+                offs = (pos + j) % page
+                k_pool = k_pool.at[page_ids, :, offs, :].set(k[:, j])
+                v_pool = v_pool.at[page_ids, :, offs, :].set(v[:, j])
+            out = paged_chunk_attention(q, k_pool, v_pool, table, pos + 1)
+            out = out.reshape(b, L, d)
+        out = dense(d, name="o_proj")(out)
         return out, k_pool, v_pool
 
 
@@ -142,8 +169,11 @@ class PagedDecodeBlock(nn.Module):
 
 
 class PagedDecodeLM(nn.Module):
-    """Checkpoint-compatible paged twin of ``DecodeLM`` for single-token
-    decode steps (prefill stays dense — see module docstring)."""
+    """Checkpoint-compatible paged twin of ``DecodeLM`` for decode steps
+    (prefill stays dense — see module docstring).  tokens may be (b, 1)
+    — the ordinary step — or (b, L) — a speculative verify window scored
+    in ONE forward; ``all_logits=True`` returns every window row's logits
+    (the verify needs all k+1 positions)."""
 
     vocab_size: int = 32000
     num_layers: int = 4
@@ -152,16 +182,19 @@ class PagedDecodeLM(nn.Module):
     max_seq: int = 2048
     dtype: jnp.dtype = jnp.bfloat16
     quant: bool = False
+    all_logits: bool = False
 
     @nn.compact
     def __call__(self, tokens, pools, table, pos):
-        # tokens: (b, 1); pools: [(k_pool, v_pool)] per layer; pos: (b,)
+        # tokens: (b, L); pools: [(k_pool, v_pool)] per layer; pos: (b,)
+        # cache row of the FIRST window token
+        L = tokens.shape[1]
         x = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype, name="embed")(
             tokens
         )
         x = x + nn.Embed(
             self.max_seq, self.hidden, dtype=self.dtype, name="pos_embed"
-        )(pos[:, None])
+        )(pos[:, None] + jnp.arange(L)[None, :])
         new_pools = []
         for i in range(self.num_layers):
             kp, vp = pools[i]
@@ -180,7 +213,7 @@ class PagedDecodeLM(nn.Module):
                 self.vocab_size, use_bias=False, dtype=jnp.float32,
                 name="lm_head"
             )(x)
-        return logits[:, -1], new_pools
+        return (logits if self.all_logits else logits[:, -1]), new_pools
 
 
 class PrefixPageCache:
@@ -329,6 +362,11 @@ class PagedContinuousBatcher:
         top_k: int = 0,
         seed: int = 0,
         metrics: Optional[Metrics] = None,
+        draft_params=None,
+        draft_num_layers: Optional[int] = None,
+        draft_num_heads: Optional[int] = None,
+        draft_hidden: Optional[int] = None,
+        speculate_k: Optional[int] = None,
     ) -> None:
         if prompt_pad > max_seq:
             raise ValueError(
@@ -362,6 +400,25 @@ class PagedContinuousBatcher:
                 f"token_budget ({token_budget}) must be positive or None"
             )
         self.token_budget = token_budget
+        if speculate_k is not None:
+            if speculate_k < 1:
+                raise ValueError(
+                    f"speculate_k ({speculate_k}) must be >= 1 or None"
+                )
+            if draft_params is None or None in (
+                draft_num_layers, draft_num_heads, draft_hidden
+            ):
+                raise ValueError(
+                    "speculate_k needs a draft model: pass draft_params "
+                    "with draft_num_layers/draft_num_heads/draft_hidden"
+                )
+            if speculate_k + 1 > max_seq:
+                raise ValueError(
+                    f"speculate_k ({speculate_k}) verify window exceeds "
+                    f"max_seq ({max_seq})"
+                )
+        self.speculate_k = speculate_k
+        self.draft_params = draft_params
         self.metrics = metrics
         self.params = params
         self.slots = slots
@@ -448,6 +505,110 @@ class PagedContinuousBatcher:
 
         self._step = jax.jit(step, donate_argnums=(1,))
 
+        if speculate_k is not None:
+            # -- speculative decode: draft k proposals per active slot,
+            # then ONE fused verify program scores all k+1 positions per
+            # slot against the paged pool (multi-query kernel), with the
+            # accept arithmetic on device.  Three programs total, all
+            # shape-stable: _draft_admit (activation), _spec_draft (the
+            # k+1-step scan), _spec_verify (window forward + accept).
+            k_spec = speculate_k
+            self.draft_model = DecodeLM(
+                vocab_size=vocab_size, num_layers=draft_num_layers,
+                num_heads=draft_num_heads, hidden=draft_hidden,
+                max_seq=max_seq, dtype=dtype,
+            )
+            # the verify twin shares self.model's params; all_logits so
+            # every window position's choice comes from one forward
+            self.verify_model = PagedDecodeLM(
+                vocab_size=vocab_size, num_layers=num_layers,
+                num_heads=num_heads, hidden=hidden, max_seq=max_seq,
+                dtype=dtype, quant=quant, all_logits=True,
+            )
+            # dense per-slot draft cache: the draft is small, so the
+            # dense max_seq-row layout costs little and keeps the draft
+            # loop a plain DecodeLM scan (no second page table)
+            self.d_caches = init_caches(
+                slots, draft_num_layers, draft_num_heads, draft_hidden,
+                max_seq, dtype,
+            )
+
+            def spec_draft(dparams, d_caches, last, pos):
+                # k+1 scan steps: the extra step's proposal is discarded
+                # but its cache write consumes p_k (speculative.py's
+                # load-bearing extra step — a k-step scan would leave row
+                # pos+k a hole after a fully-accepted window)
+                def d_step(carry, _):
+                    caches, tok, p = carry
+                    logits, caches = self.draft_model.apply(
+                        {"params": dparams}, tok[:, None], caches, p
+                    )
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    return (caches, nxt, p + 1), nxt
+
+                (d_caches, _, _), proposed = jax.lax.scan(
+                    d_step, (d_caches, last, pos), None, length=k_spec + 1
+                )
+                return proposed.T[:, :k_spec], d_caches
+
+            self._spec_draft = jax.jit(spec_draft, donate_argnums=(1,))
+
+            def spec_verify(params, pools, last, proposals, table, pos):
+                # window = [last, p_1..p_k]: row j's K/V writes land at
+                # pool rows pos+j through the slot's table (private pages
+                # only — sharable pages end strictly below the first
+                # decode row), rejected rows are junk the NEXT window
+                # overwrites before any mask exposes them — rollback is
+                # "don't commit", no pool mutation to undo
+                chunk_toks = jnp.concatenate([last[:, None], proposals], 1)
+                logits_all, pools = self.verify_model.apply(
+                    {"params": params}, chunk_toks, pools, table, pos
+                )
+                choices = jnp.argmax(logits_all, -1).astype(jnp.int32)
+                match = proposals == choices[:, :k_spec]
+                accepted = jnp.argmin(
+                    jnp.concatenate(
+                        [match, jnp.zeros((slots, 1), bool)], axis=1
+                    ).astype(jnp.int32),
+                    axis=1,
+                )
+                emit_len = accepted + 1
+                next_last = choices[jnp.arange(slots), emit_len - 1]
+                return choices, emit_len, next_last, pools
+
+            self._spec_verify = jax.jit(spec_verify, donate_argnums=(1,))
+
+            def draft_admit(dparams, d_caches, prompt_row, slot):
+                # prefill the padded prompt on a fresh b=1 draft cache and
+                # splice the WHOLE cache in (zeros past prompt_pad): a
+                # reused slot's stale rows are gone wholesale.  Padding
+                # junk past plen is overwritten by the contiguous scan
+                # writes before any causal mask can expose it — the
+                # spec_serving discipline.  The draft always recomputes
+                # the full prompt: prefix-cache hits skip TARGET pages
+                # only (draft K/V lives in its own dense cache).
+                fresh = init_caches(
+                    1, draft_num_layers, draft_num_heads, draft_hidden,
+                    max_seq, dtype,
+                )
+                _, fresh = self.draft_model.apply(
+                    {"params": dparams}, prompt_row[None, :], fresh,
+                    jnp.zeros((), jnp.int32),
+                )
+                out = []
+                for (ck, cv), (fk, fv) in zip(d_caches, fresh):
+                    out.append((
+                        jax.lax.dynamic_update_slice(
+                            ck, fk, (slot, 0, 0, 0)
+                        ),
+                        jax.lax.dynamic_update_slice(
+                            cv, fv, (slot, 0, 0, 0)
+                        ),
+                    ))
+                return out
+
+            self._draft_admit = jax.jit(draft_admit, donate_argnums=(1,))
+
         def chunk(params, station, rows, starts, mask):
             # one batched page-sized causal chunk across EVERY station
             # slot: slot i advances rows [starts[i], starts[i]+page) of
@@ -533,7 +694,13 @@ class PagedContinuousBatcher:
 
     # -- page accounting ---------------------------------------------------
     def _pages_for(self, plen: int, max_new: int) -> int:
-        return -(-(plen + max_new) // self.page)
+        # a speculative verify window writes rows [pos, pos+k]; the last
+        # window before retirement starts at plen+max_new-2, so the
+        # reservation carries k rows of write headroom (the spec_serving
+        # headroom discipline, paged: junk tail rows must land in pages
+        # this sequence OWNS, never a neighbor's)
+        extra = self.speculate_k or 0
+        return -(-(plen + max_new + extra) // self.page)
 
     def _available_pages(self, reserved: Set[int]) -> int:
         """Pages obtainable right now: free + evictable cache entries,
@@ -618,6 +785,16 @@ class PagedContinuousBatcher:
         plen = _validate_request(prompt, max_new, self.prompt_pad,
                                  self.max_seq)
         if max_new > 0:
+            if (
+                self.speculate_k is not None
+                and plen + max_new + self.speculate_k > self.max_seq
+            ):
+                raise ValueError(
+                    f"prompt {plen} + max_new {max_new} + speculate_k "
+                    f"{self.speculate_k} exceeds max_seq {self.max_seq}: "
+                    "the speculative verify window needs k rows of cache "
+                    "headroom"
+                )
             need = self._pages_for(plen, max_new)
             if need > self.pool_pages - 1:  # page 0 is the dump page
                 raise ValueError(
@@ -755,6 +932,15 @@ class PagedContinuousBatcher:
         self.tables[slot, : len(s.pages)] = s.pages
         self.pos[slot] = job.plen - 1
         self._last[slot] = int(job.prompt[job.plen - 1])
+        if self.speculate_k is not None:
+            # the draft needs rows [0, plen-1) of ITS cache before the
+            # first window's scan consumes `last` at row plen-1
+            row = np.zeros((self.prompt_pad,), np.int32)
+            row[: job.plen] = job.prompt[: job.plen]
+            self.d_caches = self._draft_admit(
+                self.draft_params, self.d_caches, jnp.asarray(row),
+                jnp.int32(slot),
+            )
         s.prefilling, s.active = False, True
 
     def _observe_prefill_wait(self, job: _PrefillJob) -> None:
@@ -777,6 +963,12 @@ class PagedContinuousBatcher:
                 pages_left = None
             else:
                 n_active = sum(1 for s in self._seqs if s.active)
+                if self.speculate_k is not None:
+                    # a speculative slot consumes k+1 budget rows per
+                    # iteration (its verify window is k+1 tokens wide);
+                    # decode-first ordering and the one-chunk floor below
+                    # are unchanged
+                    n_active *= self.speculate_k + 1
                 # at least one chunk always runs: a saturated decode
                 # batch may taper prefill but can never starve it
                 pages_left = max(
@@ -843,6 +1035,14 @@ class PagedContinuousBatcher:
         ``session_id`` is advisory: prefix sharing is content-addressed."""
         if seq_id < 0:
             raise ValueError(f"seq_id must be >= 0, got {seq_id}")
+        if self.speculate_k is not None and temperature > 0.0:
+            raise ValueError(
+                "speculative paged serving is greedy-only: lossless "
+                "speculative SAMPLING needs per-position rejection "
+                "sampling against the target distribution (a different "
+                "verify program and acceptance rule); submit with "
+                "temperature=0 or build the batcher without speculate_k"
+            )
         prompt = np.asarray(prompt, np.int32)
         self._validate(prompt, max_new)
         # a reused seq_id binds to a NEW prompt: any memoized prefix keys
@@ -884,6 +1084,7 @@ class PagedContinuousBatcher:
         self.stats = {
             "steps": 0, "admits": 0, "peak_pages": 0, "prefill_chunks": 0,
             "prefix_hit_tokens": 0, "prompt_tokens": 0,
+            "spec_steps": 0, "spec_tokens": 0,
         }
 
     def _sweep(self, finished: Dict[int, List[int]]) -> None:
@@ -949,32 +1150,111 @@ class PagedContinuousBatcher:
                 "serve_station_slots_busy", float(len(self._jobs))
             )
         if any(s.active for s in self._seqs):
-            counts = np.array(
-                [len(sq.tokens) for sq in self._seqs], np.int32
-            )
-            toks, self.pools = self._step(
-                self.params, self.pools, jnp.asarray(self._last),
-                jnp.asarray(self.tables), jnp.asarray(self.pos),
-                self._temps, self._base_keys, jnp.asarray(counts),
-            )
-            self.stats["steps"] += 1
-            toks_host = np.asarray(toks)
-            for i, s in enumerate(self._seqs):
-                if not s.active:
-                    continue
-                self.pos[i] += 1  # the step consumed one row for this slot
-                t = int(toks_host[i])
-                first = not s.tokens
-                s.tokens.append(t)
-                s.remaining -= 1
-                self._last[i] = t
-                _observe_emit(self.metrics, s, first=first)
-                if s.remaining <= 0 or (
-                    self.eos_id is not None and t == self.eos_id
-                ):
-                    s.active = False
+            if self.speculate_k is not None:
+                self._spec_step_host()
+            else:
+                counts = np.array(
+                    [len(sq.tokens) for sq in self._seqs], np.int32
+                )
+                toks, self.pools = self._step(
+                    self.params, self.pools, jnp.asarray(self._last),
+                    jnp.asarray(self.tables), jnp.asarray(self.pos),
+                    self._temps, self._base_keys, jnp.asarray(counts),
+                )
+                self.stats["steps"] += 1
+                toks_host = np.asarray(toks)
+                for i, s in enumerate(self._seqs):
+                    if not s.active:
+                        continue
+                    self.pos[i] += 1  # the step consumed one row
+                    t = int(toks_host[i])
+                    first = not s.tokens
+                    s.tokens.append(t)
+                    s.remaining -= 1
+                    self._last[i] = t
+                    _observe_emit(self.metrics, s, first=first)
+                    if s.remaining <= 0 or (
+                        self.eos_id is not None and t == self.eos_id
+                    ):
+                        s.active = False
             self._sweep(finished)
         return finished
+
+    def _spec_step_host(self) -> None:
+        """One speculative serving iteration for every active slot: the
+        draft scan proposes k tokens per slot at its own depth, ONE
+        verify program scores all k+1 window positions against the paged
+        pool, and each slot commits its accepted prefix plus the target's
+        own choice at the boundary — greedy-lossless, so the emitted
+        stream is token-identical to non-speculative paged decode for ANY
+        draft (the draft only moves how many verify programs it costs)."""
+        k = self.speculate_k
+        if self.metrics is not None:
+            draft_ctx = self.metrics.timer("serve_spec_draft_seconds")
+            verify_ctx = self.metrics.timer("serve_spec_verify_seconds")
+        else:
+            draft_ctx = verify_ctx = _null_ctx()
+        with draft_ctx:
+            proposals, self.d_caches = self._spec_draft(
+                self.draft_params, self.d_caches, jnp.asarray(self._last),
+                jnp.asarray(self.pos),
+            )
+            if self.metrics is not None:
+                # the timer boundary is also the program boundary:
+                # without the readback the verify timer would absorb the
+                # draft's async tail.  Metrics-off skips the fence — the
+                # verify consumes proposals as a device array, so the
+                # hot path keeps async dispatch
+                proposals = jax.block_until_ready(proposals)
+        with verify_ctx:
+            choices, emit_len, next_last, self.pools = self._spec_verify(
+                self.params, self.pools, jnp.asarray(self._last),
+                proposals, jnp.asarray(self.tables), jnp.asarray(self.pos),
+            )
+            choices_h = np.asarray(choices)
+            emit_h = np.asarray(emit_len)
+            next_h = np.asarray(next_last)
+        self.stats["steps"] += 1
+        self.stats["spec_steps"] += 1
+        spec_emitted = 0
+        for i, s in enumerate(self._seqs):
+            if not s.active:
+                continue
+            e = int(emit_h[i])
+            # the verify consumed e rows for this slot: rows
+            # [pos, pos+e) now hold the COMMITTED continuation's K/V
+            # (window token j is the previously-emitted token for j=0 and
+            # an accepted — i.e. emitted — proposal after); rejected
+            # rows past pos+e are junk the next window overwrites
+            self.pos[i] += e
+            emitted = [int(t) for t in choices_h[i, :e]]
+            # budget cap: the device may emit past the slot's remaining
+            # budget; the surplus is junk (the slot retires here, and the
+            # next admission resets table/pos/draft cache wholesale)
+            emitted = emitted[: s.remaining]
+            if self.eos_id is not None and self.eos_id in emitted:
+                emitted = emitted[: emitted.index(self.eos_id) + 1]
+            for t in emitted:
+                first = not s.tokens
+                s.tokens.append(t)
+                _observe_emit(self.metrics, s, first=first)
+            s.remaining -= len(emitted)
+            spec_emitted += len(emitted)
+            self._last[i] = int(next_h[i])
+            if self.metrics is not None:
+                self.metrics.observe("serve_spec_accept_rate", (e - 1) / k)
+            if s.remaining <= 0 or (
+                self.eos_id is not None
+                and emitted
+                and emitted[-1] == self.eos_id
+            ):
+                s.active = False
+        self.stats["spec_tokens"] += spec_emitted
+        if self.metrics is not None:
+            # counter pair: tokens_per_step / steps_total is the mean
+            # multi-token yield per verify program
+            self.metrics.inc("serve_spec_tokens_per_step", spec_emitted)
+            self.metrics.inc("serve_spec_steps_total")
 
     # -- the batch convenience loop ----------------------------------------
     def run(

@@ -53,6 +53,48 @@ from kubegpu_tpu.models.decoding import DecodeLM, init_caches
 from kubegpu_tpu.utils.metrics import Metrics
 
 
+def load_draft_checkpoint(ckpt_dir: str, *, vocab_size: int,
+                          num_layers: int, num_heads: int, hidden: int,
+                          max_seq: int):
+    """Restore a DRAFT model's params for speculative serving from an
+    orbax checkpoint directory (the worker's ``<ckpt>/lm`` layout),
+    bf16-cast to the serving precision.  Returns ``None`` when the
+    directory holds no checkpoint — callers fall back to a fresh init
+    (lossless either way; only the accept rate changes).
+
+    This is the ONE draft-restore path shared by the worker's
+    ``--draft-ckpt-dir`` and the gateway's ``--draft-checkpoint``: the
+    draft must ride the same restore/cast semantics as the target
+    (models/worker.py's serve path) or its proposals silently sample a
+    different numerics class than the checkpoints it was trained with."""
+    import os
+
+    import jax
+
+    from kubegpu_tpu.models.checkpoint import make_manager, restore_checkpoint
+    from kubegpu_tpu.models.decoding import bf16_cast
+    from kubegpu_tpu.models.train import train_state_template
+    from kubegpu_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(
+        vocab_size=vocab_size, num_layers=num_layers, num_heads=num_heads,
+        hidden=hidden, max_seq=max_seq,
+    )
+    mgr = make_manager(os.path.join(os.path.abspath(ckpt_dir), "lm"))
+    restored = restore_checkpoint(
+        mgr,
+        train_state_template(
+            model, jax.random.PRNGKey(0),
+            jnp.ones((1, 8), jnp.int32),
+        ),
+    )
+    if restored is None:
+        return None
+    params = bf16_cast(restored.params)
+    del restored  # drop step/optimizer moments promptly
+    return params
+
+
 @dataclass
 class _Slot:
     seq_id: int = -1          # index into the submitted prompt list

@@ -29,6 +29,20 @@ and a different acceptance rule); greedy verification is exact prefix
 matching and keeps the batcher token-identical to `greedy_generate`.
 ``run`` rejects non-zero temperatures rather than silently degrading.
 
+Losslessness is guaranteed PER NUMERICS CLASS, and that scoping is
+load-bearing (the root cause behind the r5 ``spec_serving_match_dense:
+false`` artifact): the host algorithm is exact — at fp32 this batcher is
+token-identical to ``ContinuousBatcher`` across retire/admit/budget/EOS
+churn (bench fp32 identity gate + property tests) — but at bf16 the
+(b, k+1) verify forward's K/V cache writes can differ from the (b, 1)
+step forward's by ~1 ULP wherever the backend re-blocks the GEMM for the
+wider shape.  Bit-level window replays show every window still emits the
+dense tokens; the drift enters the CACHE and may flip a later argmax
+whose top1-top2 margin is within the drift (measured margins at first
+divergence ~4e-4 on trained weights — pure tie-flips, same class as the
+int8 agreement rows).  bench.py records bf16 agreement + margins and
+hard-gates fp32 identity.
+
 Cache-depth invariant: a step writes rows [pos, pos+k] in both models'
 caches (rejected rows are junk that the NEXT step's chunk — or the next
 admission's full-slot splice — overwrites; attention never reads past the

@@ -430,6 +430,67 @@ def _run_pp(args, t0: float) -> int:
     )
 
 
+def _draft_for(args, max_seq: int):
+    """Draft model params + dims for speculative serving: restored from
+    ``--draft-ckpt-dir`` when given (models.serving.load_draft_checkpoint
+    — the shared restore/bf16-cast path), fresh-init otherwise (lossless
+    for ANY draft; a trained draft is what buys the accept rate).
+
+    Also enforces the ONE speculation headroom rule for both serving
+    modes (dense speculative and paged --speculate): a verify window
+    writes rows [pos, pos+k], so the cache needs k rows past
+    prompt+budget."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubegpu_tpu.models import TransformerLM
+    from kubegpu_tpu.models.decoding import bf16_cast
+
+    if args.prompt_len + args.steps + args.spec_k > max_seq:
+        raise SystemExit(
+            f"--prompt-len {args.prompt_len} + --steps {args.steps} + "
+            f"--spec-k {args.spec_k} exceeds --seq+1 = {max_seq}: the "
+            "speculative verify window needs k rows of cache headroom"
+        )
+
+    d_hidden = args.draft_hidden or max(args.hidden // 4, 128)
+    d_heads = max(d_hidden // 128, 1)
+    if d_hidden % d_heads:
+        # fail crisply like the other CLI geometry checks, not with a
+        # reshape traceback from inside jax tracing
+        raise SystemExit(
+            f"--draft-hidden {d_hidden} not divisible by its derived "
+            f"head count {d_heads} (heads are d_hidden//128; pick a "
+            "multiple of 128)"
+        )
+    dparams = None
+    if args.draft_ckpt_dir:
+        from kubegpu_tpu.models.serving import load_draft_checkpoint
+
+        dparams = load_draft_checkpoint(
+            args.draft_ckpt_dir, vocab_size=args.vocab,
+            num_layers=args.draft_layers, num_heads=d_heads,
+            hidden=d_hidden, max_seq=max_seq,
+        )
+        if dparams is not None:
+            print("RESTORED_DRAFT_FOR_SERVING", flush=True)
+        else:
+            log.warning(
+                "no draft checkpoint under %s; speculating with a fresh "
+                "draft init (lossless, but accept rate will be ~0)",
+                args.draft_ckpt_dir,
+            )
+    if dparams is None:
+        draft = TransformerLM(
+            vocab_size=args.vocab, num_layers=args.draft_layers,
+            num_heads=d_heads, hidden=d_hidden, max_seq=max_seq,
+        )
+        dparams = jax.jit(
+            lambda r, x: bf16_cast(draft.init(r, x)["params"])
+        )(jax.random.PRNGKey(7), jnp.ones((1, 8), jnp.int32))
+    return dparams, d_heads, d_hidden
+
+
 def _run_decode_batched(args, params, max_seq: int, t0: float) -> int:
     """--serving continuous|paged: serve a mixed-length queue through the
     slot-based batchers.  One "request wave" = slots x 2 prompts with
@@ -447,41 +508,14 @@ def _run_decode_batched(args, params, max_seq: int, t0: float) -> int:
 
         cb = ContinuousBatcher(params, **common, quant=args.int8)
     elif args.serving == "speculative":
-        import jax
-        import jax.numpy as jnp
-
-        from kubegpu_tpu.models import TransformerLM
         from kubegpu_tpu.models.spec_serving import SpeculativeContinuousBatcher
 
-        if args.prompt_len + args.steps + args.spec_k > max_seq:
-            raise SystemExit(
-                f"--prompt-len {args.prompt_len} + --steps {args.steps} + "
-                f"--spec-k {args.spec_k} exceeds --seq+1 = {max_seq}: the "
-                "speculative batcher needs k rows of cache headroom"
-            )
-        # the draft: a shrunk twin, fresh-init by default — output is
-        # token-identical to the dense batcher for ANY draft (greedy
+        # the draft: a shrunk twin (or --draft-ckpt-dir weights) — output
+        # is token-identical to the dense batcher for ANY draft (greedy
         # verification); a TRAINED draft is what turns the correctness
-        # into a speedup (see bench.py trained_quality)
-        d_hidden = args.draft_hidden or max(args.hidden // 4, 128)
-        d_heads = max(d_hidden // 128, 1)
-        if d_hidden % d_heads:
-            # fail crisply like the other CLI geometry checks, not with a
-            # reshape traceback from inside jax tracing
-            raise SystemExit(
-                f"--draft-hidden {d_hidden} not divisible by its derived "
-                f"head count {d_heads} (heads are d_hidden//128; pick a "
-                "multiple of 128)"
-            )
-        from kubegpu_tpu.models.decoding import bf16_cast
-
-        draft = TransformerLM(
-            vocab_size=args.vocab, num_layers=args.draft_layers,
-            num_heads=d_heads, hidden=d_hidden, max_seq=max_seq,
-        )
-        dparams = jax.jit(
-            lambda r, x: bf16_cast(draft.init(r, x)["params"])
-        )(jax.random.PRNGKey(7), jnp.ones((1, 8), jnp.int32))
+        # into a speedup (see bench.py trained_quality).  _draft_for
+        # enforces the k-row cache-headroom bound
+        dparams, d_heads, d_hidden = _draft_for(args, max_seq)
         cb = SpeculativeContinuousBatcher(
             params, dparams, **common, quant=args.int8, k=args.spec_k,
             draft_num_layers=args.draft_layers, draft_num_heads=d_heads,
@@ -494,10 +528,23 @@ def _run_decode_batched(args, params, max_seq: int, t0: float) -> int:
         # it divides, else one page spans the whole prompt pad
         page = 128 if args.prompt_len % 128 == 0 else args.prompt_len
         slots = args.batch_per_chip
-        pool = slots * -(-(args.prompt_len + args.steps) // page) + 1
+        spec_kw = {}
+        k_extra = 0
+        if args.speculate:
+            # _draft_for enforces the k-row cache-headroom bound
+            dparams, d_heads, d_hidden = _draft_for(args, max_seq)
+            spec_kw = dict(
+                draft_params=dparams, speculate_k=args.spec_k,
+                draft_num_layers=args.draft_layers,
+                draft_num_heads=d_heads, draft_hidden=d_hidden,
+            )
+            k_extra = args.spec_k  # per-sequence page-reservation headroom
+        pool = slots * -(
+            -(args.prompt_len + args.steps + k_extra) // page
+        ) + 1
         cb = PagedContinuousBatcher(
             params, **common, quant=args.int8, page_size=page,
-            pool_pages=pool,
+            pool_pages=pool, **spec_kw,
         )
 
     rng = np.random.RandomState(0)
@@ -737,6 +784,16 @@ def main(argv=None) -> int:
                     help="speculative: draft depth")
     ap.add_argument("--draft-hidden", type=int, default=0,
                     help="speculative: draft width (0 = hidden/4)")
+    ap.add_argument("--speculate", action="store_true",
+                    help="paged serving: draft-then-verify multi-token "
+                    "decode through the page pool (--spec-k deep; OFF by "
+                    "default — greedy-lossless, so output is identical "
+                    "either way)")
+    ap.add_argument(
+        "--draft-ckpt-dir", default="",
+        help="orbax checkpoint root for the DRAFT model "
+        "(<dir>/lm layout, like --ckpt-dir); empty = fresh-init draft",
+    )
     ap.add_argument(
         "--ckpt-dir",
         default=os.environ.get("KUBEGPU_CKPT_DIR", ""),

@@ -9,14 +9,18 @@ from kubegpu_tpu.ops.attention import (
     ulysses_attention_sharded,
 )
 from kubegpu_tpu.ops.paged_attention import (
+    paged_chunk_attention,
     paged_decode_attention,
     reference_paged_attention,
+    reference_paged_chunk_attention,
 )
 
 __all__ = [
     "flash_attention",
+    "paged_chunk_attention",
     "paged_decode_attention",
     "reference_paged_attention",
+    "reference_paged_chunk_attention",
     "reference_attention",
     "ring_attention",
     "ring_attention_sharded",

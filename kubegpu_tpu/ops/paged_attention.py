@@ -68,6 +68,28 @@ def reference_paged_attention(q, k_pool, v_pool, page_table, lengths):
     return out.astype(q.dtype)
 
 
+def reference_paged_chunk_attention(q, k_pool, v_pool, page_table, lengths):
+    """Oracle for the multi-query chunk kernel: q (b, L, h, hd); query row
+    j attends cols < lengths[b] + j (intra-window causal — row j sees the
+    window's earlier rows and itself, exactly the semantics a speculative
+    verify chunk needs once all L rows' K/V are written).  f32 math."""
+    b, L, h, hd = q.shape
+    n_pages = page_table.shape[1]
+    page = k_pool.shape[2]
+    S = n_pages * page
+    k = jnp.moveaxis(k_pool[page_table], 1, 2).reshape(b, h, S, hd)
+    v = jnp.moveaxis(v_pool[page_table], 1, 2).reshape(b, h, S, hd)
+    scores = jnp.einsum(
+        "blhd,bhsd->bhls", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) / math.sqrt(hd)
+    cols = jnp.arange(S)[None, None, None, :]
+    lim = (lengths[:, None] + jnp.arange(L)[None, :])[:, None, :, None]
+    scores = jnp.where(cols < lim, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhls,bhsd->blhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
 def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                   m_ref, l_ref, acc_ref, *, sm_scale: float, page: int):
     """One (slot, logical-page) grid step: fold this page into the slot's
@@ -178,5 +200,142 @@ def paged_decode_attention(
         partial(_paged_kernel, sm_scale=1.0 / math.sqrt(hd), page=page),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, hd), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32), q, k_pool, v_pool)
+
+
+def _paged_chunk_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                        m_ref, l_ref, acc_ref, *, sm_scale: float, page: int,
+                        L: int):
+    """One (slot, logical-page) grid step of the MULTI-QUERY kernel: fold
+    this page into L independent online-softmax states — one per query
+    row, stacked along the scratch's leading (L*h) dim.  The L loop is a
+    static unroll (L = k+1 is small), so every row's fold is the exact
+    single-query recipe with its own causal limit ``base + j``."""
+    b_i = pl.program_id(0)
+    p_i = pl.program_id(1)
+    n_p = pl.num_programs(1)
+
+    @pl.when(p_i == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    base = len_ref[b_i]  # rows attendable by query row 0; row j sees +j
+
+    # the page is live if ANY query row's window reaches it (row L-1 has
+    # the widest window); per-row masking below zeroes the rest
+    @pl.when(p_i * page < base + L - 1)
+    def _compute():
+        k = k_ref[0].astype(jnp.float32)               # (h, page, hd)
+        v = v_ref[0].astype(jnp.float32)
+        h_ = k.shape[0]
+        for j in range(L):
+            lo = j * h_
+            q = q_ref[0, j].astype(jnp.float32)        # (h, hd)
+            scores = jnp.sum(q[:, None, :] * k, axis=-1) * sm_scale
+            cols = (
+                jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+                + p_i * page
+            )
+            scores = jnp.where(cols < base + j, scores, NEG_INF)
+
+            m_prev = m_ref[lo:lo + h_, :1]             # (h, 1)
+            m_cur = jnp.max(scores, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+            p = jnp.exp(scores - shift)                # (h, page)
+            correction = jnp.where(
+                jnp.isfinite(m_prev), jnp.exp(m_prev - shift), 0.0
+            )
+            l_ref[lo:lo + h_, :] = jnp.broadcast_to(
+                correction * l_ref[lo:lo + h_, :1]
+                + jnp.sum(p, axis=-1, keepdims=True),
+                (h_, l_ref.shape[1]),
+            )
+            acc_ref[lo:lo + h_, :] = acc_ref[lo:lo + h_, :] * correction + (
+                jnp.sum(p[:, :, None] * v, axis=1)
+            )
+            m_ref[lo:lo + h_, :] = jnp.broadcast_to(
+                m_new, (h_, m_ref.shape[1])
+            )
+
+    @pl.when(p_i == n_p - 1)
+    def _finalize():
+        h_ = k_ref.shape[1]
+        for j in range(L):
+            lo = j * h_
+            l = l_ref[lo:lo + h_, :1]
+            denom = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0, j] = (acc_ref[lo:lo + h_, :] / denom).astype(o_ref.dtype)
+
+
+def paged_chunk_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    page_table: jax.Array,
+    lengths: jax.Array,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Multi-query paged attention: L query tokens per slot over the paged
+    KV pool — the q-length-(k+1) extension of ``paged_decode_attention``
+    a speculative verify step needs (ONE program scores every position of
+    every slot's draft window against the shared pool).
+
+    q: (b, L, h, hd) — the window's rows, all already written to the pool
+    at rows [lengths-1, lengths-1+L); k_pool/v_pool: (pool_pages, h,
+    page_size, hd); page_table: (b, n_pages) int32; lengths: (b,) int32 —
+    rows attendable by query row 0 (row j attends cols < lengths + j:
+    intra-window causal).  Returns (b, L, h, hd) in q's dtype.
+
+    Same DMA discipline as the single-query kernel: dead pages re-point
+    at the last live page (pipeline elides the repeat DMA), where "live"
+    is the WIDEST row's window — HBM traffic stays O(live pages), and the
+    marginal cost of the extra k query rows is VPU compute only, which is
+    why one verify program beats k+1 decode steps on a bandwidth-bound
+    pool."""
+    b, L, h, hd = q.shape
+    _, hp, page, hdp = k_pool.shape
+    assert (hp, hdp) == (h, hd), (k_pool.shape, q.shape)
+    assert L >= 1, L
+    n_pages = page_table.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def kv_map(b_i, p_i, tbl, ln):
+        # live through the WIDEST window (query row L-1 attends
+        # ln + L - 1 rows); dead pages alias the last live page so the
+        # pipeline skips their DMA — the single-query kernel's trick
+        live_pages = jnp.maximum((ln[b_i] + L - 1 + page - 1) // page, 1)
+        p_eff = jnp.minimum(p_i, live_pages - 1)
+        return (tbl[b_i, p_eff], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,   # page_table, lengths
+        grid=(b, n_pages),
+        in_specs=[
+            pl.BlockSpec(
+                (1, L, h, hd), lambda b_i, p_i, tbl, ln: (b_i, 0, 0, 0)
+            ),
+            pl.BlockSpec((1, h, page, hd), kv_map),
+            pl.BlockSpec((1, h, page, hd), kv_map),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, L, h, hd), lambda b_i, p_i, tbl, ln: (b_i, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((L * h, 128), jnp.float32),  # running max per (row, head)
+            pltpu.VMEM((L * h, 128), jnp.float32),  # running denominator
+            pltpu.VMEM((L * h, hd), jnp.float32),   # running numerator
+        ],
+    )
+    return pl.pallas_call(
+        partial(
+            _paged_chunk_kernel, sm_scale=1.0 / math.sqrt(hd), page=page, L=L
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, L, h, hd), q.dtype),
         interpret=interpret,
     )(page_table.astype(jnp.int32), lengths.astype(jnp.int32), q, k_pool, v_pool)

@@ -12,7 +12,9 @@ current value, ``set_gauge`` — queue depth, live replicas), and histograms
 from __future__ import annotations
 
 import threading
+import time
 from collections import defaultdict, deque
+from contextlib import contextmanager
 from typing import Dict, List, Tuple
 
 # Quantiles come from a bounded reservoir of the most recent observations;
@@ -53,6 +55,18 @@ class Metrics:
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             return self._gauges.get(key, 0.0)
+
+    @contextmanager
+    def timer(self, name: str):
+        """Observe the wall time of a ``with`` block into histogram
+        ``name`` — the phase-timer idiom (e.g. speculative draft vs
+        verify seconds); callers fencing device work must read the
+        result back inside the block or the timer measures dispatch."""
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.observe(name, time.monotonic() - t0)
 
     def observe(self, name: str, value: float) -> None:
         with self._lock:

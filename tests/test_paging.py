@@ -11,8 +11,10 @@ import jax.numpy as jnp
 from kubegpu_tpu.models import TransformerLM, greedy_generate
 from kubegpu_tpu.models.paging import PagedContinuousBatcher, PagedDecodeLM
 from kubegpu_tpu.ops.paged_attention import (
+    paged_chunk_attention,
     paged_decode_attention,
     reference_paged_attention,
+    reference_paged_chunk_attention,
 )
 
 pytestmark = pytest.mark.slow
@@ -46,6 +48,58 @@ def test_paged_kernel_matches_dense_reference():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
     )
+
+
+def test_paged_chunk_kernel_matches_reference():
+    """The multi-query verify kernel against its intra-window-causal
+    oracle: shuffled tables, ragged lengths, including a window whose
+    widest row spills onto a page the narrowest row never touches
+    (lengths near a page boundary) and a length-1 slot."""
+    rng = np.random.RandomState(1)
+    b, h, hd, page, n_pages, pool, L = 4, 8, 128, 128, 4, 16, 5
+    q = jnp.asarray(rng.randn(b, L, h, hd), jnp.float32)
+    kp = jnp.asarray(rng.randn(pool, h, page, hd), jnp.float32) * 0.3
+    vp = jnp.asarray(rng.randn(pool, h, page, hd), jnp.float32) * 0.3
+    table = jnp.asarray(
+        np.stack([rng.choice(pool, n_pages, replace=False) for _ in range(b)]),
+        jnp.int32,
+    )
+    # 254/508: rows 2..4 of the window cross onto the next page
+    lengths = jnp.asarray([1, 200, 254, 508], jnp.int32)
+    out = paged_chunk_attention(q, kp, vp, table, lengths)
+    ref = reference_paged_chunk_attention(q, kp, vp, table, lengths)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_paged_chunk_kernel_rows_bit_match_decode_kernel():
+    """Window row j must equal the single-query kernel at lengths+j
+    BIT-EXACTLY (not just to tolerance): both fold pages through the
+    same online-softmax recipe in f32 scratch, so the verify program's
+    per-position outputs are the decode program's outputs — the kernel
+    half of the spec-serving losslessness argument (the other half, the
+    projection GEMMs, is covered by the fp32 batcher identity tests)."""
+    rng = np.random.RandomState(2)
+    b, h, hd, page, n_pages, pool, L = 3, 4, 128, 128, 4, 12, 3
+    q = jnp.asarray(rng.randn(b, L, h, hd), jnp.float32)
+    kp = jnp.asarray(rng.randn(pool, h, page, hd), jnp.float32) * 0.3
+    vp = jnp.asarray(rng.randn(pool, h, page, hd), jnp.float32) * 0.3
+    table = jnp.asarray(
+        np.stack([rng.choice(pool, n_pages, replace=False) for _ in range(b)]),
+        jnp.int32,
+    )
+    lengths = jnp.asarray([1, 127, 300], jnp.int32)
+    out = np.asarray(paged_chunk_attention(q, kp, vp, table, lengths))
+    for j in range(L):
+        single = np.asarray(
+            paged_decode_attention(q[:, j], kp, vp, table, lengths + j)
+        )
+        assert (out[:, j] == single).all(), f"window row {j} diverged"
+    # L=1 is the degenerate window: one row, same causal limit
+    one = np.asarray(paged_chunk_attention(q[:, :1], kp, vp, table, lengths))
+    single0 = np.asarray(paged_decode_attention(q[:, 0], kp, vp, table, lengths))
+    assert (one[:, 0] == single0).all()
 
 
 def test_paged_decode_lm_param_tree_matches_training_model():

@@ -1,0 +1,380 @@
+"""Speculative decoding on the paged serving path (ISSUE 4).
+
+The contract under test: with a draft model attached
+(``speculate_k=k``), ``PagedContinuousBatcher`` emits EXACTLY the tokens
+the non-speculative paged batcher emits (which the station tests pin to
+the dense batcher and the per-sequence greedy oracle) — for ANY draft,
+across speculation depths, station widths, token budgets, prefix-cache
+hits, EOS early-exit, and slot churn.  The draft only moves how many
+verify programs the stream costs.  fp32 everywhere: losslessness is
+guaranteed per numerics class (see models/spec_serving.py — at bf16 the
+(b, k+1) verify GEMMs may round ~1 ULP apart from the (b, 1) step's,
+which is a tie-flip class, not a bookkeeping bug; these tests hold the
+HOST algorithm to token-exactness where the class guarantees it).
+
+Also here: the dense ``SpeculativeContinuousBatcher`` fp32 regression on
+the exact slot-churn traffic that exposed the r5
+``spec_serving_match_dense: false`` artifact, the GatewaySoak kill
+schedule with speculation on (no page leaked by rejected drafts), the
+compile-stability bound for the three speculative programs, and the
+``serve_spec_*`` metrics in the shared exposition format.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubegpu_tpu.models import TransformerLM, greedy_generate
+from kubegpu_tpu.models.paging import PagedContinuousBatcher
+from kubegpu_tpu.models.serving import ContinuousBatcher
+from kubegpu_tpu.utils.metrics import Metrics
+
+pytestmark = pytest.mark.slow
+
+CFG = dict(vocab_size=61, num_layers=2, num_heads=4, hidden=32, max_seq=32)
+DRAFT = dict(draft_num_layers=1, draft_num_heads=2, draft_hidden=16)
+
+
+def trained_params():
+    model = TransformerLM(dtype=jnp.float32, **CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.ones((2, 8), jnp.int32))[
+        "params"
+    ]
+
+
+def draft_params():
+    # an independent random init: a HOPELESS draft (the all-reject path);
+    # perfect-draft coverage reuses the target's own params
+    model = TransformerLM(
+        vocab_size=CFG["vocab_size"], max_seq=CFG["max_seq"],
+        num_layers=DRAFT["draft_num_layers"],
+        num_heads=DRAFT["draft_num_heads"], hidden=DRAFT["draft_hidden"],
+        dtype=jnp.float32,
+    )
+    return model.init(jax.random.PRNGKey(7), jnp.ones((2, 8), jnp.int32))[
+        "params"
+    ]
+
+
+def oracle(params, prompt, n):
+    out = greedy_generate(
+        params, jnp.asarray(prompt)[None, :], n, dtype=jnp.float32, **CFG
+    )
+    return list(np.asarray(out)[0, len(prompt):])
+
+
+def make_paged(params, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("prompt_pad", 16)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("pool_pages", 40)
+    return PagedContinuousBatcher(params, dtype=jnp.float32, **CFG, **kw)
+
+
+def make_spec_paged(params, dparams, k, **kw):
+    return make_paged(
+        params, draft_params=dparams, speculate_k=k, **DRAFT, **kw
+    )
+
+
+# ---------------------------------------------------------------------------
+# Property: spec-paged ≡ paged ≡ dense oracle across the grid
+# ---------------------------------------------------------------------------
+
+def test_spec_paged_token_identical_across_k_and_stations():
+    """Greedy, fixed seed, slot churn (10 sequences through 4 slots),
+    prompt lengths straddling page boundaries, a duplicate prompt (an
+    in-burst prefix-cache hit), mixed budgets — the speculative batcher
+    must emit the per-sequence oracle's exact tokens for k ∈ {1, 2, 4}
+    with both a hopeless and a perfect draft, across station widths and
+    under a token budget (where a speculative slot bills k+1 rows)."""
+    params = trained_params()
+    dparams = draft_params()
+    rng = np.random.RandomState(0)
+    lengths = (1, 3, 4, 5, 7, 8, 9, 12, 13)
+    prompts = [
+        np.array(rng.randint(0, CFG["vocab_size"], size=n), np.int32)
+        for n in lengths
+    ]
+    prompts.append(prompts[6].copy())  # duplicate: prefix-cache hit
+    budgets = [5, 4, 6, 3, 5, 4, 6, 5, 4, 5]
+    expected = {
+        i: oracle(params, p, n)
+        for i, (p, n) in enumerate(zip(prompts, budgets))
+    }
+    plain = make_paged(params)
+    assert plain.run(prompts, budgets) == expected
+    plain.assert_page_accounting()
+    for kw in (
+        dict(k=1),
+        dict(k=2, station_slots=2),
+        dict(k=4, station_slots=4),
+        dict(k=2, token_budget=9),
+        dict(k=4, station_slots=2, token_budget=12),
+    ):
+        k = kw.pop("k")
+        cb = make_spec_paged(params, dparams, k, **kw)
+        got = cb.run(prompts, budgets)
+        assert got == expected, (k, kw, {
+            i: (got[i], expected[i])
+            for i in expected if got[i] != expected[i]
+        })
+        cb.assert_page_accounting()
+        assert cb.stats["spec_steps"] > 0
+        # the duplicate prompt still hits its twin's registered pages:
+        # speculation must not break prefix sharing (windows write only
+        # private pages — sharable pages end below the first decode row)
+        assert cb.stats["prefix_hit_tokens"] >= 8, (k, kw)
+    # perfect draft (the target itself): the all-accept path — same
+    # tokens, strictly fewer verify programs than the hopeless draft
+    hopeless = make_spec_paged(params, dparams, 4)
+    assert hopeless.run(prompts, budgets) == expected
+    perfect = make_paged(
+        params, draft_params=params, speculate_k=4,
+        draft_num_layers=CFG["num_layers"],
+        draft_num_heads=CFG["num_heads"], draft_hidden=CFG["hidden"],
+    )
+    assert perfect.run(prompts, budgets) == expected
+    assert perfect.stats["spec_steps"] < hopeless.stats["spec_steps"]
+    # ...and the hopeless draft still advances ≥1 token per verify
+    assert hopeless.stats["spec_tokens"] >= hopeless.stats["spec_steps"]
+
+
+def test_spec_paged_eos_early_exit_and_budget_cap():
+    """A window may carry tokens past EOS or past the slot's remaining
+    budget: the surplus must be dropped exactly like the non-speculative
+    batcher drops it (stream truncated at EOS; remaining never goes
+    negative), and the pages of retired sequences must balance."""
+    params = trained_params()
+    dparams = draft_params()
+    rng = np.random.RandomState(1)
+    prompts = [
+        np.array(rng.randint(0, CFG["vocab_size"], size=n), np.int32)
+        for n in (3, 5, 7, 4)
+    ]
+    budgets = [6, 9, 4, 8]
+    for eos in (None, 7, 0):
+        plain = make_paged(params, eos_id=eos)
+        expected = plain.run(prompts, budgets)
+        plain.assert_page_accounting()
+        for k in (1, 3):
+            cb = make_spec_paged(params, dparams, k, eos_id=eos)
+            got = cb.run(prompts, budgets)
+            assert got == expected, (eos, k)
+            cb.assert_page_accounting()
+            for i, toks in got.items():
+                assert len(toks) <= budgets[i]
+                if eos is not None and eos in toks:
+                    assert toks.index(eos) == len(toks) - 1
+
+
+def test_spec_paged_incremental_api_with_cancel():
+    """submit/serve_step/cancel churn: cancelling a mid-decode
+    speculative sequence frees its pages (junk window writes on the dead
+    slot touch only pages the sequence owned), and the survivors' tokens
+    stay oracle-exact."""
+    params = trained_params()
+    dparams = draft_params()
+    rng = np.random.RandomState(2)
+    prompts = [
+        np.array(rng.randint(0, CFG["vocab_size"], size=n), np.int32)
+        for n in (4, 6, 9, 5)
+    ]
+    cb = make_spec_paged(params, dparams, 2)
+    for i, p in enumerate(prompts):
+        cb.submit(i, p, 8)
+    # let prefill/first windows run, then kill seq 1 mid-flight
+    done = {}
+    for _ in range(3):
+        done.update(cb.serve_step())
+    assert cb.cancel(1)
+    while cb.has_work():
+        done.update(cb.serve_step())
+    assert 1 not in done
+    for i in (0, 2, 3):
+        assert done[i] == oracle(params, prompts[i], 8), i
+    cb.assert_page_accounting()
+
+
+# ---------------------------------------------------------------------------
+# Guards: construction and submission contracts
+# ---------------------------------------------------------------------------
+
+def test_spec_paged_guards():
+    params = trained_params()
+    dparams = draft_params()
+    with pytest.raises(ValueError, match="speculate_k"):
+        make_spec_paged(params, dparams, 0)
+    with pytest.raises(ValueError, match="draft model"):
+        make_paged(params, speculate_k=2)
+    cb = make_spec_paged(params, dparams, 2)
+    # greedy-only: lossless speculative SAMPLING is a different program
+    with pytest.raises(ValueError, match="greedy-only"):
+        cb.submit(0, np.array([1, 2], np.int32), 4, temperature=0.7)
+    # k rows of cache headroom beyond the dense bound (max_seq 32)
+    with pytest.raises(ValueError, match="headroom"):
+        cb.submit(1, np.array([1, 2, 3], np.int32), 28)
+    # the same request is fine without speculation
+    make_paged(params).submit(1, np.array([1, 2, 3], np.int32), 28)
+
+
+# ---------------------------------------------------------------------------
+# Dense spec batcher: the r5 divergence traffic, fp32 regression
+# ---------------------------------------------------------------------------
+
+def test_dense_spec_batcher_matches_dense_batcher_under_churn():
+    """The EXACT traffic shape that exposed ``spec_serving_match_dense:
+    false`` (16 mixed-budget prompts through 8 slots, multi-hundred-token
+    budgets, slot churn), held to token-identity at fp32 — where the
+    numerics class guarantees the host algorithm shows through.  Guards
+    the retire/admit/budget bookkeeping against regressions; the bf16
+    tie-flip class is bench-instrumented (margins), not tested here."""
+    from kubegpu_tpu.models.spec_serving import SpeculativeContinuousBatcher
+
+    cfg = dict(
+        vocab_size=128, num_layers=2, num_heads=2, hidden=32, max_seq=128
+    )
+    params = TransformerLM(dtype=jnp.float32, **cfg).init(
+        jax.random.PRNGKey(3), jnp.ones((1, 8), jnp.int32)
+    )["params"]
+    dp = TransformerLM(
+        vocab_size=128, num_layers=1, num_heads=2, hidden=16, max_seq=128,
+        dtype=jnp.float32,
+    ).init(jax.random.PRNGKey(9), jnp.ones((1, 8), jnp.int32))["params"]
+    rs = np.random.RandomState(1)
+    budgets = [(8, 16, 24, 40)[i % 4] for i in range(16)]
+    prompts = [
+        np.asarray(rs.randint(0, 128, size=rs.randint(4, 16)), np.int32)
+        for _ in range(16)
+    ]
+    dense = ContinuousBatcher(
+        params, slots=8, prompt_pad=16, dtype=jnp.float32, **cfg
+    ).run(prompts, budgets)
+    spec = SpeculativeContinuousBatcher(
+        params, dp, k=4, slots=8, prompt_pad=16,
+        draft_num_layers=1, draft_num_heads=2, draft_hidden=16,
+        dtype=jnp.float32, **cfg,
+    ).run(prompts, budgets)
+    assert spec == dense, {
+        i: (dense[i][:6], spec[i][:6])
+        for i in dense if spec.get(i) != dense[i]
+    }
+
+
+# ---------------------------------------------------------------------------
+# Soak: kill schedule with speculation on — no page leaked by drafts
+# ---------------------------------------------------------------------------
+
+def test_gateway_soak_kill_schedule_with_speculation():
+    """GatewaySoak's kill/revive/hedge schedule over SPECULATIVE paged
+    batchers: invariant I5 (served exactly once or explicitly rejected)
+    plus assert_page_accounting on every surviving replica — rejected
+    draft tails must never leak pool pages (rollback is don't-commit;
+    the junk rows live in pages the sequence already owns)."""
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    tiny = dict(vocab_size=61, num_layers=1, num_heads=2, hidden=16,
+                max_seq=24)
+    params = TransformerLM(dtype=jnp.float32, **tiny).init(
+        jax.random.PRNGKey(1), jnp.ones((1, 4), jnp.int32)
+    )["params"]
+    soak = GatewaySoak(
+        seed=17, n_replicas=2,
+        batcher_factory=lambda key: PagedContinuousBatcher(
+            params, slots=4, prompt_pad=4, page_size=4, pool_pages=24,
+            station_slots=2, token_budget=8, dtype=jnp.float32,
+            draft_params=params, speculate_k=2,
+            draft_num_layers=tiny["num_layers"],
+            draft_num_heads=tiny["num_heads"],
+            draft_hidden=tiny["hidden"], **tiny,
+        ),
+    )
+    soak.run(steps=18)
+
+
+# ---------------------------------------------------------------------------
+# Compile stability: speculation mints exactly three programs, once each
+# ---------------------------------------------------------------------------
+
+def test_spec_compile_stability_fixed_jit_cache():
+    """A varied schedule — mixed lengths, cache hits, cancels, zero-
+    budget admits, EOS retirements, partial station occupancy — leaves
+    exactly ONE compiled entry for each speculative program
+    (draft-admit, draft scan, verify) and for the station programs; the
+    plain step program is never traced while speculation is on."""
+    params = trained_params()
+    dparams = draft_params()
+    rng = np.random.RandomState(5)
+    cb = make_spec_paged(params, dparams, 2, station_slots=2,
+                         token_budget=11, eos_id=3)
+    seq = 0
+    live = []
+    for _ in range(40):
+        roll = rng.rand()
+        if roll < 0.5:
+            n = int(rng.randint(1, 13))
+            max_new = int(rng.randint(0, 5))
+            prompt = (
+                np.arange(n, dtype=np.int32) % 7 if roll < 0.1
+                else np.array(
+                    rng.randint(0, CFG["vocab_size"], size=n), np.int32
+                )
+            )
+            cb.submit(seq, prompt, max_new)
+            live.append(seq)
+            seq += 1
+        elif roll < 0.6 and live:
+            cb.cancel(live.pop(rng.randint(len(live))))
+        else:
+            for s in cb.serve_step():
+                live.remove(s)
+    while cb.has_work():
+        for s in cb.serve_step():
+            live.remove(s)
+    cb.assert_page_accounting()
+    for name in ("_spec_draft", "_spec_verify", "_draft_admit", "_chunk",
+                 "_write_page"):
+        assert getattr(cb, name)._cache_size() == 1, (
+            f"{name}: {getattr(cb, name)._cache_size()} compiled entries"
+        )
+    assert cb._gather_page._cache_size() <= 1
+    assert cb._step._cache_size() == 0, "plain step traced under speculation"
+
+
+# ---------------------------------------------------------------------------
+# Metrics: serve_spec_* in the shared exposition format
+# ---------------------------------------------------------------------------
+
+def test_spec_metrics_exposition():
+    """The speculative batcher observes accept-rate, tokens-per-step and
+    the draft/verify phase timers into the SHARED registry, and they
+    render in the Prometheus text format next to the serving histograms."""
+    params = trained_params()
+    dparams = draft_params()
+    m = Metrics()
+    cb = make_spec_paged(params, dparams, 2, metrics=m)
+    rng = np.random.RandomState(6)
+    prompts = [
+        np.array(rng.randint(0, CFG["vocab_size"], size=n), np.int32)
+        for n in (4, 7)
+    ]
+    out = cb.run(prompts, [6, 5])
+    assert sum(len(v) for v in out.values()) == 11
+    assert m.histogram_count("serve_spec_accept_rate") > 0
+    assert m.histogram_count("serve_spec_draft_seconds") > 0
+    assert m.histogram_count("serve_spec_verify_seconds") > 0
+    assert m.get("serve_spec_tokens_per_step") == 11.0
+    assert m.get("serve_spec_steps_total") == cb.stats["spec_steps"]
+    # accept rate is a fraction of k: every sample within [0, 1]
+    assert 0.0 <= m.histogram_sum("serve_spec_accept_rate") <= (
+        m.histogram_count("serve_spec_accept_rate")
+    )
+    text = m.render()
+    for name in ("serve_spec_accept_rate", "serve_spec_draft_seconds",
+                 "serve_spec_verify_seconds"):
+        assert f"{name}_count" in text, name
+    assert "serve_spec_tokens_per_step 11" in text
+    # the non-speculative emit path still feeds TTFT/ITL
+    assert m.histogram_count("serve_ttft_seconds") == 2
