@@ -38,13 +38,15 @@ native:
 bench:
 	$(PY) bench.py
 
-# CPU-only serving-path micro-bench (<60 s): TTFT/ITL p95 with chunked
+# CPU-only serving-path micro-bench (~2 min): TTFT/ITL p95 with chunked
 # vs monolithic prefill, prefix-cache hit rate, burst TTFT p95
-# batched-station vs serial, and speculative vs plain paged decode tok/s
-# on tiny shapes; exits non-zero if chunked ITL regresses past
-# monolithic, hits vanish, the batched station's burst TTFT is not
-# strictly below serial, spec decode is not strictly above plain, or
-# tokens diverge on any of them
+# batched-station vs serial, speculative vs plain paged decode tok/s,
+# and multi-turn session KV reuse (turn-2 TTFT decode-page cache vs
+# prompt-only, <60 s on its own) on tiny shapes; exits non-zero if
+# chunked ITL regresses past monolithic, hits vanish, the batched
+# station's burst TTFT is not strictly below serial, spec decode is not
+# strictly above plain, turn-2 TTFT with decode-page caching is not
+# strictly below prompt-only, or tokens diverge on any of them
 bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve-smoke
 

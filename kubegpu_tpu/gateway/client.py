@@ -28,6 +28,13 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, FrozenSet, List, Optional
 
+# the paged batchers' session-KV-reuse policy values.  Canonically
+# declared in models/serving.py (DECODE_PAGE_CACHE_POLICIES); mirrored
+# here because the gateway layer is deliberately jax-free and must not
+# import the model stack for a three-string tuple.  The two tuples are
+# pinned equal by tests/test_multiturn_kv.py.
+DECODE_PAGE_CACHE_POLICIES = ("off", "fp32", "all")
+
 
 @dataclass
 class AttemptResult:
@@ -113,11 +120,17 @@ class SimBatcher:
     byte-identical to the non-speculative mill), and bills k+1 budget
     rows against ``token_budget`` whether or not the tail was accepted —
     exactly the paged scheduler's accounting (a speculative slot's
-    verify window is k+1 rows wide regardless of acceptance)."""
+    verify window is k+1 rows wide regardless of acceptance).
+
+    ``decode_page_cache`` is the paged batchers' session-KV-reuse policy
+    ({"off", "fp32", "all"}): the mill has no KV to seal, so it only
+    validates the widened contract — a policy typo must die at replica
+    construction here exactly as it would on a real batcher."""
 
     def __init__(self, slots: int = 8, vocab: int = 256,
                  token_budget: Optional[int] = None,
-                 speculate_k: Optional[int] = None) -> None:
+                 speculate_k: Optional[int] = None,
+                 decode_page_cache: str = "off") -> None:
         if token_budget is not None and token_budget <= 0:
             raise ValueError(
                 f"token_budget ({token_budget}) must be positive or None"
@@ -126,10 +139,16 @@ class SimBatcher:
             raise ValueError(
                 f"speculate_k ({speculate_k}) must be >= 1 or None"
             )
+        if decode_page_cache not in DECODE_PAGE_CACHE_POLICIES:
+            raise ValueError(
+                f"decode_page_cache must be one of "
+                f"{DECODE_PAGE_CACHE_POLICIES}, got {decode_page_cache!r}"
+            )
         self.slots = slots
         self.vocab = vocab
         self.token_budget = token_budget
         self.speculate_k = speculate_k
+        self.decode_page_cache = decode_page_cache
         self._pending: deque = deque()
         self._active: Dict[int, tuple] = {}  # seq -> (tokens, max_new)
         self._rr: deque = deque()            # active seqs in budget order

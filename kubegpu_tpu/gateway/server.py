@@ -227,7 +227,8 @@ class GatewayServer:
 # ---------------------------------------------------------------------------
 
 def _build_fake_serving_cluster(preset: str, replicas: int, group: str,
-                                token_budget=None, speculate_k=None):
+                                token_budget=None, speculate_k=None,
+                                decode_page_cache="off"):
     """Fabricated cluster + scheduled decode replicas + SimBatcher-backed
     in-memory data plane: the full serving path with zero dependencies."""
     from kubegpu_tpu.gateway.client import InMemoryReplicaClient, SimBatcher
@@ -250,7 +251,8 @@ def _build_fake_serving_cluster(preset: str, replicas: int, group: str,
     # its name tiebreak — the demo should demonstrate load spreading
     client = InMemoryReplicaClient(
         batcher_factory=lambda key: SimBatcher(
-            slots=8, token_budget=token_budget, speculate_k=speculate_k
+            slots=8, token_budget=token_budget, speculate_k=speculate_k,
+            decode_page_cache=decode_page_cache,
         ),
         step_delay_s=0.002,
     )
@@ -298,6 +300,22 @@ def main(argv=None) -> None:
         "budget rows per speculative slot; the SimBatcher data planes "
         "here model exactly that accounting",
     )
+    from kubegpu_tpu.gateway.client import DECODE_PAGE_CACHE_POLICIES
+
+    ap.add_argument(
+        "--decode-page-cache", default="off",
+        choices=list(DECODE_PAGE_CACHE_POLICIES),
+        help="replica batchers' session-KV-reuse policy: seal retired "
+        "sequences' DECODE-produced pages into the shared prefix cache "
+        "so a session's turn 2 (same session id — the affinity router "
+        "pins it to the replica holding the pages) skips re-prefilling "
+        "turn 1's output.  off = prompt pages only (default); fp32 = "
+        "share only at float32 serving precision (property-tested "
+        "greedy-token-identical); all = any dtype (bf16 may flip "
+        "near-tie argmaxes — measured in bench.py serving_multiturn).  "
+        "Consumed replica-side by the real paged batchers; the "
+        "in-process SimBatcher planes here only validate the contract",
+    )
     ap.add_argument(
         "--draft-checkpoint", default=None, metavar="DIR",
         help="orbax checkpoint directory holding the draft model's "
@@ -344,6 +362,7 @@ def main(argv=None) -> None:
         _, registry, client = _build_fake_serving_cluster(
             args.fake_cluster, args.replicas, args.group,
             token_budget=args.token_budget, speculate_k=args.speculate_k,
+            decode_page_cache=args.decode_page_cache,
         )
     else:
         from kubegpu_tpu.utils.apiserver import KubeApiServer
@@ -366,6 +385,7 @@ def main(argv=None) -> None:
                 batcher_factory=lambda key: SimBatcher(
                     slots=8, token_budget=args.token_budget,
                     speculate_k=args.speculate_k,
+                    decode_page_cache=args.decode_page_cache,
                 ),
                 step_delay_s=0.002,
             )

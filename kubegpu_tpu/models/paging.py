@@ -42,10 +42,19 @@ training.
   partial tail block is always a PRIVATE page (recomputed through the
   station — the copy-on-write discipline), so decode-step writes never
   touch a shared page.  Retirement drops refcounts; refcount-0 pages
-  stay cached LRU and are evicted only under pool pressure.  Only
-  dense-prefill-produced pages are cached (decode-produced K/V rides a
-  different numeric path), which keeps chunked + cached decode
-  token-identical to the monolithic path.
+  stay cached LRU and are evicted only under pool pressure.  By default
+  only dense-prefill-produced pages are cached (decode-produced K/V
+  rides a different numeric path), which keeps chunked + cached decode
+  token-identical to the monolithic path; ``decode_page_cache``
+  ({"off", "fp32", "all"}) additionally seals a RETIRING sequence's
+  complete pages — prompt and generated — into the chain, so a
+  multi-turn session's next prompt (turn-1 prompt + turn-1 output +
+  new text) hits through the generated region and prefills only the
+  genuinely new tokens.  Sharing decode pages mixes decode-kernel
+  numerics into shared K/V, hence the per-dtype gate: "fp32" is
+  property-tested greedy-token-identical to a fresh prefill; "all"
+  accepts bf16's measured near-tie argmax drift (bench.py
+  serving_multiturn reports agreement and margins).
 
 Memory math that motivates this: the dense batcher at 8 slots x 2048
 rows holds 16k rows per layer regardless of traffic; a paged pool
@@ -72,7 +81,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubegpu_tpu.models.decoding import DecodeLM, QuantDense, init_caches
-from kubegpu_tpu.models.serving import _observe_emit, _validate_request
+from kubegpu_tpu.models.serving import (
+    _observe_emit,
+    _validate_request,
+    resolve_decode_page_cache,
+)
 from kubegpu_tpu.ops.paged_attention import (
     paged_chunk_attention,
     paged_decode_attention,
@@ -223,12 +236,21 @@ class PrefixPageCache:
     at refcount 0 it stays cached — a later same-prefix request can still
     hit it — and becomes evictable in LRU order when the pool needs
     pages.  Host-side accounting only; the K/V bytes live in the pool.
+
+    Every entry carries a ``kind``: ``"prompt"`` for pages sealed by the
+    dense prefill station, ``"decode"`` for pages sealed at retirement
+    whose rows include decode-kernel-written K/V (the last prompt row
+    and/or generated tokens).  The chain key is identical either way —
+    the hash of every token through the page — so a turn-2 prompt hits
+    straight through a turn-1 session's generated region; the kind only
+    feeds the hit-split metrics and the dtype-policy story.
     """
 
     def __init__(self) -> None:
         self._entries: "OrderedDict[bytes, int]" = OrderedDict()
         self._refs: Dict[int, int] = {}
         self._key_of: Dict[int, bytes] = {}
+        self._kind_of: Dict[int, str] = {}
 
     def lookup(self, key: bytes) -> Optional[int]:
         """Peek without taking a reference (admission feasibility)."""
@@ -242,13 +264,15 @@ class PrefixPageCache:
         self._refs[page] += 1
         return page
 
-    def insert(self, key: bytes, page: int) -> None:
-        """Register a freshly-prefilled page; the caller holds one ref."""
+    def insert(self, key: bytes, page: int, kind: str = "prompt") -> None:
+        """Register a freshly-sealed page; the caller holds one ref."""
         assert key not in self._entries, "duplicate prefix key"
         assert page not in self._refs, "page already cached"
+        assert kind in ("prompt", "decode"), f"unknown page kind {kind!r}"
         self._entries[key] = page
         self._refs[page] = 1
         self._key_of[page] = key
+        self._kind_of[page] = kind
 
     def release(self, page: int) -> None:
         self._refs[page] -= 1
@@ -256,6 +280,9 @@ class PrefixPageCache:
 
     def refcount(self, page: int) -> int:
         return self._refs.get(page, 0)
+
+    def kind_of(self, page: int) -> str:
+        return self._kind_of[page]
 
     def idle_count(self) -> int:
         return sum(1 for r in self._refs.values() if r == 0)
@@ -268,11 +295,27 @@ class PrefixPageCache:
                 del self._entries[key]
                 del self._refs[page]
                 del self._key_of[page]
+                del self._kind_of[page]
                 return page
         return None
 
     def pages(self) -> Set[int]:
         return set(self._refs)
+
+    def assert_consistent(self) -> None:
+        """Internal-map alignment (the page-accounting invariant's cache
+        leg): entries/refs/keys/kinds describe exactly the same page set,
+        and every entry's reverse mapping agrees."""
+        assert set(self._refs) == set(self._key_of) == set(self._kind_of), (
+            "cache maps diverged: "
+            f"refs={sorted(self._refs)} keys={sorted(self._key_of)} "
+            f"kinds={sorted(self._kind_of)}"
+        )
+        assert len(self._entries) == len(self._refs), (
+            "entry/page count mismatch"
+        )
+        for key, page in self._entries.items():
+            assert self._key_of[page] == key, f"page {page} key drifted"
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -289,6 +332,11 @@ class _Seq:
     shared: Set[int] = field(default_factory=set)   # cache-owned subset
     submitted_at: float = 0.0
     last_emit_at: float = 0.0
+    # retirement sealing (decode_page_cache): the committed stream is
+    # prompt + tokens; plen stays 0 until activation, so a mid-prefill
+    # cancel (nothing decode-committed) never tries to seal
+    prompt: Optional[np.ndarray] = None
+    plen: int = 0
 
 
 @dataclass
@@ -329,9 +377,19 @@ class PagedContinuousBatcher:
     prefill chunk rows); when the decode batch leaves fewer than one
     page of budget, one chunk still runs so prefill can never starve.
     ``prefix_cache=False`` disables sharing (every page private).
+    ``decode_page_cache`` ({"off", "fp32", "all"}, default off) lets
+    retirement seal complete DECODE-produced pages into the chain for
+    session KV reuse — see the module docstring for the dtype policy.
     ``session_id`` on ``submit`` is advisory — sharing is content-
     addressed, so same-session turns and cross-session shared system
-    prompts both hit without coordination.  An admission whose first
+    prompts both hit without coordination (upstream, the gateway's
+    session-affinity router is what lands a session's turn 2 on the
+    replica already holding its sealed pages).
+    ``draft_window`` (speculative mode) bounds the draft's dense ring
+    cache to that many rows per slot instead of ``max_seq``; on wrap the
+    draft restarts its context (accept rate dips, output is unchanged —
+    greedy verification is lossless for any draft).  Default: the lesser
+    of ``max_seq`` and ``prompt_pad + 16*(k+1)``.  An admission whose first
     cache-MISSED sharable page is being prefilled by an in-flight
     admission defers, acquiring the pages as that job registers them —
     same-prefix bursts serialize (computing a shared prefix twice in
@@ -356,6 +414,7 @@ class PagedContinuousBatcher:
         station_slots: Optional[int] = None,
         token_budget: Optional[int] = None,
         prefix_cache: bool = True,
+        decode_page_cache: str = "off",
         eos_id: Optional[int] = None,
         dtype=jnp.bfloat16,
         quant: bool = False,
@@ -367,6 +426,7 @@ class PagedContinuousBatcher:
         draft_num_heads: Optional[int] = None,
         draft_hidden: Optional[int] = None,
         speculate_k: Optional[int] = None,
+        draft_window: Optional[int] = None,
     ) -> None:
         if prompt_pad > max_seq:
             raise ValueError(
@@ -417,6 +477,38 @@ class PagedContinuousBatcher:
                     f"speculate_k ({speculate_k}) verify window exceeds "
                     f"max_seq ({max_seq})"
                 )
+            # the draft's ring: its dense cache holds draft_window rows
+            # per slot (not max_seq) — the draft is advisory, so bounding
+            # its attention window changes accept rate, never output.
+            # The auto bound keeps typical streams wrap-free while
+            # shedding the slots x max_seq shape speculation was supposed
+            # to escape.
+            if draft_window is None:
+                draft_window = min(
+                    max_seq, prompt_pad + 16 * (speculate_k + 1)
+                )
+            if draft_window > max_seq:
+                raise ValueError(
+                    f"draft_window ({draft_window}) exceeds max_seq "
+                    f"({max_seq}): rows past the longest stream are waste"
+                )
+            # floor: the admit prefill writes prompt_pad rows and the
+            # first verify window k+1 more — capped at max_seq, where
+            # the admission-time plen+max_new+k bound already keeps
+            # every write in range (the pre-ring behavior)
+            floor = min(max_seq, prompt_pad + speculate_k + 1)
+            if draft_window < floor:
+                raise ValueError(
+                    f"draft_window ({draft_window}) must cover a full "
+                    f"prompt plus one verify window: >= {floor} "
+                    f"(min(max_seq, prompt_pad + speculate_k + 1))"
+                )
+        elif draft_window is not None:
+            raise ValueError(
+                "draft_window requires speculate_k: only the speculative "
+                "draft has a ring cache to bound"
+            )
+        self.draft_window = draft_window
         self.speculate_k = speculate_k
         self.draft_params = draft_params
         self.metrics = metrics
@@ -459,6 +551,16 @@ class PagedContinuousBatcher:
         self.pool_pages = pool_pages
         self.prefix_cache: Optional[PrefixPageCache] = (
             PrefixPageCache() if prefix_cache else None
+        )
+        # session KV reuse: may retirement seal DECODE-produced pages
+        # into the chain?  Resolved once against the serving dtype (the
+        # shared contract in models/serving.py); "fp32" quietly stays
+        # prompt-only at bf16 — the policy names the numerics class it
+        # trusts, not a hope
+        self.decode_page_cache = decode_page_cache
+        self._seal_decode = (
+            resolve_decode_page_cache(decode_page_cache, dtype)
+            and self.prefix_cache is not None
         )
         # host-side tables: unused entries point at page 0 (fetched but
         # masked — the kernel never attends past a slot's length)
@@ -513,10 +615,15 @@ class PagedContinuousBatcher:
             # shape-stable: _draft_admit (activation), _spec_draft (the
             # k+1-step scan), _spec_verify (window forward + accept).
             k_spec = speculate_k
+            ring = draft_window
+            # the draft model is instantiated at the RING's row count:
+            # DecodeAttention masks/attends over exactly the cache rows
+            # it is built for, so the ring shrink is a pure shape change
+            # — no kernel change, the same DecodeLM scan
             self.draft_model = DecodeLM(
                 vocab_size=vocab_size, num_layers=draft_num_layers,
                 num_heads=draft_num_heads, hidden=draft_hidden,
-                max_seq=max_seq, dtype=dtype,
+                max_seq=ring, dtype=dtype,
             )
             # the verify twin shares self.model's params; all_logits so
             # every window position's choice comes from one forward
@@ -525,15 +632,34 @@ class PagedContinuousBatcher:
                 num_heads=num_heads, hidden=hidden, max_seq=max_seq,
                 dtype=dtype, quant=quant, all_logits=True,
             )
-            # dense per-slot draft cache: the draft is small, so the
-            # dense max_seq-row layout costs little and keeps the draft
-            # loop a plain DecodeLM scan (no second page table)
+            # dense per-slot draft RING: slots x draft_window rows (was
+            # slots x max_seq — the dense memory shape speculation was
+            # supposed to escape).  The write head is the host-side
+            # _d_pos; when a slot's next verify window would spill past
+            # the ring it restarts at row 0 — the draft loses its older
+            # context (accept rate dips until it rebuilds), the TARGET
+            # stream is untouched (greedy verification is lossless for
+            # ANY draft)
             self.d_caches = init_caches(
                 slots, draft_num_layers, draft_num_heads, draft_hidden,
-                max_seq, dtype,
+                ring, dtype,
             )
+            self._d_pos = np.zeros((slots,), np.int32)
+
+            def _ring_params(dparams):
+                # the draft checkpoint's pos_embed is sized to ITS
+                # max_seq; the ring indexes rows < draft_window, so
+                # slice (the station's chunk-program discipline)
+                return {
+                    **dparams,
+                    "pos_embed": {
+                        "embedding":
+                            dparams["pos_embed"]["embedding"][:ring]
+                    },
+                }
 
             def spec_draft(dparams, d_caches, last, pos):
+                dparams = _ring_params(dparams)
                 # k+1 scan steps: the extra step's proposal is discarded
                 # but its cache write consumes p_k (speculative.py's
                 # load-bearing extra step — a k-step scan would leave row
@@ -586,10 +712,11 @@ class PagedContinuousBatcher:
                 # writes before any causal mask can expose it — the
                 # spec_serving discipline.  The draft always recomputes
                 # the full prompt: prefix-cache hits skip TARGET pages
-                # only (draft K/V lives in its own dense cache).
+                # only (draft K/V lives in its own dense ring).
+                dparams = _ring_params(dparams)
                 fresh = init_caches(
                     1, draft_num_layers, draft_num_heads, draft_hidden,
-                    max_seq, dtype,
+                    ring, dtype,
                 )
                 _, fresh = self.draft_model.apply(
                     {"params": dparams}, prompt_row[None, :], fresh,
@@ -731,6 +858,52 @@ class PagedContinuousBatcher:
                 self.free_pages.add(p)
         s.pages, s.shared = [], set()
 
+    def _seal_finished_pages(self, s: _Seq) -> None:
+        """Session KV reuse: seal a retiring sequence's complete pages —
+        prompt AND generated — into the content-hash chain, so a later
+        prompt extending this stream (the turn-2 shape) hits straight
+        through the generated region and prefills only genuinely new
+        tokens.
+
+        Committed rows are ``plen + len(tokens) - 1``: row r holds the
+        K/V of stream token r for every r below that bound (the last
+        emitted token is never consumed, and in the speculative path any
+        device rows past the host-truncated stream are junk — both sit
+        above the bound).  Only FULL pages below it seal; the partial
+        tail page stays private and returns to the pool, exactly the COW
+        discipline prompt tails already follow.  Chain keys continue the
+        admission hash — one sha256 over the whole stream, snapshotted at
+        page boundaries — so a turn-2 probe needs no new machinery.
+        Policy-gated (``decode_page_cache``): these pages carry decode-
+        kernel numerics into shared K/V."""
+        if not self._seal_decode or s.plen == 0 or not s.tokens:
+            return
+        committed = s.plen + len(s.tokens) - 1
+        n_full = committed // self.page
+        if n_full == 0:
+            return
+        n_prompt = (s.plen - 1) // self.page  # dense-prefill-only pages
+        stream = np.concatenate(
+            [np.asarray(s.prompt, np.int32),
+             np.asarray(s.tokens, np.int32)]
+        )
+        h = hashlib.sha256()
+        for j in range(n_full):
+            h.update(stream[j * self.page: (j + 1) * self.page].tobytes())
+            phys = s.pages[j]
+            if phys in s.shared:
+                continue  # already cached (acquired hit or scatter-sealed)
+            key = h.digest()
+            if self.prefix_cache.lookup(key) is not None:
+                continue  # a twin stream sealed this content first
+            kind = "prompt" if j < n_prompt else "decode"
+            self.prefix_cache.insert(key, phys, kind=kind)
+            s.shared.add(phys)
+            if kind == "decode":
+                self.stats["decode_pages_sealed"] += 1
+                if self.metrics is not None:
+                    self.metrics.inc("serve_decode_pages_sealed_total")
+
     def pages_in_use(self) -> int:
         """Distinct pool pages held by live sequences (shared pages count
         once); idle cache-resident pages are NOT in use."""
@@ -777,6 +950,18 @@ class PagedContinuousBatcher:
                 assert self.prefix_cache.refcount(p) == 0, (
                     f"page {p} refcounted with no live holder"
                 )
+            # the cache's own maps stay aligned (entries/refs/keys/kinds)
+            # — decode-page sealing and cancel-path releases must never
+            # strand a half-registered entry
+            self.prefix_cache.assert_consistent()
+            if not self._seal_decode:
+                # with sealing off, only the dense station registers
+                # pages: nothing in the cache may claim decode numerics
+                for p in cached:
+                    assert self.prefix_cache.kind_of(p) == "prompt", (
+                        f"page {p} sealed as decode with "
+                        f"decode_page_cache={self.decode_page_cache!r}"
+                    )
 
     # -- admission ---------------------------------------------------------
     def _validate(self, prompt: np.ndarray, max_new: int) -> int:
@@ -870,10 +1055,35 @@ class PagedContinuousBatcher:
         s.pages, s.shared = pages, set(hits)
         s.submitted_at = submitted_at
         hit_rows = len(hits) * self.page
+        # split hits by the HIT page's kind: "prompt" pages were sealed
+        # by the dense station, "decode" pages at retirement (a turn-2
+        # prompt reaching through turn-1's generated region) — the
+        # decode-page win must be observable apart from classic prefix
+        # reuse or the policy knob can't be judged in production
+        decode_hit_rows = sum(
+            self.page for p in hits
+            if self.prefix_cache.kind_of(p) == "decode"
+        )
+        prompt_hit_rows = hit_rows - decode_hit_rows
         self.stats["prefix_hit_tokens"] += hit_rows
+        self.stats["prefix_hit_tokens_prompt"] += prompt_hit_rows
+        self.stats["prefix_hit_tokens_decode"] += decode_hit_rows
         self.stats["prompt_tokens"] += plen
         if self.metrics is not None:
-            self.metrics.inc("serve_prefix_hit_tokens_total", hit_rows)
+            # kind-labeled ONLY: an unlabeled sibling series in the same
+            # family would double-count every hit under a plain
+            # sum(serve_prefix_hit_tokens_total); dashboards aggregate
+            # across the label instead
+            if prompt_hit_rows:
+                self.metrics.inc(
+                    "serve_prefix_hit_tokens_total", prompt_hit_rows,
+                    kind="prompt",
+                )
+            if decode_hit_rows:
+                self.metrics.inc(
+                    "serve_prefix_hit_tokens_total", decode_hit_rows,
+                    kind="decode",
+                )
             self.metrics.inc("serve_prompt_tokens_total", plen)
         # hit rows only need station residency if chunks will run after
         # them; a full-prefix hit (two-turn sessions) skips the copies
@@ -915,7 +1125,7 @@ class PagedContinuousBatcher:
                 and (j + 1) * self.page <= job.pos
                 and self.prefix_cache.lookup(job.keys[j]) is None
             ):
-                self.prefix_cache.insert(job.keys[j], phys)
+                self.prefix_cache.insert(job.keys[j], phys, kind="prompt")
                 s.shared.add(phys)
             job.next_scatter = j + 1
 
@@ -932,6 +1142,9 @@ class PagedContinuousBatcher:
         self.tables[slot, : len(s.pages)] = s.pages
         self.pos[slot] = job.plen - 1
         self._last[slot] = int(job.prompt[job.plen - 1])
+        # retirement sealing needs the committed stream's prompt half
+        s.prompt = job.prompt[: job.plen]
+        s.plen = job.plen
         if self.speculate_k is not None:
             # the draft needs rows [0, plen-1) of ITS cache before the
             # first window's scan consumes `last` at row plen-1
@@ -941,6 +1154,7 @@ class PagedContinuousBatcher:
                 self.draft_params, self.d_caches, jnp.asarray(row),
                 jnp.int32(slot),
             )
+            self._d_pos[slot] = job.plen - 1
         s.prefilling, s.active = False, True
 
     def _observe_prefill_wait(self, job: _PrefillJob) -> None:
@@ -1054,8 +1268,14 @@ class PagedContinuousBatcher:
 
     def cancel(self, seq_id: int) -> bool:
         """Withdraw a request from the queue, mid-prefill, or mid-decode;
-        its pages go back to the pool (shared ones decref).  Returns
-        False if the request is unknown."""
+        its pages go back to the pool (shared ones decref — including any
+        decode pages a cancelled multi-turn session had acquired or this
+        sequence sealed).  A cancel AFTER commit (the sequence activated
+        and emitted tokens) still seals its complete pages first: the
+        committed K/V is exactly as correct for its stream as an EOS
+        finish's, and content-addressing makes sealing safe — a chain
+        nobody extends just ages out of the LRU.  Returns False if the
+        request is unknown."""
         for i, item in enumerate(self._pending):
             if item[0] == seq_id:
                 del self._pending[i]
@@ -1068,14 +1288,31 @@ class PagedContinuousBatcher:
                         # the station slot's rows become garbage; the
                         # next job there overwrites before it attends
                         del self._jobs[st]
-                self._release_pages(s)
-                s.seq_id, s.active, s.prefilling = -1, False, False
+                self._teardown_slot(i, s)  # seals first (uses s.tokens)
+                s.active, s.prefilling = False, False
                 s.tokens, s.remaining = [], 0
-                self.tables[i, :] = 0
-                self.pos[i] = 0
-                self._last[i] = 0
                 return True
         return False
+
+    def _teardown_slot(self, i: int, s: _Seq) -> None:
+        """The shared retirement/cancel epilogue: seal complete pages
+        (policy-gated no-op unless the sequence committed tokens),
+        release the rest, and park the slot on the dump page so its
+        (inevitable, static-shape) step writes can never touch a
+        reallocated page.  Every retirement-path field reset lives HERE
+        so the finish and cancel paths cannot drift.  Seal BEFORE
+        release: sealing flips complete private pages to cache-owned, so
+        release decrefs them to idle (LRU-evictable) instead of freeing
+        the bytes a turn-2 prompt is about to want."""
+        self._seal_finished_pages(s)
+        self._release_pages(s)
+        s.seq_id = -1
+        s.prompt, s.plen = None, 0
+        self.tables[i, :] = 0
+        self.pos[i] = 0
+        self._last[i] = 0
+        if self.speculate_k is not None:
+            self._d_pos[i] = 0
 
     def has_work(self) -> bool:
         return bool(self._pending) or any(s.seq_id >= 0 for s in self._seqs)
@@ -1083,8 +1320,10 @@ class PagedContinuousBatcher:
     def _reset_stats(self) -> None:
         self.stats = {
             "steps": 0, "admits": 0, "peak_pages": 0, "prefill_chunks": 0,
-            "prefix_hit_tokens": 0, "prompt_tokens": 0,
-            "spec_steps": 0, "spec_tokens": 0,
+            "prefix_hit_tokens": 0, "prefix_hit_tokens_prompt": 0,
+            "prefix_hit_tokens_decode": 0, "prompt_tokens": 0,
+            "decode_pages_sealed": 0, "spec_steps": 0, "spec_tokens": 0,
+            "draft_wraps": 0,
         }
 
     def _sweep(self, finished: Dict[int, List[int]]) -> None:
@@ -1094,14 +1333,7 @@ class PagedContinuousBatcher:
             for i, s in enumerate(self._seqs):
                 if s.seq_id >= 0 and not s.active and not s.prefilling:
                     finished[s.seq_id] = s.tokens
-                    self._release_pages(s)
-                    s.seq_id = -1
-                    # park the slot on the dump page so its (inevitable,
-                    # static-shape) step writes can never touch a
-                    # reallocated page
-                    self.tables[i, :] = 0
-                    self.pos[i] = 0
-                    self._last[i] = 0
+                    self._teardown_slot(i, s)
                     progress = True
             # admission is strictly FIFO: requests begin in submit
             # order, and a head that cannot begin (station full, pool
@@ -1149,6 +1381,14 @@ class PagedContinuousBatcher:
             self.metrics.set_gauge(
                 "serve_station_slots_busy", float(len(self._jobs))
             )
+            if self.speculate_k is not None:
+                # the draft ring's memory shape (rows, not bytes): the
+                # paged-draft-cache follow-on's observable — was
+                # slots x max_seq before the ring
+                self.metrics.set_gauge(
+                    "serve_draft_cache_rows",
+                    float(self.slots * self.draft_window),
+                )
         if any(s.active for s in self._seqs):
             if self.speculate_k is not None:
                 self._spec_step_host()
@@ -1194,10 +1434,18 @@ class PagedContinuousBatcher:
             verify_ctx = self.metrics.timer("serve_spec_verify_seconds")
         else:
             draft_ctx = verify_ctx = _null_ctx()
+        # ring wrap: a slot whose next verify window would write past the
+        # draft ring restarts its draft context at row 0 — the draft
+        # rebuilds from the stream's recent tokens (accept rate dips,
+        # output cannot change: verification is lossless for any draft)
+        for i, s in enumerate(self._seqs):
+            if s.active and int(self._d_pos[i]) + k + 1 > self.draft_window:
+                self._d_pos[i] = 0
+                self.stats["draft_wraps"] += 1
         with draft_ctx:
             proposals, self.d_caches = self._spec_draft(
                 self.draft_params, self.d_caches, jnp.asarray(self._last),
-                jnp.asarray(self.pos),
+                jnp.asarray(self._d_pos),
             )
             if self.metrics is not None:
                 # the timer boundary is also the program boundary:
@@ -1227,6 +1475,7 @@ class PagedContinuousBatcher:
             # an accepted — i.e. emitted — proposal after); rejected
             # rows past pos+e are junk the next window overwrites
             self.pos[i] += e
+            self._d_pos[i] += e  # the draft ring's write head tracks pos
             emitted = [int(t) for t in choices_h[i, :e]]
             # budget cap: the device may emit past the slot's remaining
             # budget; the surplus is junk (the slot retires here, and the

@@ -52,6 +52,37 @@ import numpy as np
 from kubegpu_tpu.models.decoding import DecodeLM, init_caches
 from kubegpu_tpu.utils.metrics import Metrics
 
+# Session KV reuse policy: may the paged batcher seal DECODE-produced
+# pages (a retired sequence's generated tokens) into the shared prefix
+# cache?  Decode pages carry decode-kernel numerics into K/V another
+# request will attend, so sharing is gated per dtype:
+#   off  — prompt (dense-prefill) pages only, the conservative default;
+#   fp32 — decode pages too, but only when the serving dtype is float32
+#          (property-tested greedy-token-identical to a fresh prefill);
+#   all  — decode pages at any dtype (bf16 may flip near-tie argmaxes —
+#          drift is MEASURED in bench.py serving_multiturn, not assumed).
+# Lives here (not paging.py) because it is the shared serving contract:
+# the worker CLI, the gateway CLI, and the paged batcher must resolve
+# the knob identically or a deployed policy would silently diverge.
+DECODE_PAGE_CACHE_POLICIES = ("off", "fp32", "all")
+
+
+def resolve_decode_page_cache(policy: str, dtype) -> bool:
+    """Resolve the ``decode_page_cache`` policy knob against the serving
+    dtype: returns whether decode-produced pages may enter the shared
+    prefix cache.  Raises on an unknown policy (malformed serving knobs
+    die at construction, never mid-serve-loop)."""
+    if policy not in DECODE_PAGE_CACHE_POLICIES:
+        raise ValueError(
+            f"decode_page_cache must be one of "
+            f"{DECODE_PAGE_CACHE_POLICIES}, got {policy!r}"
+        )
+    if policy == "off":
+        return False
+    if policy == "all":
+        return True
+    return jnp.dtype(dtype) == jnp.dtype(jnp.float32)
+
 
 def load_draft_checkpoint(ckpt_dir: str, *, vocab_size: int,
                           num_layers: int, num_heads: int, hidden: int,

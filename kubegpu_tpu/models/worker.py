@@ -544,7 +544,8 @@ def _run_decode_batched(args, params, max_seq: int, t0: float) -> int:
         ) + 1
         cb = PagedContinuousBatcher(
             params, **common, quant=args.int8, page_size=page,
-            pool_pages=pool, **spec_kw,
+            pool_pages=pool, decode_page_cache=args.decode_page_cache,
+            **spec_kw,
         )
 
     rng = np.random.RandomState(0)
@@ -789,6 +790,18 @@ def main(argv=None) -> int:
                     "decode through the page pool (--spec-k deep; OFF by "
                     "default — greedy-lossless, so output is identical "
                     "either way)")
+    from kubegpu_tpu.models.serving import DECODE_PAGE_CACHE_POLICIES
+
+    ap.add_argument("--decode-page-cache", default="off",
+                    choices=list(DECODE_PAGE_CACHE_POLICIES),
+                    help="paged serving: seal retired sequences' "
+                    "DECODE-produced pages into the shared prefix cache "
+                    "so a session's turn 2 skips re-prefilling turn 1's "
+                    "output (session KV reuse).  off = prompt pages only "
+                    "(default); fp32 = share only when serving float32 "
+                    "(property-tested greedy-token-identical); all = any "
+                    "dtype (bf16 may flip near-tie argmaxes — drift is "
+                    "measured in bench.py serving_multiturn)")
     ap.add_argument(
         "--draft-ckpt-dir", default="",
         help="orbax checkpoint root for the DRAFT model "

@@ -419,10 +419,21 @@ class GatewaySoak:
     SimBatcher).  A factory returning real paged batchers extends I5
     with the page-accounting invariant: any surviving batcher exposing
     ``assert_page_accounting`` is checked at quiescence — the kill/
-    revive/hedge-cancel schedule must never leak KV pool pages."""
+    revive/hedge-cancel schedule must never leak KV pool pages.
+
+    ``multiturn=True`` adds the session-KV-reuse op: a completed
+    sessionful request spawns a TURN-2 request on the same session whose
+    prompt extends turn 1's prompt with its generated tokens plus new
+    text (capped at ``follow_prompt_cap`` so it stays inside the replica
+    batchers' prompt_pad) — exactly the traffic decode-page caching
+    serves from sealed pages.  With kills/hedge-cancels interleaved,
+    this is the schedule that hunts decode-page refcount leaks: a
+    session cancelled mid-turn must release every sealed page it
+    registered or acquired."""
 
     def __init__(self, seed: int, n_replicas: int = 4,
-                 batcher_factory=None):
+                 batcher_factory=None, multiturn: bool = False,
+                 follow_prompt_cap: int = 12):
         from kubegpu_tpu.gateway import (
             AdmissionQueue, FailoverPolicy, Gateway, InMemoryReplicaClient,
             SimBatcher,
@@ -463,6 +474,10 @@ class GatewaySoak:
         self.pendings = {}   # request_id -> PendingRequest
         self.dead = set()    # replica keys currently killed
         self.ops = []
+        self.multiturn = multiturn
+        self.follow_prompt_cap = follow_prompt_cap
+        self._session_prompts = {}  # request_id -> (session, prompt)
+        self._followed = set()      # request_ids already extended
 
     # -- ops ---------------------------------------------------------------
     def op_burst(self):
@@ -473,17 +488,59 @@ class GatewaySoak:
         for _ in range(k):
             rid = f"r{self.n}"
             self.n += 1
+            session = (f"s{self.rng.randrange(6)}"
+                       if self.rng.random() < 0.4 else None)
+            prompt = [1, 2, 3]
             p = self.gw.submit(GatewayRequest(
-                prompt=[1, 2, 3],
+                prompt=prompt,
                 max_new_tokens=self.rng.choice([0, 2, 5, 8, 12]),
                 request_id=rid,
                 tenant=f"t{self.rng.randrange(3)}",
-                session=(f"s{self.rng.randrange(6)}"
-                         if self.rng.random() < 0.4 else None),
+                session=session,
             ))
             self.pendings[rid] = p
+            if self.multiturn and session is not None:
+                self._session_prompts[rid] = (session, prompt)
             accepted += 1
         return f"burst x{k} (total {self.n})"
+
+    def op_multiturn(self):
+        """Session turn 2: extend a COMPLETED sessionful request's prompt
+        with its own generated tokens plus fresh text, on the same
+        session id.  With decode-page caching on, the replica that served
+        turn 1 serves this from sealed pages; with kills interleaved, the
+        cancel/retry path must balance their refcounts."""
+        from kubegpu_tpu.gateway import GatewayRequest
+
+        if not self.multiturn:
+            return "multiturn (noop: disabled)"
+        results = self.gw.results()
+        ready = [
+            rid for rid in self._session_prompts
+            if rid not in self._followed
+            and rid in results and results[rid].status == "ok"
+        ]
+        if not ready:
+            return "multiturn (noop: no completed session turn)"
+        rid = self.rng.choice(sorted(ready))
+        self._followed.add(rid)
+        session, prompt = self._session_prompts[rid]
+        salt = self.rng.randrange(4, 61)
+        follow = (list(prompt) + list(results[rid].tokens))[
+            : self.follow_prompt_cap - 1
+        ] + [salt]
+        rid2 = f"r{self.n}"
+        self.n += 1
+        p = self.gw.submit(GatewayRequest(
+            prompt=follow,
+            max_new_tokens=self.rng.choice([2, 5]),
+            request_id=rid2,
+            tenant=f"t{self.rng.randrange(3)}",
+            session=session,
+        ))
+        self.pendings[rid2] = p
+        self._session_prompts[rid2] = (session, follow)
+        return f"multiturn {rid}->{rid2} ({session}, plen {len(follow)})"
 
     def _live_keys(self):
         return [r.key for r in self.registry.live()]
@@ -586,6 +643,10 @@ class GatewaySoak:
             (self.op_straggle, 2),
             (self.op_settle, 3),
         ]
+        if self.multiturn:
+            # weighted like the burst: turn 2s should be common enough
+            # that kills land while sealed decode pages are referenced
+            ops.append((self.op_multiturn, 4))
         bag = [f for f, w in ops for _ in range(w)]
         try:
             for _ in range(steps):

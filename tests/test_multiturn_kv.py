@@ -1,0 +1,517 @@
+"""Session KV reuse: decode-page prefix caching (ISSUE 5).
+
+The contract under test: with ``decode_page_cache`` on, a retiring
+sequence's complete pages — prompt AND generated — seal into the
+content-hash chain, so a turn-2 prompt of ``turn1_prompt + turn1_output
++ new_text`` hits straight through the generated region and prefill
+starts at the first genuinely new token, while staying INVISIBLE in the
+output at fp32 (the policy's "fp32" promise): greedy tokens identical to
+an entirely uncached batcher, across page sizes, chunk widths, page-
+boundary-straddling extensions, speculation, cancels, LRU eviction, and
+the GatewaySoak multi-turn kill schedule.
+
+Numerics note (measured, not assumed): the sealed decode rows' K/V was
+written by the paged decode kernel (f32 online softmax), a fresh
+prefill's by the dense station (one-shot softmax).  At fp32 layer 0's
+K/V is byte-identical (pure projections — any chain-hash or page-mapping
+bug shows up as gross row mismatches there); layers >= 1 differ by ~1
+fp32 ULP because the two softmaxes reassociate differently, which is
+exactly why sharing is policy-gated per dtype.  The property test below
+pins both facts plus token-identity, the invariant the acceptance
+criteria gate on.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubegpu_tpu.models import TransformerLM, greedy_generate
+from kubegpu_tpu.models.paging import PagedContinuousBatcher
+from kubegpu_tpu.models.serving import resolve_decode_page_cache
+from kubegpu_tpu.utils.metrics import Metrics
+
+CFG = dict(vocab_size=61, num_layers=2, num_heads=4, hidden=32, max_seq=64)
+DRAFT = dict(draft_num_layers=1, draft_num_heads=2, draft_hidden=16)
+
+
+def trained_params():
+    model = TransformerLM(dtype=jnp.float32, **CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.ones((2, 8), jnp.int32))[
+        "params"
+    ]
+
+
+def oracle(params, prompt, n):
+    out = greedy_generate(
+        params, jnp.asarray(prompt)[None, :], n, dtype=jnp.float32, **CFG
+    )
+    return list(np.asarray(out)[0, len(prompt):])
+
+
+def make_paged(params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("prompt_pad", 40)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("pool_pages", 40)
+    kw.setdefault("decode_page_cache", "fp32")
+    return PagedContinuousBatcher(params, dtype=jnp.float32, **CFG, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Policy knob: resolution and validation (fast — tier-1)
+# ---------------------------------------------------------------------------
+
+def test_decode_page_cache_policy_resolution():
+    assert not resolve_decode_page_cache("off", jnp.float32)
+    assert resolve_decode_page_cache("fp32", jnp.float32)
+    assert not resolve_decode_page_cache("fp32", jnp.bfloat16)
+    assert resolve_decode_page_cache("all", jnp.bfloat16)
+    assert resolve_decode_page_cache("all", jnp.float32)
+    with pytest.raises(ValueError, match="decode_page_cache"):
+        resolve_decode_page_cache("fp16", jnp.float32)
+
+
+def test_decode_page_cache_construction_contract():
+    params = trained_params()
+    with pytest.raises(ValueError, match="decode_page_cache"):
+        make_paged(params, decode_page_cache="sometimes")
+    # "fp32" at bf16 serving precision resolves to prompt-only sealing
+    bf = PagedContinuousBatcher(
+        params, slots=1, prompt_pad=8, page_size=4, pool_pages=12,
+        decode_page_cache="fp32", dtype=jnp.bfloat16, **CFG,
+    )
+    assert not bf._seal_decode
+    assert make_paged(params)._seal_decode
+    assert PagedContinuousBatcher(
+        params, slots=1, prompt_pad=8, page_size=4, pool_pages=12,
+        decode_page_cache="all", dtype=jnp.bfloat16, **CFG,
+    )._seal_decode
+    # sealing needs a cache to seal into
+    assert not make_paged(params, prefix_cache=False)._seal_decode
+    # the draft ring is a speculative-only knob
+    with pytest.raises(ValueError, match="draft_window"):
+        make_paged(params, draft_window=16)
+
+
+def test_sim_batcher_validates_policy():
+    from kubegpu_tpu.gateway.client import SimBatcher
+
+    SimBatcher(decode_page_cache="all")  # valid values construct
+    with pytest.raises(ValueError, match="decode_page_cache"):
+        SimBatcher(decode_page_cache="on")
+
+
+def test_policy_tuple_pinned_across_layers():
+    """The gateway layer is jax-free, so it mirrors the policy tuple
+    instead of importing the model stack; this pin is what keeps the
+    mirror honest when a policy value is added."""
+    from kubegpu_tpu.gateway import client
+    from kubegpu_tpu.models import serving
+
+    assert (
+        client.DECODE_PAGE_CACHE_POLICIES
+        == serving.DECODE_PAGE_CACHE_POLICIES
+    )
+
+
+# ---------------------------------------------------------------------------
+# Tentpole property: turn 2 hits through generated pages, output-invisible
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_two_turn_decode_page_hits_token_identical():
+    """Turn 2 extends turn 1's full stream; with decode-page caching its
+    probe must reach past the prompt region into sealed DECODE pages
+    (prefix_hit_tokens_decode > 0) and still emit exactly what a
+    cache-less batcher emits — for second-turn extensions straddling the
+    page boundary, and for page sizes x chunk widths."""
+    params = trained_params()
+    rng = np.random.RandomState(1)
+    turn1 = np.array(rng.randint(0, CFG["vocab_size"], size=6), np.int32)
+    for page, chunk in ((4, None), (4, 8), (8, None)):
+        cb = make_paged(params, page_size=page, prefill_chunk=chunk)
+        out1 = cb.run([turn1], [10])[0]
+        assert out1 == oracle(params, turn1, 10)
+        # stream = 16 rows, committed 15: floor(15/page) full pages
+        # sealed, of which all past (6-1)//page are decode kind
+        assert cb.stats["decode_pages_sealed"] > 0, (page, chunk)
+        cb.assert_page_accounting()
+        for extra in (1, 3, 4, 6):
+            turn2 = np.concatenate([
+                turn1, np.asarray(out1, np.int32),
+                np.array(
+                    rng.randint(0, CFG["vocab_size"], size=extra), np.int32
+                ),
+            ])
+            expected = oracle(params, turn2, 5)
+            cold = make_paged(
+                params, page_size=page, prefill_chunk=chunk,
+                prefix_cache=False,
+            )
+            assert cold.run([turn2], [5])[0] == expected
+            got = cb.run([turn2], [5])[0]  # run() resets stats per call
+            assert got == expected, (page, chunk, extra, got, expected)
+            assert cb.stats["prefix_hit_tokens_decode"] > 0, (
+                page, chunk, extra,
+                "turn 2 did not reuse turn 1's generated pages",
+            )
+            # prompt-region hits split separately from decode-region
+            assert cb.stats["prefix_hit_tokens"] == (
+                cb.stats["prefix_hit_tokens_prompt"]
+                + cb.stats["prefix_hit_tokens_decode"]
+            )
+            cb.assert_page_accounting()
+
+
+@pytest.mark.slow
+def test_two_turn_with_speculation_token_identical():
+    """Decode-page sealing composes with speculative decode: the spec
+    path's host-truncated streams (EOS / budget caps drop device-emitted
+    surplus) must seal only COMMITTED rows, so a turn-2 prompt extending
+    the truncated stream still matches the oracle exactly."""
+    params = trained_params()
+    dmodel = TransformerLM(
+        vocab_size=CFG["vocab_size"], max_seq=CFG["max_seq"],
+        num_layers=DRAFT["draft_num_layers"],
+        num_heads=DRAFT["draft_num_heads"], hidden=DRAFT["draft_hidden"],
+        dtype=jnp.float32,
+    )
+    dparams = dmodel.init(
+        jax.random.PRNGKey(7), jnp.ones((2, 8), jnp.int32)
+    )["params"]
+    rng = np.random.RandomState(2)
+    turn1 = np.array(rng.randint(0, CFG["vocab_size"], size=7), np.int32)
+    for eos in (None, 7):
+        cb = make_paged(
+            params, slots=4, prompt_pad=20, draft_params=dparams,
+            speculate_k=3, eos_id=eos, **DRAFT,
+        )
+        out1 = cb.run([turn1], [9])[0]
+        plain = make_paged(params, slots=4, prompt_pad=20, eos_id=eos)
+        assert plain.run([turn1], [9])[0] == out1
+        turn2 = np.concatenate([
+            turn1, np.asarray(out1, np.int32), np.array([3, 11], np.int32),
+        ])
+        cold = make_paged(
+            params, slots=4, prompt_pad=20, prefix_cache=False, eos_id=eos,
+        )
+        expected = cold.run([turn2], [6])[0]
+        got = cb.run([turn2], [6])[0]
+        assert got == expected, (eos, got, expected)
+        if len(out1) >= cb.page:  # enough committed rows to seal past
+            assert cb.stats["prefix_hit_tokens_decode"] > 0, eos
+        cb.assert_page_accounting()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: cache-chain hashing across page boundaries — gathered K/V
+# ---------------------------------------------------------------------------
+
+def _kv_rows(cb, slot, nrows):
+    """Read rows [0, nrows) of each layer's K/V through the slot's page
+    table (the exact gather a chunk or decode step attends)."""
+    table = cb.tables[slot]
+    page = cb.page
+    out = []
+    for kp, vp in cb.pools:
+        kp, vp = np.asarray(kp), np.asarray(vp)
+        n_pages = -(-nrows // page)
+        k = np.concatenate(
+            [np.moveaxis(kp[table[j]], 0, 1) for j in range(n_pages)]
+        )[:nrows]
+        v = np.concatenate(
+            [np.moveaxis(vp[table[j]], 0, 1) for j in range(n_pages)]
+        )[:nrows]
+        out.append((k, v))
+    return out
+
+
+def _prefill_and_capture(cb, prompt):
+    """Submit, drive to activation (prompt rows [0, plen-1) resident),
+    capture the gathered K/V, then drain."""
+    cb.submit(0, prompt, 2)
+    for _ in range(200):
+        if cb._seqs[0].active:
+            break
+        cb.serve_step()
+    assert cb._seqs[0].active
+    kv = _kv_rows(cb, 0, len(prompt) - 1)
+    while cb.has_work():
+        cb.serve_step()
+    return kv
+
+
+@pytest.mark.slow
+def test_chain_hash_across_page_boundaries_gathered_kv():
+    """A turn-2 prompt hitting through generated pages gathers K/V that
+    matches a fresh prefill's at fp32: byte-identical at layer 0 (K/V
+    there is a pure projection of the token+position embedding — a wrong
+    page or wrong row from a chain-hash bug is a GROSS mismatch, not an
+    ULP), and within ~1 fp32 ULP at deeper layers (the paged decode
+    kernel's online softmax vs the dense station's one-shot softmax
+    reassociate differently — the measured kernel-path class the dtype
+    policy exists for).  Across page sizes and chunk widths."""
+    params = trained_params()
+    rng = np.random.RandomState(3)
+    turn1 = np.array(rng.randint(0, CFG["vocab_size"], size=6), np.int32)
+    for page, chunk in ((4, None), (4, 8), (8, None)):
+        cb = make_paged(params, page_size=page, prefill_chunk=chunk)
+        out1 = cb.run([turn1], [10])[0]
+        turn2 = np.concatenate([
+            turn1, np.asarray(out1, np.int32), np.array([5, 2], np.int32),
+        ])
+        kv_hit = _prefill_and_capture(cb, turn2)
+        assert cb.stats["prefix_hit_tokens_decode"] > 0, (page, chunk)
+        cold = make_paged(
+            params, page_size=page, prefill_chunk=chunk, prefix_cache=False,
+        )
+        kv_fresh = _prefill_and_capture(cold, turn2)
+        for li, ((hk, hv), (fk, fv)) in enumerate(zip(kv_hit, kv_fresh)):
+            if li == 0:
+                assert np.array_equal(hk, fk) and np.array_equal(hv, fv), (
+                    page, chunk, "layer-0 K/V not byte-identical: chain "
+                    "key mapped to wrong page content",
+                )
+            np.testing.assert_allclose(
+                hk, fk, atol=1e-5, rtol=0,
+                err_msg=f"layer {li} K drift beyond the fp32 ULP class",
+            )
+            np.testing.assert_allclose(
+                hv, fv, atol=1e-5, rtol=0,
+                err_msg=f"layer {li} V drift beyond the fp32 ULP class",
+            )
+        cb.assert_page_accounting()
+        cold.assert_page_accounting()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: cancel releases sealed/acquired decode pages
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_cancel_midturn_releases_sealed_and_acquired_pages():
+    """Three cancel shapes against the decode-page refcounts: a turn-2
+    session cancelled MID-DECODE (holding acquired decode pages), one
+    cancelled MID-PREFILL right after its hit pages gathered, and a
+    turn-1 cancelled AFTER COMMIT (sealing its own pages on the way
+    out).  Every page must end free or cached-idle, refcounts zero."""
+    params = trained_params()
+    rng = np.random.RandomState(4)
+    turn1 = np.array(rng.randint(0, CFG["vocab_size"], size=6), np.int32)
+    cb = make_paged(params, slots=3)
+    out1 = cb.run([turn1], [10])[0]
+    sealed = cb.stats["decode_pages_sealed"]
+    assert sealed > 0
+    turn2 = np.concatenate([
+        turn1, np.asarray(out1, np.int32), np.array([9, 1, 4], np.int32),
+    ])
+    # (a) cancel mid-decode: acquired decode pages must decref
+    cb.submit(50, turn2, 8)
+    for _ in range(50):
+        cb.serve_step()
+        if cb._seqs[0].active and len(cb._seqs[0].tokens) >= 2:
+            break
+    assert cb.stats["prefix_hit_tokens_decode"] > 0
+    assert cb.cancel(50)
+    cb.assert_page_accounting()
+    assert all(
+        cb.prefix_cache.refcount(p) == 0 for p in cb.prefix_cache.pages()
+    )
+    # (b) cancel mid-prefill after the hit gather
+    cb.submit(51, turn2, 8)
+    cb.serve_step()
+    if not cb._seqs[0].active:  # still prefilling the tail
+        assert cb.cancel(51)
+    else:
+        cb.cancel(51)
+    cb.assert_page_accounting()
+    assert all(
+        cb.prefix_cache.refcount(p) == 0 for p in cb.prefix_cache.pages()
+    )
+    # (c) cancel-after-commit SEALS: a fresh stream cancelled mid-decode
+    # registers its complete pages, then releases them to idle
+    fresh = np.array(rng.randint(0, CFG["vocab_size"], size=5), np.int32)
+    cb.submit(52, fresh, 12)
+    for _ in range(60):
+        cb.serve_step()
+        s = next(q for q in cb._seqs if q.seq_id == 52)
+        if s.active and len(s.tokens) >= 8:
+            break
+    before = len(cb.prefix_cache)
+    assert cb.cancel(52)
+    assert len(cb.prefix_cache) > before, "cancel-after-commit sealed nothing"
+    cb.assert_page_accounting()
+    # the sealed chain is genuinely reusable: extend the cancelled
+    # stream's committed tokens (greedy, so the oracle reproduces them)
+    replay = oracle(params, fresh, 8)
+    turn2c = np.concatenate(
+        [fresh, np.asarray(replay, np.int32), np.array([2], np.int32)]
+    )
+    expected = oracle(params, turn2c, 4)
+    got = cb.run([turn2c], [4])[0]
+    assert got == expected
+    assert cb.stats["prefix_hit_tokens_decode"] > 0
+    cb.assert_page_accounting()
+
+
+@pytest.mark.slow
+def test_lru_eviction_of_sealed_decode_pages_recomputes():
+    """Pool pressure evicts idle sealed decode pages LRU like any other
+    cache entry; a turn-2 whose sealed region was evicted recomputes it
+    and still matches the oracle."""
+    params = trained_params()
+    rng = np.random.RandomState(5)
+    turn1 = np.array(rng.randint(0, CFG["vocab_size"], size=6), np.int32)
+    # room for ~one live request + a couple of cached pages
+    cb = make_paged(params, slots=1, pool_pages=9)
+    out1 = cb.run([turn1], [10])[0]
+    # churn unrelated prompts through the tiny pool to evict the chain
+    for j in range(3):
+        other = np.array(
+            rng.randint(0, CFG["vocab_size"], size=9), np.int32
+        )
+        cb.run([other], [6])
+        cb.assert_page_accounting()
+    turn2 = np.concatenate([
+        turn1, np.asarray(out1, np.int32), np.array([8], np.int32),
+    ])
+    expected = oracle(params, turn2, 4)
+    assert cb.run([turn2], [4])[0] == expected
+    cb.assert_page_accounting()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: multi-turn compile stability — one entry per program
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_multiturn_compile_stability_fixed_jit_cache():
+    """A 40-step multi-turn schedule — turn-2/-3 submissions extending
+    completed streams, fresh admits, cancels mid-flight, zero-budget
+    admits, decode-page hits and misses — must leave exactly ONE
+    compiled entry per program: sealing is host-side accounting and
+    hits ride the existing gather program, so session KV reuse mints no
+    new shapes."""
+    params = trained_params()
+    rng = np.random.RandomState(6)
+    cb = make_paged(params, slots=3, station_slots=2, token_budget=9,
+                    pool_pages=48)
+    seq = 0
+    live = []
+    done_streams = []  # (prompt, tokens) of completed requests
+    submitted = {}
+    for _ in range(40):
+        roll = rng.rand()
+        if roll < 0.35:
+            n = int(rng.randint(1, 12))
+            prompt = np.array(
+                rng.randint(0, CFG["vocab_size"], size=n), np.int32
+            )
+            cb.submit(seq, prompt, int(rng.randint(0, 6)))
+            submitted[seq] = prompt
+            live.append(seq)
+            seq += 1
+        elif roll < 0.55 and done_streams:
+            # a session's next turn: extend a completed stream
+            prompt, tokens = done_streams[
+                rng.randint(len(done_streams))
+            ]
+            follow = np.concatenate([
+                prompt, np.asarray(tokens, np.int32),
+                np.array([int(rng.randint(0, CFG["vocab_size"]))],
+                         np.int32),
+            ])[: cb.prompt_pad]
+            cb.submit(seq, follow, int(rng.randint(1, 5)))
+            submitted[seq] = follow
+            live.append(seq)
+            seq += 1
+        elif roll < 0.65 and live:
+            cb.cancel(live.pop(rng.randint(len(live))))
+        else:
+            for s, toks in cb.serve_step().items():
+                live.remove(s)
+                done_streams.append((submitted[s], toks))
+    while cb.has_work():
+        for s, toks in cb.serve_step().items():
+            live.remove(s)
+            done_streams.append((submitted[s], toks))
+    cb.assert_page_accounting()
+    assert cb.stats["prefix_hit_tokens_decode"] > 0, (
+        "schedule never exercised a decode-page hit"
+    )
+    for name in ("_chunk", "_step", "_write_page"):
+        assert getattr(cb, name)._cache_size() == 1, (
+            f"{name}: {getattr(cb, name)._cache_size()} compiled entries"
+        )
+    assert cb._gather_page._cache_size() <= 1
+
+
+# ---------------------------------------------------------------------------
+# Satellite: hit metrics split prompt-page vs decode-page
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_prefix_hit_metrics_split_by_kind():
+    """``serve_prefix_hit_tokens_total`` is split by hit-page kind —
+    labeled series ONLY, so summing the family yields the true total
+    (an unlabeled sibling would double-count); sealing feeds
+    ``serve_decode_pages_sealed_total``."""
+    params = trained_params()
+    rng = np.random.RandomState(7)
+    turn1 = np.array(rng.randint(0, CFG["vocab_size"], size=6), np.int32)
+    m = Metrics()
+    cb = make_paged(params, metrics=m)
+    out1 = cb.run([turn1], [10])[0]
+    turn2 = np.concatenate([
+        turn1, np.asarray(out1, np.int32), np.array([3], np.int32),
+    ])
+    cb.run([turn2], [4])
+    prompt_hits = m.get("serve_prefix_hit_tokens_total", kind="prompt")
+    decode_hits = m.get("serve_prefix_hit_tokens_total", kind="decode")
+    assert decode_hits > 0
+    assert prompt_hits > 0
+    assert m.get("serve_prefix_hit_tokens_total") == 0  # no unlabeled twin
+    assert prompt_hits + decode_hits == cb.stats["prefix_hit_tokens"]
+    assert m.get("serve_decode_pages_sealed_total") > 0
+    text = m.render()
+    assert 'serve_prefix_hit_tokens_total{kind="decode"}' in text
+    assert 'serve_prefix_hit_tokens_total{kind="prompt"}' in text
+    assert "serve_decode_pages_sealed_total" in text
+    cb.assert_page_accounting()
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: multi-turn GatewaySoak kill schedule, caching + speculation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_gateway_soak_multiturn_kill_schedule():
+    """The GatewaySoak kill/revive/hedge schedule extended with the
+    multi-turn session op, over REAL paged batchers with decode-page
+    caching AND speculation on (plus a wrap-forcing draft ring):
+    invariant I5, and page accounting — refcounts, LRU, COW tails — on
+    every surviving replica at quiescence.  This is the acceptance
+    schedule: sessions cancelled mid-turn by kills and hedge losers must
+    release every sealed decode page they registered or acquired."""
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    tiny = dict(vocab_size=61, num_layers=1, num_heads=2, hidden=16,
+                max_seq=32)
+    params = TransformerLM(dtype=jnp.float32, **tiny).init(
+        jax.random.PRNGKey(1), jnp.ones((1, 4), jnp.int32)
+    )["params"]
+    soak = GatewaySoak(
+        seed=29, n_replicas=2, multiturn=True, follow_prompt_cap=12,
+        batcher_factory=lambda key: PagedContinuousBatcher(
+            params, slots=4, prompt_pad=12, page_size=4, pool_pages=48,
+            station_slots=2, token_budget=8, dtype=jnp.float32,
+            decode_page_cache="fp32",
+            draft_params=params, speculate_k=2, draft_window=16,
+            draft_num_layers=tiny["num_layers"],
+            draft_num_heads=tiny["num_heads"],
+            draft_hidden=tiny["hidden"], **tiny,
+        ),
+    )
+    soak.run(steps=20)

@@ -296,7 +296,13 @@ def test_serving_metrics_histograms_and_counters():
     pb = make_paged(params, metrics=pm)
     pb.run([prompts[0], prompts[0]], [4, 4])
     assert pm.histogram_count("serve_ttft_seconds") == 2
-    assert pm.get("serve_prefix_hit_tokens_total") > 0
+    # hits split by the hit page's kind — labeled series ONLY, so
+    # sum() over the family is the true total: prompt-station pages
+    # here (the default decode_page_cache="off" seals nothing at
+    # retirement), so the decode counter never appears
+    assert pm.get("serve_prefix_hit_tokens_total", kind="prompt") > 0
+    assert pm.get("serve_prefix_hit_tokens_total", kind="decode") == 0
+    assert pm.get("serve_prefix_hit_tokens_total") == 0  # no unlabeled twin
     assert pm.get("serve_prompt_tokens_total") == 18
     # the token-budget station observes submit->first-chunk wait per
     # admission and tracks its occupancy as a gauge
@@ -305,6 +311,7 @@ def test_serving_metrics_histograms_and_counters():
     text = pm.render()
     assert "serve_ttft_seconds_count 2" in text
     assert "serve_prefix_hit_tokens_total" in text
+    assert 'serve_prefix_hit_tokens_total{kind="prompt"}' in text
     assert "serve_prefill_wait_seconds_count 2" in text
     assert "# TYPE serve_station_slots_busy gauge" in text
     assert "serve_station_slots_busy 0.0" in text  # drained at rest

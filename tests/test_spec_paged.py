@@ -199,6 +199,91 @@ def test_spec_paged_incremental_api_with_cancel():
 
 
 # ---------------------------------------------------------------------------
+# Draft ring: the dense slots x max_seq draft cache became a ring
+# ---------------------------------------------------------------------------
+
+def test_draft_ring_window_token_identical_across_wraps():
+    """A draft ring barely above the validation floor wraps repeatedly
+    on long generations (the draft restarts its context at row 0); the
+    emitted stream must stay oracle-exact anyway — greedy verification
+    is lossless for ANY draft — and the ring only moves how many verify
+    programs the stream costs."""
+    params = trained_params()
+    dparams = draft_params()
+    rng = np.random.RandomState(7)
+    prompts = [
+        np.array(rng.randint(0, CFG["vocab_size"], size=n), np.int32)
+        for n in (3, 7, 12, 5)
+    ]
+    budgets = [14, 10, 12, 16]
+    expected = {
+        i: oracle(params, p, n)
+        for i, (p, n) in enumerate(zip(prompts, budgets))
+    }
+    for k, window in ((2, 19), (4, 21), (2, 32)):
+        cb = make_spec_paged(params, dparams, k, draft_window=window)
+        assert cb.draft_window == window
+        got = cb.run(prompts, budgets)
+        assert got == expected, (k, window, {
+            i: (got[i], expected[i])
+            for i in expected if got[i] != expected[i]
+        })
+        cb.assert_page_accounting()
+        if window < 32:  # streams reach 19+ rows: the tight rings wrap
+            assert cb.stats["draft_wraps"] > 0, (k, window)
+    # perfect draft through a wrapping ring: still token-exact (the
+    # wrap only dents the accept rate while context rebuilds)
+    perfect = make_paged(
+        params, draft_params=params, speculate_k=2, draft_window=19,
+        draft_num_layers=CFG["num_layers"],
+        draft_num_heads=CFG["num_heads"], draft_hidden=CFG["hidden"],
+    )
+    assert perfect.run(prompts, budgets) == expected
+    assert perfect.stats["draft_wraps"] > 0
+
+
+def test_draft_ring_validation_and_default():
+    params = trained_params()
+    dparams = draft_params()
+    # floor: prompt_pad + k + 1 (admit prefill + one verify window)
+    with pytest.raises(ValueError, match="draft_window"):
+        make_spec_paged(params, dparams, 2, draft_window=18)
+    with pytest.raises(ValueError, match="draft_window"):
+        make_spec_paged(params, dparams, 2, draft_window=64)  # > max_seq
+    # default: min(max_seq, prompt_pad + 16*(k+1)) — here max_seq wins
+    cb = make_spec_paged(params, dparams, 2)
+    assert cb.draft_window == CFG["max_seq"]
+    # the ring IS the draft cache's row count
+    assert cb.d_caches[0][0].shape[1] == cb.draft_window
+    tight = make_spec_paged(params, dparams, 2, draft_window=20)
+    assert tight.d_caches[0][0].shape[1] == 20
+
+
+def test_draft_ring_gauge_and_compile_stability():
+    """The ring exposes its memory shape as ``serve_draft_cache_rows``
+    (slots x draft_window), and wrap resets never mint new programs —
+    the write head is a traced argument like pos."""
+    params = trained_params()
+    dparams = draft_params()
+    m = Metrics()
+    cb = make_spec_paged(params, dparams, 2, draft_window=19, metrics=m)
+    rng = np.random.RandomState(8)
+    prompts = [
+        np.array(rng.randint(0, CFG["vocab_size"], size=n), np.int32)
+        for n in (4, 9)
+    ]
+    cb.run(prompts, [14, 12])
+    assert cb.stats["draft_wraps"] > 0
+    assert m.gauge("serve_draft_cache_rows") == 4 * 19.0
+    assert "# TYPE serve_draft_cache_rows gauge" in m.render()
+    cb.assert_page_accounting()
+    for name in ("_spec_draft", "_spec_verify", "_draft_admit"):
+        assert getattr(cb, name)._cache_size() == 1, (
+            f"{name}: {getattr(cb, name)._cache_size()} compiled entries"
+        )
+
+
+# ---------------------------------------------------------------------------
 # Guards: construction and submission contracts
 # ---------------------------------------------------------------------------
 
