@@ -41,22 +41,28 @@ bench:
 # CPU-only serving-path micro-bench (~2 min): TTFT/ITL p95 with chunked
 # vs monolithic prefill, prefix-cache hit rate, burst TTFT p95
 # batched-station vs serial, speculative vs plain paged decode tok/s,
-# and multi-turn session KV reuse (turn-2 TTFT decode-page cache vs
-# prompt-only, <60 s on its own) on tiny shapes; exits non-zero if
-# chunked ITL regresses past monolithic, hits vanish, the batched
-# station's burst TTFT is not strictly below serial, spec decode is not
-# strictly above plain, turn-2 TTFT with decode-page caching is not
-# strictly below prompt-only, or tokens diverge on any of them
+# multi-turn session KV reuse (turn-2 TTFT decode-page cache vs
+# prompt-only, <60 s on its own), and request tracing (per-request
+# phase spans must SUM to the measured TTFT within tolerance on the
+# burst, and tracing overhead must stay within 5% tok/s of untraced on
+# the same run) on tiny shapes; exits non-zero if chunked ITL regresses
+# past monolithic, hits vanish, the batched station's burst TTFT is not
+# strictly below serial, spec decode is not strictly above plain,
+# turn-2 TTFT with decode-page caching is not strictly below
+# prompt-only, tokens diverge on any of them, the TTFT phase
+# decomposition breaks, or tracing overhead blows the 5% gate
 bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve-smoke
 
 # gateway smoke runs FIRST: it has no JAX-device dependency, so it still
 # exercises the serving path in environments where the multichip dry run
-# cannot (e.g. a jax build without the APIs the parallel stack needs)
+# cannot (e.g. a jax build without the APIs the parallel stack needs).
+# dryrun_tracing: serve a few traced requests, dump/reload the JSONL,
+# assert one complete span tree each (the observability smoke)
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	  $(PY) -c "import __graft_entry__ as g; g.dryrun_gateway(); \
-	  g.dryrun_spec_serving(); g.dryrun_multichip(8)"
+	  g.dryrun_spec_serving(); g.dryrun_tracing(); g.dryrun_multichip(8)"
 
 image:
 	docker build -f deploy/Dockerfile -t kubegpu-tpu:latest .

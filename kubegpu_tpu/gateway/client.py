@@ -22,6 +22,7 @@ by the control plane kills the data-plane connection the same cycle.
 
 from __future__ import annotations
 
+import inspect
 import threading
 import time
 from collections import deque
@@ -125,7 +126,12 @@ class SimBatcher:
     ``decode_page_cache`` is the paged batchers' session-KV-reuse policy
     ({"off", "fp32", "all"}): the mill has no KV to seal, so it only
     validates the widened contract — a policy typo must die at replica
-    construction here exactly as it would on a real batcher."""
+    construction here exactly as it would on a real batcher.
+
+    ``submit(..., trace=)`` takes the caller's span context like the
+    real batchers and emits the same minimal subtree (serve → queue →
+    decode → retire), so gateway-level trace oracles (soak I5 from
+    spans) run against the millisecond-fast mill too."""
 
     def __init__(self, slots: int = 8, vocab: int = 256,
                  token_budget: Optional[int] = None,
@@ -152,27 +158,53 @@ class SimBatcher:
         self._pending: deque = deque()
         self._active: Dict[int, tuple] = {}  # seq -> (tokens, max_new)
         self._rr: deque = deque()            # active seqs in budget order
+        self._spans: Dict[int, dict] = {}    # seq -> open span ctxs
         self.stats = {"steps": 0, "admits": 0}
 
     def submit(self, seq_id: int, prompt, max_new: int,
                temperature: float = 0.0,
-               session_id: Optional[str] = None) -> None:
+               session_id: Optional[str] = None, trace=None) -> None:
         # session_id is the gateway's session/prefix key; the token mill
         # has no KV to reuse, so it only validates the widened contract
         if seq_id < 0:
             raise ValueError(f"seq_id must be >= 0, got {seq_id}")
+        if trace is not None:
+            old = self._spans.pop(seq_id, None)
+            if old is not None:
+                self._trace_end(old, "resubmitted")
+            serve = trace.child("serve", seq_id=seq_id, sim=True)
+            self._spans[seq_id] = {
+                "serve": serve, "queue": serve.child("queue"),
+            }
         self._pending.append((seq_id, int(max_new)))
+
+    def _trace_end(self, spans: dict, reason: str, **attrs) -> None:
+        serve = spans.pop("serve")
+        for span in spans.values():
+            span.end()
+        serve.event("retire", reason=reason, **attrs)
+        serve.end()
+
+    def trace_shutdown(self, reason: str = "replica died") -> None:
+        # reason rides as `note` (the retire reason keys the documented
+        # finished|cancelled|died enum; the note says WHICH replica)
+        for seq in list(self._spans):
+            self._trace_end(self._spans.pop(seq), "died", note=reason)
 
     def cancel(self, seq_id: int) -> bool:
         for i, (sid, _) in enumerate(self._pending):
             if sid == seq_id:
                 del self._pending[i]
+                if sid in self._spans:
+                    self._trace_end(self._spans.pop(sid), "cancelled")
                 return True
         if self._active.pop(seq_id, None) is None:
             return False
         # drop the ring entry too: a stale entry would double-count a
         # re-submitted seq_id against the budget forever
         self._rr.remove(seq_id)
+        if seq_id in self._spans:
+            self._trace_end(self._spans.pop(seq_id), "cancelled")
         return True
 
     def has_work(self) -> bool:
@@ -183,7 +215,14 @@ class SimBatcher:
         while self._pending and len(self._active) < self.slots:
             seq, max_new = self._pending.popleft()
             self.stats["admits"] += 1
+            spans = self._spans.get(seq)
+            if spans is not None and "queue" in spans:
+                spans.pop("queue").end()
+                if max_new > 0:
+                    spans["decode"] = spans["serve"].child("decode")
             if max_new <= 0:
+                if spans is not None:
+                    self._trace_end(self._spans.pop(seq), "finished")
                 finished[seq] = []
             else:
                 # a re-submitted still-active seq restarts its stream but
@@ -223,6 +262,8 @@ class SimBatcher:
                 if len(tokens) >= max_new:
                     finished[seq] = tokens
                     del self._active[seq]
+                    if seq in self._spans:
+                        self._trace_end(self._spans.pop(seq), "finished")
                 else:
                     self._rr.append(seq)
         return finished
@@ -237,6 +278,15 @@ class _ReplicaWorker:
         self.key = key
         self.batcher = batcher
         self.step_delay_s = step_delay_s
+        # does this batcher speak the trace-context contract?  Duck-typed
+        # once here so third-party batchers without the kwarg still work
+        # (their requests simply serve untraced below the dispatch span)
+        try:
+            self._takes_trace = (
+                "trace" in inspect.signature(batcher.submit).parameters
+            )
+        except (TypeError, ValueError):
+            self._takes_trace = False
         self.lock = threading.Lock()
         self.cond = threading.Condition(self.lock)
         self.inbox: deque = deque()          # (attempt, request)
@@ -258,16 +308,26 @@ class _ReplicaWorker:
                     dead += [a for a, _ in self.inbox]
                     self.by_seq.clear()
                     self.inbox.clear()
+                    # the process dies with its spans: close every live
+                    # request's subtree (retire reason "died") so the
+                    # trace tree stays complete — the in-memory twin of
+                    # a pod death ending its connections
+                    shutdown = getattr(self.batcher, "trace_shutdown", None)
+                    if shutdown is not None:
+                        shutdown(f"replica {self.key} died")
                     break
                 while self.inbox:
                     attempt, req = self.inbox.popleft()
                     seq = self._next_seq
                     self._next_seq += 1
+                    kwargs = {"session_id": getattr(req, "session", None)}
+                    if self._takes_trace:
+                        kwargs["trace"] = getattr(req, "trace", None)
                     try:
                         self.batcher.submit(
                             seq, req.prompt, req.max_new_tokens,
                             getattr(req, "temperature", 0.0),
-                            session_id=getattr(req, "session", None),
+                            **kwargs,
                         )
                         self.by_seq[seq] = attempt
                     except Exception as e:  # noqa: BLE001 - bad request
@@ -379,6 +439,20 @@ class InMemoryReplicaClient(ReplicaClient):
     def replicas(self) -> List[str]:
         with self._lock:
             return sorted(self._workers)
+
+    def ledgers(self, limit: int = 32) -> Dict[str, List[dict]]:
+        """Recent per-iteration serving-ledger rows per replica, for
+        batchers that keep one (duck-typed: the paged batcher's bounded
+        ring; SimBatcher and the dense batcher have none).  The
+        /debug/trace surface reads this."""
+        with self._lock:
+            workers = list(self._workers.items())
+        out: Dict[str, List[dict]] = {}
+        for key, w in workers:
+            rows = getattr(w.batcher, "ledger_rows", None)
+            if rows is not None:
+                out[key] = rows(limit)
+        return out
 
     def ready(self) -> bool:
         with self._lock:

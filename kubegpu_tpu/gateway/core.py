@@ -37,6 +37,7 @@ from kubegpu_tpu.gateway.queue import AdmissionQueue, QueueClosed, QueueFull
 from kubegpu_tpu.gateway.registry import ReplicaRegistry
 from kubegpu_tpu.gateway.router import LeastOutstandingRouter, Router
 from kubegpu_tpu.utils.metrics import Metrics, default_metrics
+from kubegpu_tpu.utils.tracing import Tracer
 
 log = logging.getLogger(__name__)
 
@@ -51,6 +52,10 @@ class GatewayRequest:
     temperature: float = 0.0
     deadline_s: Optional[float] = None   # per-request override
     enqueued_at: float = 0.0             # stamped by submit()
+    # runtime trace context (utils.tracing.SpanCtx), stamped by submit()
+    # when the gateway traces; carried down through queue -> dispatch ->
+    # replica batcher so the whole request is ONE span tree
+    trace: Optional[object] = field(default=None, repr=False, compare=False)
 
     def __post_init__(self) -> None:
         if not self.request_id:
@@ -77,6 +82,7 @@ class PendingRequest:
         self.request_id = request_id
         self._done = threading.Event()
         self._result: Optional[GatewayResult] = None
+        self._trace = None  # root SpanCtx; closed by Gateway._record
 
     def _resolve(self, result: GatewayResult) -> None:
         self._result = result
@@ -100,11 +106,20 @@ class Gateway:
         metrics: Optional[Metrics] = None,
         dispatchers: int = 4,
         max_results: int = 65536,
+        tracer: Optional[Tracer] = None,
+        trace: bool = True,
     ) -> None:
         self.registry = registry
         self.client = client
         self.queue = queue or AdmissionQueue()
         self.metrics = metrics or default_metrics
+        # request tracing is ON by default (bounded ring, a handful of
+        # dict ops per request): every request yields one span tree —
+        # admission_wait / route / dispatch / replica-side serve phases
+        # — served at /debug/trace.  trace=False opts out entirely.
+        self.tracer = tracer if tracer is not None else (
+            Tracer() if trace else None
+        )
         # a metrics-capable router (SessionAffinityRouter's repin
         # counter) that wasn't given its own registry reports into the
         # gateway's, so /metrics shows KV-loss re-pins next to the
@@ -188,6 +203,12 @@ class Gateway:
                 )
             self._pending[request.request_id] = pending
             self._n_submitted += 1
+        if self.tracer is not None:
+            request.trace = self.tracer.start_trace(
+                "gateway_request", request_id=request.request_id,
+                tenant=request.tenant,
+            )
+            pending._trace = request.trace
         request.enqueued_at = time.monotonic()
         try:
             self.queue.put(request)
@@ -270,6 +291,15 @@ class Gateway:
                     self.completed_by_replica.get(result.replica, 0) + 1
                 )
         if pending is not None:
+            if pending._trace is not None:
+                # the root closes with the terminal result; a hedge
+                # loser's serve spans may still be draining — the trace
+                # completes once they close (tracing.Tracer's
+                # completion rule), dispatch spans carry overhang_ok
+                pending._trace.end(
+                    status=result.status, attempts=result.attempts,
+                    replica=result.replica,
+                )
             pending._resolve(result)
 
     # -- views -------------------------------------------------------------

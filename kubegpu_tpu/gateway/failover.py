@@ -31,6 +31,21 @@ from kubegpu_tpu.utils.metrics import Metrics
 _POLL_S = 0.002  # attempt-completion poll; decode steps are >> this
 
 
+class _TraceView:
+    """Request view carrying per-attempt trace context (and, for
+    routing, the open route span) without mutating the caller's request
+    — the router's ``_with_hint`` pattern: attempts race, so the shared
+    request object must never hold attempt-scoped state."""
+
+    def __init__(self, request, **attrs) -> None:
+        self._request = request
+        for k, v in attrs.items():
+            setattr(self, k, v)
+
+    def __getattr__(self, name):
+        return getattr(self._request, name)
+
+
 @dataclass
 class FailoverPolicy:
     deadline_s: float = 30.0        # end-to-end cap per request
@@ -107,12 +122,38 @@ class Dispatcher:
             else:
                 self.outstanding[key] = n
 
-    def _submit(self, replica: ReplicaInfo, request) -> Attempt:
+    def _submit(self, replica: ReplicaInfo, request, attempt_n: int = 1,
+                hedge: bool = False) -> Attempt:
         self._inc(replica.key)
+        trace = getattr(request, "trace", None)
+        if trace is not None:
+            # one dispatch span per attempt; the replica's serve subtree
+            # nests under it (the worker passes the view's .trace into
+            # batcher.submit).  overhang_ok: a hedge loser's teardown
+            # legitimately lands after the gateway already recorded the
+            # winner and closed the root.
+            span = trace.child(
+                "dispatch", replica=replica.key, attempt=attempt_n,
+                hedge=hedge, overhang_ok=True,
+            )
+            request = _TraceView(request, trace=span)
+            attempt = self.client.submit(replica.key, request)
+            attempt._dispatch_span = span
+            return attempt
         return self.client.submit(replica.key, request)
 
     def _settle(self, attempt: Attempt) -> None:
         self._dec(attempt.replica)
+        span = getattr(attempt, "_dispatch_span", None)
+        if span is not None:
+            res = attempt.result()
+            span.end(
+                outcome=(
+                    "cancelled" if attempt.cancelled
+                    else "ok" if (res is not None and res.ok)
+                    else "error"
+                ),
+            )
 
     # -- the dispatch loop -------------------------------------------------
     def dispatch(
@@ -142,17 +183,41 @@ class Dispatcher:
         hedge_at: Optional[float] = None
         last_error = "no live replicas"
 
+        trace = getattr(request, "trace", None)
+        route_spans_left = [16]  # a zero-replica outage polls pick in a
+        # loop; the tree records the first N routing decisions, not one
+        # span per poll tick
+
+        def routed_pick(exclude: frozenset,
+                        hedge: bool = False) -> Optional[ReplicaInfo]:
+            # every routing decision is a span: the router annotates it
+            # (session pin state, re-pins) via the request view's
+            # route_span, so "why did this land there" is in the tree
+            replicas = live()
+            span = None
+            req = request
+            if trace is not None and route_spans_left[0] > 0:
+                route_spans_left[0] -= 1
+                span = trace.child("route", hedge=hedge)
+                req = _TraceView(request, route_span=span)
+            target = self.router.pick(
+                req, replicas, self.outstanding, exclude
+            )
+            if span is not None:
+                span.end(
+                    replica=target.key if target is not None else "",
+                    live=len(replicas),
+                )
+                if target is not None:
+                    route_spans_left[0] = max(route_spans_left[0], 4)
+            return target
+
         def pick_target() -> Optional[ReplicaInfo]:
             # prefer a replica this request hasn't touched; fall back to
             # re-trying one (it may have recovered) rather than failing
-            replicas = live()
-            target = self.router.pick(
-                request, replicas, self.outstanding, frozenset(tried)
-            )
+            target = routed_pick(frozenset(tried))
             if target is None and tried:
-                target = self.router.pick(
-                    request, replicas, self.outstanding, frozenset()
-                )
+                target = routed_pick(frozenset())
             return target
 
         while True:
@@ -204,8 +269,18 @@ class Dispatcher:
                         )
                     if self.metrics:
                         self.metrics.inc("gateway_retries_total")
+                    if trace is not None:
+                        # the retry decision itself is a tree node: the
+                        # re-admission after a failed attempt, with the
+                        # error it is retrying past
+                        trace.event(
+                            "retry", attempt=n_attempts + 1,
+                            after_error=last_error,
+                        )
                 tried.add(candidate.key)
-                attempts.append(self._submit(candidate, request))
+                attempts.append(
+                    self._submit(candidate, request, n_attempts + 1)
+                )
                 n_attempts += 1
                 hedge_at = time.monotonic() + policy.hedge_after_s
                 continue
@@ -247,14 +322,15 @@ class Dispatcher:
                 and hedge_at is not None
                 and now >= hedge_at
             ):
-                target = self.router.pick(
-                    request, live(), self.outstanding, frozenset(tried)
-                )
+                target = routed_pick(frozenset(tried), hedge=True)
                 if target is None:
                     hedge_at = None  # nowhere to hedge to; stop trying
                 elif self.hedge_budget.try_spend():
                     tried.add(target.key)
-                    attempts.append(self._submit(target, request))
+                    attempts.append(
+                        self._submit(target, request, n_attempts + 1,
+                                     hedge=True)
+                    )
                     hedged = True
                     if self.metrics:
                         self.metrics.inc("gateway_hedges_total")

@@ -67,6 +67,15 @@ class AdmissionQueue:
                 )
             q.append(request)
             self._depth += 1
+            # the admission-wait span: opened the moment the request is
+            # durably queued, closed by get() when a dispatcher picks it
+            # up — the span twin of gateway_queue_wait_seconds, but
+            # per-request and in-tree
+            trace = getattr(request, "trace", None)
+            if trace is not None:
+                request._admission_span = trace.child(
+                    "admission_wait", tenant=tenant
+                )
             self._not_empty.notify()
 
     def get(self, timeout: Optional[float] = None):
@@ -88,6 +97,9 @@ class AdmissionQueue:
                 del self._tenants[tenant]
                 if q:
                     self._tenants[tenant] = q
+                span = getattr(req, "_admission_span", None)
+                if span is not None:
+                    span.end()
                 return req
             return None
 

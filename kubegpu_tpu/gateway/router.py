@@ -108,12 +108,19 @@ class SessionAffinityRouter(Router):
 
     def pick(self, request, replicas, outstanding, exclude=frozenset()):
         session = getattr(request, "session", None)
+        # the dispatcher's open route span, when tracing: the pin
+        # decision lands in the request's tree (pinned hit vs re-pin is
+        # exactly the "why was turn-2 TTFT cold" answer)
+        route_span = getattr(request, "route_span", None)
         if not session:
             return self.fallback.pick(request, replicas, outstanding, exclude)
         with self._lock:
             pinned = self._pins.get(session)
         by_key = {r.key: r for r in replicas}
         if pinned and pinned in by_key and pinned not in exclude:
+            if route_span is not None:
+                route_span.annotate(session=session, pinned=pinned,
+                                    repin=False)
             return by_key[pinned]
         # pinned replica drained (or first sighting): route by load, with
         # the old pin as the locality hint so the replacement stays on the
@@ -122,6 +129,11 @@ class SessionAffinityRouter(Router):
             request = _with_hint(request, pinned, self._slice_of(pinned))
         choice = self.fallback.pick(request, replicas, outstanding, exclude)
         if choice is not None:
+            if route_span is not None:
+                route_span.annotate(
+                    session=session, repin=pinned is not None,
+                    lost_pin=pinned or "",
+                )
             if pinned is not None and self.metrics is not None:
                 # the session HAD a pin and lost it: its KV history is
                 # gone wherever the old replica went

@@ -16,6 +16,12 @@ behavior is testable without sockets; this module only translates HTTP:
     GET  /metrics       Prometheus text (TTFT/queue-wait histograms,
                         queue-depth/live-replica gauges)
     GET  /state         debug dump (replicas, queue, outcome counts)
+    GET  /debug/trace   recent completed request span trees (admission
+                        wait → route → dispatch → replica-side serve
+                        phases) + per-replica serving-ledger rows;
+                        ?n=K caps the trace count (default 32).  See
+                        README "Observability" for a worked example
+                        explaining a slow TTFT from this endpoint.
 
 Run self-hosted on a fabricated cluster for demos/tests (no k8s, no TPUs):
     python -m kubegpu_tpu.gateway.server --fake-cluster v5e-16 --replicas 3
@@ -89,6 +95,8 @@ def make_handler(gateway: Gateway, registry: ReplicaRegistry):
                            content_type="text/plain")
             elif self.path == "/state":
                 self._send(200, _debug_state(gateway, registry))
+            elif self.path.split("?", 1)[0] == "/debug/trace":
+                self._send(200, _debug_trace(gateway, self.path))
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 
@@ -157,6 +165,33 @@ def _debug_state(gateway: Gateway, registry: ReplicaRegistry) -> dict:
         "outstanding": dict(gateway.dispatcher.outstanding),
         "outcomes": outcomes,
         "completed_by_replica": dict(gateway.completed_by_replica),
+    }
+
+
+def _debug_trace(gateway: Gateway, path: str) -> dict:
+    """The ``GET /debug/trace`` body: recent completed span trees
+    (newest first) plus each reachable replica's per-iteration serving
+    ledger — the one page that answers "where did that request's TTFT
+    go" and "what is the pool doing" without touching the replica."""
+    limit = 32
+    if "?" in path:
+        from urllib.parse import parse_qs
+
+        qs = parse_qs(path.split("?", 1)[1])
+        try:
+            limit = max(1, int(qs.get("n", ["32"])[0]))
+        except ValueError:
+            pass
+    tracer = gateway.tracer
+    ledgers = getattr(gateway.client, "ledgers", None)
+    return {
+        "tracing": tracer is not None,
+        "open_traces": tracer.open_count() if tracer is not None else 0,
+        "evicted_traces": tracer.evicted if tracer is not None else 0,
+        "traces": (
+            tracer.dump_traces(limit=limit) if tracer is not None else []
+        ),
+        "ledgers": ledgers() if ledgers is not None else {},
     }
 
 

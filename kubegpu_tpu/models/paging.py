@@ -83,9 +83,12 @@ import numpy as np
 from kubegpu_tpu.models.decoding import DecodeLM, QuantDense, init_caches
 from kubegpu_tpu.models.serving import (
     _observe_emit,
+    _TracedBatcher,
+    _SeqTrace,
     _validate_request,
     resolve_decode_page_cache,
 )
+from kubegpu_tpu.utils.tracing import SpanCtx, Tracer
 from kubegpu_tpu.ops.paged_attention import (
     paged_chunk_attention,
     paged_decode_attention,
@@ -337,6 +340,9 @@ class _Seq:
     # cancel (nothing decode-committed) never tries to seal
     prompt: Optional[np.ndarray] = None
     plen: int = 0
+    # slot-owned trace state from admission to retirement (see
+    # _TracedBatcher's ownership model); None when untraced
+    trace: Optional[_SeqTrace] = None
 
 
 @dataclass
@@ -355,7 +361,7 @@ class _PrefillJob:
     started: bool = False    # first chunk ran (prefill-wait observed)
 
 
-class PagedContinuousBatcher:
+class PagedContinuousBatcher(_TracedBatcher):
     """Continuous batching with a shared KV page pool and prefix reuse.
 
     ``pool_pages`` bounds TOTAL cache memory across all slots; each
@@ -395,7 +401,19 @@ class PagedContinuousBatcher:
     same-prefix bursts serialize (computing a shared prefix twice in
     parallel wastes exactly the compute the cache exists to skip); a
     prefix the cache already resolves in full admits immediately, and
-    everything else overlaps."""
+    everything else overlaps.
+
+    Observability: ``tracer`` (or a per-request ``submit(..., trace=)``
+    context) turns every request into a span subtree — queue →
+    prefix_gather/station_wait → prefill (per-chunk children) → decode
+    (spec_draft/spec_verify children) → retire — whose contiguous
+    phases sum to the measured TTFT (gated in bench.py), and
+    retirement observes ``serve_phase_seconds{phase=...}``.
+    Independently of tracing, every ``serve_step`` appends one row to
+    a bounded LEDGER ring (``ledger_rows()``: budget rows used/limit,
+    station occupancy, pool page economy, prefix-cache size, spec
+    yield) mirrored as ``serve_step_rows`` / ``serve_pool_pages_*``
+    gauges — the /debug/trace surface upstream."""
 
     def __init__(
         self,
@@ -421,6 +439,8 @@ class PagedContinuousBatcher:
         top_k: int = 0,
         seed: int = 0,
         metrics: Optional[Metrics] = None,
+        tracer: Optional[Tracer] = None,
+        ledger_size: int = 512,
         draft_params=None,
         draft_num_layers: Optional[int] = None,
         draft_num_heads: Optional[int] = None,
@@ -512,6 +532,13 @@ class PagedContinuousBatcher:
         self.speculate_k = speculate_k
         self.draft_params = draft_params
         self.metrics = metrics
+        # request tracing (span trees) + the per-iteration ledger ring:
+        # both host-side, both bounded; a batcher with tracer=None and
+        # no caller-provided contexts records spans for nobody
+        self.tracer = tracer
+        self._traces: Dict[int, _SeqTrace] = {}
+        self._ledger: deque = deque(maxlen=ledger_size)
+        self._last_prefill_rows = 0
         self.params = params
         self.slots = slots
         self.prompt_pad = prompt_pad
@@ -963,6 +990,9 @@ class PagedContinuousBatcher:
                         f"decode_page_cache={self.decode_page_cache!r}"
                     )
 
+    def _trace_holders(self):
+        return self._seqs
+
     # -- admission ---------------------------------------------------------
     def _validate(self, prompt: np.ndarray, max_new: int) -> int:
         # shared dense/paged contract, plus the pool-capacity check only
@@ -1040,6 +1070,11 @@ class PagedContinuousBatcher:
         if need - len(hits) > self._available_pages(set(hits)):
             return False  # defer until retirements/evictions free pages
         self._pending_keys.pop(seq_id, None)
+        tr = self._traces.pop(seq_id, None)
+        if tr is not None:
+            # the queue phase ends at admission commit (pool + station
+            # secured); gather and station residency get their own spans
+            self._trace_phase_end(tr, "queue")
         station = min(set(range(self.station_slots)) - set(self._jobs))
         for j, key in enumerate(keys[: len(hits)]):
             acquired = self.prefix_cache.acquire(key)
@@ -1054,6 +1089,7 @@ class PagedContinuousBatcher:
         s.tokens, s.remaining = [], max_new
         s.pages, s.shared = pages, set(hits)
         s.submitted_at = submitted_at
+        s.trace = tr
         hit_rows = len(hits) * self.page
         # split hits by the HIT page's kind: "prompt" pages were sealed
         # by the dense station, "decode" pages at retirement (a turn-2
@@ -1087,12 +1123,22 @@ class PagedContinuousBatcher:
             self.metrics.inc("serve_prompt_tokens_total", plen)
         # hit rows only need station residency if chunks will run after
         # them; a full-prefix hit (two-turn sessions) skips the copies
-        if hit_rows < plen - 1:
+        if hit_rows < plen - 1 and hits:
+            gspan = (
+                tr.serve.child("prefix_gather", pages=len(hits),
+                               hit_rows=hit_rows)
+                if tr is not None else None
+            )
             for j in range(len(hits)):
                 self._station = self._gather_page(
                     self._station, self.pools, jnp.int32(station),
                     jnp.int32(hits[j]), jnp.int32(j * self.page),
                 )
+            if gspan is not None:
+                gspan.end()
+        if tr is not None:
+            self._trace_phase_start(tr, "station_wait",
+                                    hit_rows=hit_rows, pages=need)
         self._jobs[station] = _PrefillJob(
             slot=slot, station=station, seq_id=seq_id, prompt=prompt,
             plen=plen, temperature=temperature, keys=keys,
@@ -1156,6 +1202,14 @@ class PagedContinuousBatcher:
             )
             self._d_pos[slot] = job.plen - 1
         s.prefilling, s.active = False, True
+        tr = s.trace
+        if tr is not None:
+            t = time.monotonic()
+            # full-prefix hits go straight station_wait -> decode (zero
+            # chunks); everyone else closes the prefill phase here
+            self._trace_phase_end(tr, "station_wait", t=t)
+            self._trace_phase_end(tr, "prefill", t=t)
+            self._trace_phase_start(tr, "decode", t=t)
 
     def _observe_prefill_wait(self, job: _PrefillJob) -> None:
         if self.metrics is not None:
@@ -1172,6 +1226,7 @@ class PagedContinuousBatcher:
         included) per serving iteration.  Slots past the budget park via
         the program's mask — shapes never change, so occupancy and
         budget remainders never recompile."""
+        self._last_prefill_rows = 0
         if self._jobs:
             if self.token_budget is None:
                 pages_left = None
@@ -1209,14 +1264,29 @@ class PagedContinuousBatcher:
                     picked.append((st, job, end))
                 if not picked:
                     break
+                t0 = time.monotonic()
                 self._station = self._chunk(
                     self.params, self._station, jnp.asarray(rows),
                     jnp.asarray(starts), jnp.asarray(mask),
                 )
+                t1 = time.monotonic()
                 for st, job, end in picked:
                     if not job.started:
                         job.started = True
                         self._observe_prefill_wait(job)
+                    tr = self._seqs[job.slot].trace
+                    if tr is not None:
+                        if "prefill" not in tr.open:
+                            self._trace_phase_end(tr, "station_wait", t=t0)
+                            self._trace_phase_start(tr, "prefill", t=t0)
+                        # chunk spans share the batched program's wall
+                        # window: ONE invocation advanced every picked
+                        # job (the fused-station discipline, visible in
+                        # the trace as overlapping chunk spans)
+                        tr.open["prefill"].child(
+                            "chunk", t=t0, rows_start=job.pos, rows_end=end,
+                        ).end(t=t1)
+                    self._last_prefill_rows += end - job.pos
                     job.pos = end
                     advanced[st] += 1
                     self.stats["prefill_chunks"] += 1
@@ -1243,10 +1313,16 @@ class PagedContinuousBatcher:
     # -- incremental serving API (the gateway's replica loop) --------------
     def submit(self, seq_id: int, prompt: np.ndarray, max_new: int,
                temperature: float = 0.0,
-               session_id: Optional[str] = None) -> None:
+               session_id: Optional[str] = None,
+               trace: Optional[SpanCtx] = None) -> None:
         """Queue one request.  Validates shape and worst-case pool limits
         eagerly (a request that can never fit fails here, not mid-loop).
-        ``session_id`` is advisory: prefix sharing is content-addressed."""
+        ``session_id`` is advisory: prefix sharing is content-addressed.
+        ``trace`` is an optional caller span context (the gateway's
+        dispatch span): the request's ``serve`` subtree — queue →
+        prefix_gather/station_wait → prefill (chunks) → decode
+        (spec_draft/spec_verify) → retire — nests under it; otherwise
+        the batcher's own ``tracer``, if any, roots a fresh trace."""
         if seq_id < 0:
             raise ValueError(f"seq_id must be >= 0, got {seq_id}")
         if self.speculate_k is not None and temperature > 0.0:
@@ -1258,10 +1334,11 @@ class PagedContinuousBatcher:
                 "temperature=0 or build the batcher without speculate_k"
             )
         prompt = np.asarray(prompt, np.int32)
-        self._validate(prompt, max_new)
+        plen = self._validate(prompt, max_new)
         # a reused seq_id binds to a NEW prompt: any memoized prefix keys
         # from a deferred-then-abandoned admission are stale now
         self._pending_keys.pop(seq_id, None)
+        self._trace_begin(seq_id, plen, max_new, trace)
         self._pending.append(
             (seq_id, prompt, max_new, temperature, time.monotonic())
         )
@@ -1280,6 +1357,7 @@ class PagedContinuousBatcher:
             if item[0] == seq_id:
                 del self._pending[i]
                 self._pending_keys.pop(seq_id, None)
+                self._trace_retire_queued(seq_id, "cancelled")
                 return True
         for i, s in enumerate(self._seqs):
             if s.seq_id == seq_id:
@@ -1288,22 +1366,26 @@ class PagedContinuousBatcher:
                         # the station slot's rows become garbage; the
                         # next job there overwrites before it attends
                         del self._jobs[st]
-                self._teardown_slot(i, s)  # seals first (uses s.tokens)
+                self._teardown_slot(i, s, reason="cancelled")
                 s.active, s.prefilling = False, False
                 s.tokens, s.remaining = [], 0
                 return True
         return False
 
-    def _teardown_slot(self, i: int, s: _Seq) -> None:
+    def _teardown_slot(self, i: int, s: _Seq,
+                       reason: str = "finished") -> None:
         """The shared retirement/cancel epilogue: seal complete pages
         (policy-gated no-op unless the sequence committed tokens),
         release the rest, and park the slot on the dump page so its
         (inevitable, static-shape) step writes can never touch a
         reallocated page.  Every retirement-path field reset lives HERE
-        so the finish and cancel paths cannot drift.  Seal BEFORE
-        release: sealing flips complete private pages to cache-owned, so
-        release decrefs them to idle (LRU-evictable) instead of freeing
-        the bytes a turn-2 prompt is about to want."""
+        so the finish and cancel paths cannot drift — including the
+        trace epilogue: exactly ONE ``retire`` span per sequence, which
+        is what the trace-derived soak oracle holds the batcher to.
+        Seal BEFORE release: sealing flips complete private pages to
+        cache-owned, so release decrefs them to idle (LRU-evictable)
+        instead of freeing the bytes a turn-2 prompt is about to want."""
+        self._trace_retire_slot(s, reason)
         self._seal_finished_pages(s)
         self._release_pages(s)
         s.seq_id = -1
@@ -1358,6 +1440,7 @@ class PagedContinuousBatcher:
                     s = self._seqs[free]
                     s.seq_id, s.active = nxt[0], False
                     s.prefilling, s.tokens, s.remaining = False, [], 0
+                    s.trace = self._traces.pop(nxt[0], None)
                     self._pending.popleft()
                     self.stats["admits"] += 1
                     progress = True
@@ -1375,6 +1458,7 @@ class PagedContinuousBatcher:
         pack bounded by ``token_budget``), run ONE paged decode step if
         anything is active, retire again."""
         finished: Dict[int, List[int]] = {}
+        spec_emitted = 0
         self._sweep(finished)
         self._advance_prefill()
         if self.metrics is not None:
@@ -1389,9 +1473,10 @@ class PagedContinuousBatcher:
                     "serve_draft_cache_rows",
                     float(self.slots * self.draft_window),
                 )
-        if any(s.active for s in self._seqs):
+        n_active = sum(1 for s in self._seqs if s.active)
+        if n_active:
             if self.speculate_k is not None:
-                self._spec_step_host()
+                spec_emitted = self._spec_step_host()
             else:
                 counts = np.array(
                     [len(sq.tokens) for sq in self._seqs], np.int32
@@ -1413,14 +1498,66 @@ class PagedContinuousBatcher:
                     s.remaining -= 1
                     self._last[i] = t
                     _observe_emit(self.metrics, s, first=first)
+                    if first:
+                        self._trace_first_token(s)
                     if s.remaining <= 0 or (
                         self.eos_id is not None and t == self.eos_id
                     ):
                         s.active = False
             self._sweep(finished)
+        self._ledger_record(n_active, spec_emitted)
         return finished
 
-    def _spec_step_host(self) -> None:
+    def _ledger_record(self, n_active: int, spec_emitted: int) -> None:
+        """Append this iteration's LEDGER row — what the pool, station
+        and budget were doing — to the bounded ring, and mirror it as
+        gauges.  One glance answers "what is the replica doing": rows
+        spent against the budget, station occupancy, page economy,
+        speculation yield.  Host-side dict assembly only; ~1 µs."""
+        rows = self._last_prefill_rows + n_active * (
+            (self.speculate_k + 1) if self.speculate_k is not None else 1
+        )
+        cached = (
+            len(self.prefix_cache) if self.prefix_cache is not None else 0
+        )
+        row = {
+            "step": self.stats["steps"],
+            "t": time.monotonic(),
+            "rows": rows,
+            "budget": self.token_budget or 0,
+            "station_busy": len(self._jobs),
+            "station_slots": self.station_slots,
+            "active": n_active,
+            "pending": len(self._pending),
+            "pages_free": len(self.free_pages),
+            "pages_live": self.pages_in_use(),
+            "pages_cached": cached,
+            "cache_idle": (
+                self.prefix_cache.idle_count()
+                if self.prefix_cache is not None else 0
+            ),
+            "decode_pages_sealed": self.stats["decode_pages_sealed"],
+            "prefix_hit_tokens": self.stats["prefix_hit_tokens"],
+            "spec_tokens": spec_emitted,
+        }
+        self._ledger.append(row)
+        if self.metrics is not None:
+            self.metrics.set_gauge("serve_step_rows", float(rows))
+            self.metrics.set_gauge(
+                "serve_pool_pages_free", float(row["pages_free"])
+            )
+            self.metrics.set_gauge(
+                "serve_pool_pages_live", float(row["pages_live"])
+            )
+            self.metrics.set_gauge("serve_pool_pages_cached", float(cached))
+
+    def ledger_rows(self, limit: Optional[int] = None) -> List[dict]:
+        """The most recent ledger rows (oldest first), up to ``limit``
+        — the /debug/trace surface and the bench's budget audit."""
+        rows = list(self._ledger)
+        return rows[-limit:] if limit is not None else rows
+
+    def _spec_step_host(self) -> int:
         """One speculative serving iteration for every active slot: the
         draft scan proposes k tokens per slot at its own depth, ONE
         verify program scores all k+1 window positions against the paged
@@ -1442,6 +1579,7 @@ class PagedContinuousBatcher:
             if s.active and int(self._d_pos[i]) + k + 1 > self.draft_window:
                 self._d_pos[i] = 0
                 self.stats["draft_wraps"] += 1
+        td0 = time.monotonic()
         with draft_ctx:
             proposals, self.d_caches = self._spec_draft(
                 self.draft_params, self.d_caches, jnp.asarray(self._last),
@@ -1454,6 +1592,7 @@ class PagedContinuousBatcher:
                 # verify consumes proposals as a device array, so the
                 # hot path keeps async dispatch
                 proposals = jax.block_until_ready(proposals)
+        tv0 = time.monotonic()
         with verify_ctx:
             choices, emit_len, next_last, self.pools = self._spec_verify(
                 self.params, self.pools, jnp.asarray(self._last),
@@ -1462,6 +1601,7 @@ class PagedContinuousBatcher:
             choices_h = np.asarray(choices)
             emit_h = np.asarray(emit_len)
             next_h = np.asarray(next_last)
+        tv1 = time.monotonic()
         self.stats["steps"] += 1
         self.stats["spec_steps"] += 1
         spec_emitted = 0
@@ -1483,10 +1623,22 @@ class PagedContinuousBatcher:
             emitted = emitted[: s.remaining]
             if self.eos_id is not None and self.eos_id in emitted:
                 emitted = emitted[: emitted.index(self.eos_id) + 1]
+            tr = s.trace
+            if tr is not None and "decode" in tr.open:
+                # one draft + one verify span per iteration per traced
+                # slot, sharing the iteration's wall windows (the fused
+                # programs covered every slot at once)
+                decode = tr.open["decode"]
+                decode.child("spec_draft", t=td0, k=k).end(t=tv0)
+                decode.child(
+                    "spec_verify", t=tv0, accepted=e, emitted=len(emitted),
+                ).end(t=tv1)
             for t in emitted:
                 first = not s.tokens
                 s.tokens.append(t)
                 _observe_emit(self.metrics, s, first=first)
+                if first:
+                    self._trace_first_token(s)
             s.remaining -= len(emitted)
             spec_emitted += len(emitted)
             self._last[i] = int(next_h[i])
@@ -1504,6 +1656,7 @@ class PagedContinuousBatcher:
             # multi-token yield per verify program
             self.metrics.inc("serve_spec_tokens_per_step", spec_emitted)
             self.metrics.inc("serve_spec_steps_total")
+        return spec_emitted
 
     # -- the batch convenience loop ----------------------------------------
     def run(

@@ -51,6 +51,7 @@ import numpy as np
 
 from kubegpu_tpu.models.decoding import DecodeLM, init_caches
 from kubegpu_tpu.utils.metrics import Metrics
+from kubegpu_tpu.utils.tracing import SpanCtx, Tracer
 
 # Session KV reuse policy: may the paged batcher seal DECODE-produced
 # pages (a retired sequence's generated tokens) into the shared prefix
@@ -141,6 +142,147 @@ class _Slot:
     submitted_at: float = 0.0
     last_emit_at: float = 0.0
     admit_seq: int = 0        # admission order (token-budget FIFO)
+    # slot-owned trace state from admission to retirement (see
+    # _TracedBatcher's ownership model); None when untraced
+    trace: Optional["_SeqTrace"] = None
+
+
+@dataclass
+class _SeqTrace:
+    """Per-request trace state a batcher keeps while the request lives:
+    the ``serve`` span (the replica-side subtree root), the currently
+    open phase spans, and the completed phase durations (observed into
+    ``serve_phase_seconds{phase=...}`` at retirement)."""
+
+    serve: SpanCtx
+    open: Dict[str, SpanCtx] = field(default_factory=dict)
+    phases: Dict[str, float] = field(default_factory=dict)
+
+
+class _TracedBatcher:
+    """Shared request-tracing plumbing for the dense and paged batchers
+    (the ``_observe_emit`` discipline applied to spans: one
+    implementation, so phase semantics cannot diverge).
+
+    Ownership model: a QUEUED request's trace lives in ``self._traces``
+    (keyed by seq_id); at admission the batcher moves it onto the
+    sequence's slot state (``s.trace``), so a later submit REUSING the
+    seq_id while the old sequence still runs cannot cross wires — the
+    old sequence closes its own trace at its own retirement, the new
+    request's trace waits in ``_traces``.  Only a duplicate seq_id that
+    is still QUEUED gets its stale trace closed (``resubmitted``).
+
+    Requires the host class to provide ``self.tracer``
+    (Optional[Tracer]), ``self._traces``, ``self.metrics``, and
+    ``_trace_holders()`` (live slot states carrying ``.trace``).  Every
+    method is a no-op for untraced requests — a batcher built without a
+    tracer and fed no gateway context pays a dict lookup at most."""
+
+    tracer: Optional[Tracer]
+    _traces: Dict[int, "_SeqTrace"]
+
+    def _trace_begin(self, seq_id: int, plen: int, max_new: int,
+                     trace: Optional[SpanCtx]) -> None:
+        """Open the ``serve`` subtree (under the caller's context —
+        normally the gateway's dispatch span — or as a root trace of the
+        batcher's own tracer) plus the ``queue`` admission-wait phase."""
+        old = self._traces.pop(seq_id, None)
+        if old is not None:
+            # same seq_id submitted twice while still QUEUED: close the
+            # stale subtree or its spans leak open forever (an id reused
+            # after admission is not affected — that trace moved onto
+            # the slot and retires with its own sequence)
+            self._trace_close(old, "resubmitted")
+        if trace is not None:
+            ctx = trace.child("serve", seq_id=seq_id, plen=plen,
+                              max_new=max_new)
+        elif self.tracer is not None:
+            ctx = self.tracer.start_trace("serve", seq_id=seq_id, plen=plen,
+                                          max_new=max_new)
+        else:
+            return
+        tr = _SeqTrace(serve=ctx)
+        tr.open["queue"] = ctx.child("queue")
+        self._traces[seq_id] = tr
+
+    def _trace_phase_end(self, tr: "_SeqTrace", name: str,
+                         t: Optional[float] = None) -> None:
+        span = tr.open.pop(name, None)
+        if span is not None:
+            t = time.monotonic() if t is None else t
+            span.end(t=t)
+            tr.phases[name] = tr.phases.get(name, 0.0) + (t - span.start)
+
+    def _trace_phase_start(self, tr: "_SeqTrace", name: str,
+                           t: Optional[float] = None, **attrs) -> None:
+        tr.open[name] = tr.serve.child(name, t=t, **attrs)
+
+    def _trace_first_token(self, s) -> None:
+        """Annotate the decode span with the first-token stamp and the
+        INDEPENDENTLY-measured TTFT (``_observe_emit``'s submitted_at
+        arithmetic) — bench.py gates the span-sum against this value,
+        so the two instrumentation paths cross-check each other."""
+        tr = s.trace
+        if tr is None:
+            return
+        decode = tr.open.get("decode")
+        if decode is not None:
+            decode.annotate(
+                first_token_t=s.last_emit_at,
+                measured_ttft=s.last_emit_at - s.submitted_at,
+            )
+            tr.phases["first_step"] = s.last_emit_at - decode.start
+
+    def _trace_close(self, tr: "_SeqTrace", reason: str,
+                     n_tokens: int = 0, **attrs) -> None:
+        t = time.monotonic()
+        for name in list(tr.open):
+            self._trace_phase_end(tr, name, t=t)
+        tr.serve.event("retire", t=t, reason=reason, n_tokens=n_tokens,
+                       **attrs)
+        tr.serve.end(t=t)
+        if self.metrics is not None and tr.phases:
+            phases = dict(tr.phases)
+            if "first_step" in phases and "decode" in phases:
+                # the decode PHASE starts at activation; first_step is
+                # its leading slice (activation -> first token) — split
+                # so the labeled series sum to the request's wall time
+                phases["decode"] = max(
+                    0.0, phases["decode"] - phases["first_step"]
+                )
+            for phase, d in phases.items():
+                self.metrics.observe("serve_phase_seconds", d, phase=phase)
+
+    def _trace_retire_queued(self, seq_id: int, reason: str) -> None:
+        """Close a trace still in the QUEUED map (cancel-from-pending)."""
+        tr = self._traces.pop(seq_id, None)
+        if tr is not None:
+            self._trace_close(tr, reason)
+
+    def _trace_retire_slot(self, s, reason: str) -> None:
+        """Close a slot-owned trace at retirement/cancel — the one
+        place a live sequence's tree ends, so exactly one retire."""
+        tr = s.trace
+        if tr is not None:
+            s.trace = None
+            self._trace_close(tr, reason, n_tokens=len(s.tokens))
+
+    def trace_shutdown(self, reason: str = "replica died") -> None:
+        """The process-death epilogue (in-memory data plane: the worker
+        thread's exit path): every queued and live request's spans close
+        with a ``retire`` of reason ``died`` (the caller's detail kept
+        as the ``note`` attribute) so the trace tree stays complete — a
+        killed replica must end its spans the way a dead pod ends its
+        connections, explicitly."""
+        for seq_id in list(self._traces):
+            tr = self._traces.pop(seq_id)
+            self._trace_close(tr, "died", note=reason)
+        for s in self._trace_holders():
+            tr = s.trace
+            if tr is not None:
+                s.trace = None
+                self._trace_close(tr, "died", n_tokens=len(s.tokens),
+                                  note=reason)
 
 
 def _observe_emit(metrics, s, first: bool) -> None:
@@ -176,7 +318,7 @@ def _validate_request(prompt: np.ndarray, max_new: int,
     return plen
 
 
-class ContinuousBatcher:
+class ContinuousBatcher(_TracedBatcher):
     """Greedy continuous-batching decoder over a fixed slot count.
 
     ``prompt_pad``: upper bound on admissible prompt length.  Under the
@@ -203,6 +345,13 @@ class ContinuousBatcher:
     the batcher observes ``serve_ttft_seconds`` / ``serve_itl_seconds``
     histograms and ``serve_prefill_chunks_total`` so a gateway sharing
     the registry exposes data-plane latency next to its own.
+
+    ``tracer``: optional ``utils.tracing.Tracer``; when given (or when
+    ``submit`` receives a caller's trace context), every request yields
+    a ``serve`` span subtree — queue → prefill → decode → retire — and
+    retirement observes per-phase wall time into
+    ``serve_phase_seconds{phase=...}``.  Without either, tracing costs
+    nothing.
     """
 
     def __init__(
@@ -224,6 +373,7 @@ class ContinuousBatcher:
         top_k: int = 0,
         seed: int = 0,
         metrics: Optional[Metrics] = None,
+        tracer: Optional[Tracer] = None,
     ) -> None:
         if prompt_pad > max_seq:
             raise ValueError(
@@ -273,6 +423,8 @@ class ContinuousBatcher:
         self.token_budget = token_budget
         self._admit_counter = 0
         self.metrics = metrics
+        self.tracer = tracer
+        self._traces: Dict[int, _SeqTrace] = {}
         self.params = params
         self.slots = slots
         self.prompt_pad = prompt_pad
@@ -404,6 +556,9 @@ class ContinuousBatcher:
         self._last_tokens = jnp.zeros((slots,), jnp.int32)
 
     # -- host-side orchestration -------------------------------------------
+    def _trace_holders(self):
+        return self._slots
+
     def _validate(self, prompt: np.ndarray, max_new: int) -> int:
         return _validate_request(prompt, max_new, self.prompt_pad,
                                  self.max_seq)
@@ -417,12 +572,18 @@ class ContinuousBatcher:
         # monolithic admit (prefill_chunk=None): one padded b=1 prefill
         # spliced into the shared cache, first token included
         plen = self._validate(prompt, max_new)
+        tr = self._traces.pop(seq_id, None)
         if max_new <= 0:
             # match generate(num_steps=0): nothing owed, nothing emitted —
             # the admit program would still produce a first token
             s = self._slots[slot_idx]
             s.seq_id, s.active, s.tokens, s.remaining = seq_id, False, [], 0
+            s.trace = tr        # _sweep retires the no-op slot's trace
             return
+        if tr is not None:
+            t = time.monotonic()
+            self._trace_phase_end(tr, "queue", t=t)
+            self._trace_phase_start(tr, "prefill", t=t, monolithic=True)
         row = np.zeros((self.prompt_pad,), np.int32)
         row[:plen] = prompt
         base_key = jax.random.fold_in(self._root_key, seq_id)
@@ -438,7 +599,13 @@ class ContinuousBatcher:
         s.tokens = [int(first_tok)]
         s.remaining = max_new - 1
         s.submitted_at = submitted_at
+        s.trace = tr
+        if tr is not None:
+            t = time.monotonic()
+            self._trace_phase_end(tr, "prefill", t=t)
+            self._trace_phase_start(tr, "decode", t=t)
         _observe_emit(self.metrics, s, first=True)
+        self._trace_first_token(s)
         self._last_tokens = self._last_tokens.at[slot_idx].set(first_tok)
         if self.eos_id is not None and s.tokens[-1] == self.eos_id:
             s.remaining = 0
@@ -452,10 +619,16 @@ class ContinuousBatcher:
         # advance in serve_step, interleaved with decode
         self._validate(prompt, max_new)
         s = self._slots[slot_idx]
+        tr = self._traces.pop(seq_id, None)
+        s.trace = tr
         if max_new <= 0:
             s.seq_id, s.active, s.tokens, s.remaining = seq_id, False, [], 0
             s.prompt = None
             return
+        if tr is not None:
+            t = time.monotonic()
+            self._trace_phase_end(tr, "queue", t=t)
+            self._trace_phase_start(tr, "prefill", t=t)
         s.seq_id, s.active = seq_id, False
         s.tokens, s.remaining = [], max_new
         s.prompt, s.prefill_pos = prompt, 0
@@ -486,6 +659,11 @@ class ContinuousBatcher:
         self.pos = self.pos.at[slot_idx].set(plen - 1)
         s.active = True
         s.prompt = None
+        tr = s.trace
+        if tr is not None:
+            t = time.monotonic()
+            self._trace_phase_end(tr, "prefill", t=t)
+            self._trace_phase_start(tr, "decode", t=t)
 
     def _advance_prefill(self) -> None:
         """One chunk program covering every prefilling slot within the
@@ -525,15 +703,29 @@ class ContinuousBatcher:
                 mask[i] = True
                 any_rows = True
         if any_rows:
+            t0 = time.monotonic()
             self.caches = self._chunk(
                 self.params, self.caches, jnp.asarray(tokens),
                 jnp.asarray(cpos), jnp.asarray(mask),
             )
+            t1 = time.monotonic()
             self.stats["prefill_chunks"] += int(mask.sum())
             if self.metrics is not None:
                 self.metrics.inc(
                     "serve_prefill_chunks_total", float(mask.sum())
                 )
+            if self._traces:
+                # per-slot chunk spans share the batched program's wall
+                # window (ONE invocation covered them all)
+                for i in pref:
+                    if not mask[i]:
+                        continue
+                    tr = self._slots[i].trace
+                    if tr is not None and "prefill" in tr.open:
+                        tr.open["prefill"].child(
+                            "chunk", t=t0, rows_start=int(cpos[i]),
+                            rows_end=int(ends[i]),
+                        ).end(t=t1)
         for i in pref:
             s = self._slots[i]
             s.prefill_pos = ends[i]
@@ -543,18 +735,23 @@ class ContinuousBatcher:
     # -- incremental serving API (the gateway's replica loop) --------------
     def submit(self, seq_id: int, prompt: np.ndarray, max_new: int,
                temperature: float = 0.0,
-               session_id: Optional[str] = None) -> None:
+               session_id: Optional[str] = None,
+               trace: Optional[SpanCtx] = None) -> None:
         """Queue one request (seq_id must be a fresh non-negative int).
         Validates shape limits eagerly so a malformed request fails at
         submission, never mid-serve-loop where it would take down the
         whole batch.  ``session_id`` is the gateway's session/prefix key;
         the dense batcher records it for operators but shares no state —
         prefix reuse lives in the paged batcher (content-addressed, so
-        the key itself is advisory there too)."""
+        the key itself is advisory there too).  ``trace`` is an optional
+        caller span context (the gateway's dispatch span): the request's
+        ``serve`` subtree nests under it; otherwise the batcher's own
+        ``tracer``, if any, roots a fresh trace."""
         if seq_id < 0:
             raise ValueError(f"seq_id must be >= 0, got {seq_id}")
         prompt = np.asarray(prompt, np.int32)
-        self._validate(prompt, max_new)
+        plen = self._validate(prompt, max_new)
+        self._trace_begin(seq_id, plen, max_new, trace)
         self._pending.append(
             (seq_id, prompt, max_new, temperature, time.monotonic())
         )
@@ -567,9 +764,11 @@ class ContinuousBatcher:
         for i, item in enumerate(self._pending):
             if item[0] == seq_id:
                 del self._pending[i]
+                self._trace_retire_queued(seq_id, "cancelled")
                 return True
         for s in self._slots:
             if s.seq_id == seq_id:
+                self._trace_retire_slot(s, "cancelled")
                 s.seq_id, s.active, s.tokens, s.remaining = -1, False, [], 0
                 s.prompt = None
                 return True
@@ -589,6 +788,7 @@ class ContinuousBatcher:
             for i, s in enumerate(self._slots):
                 if s.seq_id >= 0 and not s.active and s.prompt is None:
                     finished[s.seq_id] = s.tokens
+                    self._trace_retire_slot(s, "finished")
                     s.seq_id = -1
                     progress = True
                 if s.seq_id < 0 and self._pending:
@@ -638,6 +838,8 @@ class ContinuousBatcher:
                 s.tokens.append(t)
                 s.remaining -= 1
                 _observe_emit(self.metrics, s, first=first)
+                if first:
+                    self._trace_first_token(s)
                 if s.remaining <= 0 or (
                     self.eos_id is not None and t == self.eos_id
                 ):

@@ -23,6 +23,15 @@ provoking hedged dispatch — with the data-plane invariant:
   I5  after quiescence every admitted request was served exactly once or
       rejected with explicit backpressure: one terminal result per
       request, no hedge-duplicated delivery, nothing silently dropped.
+
+With tracing on (the Gateway default), I5 is additionally RE-DERIVED
+from the span trees: every request yields exactly one complete,
+properly-nested trace — zero orphan spans, zero unclosed spans, and
+every replica-side ``serve`` subtree contains exactly one ``retire``
+(a second retire is the double-teardown I5's result-level accounting
+could miss when a hedge loser and a cancel race).  The trace oracle and
+the result-ledger oracle check the same invariant through two
+independent instrumentation paths.
 """
 
 import random
@@ -456,8 +465,12 @@ class GatewaySoak:
         )
         self.registry.subscribe(self.client.sync_live)
         self.metrics = Metrics()
+        from kubegpu_tpu.utils.tracing import Tracer
+
         # generous retry budget: a replica kill must cost retries, never
-        # requests — that is exactly what I5 holds the gateway to
+        # requests — that is exactly what I5 holds the gateway to.  The
+        # tracer ring is sized past any soak's request count so the
+        # trace oracle judges EVERY request, not a sample.
         self.gw = Gateway(
             self.registry, self.client,
             queue=AdmissionQueue(capacity=64),
@@ -466,6 +479,7 @@ class GatewaySoak:
                 retry_budget_ratio=1.0, budget_floor=1000,
             ),
             metrics=self.metrics, dispatchers=8,
+            tracer=Tracer(max_traces=65536),
         )
         self.registry.refresh()
         self.gw.start()
@@ -625,6 +639,51 @@ class GatewaySoak:
             check = getattr(w.batcher, "assert_page_accounting", None)
             if check is not None:
                 check()
+        self.check_traces(trace)
+
+    def check_traces(self, trace: str):
+        """I5 re-derived from spans: every request yields exactly one
+        COMPLETE, properly-nested span tree — zero orphans, zero
+        unclosed spans, exactly one retire per serve subtree — across
+        whatever kill/revive/hedge/cancel schedule just ran."""
+        from kubegpu_tpu.utils.tracing import (
+            serve_retire_violations, validate_trace,
+        )
+
+        tracer = self.gw.tracer
+        if tracer is None:
+            return
+        # hedge-loser cancels drain asynchronously after the winner's
+        # result; give them their bounded moment before judging
+        assert tracer.wait_quiescent(10.0), (
+            f"I5/traces: {tracer.open_count()} traces still open after "
+            f"quiescence — spans leaked\n{trace}"
+        )
+        completed = tracer.completed()
+        problems = []
+        seen_ids = set()
+        for spans in completed:
+            problems += validate_trace(spans)
+            problems += serve_retire_violations(spans)
+            root = next(s for s in spans if s["parent"] is None)
+            seen_ids.add(root["attrs"].get("request_id"))
+        assert not problems, (
+            "I5/traces: structural violations:\n"
+            + "\n".join(problems[:20]) + f"\n{trace}"
+        )
+        if tracer.evicted == 0:
+            # the ring retained everything: the tree set must cover the
+            # request set exactly — one tree per request, no phantoms
+            missing = set(self.pendings) - seen_ids
+            phantom = seen_ids - set(self.pendings)
+            assert not missing, (
+                f"I5/traces: requests without a span tree: "
+                f"{sorted(missing)[:10]}\n{trace}"
+            )
+            assert not phantom, (
+                f"I5/traces: span trees for unknown requests: "
+                f"{sorted(p for p in phantom if p)[:10]}\n{trace}"
+            )
 
     def quiesce(self, timeout: float = 120.0):
         """Restore all hardware, then wait out the in-flight work."""

@@ -1,0 +1,141 @@
+"""The one catalog of every metric name this codebase emits.
+
+Dashboards, alerts, README's "Observability" table, and the exposition
+tests all reference metric names as strings; nothing ties them to the
+emitting call sites — a rename in code silently breaks every consumer.
+This module is the tie: each emitted name appears EXACTLY once below
+with its instrument type, label keys, and one-line meaning, and
+``tests/test_metrics_catalog.py`` greps ``kubegpu_tpu/`` for
+``inc(``/``observe(``/``set_gauge(``/``timer(`` string literals and
+fails the build on any name missing here (and on any catalog entry no
+code emits — a stale catalog is drift too).
+
+Conventions (Prometheus): ``*_total`` counters, ``*_seconds`` histograms
+of wall time, gauges for current levels.  Histograms render as
+``<name>_count`` / ``<name>_sum`` plus reservoir ``quantile`` series —
+see utils/metrics.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Tuple
+
+
+class MetricSpec(NamedTuple):
+    type: str                    # "counter" | "gauge" | "histogram"
+    labels: Tuple[str, ...]      # label KEYS the emitting sites attach
+    help: str
+
+
+def _c(labels: Tuple[str, ...], help: str) -> MetricSpec:
+    return MetricSpec("counter", labels, help)
+
+
+def _g(labels: Tuple[str, ...], help: str) -> MetricSpec:
+    return MetricSpec("gauge", labels, help)
+
+
+def _h(labels: Tuple[str, ...], help: str) -> MetricSpec:
+    return MetricSpec("histogram", labels, help)
+
+
+CATALOG: Dict[str, MetricSpec] = {
+    # -- scheduler / control plane (scheduler/core.py, utils/apiserver.py)
+    "kubegpu_filter_total": _c((), "extender Filter calls"),
+    "kubegpu_filter_seconds": _h((), "Filter handler wall time"),
+    "kubegpu_prioritize_total": _c((), "extender Prioritize calls"),
+    "kubegpu_prioritize_seconds": _h((), "Prioritize handler wall time"),
+    "kubegpu_bind_total": _c((), "extender Bind calls"),
+    "kubegpu_bind_seconds": _h((), "Bind handler wall time"),
+    "kubegpu_bind_conflicts_total": _c(
+        (), "binds refused by optimistic-concurrency conflicts"),
+    "kubegpu_chips_allocated_total": _c((), "chips handed to pods"),
+    "kubegpu_grouped_allocated_total": _c(
+        (), "chips allocated via gang (grouped) admission"),
+    "kubegpu_placements_total": _c((), "successful placements"),
+    "kubegpu_placements_contiguous_total": _c(
+        (), "placements that were ICI-contiguous"),
+    "kubegpu_preemptions_total": _c((), "preemption plans executed"),
+    "kubegpu_preempted_pods_total": _c((), "pods evicted by preemption"),
+    "kubegpu_stranded_gang_rollbacks_total": _c(
+        (), "partially-bound gangs rolled back after the grace window"),
+    "kubegpu_health_evictions_total": _c(
+        (), "assignments evicted because their chips disappeared"),
+
+    # -- gateway control plane (gateway/core.py, failover.py, router.py)
+    "gateway_requests_total": _c(
+        ("outcome",), "terminal results by outcome (ok/rejected/error/"
+        "timeout)"),
+    "gateway_queue_depth": _g((), "admission queue depth"),
+    "gateway_queue_wait_seconds": _h(
+        (), "enqueue -> dispatcher pickup wait"),
+    "gateway_ttft_seconds": _h(
+        (), "enqueue -> full response (unary data plane: TTFT == TTLT)"),
+    "gateway_live_replicas": _g((), "replicas routable right now"),
+    "gateway_deadline_exceeded_total": _c(
+        (), "requests failed by the end-to-end deadline"),
+    "gateway_failures_total": _c(
+        (), "requests failed after exhausting attempts"),
+    "gateway_retries_total": _c((), "re-dispatches after a failed attempt"),
+    "gateway_retry_budget_exhausted_total": _c(
+        (), "retries refused by the retry budget"),
+    "gateway_hedges_total": _c((), "hedged (duplicate) dispatches issued"),
+    "gateway_hedge_wasted_total": _c(
+        (), "hedge attempts cancelled because another attempt won"),
+    "gateway_duplicate_results_total": _c(
+        (), "second terminal results dropped by exactly-once delivery"),
+    "gateway_session_repin_total": _c(
+        (), "session re-pins after the pinned replica drained (KV loss)"),
+
+    # -- serving data plane (models/serving.py, models/paging.py)
+    "serve_ttft_seconds": _h((), "submit -> first generated token"),
+    "serve_itl_seconds": _h((), "inter-token latency between emits"),
+    "serve_phase_seconds": _h(
+        ("phase",), "per-request phase wall time from the span tree "
+        "(queue/station_wait/prefill/first_step/decode); emitted only "
+        "when tracing is enabled"),
+    "serve_prefill_chunks_total": _c((), "prefill chunk programs run"),
+    "serve_prefill_wait_seconds": _h(
+        (), "submit -> first prefill chunk (station wait included)"),
+    "serve_station_slots_busy": _g(
+        (), "prefill-station slots occupied by in-flight admissions"),
+    "serve_prompt_tokens_total": _c((), "prompt tokens admitted"),
+    "serve_prefix_hit_tokens_total": _c(
+        ("kind",), "prompt tokens skipped via prefix-cache hits, split "
+        "by hit-page kind (prompt/decode); labeled series only — sum "
+        "over the label for the total"),
+    "serve_decode_pages_sealed_total": _c(
+        (), "decode-produced pages sealed into the prefix cache at "
+        "retirement"),
+    "serve_spec_steps_total": _c((), "speculative verify iterations"),
+    "serve_spec_tokens_per_step": _c(
+        (), "tokens committed by speculative verifies (divide by "
+        "serve_spec_steps_total for the per-step mean)"),
+    "serve_spec_accept_rate": _h(
+        (), "accepted-draft fraction per slot per verify (e-1)/k"),
+    "serve_spec_draft_seconds": _h((), "draft proposal program wall time"),
+    "serve_spec_verify_seconds": _h((), "verify program wall time"),
+    "serve_draft_cache_rows": _g(
+        (), "draft ring-cache rows resident (slots x draft_window)"),
+
+    # -- per-iteration serving ledger (PagedContinuousBatcher.serve_step;
+    #    the gauge twin of the bounded ledger ring at /debug/trace)
+    "serve_step_rows": _g(
+        (), "rows processed by the last serving iteration (decode tokens"
+        " + prefill chunk rows) against token_budget"),
+    "serve_pool_pages_free": _g((), "KV pool pages on the free list"),
+    "serve_pool_pages_live": _g(
+        (), "KV pool pages privately held by live sequences"),
+    "serve_pool_pages_cached": _g(
+        (), "KV pool pages resident in the prefix cache (shared or "
+        "idle-evictable)"),
+}
+
+
+def assert_known(name: str) -> None:
+    """Raise if a metric name is not in the catalog (tests' helper)."""
+    if name not in CATALOG:
+        raise KeyError(
+            f"metric {name!r} is not in utils/metric_names.CATALOG — add "
+            "it (type, labels, help) or fix the emitting call site"
+        )

@@ -6,7 +6,25 @@ counters.  Text exposition format only — no client library dependency.
 
 Three instrument kinds: counters (monotonic, ``inc``), gauges (set to the
 current value, ``set_gauge`` — queue depth, live replicas), and histograms
-(``observe`` — reservoir quantiles + exact count/sum).
+(``observe`` — reservoir quantiles + exact count/sum).  All three take
+labels as keyword arguments; a labeled series is independent of the
+unlabeled one under the same name (``serve_ttft_seconds`` can split by
+tenant or phase without disturbing the aggregate callers already read).
+
+Exposition conformance: label values are escaped per the Prometheus text
+format (``\\`` → ``\\\\``, ``"`` → ``\\"``, newline → ``\\n``), gauges and
+histograms carry ``# TYPE`` lines, and ``render()`` output is stably
+ordered (sorted by name then label set — independent of insertion order)
+so scrapes diff cleanly and the conformance tests can parse it line by
+line.  Histogram instruments expose ``_count``/``_sum`` plus reservoir
+``{quantile=...}`` series — that sample shape IS Prometheus's
+``summary`` type, so the TYPE line says ``summary`` (declaring
+``histogram`` without ``_bucket``/``le="+Inf"`` samples is invalid under
+strict/OpenMetrics parsers and would fail the whole scrape).
+
+The canonical list of every metric name this codebase emits lives in
+``utils/metric_names.py`` (lint-enforced); README "Observability"
+documents each one.
 """
 
 from __future__ import annotations
@@ -22,6 +40,32 @@ from typing import Dict, List, Tuple
 # (or re-sort) an unbounded list on the scheduling hot path.
 RESERVOIR_SIZE = 1024
 
+_Key = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+def _key(name: str, labels: Dict[str, str]) -> _Key:
+    return (name, tuple(sorted(labels.items())))
+
+
+def escape_label_value(value: str) -> str:
+    """Prometheus text-format label-value escaping: backslash first (or
+    the other escapes' backslashes would double-escape)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _label_str(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{escape_label_value(v)}"' for k, v in labels
+    )
+    return "{" + inner + "}"
+
 
 class _Histogram:
     __slots__ = ("count", "total", "recent")
@@ -35,29 +79,26 @@ class _Histogram:
 class Metrics:
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = defaultdict(float)
-        self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
-        self._histograms: Dict[str, _Histogram] = defaultdict(_Histogram)
+        self._counters: Dict[_Key, float] = defaultdict(float)
+        self._gauges: Dict[_Key, float] = {}
+        self._histograms: Dict[_Key, _Histogram] = defaultdict(_Histogram)
 
     def inc(self, name: str, value: float = 1.0, **labels: str) -> None:
-        key = (name, tuple(sorted(labels.items())))
         with self._lock:
-            self._counters[key] += value
+            self._counters[_key(name, labels)] += value
 
     def set_gauge(self, name: str, value: float, **labels: str) -> None:
         """Gauges overwrite (current level, not a running total): queue
         depth, live-replica count — values that go down as well as up."""
-        key = (name, tuple(sorted(labels.items())))
         with self._lock:
-            self._gauges[key] = value
+            self._gauges[_key(name, labels)] = value
 
     def gauge(self, name: str, **labels: str) -> float:
-        key = (name, tuple(sorted(labels.items())))
         with self._lock:
-            return self._gauges.get(key, 0.0)
+            return self._gauges.get(_key(name, labels), 0.0)
 
     @contextmanager
-    def timer(self, name: str):
+    def timer(self, name: str, **labels: str):
         """Observe the wall time of a ``with`` block into histogram
         ``name`` — the phase-timer idiom (e.g. speculative draft vs
         verify seconds); callers fencing device work must read the
@@ -66,54 +107,50 @@ class Metrics:
         try:
             yield
         finally:
-            self.observe(name, time.monotonic() - t0)
+            self.observe(name, time.monotonic() - t0, **labels)
 
-    def observe(self, name: str, value: float) -> None:
+    def observe(self, name: str, value: float, **labels: str) -> None:
         with self._lock:
-            h = self._histograms[name]
+            h = self._histograms[_key(name, labels)]
             h.count += 1
             h.total += value
             h.recent.append(value)
 
     def get(self, name: str, **labels: str) -> float:
-        key = (name, tuple(sorted(labels.items())))
         with self._lock:
-            return self._counters.get(key, 0.0)
+            return self._counters.get(_key(name, labels), 0.0)
 
-    def quantile(self, name: str, q: float) -> float:
-        """Reservoir quantile of a histogram (0.0 if never observed) —
-        the programmatic twin of the exposition lines, for bench rows
-        and tests that assert on latency percentiles."""
+    def quantile(self, name: str, q: float, **labels: str) -> float:
+        """Reservoir quantile of a histogram series (0.0 if never
+        observed) — the programmatic twin of the exposition lines, for
+        bench rows and tests that assert on latency percentiles."""
         with self._lock:
-            h = self._histograms.get(name)
+            h = self._histograms.get(_key(name, labels))
             if h is None or not h.recent:
                 return 0.0
             s = sorted(h.recent)
             return s[min(len(s) - 1, int(q * len(s)))]
 
-    def histogram_count(self, name: str) -> int:
+    def histogram_count(self, name: str, **labels: str) -> int:
         with self._lock:
-            h = self._histograms.get(name)
+            h = self._histograms.get(_key(name, labels))
             return h.count if h is not None else 0
 
-    def histogram_sum(self, name: str) -> float:
-        """Exact running sum of a histogram (0.0 if never observed) —
-        with ``histogram_count`` it yields the mean, e.g. mean
-        submit→first-chunk wait from ``serve_prefill_wait_seconds``."""
+    def histogram_sum(self, name: str, **labels: str) -> float:
+        """Exact running sum of a histogram series (0.0 if never
+        observed) — with ``histogram_count`` it yields the mean, e.g.
+        mean submit→first-chunk wait from ``serve_prefill_wait_seconds``."""
         with self._lock:
-            h = self._histograms.get(name)
+            h = self._histograms.get(_key(name, labels))
             return h.total if h is not None else 0.0
 
     def render(self) -> str:
-        """Prometheus text exposition."""
+        """Prometheus text exposition (stable-ordered: sorted by metric
+        name, then label set)."""
         out: List[str] = []
 
         def line(name, labels, v):
-            if labels:
-                lbl = ",".join(f'{k}="{val}"' for k, val in labels)
-                out.append(f"{name}{{{lbl}}} {v}")
-            else:
-                out.append(f"{name} {v}")
+            out.append(f"{name}{_label_str(labels)} {v}")
 
         with self._lock:
             for (name, labels), v in sorted(self._counters.items()):
@@ -127,14 +164,22 @@ class Metrics:
                     out.append(f"# TYPE {name} gauge")
                     typed.add(name)
                 line(name, labels, v)
-            for name, h in sorted(self._histograms.items()):
-                out.append(f"{name}_count {h.count}")
-                out.append(f"{name}_sum {h.total}")
+            typed = set()
+            for (name, labels), h in sorted(self._histograms.items()):
+                if name not in typed:
+                    # count/sum/quantile samples are the SUMMARY shape;
+                    # "histogram" would require _bucket/le series and
+                    # fail strict parsers (see module docstring)
+                    out.append(f"# TYPE {name} summary")
+                    typed.add(name)
+                out.append(f"{name}_count{_label_str(labels)} {h.count}")
+                out.append(f"{name}_sum{_label_str(labels)} {h.total}")
                 if h.recent:
                     s = sorted(h.recent)
                     for q in (0.5, 0.9, 0.99):
                         idx = min(len(s) - 1, int(q * len(s)))
-                        out.append(f'{name}{{quantile="{q}"}} {s[idx]}')
+                        qlabels = labels + (("quantile", str(q)),)
+                        out.append(f"{name}{_label_str(qlabels)} {s[idx]}")
         return "\n".join(out) + "\n"
 
 

@@ -1,0 +1,195 @@
+"""Metrics exposition conformance + the metric-name catalog lint
+(ISSUE 6 satellites).
+
+Three layers:
+
+1. labeled histograms — the capability ``serve_ttft_seconds`` never
+   had: per-label series with their own count/sum/quantiles, TYPE
+   lines, and back-compatible unlabeled accessors;
+2. exposition hardening — label-value escaping, stable ordering, and
+   line-by-line parseability of ``render()``;
+3. the catalog lint — every metric name emitted anywhere under
+   ``kubegpu_tpu/`` must be declared in ``utils/metric_names.CATALOG``
+   (and vice versa), so code, README and dashboards cannot drift apart
+   silently.
+"""
+
+import re
+from pathlib import Path
+
+from kubegpu_tpu.utils.metric_names import CATALOG, assert_known
+from kubegpu_tpu.utils.metrics import Metrics, escape_label_value
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "kubegpu_tpu"
+
+# an emission is .inc( / .observe( / .set_gauge( / .timer( whose first
+# argument is a STRING LITERAL (possibly on the next line); names built
+# dynamically would defeat the lint and are banned by convention
+_EMIT_RE = re.compile(
+    r"\.(?:inc|observe|set_gauge|timer)\(\s*[\"']([a-z0-9_]+)[\"']",
+    re.S,
+)
+
+
+def emitted_names():
+    names = {}
+    for path in sorted(PKG.rglob("*.py")):
+        for m in _EMIT_RE.finditer(path.read_text()):
+            names.setdefault(m.group(1), set()).add(
+                str(path.relative_to(REPO))
+            )
+    return names
+
+
+# ---------------------------------------------------------------------------
+# 1. labeled histograms
+# ---------------------------------------------------------------------------
+
+def test_labeled_histograms_are_independent_series():
+    m = Metrics()
+    m.observe("serve_ttft_seconds", 0.5)
+    m.observe("serve_ttft_seconds", 0.1, tenant="a")
+    m.observe("serve_ttft_seconds", 0.3, tenant="a")
+    m.observe("serve_ttft_seconds", 0.9, tenant="b")
+    # exact-series accessors: labels select, absence selects unlabeled
+    assert m.histogram_count("serve_ttft_seconds") == 1
+    assert m.histogram_count("serve_ttft_seconds", tenant="a") == 2
+    assert m.histogram_sum("serve_ttft_seconds", tenant="a") == 0.4
+    assert m.quantile("serve_ttft_seconds", 0.5, tenant="b") == 0.9
+    assert m.histogram_count("serve_ttft_seconds", tenant="zzz") == 0
+    text = m.render()
+    lines = text.splitlines()
+    assert lines.count("# TYPE serve_ttft_seconds summary") == 1
+    assert "serve_ttft_seconds_count 1" in lines
+    assert 'serve_ttft_seconds_count{tenant="a"} 2' in lines
+    assert 'serve_ttft_seconds_sum{tenant="b"} 0.9' in lines
+    assert any(
+        line.startswith('serve_ttft_seconds{tenant="a",quantile="0.5"}')
+        for line in lines
+    )
+    # the TYPE line precedes every series of its family
+    t = lines.index("# TYPE serve_ttft_seconds summary")
+    assert t < lines.index("serve_ttft_seconds_count 1")
+    assert t < lines.index('serve_ttft_seconds_count{tenant="a"} 2')
+
+
+def test_labeled_timer_context_manager():
+    m = Metrics()
+    with m.timer("serve_phase_seconds", phase="queue"):
+        pass
+    assert m.histogram_count("serve_phase_seconds", phase="queue") == 1
+    assert m.histogram_count("serve_phase_seconds") == 0
+
+
+# ---------------------------------------------------------------------------
+# 2. exposition conformance
+# ---------------------------------------------------------------------------
+
+def test_label_values_are_escaped():
+    assert escape_label_value('a"b') == 'a\\"b'
+    assert escape_label_value("a\\b") == "a\\\\b"
+    assert escape_label_value("a\nb") == "a\\nb"
+    m = Metrics()
+    m.inc("gateway_requests_total", outcome='bad"quote')
+    m.set_gauge("gateway_queue_depth", 1, note="back\\slash")
+    m.observe("serve_ttft_seconds", 0.1, tenant="two\nlines")
+    text = m.render()
+    assert 'outcome="bad\\"quote"' in text
+    assert 'note="back\\\\slash"' in text
+    assert 'tenant="two\\nlines"' in text
+    # nothing rendered a raw newline inside a line (the broken-exposition
+    # failure mode this satellite hardens against)
+    for line in text.splitlines():
+        assert line.count('"') % 2 == 0 or "\\" in line
+
+
+_LINE_RE = re.compile(
+    r"^(?:# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (?:gauge|summary)"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(?:\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\""
+    r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*\})?"
+    r" -?[0-9.e+-]+(?:[0-9]|\.0?)?)$"
+)
+
+
+def _fill(m: Metrics, order: int):
+    ops = [
+        lambda: m.inc("gateway_requests_total", outcome="ok"),
+        lambda: m.inc("gateway_requests_total", outcome="rejected"),
+        lambda: m.set_gauge("gateway_queue_depth", 3),
+        lambda: m.set_gauge("gateway_live_replicas", 2),
+        lambda: m.observe("serve_ttft_seconds", 0.25),
+        lambda: m.observe("serve_phase_seconds", 0.1, phase="queue"),
+        lambda: m.observe("serve_phase_seconds", 0.2, phase="prefill"),
+    ]
+    for op in (ops if order == 0 else list(reversed(ops))):
+        op()
+
+
+def test_render_is_stable_ordered_and_line_parseable():
+    a, b = Metrics(), Metrics()
+    _fill(a, 0)
+    _fill(b, 1)                      # reversed insertion order
+    assert a.render() == b.render()  # ordering is by name, not arrival
+    assert a.render() == a.render()  # and idempotent
+    text = a.render()
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        assert _LINE_RE.match(line), f"unparseable exposition line: {line!r}"
+
+
+# ---------------------------------------------------------------------------
+# 3. the catalog lint
+# ---------------------------------------------------------------------------
+
+def test_every_emitted_metric_name_is_in_the_catalog():
+    missing = {
+        name: sorted(files)
+        for name, files in emitted_names().items()
+        if name not in CATALOG
+    }
+    assert not missing, (
+        "metric names emitted but missing from utils/metric_names."
+        f"CATALOG (add type/labels/help): {missing}"
+    )
+
+
+def test_every_catalog_entry_is_emitted_somewhere():
+    emitted = emitted_names()
+    stale = sorted(n for n in CATALOG if n not in emitted)
+    assert not stale, (
+        "catalog entries no code emits (drift — delete or re-wire): "
+        f"{stale}"
+    )
+
+
+def test_catalog_specs_are_well_formed():
+    for name, spec in CATALOG.items():
+        assert spec.type in ("counter", "gauge", "histogram"), name
+        assert isinstance(spec.labels, tuple), name
+        assert spec.help and spec.help == spec.help.strip(), name
+        if spec.type == "counter":
+            assert name.endswith("_total") or name.startswith(
+                "serve_spec_"
+            ), f"{name}: counters end in _total by convention"
+    assert_known("serve_ttft_seconds")
+    try:
+        assert_known("totally_unknown_metric")
+    except KeyError:
+        pass
+    else:
+        raise AssertionError("assert_known accepted an unknown name")
+
+
+def test_readme_observability_documents_every_serving_metric():
+    """README's Observability section must name every serve_*/gateway_*
+    metric: the catalog is the source of truth, the README is the copy
+    operators read — keep them equal."""
+    readme = (REPO / "README.md").read_text()
+    missing = [
+        n for n in CATALOG
+        if (n.startswith("serve_") or n.startswith("gateway_"))
+        and n not in readme
+    ]
+    assert not missing, f"README Observability section missing: {missing}"

@@ -1,0 +1,518 @@
+"""End-to-end request tracing: the span tracer, its oracles, the
+batchers' phase trees, the gateway's tree, and trace completeness under
+churn (ISSUE 6).
+
+Layers, in test order:
+
+1. the Tracer itself — span trees, bounded rings, JSONL round-trip,
+   and the validate/retire oracles catching deliberately broken traces;
+2. batcher-side tracing — dense + paged + SimBatcher emit complete
+   trees whose phase decomposition sums to the independently-measured
+   TTFT, with cancel/churn/speculation covered;
+3. gateway-side tracing — admission_wait/route/dispatch spans, the
+   /debug/trace HTTP surface, and the GatewaySoak kill schedule's
+   trace-derived I5 (zero orphans, one retire per serve subtree).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubegpu_tpu.models import TransformerLM
+from kubegpu_tpu.models.paging import PagedContinuousBatcher
+from kubegpu_tpu.models.serving import ContinuousBatcher
+from kubegpu_tpu.utils.metrics import Metrics
+from kubegpu_tpu.utils.tracing import (
+    Tracer,
+    load_jsonl,
+    phase_durations,
+    serve_retire_violations,
+    span_tree,
+    validate_trace,
+)
+
+TINY = dict(vocab_size=61, num_layers=1, num_heads=2, hidden=16, max_seq=48)
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    model = TransformerLM(dtype=jnp.float32, **TINY)
+    return model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32)
+    )["params"]
+
+
+def make_paged(params, **kw):
+    cfg = dict(slots=3, prompt_pad=12, page_size=4, pool_pages=32,
+               dtype=jnp.float32, **TINY)
+    cfg.update(kw)
+    return PagedContinuousBatcher(params, **cfg)
+
+
+# ---------------------------------------------------------------------------
+# 1. Tracer mechanics + oracles
+# ---------------------------------------------------------------------------
+
+def test_tracer_basic_tree_completion_and_jsonl(tmp_path):
+    tr = Tracer()
+    root = tr.start_trace("gateway_request", request_id="r1")
+    child = root.child("admission_wait", tenant="t0")
+    child.end()
+    serve = root.child("serve", seq_id=0)
+    q = serve.child("queue")
+    q.end()
+    serve.event("retire", reason="finished")
+    serve.end()
+    assert tr.open_count() == 1          # root still open
+    assert tr.completed() == []
+    root.end(status="ok")
+    assert tr.open_count() == 0
+    comp = tr.completed()
+    assert len(comp) == 1
+    spans = comp[0]
+    assert validate_trace(spans) == []
+    assert serve_retire_violations(spans) == []
+    tree = span_tree(spans)
+    assert tree["name"] == "gateway_request"
+    assert {c["name"] for c in tree["children"]} == {
+        "admission_wait", "serve",
+    }
+    # JSONL round-trip: same spans, same verdicts
+    path = tmp_path / "traces.jsonl"
+    n = tr.dump_jsonl(str(path))
+    assert n == len(spans)
+    loaded = load_jsonl(str(path))
+    assert len(loaded) == 1
+    (reloaded,) = loaded.values()
+    assert validate_trace(reloaded) == []
+    assert {s["name"] for s in reloaded} == {s["name"] for s in spans}
+    for line in path.read_text().splitlines():
+        assert json.loads(line)["v"] == 1
+
+
+def test_tracer_completion_waits_for_late_children():
+    """A hedge loser's teardown lands AFTER the root closed: the trace
+    must complete then (not at root end), with no abandoned spans."""
+    tr = Tracer()
+    root = tr.start_trace("gateway_request")
+    dispatch = root.child("dispatch", replica="a", overhang_ok=True)
+    root.end(status="ok")                # winner recorded
+    assert tr.open_count() == 1          # loser still draining
+    serve = dispatch.child("serve")
+    serve.event("retire", reason="cancelled")
+    serve.end()
+    dispatch.end(outcome="cancelled")
+    assert tr.open_count() == 0
+    (spans,) = tr.completed()
+    assert validate_trace(spans) == []   # overhang_ok exempts the subtree
+    assert serve_retire_violations(spans) == []
+
+
+def test_oracles_catch_broken_traces():
+    # orphan: parent id points nowhere
+    spans = [
+        {"trace": "t", "span": 1, "parent": None, "name": "root",
+         "start": 0.0, "end": 2.0, "attrs": {}},
+        {"trace": "t", "span": 2, "parent": 99, "name": "lost",
+         "start": 0.1, "end": 0.2, "attrs": {}},
+    ]
+    assert any("orphan" in p for p in validate_trace(spans))
+    # unclosed span
+    spans[1] = {"trace": "t", "span": 2, "parent": 1, "name": "open",
+                "start": 0.1, "end": None, "attrs": {}}
+    assert any("never closed" in p for p in validate_trace(spans))
+    # child outliving parent without overhang_ok
+    spans[1] = {"trace": "t", "span": 2, "parent": 1, "name": "late",
+                "start": 0.1, "end": 5.0, "attrs": {}}
+    assert any("outlives" in p for p in validate_trace(spans))
+    spans[1]["attrs"] = {"overhang_ok": True}
+    assert validate_trace(spans) == []
+    # double retire inside one serve subtree
+    spans = [
+        {"trace": "t", "span": 1, "parent": None, "name": "serve",
+         "start": 0.0, "end": 1.0, "attrs": {}},
+        {"trace": "t", "span": 2, "parent": 1, "name": "retire",
+         "start": 0.5, "end": 0.5, "attrs": {}},
+        {"trace": "t", "span": 3, "parent": 1, "name": "retire",
+         "start": 0.6, "end": 0.6, "attrs": {}},
+    ]
+    assert serve_retire_violations(spans)
+    # zero retires is just as wrong (vanished sequence)
+    assert serve_retire_violations(spans[:1])
+    assert not serve_retire_violations(spans[:2])
+
+
+def test_tracer_rings_are_bounded():
+    tr = Tracer(max_traces=4, max_open=8)
+    for i in range(10):
+        tr.start_trace("r", request_id=f"r{i}").end()
+    assert len(tr.completed()) == 4
+    assert tr.evicted == 6
+    # leak guard: open traces past max_open force-complete as abandoned
+    tr2 = Tracer(max_traces=64, max_open=3)
+    ctxs = [tr2.start_trace("leak") for _ in range(6)]
+    assert tr2.open_count() == 3
+    assert tr2.aborted == 3
+    abandoned = [
+        s for spans in tr2.completed() for s in spans
+        if s["attrs"].get("abandoned")
+    ]
+    assert len(abandoned) == 3
+    # and the oracle refuses abandoned spans
+    assert all(validate_trace(spans) for spans in tr2.completed())
+    for c in ctxs:
+        c.end()
+
+
+# ---------------------------------------------------------------------------
+# 2. Batcher-side tracing
+# ---------------------------------------------------------------------------
+
+def assert_sound(spans):
+    problems = validate_trace(spans) + serve_retire_violations(spans)
+    assert not problems, problems
+
+
+@pytest.mark.slow
+def test_paged_batcher_traces_complete_and_sum_to_ttft(tiny_params):
+    """Every served request yields one complete tree; the phase
+    decomposition (queue + station_wait + prefill + first_step, via
+    span timestamps) matches the measured TTFT (submitted_at
+    arithmetic) — two independent instrumentation paths agreeing."""
+    tr = Tracer()
+    m = Metrics()
+    cb = make_paged(tiny_params, tracer=tr, metrics=m, token_budget=8,
+                    station_slots=2)
+    rs = np.random.RandomState(3)
+    prompts = [
+        rs.randint(0, 61, size=rs.randint(3, 12)).astype(np.int32)
+        for _ in range(6)
+    ]
+    out = cb.run(prompts, [5, 4, 6, 0, 3, 2])
+    assert len(out) == 6
+    assert tr.open_count() == 0
+    comp = tr.completed()
+    assert len(comp) == 6
+    checked = 0
+    for spans in comp:
+        assert_sound(spans)
+        phases = phase_durations(spans)
+        measured = next(
+            (s["attrs"]["measured_ttft"] for s in spans
+             if "measured_ttft" in s["attrs"]), None,
+        )
+        if measured is None:
+            continue  # the zero-budget request emits nothing
+        ttft_sum = sum(v for k, v in phases.items() if k != "decode")
+        assert abs(ttft_sum - measured) < 0.005 + 0.1 * measured, (
+            phases, measured,
+        )
+        checked += 1
+    assert checked == 5
+    # the phase histogram is labeled: split by phase, no unlabeled twin.
+    # Every request — the zero-budget no-op included — waits in queue,
+    # so the queue series counts all 6; only the 5 emitting requests
+    # reach a first token
+    assert m.histogram_count("serve_phase_seconds", phase="queue") == 6
+    assert m.histogram_count(
+        "serve_phase_seconds", phase="first_step") == 5
+    assert m.histogram_count("serve_phase_seconds") == 0
+    cb.assert_page_accounting()
+    # the ledger ring recorded every iteration, within budget accounting
+    rows = cb.ledger_rows()
+    assert rows and rows[-1]["step"] == cb.stats["steps"]
+    for row in rows:
+        assert row["rows"] >= 0
+        assert row["pages_free"] + row["pages_live"] + row[
+            "cache_idle"] <= cb.pool_pages - 1 + row["pages_cached"]
+
+
+@pytest.mark.slow
+def test_paged_tracing_under_cancel_prefix_hits_and_speculation(
+        tiny_params):
+    """Churny single-replica schedule: prefix-cache hits (gather span),
+    cancels mid-prefill and mid-decode, speculation spans — trees stay
+    complete, accounting stays balanced, exactly one retire each."""
+    tr = Tracer()
+    cb = make_paged(
+        tiny_params, tracer=tr, prompt_pad=16, draft_params=tiny_params,
+        speculate_k=2, draft_num_layers=TINY["num_layers"],
+        draft_num_heads=TINY["num_heads"], draft_hidden=TINY["hidden"],
+    )
+    rs = np.random.RandomState(5)
+    base = rs.randint(0, 61, size=9).astype(np.int32)
+    cb.submit(0, base, 6)
+    while cb.has_work():
+        cb.serve_step()
+    # same prefix again: gather span rides the hit
+    cb.submit(1, np.concatenate([base, [7, 8]]).astype(np.int32), 5)
+    cb.submit(2, rs.randint(0, 61, size=14).astype(np.int32), 6)
+    cb.serve_step()
+    cb.cancel(2)                         # mid-prefill (or just admitted)
+    cb.submit(3, rs.randint(0, 61, size=5).astype(np.int32), 8)
+    for _ in range(2):
+        cb.serve_step()
+    cb.cancel(3)                         # mid-decode or mid-queue
+    while cb.has_work():
+        cb.serve_step()
+    assert tr.open_count() == 0
+    comp = tr.completed()
+    assert len(comp) == 4
+    names = set()
+    reasons = []
+    for spans in comp:
+        assert_sound(spans)
+        names |= {s["name"] for s in spans}
+        reasons += [
+            s["attrs"]["reason"] for s in spans if s["name"] == "retire"
+        ]
+    assert "prefix_gather" in names
+    assert "spec_draft" in names and "spec_verify" in names
+    assert "chunk" in names
+    assert reasons.count("cancelled") == 2
+    cb.assert_page_accounting()
+    # died-path: live requests' spans close when the replica dies
+    cb.submit(7, base, 6)
+    cb.serve_step()
+    cb.trace_shutdown("replica test died")
+    assert tr.open_count() == 0
+    last = tr.completed()[-1]
+    assert_sound(last)
+    assert any(
+        s["name"] == "retire" and s["attrs"]["reason"] == "died"
+        for s in last
+    )
+
+
+@pytest.mark.slow
+def test_dense_batcher_traces_monolithic_and_chunked(tiny_params):
+    for chunk in (None, 4):
+        tr = Tracer()
+        cb = ContinuousBatcher(
+            params=tiny_params, slots=2, prompt_pad=12,
+            prefill_chunk=chunk, dtype=jnp.float32, tracer=tr, **TINY
+        )
+        rs = np.random.RandomState(1)
+        prompts = [
+            rs.randint(0, 61, size=rs.randint(3, 12)).astype(np.int32)
+            for _ in range(4)
+        ]
+        out = cb.run(prompts, [4, 3, 0, 5])
+        assert len(out) == 4
+        assert tr.open_count() == 0, f"chunk={chunk}"
+        comp = tr.completed()
+        assert len(comp) == 4
+        for spans in comp:
+            assert_sound(spans)
+        names = {s["name"] for spans in comp for s in spans}
+        assert {"serve", "queue", "prefill", "decode", "retire"} <= names
+        if chunk is not None:
+            assert "chunk" in names
+        # cancel closes the tree too
+        cb.submit(9, prompts[0], 6)
+        cb.serve_step()
+        cb.cancel(9)
+        assert tr.open_count() == 0
+        assert_sound(tr.completed()[-1])
+
+
+# ---------------------------------------------------------------------------
+# 3. Gateway-side tracing
+# ---------------------------------------------------------------------------
+
+def make_traced_gateway(n_replicas=3, **policy_kw):
+    from kubegpu_tpu.gateway import (
+        FailoverPolicy, Gateway, InMemoryReplicaClient, SimBatcher,
+    )
+    from kubegpu_tpu.testing.fake_serving import build_fake_serving_stack
+
+    stack = build_fake_serving_stack(n_replicas)
+    client = InMemoryReplicaClient(
+        batcher_factory=lambda key: SimBatcher(slots=8),
+        step_delay_s=0.001,
+    )
+    stack.registry.subscribe(client.sync_live)
+    defaults = dict(deadline_s=30.0, hedge_after_s=0.05, max_attempts=6,
+                    retry_budget_ratio=1.0, budget_floor=256)
+    defaults.update(policy_kw)
+    gw = Gateway(
+        stack.registry, client, metrics=Metrics(), dispatchers=4,
+        policy=FailoverPolicy(**defaults),
+    )
+    stack.registry.refresh()
+    gw.start()
+    return stack, client, gw
+
+
+def test_gateway_request_yields_one_nested_tree():
+    from kubegpu_tpu.gateway import GatewayRequest
+
+    stack, client, gw = make_traced_gateway()
+    try:
+        pendings = [
+            gw.submit(GatewayRequest(
+                prompt=[1, 2, 3], max_new_tokens=4, request_id=f"r{i}",
+                tenant=f"t{i % 2}", session=f"s{i % 3}",
+            ))
+            for i in range(12)
+        ]
+        assert gw.drain(30.0)
+        assert all(p.wait(1.0) for p in pendings)
+        assert gw.tracer.wait_quiescent(5.0)
+        comp = gw.tracer.completed()
+        assert len(comp) == 12
+        for spans in comp:
+            assert_sound(spans)
+            names = {s["name"] for s in spans}
+            assert {"gateway_request", "admission_wait", "route",
+                    "dispatch", "serve", "queue", "decode",
+                    "retire"} <= names
+            root = next(s for s in spans if s["parent"] is None)
+            assert root["attrs"]["status"] == "ok"
+            # dispatch nests under root; serve nests under dispatch
+            dispatch = next(s for s in spans if s["name"] == "dispatch")
+            serve = next(s for s in spans if s["name"] == "serve")
+            assert dispatch["parent"] == root["span"]
+            assert serve["parent"] == dispatch["span"]
+            # the session router annotated its routing decision
+            route = next(s for s in spans if s["name"] == "route")
+            assert route["attrs"]["replica"]
+    finally:
+        gw.stop()
+        client.stop()
+
+
+def test_gateway_rejected_request_still_closes_its_trace():
+    from kubegpu_tpu.gateway import AdmissionQueue, Gateway, GatewayRequest
+    from kubegpu_tpu.gateway import InMemoryReplicaClient, SimBatcher
+    from kubegpu_tpu.testing.fake_serving import build_fake_serving_stack
+
+    stack = build_fake_serving_stack(1)
+    client = InMemoryReplicaClient(
+        batcher_factory=lambda key: SimBatcher(slots=8))
+    gw = Gateway(
+        stack.registry, client, queue=AdmissionQueue(capacity=2),
+        metrics=Metrics(), dispatchers=0,  # nobody drains: queue fills
+    )
+    try:
+        for i in range(4):
+            gw.submit(GatewayRequest(
+                prompt=[1], max_new_tokens=2, request_id=f"q{i}",
+            ))
+        rejected = [
+            spans for spans in gw.tracer.completed()
+            if next(s for s in spans if s["parent"] is None)
+            ["attrs"]["status"] == "rejected"
+        ]
+        assert len(rejected) == 2
+        for spans in rejected:
+            assert validate_trace(spans) == []
+    finally:
+        gw.stop()
+        client.stop()
+
+
+def test_debug_trace_http_endpoint():
+    """GET /debug/trace returns parseable span trees + replica ledgers
+    through the real HTTP frontend."""
+    import http.client
+
+    from kubegpu_tpu.gateway import GatewayRequest
+    from kubegpu_tpu.gateway.server import GatewayServer
+
+    stack, client, gw = make_traced_gateway(n_replicas=2)
+    server = GatewayServer(gw, listen=("127.0.0.1", 0), watch=False)
+    # Gateway.start() is idempotent enough for this test path: the
+    # server starts the HTTP thread; gw dispatchers already run
+    t = __import__("threading").Thread(
+        target=server.httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        for i in range(3):
+            gw.submit(GatewayRequest(
+                prompt=[1, 2], max_new_tokens=3, request_id=f"d{i}",
+            ))
+        assert gw.drain(30.0)
+        assert gw.tracer.wait_quiescent(5.0)
+        host, port = server.address
+        conn = http.client.HTTPConnection(host, port, timeout=5)
+        conn.request("GET", "/debug/trace?n=2")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        body = json.loads(resp.read())
+        assert body["tracing"] is True
+        assert body["open_traces"] == 0
+        assert 1 <= len(body["traces"]) <= 2
+        tree = body["traces"][0]
+        assert tree["name"] == "gateway_request"
+        assert tree["children"]
+        assert isinstance(body["ledgers"], dict)  # SimBatcher: no rows
+        conn.close()
+    finally:
+        server.httpd.shutdown()
+        server.httpd.server_close()
+        gw.stop()
+        client.stop()
+
+
+def test_gateway_soak_trace_oracle_kill_schedule():
+    """The FAST trace-completeness churn test (SimBatcher data plane):
+    the GatewaySoak kill/revive/straggle schedule must leave every
+    request with exactly one complete span tree — zero orphans, zero
+    double-retires — via the soak's own check_traces oracle."""
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    soak = GatewaySoak(seed=11, n_replicas=3, multiturn=True)
+    soak.run(steps=25)
+    # run() already called check() -> check_traces(); re-assert the
+    # headline numbers explicitly so a future soak refactor cannot
+    # silently stop checking traces
+    completed = soak.gw.tracer.completed()
+    assert completed
+    assert soak.gw.tracer.evicted == 0
+    for spans in completed:
+        assert_sound(spans)
+
+
+@pytest.mark.slow
+def test_gateway_soak_paged_multiturn_spec_traces(tiny_params):
+    """ISSUE 6 acceptance churn: the GatewaySoak kill schedule over
+    REAL paged batchers with speculation AND multi-turn caching on,
+    tracing enabled end to end — zero orphan spans, zero requests with
+    two retire spans, and page accounting still balances on every
+    surviving replica (check() runs assert_page_accounting with the
+    tracer attached)."""
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    soak = GatewaySoak(
+        seed=31, n_replicas=2, multiturn=True, follow_prompt_cap=12,
+        batcher_factory=lambda key: PagedContinuousBatcher(
+            tiny_params, slots=4, prompt_pad=12, page_size=4,
+            pool_pages=48, station_slots=2, token_budget=8,
+            dtype=jnp.float32, decode_page_cache="fp32",
+            draft_params=tiny_params, speculate_k=2, draft_window=16,
+            draft_num_layers=TINY["num_layers"],
+            draft_num_heads=TINY["num_heads"],
+            draft_hidden=TINY["hidden"], **TINY,
+        ),
+    )
+    soak.run(steps=15)
+    completed = soak.gw.tracer.completed()
+    assert completed
+    double_retires = [
+        v for spans in completed for v in serve_retire_violations(spans)
+    ]
+    orphans = [
+        p for spans in completed for p in validate_trace(spans)
+        if "orphan" in p
+    ]
+    assert not double_retires and not orphans, (
+        double_retires, orphans,
+    )
+    # replica-side phase spans made it through the gateway tree: the
+    # paged batcher's serve subtree carries its prefill/decode phases
+    names = {s["name"] for spans in completed for s in spans}
+    assert {"serve", "queue", "decode", "retire"} <= names
