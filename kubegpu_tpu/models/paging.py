@@ -63,6 +63,17 @@ rows per live sequence — 5x less HBM for the same slot count, or 5x the
 concurrent sequences in the same HBM.  The prefix cache stacks on top:
 a shared system prompt or a second same-session turn skips its cached
 pages' prefill compute entirely (``stats['prefix_hit_tokens']``).
+
+The decode HOT LOOP is device-resident and pipelined: the step /
+spec-draft / spec-verify programs consume the previous iteration's
+on-device outputs (last tokens, positions, tables, active mask,
+remaining budgets) and advance them in-program — termination included —
+while the host syncs tokens at ONE designated readback point, one
+iteration late (``pipeline_decode``), so bookkeeping overlaps device
+compute.  Prefix-hit gathers and chunk flushes move whole page RUNS
+through bucketed multi-page programs instead of per-page dispatches.
+See the README's "Serving hot loop" subsection for the pipeline
+diagram and the first-token eager-sync rule.
 """
 
 from __future__ import annotations
@@ -335,6 +346,10 @@ class _Seq:
     shared: Set[int] = field(default_factory=set)   # cache-owned subset
     submitted_at: float = 0.0
     last_emit_at: float = 0.0
+    # admission generation: bumped every time the slot is (re)assigned,
+    # so a pipelined in-flight step's results can never be credited to a
+    # later occupant of the same slot — even one reusing the seq_id
+    gen: int = 0
     # retirement sealing (decode_page_cache): the committed stream is
     # prompt + tokens; plen stays 0 until activation, so a mid-prefill
     # cancel (nothing decode-committed) never tries to seal
@@ -361,6 +376,28 @@ class _PrefillJob:
     started: bool = False    # first chunk ran (prefill-wait observed)
 
 
+@dataclass
+class _Inflight:
+    """One dispatched-but-unsynced decode iteration.  The device arrays
+    (``toks`` for the plain step; ``choices``/``emit``/``wrapped`` for a
+    speculative iteration) are futures until ``_process_entry`` performs
+    the ONE designated readback; ``cand`` maps slot index -> the slot's
+    admission generation at dispatch, so results are only ever credited
+    to the sequence that was actually running when the program launched
+    (a slot retired-and-reused in the readback gap fails the gen check
+    and its junk lanes are dropped)."""
+
+    kind: str                       # "step" | "spec"
+    cand: Dict[int, int]            # slot -> _Seq.gen at dispatch
+    toks: object = None             # (slots,) device tokens (plain step)
+    choices: object = None          # (slots, k+1) device tokens (spec)
+    emit: object = None             # (slots,) device accepted-prefix len
+    wrapped: object = None          # (slots,) device draft-ring wrap flags
+    td0: float = 0.0                # dispatch wall stamps for trace spans
+    tv0: float = 0.0
+    tv1: float = 0.0
+
+
 class PagedContinuousBatcher(_TracedBatcher):
     """Continuous batching with a shared KV page pool and prefix reuse.
 
@@ -383,6 +420,14 @@ class PagedContinuousBatcher(_TracedBatcher):
     prefill chunk rows); when the decode batch leaves fewer than one
     page of budget, one chunk still runs so prefill can never starve.
     ``prefix_cache=False`` disables sharing (every page private).
+    ``pipeline_decode`` (default True) overlaps host bookkeeping with
+    device compute: the decode loop keeps ONE iteration in flight and
+    syncs its tokens after dispatching the next; retirement is decided
+    on device, the host replays it one step late, and a slot awaiting
+    its first token syncs eagerly so TTFT keeps synchronous semantics.
+    ``False`` selects the synchronous host-driven loop (state
+    re-uploaded from host mirrors every step) — the bench baseline and
+    the property-test oracle.
     ``decode_page_cache`` ({"off", "fp32", "all"}, default off) lets
     retirement seal complete DECODE-produced pages into the chain for
     session KV reuse — see the module docstring for the dtype policy.
@@ -433,6 +478,7 @@ class PagedContinuousBatcher(_TracedBatcher):
         token_budget: Optional[int] = None,
         prefix_cache: bool = True,
         decode_page_cache: str = "off",
+        pipeline_decode: bool = True,
         eos_id: Optional[int] = None,
         dtype=jnp.bfloat16,
         quant: bool = False,
@@ -589,12 +635,34 @@ class PagedContinuousBatcher(_TracedBatcher):
             resolve_decode_page_cache(decode_page_cache, dtype)
             and self.prefix_cache is not None
         )
-        # host-side tables: unused entries point at page 0 (fetched but
-        # masked — the kernel never attends past a slot's length)
+        # host-side MIRRORS of the decode loop state (bookkeeping,
+        # debugging, tests): the authoritative copies live on DEVICE and
+        # advance in-program — the host only pushes them at admission /
+        # retirement events and replays the same integer arithmetic when
+        # it processes a readback
         self.tables = np.zeros((slots, self.max_pages), np.int32)
         self.pos = np.zeros((slots,), np.int32)  # rows already consumed
         self._seqs = [_Seq() for _ in range(slots)]
         self._last = np.zeros((slots,), np.int32)
+        # device-resident decode loop state: the step/spec programs
+        # consume the PREVIOUS iteration's on-device outputs directly
+        # (no per-step host re-upload), update position/termination
+        # in-program, and the host syncs tokens at most once per
+        # iteration — one step LATE when ``pipeline_decode`` is on, so
+        # host bookkeeping overlaps device compute
+        self._tables_dev = jnp.zeros((slots, self.max_pages), jnp.int32)
+        self._pos_dev = jnp.zeros((slots,), jnp.int32)
+        self._last_dev = jnp.zeros((slots,), jnp.int32)
+        self._active_dev = jnp.zeros((slots,), bool)
+        self._remaining_dev = jnp.zeros((slots,), jnp.int32)
+        self._counts_dev = jnp.zeros((slots,), jnp.int32)
+        self.pipeline_decode = pipeline_decode
+        self._inflight: deque = deque()
+        self._sync_wait_s = 0.0
+        # bucketed multi-page gather/scatter programs, keyed by padded
+        # page-run width (lazily built; see _page_bucket)
+        self._write_pages: Dict[int, object] = {}
+        self._gather_pages: Dict[int, object] = {}
         # the prefill station: ONE persistent dense cache with
         # station_slots rows-of-prompt_pad slots; chunked prompts flow
         # through their own slot before their pages scatter into the
@@ -624,13 +692,38 @@ class PagedContinuousBatcher(_TracedBatcher):
 
         from kubegpu_tpu.models.decoding import pick_tokens
 
-        def step(params, pools, last_tokens, table, pos, temps, base_keys,
-                 counts):
+        def step(params, pools, last_tokens, table, pos, active, remaining,
+                 counts, temps, base_keys):
+            # the WHOLE loop transition in one program: emit a token for
+            # every slot, then advance last/pos/counts and retire
+            # (budget/EOS) for active slots on DEVICE — consecutive
+            # iterations chain device arrays with no host round-trip.
+            # Inactive lanes are frozen AND parked: their table/pos are
+            # redirected to the dump page IN-PROGRAM, so the lane's
+            # (inevitable, static-shape) K/V write lands on page 0 no
+            # matter how long the host takes to learn of the retirement
+            # — a device-retired slot's pages may already be sealed in
+            # the prefix cache by the time the overhang iteration runs,
+            # and nothing may ever write them again.
+            table = jnp.where(active[:, None], table, 0)
+            run_pos = jnp.where(active, pos, 0)
             logits, pools = self.model.apply(
-                {"params": params}, last_tokens[:, None], pools, table, pos
+                {"params": params}, last_tokens[:, None], pools, table,
+                run_pos,
             )
             keys = jax.vmap(jax.random.fold_in)(base_keys, counts)
-            return pick_tokens(logits, temps, keys, self.top_k), pools
+            toks = pick_tokens(logits, temps, keys, self.top_k)
+            act = active.astype(jnp.int32)
+            new_rem = remaining - act
+            done = new_rem <= 0
+            if self.eos_id is not None:
+                done = done | (toks == self.eos_id)
+            new_active = active & ~done
+            new_last = jnp.where(active, toks, last_tokens)
+            new_pos = pos + act
+            new_counts = counts + act
+            return (toks, pools, new_last, new_pos, new_active, new_rem,
+                    new_counts)
 
         self._step = jax.jit(step, donate_argnums=(1,))
 
@@ -671,7 +764,22 @@ class PagedContinuousBatcher(_TracedBatcher):
                 slots, draft_num_layers, draft_num_heads, draft_hidden,
                 ring, dtype,
             )
-            self._d_pos = np.zeros((slots,), np.int32)
+            self._d_pos = np.zeros((slots,), np.int32)   # host mirror
+            self._d_pos_dev = jnp.zeros((slots,), jnp.int32)
+            # the ring's memory shape (rows, not bytes) is a CONSTANT
+            # of the construction — set the gauge ONCE, not per
+            # serve_step (the paged-draft-cache follow-on's
+            # observable; was slots x max_seq before the ring).  A
+            # registry attached after construction (the bench's
+            # attach-after-warm pattern) gets it from the first ledger
+            # record instead — still once, flag-guarded.
+            self._draft_gauge_set = False
+            if metrics is not None:
+                metrics.set_gauge(
+                    "serve_draft_cache_rows",
+                    float(slots * draft_window),
+                )
+                self._draft_gauge_set = True
 
             def _ring_params(dparams):
                 # the draft checkpoint's pos_embed is sized to ITS
@@ -685,8 +793,16 @@ class PagedContinuousBatcher(_TracedBatcher):
                     },
                 }
 
-            def spec_draft(dparams, d_caches, last, pos):
+            def spec_draft(dparams, d_caches, last, d_pos, active):
                 dparams = _ring_params(dparams)
+                # ring wrap IN-PROGRAM: a slot whose next verify window
+                # would spill past the draft ring restarts its draft
+                # context at row 0 (accept rate dips, output cannot
+                # change — verification is lossless for any draft); the
+                # wrap flags come back so the host mirror can replay it
+                wrap = active & (d_pos + (k_spec + 1) > ring)
+                d_pos_w = jnp.where(wrap, 0, d_pos)
+
                 # k+1 scan steps: the extra step's proposal is discarded
                 # but its cache write consumes p_k (speculative.py's
                 # load-bearing extra step — a k-step scan would leave row
@@ -700,22 +816,32 @@ class PagedContinuousBatcher(_TracedBatcher):
                     return (caches, nxt, p + 1), nxt
 
                 (d_caches, _, _), proposed = jax.lax.scan(
-                    d_step, (d_caches, last, pos), None, length=k_spec + 1
+                    d_step, (d_caches, last, d_pos_w), None,
+                    length=k_spec + 1
                 )
-                return proposed.T[:, :k_spec], d_caches
+                return proposed.T[:, :k_spec], d_caches, d_pos_w, wrap
 
             self._spec_draft = jax.jit(spec_draft, donate_argnums=(1,))
 
-            def spec_verify(params, pools, last, proposals, table, pos):
+            def spec_verify(params, pools, last, proposals, table, pos,
+                            d_pos, active, remaining):
                 # window = [last, p_1..p_k]: row j's K/V writes land at
                 # pool rows pos+j through the slot's table (private pages
                 # only — sharable pages end strictly below the first
                 # decode row), rejected rows are junk the NEXT window
                 # overwrites before any mask exposes them — rollback is
-                # "don't commit", no pool mutation to undo
+                # "don't commit", no pool mutation to undo.  Inactive
+                # lanes park on the dump page IN-PROGRAM: a retired
+                # slot's overhang window would otherwise write rows past
+                # its reservation — where the table's padding points at
+                # the sequence's FIRST page, which may be sealed in the
+                # prefix cache (the pipelined-retirement corruption the
+                # multi-pass property test pins down)
+                table = jnp.where(active[:, None], table, 0)
+                run_pos = jnp.where(active, pos, 0)
                 chunk_toks = jnp.concatenate([last[:, None], proposals], 1)
                 logits_all, pools = self.verify_model.apply(
-                    {"params": params}, chunk_toks, pools, table, pos
+                    {"params": params}, chunk_toks, pools, table, run_pos
                 )
                 choices = jnp.argmax(logits_all, -1).astype(jnp.int32)
                 match = proposals == choices[:, :k_spec]
@@ -727,7 +853,34 @@ class PagedContinuousBatcher(_TracedBatcher):
                 )
                 emit_len = accepted + 1
                 next_last = choices[jnp.arange(slots), emit_len - 1]
-                return choices, emit_len, next_last, pools
+                # commit + termination on DEVICE, mirroring the host's
+                # truncation exactly: cap the emitted prefix at the
+                # slot's remaining budget, cut at the first EOS inside
+                # it, retire on either; pos/d_pos advance by the rows
+                # the verify CONSUMED (uncapped — surplus rows are junk
+                # above the committed stream, covered by the k-row
+                # reservation headroom), and only for active slots
+                act = active.astype(jnp.int32)
+                trunc = jnp.minimum(emit_len, remaining)
+                if self.eos_id is not None:
+                    iseos = (choices == self.eos_id) & (
+                        jnp.arange(k_spec + 1)[None, :] < trunc[:, None]
+                    )
+                    has_eos = iseos.any(axis=1)
+                    n_emit = jnp.where(
+                        has_eos, jnp.argmax(iseos, axis=1) + 1, trunc
+                    )
+                else:
+                    has_eos = jnp.zeros((slots,), bool)
+                    n_emit = trunc
+                new_rem = remaining - n_emit * act
+                done = (new_rem <= 0) | has_eos
+                new_active = active & ~done
+                new_last = jnp.where(active, next_last, last)
+                new_pos = pos + emit_len * act
+                new_d_pos = d_pos + emit_len * act
+                return (choices, emit_len, pools, new_last, new_pos,
+                        new_d_pos, new_active, new_rem)
 
             self._spec_verify = jax.jit(spec_verify, donate_argnums=(1,))
 
@@ -808,43 +961,102 @@ class PagedContinuousBatcher(_TracedBatcher):
 
         self._chunk = jax.jit(chunk, donate_argnums=(1,))
 
-        def write_page(pools, station, slot, phys, row):
-            # scatter ONE completed station page (slot's rows
-            # [row, row+page)) into pool page `phys`; traced scalars, so
-            # one compile serves every page of every station slot of
-            # every admission
+    # -- bucketed multi-page gather/scatter ---------------------------------
+    # A prefix-cache hit of H pages or a chunk flush of C ready pages
+    # used to cost O(pages) separate jit dispatches; these programs move
+    # a whole padded RUN of pages in one dispatch.  Run widths are
+    # padded to a power of two (capped at the station's page capacity)
+    # so the jit cache holds a handful of widths, not one per run
+    # length; padded lanes point at the permanent dump page 0, which
+    # absorbs their junk (scatter) or is masked out of the write-back
+    # (gather).
+
+    def _page_bucket(self, n: int) -> int:
+        """Padded width for an n-page run: next power of two, capped at
+        the station slot's page capacity (every run fits a station slot,
+        so the cap can never under-size a real run)."""
+        cap = self.prompt_pad // self.page
+        w = 1
+        while w < n:
+            w *= 2
+        return min(w, cap)
+
+    def _get_write_pages(self, width: int):
+        fn = self._write_pages.get(width)
+        if fn is None:
+            fn = self._write_pages[width] = self._build_write_pages(width)
+        return fn
+
+    def _get_gather_pages(self, width: int):
+        fn = self._gather_pages.get(width)
+        if fn is None:
+            fn = self._gather_pages[width] = self._build_gather_pages(width)
+        return fn
+
+    def _build_write_pages(self, width: int):
+        page = self.page
+        pad = self.prompt_pad
+
+        def write_pages(pools, station, slot, phys_vec, base_row):
+            # scatter `width` consecutive completed station pages (the
+            # slot's rows [base_row + j*page, ...)) into pool pages
+            # phys_vec[j] in ONE program.  Padded lanes carry phys 0
+            # (the dump page): their start rows clamp near the station's
+            # end — misaligned junk the dump absorbs; valid lanes always
+            # fit, so they never clamp.  Duplicate dump indices in the
+            # scatter race only against each other (junk over junk).
+            starts = base_row + jnp.arange(width, dtype=jnp.int32) * page
+            starts = jnp.clip(starts, 0, pad - page)
+            idx = starts[:, None] + jnp.arange(page, dtype=jnp.int32)[None]
             out = []
             for (kp, vp), (ck, cv) in zip(pools, station):
-                h = kp.shape[1]
-                hd = kp.shape[3]
-                rk = jax.lax.dynamic_slice(
-                    ck, (slot, row, 0, 0), (1, page_size, h, hd)
-                )[0]
-                rv = jax.lax.dynamic_slice(
-                    cv, (slot, row, 0, 0), (1, page_size, h, hd)
-                )[0]
-                kp = kp.at[phys].set(jnp.moveaxis(rk, 0, 1))
-                vp = vp.at[phys].set(jnp.moveaxis(rv, 0, 1))
-                out.append((kp, vp))
+                bk = jnp.swapaxes(jnp.take(ck, slot, axis=0)[idx], 1, 2)
+                bv = jnp.swapaxes(jnp.take(cv, slot, axis=0)[idx], 1, 2)
+                out.append((
+                    kp.at[phys_vec].set(bk), vp.at[phys_vec].set(bv)
+                ))
             return out
 
-        self._write_page = jax.jit(write_page, donate_argnums=(0,))
+        return jax.jit(write_pages, donate_argnums=(0,))
 
-        def gather_page(station, pools, slot, phys, row):
-            # the reverse copy: a prefix-cache HIT page streamed back
-            # into the admission's station slot so later chunks can
-            # attend its rows — bit-identical bytes, no recompute (the
-            # COW "copy")
+    def _build_gather_pages(self, width: int):
+        page = self.page
+        n_rows = width * page
+
+        def gather_pages(station, pools, slot, phys_vec, n_valid):
+            # the reverse copy: a prefix-cache HIT's first n_valid pages
+            # streamed back into the admission's station slot rows
+            # [0, n_valid*page) in ONE program — bit-identical bytes, no
+            # recompute (the COW "copy").  Hits are always a PREFIX, so
+            # the station destination starts at row 0; padded lanes read
+            # the dump page and are masked out of the write-back so
+            # station rows past the run keep their bytes.
+            rows_ok = (
+                jnp.arange(n_rows, dtype=jnp.int32) < n_valid * page
+            )[:, None, None]
             out = []
             for (ck, cv), (kp, vp) in zip(station, pools):
-                rk = jnp.moveaxis(kp[phys], 0, 1)[None]
-                rv = jnp.moveaxis(vp[phys], 0, 1)[None]
-                ck = jax.lax.dynamic_update_slice(ck, rk, (slot, row, 0, 0))
-                cv = jax.lax.dynamic_update_slice(cv, rv, (slot, row, 0, 0))
+                h, hd = ck.shape[-2], ck.shape[-1]
+                bk = jnp.swapaxes(kp[phys_vec], 1, 2).reshape(n_rows, h, hd)
+                bv = jnp.swapaxes(vp[phys_vec], 1, 2).reshape(n_rows, h, hd)
+                ck_cur = jax.lax.dynamic_slice(
+                    ck, (slot, 0, 0, 0), (1, n_rows, h, hd)
+                )[0]
+                cv_cur = jax.lax.dynamic_slice(
+                    cv, (slot, 0, 0, 0), (1, n_rows, h, hd)
+                )[0]
+                ck = jax.lax.dynamic_update_slice(
+                    ck, jnp.where(rows_ok, bk, ck_cur)[None],
+                    (slot, 0, 0, 0),
+                )
+                cv = jax.lax.dynamic_update_slice(
+                    cv, jnp.where(rows_ok, bv, cv_cur)[None],
+                    (slot, 0, 0, 0),
+                )
                 out.append((ck, cv))
             return out
 
-        self._gather_page = jax.jit(gather_page, donate_argnums=(0,))
+        return jax.jit(gather_pages, donate_argnums=(0,))
 
     # -- page accounting ---------------------------------------------------
     def _pages_for(self, plen: int, max_new: int) -> int:
@@ -1086,6 +1298,7 @@ class PagedContinuousBatcher(_TracedBatcher):
         # iteration, and a prefilling slot's garbage write must never
         # land in a real page — least of all a shared hit page
         s.seq_id, s.active, s.prefilling = seq_id, False, True
+        s.gen += 1   # new occupant: in-flight readbacks can't credit it
         s.tokens, s.remaining = [], max_new
         s.pages, s.shared = pages, set(hits)
         s.submitted_at = submitted_at
@@ -1129,11 +1342,15 @@ class PagedContinuousBatcher(_TracedBatcher):
                                hit_rows=hit_rows)
                 if tr is not None else None
             )
-            for j in range(len(hits)):
-                self._station = self._gather_page(
-                    self._station, self.pools, jnp.int32(station),
-                    jnp.int32(hits[j]), jnp.int32(j * self.page),
-                )
+            # ONE bucketed program moves the whole hit run (was one
+            # dispatch per page); padding lanes point at the dump page
+            width = self._page_bucket(len(hits))
+            phys = np.zeros((width,), np.int32)
+            phys[: len(hits)] = hits
+            self._station = self._get_gather_pages(width)(
+                self._station, self.pools, jnp.int32(station),
+                jnp.asarray(phys), jnp.int32(len(hits)),
+            )
             if gspan is not None:
                 gspan.end()
         if tr is not None:
@@ -1154,26 +1371,36 @@ class PagedContinuousBatcher(_TracedBatcher):
     def _scatter_ready_pages(self, job: _PrefillJob) -> None:
         s = self._seqs[job.slot]
         n_sharable = len(job.keys)
-        while job.next_scatter * self.page < job.pos:
-            j = job.next_scatter
-            # a page scatters once prefill has passed it (complete) or
-            # the job is flushing its partial tail (pos == plen-1)
-            if (j + 1) * self.page > job.pos and job.pos < job.plen - 1:
+        # the ready RUN: pages prefill has passed (complete), plus the
+        # partial tail once the job is flushing (pos == plen-1)
+        first = hi = job.next_scatter
+        while hi * self.page < job.pos:
+            if (hi + 1) * self.page > job.pos and job.pos < job.plen - 1:
                 break
-            phys = s.pages[j]
-            self.pools = self._write_page(
-                self.pools, self._station, jnp.int32(job.station),
-                jnp.int32(phys), jnp.int32(j * self.page),
-            )
+            hi += 1
+        if hi == first:
+            return
+        # ONE bucketed program scatters the whole run (was one dispatch
+        # per page); padding lanes write junk to the dump page
+        width = self._page_bucket(hi - first)
+        phys = np.zeros((width,), np.int32)
+        phys[: hi - first] = s.pages[first:hi]
+        self.pools = self._get_write_pages(width)(
+            self.pools, self._station, jnp.int32(job.station),
+            jnp.asarray(phys), jnp.int32(first * self.page),
+        )
+        for j in range(first, hi):
             if (
                 self.prefix_cache is not None
                 and j < n_sharable
                 and (j + 1) * self.page <= job.pos
                 and self.prefix_cache.lookup(job.keys[j]) is None
             ):
-                self.prefix_cache.insert(job.keys[j], phys, kind="prompt")
-                s.shared.add(phys)
-            job.next_scatter = j + 1
+                self.prefix_cache.insert(
+                    job.keys[j], s.pages[j], kind="prompt"
+                )
+                s.shared.add(s.pages[j])
+        job.next_scatter = hi
 
     def _activate(self, job: _PrefillJob) -> None:
         # prompt rows [0, plen-1) are in pool pages; the LAST prompt
@@ -1188,6 +1415,18 @@ class PagedContinuousBatcher(_TracedBatcher):
         self.tables[slot, : len(s.pages)] = s.pages
         self.pos[slot] = job.plen - 1
         self._last[slot] = int(job.prompt[job.plen - 1])
+        # push the slot's loop state to the DEVICE once, here: from now
+        # until retirement the step/spec programs advance it in-program
+        # and the host only mirrors it from readbacks
+        last_tok = int(job.prompt[job.plen - 1])
+        self._tables_dev = self._tables_dev.at[slot].set(
+            jnp.asarray(self.tables[slot])
+        )
+        self._pos_dev = self._pos_dev.at[slot].set(job.plen - 1)
+        self._last_dev = self._last_dev.at[slot].set(last_tok)
+        self._active_dev = self._active_dev.at[slot].set(True)
+        self._remaining_dev = self._remaining_dev.at[slot].set(s.remaining)
+        self._counts_dev = self._counts_dev.at[slot].set(0)
         # retirement sealing needs the committed stream's prompt half
         s.prompt = job.prompt[: job.plen]
         s.plen = job.plen
@@ -1201,6 +1440,7 @@ class PagedContinuousBatcher(_TracedBatcher):
                 jnp.int32(slot),
             )
             self._d_pos[slot] = job.plen - 1
+            self._d_pos_dev = self._d_pos_dev.at[slot].set(job.plen - 1)
         s.prefilling, s.active = False, True
         tr = s.trace
         if tr is not None:
@@ -1393,8 +1633,21 @@ class PagedContinuousBatcher(_TracedBatcher):
         self.tables[i, :] = 0
         self.pos[i] = 0
         self._last[i] = 0
+        # park the DEVICE slot on the dump page and deactivate its
+        # lane: any still-in-flight iteration already wrote only to
+        # rows above this sequence's committed stream (its own private
+        # pages), and every later one lands on the dump.  The queued
+        # device updates order after all in-flight programs.
+        self._tables_dev = self._tables_dev.at[i].set(
+            jnp.zeros((self.max_pages,), jnp.int32)
+        )
+        self._pos_dev = self._pos_dev.at[i].set(0)
+        self._last_dev = self._last_dev.at[i].set(0)
+        self._active_dev = self._active_dev.at[i].set(False)
+        self._remaining_dev = self._remaining_dev.at[i].set(0)
         if self.speculate_k is not None:
             self._d_pos[i] = 0
+            self._d_pos_dev = self._d_pos_dev.at[i].set(0)
 
     def has_work(self) -> bool:
         return bool(self._pending) or any(s.seq_id >= 0 for s in self._seqs)
@@ -1439,6 +1692,7 @@ class PagedContinuousBatcher(_TracedBatcher):
                     # contract)
                     s = self._seqs[free]
                     s.seq_id, s.active = nxt[0], False
+                    s.gen += 1
                     s.prefilling, s.tokens, s.remaining = False, [], 0
                     s.trace = self._traces.pop(nxt[0], None)
                     self._pending.popleft()
@@ -1455,8 +1709,19 @@ class PagedContinuousBatcher(_TracedBatcher):
     def serve_step(self) -> Dict[int, List[int]]:
         """One serving iteration: retire + admit, advance every
         in-flight admission up to ``prefill_chunk`` rows (the whole
-        pack bounded by ``token_budget``), run ONE paged decode step if
-        anything is active, retire again."""
+        pack bounded by ``token_budget``), DISPATCH one paged decode
+        iteration if anything is active, then sync tokens at the one
+        designated readback point — one iteration LATE when
+        ``pipeline_decode`` is on, so the host's bookkeeping (token
+        append, EOS/budget retirement, tracing, metrics, ledger)
+        overlaps the device computing the next iteration.  Termination
+        lives in the program (device-side active mask); the host learns
+        of a retirement one step late, and the overhang lane is masked
+        on device and billed against the budget.  A slot awaiting its
+        FIRST token syncs eagerly (no pipeline lag), so TTFT — and its
+        trace-phase decomposition — keeps sync-mode semantics."""
+        t_begin = time.monotonic()
+        self._sync_wait_s = 0.0
         finished: Dict[int, List[int]] = {}
         spec_emitted = 0
         self._sweep(finished)
@@ -1465,55 +1730,247 @@ class PagedContinuousBatcher(_TracedBatcher):
             self.metrics.set_gauge(
                 "serve_station_slots_busy", float(len(self._jobs))
             )
-            if self.speculate_k is not None:
-                # the draft ring's memory shape (rows, not bytes): the
-                # paged-draft-cache follow-on's observable — was
-                # slots x max_seq before the ring
-                self.metrics.set_gauge(
-                    "serve_draft_cache_rows",
-                    float(self.slots * self.draft_window),
-                )
         n_active = sum(1 for s in self._seqs if s.active)
         if n_active:
             if self.speculate_k is not None:
-                spec_emitted = self._spec_step_host()
+                self._dispatch_spec()
             else:
-                counts = np.array(
-                    [len(sq.tokens) for sq in self._seqs], np.int32
-                )
-                toks, self.pools = self._step(
-                    self.params, self.pools, jnp.asarray(self._last),
-                    jnp.asarray(self.tables), jnp.asarray(self.pos),
-                    self._temps, self._base_keys, jnp.asarray(counts),
-                )
-                self.stats["steps"] += 1
-                toks_host = np.asarray(toks)
-                for i, s in enumerate(self._seqs):
-                    if not s.active:
-                        continue
-                    self.pos[i] += 1  # the step consumed one row
-                    t = int(toks_host[i])
-                    first = not s.tokens
-                    s.tokens.append(t)
-                    s.remaining -= 1
-                    self._last[i] = t
-                    _observe_emit(self.metrics, s, first=first)
-                    if first:
-                        self._trace_first_token(s)
-                    if s.remaining <= 0 or (
-                        self.eos_id is not None and t == self.eos_id
-                    ):
-                        s.active = False
+                self._dispatch_step()
+        # the sync policy: pipelined mode keeps ONE iteration in flight
+        # (host works on iteration N while the device runs N+1) unless
+        # a slot is owed its first token or decode went idle
+        keep = 1 if (
+            self.pipeline_decode
+            and n_active
+            and not any(s.active and not s.tokens for s in self._seqs)
+        ) else 0
+        while len(self._inflight) > keep:
+            spec_emitted += self._process_entry(self._inflight.popleft())
+        if n_active:
             self._sweep(finished)
-        self._ledger_record(n_active, spec_emitted)
+            if not any(s.seq_id >= 0 for s in self._seqs):
+                # every sequence retired this iteration: the pipelined
+                # overhang dispatch (if any) is all-junk — drain it now
+                # so no device future outlives the work it was part of
+                # (and the iteration counters match the dispatch count)
+                while self._inflight:
+                    spec_emitted += self._process_entry(
+                        self._inflight.popleft()
+                    )
+        host_s = (time.monotonic() - t_begin) - self._sync_wait_s
+        self._ledger_record(n_active, spec_emitted, host_s,
+                            self._sync_wait_s)
         return finished
 
-    def _ledger_record(self, n_active: int, spec_emitted: int) -> None:
+    def _loop_state(self):
+        """The decode programs' input state.  Pipelined mode chains the
+        previous iteration's ON-DEVICE outputs (zero uploads — the
+        whole point); synchronous mode re-builds it from the host
+        mirrors every step, which IS the pre-pipeline serve loop,
+        faithfully — kept as the bench baseline (the host gap
+        ``serving_decode_overhead`` measures) and as the property
+        tests' oracle that the host replay and the in-program updates
+        never drift apart."""
+        if self.pipeline_decode:
+            return (self._last_dev, self._tables_dev, self._pos_dev,
+                    self._active_dev, self._remaining_dev,
+                    self._counts_dev,
+                    getattr(self, "_d_pos_dev", None))
+        return self._host_loop_state()
+
+    def _host_loop_state(self):
+        # the SYNCHRONOUS baseline's per-step host round-trip: np
+        # assembly + device uploads of every loop input, every token —
+        # exactly the serialization the device-resident loop deletes
+        counts = np.array([len(s.tokens) for s in self._seqs], np.int32)
+        active = np.array([s.active for s in self._seqs], bool)
+        remaining = np.array(
+            [s.remaining for s in self._seqs], np.int32
+        )
+        return (
+            jnp.asarray(self._last), jnp.asarray(self.tables),
+            jnp.asarray(self.pos), jnp.asarray(active),
+            jnp.asarray(remaining), jnp.asarray(counts),
+            jnp.asarray(self._d_pos)
+            if self.speculate_k is not None else None,
+        )
+
+    def _dispatch_step(self) -> None:
+        """Launch one plain decode iteration: the program consumes the
+        previous iteration's on-device state and returns the next —
+        no host upload, no readback (that is ``_process_entry``'s)."""
+        cand = {i: s.gen for i, s in enumerate(self._seqs) if s.active}
+        last, table, pos, active, remaining, counts, _ = self._loop_state()
+        (toks, self.pools, self._last_dev, self._pos_dev,
+         self._active_dev, self._remaining_dev, self._counts_dev) = (
+            self._step(
+                self.params, self.pools, last, table, pos, active,
+                remaining, counts, self._temps, self._base_keys,
+            )
+        )
+        self.stats["steps"] += 1
+        self._inflight.append(_Inflight(kind="step", cand=cand, toks=toks))
+
+    def _dispatch_spec(self) -> None:
+        """Launch one speculative iteration (draft scan + fused verify),
+        chaining device state exactly like ``_dispatch_step``: ring
+        wrap, budget/EOS truncation and retirement all happen in the
+        programs; the host replays the same arithmetic at readback.
+        With pipelining on, the draft/verify timers measure dispatch
+        windows (async tails overlap the next iteration); the
+        synchronous mode keeps the fenced per-program timings."""
+        cand = {i: s.gen for i, s in enumerate(self._seqs) if s.active}
+        last, table, pos, active, remaining, _, d_pos = self._loop_state()
+        if self.metrics is not None:
+            draft_ctx = self.metrics.timer("serve_spec_draft_seconds")
+            verify_ctx = self.metrics.timer("serve_spec_verify_seconds")
+        else:
+            draft_ctx = verify_ctx = _null_ctx()
+        td0 = time.monotonic()
+        with draft_ctx:
+            proposals, self.d_caches, d_pos_w, wrapped = self._spec_draft(
+                self.draft_params, self.d_caches, last, d_pos, active,
+            )
+            if self.metrics is not None and not self.pipeline_decode:
+                # the timer boundary is also the program boundary:
+                # without the fence the verify timer would absorb the
+                # draft's async tail.  The pipelined path skips it —
+                # the verify consumes proposals as a device array, and
+                # the one sync point stays the token readback
+                proposals = jax.block_until_ready(proposals)
+        tv0 = time.monotonic()
+        with verify_ctx:
+            (choices, emit_len, self.pools, self._last_dev, self._pos_dev,
+             self._d_pos_dev, self._active_dev, self._remaining_dev) = (
+                self._spec_verify(
+                    self.params, self.pools, last, proposals,
+                    table, pos, d_pos_w, active, remaining,
+                )
+            )
+            if self.metrics is not None and not self.pipeline_decode:
+                choices = jax.block_until_ready(choices)
+        tv1 = time.monotonic()
+        self.stats["steps"] += 1
+        self.stats["spec_steps"] += 1
+        self._inflight.append(_Inflight(
+            kind="spec", cand=cand, choices=choices, emit=emit_len,
+            wrapped=wrapped, td0=td0, tv0=tv0, tv1=tv1,
+        ))
+
+    def _process_entry(self, entry: _Inflight) -> int:
+        """The ONE designated readback point: sync a dispatched
+        iteration's device outputs and replay the program's integer
+        arithmetic on the host mirrors — token append, budget/EOS
+        retirement, tracing, metrics.  Lanes whose slot generation
+        changed since dispatch (retired, cancelled, reused) are junk
+        and dropped; everything else must match the device's in-program
+        decisions exactly, or sync and pipelined streams would
+        diverge (the property tests' contract).  Returns the tokens a
+        speculative iteration committed (the ledger's spec yield)."""
+        t0 = time.monotonic()
+        if entry.kind == "step":
+            toks_h = np.asarray(entry.toks)           # READBACK
+        else:
+            choices_h = np.asarray(entry.choices)     # READBACK
+            emit_h = np.asarray(entry.emit)
+            wrapped_h = np.asarray(entry.wrapped)
+        self._sync_wait_s += time.monotonic() - t0
+        if entry.kind == "step":
+            for i, s in enumerate(self._seqs):
+                gen = entry.cand.get(i)
+                if gen is None or s.gen != gen or not s.active:
+                    continue
+                self.pos[i] += 1  # the step consumed one row
+                t = int(toks_h[i])
+                first = not s.tokens
+                s.tokens.append(t)
+                s.remaining -= 1
+                self._last[i] = t
+                _observe_emit(self.metrics, s, first=first)
+                if first:
+                    self._trace_first_token(s)
+                if s.remaining <= 0 or (
+                    self.eos_id is not None and t == self.eos_id
+                ):
+                    s.active = False
+            return 0
+        k = self.speculate_k
+        spec_emitted = 0
+        for i, s in enumerate(self._seqs):
+            gen = entry.cand.get(i)
+            if gen is None or s.gen != gen or not s.active:
+                continue
+            if wrapped_h[i]:
+                # the draft restarted this slot's ring context (accept
+                # rate dips until it rebuilds; output cannot change)
+                self._d_pos[i] = 0
+                self.stats["draft_wraps"] += 1
+            e = int(emit_h[i])
+            # the verify consumed e rows for this slot: rows
+            # [pos, pos+e) now hold the COMMITTED continuation's K/V
+            # (window token j is the previously-emitted token for j=0
+            # and an accepted — i.e. emitted — proposal after);
+            # rejected rows past pos+e are junk the next window
+            # overwrites
+            self.pos[i] += e
+            self._d_pos[i] += e  # the draft ring's write head tracks pos
+            emitted = [int(t) for t in choices_h[i, :e]]
+            # budget cap: the device may emit past the slot's remaining
+            # budget; the surplus is junk (the slot retires here, and
+            # the next admission resets table/pos/draft cache wholesale)
+            emitted = emitted[: s.remaining]
+            if self.eos_id is not None and self.eos_id in emitted:
+                emitted = emitted[: emitted.index(self.eos_id) + 1]
+            tr = s.trace
+            if tr is not None and "decode" in tr.open:
+                # one draft + one verify span per iteration per traced
+                # slot, sharing the iteration's dispatch windows (the
+                # fused programs covered every slot at once)
+                decode = tr.open["decode"]
+                decode.child("spec_draft", t=entry.td0, k=k).end(
+                    t=entry.tv0
+                )
+                decode.child(
+                    "spec_verify", t=entry.tv0, accepted=e,
+                    emitted=len(emitted),
+                ).end(t=entry.tv1)
+            for t in emitted:
+                first = not s.tokens
+                s.tokens.append(t)
+                _observe_emit(self.metrics, s, first=first)
+                if first:
+                    self._trace_first_token(s)
+            s.remaining -= len(emitted)
+            spec_emitted += len(emitted)
+            self._last[i] = int(choices_h[i, e - 1])
+            if self.metrics is not None:
+                self.metrics.observe("serve_spec_accept_rate", (e - 1) / k)
+            if s.remaining <= 0 or (
+                self.eos_id is not None
+                and emitted
+                and emitted[-1] == self.eos_id
+            ):
+                s.active = False
+        self.stats["spec_tokens"] += spec_emitted
+        if self.metrics is not None:
+            # counter pair: tokens_per_step / steps_total is the mean
+            # multi-token yield per verify program
+            self.metrics.inc("serve_spec_tokens_per_step", spec_emitted)
+            self.metrics.inc("serve_spec_steps_total")
+        return spec_emitted
+
+    def _ledger_record(self, n_active: int, spec_emitted: int,
+                       host_s: float = 0.0, device_s: float = 0.0) -> None:
         """Append this iteration's LEDGER row — what the pool, station
         and budget were doing — to the bounded ring, and mirror it as
         gauges.  One glance answers "what is the replica doing": rows
         spent against the budget, station occupancy, page economy,
-        speculation yield.  Host-side dict assembly only; ~1 µs."""
+        speculation yield, and the host/device overlap split —
+        ``host_ms`` is the iteration's host-side bookkeeping time,
+        ``device_ms`` the time it spent BLOCKED on the token readback
+        (near zero when pipelining hides the device behind the host
+        work; the whole step time when synchronous).  Host-side dict
+        assembly only; ~1 µs."""
         rows = self._last_prefill_rows + n_active * (
             (self.speculate_k + 1) if self.speculate_k is not None else 1
         )
@@ -1539,9 +1996,23 @@ class PagedContinuousBatcher(_TracedBatcher):
             "decode_pages_sealed": self.stats["decode_pages_sealed"],
             "prefix_hit_tokens": self.stats["prefix_hit_tokens"],
             "spec_tokens": spec_emitted,
+            "host_ms": round(host_s * 1e3, 3),
+            "device_ms": round(device_s * 1e3, 3),
         }
         self._ledger.append(row)
         if self.metrics is not None:
+            if self.speculate_k is not None and not self._draft_gauge_set:
+                # a registry attached after construction still gets the
+                # construction-constant ring gauge, exactly once
+                self.metrics.set_gauge(
+                    "serve_draft_cache_rows",
+                    float(self.slots * self.draft_window),
+                )
+                self._draft_gauge_set = True
+            self.metrics.set_gauge("serve_step_host_ms", row["host_ms"])
+            self.metrics.set_gauge(
+                "serve_step_device_ms", row["device_ms"]
+            )
             self.metrics.set_gauge("serve_step_rows", float(rows))
             self.metrics.set_gauge(
                 "serve_pool_pages_free", float(row["pages_free"])
@@ -1556,107 +2027,6 @@ class PagedContinuousBatcher(_TracedBatcher):
         — the /debug/trace surface and the bench's budget audit."""
         rows = list(self._ledger)
         return rows[-limit:] if limit is not None else rows
-
-    def _spec_step_host(self) -> int:
-        """One speculative serving iteration for every active slot: the
-        draft scan proposes k tokens per slot at its own depth, ONE
-        verify program scores all k+1 window positions against the paged
-        pool, and each slot commits its accepted prefix plus the target's
-        own choice at the boundary — greedy-lossless, so the emitted
-        stream is token-identical to non-speculative paged decode for ANY
-        draft (the draft only moves how many verify programs it costs)."""
-        k = self.speculate_k
-        if self.metrics is not None:
-            draft_ctx = self.metrics.timer("serve_spec_draft_seconds")
-            verify_ctx = self.metrics.timer("serve_spec_verify_seconds")
-        else:
-            draft_ctx = verify_ctx = _null_ctx()
-        # ring wrap: a slot whose next verify window would write past the
-        # draft ring restarts its draft context at row 0 — the draft
-        # rebuilds from the stream's recent tokens (accept rate dips,
-        # output cannot change: verification is lossless for any draft)
-        for i, s in enumerate(self._seqs):
-            if s.active and int(self._d_pos[i]) + k + 1 > self.draft_window:
-                self._d_pos[i] = 0
-                self.stats["draft_wraps"] += 1
-        td0 = time.monotonic()
-        with draft_ctx:
-            proposals, self.d_caches = self._spec_draft(
-                self.draft_params, self.d_caches, jnp.asarray(self._last),
-                jnp.asarray(self._d_pos),
-            )
-            if self.metrics is not None:
-                # the timer boundary is also the program boundary:
-                # without the readback the verify timer would absorb the
-                # draft's async tail.  Metrics-off skips the fence — the
-                # verify consumes proposals as a device array, so the
-                # hot path keeps async dispatch
-                proposals = jax.block_until_ready(proposals)
-        tv0 = time.monotonic()
-        with verify_ctx:
-            choices, emit_len, next_last, self.pools = self._spec_verify(
-                self.params, self.pools, jnp.asarray(self._last),
-                proposals, jnp.asarray(self.tables), jnp.asarray(self.pos),
-            )
-            choices_h = np.asarray(choices)
-            emit_h = np.asarray(emit_len)
-            next_h = np.asarray(next_last)
-        tv1 = time.monotonic()
-        self.stats["steps"] += 1
-        self.stats["spec_steps"] += 1
-        spec_emitted = 0
-        for i, s in enumerate(self._seqs):
-            if not s.active:
-                continue
-            e = int(emit_h[i])
-            # the verify consumed e rows for this slot: rows
-            # [pos, pos+e) now hold the COMMITTED continuation's K/V
-            # (window token j is the previously-emitted token for j=0 and
-            # an accepted — i.e. emitted — proposal after); rejected
-            # rows past pos+e are junk the next window overwrites
-            self.pos[i] += e
-            self._d_pos[i] += e  # the draft ring's write head tracks pos
-            emitted = [int(t) for t in choices_h[i, :e]]
-            # budget cap: the device may emit past the slot's remaining
-            # budget; the surplus is junk (the slot retires here, and the
-            # next admission resets table/pos/draft cache wholesale)
-            emitted = emitted[: s.remaining]
-            if self.eos_id is not None and self.eos_id in emitted:
-                emitted = emitted[: emitted.index(self.eos_id) + 1]
-            tr = s.trace
-            if tr is not None and "decode" in tr.open:
-                # one draft + one verify span per iteration per traced
-                # slot, sharing the iteration's wall windows (the fused
-                # programs covered every slot at once)
-                decode = tr.open["decode"]
-                decode.child("spec_draft", t=td0, k=k).end(t=tv0)
-                decode.child(
-                    "spec_verify", t=tv0, accepted=e, emitted=len(emitted),
-                ).end(t=tv1)
-            for t in emitted:
-                first = not s.tokens
-                s.tokens.append(t)
-                _observe_emit(self.metrics, s, first=first)
-                if first:
-                    self._trace_first_token(s)
-            s.remaining -= len(emitted)
-            spec_emitted += len(emitted)
-            self._last[i] = int(next_h[i])
-            if self.metrics is not None:
-                self.metrics.observe("serve_spec_accept_rate", (e - 1) / k)
-            if s.remaining <= 0 or (
-                self.eos_id is not None
-                and emitted
-                and emitted[-1] == self.eos_id
-            ):
-                s.active = False
-        self.stats["spec_tokens"] += spec_emitted
-        if self.metrics is not None:
-            # counter pair: tokens_per_step / steps_total is the mean
-            # multi-token yield per verify program
-            self.metrics.inc("serve_spec_tokens_per_step", spec_emitted)
-            self.metrics.inc("serve_spec_steps_total")
-        return spec_emitted
 
     # -- the batch convenience loop ----------------------------------------
     def run(

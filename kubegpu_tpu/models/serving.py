@@ -464,17 +464,25 @@ class ContinuousBatcher(_TracedBatcher):
 
         from kubegpu_tpu.models.decoding import pick_tokens
 
-        def step(params, caches, last_tokens, pos, temps, base_keys, counts):
+        def step(params, caches, last_tokens, pos, active, counts, temps,
+                 base_keys):
             # one decode step for EVERY slot at its own depth; inactive
             # slots compute garbage that the host never collects.  counts
             # = tokens already emitted per slot: a sequence's nth sample
             # always draws from fold_in(its base key, n), so neighbors
-            # and slot scheduling never perturb its stream
+            # and slot scheduling never perturb its stream.  The loop
+            # state (last/pos/counts) advances IN-PROGRAM off the
+            # device-resident active mask — the hot loop re-uploads
+            # nothing per token (the paged batcher's discipline; the
+            # mask itself is pushed only when membership changes)
             logits, caches = self.model.apply(
                 {"params": params}, last_tokens[:, None], caches, pos
             )
             keys = jax.vmap(jax.random.fold_in)(base_keys, counts)
-            return pick_tokens(logits, temps, keys, self.top_k), caches
+            toks = pick_tokens(logits, temps, keys, self.top_k)
+            act = active.astype(jnp.int32)
+            new_last = jnp.where(active, toks, last_tokens)
+            return toks, caches, new_last, pos + act, counts + act
 
         def admit(params, caches, pos, prompt_row, prompt_len, slot, temp,
                   key):
@@ -554,6 +562,11 @@ class ContinuousBatcher(_TracedBatcher):
         self._admit = jax.jit(admit, donate_argnums=(1,))
         self._chunk = jax.jit(chunk, donate_argnums=(1,))
         self._last_tokens = jnp.zeros((slots,), jnp.int32)
+        # device-resident active mask + emit counts: pushed only when
+        # slot membership changes (admit/retire/cancel), never per step
+        self._active_host = np.zeros((slots,), bool)
+        self._active_dev = jnp.zeros((slots,), bool)
+        self._counts_dev = jnp.zeros((slots,), jnp.int32)
 
     # -- host-side orchestration -------------------------------------------
     def _trace_holders(self):
@@ -607,6 +620,8 @@ class ContinuousBatcher(_TracedBatcher):
         _observe_emit(self.metrics, s, first=True)
         self._trace_first_token(s)
         self._last_tokens = self._last_tokens.at[slot_idx].set(first_tok)
+        # the admit program consumed sample 0; the next step draws 1
+        self._counts_dev = self._counts_dev.at[slot_idx].set(1)
         if self.eos_id is not None and s.tokens[-1] == self.eos_id:
             s.remaining = 0
         if s.remaining <= 0:
@@ -657,6 +672,7 @@ class ContinuousBatcher(_TracedBatcher):
             int(s.prompt[plen - 1])
         )
         self.pos = self.pos.at[slot_idx].set(plen - 1)
+        self._counts_dev = self._counts_dev.at[slot_idx].set(0)
         s.active = True
         s.prompt = None
         tr = s.trace
@@ -814,22 +830,24 @@ class ContinuousBatcher(_TracedBatcher):
         if self.prefill_chunk is not None:
             self._advance_prefill()
         if any(s.active for s in self._slots):
-            counts = np.array(
-                [len(s.tokens) for s in self._slots], np.int32
+            # push the active mask only when membership changed since
+            # the last dispatch (admit/retire/cancel events); the step
+            # program advances last/pos/counts in-program off it, so
+            # the steady-state loop uploads NOTHING per token
+            active = np.fromiter(
+                (s.active for s in self._slots), bool, self.slots
             )
-            toks, self.caches = self._step(
+            if not np.array_equal(active, self._active_host):
+                self._active_host = active
+                self._active_dev = jnp.asarray(active)
+            (toks, self.caches, self._last_tokens, self.pos,
+             self._counts_dev) = self._step(
                 self.params, self.caches, self._last_tokens, self.pos,
-                self._temps, self._base_keys, jnp.asarray(counts),
+                self._active_dev, self._counts_dev, self._temps,
+                self._base_keys,
             )
             self.stats["steps"] += 1
             toks_host = np.asarray(toks)
-            # every slot active at step time wrote a cache row: advance
-            # their positions in ONE vectorized update (a per-slot .at
-            # loop would dispatch `slots` tiny device ops per step)
-            advanced = np.array(
-                [s.active for s in self._slots], np.int32
-            )
-            self.pos = self.pos + jnp.asarray(advanced)
             for i, s in enumerate(self._slots):
                 if not s.active:
                     continue
@@ -844,7 +862,6 @@ class ContinuousBatcher(_TracedBatcher):
                     self.eos_id is not None and t == self.eos_id
                 ):
                     s.active = False
-            self._last_tokens = toks
             self._sweep(finished)
         return finished
 

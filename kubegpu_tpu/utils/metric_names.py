@@ -123,6 +123,12 @@ CATALOG: Dict[str, MetricSpec] = {
     "serve_step_rows": _g(
         (), "rows processed by the last serving iteration (decode tokens"
         " + prefill chunk rows) against token_budget"),
+    "serve_step_host_ms": _g(
+        (), "host-side bookkeeping time of the last serving iteration "
+        "(overlaps device compute under pipelined decode)"),
+    "serve_step_device_ms": _g(
+        (), "time the last serving iteration spent BLOCKED on the "
+        "device token readback (near zero when pipelining hides it)"),
     "serve_pool_pages_free": _g((), "KV pool pages on the free list"),
     "serve_pool_pages_live": _g(
         (), "KV pool pages privately held by live sequences"),

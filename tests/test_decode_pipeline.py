@@ -1,0 +1,462 @@
+"""Device-resident pipelined decode loop (ISSUE 8).
+
+The paged decode loop keeps its state (last tokens, positions, page
+tables, active mask, remaining budgets) on DEVICE and advances it
+in-program; the host syncs tokens at ONE designated readback point, one
+iteration late when ``pipeline_decode`` is on, so bookkeeping overlaps
+device compute.  The pipelining must be INVISIBLE in the output:
+greedy fp32 token-identical to the synchronous mode across speculation
+× prefix hits × EOS/budget retirement × cancel churn × multi-turn
+decode-page sealing, with page accounting balanced under the
+GatewaySoak kill schedule and one compiled entry per program (including
+the bucketed multi-page gather/scatter) across varied schedules.
+"""
+
+import inspect
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubegpu_tpu.models import TransformerLM, greedy_generate
+from kubegpu_tpu.models import paging as paging_mod
+from kubegpu_tpu.models.paging import PagedContinuousBatcher
+from kubegpu_tpu.utils.metrics import Metrics
+
+CFG = dict(vocab_size=61, num_layers=2, num_heads=4, hidden=32, max_seq=32)
+
+
+def trained_params():
+    model = TransformerLM(dtype=jnp.float32, **CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.ones((2, 8), jnp.int32))[
+        "params"
+    ]
+
+
+def oracle(params, prompt, n):
+    out = greedy_generate(
+        params, jnp.asarray(prompt)[None, :], n, dtype=jnp.float32, **CFG
+    )
+    return list(np.asarray(out)[0, len(prompt):])
+
+
+def make_paged(params, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("prompt_pad", 20)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("pool_pages", 40)
+    return PagedContinuousBatcher(params, dtype=jnp.float32, **CFG, **kw)
+
+
+def spec_kw(params, k=2, **kw):
+    return dict(
+        draft_params=params, speculate_k=k,
+        draft_num_layers=CFG["num_layers"],
+        draft_num_heads=CFG["num_heads"], draft_hidden=CFG["hidden"],
+        **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Satellite: the hot path has ONE designated readback point (lint)
+# ---------------------------------------------------------------------------
+
+def test_decode_hot_path_single_readback_point():
+    """The decode hot path must not grow back per-step host round-trips:
+    ``serve_step`` and the dispatch functions contain NO asarray calls
+    (state is device-resident, chained program-to-program), and the one
+    designated readback lives in ``_process_entry``.  A stray
+    ``np.asarray``/``jnp.asarray`` creeping into a dispatch function is
+    exactly the per-token serialization this loop exists to kill."""
+    hot = [
+        PagedContinuousBatcher.serve_step,
+        PagedContinuousBatcher._dispatch_step,
+        PagedContinuousBatcher._dispatch_spec,
+        PagedContinuousBatcher._loop_state,
+        PagedContinuousBatcher._ledger_record,
+        PagedContinuousBatcher._sweep,
+    ]
+    for fn in hot:
+        src = inspect.getsource(fn)
+        assert "asarray(" not in src, (
+            f"{fn.__name__} grew a host round-trip: asarray outside the "
+            "designated readback point (_process_entry)"
+        )
+    sync = inspect.getsource(PagedContinuousBatcher._process_entry)
+    assert "np.asarray(" in sync and "READBACK" in sync, (
+        "_process_entry is no longer the designated readback point"
+    )
+    # the per-step upload path survives ONLY as the synchronous
+    # baseline, behind the pipeline_decode guard
+    gate = inspect.getsource(PagedContinuousBatcher._loop_state)
+    assert "if self.pipeline_decode" in gate
+    assert "_host_loop_state" in gate
+
+
+# ---------------------------------------------------------------------------
+# Satellite: the draft-ring gauge is set once, at construction
+# ---------------------------------------------------------------------------
+
+def test_draft_cache_rows_gauge_set_at_construction():
+    """``serve_draft_cache_rows`` is a constant of the construction —
+    it must be visible BEFORE any serve_step runs (and must not be
+    re-set on the per-step path; the lint above keeps serve_step free
+    of it)."""
+    params = trained_params()
+    m = Metrics()
+    make_paged(params, metrics=m, **spec_kw(params, k=2, draft_window=24))
+    assert m.gauge("serve_draft_cache_rows") == 4 * 24.0
+    # a registry attached AFTER construction (the bench's
+    # attach-after-warm pattern) still gets the gauge, from the first
+    # ledger record
+    cb = make_paged(params, **spec_kw(params, k=2, draft_window=24))
+    late = Metrics()
+    cb.metrics = late
+    cb.run([np.array([1, 2, 3], np.int32)], [2])
+    assert late.gauge("serve_draft_cache_rows") == 4 * 24.0
+    # and it stays off the per-step path (the occupancy gauge is
+    # per-step by design; this one is a construction constant)
+    src = inspect.getsource(PagedContinuousBatcher.serve_step)
+    assert "serve_draft_cache_rows" not in src
+
+
+# ---------------------------------------------------------------------------
+# Property: pipelined ≡ synchronous, across the matrix (slow tier below)
+# ---------------------------------------------------------------------------
+
+pipeline_matrix = pytest.mark.slow
+
+
+@pipeline_matrix
+def test_pipelined_token_identity_plain_and_spec():
+    """Greedy fp32, mixed lengths straddling page boundaries, an
+    in-burst duplicate (prefix hit), EOS retirement: the pipelined loop
+    emits EXACTLY the synchronous loop's tokens — which are also the
+    per-sequence oracle's — with and without speculation."""
+    params = trained_params()
+    rng = np.random.RandomState(1)
+    lengths = (1, 3, 4, 5, 8, 9, 13)
+    prompts = [
+        np.array(rng.randint(0, CFG["vocab_size"], size=n), np.int32)
+        for n in lengths
+    ]
+    prompts.append(prompts[4].copy())  # duplicate: prefix hit mid-burst
+    budgets = [5, 4, 6, 3, 5, 6, 4, 5]
+    expected = {
+        i: oracle(params, p, n)
+        for i, (p, n) in enumerate(zip(prompts, budgets))
+    }
+    for extra in (dict(), spec_kw(params, k=2)):
+        sync = make_paged(params, pipeline_decode=False, **extra)
+        got_sync = sync.run(prompts, budgets)
+        assert got_sync == expected, ("sync", extra.keys())
+        sync.assert_page_accounting()
+        pipe = make_paged(params, pipeline_decode=True, **extra)
+        got_pipe = pipe.run(prompts, budgets)
+        assert got_pipe == expected, ("pipelined", extra.keys())
+        pipe.assert_page_accounting()
+
+
+@pipeline_matrix
+def test_pipelined_first_token_syncs_eagerly():
+    """A slot awaiting its FIRST token must not pay the pipeline lag:
+    the serve_step that dispatches the first-token iteration also
+    syncs it, so the step count to first emit matches sync mode (the
+    TTFT phase-attribution gate's foundation)."""
+    params = trained_params()
+    rng = np.random.RandomState(2)
+    prompt = np.array(rng.randint(0, CFG["vocab_size"], size=6), np.int32)
+
+    def steps_to_first_token(pipeline):
+        cb = make_paged(params, pipeline_decode=pipeline)
+        cb.submit(0, prompt, 4)
+        for step in range(50):
+            cb.serve_step()
+            if cb._seqs[0].tokens:
+                return step
+        raise AssertionError("no token in 50 steps")
+
+    assert steps_to_first_token(True) == steps_to_first_token(False)
+
+
+@pipeline_matrix
+def test_lagged_eos_overhang_emits_nothing_past_eos_or_budget():
+    """The overhang property: under pipelined readback the host learns
+    of EOS/budget retirement one step late, but the emitted stream must
+    still end exactly AT the EOS token (never past it) and never exceed
+    max_new — with speculation and multi-turn sealing on, and the
+    sealed-page chain identical to sync mode's."""
+    params = trained_params()
+    rng = np.random.RandomState(3)
+    prompts = [
+        np.array(rng.randint(0, CFG["vocab_size"], size=n), np.int32)
+        for n in (3, 5, 7, 9, 4, 11)
+    ]
+    budgets = [8, 6, 9, 5, 7, 8]
+    chains = {}
+    sealed = {}
+    outs = {}
+    for pipeline in (False, True):
+        for extra in (dict(), spec_kw(params, k=2)):
+            label = (pipeline, bool(extra))
+            # sweep EVERY eos id so some sequence genuinely retires on
+            # EOS mid-stream (61-vocab argmaxes are dense in [0, 61))
+            for eos in range(0, CFG["vocab_size"], 7):
+                cb = make_paged(
+                    params, pipeline_decode=pipeline, eos_id=eos,
+                    decode_page_cache="fp32", **extra,
+                )
+                done = cb.run(prompts, budgets)
+                for i, toks in done.items():
+                    assert len(toks) <= budgets[i], (label, eos, i)
+                    if eos in toks:
+                        assert toks.index(eos) == len(toks) - 1, (
+                            "token emitted past EOS", label, eos, i, toks
+                        )
+                cb.assert_page_accounting()
+                if eos == 0:
+                    chains[label] = set(cb.prefix_cache._entries.keys())
+                    sealed[label] = cb.stats["decode_pages_sealed"]
+                    outs[label] = done
+    # pipelining must not change WHAT gets sealed (same streams, same
+    # committed rows, same chain keys) nor the outputs
+    for with_spec in (False, True):
+        assert outs[(True, with_spec)] == outs[(False, with_spec)]
+        assert chains[(True, with_spec)] == chains[(False, with_spec)]
+        assert sealed[(True, with_spec)] == sealed[(False, with_spec)]
+        assert sealed[(True, with_spec)] > 0, "schedule sealed nothing"
+
+
+@pipeline_matrix
+def test_pipelined_multiturn_hits_token_identical():
+    """Turn-2 traffic through sealed decode pages, pipelined: the
+    extended prompt hits the turn-1 chain (prompt AND decode pages) and
+    the continuation is token-identical to a cold batcher's."""
+    params = trained_params()
+    rng = np.random.RandomState(4)
+    turn1 = np.array(rng.randint(0, CFG["vocab_size"], size=6), np.int32)
+    cb = make_paged(params, decode_page_cache="fp32", pipeline_decode=True)
+    out1 = cb.run([turn1], [8])[0]
+    assert cb.stats["decode_pages_sealed"] > 0
+    turn2 = np.concatenate([
+        turn1, np.asarray(out1, np.int32), np.array([9, 1, 4], np.int32),
+    ])
+    cold = make_paged(params, prefix_cache=False, pipeline_decode=True)
+    expected = cold.run([turn2], [6])[0]
+    got = cb.run([turn2], [6])[0]
+    assert got == expected
+    assert cb.stats["prefix_hit_tokens_decode"] > 0
+    cb.assert_page_accounting()
+
+
+@pipeline_matrix
+def test_pipelined_cancel_churn_holds_accounting_and_outputs():
+    """Random submit/cancel/step churn with pipelining, speculation and
+    sealing on: every sequence that RETIRES normally emits its oracle
+    stream (cancel timing may differ from sync mode — that only moves
+    which requests die, never what survivors say), accounting balances
+    at every step, and nothing leaks at drain."""
+    params = trained_params()
+    rng = np.random.RandomState(5)
+    cb = make_paged(
+        params, pool_pages=60, decode_page_cache="fp32",
+        **spec_kw(params, k=2),
+    )
+    live, seq, submitted = [], 0, {}
+    done = {}
+    for _ in range(60):
+        roll = rng.rand()
+        if roll < 0.45:
+            n = int(rng.randint(1, 14))
+            prompt = np.array(
+                rng.randint(0, CFG["vocab_size"], size=n), np.int32
+            )
+            max_new = int(rng.randint(1, 6))
+            cb.submit(seq, prompt, max_new)
+            submitted[seq] = (prompt, max_new)
+            live.append(seq)
+            seq += 1
+        elif roll < 0.6 and live:
+            cb.cancel(live.pop(rng.randint(len(live))))
+        else:
+            for s, toks in cb.serve_step().items():
+                live.remove(s)
+                done[s] = toks
+        cb.assert_page_accounting()
+    while cb.has_work():
+        for s, toks in cb.serve_step().items():
+            live.remove(s)
+            done[s] = toks
+    cb.assert_page_accounting()
+    assert done, "churn retired nothing"
+    for s, toks in done.items():
+        prompt, max_new = submitted[s]
+        assert toks == oracle(params, prompt, max_new), s
+
+
+@pipeline_matrix
+def test_overhang_window_cannot_corrupt_sealed_pages():
+    """Regression (found by the decode-overhead bench): a slot the
+    DEVICE retired keeps its table live until the host processes the
+    retirement one step later, and the overhang speculative verify
+    window writes rows past the sequence's reservation — where the
+    table's padding points at the sequence's FIRST page, which pass 1
+    sealed into the prefix cache.  Without the in-program dump-parking
+    of inactive lanes, pass 2's hits read corrupted K/V: outputs
+    drift between passes and accepts collapse.  Three passes of the
+    same prompts through one warm batcher must stay token-identical
+    (to each other and to sync mode), with accounting balanced."""
+    params = trained_params()
+    rng = np.random.RandomState(11)
+    prompts = [
+        np.array(rng.randint(0, CFG["vocab_size"], size=n), np.int32)
+        for n in (8, 13, 17, 9)
+    ]
+    # budgets chosen so spec retirement is budget-CAPPED mid-window —
+    # the uncapped device pos advance is what spills the overhang
+    budgets = [6, 9, 11, 7]
+    outs = {}
+    for pipeline in (False, True):
+        cb = make_paged(
+            params, prompt_pad=20, pipeline_decode=pipeline,
+            pool_pages=60, **spec_kw(params, k=2),
+        )
+        cb.submit(900, prompts[0][:5], 2)
+        while cb.has_work():
+            cb.serve_step()
+        per_pass = []
+        for _ in range(3):
+            done = {}
+            for j, p in enumerate(prompts):
+                cb.submit(j, p, budgets[j])
+            while cb.has_work():
+                done.update(cb.serve_step())
+            per_pass.append(done)
+            cb.assert_page_accounting()
+        assert per_pass[0] == per_pass[1] == per_pass[2], (
+            "warm-cache passes drifted", pipeline
+        )
+        outs[pipeline] = per_pass[0]
+    assert outs[True] == outs[False]
+
+
+# ---------------------------------------------------------------------------
+# Compile stability: 40-step schedule, one entry per program incl. buckets
+# ---------------------------------------------------------------------------
+
+@pipeline_matrix
+def test_pipelined_compile_stability_fixed_jit_cache():
+    """40 steps of cancels, prefix hits, speculation and station churn
+    under pipelining: exactly ONE compiled entry per program — the
+    chained step/draft/verify programs AND each bucketed multi-page
+    gather/scatter width (run lengths pad to powers of two, so varied
+    hit/flush sizes reuse a handful of programs instead of minting one
+    per length)."""
+    params = trained_params()
+    rng = np.random.RandomState(6)
+    cb = make_paged(
+        params, station_slots=3, token_budget=11, prefill_chunk=8,
+        pipeline_decode=True, **spec_kw(params, k=2),
+    )
+    seq, live = 0, []
+    for _ in range(40):
+        roll = rng.rand()
+        if roll < 0.5:
+            n = int(rng.randint(1, 13))
+            max_new = int(rng.randint(0, 5))
+            prompt = (
+                np.arange(n, dtype=np.int32) % 7 if roll < 0.15
+                else np.array(
+                    rng.randint(0, CFG["vocab_size"], size=n), np.int32
+                )
+            )  # the arange prompts repeat -> prefix-cache hits
+            cb.submit(seq, prompt, max_new)
+            live.append(seq)
+            seq += 1
+        elif roll < 0.6 and live:
+            cb.cancel(live.pop(rng.randint(len(live))))
+        else:
+            for s in cb.serve_step():
+                live.remove(s)
+    while cb.has_work():
+        for s in cb.serve_step():
+            live.remove(s)
+    cb.assert_page_accounting()
+    for name in ("_spec_draft", "_spec_verify", "_draft_admit", "_chunk"):
+        assert getattr(cb, name)._cache_size() == 1, (
+            f"{name}: {getattr(cb, name)._cache_size()} compiled entries"
+        )
+    assert cb._write_pages, "no multi-page scatter ran"
+    for w, fn in cb._write_pages.items():
+        assert fn._cache_size() == 1, f"scatter width {w} recompiled"
+    for w, fn in cb._gather_pages.items():
+        assert fn._cache_size() == 1, f"gather width {w} recompiled"
+    # bucketing bounds the width set: powers of two up to the station's
+    # page capacity (prompt_pad // page)
+    cap = cb.prompt_pad // cb.page
+    widths = set(cb._write_pages) | set(cb._gather_pages)
+    assert all(
+        (w & (w - 1)) == 0 or w == cap for w in widths
+    ), widths
+    assert all(w <= cap for w in widths), widths
+
+
+# ---------------------------------------------------------------------------
+# Ledger: the host/device overlap split is recorded per iteration
+# ---------------------------------------------------------------------------
+
+@pipeline_matrix
+def test_ledger_records_host_device_split():
+    params = trained_params()
+    m = Metrics()
+    cb = make_paged(params, metrics=m, pipeline_decode=True)
+    rng = np.random.RandomState(7)
+    prompts = [
+        np.array(rng.randint(0, CFG["vocab_size"], size=5), np.int32)
+        for _ in range(3)
+    ]
+    cb.run(prompts, [6, 6, 6])
+    rows = cb.ledger_rows()
+    assert rows
+    for r in rows:
+        assert r["host_ms"] >= 0.0 and r["device_ms"] >= 0.0
+    # some iteration actually performed a readback
+    assert any(r["device_ms"] > 0.0 for r in rows)
+    assert m.gauge("serve_step_host_ms") >= 0.0
+    assert m.gauge("serve_step_device_ms") >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# Soak: kill schedule with pipelining + speculation + multiturn sealing
+# ---------------------------------------------------------------------------
+
+@pipeline_matrix
+def test_gateway_soak_pipelined_kill_schedule():
+    """The acceptance soak: GatewaySoak's kill/revive/hedge schedule
+    with the multi-turn session op, over paged batchers with PIPELINED
+    decode, speculation AND decode-page caching all enabled — invariant
+    I5 plus page accounting on every surviving replica at quiescence.
+    Kills and hedge-loser cancels land in the readback gap, so the
+    lagged-retirement path is exactly what this schedule hunts."""
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    tiny = dict(vocab_size=61, num_layers=1, num_heads=2, hidden=16,
+                max_seq=32)
+    params = TransformerLM(dtype=jnp.float32, **tiny).init(
+        jax.random.PRNGKey(1), jnp.ones((1, 4), jnp.int32)
+    )["params"]
+    soak = GatewaySoak(
+        seed=31, n_replicas=2, multiturn=True, follow_prompt_cap=12,
+        batcher_factory=lambda key: PagedContinuousBatcher(
+            params, slots=4, prompt_pad=12, page_size=4, pool_pages=48,
+            station_slots=2, token_budget=8, dtype=jnp.float32,
+            decode_page_cache="fp32", pipeline_decode=True,
+            draft_params=params, speculate_k=2, draft_window=16,
+            draft_num_layers=tiny["num_layers"],
+            draft_num_heads=tiny["num_heads"],
+            draft_hidden=tiny["hidden"], **tiny,
+        ),
+    )
+    soak.run(steps=20)

@@ -441,11 +441,16 @@ def test_multiturn_compile_stability_fixed_jit_cache():
     assert cb.stats["prefix_hit_tokens_decode"] > 0, (
         "schedule never exercised a decode-page hit"
     )
-    for name in ("_chunk", "_step", "_write_page"):
+    for name in ("_chunk", "_step"):
         assert getattr(cb, name)._cache_size() == 1, (
             f"{name}: {getattr(cb, name)._cache_size()} compiled entries"
         )
-    assert cb._gather_page._cache_size() <= 1
+    # bucketed multi-page programs: one compiled entry per padded width
+    assert cb._write_pages, "no multi-page scatter ran"
+    for w, fn in cb._write_pages.items():
+        assert fn._cache_size() == 1, f"scatter width {w} recompiled"
+    for w, fn in cb._gather_pages.items():
+        assert fn._cache_size() == 1, f"gather width {w} recompiled"
 
 
 # ---------------------------------------------------------------------------

@@ -293,11 +293,16 @@ def test_compile_stability_fixed_jit_cache():
         for s in cb.serve_step():
             live.remove(s)
     cb.assert_page_accounting()
-    for name in ("_chunk", "_step", "_write_page"):
+    for name in ("_chunk", "_step"):
         assert getattr(cb, name)._cache_size() == 1, (
             f"{name}: {getattr(cb, name)._cache_size()} compiled entries"
         )
-    assert cb._gather_page._cache_size() <= 1
+    # bucketed multi-page programs: one compiled entry per padded width
+    assert cb._write_pages, "no multi-page scatter ran"
+    for w, fn in cb._write_pages.items():
+        assert fn._cache_size() == 1, f"scatter width {w} recompiled"
+    for w, fn in cb._gather_pages.items():
+        assert fn._cache_size() == 1, f"gather width {w} recompiled"
 
 
 # ---------------------------------------------------------------------------
