@@ -4,7 +4,7 @@
 PY ?= python
 SHELL := /bin/bash
 
-.PHONY: test tier1 test-mid test-slow test-all native bench bench-smoke dryrun image clean
+.PHONY: test tier1 test-mid test-slow test-all native bench bench-smoke multichip-smoke dryrun image clean
 
 # fast half: control plane + wire protocols, ~1 min (default pytest run)
 test: native
@@ -58,6 +58,16 @@ bench:
 # the 5% gate
 bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve-smoke
+
+# tensor-parallel paged serving on the 8-device CPU sim (~2 min):
+# fp32 token identity TP=8 vs TP=1 (burst + speculation + multi-turn
+# through sealed decode pages), pool-rows-per-replica scaling >= 4x at
+# equal per-device memory budget, per-step collective bytes reported,
+# and a GatewaySoak kill schedule over TP batchers holding page
+# accounting at quiescence; exits non-zero on any gate
+multichip-smoke:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+	  $(PY) bench.py --tp-smoke
 
 # gateway smoke runs FIRST: it has no JAX-device dependency, so it still
 # exercises the serving path in environments where the multichip dry run

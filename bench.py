@@ -2077,6 +2077,171 @@ def serving_trace_report(extra: dict, tiny: bool = False) -> None:
     extra["serve_trace_overhead_ok"] = bool(overhead_ok)
 
 
+def serving_tp_paged(extra: dict, tiny: bool = False) -> None:
+    """Tensor-parallel paged serving (ISSUE 9 acceptance): the whole
+    ``PagedContinuousBatcher`` hot loop over a "model" mesh — KV page
+    pool / prefill station / draft ring head-sharded, tables and loop
+    state replicated, paged kernels per head-shard under shard_map,
+    one Megatron all-reduce per block in the projections.
+
+    Gates (the ``make multichip-smoke`` lane, 8-device CPU sim):
+      (a) greedy fp32 TOKEN IDENTITY, TP=8 vs TP=1, on the same
+          workload — burst with an in-burst duplicate (prefix hit),
+          speculation, and a multi-turn second pass through sealed
+          decode pages;
+      (b) pool-rows-per-replica scaling: for the same per-DEVICE memory
+          budget a TP=8 replica holds >= 4x the pool rows of TP=1
+          (measured from the resting pools' per-device bytes, not the
+          formula);
+      (c) a GatewaySoak kill schedule over TP batchers (speculation +
+          multi-turn sealing on) holds page accounting at quiescence.
+    Collective traffic is reported from the ledger's per-iteration
+    modeled all-reduce bytes.  Throughput both widths is reported but
+    NOT gated: on a 1-core host sim the inserted collectives are pure
+    overhead — the FLOP split needs real chips (ICI) to pay off."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubegpu_tpu.models import TransformerLM
+    from kubegpu_tpu.models.paging import PagedContinuousBatcher
+    from kubegpu_tpu.parallel import device_mesh
+
+    tp = 8
+    if jax.device_count() < tp:
+        log(
+            f"serving tp paged: SKIPPED ({jax.device_count()} devices "
+            f"visible, need {tp} — run under the multichip lane's "
+            "--xla_force_host_platform_device_count=8)"
+        )
+        extra["serve_tp_skipped"] = True
+        return
+    if tiny:
+        vocab, layers, heads, hidden = 64, 2, 8, 32
+        page, prompt_pad, max_seq = 8, 32, 64
+        n_req, soak_steps = 6, 12
+    else:
+        vocab, layers, heads, hidden = 32768, 4, 32, 1024
+        page, prompt_pad, max_seq = 16, 64, 256
+        n_req, soak_steps = 6, 12
+    dtype = jnp.float32  # the identity gate's precision class
+    model = TransformerLM(
+        vocab_size=vocab, num_layers=layers, num_heads=heads,
+        hidden=hidden, max_seq=max_seq,
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32)
+    )["params"]
+    rs = np.random.RandomState(41)
+    # turn-1 prompts short enough that turn 2 (prompt + generated + new
+    # text) still fits prompt_pad - 1
+    prompts = [
+        rs.randint(0, vocab, size=rs.randint(2, 8)).astype(np.int32)
+        for _ in range(n_req)
+    ]
+    prompts.append(prompts[2].copy())   # in-burst duplicate: prefix hit
+    budgets = [max(6 + i % 5, 2) for i in range(len(prompts))]
+    spec_kw = dict(
+        draft_params=params, speculate_k=2, draft_num_layers=layers,
+        draft_num_heads=heads, draft_hidden=hidden,
+    )
+    pool_pages = 4 * len(prompts) * -(-(prompt_pad + max(budgets) + 2) // page)
+
+    def build(mesh):
+        return PagedContinuousBatcher(
+            params, vocab_size=vocab, num_layers=layers, num_heads=heads,
+            hidden=hidden, max_seq=max_seq, slots=len(prompts),
+            prompt_pad=prompt_pad, page_size=page, pool_pages=pool_pages,
+            dtype=dtype, decode_page_cache="fp32", mesh=mesh, **spec_kw,
+        )
+
+    def drive(cb):
+        """burst (+hit) -> multi-turn second pass through sealed decode
+        pages; returns (tokens_by_phase, wall_s, ledger rows)."""
+        t_mark = time.monotonic()
+        t0 = time.perf_counter()
+        out1 = cb.run(prompts, budgets)
+        turn2 = [
+            np.concatenate([
+                prompts[j], np.asarray(out1[j], np.int32),
+                np.array([5, 3, 1], np.int32),
+            ])
+            for j in range(3)
+        ]
+        out2 = cb.run(turn2, [4, 4, 4])
+        wall = time.perf_counter() - t0
+        rows = [r for r in cb.ledger_rows() if r["t"] >= t_mark]
+        return (out1, out2), wall, rows
+
+    # warm then time the SAME instance both widths: the jit programs
+    # are per-batcher closures, so a fresh batcher's first drive pays
+    # every compile — pass 2 on a warm instance is the steady state
+    # (fp32 sealed-chain hits keep pass-2 tokens identical to pass 1's,
+    # the PR 8 warm-pass posture, and both widths get the same
+    # treatment so the comparison stays fair)
+    ref_cb = build(None)
+    cold_ref, _, _ = drive(ref_cb)         # pass 1: compiles
+    ref_out, ref_wall, _ = drive(ref_cb)   # pass 2: warm, timed
+    mesh = device_mesh({"model": tp}, devices=jax.devices()[:tp])
+    tp_cb = build(mesh)
+    tp_cold, _, _ = drive(tp_cb)           # pass 1: compiles
+    tp_out, tp_wall, tp_rows = drive(tp_cb)  # pass 2: warm, timed
+    tp_cb.assert_page_accounting()
+    identical = bool(
+        tp_out == ref_out and tp_cold == cold_ref and cold_ref == ref_out
+    )
+    decode_hits = tp_cb.stats["prefix_hit_tokens_decode"]
+
+    # pool-rows scaling, MEASURED from the resting pools: same page
+    # count both widths, so per-device bytes must divide by tp — i.e.
+    # the same per-device budget holds tp x the rows
+    ref_dev_bytes = ref_cb.pools[0][0].addressable_shards[0].data.nbytes
+    tp_dev_bytes = tp_cb.pools[0][0].addressable_shards[0].data.nbytes
+    rows_ratio = ref_dev_bytes / max(tp_dev_bytes, 1)
+    coll_bytes = [r["collective_bytes"] for r in tp_rows]
+    mean_coll = sum(coll_bytes) / max(len(coll_bytes), 1)
+
+    # (c) the kill schedule: TP batchers under GatewaySoak with
+    # speculation + multi-turn sealing — accounting (incl. the
+    # sharded-pool layout leg) holds at quiescence or run() raises
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    soak = GatewaySoak(
+        seed=47, n_replicas=2, multiturn=True, follow_prompt_cap=12,
+        batcher_factory=lambda key: PagedContinuousBatcher(
+            params, vocab_size=vocab, num_layers=layers, num_heads=heads,
+            hidden=hidden, max_seq=max_seq, slots=4,
+            prompt_pad=prompt_pad, page_size=page, pool_pages=48,
+            station_slots=2, token_budget=24, dtype=dtype,
+            decode_page_cache="fp32", mesh=mesh, **spec_kw,
+        ),
+    )
+    soak.run(steps=soak_steps)
+
+    n_tokens = sum(budgets) + 12
+    label = "tiny/CPU-sim fp32" if tiny else f"{heads}-head fp32"
+    log(
+        f"serving tp paged ({label}, {len(prompts)} reqs + spec k=2 + "
+        f"multi-turn, TP={tp} vs 1): token-identical: {identical}; "
+        f"pool rows per replica at equal per-device budget: "
+        f"{rows_ratio:.1f}x ({ref_dev_bytes} -> {tp_dev_bytes} "
+        f"B/device/layer); mean modeled collective "
+        f"{mean_coll / 1e3:.1f} kB/step; {n_tokens / tp_wall:.0f} tok/s "
+        f"TP={tp} vs {n_tokens / ref_wall:.0f} TP=1 (sim — collectives "
+        "are pure overhead on one core); soak accounting held"
+    )
+    extra["serve_tp_token_identical"] = identical
+    extra["serve_tp_rows_ratio"] = round(rows_ratio, 2)
+    extra["serve_tp_collective_bytes_per_step"] = round(mean_coll, 1)
+    extra["serve_tp_decode_hit_tokens"] = int(decode_hits)
+    extra["serve_tp_tok_s"] = round(n_tokens / tp_wall, 1)
+    extra["serve_tp_ref_tok_s"] = round(n_tokens / ref_wall, 1)
+    extra["serve_tp_rows_scaling_ok"] = bool(rows_ratio >= 4.0)
+    extra["serve_tp_soak_ok"] = True
+
+
 def serving_continuous_batching(extra: dict) -> None:
     """Continuous batching vs static batching on the 1.08B flagship
     (models/serving.py): a queue of prompts with VARYING token budgets
@@ -3133,6 +3298,31 @@ def main() -> None:
         print(json.dumps(first_step_probe()))
         return
 
+    if "--tp-smoke" in sys.argv:
+        # the multichip lane (make multichip-smoke): tensor-parallel
+        # paged serving on the 8-device CPU sim — fp32 token identity
+        # TP=8 vs TP=1 (burst + speculation + multi-turn), pool-rows
+        # scaling >= 4x at equal per-device budget, collective bytes
+        # reported, and the TP GatewaySoak kill schedule holding page
+        # accounting (soak raises into a failed gate if not)
+        extra = {}
+        try:
+            serving_tp_paged(extra, tiny=True)
+        except AssertionError as e:
+            log(f"serving tp paged FAILED: {e}")
+            extra.setdefault("serve_tp_token_identical", False)
+        ok = (
+            not extra.get("serve_tp_skipped", False)
+            and extra.get("serve_tp_token_identical", False)
+            and extra.get("serve_tp_rows_scaling_ok", False)
+            and extra.get("serve_tp_soak_ok", False)
+            and extra.get("serve_tp_decode_hit_tokens", 0) > 0
+        )
+        print(json.dumps({
+            "metric": "serving_tp_smoke", "ok": ok, "extra": extra,
+        }))
+        sys.exit(0 if ok else 1)
+
     if "--serve-smoke" in sys.argv:
         # CPU-only micro-subset (make bench-smoke): the serving-path
         # latency rows — TTFT/ITL p95 chunked-vs-monolithic and the
@@ -3271,6 +3461,7 @@ def main() -> None:
     serving_decode_overhead(extra)
     serving_multiturn(extra)
     serving_trace_report(extra)
+    serving_tp_paged(extra)  # no-op skip below 8 devices
     paged_longctx_row(extra)
     steady_state_moe(extra)
     pipeline_bubble_row(extra)

@@ -136,7 +136,8 @@ class SimBatcher:
     def __init__(self, slots: int = 8, vocab: int = 256,
                  token_budget: Optional[int] = None,
                  speculate_k: Optional[int] = None,
-                 decode_page_cache: str = "off") -> None:
+                 decode_page_cache: str = "off",
+                 tp: int = 1) -> None:
         if token_budget is not None and token_budget <= 0:
             raise ValueError(
                 f"token_budget ({token_budget}) must be positive or None"
@@ -150,11 +151,19 @@ class SimBatcher:
                 f"decode_page_cache must be one of "
                 f"{DECODE_PAGE_CACHE_POLICIES}, got {decode_page_cache!r}"
             )
+        if tp < 1:
+            # the paged batchers' tensor-parallel width contract: the
+            # mill has no mesh, so it only validates and ADVERTISES the
+            # width (the /state replica_mesh surface) — a bad
+            # value must die at replica construction here exactly as a
+            # real batcher's mesh validation would
+            raise ValueError(f"tp ({tp}) must be >= 1")
         self.slots = slots
         self.vocab = vocab
         self.token_budget = token_budget
         self.speculate_k = speculate_k
         self.decode_page_cache = decode_page_cache
+        self.tp = tp
         self._pending: deque = deque()
         self._active: Dict[int, tuple] = {}  # seq -> (tokens, max_new)
         self._rr: deque = deque()            # active seqs in budget order
@@ -439,6 +448,19 @@ class InMemoryReplicaClient(ReplicaClient):
     def replicas(self) -> List[str]:
         with self._lock:
             return sorted(self._workers)
+
+    def advertised(self) -> Dict[str, dict]:
+        """Per-replica serving contract the data plane advertises
+        upstream (duck-typed off each batcher): today the tensor-
+        parallel width — a TP replica serves tp x the pool rows, which
+        routing and autoscaling will want to weigh.  The ``GET /state``
+        ``replica_mesh`` surface reads this."""
+        with self._lock:
+            workers = list(self._workers.items())
+        return {
+            key: {"tp": int(getattr(w.batcher, "tp", 1))}
+            for key, w in workers
+        }
 
     def ledgers(self, limit: int = 32) -> Dict[str, List[dict]]:
         """Recent per-iteration serving-ledger rows per replica, for

@@ -165,6 +165,13 @@ def _debug_state(gateway: Gateway, registry: ReplicaRegistry) -> dict:
         "outstanding": dict(gateway.dispatcher.outstanding),
         "outcomes": outcomes,
         "completed_by_replica": dict(gateway.completed_by_replica),
+        # each wired replica's advertised serving mesh (tensor-parallel
+        # width; duck-typed off the data-plane client so third-party
+        # clients without the surface degrade to {})
+        "replica_mesh": (
+            gateway.client.advertised()
+            if hasattr(gateway.client, "advertised") else {}
+        ),
     }
 
 
@@ -263,7 +270,7 @@ class GatewayServer:
 
 def _build_fake_serving_cluster(preset: str, replicas: int, group: str,
                                 token_budget=None, speculate_k=None,
-                                decode_page_cache="off"):
+                                decode_page_cache="off", tp=1):
     """Fabricated cluster + scheduled decode replicas + SimBatcher-backed
     in-memory data plane: the full serving path with zero dependencies."""
     from kubegpu_tpu.gateway.client import InMemoryReplicaClient, SimBatcher
@@ -287,7 +294,7 @@ def _build_fake_serving_cluster(preset: str, replicas: int, group: str,
     client = InMemoryReplicaClient(
         batcher_factory=lambda key: SimBatcher(
             slots=8, token_budget=token_budget, speculate_k=speculate_k,
-            decode_page_cache=decode_page_cache,
+            decode_page_cache=decode_page_cache, tp=tp,
         ),
         step_delay_s=0.002,
     )
@@ -352,6 +359,16 @@ def main(argv=None) -> None:
         "in-process SimBatcher planes here only validate the contract",
     )
     ap.add_argument(
+        "--tp", type=int, default=1,
+        help="tensor-parallel width of each serving replica's mesh: a "
+        "TP replica shards its KV page pool / prefill station / draft "
+        "ring on heads over a 'model' mesh (models/worker.py --tp on "
+        "the paged path), serving tp x the pool rows per replica for "
+        "the same per-device HBM.  Consumed replica-side; the gateway "
+        "validates the contract, and wired replicas advertise their "
+        "width at /state (replica_mesh).  Default 1 (no TP)",
+    )
+    ap.add_argument(
         "--draft-checkpoint", default=None, metavar="DIR",
         help="orbax checkpoint directory holding the draft model's "
         "weights; required when --speculate-k is set and must exist.  "
@@ -372,6 +389,8 @@ def main(argv=None) -> None:
     args = ap.parse_args(argv)
     if args.token_budget is not None and args.token_budget <= 0:
         ap.error(f"--token-budget must be positive, got {args.token_budget}")
+    if args.tp < 1:
+        ap.error(f"--tp must be >= 1, got {args.tp}")
     if args.speculate_k is not None:
         # the --token-budget pattern: malformed serving knobs die at
         # argparse time, never mid-serve-loop
@@ -397,7 +416,7 @@ def main(argv=None) -> None:
         _, registry, client = _build_fake_serving_cluster(
             args.fake_cluster, args.replicas, args.group,
             token_budget=args.token_budget, speculate_k=args.speculate_k,
-            decode_page_cache=args.decode_page_cache,
+            decode_page_cache=args.decode_page_cache, tp=args.tp,
         )
     else:
         from kubegpu_tpu.utils.apiserver import KubeApiServer
@@ -421,6 +440,7 @@ def main(argv=None) -> None:
                     slots=8, token_budget=args.token_budget,
                     speculate_k=args.speculate_k,
                     decode_page_cache=args.decode_page_cache,
+                    tp=args.tp,
                 ),
                 step_delay_s=0.002,
             )

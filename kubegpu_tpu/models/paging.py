@@ -90,6 +90,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubegpu_tpu.models.decoding import DecodeLM, QuantDense, init_caches
 from kubegpu_tpu.models.serving import (
@@ -99,10 +100,21 @@ from kubegpu_tpu.models.serving import (
     _validate_request,
     resolve_decode_page_cache,
 )
+from kubegpu_tpu.parallel.sharding import (
+    MODEL_AXIS,
+    TRANSFORMER_TP_RULES,
+    dense_cache_spec,
+    paged_pool_spec,
+    param_shardings,
+    tp_all_reduce_wire_bytes,
+    tp_size,
+)
 from kubegpu_tpu.utils.tracing import SpanCtx, Tracer
 from kubegpu_tpu.ops.paged_attention import (
     paged_chunk_attention,
+    paged_chunk_attention_sharded,
     paged_decode_attention,
+    paged_decode_attention_sharded,
 )
 from kubegpu_tpu.utils.metrics import Metrics
 
@@ -118,11 +130,21 @@ class PagedDecodeAttention(nn.Module):
     speculative verify chunk, q-length L through the multi-query kernel
     with intra-window causal masking).  Either way every window row's K/V
     is written to the slot's pages FIRST, then attention runs — row j
-    sees rows < pos+j+1, the dense twin's exact semantics."""
+    sees rows < pos+j+1, the dense twin's exact semantics.
+
+    With ``mesh`` (tensor-parallel serving), the pools carry heads
+    sharded over the mesh's "model" axis and the kernels run per
+    head-shard under shard_map (ops/paged_attention's *_sharded
+    wrappers — GSPMD cannot partition a pallas call and would replicate
+    the pool).  The K/V writes stay outside: their sharded heads dim is
+    never an indexed dim, so GSPMD partitions the scatter locally.  The
+    one all-reduce per block stays in the row-parallel o_proj matmul
+    (the Megatron discipline)."""
 
     num_heads: int
     dtype: jnp.dtype = jnp.bfloat16
     quant: bool = False
+    mesh: Optional[Mesh] = None
 
     @nn.compact
     def __call__(self, x, k_pool, v_pool, table, pos):
@@ -140,6 +162,16 @@ class PagedDecodeAttention(nn.Module):
         q = dense(d, name="q_proj")(x).reshape(b, L, h, hd)
         k = dense(d, name="k_proj")(x).reshape(b, L, h, hd)
         v = dense(d, name="v_proj")(x).reshape(b, L, h, hd)
+        if self.mesh is not None:
+            decode_attn = partial(
+                paged_decode_attention_sharded, mesh=self.mesh
+            )
+            chunk_attn = partial(
+                paged_chunk_attention_sharded, mesh=self.mesh
+            )
+        else:
+            decode_attn = paged_decode_attention
+            chunk_attn = paged_chunk_attention
         rows = jnp.arange(b)
         if L == 1:
             # the proven decode-step path, byte-for-byte: one write, the
@@ -149,7 +181,7 @@ class PagedDecodeAttention(nn.Module):
             offs = pos % page
             k_pool = k_pool.at[page_ids, :, offs, :].set(k[:, 0])
             v_pool = v_pool.at[page_ids, :, offs, :].set(v[:, 0])
-            out = paged_decode_attention(
+            out = decode_attn(
                 q[:, 0], k_pool, v_pool, table, pos + 1
             )
             out = out.reshape(b, 1, d)
@@ -163,7 +195,7 @@ class PagedDecodeAttention(nn.Module):
                 offs = (pos + j) % page
                 k_pool = k_pool.at[page_ids, :, offs, :].set(k[:, j])
                 v_pool = v_pool.at[page_ids, :, offs, :].set(v[:, j])
-            out = paged_chunk_attention(q, k_pool, v_pool, table, pos + 1)
+            out = chunk_attn(q, k_pool, v_pool, table, pos + 1)
             out = out.reshape(b, L, d)
         out = dense(d, name="o_proj")(out)
         return out, k_pool, v_pool
@@ -174,6 +206,7 @@ class PagedDecodeBlock(nn.Module):
     mlp_ratio: int = 4
     dtype: jnp.dtype = jnp.bfloat16
     quant: bool = False
+    mesh: Optional[Mesh] = None
 
     @nn.compact
     def __call__(self, x, k_pool, v_pool, table, pos):
@@ -185,7 +218,8 @@ class PagedDecodeBlock(nn.Module):
         )
         y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
         attn_out, k_pool, v_pool = PagedDecodeAttention(
-            self.num_heads, self.dtype, self.quant, name="attn"
+            self.num_heads, self.dtype, self.quant, mesh=self.mesh,
+            name="attn"
         )(y, k_pool, v_pool, table, pos)
         x = x + attn_out
         y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
@@ -210,6 +244,7 @@ class PagedDecodeLM(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     quant: bool = False
     all_logits: bool = False
+    mesh: Optional[Mesh] = None
 
     @nn.compact
     def __call__(self, tokens, pools, table, pos):
@@ -227,7 +262,7 @@ class PagedDecodeLM(nn.Module):
             kp, vp = pools[i]
             x, kp, vp = PagedDecodeBlock(
                 self.num_heads, dtype=self.dtype, quant=self.quant,
-                name=f"layer{i}"
+                mesh=self.mesh, name=f"layer{i}"
             )(x, kp, vp, table, pos)
             new_pools.append((kp, vp))
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
@@ -493,7 +528,46 @@ class PagedContinuousBatcher(_TracedBatcher):
         draft_hidden: Optional[int] = None,
         speculate_k: Optional[int] = None,
         draft_window: Optional[int] = None,
+        mesh: Optional[Mesh] = None,
     ) -> None:
+        # tensor-parallel serving: a mesh with a "model" axis shards the
+        # KV page pool, the prefill station and the draft ring on their
+        # HEADS dim (tables/lengths/positions/active masks replicated),
+        # TP-shards the projections per TRANSFORMER_TP_RULES, and runs
+        # the paged kernels per head-shard under shard_map — every
+        # device holds 1/tp of each page's bytes, so the same per-device
+        # memory budget carries tp x the pool ROWS (and the concurrent
+        # sessions they admit)
+        if mesh is not None and MODEL_AXIS not in mesh.axis_names:
+            raise ValueError(
+                f"tensor-parallel serving needs a mesh with a "
+                f"{MODEL_AXIS!r} axis, got {mesh.axis_names}"
+            )
+        self.mesh = mesh
+        self.tp = tp_size(mesh)
+        if num_heads % self.tp:
+            raise ValueError(
+                f"num_heads ({num_heads}) not divisible by the mesh's "
+                f"tensor-parallel width ({self.tp}): the pool shards "
+                "whole heads"
+            )
+        if vocab_size % self.tp:
+            raise ValueError(
+                f"vocab_size ({vocab_size}) not divisible by the "
+                f"tensor-parallel width ({self.tp}): lm_head is "
+                "column-parallel over the vocab (TRANSFORMER_TP_RULES)"
+            )
+        if (
+            mesh is not None
+            and speculate_k is not None
+            and draft_num_heads is not None
+            and draft_num_heads % self.tp
+        ):
+            raise ValueError(
+                f"draft_num_heads ({draft_num_heads}) not divisible by "
+                f"the tensor-parallel width ({self.tp}): the draft ring "
+                "shards whole heads too"
+            )
         if prompt_pad > max_seq:
             raise ValueError(
                 f"prompt_pad ({prompt_pad}) exceeds max_seq ({max_seq})"
@@ -585,6 +659,23 @@ class PagedContinuousBatcher(_TracedBatcher):
         self._traces: Dict[int, _SeqTrace] = {}
         self._ledger: deque = deque(maxlen=ledger_size)
         self._last_prefill_rows = 0
+        if mesh is not None:
+            # Megatron-shard the target (and draft) params over the mesh
+            # — idempotent when the caller already placed them — and keep
+            # a replicated-sharding handle for the small loop state (the
+            # device-resident tables/pos/masks every shard reads whole)
+            params = jax.device_put(
+                params, param_shardings(params, mesh, TRANSFORMER_TP_RULES)
+            )
+            if draft_params is not None:
+                draft_params = jax.device_put(
+                    draft_params,
+                    param_shardings(draft_params, mesh, TRANSFORMER_TP_RULES),
+                )
+            self.draft_params = draft_params
+            self._repl = NamedSharding(mesh, P())
+        else:
+            self._repl = None
         self.params = params
         self.slots = slots
         self.prompt_pad = prompt_pad
@@ -596,7 +687,7 @@ class PagedContinuousBatcher(_TracedBatcher):
         self.model = PagedDecodeLM(
             vocab_size=vocab_size, num_layers=num_layers,
             num_heads=num_heads, hidden=hidden, max_seq=max_seq, dtype=dtype,
-            quant=quant,
+            quant=quant, mesh=mesh,
         )
         # the dense twin handles admit prefill (same param tree)
         self.dense_model = DecodeLM(
@@ -608,12 +699,16 @@ class PagedContinuousBatcher(_TracedBatcher):
         self.num_heads = num_heads
         self.hidden = hidden
         self.dtype = dtype
+        def _pool_zeros():
+            z = jnp.zeros((pool_pages, num_heads, page_size, hd), dtype)
+            if mesh is not None:
+                # heads over "model": every device holds 1/tp of each
+                # page's bytes; the page-id space stays mesh-wide
+                z = jax.device_put(z, NamedSharding(mesh, paged_pool_spec()))
+            return z
+
         self.pools = [
-            (
-                jnp.zeros((pool_pages, num_heads, page_size, hd), dtype),
-                jnp.zeros((pool_pages, num_heads, page_size, hd), dtype),
-            )
-            for _ in range(num_layers)
+            (_pool_zeros(), _pool_zeros()) for _ in range(num_layers)
         ]
         # page 0 is the permanent DUMP page, never allocated: the step
         # program runs EVERY slot (static shapes), and an idle slot's
@@ -650,12 +745,21 @@ class PagedContinuousBatcher(_TracedBatcher):
         # in-program, and the host syncs tokens at most once per
         # iteration — one step LATE when ``pipeline_decode`` is on, so
         # host bookkeeping overlaps device compute
-        self._tables_dev = jnp.zeros((slots, self.max_pages), jnp.int32)
-        self._pos_dev = jnp.zeros((slots,), jnp.int32)
-        self._last_dev = jnp.zeros((slots,), jnp.int32)
-        self._active_dev = jnp.zeros((slots,), bool)
-        self._remaining_dev = jnp.zeros((slots,), jnp.int32)
-        self._counts_dev = jnp.zeros((slots,), jnp.int32)
+        def _repl_dev(a):
+            # under a mesh, the loop state is REPLICATED-committed so
+            # every head-shard chains the same tables/masks and eager
+            # admission updates keep the placement
+            return a if self._repl is None else jax.device_put(a, self._repl)
+
+        self._repl_dev = _repl_dev
+        self._tables_dev = _repl_dev(
+            jnp.zeros((slots, self.max_pages), jnp.int32)
+        )
+        self._pos_dev = _repl_dev(jnp.zeros((slots,), jnp.int32))
+        self._last_dev = _repl_dev(jnp.zeros((slots,), jnp.int32))
+        self._active_dev = _repl_dev(jnp.zeros((slots,), bool))
+        self._remaining_dev = _repl_dev(jnp.zeros((slots,), jnp.int32))
+        self._counts_dev = _repl_dev(jnp.zeros((slots,), jnp.int32))
         self.pipeline_decode = pipeline_decode
         self._inflight: deque = deque()
         self._sync_wait_s = 0.0
@@ -672,11 +776,21 @@ class PagedContinuousBatcher(_TracedBatcher):
         self._station = init_caches(
             station_slots, num_layers, num_heads, hidden, prompt_pad, dtype
         )
+        if mesh is not None:
+            # the station's dense (slots, rows, heads, hd) caches shard
+            # their heads dim like the pool, so chunk prefill and the
+            # page scatter/gather stay shard-local end to end
+            st_sh = NamedSharding(mesh, dense_cache_spec())
+            self._station = [
+                (jax.device_put(ck, st_sh), jax.device_put(cv, st_sh))
+                for ck, cv in self._station
+            ]
         self._jobs: "OrderedDict[int, _PrefillJob]" = OrderedDict()
+        # each queued entry CARRIES its own prefix chain keys (computed
+        # at submit): a seq_id may legally be queued twice — keys living
+        # on the entry, not in a per-id map, means the two admissions
+        # can never alias each other's content hashes
         self._pending: deque = deque()
-        # prefix keys memoized for the deferred FIFO head (see
-        # _try_begin_admit); entries die on admission or cancel
-        self._pending_keys: Dict[int, List[bytes]] = {}
         self._reset_stats()
         # per-request sampling state (the dense batcher's exact recipe:
         # fold_in(fold_in(seed, seq_id), nth-token) keys, 0 = greedy)
@@ -687,8 +801,93 @@ class PagedContinuousBatcher(_TracedBatcher):
         self.top_k = top_k
         self._root_key = jax.random.PRNGKey(seed)
         # device-resident, admission-updated (the dense batcher's pattern)
-        self._temps = jnp.zeros((slots,), jnp.float32)
-        self._base_keys = jnp.zeros((slots, 2), jnp.uint32)
+        self._temps = _repl_dev(jnp.zeros((slots,), jnp.float32))
+        self._base_keys = _repl_dev(jnp.zeros((slots, 2), jnp.uint32))
+        # in-program sharding PINS for the mesh case: every hot program
+        # constrains its outputs to the layouts its inputs were placed
+        # with (pools/station/ring head-sharded, loop state replicated).
+        # Without the pins GSPMD is free to hand outputs back in
+        # whatever sharding propagation chose, and the NEXT dispatch —
+        # jit caches on input shardings — would mint a second compile
+        # (the per-width one-entry-per-program compile-stability test
+        # pins this down), or worse, quietly replicate the pool.
+        if mesh is not None:
+            _pool_sh = NamedSharding(mesh, paged_pool_spec())
+            _dense_sh = NamedSharding(mesh, dense_cache_spec())
+            _repl_sh = self._repl
+
+            def _pin_state(*xs):
+                out = tuple(
+                    jax.lax.with_sharding_constraint(x, _repl_sh)
+                    for x in xs
+                )
+                return out if len(out) > 1 else out[0]
+
+            def _pin_kv(caches, dense=False):
+                sh = _dense_sh if dense else _pool_sh
+                return [
+                    (
+                        jax.lax.with_sharding_constraint(k_, sh),
+                        jax.lax.with_sharding_constraint(v_, sh),
+                    )
+                    for k_, v_ in caches
+                ]
+        else:
+            def _pin_state(*xs):
+                return xs if len(xs) > 1 else xs[0]
+
+            def _pin_kv(caches, dense=False):
+                return caches
+
+        self._pin_state, self._pin_kv = _pin_state, _pin_kv
+        # tensor-parallel accounting constants: the Megatron discipline
+        # costs ONE all-reduce after each row-parallel matmul (o_proj and
+        # mlp_down — two per block), payload = the block's activations.
+        # These per-program wire-byte models feed the ledger's
+        # per-iteration collective counter; shard-local traffic (pool
+        # writes, page moves, the kernels) is zero by construction.
+        dsize = jnp.dtype(dtype).itemsize
+        self._step_psum_bytes = tp_all_reduce_wire_bytes(
+            self.tp, 2 * num_layers * slots * hidden * dsize
+        )
+        if speculate_k is not None:
+            self._spec_psum_bytes = tp_all_reduce_wire_bytes(
+                self.tp,
+                2 * draft_num_layers * slots * draft_hidden * dsize
+                * (speculate_k + 1)
+                + 2 * num_layers * slots * (speculate_k + 1) * hidden
+                * dsize,
+            )
+            self._admit_psum_bytes = tp_all_reduce_wire_bytes(
+                self.tp,
+                2 * draft_num_layers * prompt_pad * draft_hidden * dsize,
+            )
+        else:
+            self._spec_psum_bytes = 0
+            self._admit_psum_bytes = 0
+        self._chunk_psum_bytes = tp_all_reduce_wire_bytes(
+            self.tp,
+            2 * num_layers * station_slots * page_size * hidden * dsize,
+        )
+        # the pool's resting bytes per DEVICE: heads shard 1/tp of every
+        # page, so per-device page economy is the aggregate divided by tp
+        self._pool_bytes_per_device = (
+            2 * num_layers * pool_pages * num_heads * page_size * hd * dsize
+            // self.tp
+        )
+        self._step_collective_bytes = 0
+        # both TP gauges are construction CONSTANTS — set once here, off
+        # the per-step path (the serve_draft_cache_rows discipline); a
+        # registry attached after construction gets them from the first
+        # ledger record, flag-guarded
+        self._tp_gauges_set = False
+        if metrics is not None:
+            metrics.set_gauge("serve_tp_devices", float(self.tp))
+            metrics.set_gauge(
+                "serve_tp_pool_bytes_per_device",
+                float(self._pool_bytes_per_device),
+            )
+            self._tp_gauges_set = True
 
         from kubegpu_tpu.models.decoding import pick_tokens
 
@@ -722,8 +921,12 @@ class PagedContinuousBatcher(_TracedBatcher):
             new_last = jnp.where(active, toks, last_tokens)
             new_pos = pos + act
             new_counts = counts + act
-            return (toks, pools, new_last, new_pos, new_active, new_rem,
-                    new_counts)
+            (toks, new_last, new_pos, new_active, new_rem, new_counts) = (
+                _pin_state(toks, new_last, new_pos, new_active, new_rem,
+                           new_counts)
+            )
+            return (toks, _pin_kv(pools), new_last, new_pos, new_active,
+                    new_rem, new_counts)
 
         self._step = jax.jit(step, donate_argnums=(1,))
 
@@ -750,7 +953,7 @@ class PagedContinuousBatcher(_TracedBatcher):
             self.verify_model = PagedDecodeLM(
                 vocab_size=vocab_size, num_layers=num_layers,
                 num_heads=num_heads, hidden=hidden, max_seq=max_seq,
-                dtype=dtype, quant=quant, all_logits=True,
+                dtype=dtype, quant=quant, all_logits=True, mesh=mesh,
             )
             # dense per-slot draft RING: slots x draft_window rows (was
             # slots x max_seq — the dense memory shape speculation was
@@ -764,8 +967,15 @@ class PagedContinuousBatcher(_TracedBatcher):
                 slots, draft_num_layers, draft_num_heads, draft_hidden,
                 ring, dtype,
             )
+            if mesh is not None:
+                # the draft ring shards its heads dim like the pool
+                d_sh = NamedSharding(mesh, dense_cache_spec())
+                self.d_caches = [
+                    (jax.device_put(ck, d_sh), jax.device_put(cv, d_sh))
+                    for ck, cv in self.d_caches
+                ]
             self._d_pos = np.zeros((slots,), np.int32)   # host mirror
-            self._d_pos_dev = jnp.zeros((slots,), jnp.int32)
+            self._d_pos_dev = _repl_dev(jnp.zeros((slots,), jnp.int32))
             # the ring's memory shape (rows, not bytes) is a CONSTANT
             # of the construction — set the gauge ONCE, not per
             # serve_step (the paged-draft-cache follow-on's
@@ -819,7 +1029,10 @@ class PagedContinuousBatcher(_TracedBatcher):
                     d_step, (d_caches, last, d_pos_w), None,
                     length=k_spec + 1
                 )
-                return proposed.T[:, :k_spec], d_caches, d_pos_w, wrap
+                prop, d_pos_w, wrap = _pin_state(
+                    proposed.T[:, :k_spec], d_pos_w, wrap
+                )
+                return prop, _pin_kv(d_caches, dense=True), d_pos_w, wrap
 
             self._spec_draft = jax.jit(spec_draft, donate_argnums=(1,))
 
@@ -879,8 +1092,13 @@ class PagedContinuousBatcher(_TracedBatcher):
                 new_last = jnp.where(active, next_last, last)
                 new_pos = pos + emit_len * act
                 new_d_pos = d_pos + emit_len * act
-                return (choices, emit_len, pools, new_last, new_pos,
-                        new_d_pos, new_active, new_rem)
+                (choices, emit_len, new_last, new_pos, new_d_pos,
+                 new_active, new_rem) = _pin_state(
+                    choices, emit_len, new_last, new_pos, new_d_pos,
+                    new_active, new_rem,
+                )
+                return (choices, emit_len, _pin_kv(pools), new_last,
+                        new_pos, new_d_pos, new_active, new_rem)
 
             self._spec_verify = jax.jit(spec_verify, donate_argnums=(1,))
 
@@ -912,7 +1130,7 @@ class PagedContinuousBatcher(_TracedBatcher):
                             cv, fv, (slot, 0, 0, 0)
                         ),
                     ))
-                return out
+                return _pin_kv(out, dense=True)
 
             self._draft_admit = jax.jit(draft_admit, donate_argnums=(1,))
 
@@ -957,7 +1175,7 @@ class PagedContinuousBatcher(_TracedBatcher):
                     merge(ok, nk, starts, mask),
                     merge(ov, nv, starts, mask),
                 ))
-            return merged
+            return _pin_kv(merged, dense=True)
 
         self._chunk = jax.jit(chunk, donate_argnums=(1,))
 
@@ -996,6 +1214,7 @@ class PagedContinuousBatcher(_TracedBatcher):
     def _build_write_pages(self, width: int):
         page = self.page
         pad = self.prompt_pad
+        pin_kv = self._pin_kv
 
         def write_pages(pools, station, slot, phys_vec, base_row):
             # scatter `width` consecutive completed station pages (the
@@ -1015,13 +1234,14 @@ class PagedContinuousBatcher(_TracedBatcher):
                 out.append((
                     kp.at[phys_vec].set(bk), vp.at[phys_vec].set(bv)
                 ))
-            return out
+            return pin_kv(out)
 
         return jax.jit(write_pages, donate_argnums=(0,))
 
     def _build_gather_pages(self, width: int):
         page = self.page
         n_rows = width * page
+        pin_kv = self._pin_kv
 
         def gather_pages(station, pools, slot, phys_vec, n_valid):
             # the reverse copy: a prefix-cache HIT's first n_valid pages
@@ -1054,7 +1274,7 @@ class PagedContinuousBatcher(_TracedBatcher):
                     (slot, 0, 0, 0),
                 )
                 out.append((ck, cv))
-            return out
+            return pin_kv(out, dense=True)
 
         return jax.jit(gather_pages, donate_argnums=(0,))
 
@@ -1201,6 +1421,41 @@ class PagedContinuousBatcher(_TracedBatcher):
                         f"page {p} sealed as decode with "
                         f"decode_page_cache={self.decode_page_cache!r}"
                     )
+        if self.mesh is not None:
+            # the sharded-pool leg: under TP the invariant above is
+            # mesh-WIDE (tables replicate, every page spans all shards)
+            # and only holds the capacity story if the pool is still
+            # RESTING head-sharded — a program whose output sharding
+            # drifted to replicated would silently cost tp x the
+            # per-device bytes the page math promises.  The station and
+            # draft ring carry the same layout.
+            pool_want = NamedSharding(self.mesh, paged_pool_spec())
+            dense_want = NamedSharding(self.mesh, dense_cache_spec())
+            for li, (kp, vp) in enumerate(self.pools):
+                for nm, arr in (("k", kp), ("v", vp)):
+                    assert arr.sharding.is_equivalent_to(
+                        pool_want, arr.ndim
+                    ), (
+                        f"layer {li} {nm}_pool lost its head-sharding: "
+                        f"{arr.sharding}"
+                    )
+            for li, (ck, cv) in enumerate(self._station):
+                for nm, arr in (("k", ck), ("v", cv)):
+                    assert arr.sharding.is_equivalent_to(
+                        dense_want, arr.ndim
+                    ), (
+                        f"station layer {li} {nm} lost its "
+                        f"head-sharding: {arr.sharding}"
+                    )
+            if self.speculate_k is not None:
+                for li, (ck, cv) in enumerate(self.d_caches):
+                    for nm, arr in (("k", ck), ("v", cv)):
+                        assert arr.sharding.is_equivalent_to(
+                            dense_want, arr.ndim
+                        ), (
+                            f"draft ring layer {li} {nm} lost its "
+                            f"head-sharding: {arr.sharding}"
+                        )
 
     def _trace_holders(self):
         return self._seqs
@@ -1232,36 +1487,27 @@ class PagedContinuousBatcher(_TracedBatcher):
 
     def _try_begin_admit(self, slot: int, seq_id: int, prompt: np.ndarray,
                          max_new: int, temperature: float,
-                         submitted_at: float) -> bool:
+                         submitted_at: float,
+                         keys: Optional[List[bytes]] = None) -> bool:
         """Reserve pages (prefix-cache hits first), gather hit pages into
         a free station slot, and open the prefill job.  Returns False to
         defer (pool pressure, or an in-flight admission is already
-        prefilling this prompt's shared prefix) with no state changed."""
+        prefilling this prompt's shared prefix) with no state changed.
+        ``keys`` are the prompt's prefix chain keys, computed at SUBMIT
+        (the hot-path lint in tests/test_decode_pipeline.py keeps
+        content digesting off the serving loop): a head deferred on pool
+        pressure retries every sweep, and each retry re-runs only the
+        cheap cache lookups below, never a digest walk."""
         plen = self._validate(prompt, max_new)  # max_new > 0: _sweep
         s = self._seqs[slot]                    # handles zero-budget admits
         need = self._pages_for(plen, max_new)
         # sharable pages: FULL prompt pages strictly below row plen-1 —
         # the page holding the last prompt row takes the first decode
-        # write (the re-run of row plen-1), so it must stay private
-        n_sharable = (plen - 1) // self.page
-        keys: List[bytes] = []
+        # write (the re-run of row plen-1), so it must stay private;
+        # their chain keys were computed at submit (one per such page)
+        keys = keys or []
         hits: List[int] = []
         if self.prefix_cache is not None:
-            # chain the hash: one update per page, snapshot the digest at
-            # each boundary — linear in plen, same keys as hashing each
-            # prefix from scratch.  Memoized per seq_id: a head deferred
-            # on pool pressure retries every sweep, and its prompt never
-            # changes while queued (only the cheap lookups re-run).
-            keys = self._pending_keys.get(seq_id)
-            if keys is None:
-                h = hashlib.sha256()
-                keys = []
-                for j in range(n_sharable):
-                    h.update(
-                        prompt[j * self.page: (j + 1) * self.page].tobytes()
-                    )
-                    keys.append(h.copy().digest())
-                self._pending_keys[seq_id] = keys
             for key in keys:  # probe the unbroken hit prefix
                 page = self.prefix_cache.lookup(key)
                 if page is None:
@@ -1281,7 +1527,6 @@ class PagedContinuousBatcher(_TracedBatcher):
                     return False
         if need - len(hits) > self._available_pages(set(hits)):
             return False  # defer until retirements/evictions free pages
-        self._pending_keys.pop(seq_id, None)
         tr = self._traces.pop(seq_id, None)
         if tr is not None:
             # the queue phase ends at admission commit (pool + station
@@ -1439,6 +1684,7 @@ class PagedContinuousBatcher(_TracedBatcher):
                 self.draft_params, self.d_caches, jnp.asarray(row),
                 jnp.int32(slot),
             )
+            self._step_collective_bytes += self._admit_psum_bytes
             self._d_pos[slot] = job.plen - 1
             self._d_pos_dev = self._d_pos_dev.at[slot].set(job.plen - 1)
         s.prefilling, s.active = False, True
@@ -1510,6 +1756,7 @@ class PagedContinuousBatcher(_TracedBatcher):
                     jnp.asarray(starts), jnp.asarray(mask),
                 )
                 t1 = time.monotonic()
+                self._step_collective_bytes += self._chunk_psum_bytes
                 for st, job, end in picked:
                     if not job.started:
                         job.started = True
@@ -1575,12 +1822,27 @@ class PagedContinuousBatcher(_TracedBatcher):
             )
         prompt = np.asarray(prompt, np.int32)
         plen = self._validate(prompt, max_new)
-        # a reused seq_id binds to a NEW prompt: any memoized prefix keys
-        # from a deferred-then-abandoned admission are stale now
-        self._pending_keys.pop(seq_id, None)
+        keys: List[bytes] = []
+        if self.prefix_cache is not None and max_new > 0:
+            # prefix-chain content hashing happens HERE, at submit — one
+            # sha256 update per sharable page, digest snapshotted at each
+            # boundary (identical keys to hashing every prefix from
+            # scratch, linear in plen) — so the serving loop's admission
+            # probe is pure cache lookups.  The keys ride the pending
+            # ENTRY itself: a seq_id queued twice (the supported
+            # resubmit-while-queued flow) gives each admission its own
+            # keys — a shared per-id memo would let the second submit's
+            # prompt poison the first admission's chain hashes.
+            n_sharable = (plen - 1) // self.page
+            h = hashlib.sha256()
+            for j in range(n_sharable):
+                h.update(
+                    prompt[j * self.page: (j + 1) * self.page].tobytes()
+                )
+                keys.append(h.copy().digest())
         self._trace_begin(seq_id, plen, max_new, trace)
         self._pending.append(
-            (seq_id, prompt, max_new, temperature, time.monotonic())
+            (seq_id, prompt, max_new, temperature, time.monotonic(), keys)
         )
 
     def cancel(self, seq_id: int) -> bool:
@@ -1595,8 +1857,7 @@ class PagedContinuousBatcher(_TracedBatcher):
         request is unknown."""
         for i, item in enumerate(self._pending):
             if item[0] == seq_id:
-                del self._pending[i]
-                self._pending_keys.pop(seq_id, None)
+                del self._pending[i]  # its chain keys die with the entry
                 self._trace_retire_queued(seq_id, "cancelled")
                 return True
         for i, s in enumerate(self._seqs):
@@ -1722,6 +1983,7 @@ class PagedContinuousBatcher(_TracedBatcher):
         trace-phase decomposition — keeps sync-mode semantics."""
         t_begin = time.monotonic()
         self._sync_wait_s = 0.0
+        self._step_collective_bytes = 0
         finished: Dict[int, List[int]] = {}
         spec_emitted = 0
         self._sweep(finished)
@@ -1809,6 +2071,7 @@ class PagedContinuousBatcher(_TracedBatcher):
             )
         )
         self.stats["steps"] += 1
+        self._step_collective_bytes += self._step_psum_bytes
         self._inflight.append(_Inflight(kind="step", cand=cand, toks=toks))
 
     def _dispatch_spec(self) -> None:
@@ -1852,6 +2115,7 @@ class PagedContinuousBatcher(_TracedBatcher):
         tv1 = time.monotonic()
         self.stats["steps"] += 1
         self.stats["spec_steps"] += 1
+        self._step_collective_bytes += self._spec_psum_bytes
         self._inflight.append(_Inflight(
             kind="spec", cand=cand, choices=choices, emit=emit_len,
             wrapped=wrapped, td0=td0, tv0=tv0, tv1=tv1,
@@ -1998,6 +2262,14 @@ class PagedContinuousBatcher(_TracedBatcher):
             "spec_tokens": spec_emitted,
             "host_ms": round(host_s * 1e3, 3),
             "device_ms": round(device_s * 1e3, 3),
+            # tensor-parallel economy: page COUNTS above are mesh-wide
+            # aggregates (tables are replicated, a page spans every
+            # shard); the per-DEVICE view is the byte column — each
+            # device rests 1/tp of the pool — plus this iteration's
+            # modeled all-reduce wire bytes per device
+            "tp": self.tp,
+            "collective_bytes": self._step_collective_bytes,
+            "pool_bytes_per_device": self._pool_bytes_per_device,
         }
         self._ledger.append(row)
         if self.metrics is not None:
@@ -2021,6 +2293,24 @@ class PagedContinuousBatcher(_TracedBatcher):
                 "serve_pool_pages_live", float(row["pages_live"])
             )
             self.metrics.set_gauge("serve_pool_pages_cached", float(cached))
+            # the serve_pool_pages_* gauges are AGGREGATE (mesh-wide)
+            # page counts under TP too — consistent across widths
+            # because tables replicate; the per-device half of the
+            # economy is bytes, which shard 1/tp.  Both TP gauges are
+            # construction constants — set once (late-attached
+            # registries get them here, flag-guarded)
+            if not self._tp_gauges_set:
+                self.metrics.set_gauge("serve_tp_devices", float(self.tp))
+                self.metrics.set_gauge(
+                    "serve_tp_pool_bytes_per_device",
+                    float(self._pool_bytes_per_device),
+                )
+                self._tp_gauges_set = True
+            if self._step_collective_bytes:
+                self.metrics.inc(
+                    "serve_tp_collective_bytes_total",
+                    self._step_collective_bytes,
+                )
 
     def ledger_rows(self, limit: Optional[int] = None) -> List[dict]:
         """The most recent ledger rows (oldest first), up to ``limit``

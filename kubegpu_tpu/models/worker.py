@@ -503,6 +503,12 @@ def _run_decode_batched(args, params, max_seq: int, t0: float) -> int:
         hidden=args.hidden, max_seq=max_seq,
         slots=args.batch_per_chip, prompt_pad=args.prompt_len,
     )
+    if args.tp > 1 and args.serving != "paged":
+        raise SystemExit(
+            f"--tp {args.tp} with --serving {args.serving}: tensor-"
+            "parallel serving is the PAGED batcher's mesh (--serving "
+            "paged); dense/speculative batchers are single-device"
+        )
     if args.serving == "continuous":
         from kubegpu_tpu.models.serving import ContinuousBatcher
 
@@ -528,11 +534,55 @@ def _run_decode_batched(args, params, max_seq: int, t0: float) -> int:
         # it divides, else one page spans the whole prompt pad
         page = 128 if args.prompt_len % 128 == 0 else args.prompt_len
         slots = args.batch_per_chip
+        mesh = None
+        if args.tp > 1:
+            # tensor-parallel paged serving: shard the KV page pool, the
+            # station and the draft ring over an ICI "model" mesh — tp x
+            # the pool rows (and sessions) per replica for the same
+            # per-device HBM, TP-scaled per-token FLOPs.  The replica
+            # ADVERTISES its mesh via the SERVING_TP line (and its
+            # ledger's tp column at /debug/trace upstream).
+            import jax
+
+            from kubegpu_tpu.parallel import device_mesh
+
+            n = jax.device_count()
+            if args.tp > n:
+                raise SystemExit(
+                    f"--tp {args.tp} exceeds the visible device count {n}"
+                )
+            if args.heads % args.tp:
+                raise SystemExit(
+                    f"--heads {args.heads} not divisible by tp={args.tp}"
+                )
+            if args.vocab % args.tp:
+                raise SystemExit(
+                    f"--vocab {args.vocab} not divisible by tp={args.tp} "
+                    "(lm_head is column-parallel over the vocab)"
+                )
+            mesh = device_mesh(
+                {"model": args.tp}, devices=jax.devices()[: args.tp]
+            )
+            print(
+                f"SERVING_TP tp={args.tp} devices="
+                + ",".join(str(d.id) for d in mesh.devices.flat),
+                flush=True,
+            )
         spec_kw = {}
         k_extra = 0
         if args.speculate:
             # _draft_for enforces the k-row cache-headroom bound
             dparams, d_heads, d_hidden = _draft_for(args, max_seq)
+            if args.tp > 1 and d_heads % args.tp:
+                # crisp like the other CLI geometry checks — the draft
+                # ring shards whole heads too, and the DERIVED head
+                # count (draft_hidden // 128) is what must divide
+                raise SystemExit(
+                    f"draft head count {d_heads} (derived from "
+                    f"--draft-hidden {d_hidden} // 128) not divisible "
+                    f"by tp={args.tp}: pick --draft-hidden = a multiple "
+                    f"of {128 * args.tp}"
+                )
             spec_kw = dict(
                 draft_params=dparams, speculate_k=args.spec_k,
                 draft_num_layers=args.draft_layers,
@@ -545,7 +595,7 @@ def _run_decode_batched(args, params, max_seq: int, t0: float) -> int:
         cb = PagedContinuousBatcher(
             params, **common, quant=args.int8, page_size=page,
             pool_pages=pool, decode_page_cache=args.decode_page_cache,
-            **spec_kw,
+            mesh=mesh, **spec_kw,
         )
 
     rng = np.random.RandomState(0)
@@ -606,6 +656,11 @@ def _run_decode(args, t0: float) -> int:
 
     max_seq = args.seq + 1  # the lm family trains seq+1 windows; pos_embed
     # (and therefore any restored checkpoint) is sized to it
+    if args.serving == "static" and args.tp > 1:
+        raise SystemExit(
+            f"--tp {args.tp} with --serving static: tensor-parallel "
+            "serving is the paged batcher's mesh (--serving paged)"
+        )
     if args.prompt_len + args.steps > max_seq:
         raise SystemExit(
             f"--prompt-len {args.prompt_len} + --steps {args.steps} exceeds "
@@ -736,7 +791,10 @@ def main(argv=None) -> int:
     ap.add_argument("--tp", type=int, default=0,
                     help="tensor-parallel size — lm: 0 = all devices; "
                     "moe: 0 = no TP (EP only), N > 1 Megatron-shards each "
-                    "expert's FFN over N devices")
+                    "expert's FFN over N devices; decode --serving paged: "
+                    "N > 1 shards the KV page pool / station / draft ring "
+                    "on heads over an N-device 'model' mesh (N x pool "
+                    "rows per replica for the same per-device HBM)")
     ap.add_argument("--cp", type=int, default=0,
                     help="lm-cp: context-parallel size (0 = all devices)")
     ap.add_argument("--ep", type=int, default=0,

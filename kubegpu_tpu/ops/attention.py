@@ -37,6 +37,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kubegpu_tpu.parallel.sharding import shard_map_compat
+
 NEG_INF = float("-inf")
 
 
@@ -168,7 +170,7 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     qf, kf, vf = fold(q), fold(k), fold(v)
     # under shard_map with vma checking, pallas outputs must declare which
     # mesh axes they vary over: the join of the inputs'
-    vma = jax.typeof(qf).vma | jax.typeof(kf).vma | jax.typeof(vf).vma
+    vma = _vma_join(qf, kf, vf)
     grid = (b * h, sqp // block_q, skp // block_k)
     kernel = functools.partial(
         _flash_kernel, sm_scale=sm_scale, causal=causal,
@@ -187,10 +189,8 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, 1, 8, block_q), lambda bh, qi, ki: (bh, qi, 0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, sqp, d), q.dtype, vma=vma),
-            jax.ShapeDtypeStruct(
-                (b * h, sqp // block_q, 8, block_q), jnp.float32, vma=vma
-            ),
+            _sds((b * h, sqp, d), q.dtype, vma),
+            _sds((b * h, sqp // block_q, 8, block_q), jnp.float32, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),   # running max (lane-replicated)
@@ -351,8 +351,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
 
     bh = b * h
     nq, nk = sqp // block_q, skp // block_k
-    vma = (jax.typeof(qf).vma | jax.typeof(kf).vma | jax.typeof(vf).vma
-           | jax.typeof(dof).vma | jax.typeof(of).vma | jax.typeof(lse).vma)
+    vma = _vma_join(qf, kf, vf, dof, of, lse)
     qspec3 = pl.BlockSpec((1, block_q, d), lambda bhi, ki, qi: (bhi, qi, 0))
     lspec = pl.BlockSpec((1, 1, 8, block_q), lambda bhi, ki, qi: (bhi, qi, 0, 0))
     kspec3 = pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0))
@@ -368,8 +367,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, skp, d), k.dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh, skp, d), v.dtype, vma=vma),
+            _sds((bh, skp, d), k.dtype, vma),
+            _sds((bh, skp, d), v.dtype, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -393,7 +392,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sqp, d), q.dtype, vma=vma),
+        out_shape=_sds((bh, sqp, d), q.dtype, vma),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(qf, dof, of, lse, kf, vf)
@@ -455,6 +454,28 @@ def _lse_pack(dense, block_q):
     b, s, h = dense.shape
     x = dense.transpose(0, 2, 1).reshape(b * h, s // block_q, 1, block_q)
     return jnp.broadcast_to(x, (b * h, s // block_q, 8, block_q))
+
+
+def _vma_join(*arrays):
+    """The union of the arrays' shard_map varying-axes (vma) types, or
+    None on jax versions without vma typing (0.4.x — where
+    ``shard_map_compat`` disables replication checking, so no
+    declaration is needed)."""
+    tof = getattr(jax, "typeof", None)
+    if tof is None:
+        return None
+    vma = frozenset()
+    for a in arrays:
+        vma = vma | tof(a).vma
+    return vma
+
+
+def _sds(shape, dtype, vma):
+    """``jax.ShapeDtypeStruct`` carrying a vma declaration when the
+    running jax supports one (``_vma_join`` returned a set)."""
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
 
 
 def _stamp(x, *refs):
@@ -731,7 +752,7 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, axis: str, causal: bool = True,
     ring only ever talks along `axis`; attention is independent per batch
     row AND per head, so the other shards never communicate."""
     spec = P(batch_axis, axis, heads_axis, None)
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         functools.partial(ring_attention, axis_name=axis, causal=causal,
                           impl=impl),
         mesh=mesh,
@@ -803,7 +824,7 @@ def ulysses_attention_sharded(q, k, v, mesh: Mesh, axis: str,
     still divide by the seq axis — ulysses' head-scatter works on the
     local head set)."""
     spec = P(batch_axis, axis, heads_axis, None)
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         functools.partial(
             ulysses_attention, axis_name=axis, causal=causal, use_flash=use_flash
         ),

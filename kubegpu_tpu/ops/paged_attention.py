@@ -44,6 +44,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubegpu_tpu.parallel.sharding import MODEL_AXIS, shard_map_compat
 
 NEG_INF = float("-inf")
 
@@ -202,6 +205,69 @@ def paged_decode_attention(
         out_shape=jax.ShapeDtypeStruct((b, h, hd), q.dtype),
         interpret=interpret,
     )(page_table.astype(jnp.int32), lengths.astype(jnp.int32), q, k_pool, v_pool)
+
+
+# ---------------------------------------------------------------------------
+# Tensor-parallel wrappers: heads sharded over the mesh's "model" axis.
+#
+# Every head's online-softmax walk is independent — no cross-head math
+# anywhere in the kernels — so head-sharding is EXACT parallelism: each
+# device runs the ordinary kernel on its h/tp local heads against its
+# local 1/tp of every pool page's bytes, with the page table and lengths
+# replicated.  No collective is issued here at all; the one all-reduce
+# per transformer block lives in the row-parallel o_proj matmul that
+# consumes this output (the Megatron discipline, parallel/sharding.py).
+# GSPMD cannot partition a pallas_call on its own (it would replicate
+# the POOL — the exact memory win paging exists for), hence shard_map.
+# ---------------------------------------------------------------------------
+
+def paged_decode_attention_sharded(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    page_table: jax.Array,
+    lengths: jax.Array,
+    mesh: Mesh,
+    axis: str = MODEL_AXIS,
+) -> jax.Array:
+    """``paged_decode_attention`` with the heads dim sharded over
+    ``axis``: q (b, h, hd) and the pools (P, h, page, hd) carry h/tp
+    local heads per device; table/lengths replicate.  Byte-identical to
+    the unsharded kernel (per-head math is untouched)."""
+    fn = shard_map_compat(
+        paged_decode_attention,
+        mesh,
+        in_specs=(
+            P(None, axis, None), P(None, axis, None, None),
+            P(None, axis, None, None), P(None, None), P(None),
+        ),
+        out_specs=P(None, axis, None),
+    )
+    return fn(q, k_pool, v_pool, page_table, lengths)
+
+
+def paged_chunk_attention_sharded(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    page_table: jax.Array,
+    lengths: jax.Array,
+    mesh: Mesh,
+    axis: str = MODEL_AXIS,
+) -> jax.Array:
+    """``paged_chunk_attention`` (the speculative-verify multi-query
+    kernel) head-sharded over ``axis``; same contract as the decode
+    wrapper with q (b, L, h, hd)."""
+    fn = shard_map_compat(
+        paged_chunk_attention,
+        mesh,
+        in_specs=(
+            P(None, None, axis, None), P(None, axis, None, None),
+            P(None, axis, None, None), P(None, None), P(None),
+        ),
+        out_specs=P(None, None, axis, None),
+    )
+    return fn(q, k_pool, v_pool, page_table, lengths)
 
 
 def _paged_chunk_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,

@@ -40,6 +40,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kubegpu_tpu.parallel.sharding import pvary_compat, shard_map_compat
+
 PIPE_AXIS = "pipe"
 
 
@@ -127,7 +129,7 @@ def pipeline_apply(
         # carries vary over the pipe axis (they depend on axis_index);
         # mark the invariant zero-inits so scan's carry types match
         recv0, buf0 = (
-            lax.pcast(z, (axis,), to="varying")
+            pvary_compat(z, axis)
             for z in (jnp.zeros_like(stream[0]), jnp.zeros_like(stream))
         )
         (_, out_buf), _ = lax.scan(tick, (recv0, buf0), jnp.arange(ticks))
@@ -137,7 +139,7 @@ def pipeline_apply(
             axis,
         )
 
-    mapped = jax.shard_map(
+    mapped = shard_map_compat(
         per_device, mesh=mesh,
         in_specs=(P(axis) if params_specs is None else params_specs, P()),
         out_specs=P(),
@@ -216,7 +218,7 @@ def _circular_apply(stage_fn, mesh: Mesh, axis: str, num_rounds: int):
             return (sent, buf, out_buf), None
 
         recv0, buf0, out0 = (
-            lax.pcast(z, (axis,), to="varying")
+            pvary_compat(z, axis)
             for z in (
                 jnp.zeros_like(stream[0]),
                 jnp.zeros_like(stream),
@@ -229,7 +231,7 @@ def _circular_apply(stage_fn, mesh: Mesh, axis: str, num_rounds: int):
             axis,
         )
 
-    mapped = jax.shard_map(
+    mapped = shard_map_compat(
         per_device, mesh=mesh, in_specs=(P(None, axis), P()), out_specs=P()
     )
 

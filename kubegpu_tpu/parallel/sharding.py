@@ -189,6 +189,84 @@ def param_shardings(
     return jax.tree_util.tree_map_with_path(spec_of, params)
 
 
+def shard_map_compat(f, mesh: Mesh, in_specs, out_specs):
+    """``jax.shard_map`` across the API churn: the top-level name (newer
+    jax) when present, else the 0.4.x ``jax.experimental.shard_map``
+    module.  Replication checking is disabled either way — the serving
+    kernels this wraps are pallas calls, which carry no replication
+    rule, and their head-sharded specs are exact by construction (every
+    head's attention is independent)."""
+    sm = getattr(jax, "shard_map", None)
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+
+        return sm(f, check_rep=False, **kwargs)
+    for flag in ({"check_vma": False}, {"check_rep": False}, {}):
+        try:
+            return sm(f, **kwargs, **flag)
+        except TypeError:
+            continue
+    raise TypeError("no compatible shard_map signature found")
+
+
+def pvary_compat(x, axis: str):
+    """Mark ``x`` as varying over ``axis`` for shard_map's vma typing —
+    ``lax.pvary`` / ``lax.pcast`` where the running jax has them,
+    identity on 0.4.x (``shard_map_compat`` disables replication
+    checking there, so the marker is unneeded)."""
+    fn = getattr(jax.lax, "pvary", None)
+    if fn is not None:
+        return fn(x, (axis,))
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is not None:
+        return fn(x, (axis,), to="varying")
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Tensor-parallel paged serving (models/paging.py): the KV page pool,
+# the dense prefill station and the draft ring all shard their HEADS
+# axis over "model" — page tables / lengths / positions / active masks
+# stay replicated, so page accounting is mesh-wide while every device
+# holds 1/tp of each page's bytes (tp x the pool ROWS for the same
+# per-device memory budget).
+# ---------------------------------------------------------------------------
+
+def paged_pool_spec() -> P:
+    """(pool_pages, heads, page, head_dim): heads over MODEL_AXIS.
+    Written WITHOUT trailing Nones — jit normalizes output specs that
+    way, and its compile cache keys on spec EQUALITY, so an initial
+    placement spelled ``P(None, "model", None, None)`` would mint a
+    second compile the first time a program's output chains back in."""
+    return P(None, MODEL_AXIS)
+
+
+def dense_cache_spec() -> P:
+    """(slots, rows, heads, head_dim) — the station / draft-ring layout
+    (models/decoding.init_caches): heads over MODEL_AXIS.  Trailing
+    Nones omitted; see ``paged_pool_spec``."""
+    return P(None, None, MODEL_AXIS)
+
+
+def tp_size(mesh: Optional[Mesh]) -> int:
+    """The tensor-parallel width a mesh carries (1 without a mesh or
+    a "model" axis)."""
+    if mesh is None or MODEL_AXIS not in mesh.axis_names:
+        return 1
+    return int(mesh.shape[MODEL_AXIS])
+
+
+def tp_all_reduce_wire_bytes(tp: int, payload_bytes: int) -> int:
+    """Per-device wire traffic of one ring all-reduce of
+    ``payload_bytes``: 2*(tp-1)/tp of the payload (reduce-scatter +
+    all-gather), 0 at tp=1.  The serving ledger's collective-byte
+    counters use this as the per-psum cost model."""
+    if tp <= 1:
+        return 0
+    return int(2 * (tp - 1) * payload_bytes // tp)
+
+
 def constrain_seq_sharded(x: jax.Array) -> jax.Array:
     """Sequence-parallel residual/LN activations: [batch, seq, hidden]
     sharded (data, model, None) — batch composing over "dcn" on hybrid

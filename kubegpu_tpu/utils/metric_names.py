@@ -129,12 +129,30 @@ CATALOG: Dict[str, MetricSpec] = {
     "serve_step_device_ms": _g(
         (), "time the last serving iteration spent BLOCKED on the "
         "device token readback (near zero when pipelining hides it)"),
-    "serve_pool_pages_free": _g((), "KV pool pages on the free list"),
+    "serve_pool_pages_free": _g(
+        (), "KV pool pages on the free list (mesh-wide count under "
+        "tensor parallelism: tables replicate, a page spans every "
+        "shard)"),
     "serve_pool_pages_live": _g(
-        (), "KV pool pages privately held by live sequences"),
+        (), "KV pool pages privately held by live sequences (mesh-wide "
+        "count under tensor parallelism)"),
     "serve_pool_pages_cached": _g(
         (), "KV pool pages resident in the prefix cache (shared or "
-        "idle-evictable)"),
+        "idle-evictable; mesh-wide count under tensor parallelism)"),
+
+    # -- tensor-parallel serving (models/paging.py with a mesh): the
+    #    per-DEVICE half of the pool economy plus the collective traffic
+    #    the Megatron psums cost per iteration
+    "serve_tp_devices": _g(
+        (), "tensor-parallel width of the serving mesh (1 = unsharded)"),
+    "serve_tp_pool_bytes_per_device": _g(
+        (), "KV pool bytes RESTING per device (the aggregate pool "
+        "divided by the tensor-parallel width — heads shard 1/tp of "
+        "every page)"),
+    "serve_tp_collective_bytes_total": _c(
+        (), "modeled per-device all-reduce wire bytes of the serving "
+        "iterations' TP psums (2 per transformer block: o_proj + "
+        "mlp_down; ring cost 2*(tp-1)/tp of the payload; 0 at tp=1)"),
 }
 
 

@@ -96,6 +96,67 @@ def test_decode_hot_path_single_readback_point():
 
 
 # ---------------------------------------------------------------------------
+# Satellite (ISSUE 9): prefix-chain hashing lives at SUBMIT, not on the
+# serving loop's admission probe
+# ---------------------------------------------------------------------------
+
+def test_prefix_chain_hashing_off_the_admission_hot_path():
+    """Content hashing is O(prompt) sha256 work: it happens once at
+    ``submit`` (chunk-incrementally, digest snapshotted per page
+    boundary) and the serving loop's admission probe — which a deferred
+    FIFO head re-runs EVERY sweep — does pure dict lookups.  Same lint
+    pattern as the readback-point test above: hashing creeping back
+    into the sweep path is exactly the per-probe rehash this hoist
+    killed."""
+    for fn in (
+        PagedContinuousBatcher._try_begin_admit,
+        PagedContinuousBatcher._sweep,
+        PagedContinuousBatcher.serve_step,
+        PagedContinuousBatcher._advance_prefill,
+    ):
+        src = inspect.getsource(fn)
+        assert "sha256" not in src and "hashlib" not in src, (
+            f"{fn.__name__} grew prefix hashing back onto the serving "
+            "loop — it belongs in submit()"
+        )
+    submit_src = inspect.getsource(PagedContinuousBatcher.submit)
+    assert "sha256" in submit_src, (
+        "submit() no longer computes the prefix chain keys"
+    )
+    # retirement sealing keeps its own hash walk (it runs once per
+    # retiring sequence, not per probe)
+    assert "sha256" in inspect.getsource(
+        PagedContinuousBatcher._seal_finished_pages
+    )
+    # and behavior: a prompt submitted, cancelled from the queue, then
+    # resubmitted under the same seq_id still hits its prefix (the chain
+    # keys ride the pending entry, so they die and recompute with it)
+    params = trained_params()
+    cb = make_paged(params)
+    p = np.arange(9, dtype=np.int32) % 7
+    out1 = cb.run([p], [3])[0]
+    cb.submit(5, p, 3)
+    cb.cancel(5)
+    cb.submit(5, p, 3)
+    done = {}
+    while cb.has_work():
+        done.update(cb.serve_step())
+    assert done[5] == out1
+    assert cb.stats["prefix_hit_tokens"] > 0
+    cb.assert_page_accounting()
+    # a seq_id queued TWICE (resubmit-while-queued, the supported
+    # duplicate flow) must not crash or cross-wire chain keys: each
+    # entry owns its own keys, both admissions serve
+    cb.submit(7, p, 3)
+    cb.submit(7, p, 3)
+    done = {}
+    while cb.has_work():
+        done.update(cb.serve_step())
+    assert done[7] == out1
+    cb.assert_page_accounting()
+
+
+# ---------------------------------------------------------------------------
 # Satellite: the draft-ring gauge is set once, at construction
 # ---------------------------------------------------------------------------
 
