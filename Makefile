@@ -45,17 +45,20 @@ bench:
 # baseline (same warm batcher, min-of-N interleaved, ledger
 # host_ms/device_ms as the host-gap measurement), multi-turn session
 # KV reuse (turn-2 TTFT decode-page cache vs prompt-only, <60 s on its
-# own), and request tracing (per-request phase spans must SUM to the
+# own), request tracing (per-request phase spans must SUM to the
 # measured TTFT within tolerance on the burst, and tracing overhead
-# must stay within 5% tok/s of untraced on the same run) on tiny
-# shapes; exits non-zero if chunked ITL regresses >10% past monolithic
-# (compute-bound tie on a 1-core box; the strict gate flaked at seed),
-# hits vanish, the batched station's burst TTFT is not strictly below
-# serial, spec decode is not strictly above plain, pipelined decode is
-# not strictly above the sync baseline, turn-2 TTFT with decode-page
-# caching is not strictly below prompt-only, tokens diverge on any of
-# them, the TTFT phase decomposition breaks, or tracing overhead blows
-# the 5% gate
+# must stay within 5% tok/s of untraced on the same run), and the HTTP
+# data plane (the same warm batcher served through the in-memory client
+# vs the replica HTTP endpoint over loopback — token-identical, HTTP
+# tok/s within a fixed 0.5x tolerance) on tiny shapes; exits non-zero
+# if chunked ITL regresses >10% past monolithic (compute-bound tie on a
+# 1-core box; the strict gate flaked at seed), hits vanish, the batched
+# station's burst TTFT is not strictly below serial, spec decode is not
+# strictly above plain, pipelined decode is not strictly above the sync
+# baseline, turn-2 TTFT with decode-page caching is not strictly below
+# prompt-only, tokens diverge on any of them (the HTTP lane included),
+# the TTFT phase decomposition breaks, tracing overhead blows the 5%
+# gate, or the HTTP path falls past its tolerance
 bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve-smoke
 
@@ -73,11 +76,15 @@ multichip-smoke:
 # exercises the serving path in environments where the multichip dry run
 # cannot (e.g. a jax build without the APIs the parallel stack needs).
 # dryrun_tracing: serve a few traced requests, dump/reload the JSONL,
-# assert one complete span tree each (the observability smoke)
+# assert one complete span tree each (the observability smoke).
+# dryrun_http_serving: spawn a REAL replica subprocess (worker
+# --serve-http), stream/cancel over loopback sockets, then SIGKILL it
+# mid-stream — the distributed-data-plane smoke
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	  $(PY) -c "import __graft_entry__ as g; g.dryrun_gateway(); \
-	  g.dryrun_spec_serving(); g.dryrun_tracing(); g.dryrun_multichip(8)"
+	  g.dryrun_spec_serving(); g.dryrun_tracing(); \
+	  g.dryrun_http_serving(); g.dryrun_multichip(8)"
 
 image:
 	docker build -f deploy/Dockerfile -t kubegpu-tpu:latest .

@@ -2077,6 +2077,144 @@ def serving_trace_report(extra: dict, tiny: bool = False) -> None:
     extra["serve_trace_overhead_ok"] = bool(overhead_ok)
 
 
+def serving_http_overhead(extra: dict, tiny: bool = False) -> None:
+    """The wire's cost (ISSUE 10 CI satellite): the SAME warm paged
+    batcher serves the SAME decode traffic through BOTH data planes —
+    the in-memory client (worker thread + queues, the pre-wire baseline)
+    and the HTTP replica endpoint over a real loopback socket (SSE
+    token streaming, chunked framing, one event per committed batch).
+    Exactly one lane drives the batcher at a time (each pass brings its
+    lane up around the shared instance and tears it down), so the delta
+    is pure transport: HTTP parse, SSE writes, client-side event
+    reassembly.
+
+    Gates (tiny/CPU, make bench-smoke): token identity across the two
+    planes, and HTTP-path tok/s within a fixed tolerance
+    (>= {tol}x) of the in-memory client — the wire is allowed a bounded
+    tax, never a collapse."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubegpu_tpu.gateway.client import InMemoryReplicaClient
+    from kubegpu_tpu.gateway.dataplane import HttpReplicaClient, ReplicaServer
+    from kubegpu_tpu.models import TransformerLM
+    from kubegpu_tpu.models.paging import PagedContinuousBatcher
+
+    TOL = 0.5  # HTTP must keep >= 50% of in-memory tok/s on loopback
+
+    if tiny:
+        vocab, layers, heads, hidden = 61, 2, 4, 32
+        dtype = jnp.float32
+        page, prompt_pad, max_seq = 8, 24, 96
+        n_req, max_new, n_pairs = 6, 32, 3
+    else:
+        vocab, layers, hidden = 32768, 4, 4096
+        heads = hidden // 128
+        dtype = jnp.bfloat16
+        page, prompt_pad, max_seq = 64, 128, 512
+        n_req, max_new, n_pairs = 8, 128, 3
+    model = TransformerLM(
+        vocab_size=vocab, num_layers=layers, num_heads=heads, hidden=hidden,
+        max_seq=max_seq,
+    )
+    rng = jax.random.PRNGKey(0)
+    if tiny:
+        params = model.init(rng, jnp.ones((1, 8), jnp.int32))["params"]
+    else:
+        params = jax.jit(
+            lambda r, x: _bf16_cast(model.init(r, x)["params"])
+        )(rng, jnp.ones((1, 8), jnp.int32))
+    rs = np.random.RandomState(41)
+    prompts = [
+        rs.randint(0, vocab, size=rs.randint(4, prompt_pad // 2))
+        .astype(np.int32)
+        for _ in range(n_req)
+    ]
+    budgets = [max(max_new * (3 + i % 2) // 4, 2) for i in range(n_req)]
+    n_tokens = sum(budgets)
+    pages_each = -(-(prompt_pad // 2 + max(budgets)) // page)
+    cb = PagedContinuousBatcher(
+        params, vocab_size=vocab, num_layers=layers, num_heads=heads,
+        hidden=hidden, max_seq=max_seq, slots=n_req,
+        prompt_pad=prompt_pad, page_size=page,
+        pool_pages=n_req * pages_each + pages_each + 2, dtype=dtype,
+        prefix_cache=False,  # identical device work every pass: the
+        # lanes must differ by TRANSPORT alone, not cache hits
+    )
+    cb.submit(900, prompts[0], 2)   # warm every program off the clock
+    while cb.has_work():
+        cb.serve_step()
+
+    class _Req:
+        def __init__(self, i):
+            self.request_id = f"q{i}"
+            self.prompt = [int(t) for t in prompts[i]]
+            self.max_new_tokens = budgets[i]
+            self.temperature = 0.0
+            self.session = None
+
+    def wave(submit):
+        t0 = time.perf_counter()
+        attempts = [submit(_Req(i)) for i in range(n_req)]
+        out = {}
+        for i, a in enumerate(attempts):
+            assert a.wait(300), f"request {i} stuck"
+            res = a.result()
+            assert res.ok, res.error
+            out[i] = res.tokens
+        return out, time.perf_counter() - t0
+
+    def inmem_pass():
+        client = InMemoryReplicaClient()
+        client.add_replica("r", cb)
+        try:
+            return wave(lambda req: client.submit("r", req))
+        finally:
+            client.stop()
+
+    def http_pass():
+        server = ReplicaServer(cb).start()
+        client = HttpReplicaClient(endpoints={"r": server.endpoint})
+        try:
+            return wave(lambda req: client.submit("r", req))
+        finally:
+            client.stop()
+            server.stop()
+
+    ref, _ = inmem_pass()           # warm + identity reference
+    got, _ = http_pass()
+    identical = got == ref
+    walls = {"inmem": [], "http": []}
+    for i in range(n_pairs):
+        order = (("inmem", inmem_pass), ("http", http_pass))
+        if i % 2:
+            order = order[::-1]     # slow waves hit both symmetrically
+        for name, fn in order:
+            _, wall = fn()
+            walls[name].append(wall)
+    inmem_tok_s = n_tokens / min(walls["inmem"])
+    http_tok_s = n_tokens / min(walls["http"])
+    ratio = http_tok_s / max(inmem_tok_s, 1e-9)
+    label = "tiny/CPU fp32" if tiny else "1.08B bf16"
+    log(
+        f"serving http overhead ({label}, {n_req} requests, {n_tokens} "
+        f"tokens, one warm batcher, min-of-{n_pairs} interleaved): "
+        f"{http_tok_s:.0f} tok/s over loopback HTTP vs {inmem_tok_s:.0f} "
+        f"in-memory ({ratio:.2f}x, tolerance {TOL}x); token-identical: "
+        f"{identical}"
+    )
+    extra["serve_http_tok_s"] = round(http_tok_s, 1)
+    extra["serve_http_inmem_tok_s"] = round(inmem_tok_s, 1)
+    extra["serve_http_ratio"] = round(ratio, 3)
+    extra["serve_http_token_identical"] = bool(identical)
+    extra["serve_http_within_tolerance"] = bool(
+        http_tok_s >= TOL * inmem_tok_s
+    )
+
+
 def serving_tp_paged(extra: dict, tiny: bool = False) -> None:
     """Tensor-parallel paged serving (ISSUE 9 acceptance): the whole
     ``PagedContinuousBatcher`` hot loop over a "model" mesh — KV page
@@ -3335,6 +3473,7 @@ def main() -> None:
         serving_decode_overhead(extra, tiny=True)
         serving_multiturn(extra, tiny=True)
         serving_trace_report(extra, tiny=True)
+        serving_http_overhead(extra, tiny=True)
         ok = (
             # chunked ITL must not SUBSTANTIALLY regress vs monolithic:
             # on the 1-core smoke box the two are compute-bound ties
@@ -3359,6 +3498,8 @@ def main() -> None:
             and extra["serve_trace_attribution_ok"]
             and extra["serve_trace_ledger_ok"]
             and extra["serve_trace_overhead_ok"]
+            and extra["serve_http_token_identical"]
+            and extra["serve_http_within_tolerance"]
         )
         print(json.dumps({
             "metric": "serve_smoke", "ok": ok, "extra": extra,

@@ -24,6 +24,11 @@ from kubegpu_tpu.gateway.core import (
     GatewayResult,
     PendingRequest,
 )
+from kubegpu_tpu.gateway.dataplane import (
+    HttpReplicaClient,
+    ReplicaServer,
+    ReplicaServingLoop,
+)
 from kubegpu_tpu.gateway.failover import Dispatcher, FailoverPolicy
 from kubegpu_tpu.gateway.queue import AdmissionQueue, QueueClosed, QueueFull
 from kubegpu_tpu.gateway.registry import ReplicaInfo, ReplicaRegistry
@@ -44,8 +49,11 @@ __all__ = [
     "GatewayRequest",
     "GatewayResult",
     "GatewayServer",
+    "HttpReplicaClient",
     "InMemoryReplicaClient",
     "LeastOutstandingRouter",
+    "ReplicaServer",
+    "ReplicaServingLoop",
     "PendingRequest",
     "QueueClosed",
     "QueueFull",

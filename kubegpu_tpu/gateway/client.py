@@ -37,6 +37,18 @@ from typing import Callable, Dict, FrozenSet, List, Optional
 DECODE_PAGE_CACHE_POLICIES = ("off", "fp32", "all")
 
 
+def _sniff_takes_trace(batcher) -> bool:
+    """Does this batcher speak the trace-context contract?  Duck-typed
+    once per worker/serving-loop so third-party batchers without the
+    kwarg still work (their requests simply serve untraced below the
+    dispatch span).  Shared with the HTTP data plane (gateway/
+    dataplane.py) so both drivers sniff identically."""
+    try:
+        return "trace" in inspect.signature(batcher.submit).parameters
+    except (TypeError, ValueError):
+        return False
+
+
 @dataclass
 class AttemptResult:
     ok: bool
@@ -219,6 +231,13 @@ class SimBatcher:
     def has_work(self) -> bool:
         return bool(self._pending) or bool(self._active)
 
+    def live_tokens(self) -> Dict[int, List[int]]:
+        """Committed tokens of every ACTIVE sequence (the real batchers'
+        incremental-streaming surface): the HTTP serving loop reads this
+        after each serve_step to emit one SSE event per committed token
+        batch."""
+        return {seq: list(t) for seq, (t, _) in self._active.items()}
+
     def serve_step(self) -> Dict[int, List[int]]:
         finished: Dict[int, List[int]] = {}
         while self._pending and len(self._active) < self.slots:
@@ -287,21 +306,19 @@ class _ReplicaWorker:
         self.key = key
         self.batcher = batcher
         self.step_delay_s = step_delay_s
-        # does this batcher speak the trace-context contract?  Duck-typed
-        # once here so third-party batchers without the kwarg still work
-        # (their requests simply serve untraced below the dispatch span)
-        try:
-            self._takes_trace = (
-                "trace" in inspect.signature(batcher.submit).parameters
-            )
-        except (TypeError, ValueError):
-            self._takes_trace = False
+        self._takes_trace = _sniff_takes_trace(batcher)
         self.lock = threading.Lock()
         self.cond = threading.Condition(self.lock)
         self.inbox: deque = deque()          # (attempt, request)
         self.cancels: List[Attempt] = []
         self.alive = True
         self.by_seq: Dict[int, Attempt] = {}
+        # streaming parity with the HTTP data plane: per-sequence token
+        # sink + emitted watermark, fed from the batcher's live_tokens()
+        # after each step so `on_tokens` callers see committed batches
+        # from the in-memory plane too
+        self.sinks: Dict[int, Callable] = {}
+        self.emitted: Dict[int, int] = {}
         self._next_seq = 0
         self.thread = threading.Thread(target=self._run, daemon=True)
         self.thread.start()
@@ -339,6 +356,10 @@ class _ReplicaWorker:
                             **kwargs,
                         )
                         self.by_seq[seq] = attempt
+                        sink = getattr(req, "on_tokens", None)
+                        if sink is not None:
+                            self.sinks[seq] = sink
+                            self.emitted[seq] = 0
                     except Exception as e:  # noqa: BLE001 - bad request
                         attempt.finish(AttemptResult(False, error=str(e)))
                 for attempt in self.cancels:
@@ -346,6 +367,8 @@ class _ReplicaWorker:
                         if a is attempt:
                             self.batcher.cancel(seq)
                             del self.by_seq[seq]
+                            self.sinks.pop(seq, None)
+                            self.emitted.pop(seq, None)
                     attempt.finish(
                         AttemptResult(False, error="cancelled")
                     )
@@ -353,8 +376,14 @@ class _ReplicaWorker:
             # decode OUTSIDE the lock: a slow step (real JAX dispatch)
             # must not block submission/cancel delivery
             finished = self.batcher.serve_step()
+            self._flush_sinks()
             for seq, tokens in finished.items():
+                # flush the tail BEFORE dropping by_seq: the sink gets
+                # its attempt handle alongside the final delta
+                self._flush_one(seq, list(tokens))
                 attempt = self.by_seq.pop(seq, None)
+                self.sinks.pop(seq, None)
+                self.emitted.pop(seq, None)
                 if attempt is not None:
                     attempt.finish(AttemptResult(True, tokens=list(tokens)))
             if self.step_delay_s:
@@ -364,6 +393,29 @@ class _ReplicaWorker:
             attempt.finish(
                 AttemptResult(False, error=f"replica {self.key} died")
             )
+
+    def _flush_one(self, seq: int, tokens) -> None:
+        """Emit a sequence's unseen committed tokens into its sink."""
+        sink = self.sinks.get(seq)
+        if sink is None:
+            return
+        done = self.emitted.get(seq, 0)
+        if len(tokens) > done:
+            self.emitted[seq] = len(tokens)
+            attempt = self.by_seq.get(seq)
+            try:
+                sink(attempt, list(tokens[done:]))
+            except Exception:  # noqa: BLE001 - sink is advisory
+                pass
+
+    def _flush_sinks(self) -> None:
+        if not self.sinks:
+            return
+        live = getattr(self.batcher, "live_tokens", None)
+        if live is None:
+            return
+        for seq, tokens in live().items():
+            self._flush_one(seq, tokens)
 
     def submit(self, attempt: Attempt, request) -> None:
         with self.cond:

@@ -56,6 +56,21 @@ class GatewayRequest:
     # when the gateway traces; carried down through queue -> dispatch ->
     # replica batcher so the whole request is ONE span tree
     trace: Optional[object] = field(default=None, repr=False, compare=False)
+    # streaming sink: a data-plane client that streams (HttpReplicaClient)
+    # calls on_tokens(attempt, delta) for every committed token batch —
+    # the gateway's SSE pass-through feeds its caller from this.  The
+    # terminal result's token list remains authoritative (a hedge's
+    # winner may differ from the attempt that streamed).
+    on_tokens: Optional[object] = field(default=None, repr=False,
+                                        compare=False)
+    # caller-abort signal (threading.Event): set when the downstream
+    # client vanished mid-stream; the dispatcher cancels every in-flight
+    # attempt (wire-level, pages freed replica-side) and resolves the
+    # request with an explicit error
+    abort: Optional[object] = field(default=None, repr=False, compare=False)
+    # streaming requests never hedge: one caller follows one attempt's
+    # stream (retries still re-dispatch a failed one)
+    no_hedge: bool = field(default=False, repr=False, compare=False)
 
     def __post_init__(self) -> None:
         if not self.request_id:

@@ -1,0 +1,989 @@
+"""The cross-process data plane: replica HTTP serving + streaming client.
+
+Until now the gateway's routing/failover/hedging machinery only ever met
+a batcher in-process (``InMemoryReplicaClient``); in-cluster ``/readyz``
+fail-safed at 503 because there was no wire to a real replica.  This
+module is that wire, both ends:
+
+**Replica side** — ``ReplicaServer`` wraps any batcher speaking the
+incremental serving API (``submit``/``serve_step``/``cancel``/
+``has_work``: the paged and dense continuous batchers, or SimBatcher)
+behind a small HTTP endpoint, driven by ONE serving thread that owns the
+batcher's ``serve_step`` loop (the in-process ``_ReplicaWorker`` shape,
+behind sockets):
+
+    POST /v1/submit   {"request_id", "prompt": [ints], "max_new_tokens",
+                       "temperature", "session"}
+        → 200 text/event-stream (chunked): one ``tokens`` event per
+          committed token batch — under the pipelined decode loop the
+          host learns tokens at its one readback point, one step late,
+          so each flush IS a commit point — then a terminal ``done``
+          (full token list + the replica-side span dicts + the receive
+          stamp) or ``error`` event.  ``: ping`` comment frames keep the
+          socket honest while a sequence waits; a client that vanishes
+          mid-stream fails the next write and its sequence is CANCELLED
+          (pages freed) — disconnect ⇒ cancel.
+    POST /v1/cancel   {"request_id"} → {"cancelled": bool}; wire-level
+          cancel: the sequence's pages go back to the pool NOW, not when
+          the stream times out.
+    GET  /v1/state    advertised serving contract: tensor-parallel width
+          (``replica_mesh``), slots, page economy (free/live/cached from
+          the last ledger row), active streams; ``?ledger=K`` adds the
+          last K ledger rows.
+    GET  /healthz     liveness ("ok") — the registry's HTTP probe target.
+    GET  /metrics     Prometheus text (``replica_http_*`` + whatever the
+          batcher observed into the shared registry).
+
+**Gateway side** — ``HttpReplicaClient`` implements the existing
+``ReplicaClient`` interface over that protocol: ``submit`` returns an
+``Attempt`` handle and streams on a reader thread (token deltas surface
+through the request's optional ``on_tokens`` callback — the gateway's
+SSE pass-through), connections are kept per replica and reused across
+completed streams, ``cancel`` closes the stream socket AND posts a wire
+cancel so the replica frees pages immediately, and per-attempt deadlines
+(``request.deadline_s`` anchored at ``enqueued_at``) cancel on the wire
+when they expire.  Trace context crosses the boundary in headers
+(``X-Trace-Id``/``X-Span-Id``); the replica serves the request under its
+OWN tracer and ships the finished span dicts back in the terminal event,
+which the client grafts under the gateway's dispatch span
+(``Tracer.graft``) — one tree, two processes.
+
+Failure model mirrors the in-memory client: connection refusal and
+mid-stream resets are attempt RESULTS (errors), never exceptions; a
+replica leaving the registry's live set aborts its in-flight attempts so
+failover re-dispatches the same cycle.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import queue
+import socket
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kubegpu_tpu.gateway.client import (
+    Attempt,
+    AttemptResult,
+    ReplicaClient,
+    _sniff_takes_trace,
+)
+from kubegpu_tpu.utils.metrics import Metrics
+from kubegpu_tpu.utils.tracing import SpanCtx, Tracer
+
+log = logging.getLogger(__name__)
+
+# SSE keepalive cadence: a stream with no token progress writes a ping
+# comment this often, so a vanished client is detected within one frame
+# (the write fails) instead of holding its sequence until some timeout
+PING_INTERVAL_S = 0.2
+
+
+def sse_event(event: str, payload: dict) -> bytes:
+    """One SSE frame.  The ONE framing implementation for both ends of
+    the wire (replica handler here, the gateway's streaming
+    pass-through in server.py) — the schema must not drift apart."""
+    return f"event: {event}\ndata: {json.dumps(payload)}\n\n".encode()
+
+
+def write_chunk(wfile, data: bytes) -> None:
+    """One HTTP/1.1 chunked-transfer frame (shared with server.py)."""
+    wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+    wfile.flush()
+
+
+def end_chunks(wfile) -> None:
+    """The chunked-transfer terminator: the connection stays reusable."""
+    wfile.write(b"0\r\n\r\n")
+    wfile.flush()
+
+
+def _connect(addr: str, timeout: float) -> http.client.HTTPConnection:
+    """The ONE "host:port" parse + connection constructor (probe, state,
+    wire cancel and the stream pool all route through it)."""
+    host, _, port = addr.rpartition(":")
+    return http.client.HTTPConnection(host, int(port), timeout=timeout)
+
+
+def _int_or(value, default: int) -> int:
+    """Defensive wire-field parse: a malformed numeric from a CLIENT
+    must degrade, never raise on the serving thread."""
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return default
+
+
+# ---------------------------------------------------------------------------
+# Replica side
+# ---------------------------------------------------------------------------
+
+class _Stream:
+    """One in-flight request's server-side state: the event queue its
+    HTTP handler drains, and the incremental-emit watermark."""
+
+    __slots__ = ("request_id", "seq", "q", "emitted", "t_recv", "trace",
+                 "cancelled", "closed")
+
+    def __init__(self, request_id: str, t_recv: float) -> None:
+        self.request_id = request_id
+        self.seq: Optional[int] = None
+        self.q: "queue.Queue[tuple]" = queue.Queue()
+        self.emitted = 0
+        self.t_recv = t_recv
+        self.trace: Optional[SpanCtx] = None
+        self.cancelled = False
+        self.closed = False
+
+
+class ReplicaServingLoop:
+    """The serving thread that owns the batcher: drains submissions and
+    cancels, drives ``serve_step``, and pushes token-batch events into
+    per-request stream queues.  Exactly one thread touches the batcher
+    (the batchers are single-driver by design), so HTTP handler threads
+    never race the decode loop."""
+
+    def __init__(self, batcher, metrics: Optional[Metrics] = None,
+                 tracer: Optional[Tracer] = None,
+                 step_delay_s: float = 0.0) -> None:
+        self.batcher = batcher
+        self.metrics = metrics
+        # the replica's own tracer: every request serves under a local
+        # root whose finished span dicts ride the terminal event back to
+        # the gateway for grafting
+        self.tracer = tracer if tracer is not None else Tracer(
+            max_traces=64
+        )
+        self.step_delay_s = step_delay_s
+        self._takes_trace = _sniff_takes_trace(batcher)
+        # RLock: _finish mutates stream maps from both the serving
+        # thread (already holding the condition's lock on the shutdown
+        # path) and the flush path
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._inbox: deque = deque()        # (_Stream, payload dict)
+        self._cancels: List[str] = []       # request ids
+        self._evicted: List[_Stream] = []   # duplicate-id losers
+        self._streams: Dict[str, _Stream] = {}
+        self._by_seq: Dict[int, _Stream] = {}
+        self._next_seq = 0
+        self.alive = True
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    # -- handler-facing surface (any thread) -------------------------------
+    def submit(self, payload: dict, t_recv: float) -> _Stream:
+        st = _Stream(str(payload.get("request_id") or ""), t_recv)
+        with self._cond:
+            if not self.alive:
+                st.q.put(("error", "replica shutting down", [], t_recv))
+                st.closed = True
+                return st
+            # last-writer-wins on a duplicate id, like the batchers'
+            # resubmit flow: the old stream errors out, the new one owns
+            # the id (cancel routing needs one owner)
+            old = self._streams.get(st.request_id)
+            if old is not None and not old.closed:
+                old.cancelled = True
+                self._evicted.append(old)
+            self._streams[st.request_id] = st
+            self._inbox.append((st, payload))
+            self._cond.notify()
+        return st
+
+    def cancel(self, request_id: str,
+               stream: Optional[_Stream] = None) -> bool:
+        """Cancel by request id.  ``stream`` pins the cancel to ONE
+        stream object: a disconnect handler for an EVICTED (resubmitted)
+        stream must not cancel the newer live stream that now owns the
+        same id — its retry is healthy."""
+        with self._cond:
+            st = self._streams.get(request_id)
+            if st is None or st.closed:
+                return False
+            if stream is not None and st is not stream:
+                return False
+            st.cancelled = True
+            self._cancels.append(request_id)
+            self._cond.notify()
+            return True
+
+    def active_streams(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._streams.values() if not s.closed)
+
+    def state(self, ledger_limit: int = 0) -> dict:
+        b = self.batcher
+        active_streams = self.active_streams()
+        out = {
+            "tp": int(getattr(b, "tp", 1)),
+            "slots": getattr(b, "slots", None),
+            "decode_page_cache": getattr(b, "decode_page_cache", "off"),
+            "active_streams": active_streams,
+        }
+        rows_fn = getattr(b, "ledger_rows", None)
+        if rows_fn is not None:
+            rows = rows_fn(max(ledger_limit, 1))
+            if rows:
+                last = rows[-1]
+                out["pages"] = {
+                    "free": last.get("pages_free", 0),
+                    "live": last.get("pages_live", 0),
+                    "cached": last.get("pages_cached", 0),
+                }
+            if ledger_limit > 0:
+                out["ledger"] = rows[-ledger_limit:]
+        return out
+
+    def stop(self) -> None:
+        with self._cond:
+            self.alive = False
+            self._cond.notify()
+        self._thread.join(timeout=5.0)
+
+    # -- the serving thread ------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while (self.alive and not self._inbox and not self._cancels
+                       and not self.batcher.has_work()):
+                    self._cond.wait(0.05)
+                if not self.alive:
+                    # process death: close the batcher's spans FIRST
+                    # (every live serve subtree gets its ``died`` retire,
+                    # the way a dead pod ends its connections), so the
+                    # error events that follow ship COMPLETE subtrees for
+                    # the gateway-side graft — then error every stream
+                    # (the HTTP handlers flush it if their sockets still
+                    # stand)
+                    shutdown = getattr(self.batcher, "trace_shutdown", None)
+                    if shutdown is not None:
+                        shutdown("replica server stopped")
+                    for st in list(self._streams.values()):
+                        if not st.closed:
+                            self._finish(st, "error", "replica shutting down")
+                    return
+                for st in self._evicted:
+                    if not st.closed:
+                        if st.seq is not None:
+                            self.batcher.cancel(st.seq)
+                        self._finish(st, "error", "resubmitted")
+                        if self.metrics is not None:
+                            # a duplicate-id eviction IS a wire-level
+                            # cancel (the catalog counts both flavors)
+                            self.metrics.inc("replica_http_cancels_total")
+                self._evicted.clear()
+                while self._inbox:
+                    st, payload = self._inbox.popleft()
+                    if st.cancelled:
+                        self._finish(st, "error", "cancelled")
+                        continue
+                    self._admit(st, payload)
+                for rid in self._cancels:
+                    st = self._streams.get(rid)
+                    if st is None or st.closed:
+                        continue
+                    if st.seq is not None:
+                        self.batcher.cancel(st.seq)
+                    self._finish(st, "error", "cancelled")
+                    if self.metrics is not None:
+                        self.metrics.inc(
+                            "replica_http_cancels_total"
+                        )
+                self._cancels.clear()
+            # decode OUTSIDE the lock: a slow step (real JAX dispatch)
+            # must not block submission/cancel delivery
+            finished = (
+                self.batcher.serve_step() if self.batcher.has_work() else {}
+            )
+            self._flush(finished)
+            if self.step_delay_s:
+                time.sleep(self.step_delay_s)
+
+    def _admit(self, st: _Stream, payload: dict) -> None:
+        seq = self._next_seq
+        self._next_seq += 1
+        root = None
+        if self.tracer is not None:
+            # the replica-side root; the batcher's serve subtree nests
+            # under it.  The REMOTE parent ids ride as attributes — the
+            # client-side graft re-parents under the live dispatch span,
+            # these are the audit trail
+            root = self.tracer.start_trace(
+                "replica_request", request_id=st.request_id,
+                remote_trace=str(payload.get("trace_id") or ""),
+                # defensive: a garbage X-Span-Id must not kill the
+                # serving thread (it only annotates the audit trail)
+                remote_span=_int_or(payload.get("span_id"), 0),
+            )
+        kwargs = {"session_id": payload.get("session")}
+        if self._takes_trace:
+            kwargs["trace"] = root
+        try:
+            self.batcher.submit(
+                seq,
+                np.asarray(payload.get("prompt") or [], np.int32),
+                int(payload.get("max_new_tokens", 0)),
+                float(payload.get("temperature", 0.0)),
+                **kwargs,
+            )
+        except Exception as e:  # noqa: BLE001 - bad request is a result
+            if root is not None:
+                root.end(status="rejected")
+            st.trace = root
+            self._finish(st, "error", str(e))
+            return
+        st.seq = seq
+        st.trace = root
+        self._by_seq[seq] = st
+
+    def _flush(self, finished: Dict[int, List[int]]) -> None:
+        """Emit token deltas for live sequences (one event per committed
+        batch — the pipelined loop's readback points) and terminal events
+        for finished ones."""
+        live = getattr(self.batcher, "live_tokens", None)
+        if live is not None:
+            for seq, toks in live().items():
+                st = self._by_seq.get(seq)
+                if st is not None and len(toks) > st.emitted:
+                    delta = list(toks[st.emitted:])
+                    st.emitted = len(toks)
+                    st.q.put(("tokens", delta))
+        for seq, toks in finished.items():
+            st = self._by_seq.pop(seq, None)
+            if st is None or st.closed:
+                continue
+            if len(toks) > st.emitted:
+                st.q.put(("tokens", list(toks[st.emitted:])))
+            self._finish(st, "done", list(toks))
+
+    def _finish(self, st: _Stream, kind: str, payload) -> None:
+        """Terminal event: close the replica-side root, collect the
+        completed span dicts, and hand the handler everything it needs
+        for the wire."""
+        st.closed = True
+        with self._lock:
+            if self._streams.get(st.request_id) is st:
+                del self._streams[st.request_id]
+        if st.seq is not None:
+            self._by_seq.pop(st.seq, None)
+        spans: List[dict] = []
+        if st.trace is not None:
+            st.trace.end(status=kind)
+            got = self.tracer.trace(st.trace.trace_id)
+            if got is not None:
+                spans = got
+        st.q.put((kind, payload, spans, st.t_recv))
+
+
+def make_replica_handler(loop: ReplicaServingLoop,
+                         metrics: Optional[Metrics]):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            log.debug("replica http: " + fmt, *args)
+
+        def _read_json(self) -> Optional[dict]:
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                raw = self.rfile.read(length)
+                return json.loads(raw) if raw else {}
+            except (ValueError, json.JSONDecodeError):
+                return None
+
+        def _send_json(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        # -- chunked streaming helpers (shared framing) -----------------
+        def _chunk(self, data: bytes) -> None:
+            write_chunk(self.wfile, data)
+
+        def _chunk_end(self) -> None:
+            end_chunks(self.wfile)
+
+        def do_GET(self):
+            path, _, query = self.path.partition("?")
+            if metrics is not None:
+                metrics.inc("replica_http_requests_total", verb="state"
+                            if path == "/v1/state" else "get")
+            if path == "/healthz":
+                body = b"ok"
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif path == "/metrics" and metrics is not None:
+                body = metrics.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif path == "/v1/state":
+                limit = 0
+                for part in query.split("&"):
+                    if part.startswith("ledger="):
+                        try:
+                            limit = max(0, int(part.split("=", 1)[1]))
+                        except ValueError:
+                            pass
+                self._send_json(200, loop.state(ledger_limit=limit))
+            else:
+                self._send_json(404, {"error": f"no route {path}"})
+
+        def do_POST(self):
+            if self.path == "/v1/cancel":
+                if metrics is not None:
+                    metrics.inc("replica_http_requests_total", verb="cancel")
+                body = self._read_json()
+                if body is None or not body.get("request_id"):
+                    self._send_json(400, {"error": "request_id required"})
+                    return
+                ok = loop.cancel(str(body["request_id"]))
+                self._send_json(200, {"cancelled": ok})
+                return
+            if self.path != "/v1/submit":
+                self._send_json(404, {"error": f"no route {self.path}"})
+                return
+            t_recv = time.monotonic()
+            if metrics is not None:
+                metrics.inc("replica_http_requests_total", verb="submit")
+            body = self._read_json()
+            if body is None:
+                self._send_json(400, {"error": "malformed JSON body"})
+                return
+            body.setdefault("trace_id", self.headers.get("X-Trace-Id", ""))
+            body.setdefault("span_id", self.headers.get("X-Span-Id", "0"))
+            st = loop.submit(body, t_recv)
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            if metrics is not None:
+                metrics.set_gauge(
+                    "replica_http_streams_active", loop.active_streams()
+                )
+            try:
+                self._stream(st)
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                # the client vanished mid-stream: its sequence must not
+                # keep decoding into pages nobody will read — disconnect
+                # IS a cancel (the wire-level page-freeing guarantee).
+                # Pinned to THIS stream: an evicted stream's dead socket
+                # must not cancel the id's newer owner
+                if loop.cancel(st.request_id, stream=st):
+                    if metrics is not None:
+                        metrics.inc(
+                            "replica_http_disconnect_cancels_total"
+                        )
+                self.close_connection = True
+            finally:
+                if metrics is not None:
+                    metrics.set_gauge(
+                        "replica_http_streams_active", loop.active_streams()
+                    )
+
+        def _stream(self, st: _Stream) -> None:
+            while True:
+                try:
+                    ev = st.q.get(timeout=PING_INTERVAL_S)
+                except queue.Empty:
+                    self._chunk(b": ping\n\n")
+                    continue
+                kind = ev[0]
+                if metrics is not None:
+                    metrics.inc("replica_http_stream_events_total")
+                if kind == "tokens":
+                    self._chunk(sse_event("tokens", {"tokens": ev[1]}))
+                    continue
+                if kind == "done":
+                    self._chunk(sse_event("done", {
+                        "tokens": ev[1], "spans": ev[2], "t_recv": ev[3],
+                    }))
+                else:
+                    self._chunk(sse_event("error", {
+                        "error": ev[1], "spans": ev[2], "t_recv": ev[3],
+                    }))
+                self._chunk_end()
+                return
+
+    return Handler
+
+
+class _ReplicaHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def handle_error(self, request, client_address):
+        log.debug("replica connection error from %s", client_address,
+                  exc_info=True)
+
+
+class ReplicaServer:
+    """One replica's HTTP serving endpoint: the serving loop plus the
+    threaded HTTP server in front of it.  ``listen`` port 0 picks an
+    ephemeral port (tests, loopback soak); ``stop()`` ends the serving
+    loop first (live streams flush an explicit error event) and then the
+    listener."""
+
+    def __init__(self, batcher, listen: Tuple[str, int] = ("127.0.0.1", 0),
+                 metrics: Optional[Metrics] = None,
+                 tracer: Optional[Tracer] = None,
+                 step_delay_s: float = 0.0) -> None:
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.loop = ReplicaServingLoop(
+            batcher, metrics=self.metrics, tracer=tracer,
+            step_delay_s=step_delay_s,
+        )
+        self.httpd = _ReplicaHTTPServer(
+            listen, make_replica_handler(self.loop, self.metrics)
+        )
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def batcher(self):
+        return self.loop.batcher
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.httpd.server_address[:2]
+
+    @property
+    def port(self) -> int:
+        return self.address[1]
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self.address
+        return f"{host}:{port}"
+
+    def start(self) -> "ReplicaServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.loop.stop()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# Gateway side
+# ---------------------------------------------------------------------------
+
+class HttpReplicaClient(ReplicaClient):
+    """``ReplicaClient`` over the replica HTTP protocol.
+
+    Endpoint resolution: explicit ``set_endpoint(key, "host:port")``
+    entries win (tests, loopback soak); otherwise ``resolver(key)``
+    (in-cluster: the registry's discovered pod IP + ``default_port``).
+    A replica with no resolvable endpoint fails submissions immediately
+    with an unreachable RESULT, like the in-memory client.
+
+    Connection reuse: completed streams return their ``HTTPConnection``
+    to a small per-replica pool; errors and cancels discard it (the
+    socket state is unknowable mid-stream)."""
+
+    def __init__(
+        self,
+        endpoints: Optional[Dict[str, str]] = None,
+        resolver: Optional[Callable[[str], Optional[str]]] = None,
+        default_port: int = 8700,
+        timeout_s: float = 5.0,
+        metrics: Optional[Metrics] = None,
+    ) -> None:
+        self.resolver = resolver
+        self.default_port = default_port
+        self.timeout_s = timeout_s
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._endpoints: Dict[str, str] = dict(endpoints or {})
+        self._pool: Dict[str, List[http.client.HTTPConnection]] = {}
+        # replica key -> in-flight attempts (sync_live aborts these the
+        # cycle a replica drains, so failover re-dispatches immediately
+        # instead of waiting out a dead socket)
+        self._inflight: Dict[str, set] = {}
+        # request_id -> completed ok deliveries (soak's exactly-once and
+        # wasted-hedge accounting — the in-memory client's `decodes`)
+        self.decodes: Dict[str, int] = {}
+        self._stopped = False
+
+    # -- endpoints ---------------------------------------------------------
+    def set_endpoint(self, key: str, addr: str) -> None:
+        with self._lock:
+            self._endpoints[key] = addr
+
+    def drop_endpoint(self, key: str) -> None:
+        with self._lock:
+            self._endpoints.pop(key, None)
+            for conn in self._pool.pop(key, []):
+                conn.close()
+
+    def endpoint_for(self, key: str) -> Optional[str]:
+        with self._lock:
+            addr = self._endpoints.get(key)
+        if addr is None and self.resolver is not None:
+            try:
+                addr = self.resolver(key)
+            except Exception:  # noqa: BLE001 - resolution is best-effort
+                addr = None
+        return addr
+
+    def replicas(self) -> List[str]:
+        with self._lock:
+            return sorted(self._endpoints)
+
+    def ready(self) -> bool:
+        with self._lock:
+            if self._stopped:
+                return False
+            return bool(self._endpoints) or self.resolver is not None
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            attempts = [a for s in self._inflight.values() for a in s]
+            conns = [c for pool in self._pool.values() for c in pool]
+            self._pool.clear()
+        for conn in conns:
+            conn.close()
+        for attempt in attempts:
+            self._abort(attempt, "client stopped")
+
+    # -- registry subscription --------------------------------------------
+    def sync_live(self, live) -> None:
+        """A replica leaving the live set is its process dying: abort
+        its in-flight attempts now (the socket may linger half-open) so
+        failover re-routes this cycle, and drop its pooled sockets."""
+        with self._lock:
+            gone = [k for k in self._inflight if k not in live]
+            attempts = [a for k in gone for a in self._inflight.get(k, ())]
+            for k in [k for k in self._pool if k not in live]:
+                for conn in self._pool.pop(k, []):
+                    conn.close()
+        for attempt in attempts:
+            self._abort(attempt, f"replica {attempt.replica} left live set")
+
+    # -- probes / advertisement -------------------------------------------
+    def _addr_of(self, info) -> Optional[str]:
+        addr = self.endpoint_for(getattr(info, "key", ""))
+        if addr is None and getattr(info, "addr", None):
+            addr = f"{info.addr}:{self.default_port}"
+        return addr
+
+    def probe(self, info) -> Tuple[bool, str]:
+        """HTTP health probe for the registry (``ReplicaRegistry``'s
+        ``probe=`` hook): GET /healthz on the replica's endpoint.  A
+        replica the control plane believes healthy but whose serving
+        process is gone must drain from the live set — this is what
+        makes in-cluster ``/readyz`` REAL instead of fail-safe."""
+        addr = self._addr_of(info)
+        if addr is None:
+            return False, "no data-plane endpoint (pod IP unknown)"
+        conn = _connect(addr, timeout=1.0)
+        try:
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status == 200:
+                return True, ""
+            return False, f"/healthz returned {resp.status}"
+        except OSError as e:
+            return False, f"/healthz unreachable: {e}"
+        finally:
+            conn.close()
+
+    def _get_state(self, key: str, ledger: int = 0) -> Optional[dict]:
+        addr = self.endpoint_for(key)
+        if addr is None:
+            return None
+        conn = _connect(addr, timeout=1.0)
+        try:
+            path = "/v1/state" + (f"?ledger={ledger}" if ledger else "")
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                resp.read()
+                return None
+            return json.loads(resp.read())
+        except (OSError, ValueError):
+            return None
+        finally:
+            conn.close()
+
+    def advertised(self) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
+        for key in self.replicas():
+            state = self._get_state(key)
+            if state is not None:
+                out[key] = {"tp": int(state.get("tp", 1))}
+        return out
+
+    def ledgers(self, limit: int = 32) -> Dict[str, List[dict]]:
+        out: Dict[str, List[dict]] = {}
+        for key in self.replicas():
+            state = self._get_state(key, ledger=limit)
+            if state is not None and state.get("ledger"):
+                out[key] = state["ledger"]
+        return out
+
+    # -- ReplicaClient -----------------------------------------------------
+    def submit(self, replica_key: str, request) -> Attempt:
+        attempt = Attempt(replica_key, request.request_id)
+        addr = self.endpoint_for(replica_key)
+        with self._lock:
+            stopped = self._stopped
+        if addr is None or stopped:
+            attempt.finish(AttemptResult(
+                False, error=f"replica {replica_key} unreachable"
+            ))
+            return attempt
+        with self._lock:
+            self._inflight.setdefault(replica_key, set()).add(attempt)
+        t = threading.Thread(
+            target=self._run_attempt, args=(attempt, request, addr),
+            daemon=True,
+        )
+        t.start()
+        return attempt
+
+    def cancel(self, attempt: Attempt) -> None:
+        attempt.cancelled = True
+        # wire-level cancel FIRST (frees the replica's pages even if the
+        # stream socket lingers), then tear the stream down locally
+        threading.Thread(
+            target=self._wire_cancel,
+            args=(attempt.replica, attempt.request_id),
+            daemon=True,
+        ).start()
+        self._abort(attempt, "cancelled")
+
+    # -- internals ---------------------------------------------------------
+    def _abort(self, attempt: Attempt, error: str) -> None:
+        attempt.finish(AttemptResult(False, error=error))
+        conn = getattr(attempt, "_stream_conn", None)
+        if conn is not None:
+            try:
+                conn.close()  # unblocks the reader thread mid-read
+            except OSError:
+                pass
+
+    def _wire_cancel(self, replica_key: str, request_id: str) -> None:
+        addr = self.endpoint_for(replica_key)
+        if addr is None:
+            return
+        conn = _connect(addr, timeout=2.0)
+        try:
+            conn.request(
+                "POST", "/v1/cancel",
+                json.dumps({"request_id": request_id}),
+                {"Content-Type": "application/json"},
+            )
+            conn.getresponse().read()
+        except OSError:
+            pass  # the replica may already be gone; its death frees pages
+        finally:
+            conn.close()
+
+    def _checkout(self, key: str, addr: str) -> http.client.HTTPConnection:
+        with self._lock:
+            pool = self._pool.get(key)
+            if pool:
+                return pool.pop()
+        return _connect(addr, timeout=self.timeout_s)
+
+    def _checkin(self, key: str, conn: http.client.HTTPConnection) -> None:
+        with self._lock:
+            if not self._stopped:
+                self._pool.setdefault(key, []).append(conn)
+                return
+        conn.close()
+
+    def _settle(self, attempt: Attempt) -> None:
+        with self._lock:
+            bucket = self._inflight.get(attempt.replica)
+            if bucket is not None:
+                bucket.discard(attempt)
+                if not bucket:
+                    self._inflight.pop(attempt.replica, None)
+
+    def _deadline_of(self, request) -> Optional[float]:
+        deadline_s = getattr(request, "deadline_s", None)
+        if deadline_s is None:
+            return None
+        anchor = getattr(request, "enqueued_at", 0.0) or time.monotonic()
+        return anchor + deadline_s
+
+    def _run_attempt(self, attempt: Attempt, request, addr: str) -> None:
+        """Reader thread: stream the attempt to completion.  The
+        terminal event's span dicts are grafted into the gateway's trace
+        BEFORE the attempt resolves, so the winner's tree is complete
+        when the dispatcher records the result."""
+        conn = self._checkout(attempt.replica, addr)
+        trace = getattr(request, "trace", None)
+        if not isinstance(trace, SpanCtx):
+            trace = None
+        deadline = self._deadline_of(request)
+        reusable = False
+        try:
+            body = json.dumps({
+                "request_id": request.request_id,
+                "prompt": [int(t) for t in request.prompt],
+                "max_new_tokens": int(request.max_new_tokens),
+                "temperature": float(getattr(request, "temperature", 0.0)),
+                "session": getattr(request, "session", None),
+            })
+            headers = {"Content-Type": "application/json"}
+            if trace is not None:
+                headers["X-Trace-Id"] = trace.trace_id
+                headers["X-Span-Id"] = str(trace.span_id)
+            attempt._stream_conn = conn
+            t_send = time.monotonic()
+            conn.request("POST", "/v1/submit", body, headers)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                err = resp.read()[:200].decode(errors="replace")
+                attempt.finish(AttemptResult(
+                    False, error=f"replica {attempt.replica} refused "
+                    f"({resp.status}): {err}"
+                ))
+                return
+            reusable = self._read_stream(
+                attempt, request, resp, trace, t_send, deadline
+            )
+        except socket.timeout:
+            self._wire_cancel(attempt.replica, request.request_id)
+            attempt.finish(AttemptResult(
+                False, error="attempt timed out on the wire"
+            ))
+        except (OSError, ValueError, AttributeError,
+                http.client.HTTPException) as e:
+            # AttributeError: http.client reading a connection that
+            # cancel() closed under us (fp already torn down)
+            attempt.finish(AttemptResult(
+                False,
+                error=f"replica {attempt.replica} connection failed: {e}",
+            ))
+        finally:
+            attempt._stream_conn = None
+            self._settle(attempt)
+            if reusable:
+                self._checkin(attempt.replica, conn)
+            else:
+                conn.close()
+
+    def _read_stream(self, attempt: Attempt, request, resp, trace,
+                     t_send: float, deadline: Optional[float]) -> bool:
+        """Parse SSE events off the response.  Returns True when the
+        stream terminated cleanly (connection reusable)."""
+        on_tokens = getattr(request, "on_tokens", None)
+        tokens: List[int] = []
+        event, data = "", ""
+        terminal = None
+        while True:
+            if (terminal is None and deadline is not None
+                    and time.monotonic() >= deadline):
+                # per-attempt deadline: cancel ON THE WIRE so the
+                # replica frees the sequence's pages now — an expired
+                # attempt must not keep decoding server-side.  Not
+                # enforced during the post-terminal drain-to-EOF: the
+                # request already resolved, and a spurious cancel there
+                # would only discard a cleanly-reusable connection
+                self._wire_cancel(attempt.replica, request.request_id)
+                attempt.finish(AttemptResult(
+                    False, error="attempt deadline expired"
+                ))
+                return False
+            line = resp.readline()
+            if not line:
+                if terminal is not None:
+                    return True  # chunked terminator after the event
+                attempt.finish(AttemptResult(
+                    False,
+                    error=f"replica {attempt.replica} closed mid-stream",
+                ))
+                return False
+            line = line.strip().decode(errors="replace")
+            if terminal is not None:
+                continue  # drain to EOF for connection reuse
+            if line.startswith(":"):
+                continue  # keepalive ping
+            if line.startswith("event:"):
+                event = line[6:].strip()
+                continue
+            if line.startswith("data:"):
+                data = line[5:].strip()
+                continue
+            if line:
+                continue
+            # blank line: dispatch the buffered event
+            if not event:
+                continue
+            try:
+                payload = json.loads(data) if data else {}
+            except json.JSONDecodeError:
+                payload = {}
+            if event == "tokens":
+                delta = payload.get("tokens") or []
+                tokens.extend(delta)
+                if on_tokens is not None and delta:
+                    try:
+                        on_tokens(attempt, list(delta))
+                    except Exception:  # noqa: BLE001 - sink is advisory
+                        log.exception("on_tokens callback failed")
+            elif event in ("done", "error"):
+                terminal = event
+                self._graft(trace, payload, t_send)
+                if event == "done":
+                    final = payload.get("tokens")
+                    result = AttemptResult(
+                        True,
+                        tokens=list(final) if final is not None else tokens,
+                    )
+                    if attempt.finish(result) and result.ok:
+                        with self._lock:
+                            self.decodes[request.request_id] = (
+                                self.decodes.get(request.request_id, 0) + 1
+                            )
+                else:
+                    attempt.finish(AttemptResult(
+                        False, error=str(payload.get("error", "error"))
+                    ))
+            event, data = "", ""
+
+    def _graft(self, trace, payload: dict, t_send: float) -> None:
+        """Stitch the replica-side spans under the gateway's dispatch
+        span.  The offset maps the replica's monotonic clock onto ours:
+        anchored at send time, so the whole remote subtree lands inside
+        the dispatch span's window (network time on either side only
+        widens the containment margin)."""
+        if trace is None:
+            return
+        spans = payload.get("spans") or []
+        t_recv = payload.get("t_recv")
+        if not spans or t_recv is None:
+            return
+        try:
+            trace.tracer.graft(trace, spans, t_send - float(t_recv))
+        except Exception:  # noqa: BLE001 - tracing must never break serving
+            log.exception("span graft failed")

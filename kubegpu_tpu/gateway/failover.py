@@ -220,8 +220,25 @@ class Dispatcher:
                 target = routed_pick(frozenset())
             return target
 
+        abort = getattr(request, "abort", None)
+
         while True:
             now = time.monotonic()
+            if abort is not None and abort.is_set():
+                # the caller vanished (streaming disconnect): cancel
+                # every in-flight attempt — wire-level, so the replica
+                # frees the sequence's pages — and fail explicitly; a
+                # request nobody will read must not keep decoding
+                for a in attempts:
+                    if not a.done:
+                        self.client.cancel(a)
+                    self._settle(a)
+                if self.metrics:
+                    self.metrics.inc("gateway_stream_disconnects_total")
+                return DispatchOutcome(
+                    "error", error="cancelled: caller disconnected",
+                    attempts=n_attempts, hedged=hedged,
+                )
             if now >= deadline:
                 for a in attempts:
                     if not a.done:
@@ -321,6 +338,12 @@ class Dispatcher:
                 and len(attempts) == 1
                 and hedge_at is not None
                 and now >= hedge_at
+                # a STREAMING request never hedges: its caller follows
+                # one attempt's token stream, and a twin racing it could
+                # win the terminal result with a stream nobody read
+                # (retries still apply — a failed stream re-dispatches,
+                # and the terminal result stays authoritative)
+                and not getattr(request, "no_hedge", False)
             ):
                 target = routed_pick(frozenset(tried), hedge=True)
                 if target is None:

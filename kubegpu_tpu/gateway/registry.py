@@ -45,6 +45,12 @@ class ReplicaInfo:
     coords: FrozenSet[Coord] = field(default_factory=frozenset)
     healthy: bool = True
     reason: str = ""              # why not healthy (operator-facing)
+    # data-plane address (status.podIP): where the replica's HTTP serving
+    # endpoint lives — the HttpReplicaClient resolves "addr:port" from
+    # this.  None until kubelet reports the IP (the HTTP probe then
+    # holds the replica out of the live set, which is correct: a replica
+    # without a routable address cannot serve)
+    addr: Optional[str] = None
 
 
 class ReplicaRegistry:
@@ -56,9 +62,19 @@ class ReplicaRegistry:
     client uses it to model the pod's process dying with its chips.
     """
 
-    def __init__(self, api: ApiServer, group: Optional[str] = None) -> None:
+    def __init__(self, api: ApiServer, group: Optional[str] = None,
+                 probe=None) -> None:
         self.api = api
         self.group = group  # None = every serving group
+        # optional DATA-PLANE health probe: called with each replica the
+        # annotation join believes healthy; (False, why) drains it.  The
+        # HTTP data plane wires HttpReplicaClient.probe here so in-cluster
+        # liveness is the serving endpoint answering /healthz, not just
+        # the control plane's chip-health join.  Probes run serially
+        # inside the refresh (bounded ~1 s timeout each) — fine for the
+        # replica counts a gateway fronts; sample or parallelize before
+        # pointing this at hundreds of replicas.
+        self.probe = probe
         self._lock = threading.Lock()
         # serializes whole refresh cycles (LIST → join → swap): the watch
         # handlers and the periodic loop both call refresh(), and an older
@@ -107,7 +123,9 @@ class ReplicaRegistry:
             key = f"{ns}/{name}"
             node = (obj.get("spec") or {}).get("nodeName") or ""
             a = annotations.assignment_from_pod(obj)
-            phase = ((obj.get("status") or {}).get("phase") or "")
+            status = obj.get("status") or {}
+            phase = (status.get("phase") or "")
+            addr = status.get("podIP") or None
             healthy, reason = True, ""
             coords: FrozenSet[Coord] = frozenset()
             slice_id = None
@@ -129,11 +147,21 @@ class ReplicaRegistry:
                     )
                     if dead:
                         healthy, reason = False, f"dead chips {dead}"
-            replicas[key] = ReplicaInfo(
+            info = ReplicaInfo(
                 key=key, pod=name, namespace=ns, group=group, node=node,
                 slice_id=slice_id, coords=coords, healthy=healthy,
-                reason=reason,
+                reason=reason, addr=addr,
             )
+            if healthy and self.probe is not None:
+                ok, why = self.probe(info)
+                if not ok:
+                    info = ReplicaInfo(
+                        key=key, pod=name, namespace=ns, group=group,
+                        node=node, slice_id=slice_id, coords=coords,
+                        healthy=False, reason=f"data plane: {why}",
+                        addr=addr,
+                    )
+            replicas[key] = info
 
         with self._lock:
             self._replicas = replicas

@@ -5,9 +5,15 @@ behavior is testable without sockets; this module only translates HTTP:
 
     POST /v1/generate   {"prompt": [ints], "max_new_tokens": n,
                          "tenant": "...", "session": "...",
-                         "temperature": t, "deadline_s": s}
+                         "temperature": t, "deadline_s": s,
+                         "stream": bool}
         → 200 {"tokens": [...], "replica": "...", "attempts": n,
                "hedged": bool}
+        → 200 text/event-stream when "stream": true — committed token
+          batches relayed from the replica as ``tokens`` events, then a
+          terminal ``done`` (the authoritative full result) or ``error``
+          event; a caller that disconnects mid-stream cancels the
+          request all the way down to the replica's page pool
         → 429 {"error": ...}   explicit backpressure (queue full)
         → 502 {"error": ...}   all attempts failed
         → 504 {"error": ...}   deadline exceeded
@@ -126,6 +132,9 @@ def make_handler(gateway: Gateway, registry: ReplicaRegistry):
             if not request.prompt:
                 self._send(400, {"error": "bad request: empty prompt"})
                 return
+            if body.get("stream"):
+                self._stream_generate(request)
+                return
             # blocking unary call: the handler thread IS the caller's
             # connection; backpressure resolves instantly, decode blocks
             # until the dispatcher delivers
@@ -143,6 +152,91 @@ def make_handler(gateway: Gateway, registry: ReplicaRegistry):
             else:
                 payload["error"] = result.error
             self._send(code, payload)
+
+        # -- streaming pass-through ------------------------------------
+        def _chunk(self, data: bytes) -> None:
+            # the replica endpoint's framing helpers, shared so the two
+            # SSE surfaces cannot drift apart
+            from kubegpu_tpu.gateway.dataplane import write_chunk
+
+            write_chunk(self.wfile, data)
+
+        def _stream_generate(self, request) -> None:
+            """SSE pass-through: committed token batches relayed from
+            the data plane as they stream off the replica, then the
+            terminal result.  The done event's token list is
+            AUTHORITATIVE — with hedging, the attempt that streamed may
+            lose the race to a twin; the winner's full result closes the
+            stream either way.  A vanished caller fails the next write,
+            which sets the request's abort event: the dispatcher cancels
+            every in-flight attempt wire-level, so the replica frees the
+            sequence's pages."""
+            import queue as _queue
+
+            sink: "_queue.Queue" = _queue.Queue()
+            first = []  # the one attempt allowed to stream (hedge guard)
+
+            def on_tokens(attempt, delta):
+                if not first:
+                    first.append(attempt)
+                if first[0] is attempt:
+                    sink.put(delta)
+
+            from kubegpu_tpu.gateway.dataplane import end_chunks, sse_event
+
+            request.on_tokens = on_tokens
+            request.abort = threading.Event()
+            request.no_hedge = True  # one caller, one stream
+            gateway.metrics.inc("gateway_stream_requests_total")
+            pending = gateway.submit(request)
+            # ONLY a refusal short-circuits to plain JSON (429): any
+            # other instantly-resolved result (a fast completion racing
+            # this check) must still stream its terminal event, tokens
+            # included — the SSE contract the caller asked for
+            if pending.wait(0.0) and pending.result().status == "rejected":
+                result = pending.result()
+                self._send(_STATUS_HTTP.get(result.status, 500), {
+                    "request_id": result.request_id,
+                    "status": result.status, "error": result.error,
+                })
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            try:
+                while not pending.wait(0.0):
+                    try:
+                        delta = sink.get(timeout=0.2)
+                    except _queue.Empty:
+                        self._chunk(b": ping\n\n")
+                        continue
+                    gateway.metrics.inc(
+                        "gateway_stream_tokens_total", len(delta)
+                    )
+                    self._chunk(sse_event("tokens", {"tokens": delta}))
+                result = pending.result()
+                payload = {
+                    "request_id": result.request_id,
+                    "status": result.status,
+                }
+                if result.status == "ok":
+                    payload.update(
+                        tokens=result.tokens, replica=result.replica,
+                        attempts=result.attempts, hedged=result.hedged,
+                    )
+                    event = "done"
+                else:
+                    payload["error"] = result.error
+                    event = "error"
+                self._chunk(sse_event(event, payload))
+                end_chunks(self.wfile)
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                # the caller vanished: propagate the cancel downstream
+                # (dispatcher counts gateway_stream_disconnects_total)
+                request.abort.set()
+                self.close_connection = True
 
     return Handler
 
@@ -320,9 +414,16 @@ def main(argv=None) -> None:
     ap.add_argument(
         "--sim-data-plane", action="store_true",
         help="in-cluster mode: wire an in-process SimBatcher data "
-        "plane (fabricated tokens — cluster smoke only).  Default is "
-        "discovery/metrics only: /readyz stays 503 so the instance "
-        "never joins the Service",
+        "plane instead of the real HTTP one (fabricated tokens — "
+        "cluster smoke only)",
+    )
+    ap.add_argument(
+        "--replica-port", type=int, default=8700,
+        help="in-cluster mode: the port each replica pod's HTTP serving "
+        "endpoint listens on (models.worker --serve-http).  The gateway "
+        "dispatches to podIP:port, health-checks replicas over it "
+        "(/readyz goes live from real replica health), and propagates "
+        "trace context across the wire",
     )
     ap.add_argument(
         "--token-budget", type=int, default=None,
@@ -424,15 +525,41 @@ def main(argv=None) -> None:
         registry = ReplicaRegistry(KubeApiServer(), group=args.group)
         from kubegpu_tpu.gateway.client import InMemoryReplicaClient
 
-        if args.sim_data_plane:
+        if not args.sim_data_plane:
+            # the REAL in-cluster data plane: stream from each replica
+            # pod's HTTP serving endpoint (models.worker --serve-http) at
+            # its discovered podIP.  The registry additionally
+            # health-checks every replica's /healthz each refresh, so
+            # /readyz is live serving capacity, not just annotation
+            # bookkeeping — the 503 fail-safe this flag-day retires.
+            from kubegpu_tpu.gateway.dataplane import HttpReplicaClient
+
+            def _resolve(key, _port=args.replica_port):
+                # one snapshot read: the refresh thread swaps the
+                # registry table concurrently
+                info = registry.get(key)
+                if info is None or not info.addr:
+                    return None
+                return f"{info.addr}:{_port}"
+
+            client = HttpReplicaClient(
+                resolver=_resolve,
+                default_port=args.replica_port,
+            )
+            registry.probe = client.probe
+            registry.subscribe(client.sync_live)
+            log.info(
+                "HTTP data plane: dispatching to replica pods on port "
+                "%d (podIP discovery + /healthz probes)",
+                args.replica_port,
+            )
+        else:
             # OPT-IN in-process data plane (cluster smoke tests): every
             # replica the registry discovers gets a worker driving a
             # local SimBatcher, so the gateway is live end to end and
             # /readyz goes 200 the moment a replica is wired — 503
             # again only when the registry drains to zero.  Tokens are
-            # fabricated; never expose this to real clients.  A remote
-            # HTTP data-plane client that dispatches to the replica
-            # pods themselves is the tracked next step (ROADMAP).
+            # fabricated; never expose this to real clients.
             from kubegpu_tpu.gateway.client import SimBatcher
 
             client = InMemoryReplicaClient(
@@ -448,20 +575,6 @@ def main(argv=None) -> None:
             log.warning(
                 "--sim-data-plane: serving FABRICATED tokens from "
                 "in-process SimBatchers — cluster smoke only"
-            )
-        else:
-            # fail-safe default: discovery-only — no wired replicas, so
-            # /readyz stays 503 (zero live data-plane replicas) and the
-            # instance never joins the Service; an honest NotReady
-            # beats converting traffic into guaranteed 5xx (or worse,
-            # fabricated tokens)
-            client = InMemoryReplicaClient(batcher_factory=None)
-            log.warning(
-                "in-cluster data plane not wired: replica discovery "
-                "and /metrics are live, but /readyz reports 503 and no "
-                "traffic is served (--sim-data-plane wires an "
-                "in-process smoke data plane; --fake-cluster runs the "
-                "full demo)"
             )
     from kubegpu_tpu.gateway.failover import FailoverPolicy
 

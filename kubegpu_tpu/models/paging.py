@@ -1913,6 +1913,18 @@ class PagedContinuousBatcher(_TracedBatcher):
     def has_work(self) -> bool:
         return bool(self._pending) or any(s.seq_id >= 0 for s in self._seqs)
 
+    def live_tokens(self) -> Dict[int, List[int]]:
+        """Committed tokens of every live sequence — the incremental
+        streaming surface the HTTP data plane flushes after each
+        ``serve_step``.  Under the pipelined loop the host mirror
+        advances only at the designated readback, one iteration late, so
+        each delta here IS a committed batch (never a token the device
+        could still roll back)."""
+        return {
+            s.seq_id: list(s.tokens)
+            for s in self._seqs if s.seq_id >= 0
+        }
+
     def _reset_stats(self) -> None:
         self.stats = {
             "steps": 0, "admits": 0, "peak_pages": 0, "prefill_chunks": 0,

@@ -793,6 +793,15 @@ class ContinuousBatcher(_TracedBatcher):
     def has_work(self) -> bool:
         return bool(self._pending) or any(s.seq_id >= 0 for s in self._slots)
 
+    def live_tokens(self) -> Dict[int, List[int]]:
+        """Committed tokens of every live sequence — the incremental
+        streaming surface the HTTP data plane (gateway/dataplane.py)
+        flushes after each ``serve_step``."""
+        return {
+            s.seq_id: list(s.tokens)
+            for s in self._slots if s.seq_id >= 0
+        }
+
     def _sweep(self, finished: Dict[int, List[int]]) -> None:
         # sweep until a full pass makes no progress: an admit can
         # complete INSTANTLY (max_new=1, or the first token is EOS),

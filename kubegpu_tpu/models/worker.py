@@ -598,6 +598,9 @@ def _run_decode_batched(args, params, max_seq: int, t0: float) -> int:
             mesh=mesh, **spec_kw,
         )
 
+    if args.serve_http is not None:
+        return _serve_http(args, cb, t0)
+
     rng = np.random.RandomState(0)
     n_req = args.batch_per_chip * 2
     budgets = [
@@ -639,6 +642,47 @@ def _run_decode_batched(args, params, max_seq: int, t0: float) -> int:
     return 0
 
 
+def _serve_http(args, cb, t0: float) -> int:
+    """--serve-http: expose the batcher as a REPLICA HTTP serving
+    endpoint (gateway/dataplane.py) — POST /v1/submit streams one SSE
+    event per committed token batch, POST /v1/cancel frees the
+    sequence's pages wire-level, GET /v1/state advertises the serving
+    contract (tp, page economy), GET /healthz answers the gateway
+    registry's probe.  This is the pod-side half of the distributed
+    data plane: the gateway dispatches to podIP:port and stitches the
+    replica's trace spans under its own dispatch span."""
+    import signal
+    import threading
+
+    import numpy as np
+
+    from kubegpu_tpu.gateway.dataplane import ReplicaServer
+
+    # pay the program compiles BEFORE advertising the port: the first
+    # real request must meet a warm batcher, not a compile wall
+    cb.submit(0, np.asarray([1, 2, 3], np.int32), 2)
+    while cb.has_work():
+        cb.serve_step()
+    server = ReplicaServer(
+        cb, listen=("0.0.0.0", args.serve_http),
+        step_delay_s=args.serve_http_step_delay,
+    )
+    server.start()
+    print(
+        f"REPLICA_HTTP_SERVING port={server.port} serving={args.serving} "
+        f"seconds={time.monotonic() - t0:.2f}",
+        flush=True,
+    )
+    shutdown = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: shutdown.set())
+    try:
+        shutdown.wait()
+    except KeyboardInterrupt:
+        pass
+    server.stop()
+    return 0
+
+
 def _run_decode(args, t0: float) -> int:
     """Serving mode: KV-cached greedy decode (models/decoding.py) of the
     lm family's param contract.  With --ckpt-dir it restores the TRAINED
@@ -656,6 +700,15 @@ def _run_decode(args, t0: float) -> int:
 
     max_seq = args.seq + 1  # the lm family trains seq+1 windows; pos_embed
     # (and therefore any restored checkpoint) is sized to it
+    if args.serve_http is not None and args.serving not in (
+        "continuous", "paged"
+    ):
+        raise SystemExit(
+            f"--serve-http with --serving {args.serving}: the replica "
+            "HTTP endpoint drives the incremental serving API "
+            "(submit/serve_step/cancel) — use --serving continuous or "
+            "--serving paged"
+        )
     if args.serving == "static" and args.tp > 1:
         raise SystemExit(
             f"--tp {args.tp} with --serving static: tensor-parallel "
@@ -822,6 +875,20 @@ def main(argv=None) -> int:
     ap.add_argument("--serve", action="store_true",
                     help="decode: loop forever as a serving replica "
                     "(default: benchmark a few calls and exit)")
+    ap.add_argument("--serve-http", type=int, default=None, metavar="PORT",
+                    help="decode --serving continuous|paged: serve as a "
+                    "REPLICA HTTP endpoint on this port (0 = ephemeral; "
+                    "the chosen port prints as REPLICA_HTTP_SERVING) — "
+                    "POST /v1/submit streams committed token batches as "
+                    "SSE, /v1/cancel frees pages wire-level, /healthz "
+                    "answers the gateway's probe.  The gateway "
+                    "(gateway/server.py --replica-port) dispatches here")
+    ap.add_argument("--serve-http-step-delay", type=float, default=0.0,
+                    metavar="S",
+                    help="--serve-http: sleep this long between serving "
+                    "iterations (0 = flat out).  Chaos/test knob: slows "
+                    "the loop so kill/cancel schedules land provably "
+                    "mid-stream")
     ap.add_argument("--prompt-len", type=int, default=32,
                     help="decode: prompt tokens per request (prompt-len + "
                     "--steps must fit --seq + 1, the lm family's cache size)")

@@ -438,14 +438,25 @@ class GatewaySoak:
     serves from sealed pages.  With kills/hedge-cancels interleaved,
     this is the schedule that hunts decode-page refcount leaks: a
     session cancelled mid-turn must release every sealed page it
-    registered or acquired."""
+    registered or acquired.
+
+    ``http=True`` swaps the data plane for the REAL wire: each replica
+    is a ``ReplicaServer`` on a loopback socket (its own serving thread
+    driving the batcher), the gateway dispatches through
+    ``HttpReplicaClient`` (SSE streams, wire-level cancels), a kill
+    stops the replica's HTTP server (in-flight streams error, new
+    submissions meet connection refusal), and a new ``disconnect`` op
+    abandons a raw mid-stream socket so the replica's disconnect⇒cancel
+    path runs under chaos.  The page-accounting invariant then holds
+    ACROSS THE WIRE: whatever the kill/cancel/disconnect schedule did,
+    every surviving replica's pool must balance at quiescence."""
 
     def __init__(self, seed: int, n_replicas: int = 4,
                  batcher_factory=None, multiturn: bool = False,
-                 follow_prompt_cap: int = 12):
+                 follow_prompt_cap: int = 12, http: bool = False):
         from kubegpu_tpu.gateway import (
-            AdmissionQueue, FailoverPolicy, Gateway, InMemoryReplicaClient,
-            SimBatcher,
+            AdmissionQueue, FailoverPolicy, Gateway, HttpReplicaClient,
+            InMemoryReplicaClient, ReplicaServer, SimBatcher,
         )
         from kubegpu_tpu.testing.fake_serving import build_fake_serving_stack
 
@@ -458,11 +469,21 @@ class GatewaySoak:
         self.advs = stack.advs
         self.sched = stack.sched
         self.registry = stack.registry
-        self.client = InMemoryReplicaClient(
-            batcher_factory=batcher_factory
-            or (lambda key: SimBatcher(slots=8)),
-            step_delay_s=0.001,
+        self.http = http
+        self.batcher_factory = (
+            batcher_factory or (lambda key: SimBatcher(slots=8))
         )
+        self.servers = {}    # http lane: replica key -> ReplicaServer
+        if http:
+            self.registry.refresh()
+            self.client = HttpReplicaClient(metrics=Metrics())
+            for rep in self.registry.live():
+                self._start_server(rep.key)
+        else:
+            self.client = InMemoryReplicaClient(
+                batcher_factory=self.batcher_factory,
+                step_delay_s=0.001,
+            )
         self.registry.subscribe(self.client.sync_live)
         self.metrics = Metrics()
         from kubegpu_tpu.utils.tracing import Tracer
@@ -492,6 +513,22 @@ class GatewaySoak:
         self.follow_prompt_cap = follow_prompt_cap
         self._session_prompts = {}  # request_id -> (session, prompt)
         self._followed = set()      # request_ids already extended
+
+    # -- http-lane plumbing ------------------------------------------------
+    def _start_server(self, key: str) -> None:
+        """Bring up (or cold-restart) one replica's HTTP serving endpoint
+        on a fresh loopback port and point the client at it — the wire
+        twin of a pod restarting with a cold cache."""
+        from kubegpu_tpu.gateway import ReplicaServer
+
+        old = self.servers.pop(key, None)
+        if old is not None:
+            old.stop()
+        srv = ReplicaServer(
+            self.batcher_factory(key), step_delay_s=0.001
+        ).start()
+        self.servers[key] = srv
+        self.client.set_endpoint(key, srv.endpoint)
 
     # -- ops ---------------------------------------------------------------
     def op_burst(self):
@@ -564,7 +601,15 @@ class GatewaySoak:
         if len(live) < 2:
             return "kill (noop: must keep one replica)"
         key = self.rng.choice(live)
-        self.client.fail_replica(key)       # process dies with its chips
+        if self.http:
+            # the serving process dies: its HTTP server stops (in-flight
+            # streams error out, new connections are refused — genuine
+            # wire-level partial failure), then its chips go with it
+            srv = self.servers.pop(key, None)
+            if srv is not None:
+                srv.stop()
+        else:
+            self.client.fail_replica(key)   # process dies with its chips
         rep = self.registry.get(key)
         for coords in rep.coords:
             self.slices[rep.slice_id].kill_chip(coords)
@@ -581,6 +626,8 @@ class GatewaySoak:
         rep = self.registry.get(key)
         for coords in rep.coords:
             self.slices[rep.slice_id].revive_chip(coords)
+        if self.http:
+            self._start_server(key)  # cold restart on a fresh port
         for a in self.advs.values():
             a.advertise_once()
         self.registry.refresh()  # sync_live restarts the replica cold
@@ -593,8 +640,56 @@ class GatewaySoak:
             return "straggle (noop)"
         key = self.rng.choice(live)
         slow = self.rng.random() < 0.6
-        self.client.set_step_delay(key, 0.03 if slow else 0.001)
+        delay = 0.03 if slow else 0.001
+        if self.http:
+            srv = self.servers.get(key)
+            if srv is None:
+                return "straggle (noop)"
+            srv.loop.step_delay_s = delay
+        else:
+            self.client.set_step_delay(key, delay)
         return f"straggle {key} {'on' if slow else 'off'}"
+
+    def op_disconnect(self):
+        """HTTP lane only: a raw client submits straight to a replica
+        and VANISHES mid-stream (socket closed, no cancel sent).  The
+        replica's next write fails and must cancel the sequence — the
+        disconnect⇒cancel page-freeing path, exercised under the same
+        chaos as everything else.  Bypasses the gateway on purpose: I5's
+        request accounting stays clean while the replica-side invariant
+        gets hunted."""
+        import http.client as _http
+        import json as _json
+        import time as _time
+
+        if not self.http:
+            return "disconnect (noop: in-memory lane)"
+        keys = [k for k in self.servers if k not in self.dead]
+        if not keys:
+            return "disconnect (noop: no live server)"
+        key = self.rng.choice(sorted(keys))
+        srv = self.servers[key]
+        host, port = srv.address
+        conn = _http.HTTPConnection(host, port, timeout=5.0)
+        rid = f"disc{self.n}"
+        self.n += 1
+        try:
+            conn.request(
+                "POST", "/v1/submit",
+                _json.dumps({
+                    "request_id": rid, "prompt": [1, 2, 3],
+                    "max_new_tokens": 8,
+                }),
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            resp.fp.read(1)   # raw read: leave the stream mid-flight
+            _time.sleep(self.rng.choice([0.0, 0.01, 0.03]))
+        except OSError:
+            pass  # the replica died under us: equally a disconnect
+        finally:
+            conn.close()      # abandon without cancel
+        return f"disconnect {key} ({rid})"
 
     def op_settle(self):
         import time
@@ -632,11 +727,32 @@ class GatewaySoak:
         # page-accounting invariant: at quiescence every surviving
         # replica's KV pool must balance — no page leaked by a kill,
         # cancel, or hedge loser anywhere in the schedule (duck-typed:
-        # SimBatcher has no pool, paged batchers do)
-        with self.client._lock:
-            workers = list(self.client._workers.values())
-        for w in workers:
-            check = getattr(w.batcher, "assert_page_accounting", None)
+        # SimBatcher has no pool, paged batchers do).  In the HTTP lane
+        # this is the ACROSS-THE-WIRE claim: the batcher sits behind a
+        # socket, and every cancel that freed its pages was a wire-level
+        # one (explicit /v1/cancel, or a vanished client's failed write)
+        if self.http:
+            import time as _time
+
+            batchers = [srv.batcher for srv in self.servers.values()]
+            # raw-disconnect sequences drain outside the gateway's
+            # accounting: give their cancels (bounded by the SSE ping
+            # cadence) their moment before judging the pools
+            deadline = _time.monotonic() + 10.0
+            while (any(b.has_work() for b in batchers)
+                   and _time.monotonic() < deadline):
+                _time.sleep(0.01)
+            for b in batchers:
+                assert not b.has_work(), (
+                    f"replica batcher still decoding at quiescence\n{trace}"
+                )
+        else:
+            with self.client._lock:
+                batchers = [
+                    w.batcher for w in self.client._workers.values()
+                ]
+        for b in batchers:
+            check = getattr(b, "assert_page_accounting", None)
             if check is not None:
                 check()
         self.check_traces(trace)
@@ -706,6 +822,11 @@ class GatewaySoak:
             # weighted like the burst: turn 2s should be common enough
             # that kills land while sealed decode pages are referenced
             ops.append((self.op_multiturn, 4))
+        if self.http:
+            # mid-stream client disconnects belong in the chaos mix: the
+            # replica's disconnect⇒cancel path must hold page accounting
+            # under kills and stragglers, not just in a quiet unit test
+            ops.append((self.op_disconnect, 2))
         bag = [f for f, w in ops for _ in range(w)]
         try:
             for _ in range(steps):
@@ -715,3 +836,5 @@ class GatewaySoak:
         finally:
             self.gw.stop()
             self.client.stop()
+            for srv in self.servers.values():
+                srv.stop()

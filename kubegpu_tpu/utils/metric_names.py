@@ -87,6 +87,35 @@ CATALOG: Dict[str, MetricSpec] = {
     "gateway_session_repin_total": _c(
         (), "session re-pins after the pinned replica drained (KV loss)"),
 
+    # -- gateway streaming pass-through (gateway/server.py, failover.py)
+    "gateway_stream_requests_total": _c(
+        (), "streaming (SSE) /v1/generate requests accepted"),
+    "gateway_stream_tokens_total": _c(
+        (), "tokens relayed to streaming callers as they came off "
+        "replicas (the terminal result stays authoritative)"),
+    "gateway_stream_disconnects_total": _c(
+        (), "streaming callers that vanished mid-stream; each one "
+        "cancelled its in-flight attempts wire-level (replica pages "
+        "freed)"),
+
+    # -- replica HTTP serving endpoint (gateway/dataplane.py): the
+    #    pod-side half of the distributed data plane
+    "replica_http_requests_total": _c(
+        ("verb",), "replica endpoint requests by verb "
+        "(submit/cancel/state/get)"),
+    "replica_http_stream_events_total": _c(
+        (), "SSE data events written to submit streams "
+        "(tokens/done/error; pings not counted)"),
+    "replica_http_streams_active": _g(
+        (), "submit streams currently open (one per in-flight remote "
+        "request)"),
+    "replica_http_cancels_total": _c(
+        (), "sequences cancelled wire-level (/v1/cancel, or a "
+        "duplicate-id eviction)"),
+    "replica_http_disconnect_cancels_total": _c(
+        (), "sequences cancelled because their stream's client "
+        "vanished mid-stream (disconnect ⇒ cancel; pages freed)"),
+
     # -- serving data plane (models/serving.py, models/paging.py)
     "serve_ttft_seconds": _h((), "submit -> first generated token"),
     "serve_itl_seconds": _h((), "inter-token latency between emits"),

@@ -37,9 +37,14 @@ is answerable from data instead of print statements.  Design points:
   trace from a production incident replays through the same oracles the
   tests use.
 
-Cross-process propagation (a remote data-plane client carrying trace
-ids over HTTP) is deliberately out of scope until the wire data plane
-lands (ROADMAP item 1): in-process, the SpanCtx handle IS the context.
+Cross-process propagation: in-process the SpanCtx handle IS the
+context; across the wire (gateway/dataplane.py) the dispatch span's
+ids travel as ``X-Trace-Id``/``X-Span-Id`` headers, the replica serves
+under its OWN tracer, ships its finished span dicts back in the
+stream's terminal event, and ``Tracer.graft`` renumbers them into the
+gateway's tree under the dispatch span — offsetting the remote
+monotonic clock so the subtree lands inside the dispatch window.  One
+request, one tree, two processes.
 """
 
 from __future__ import annotations
@@ -190,6 +195,53 @@ class Tracer:
                 del self._open[ctx.trace_id]
                 del self._open_spans[ctx.trace_id]
                 self._complete_locked(ctx.trace_id, spans)
+
+    def graft(self, parent: SpanCtx, spans: Iterable[dict],
+              offset: float = 0.0) -> int:
+        """Stitch a FOREIGN trace's spans (a remote replica's, shipped
+        back over the wire as dicts) under ``parent`` — the cross-process
+        half of request tracing.  Span ids are renumbered into this
+        tracer's id space, the remote root re-parents onto ``parent``,
+        and ``offset`` maps the remote monotonic clock onto ours (the
+        caller anchors the remote receive stamp at its own send time, so
+        the subtree lands inside the parent's window).  Every grafted
+        span arrives CLOSED — a remote span still open at dump time is
+        force-closed at its start and marked ``remote_unclosed`` — so
+        grafting never changes when the local trace completes.  Returns
+        the span count grafted (0 when the parent's trace has already
+        completed: a hedge loser's late stream must never resurrect a
+        finished tree)."""
+        spans = list(spans)
+        with self._lock:
+            target = self._open.get(parent.trace_id)
+            if target is None or parent.span_id < 0 or not spans:
+                return 0
+            idmap: Dict[int, int] = {}
+            for s in sorted(spans, key=lambda s: s["span"]):
+                self._next_span += 1
+                idmap[s["span"]] = self._next_span
+            for s in sorted(spans, key=lambda s: s["span"]):
+                attrs = dict(s.get("attrs") or {}, remote=True)
+                end = s.get("end")
+                if end is None:
+                    end = s["start"]
+                    attrs["remote_unclosed"] = True
+                parent_id = s.get("parent")
+                new = {
+                    "trace": parent.trace_id,
+                    "span": idmap[s["span"]],
+                    # a remote orphan (its parent missing from the dump)
+                    # re-parents onto the graft point too — the local
+                    # tree must stay orphan-free whatever arrived
+                    "parent": idmap.get(parent_id, parent.span_id)
+                    if parent_id is not None else parent.span_id,
+                    "name": s["name"],
+                    "start": s["start"] + offset,
+                    "end": end + offset,
+                    "attrs": attrs,
+                }
+                target[new["span"]] = new
+            return len(spans)
 
     def _complete_locked(self, trace_id: str, spans: Dict[int, dict]) -> None:
         self._completed[trace_id] = spans

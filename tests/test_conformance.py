@@ -52,6 +52,7 @@ def cluster(tmp_path):
     signing CA via tlsConfig — the production scheduler-config.yaml is
     consumed as-is, with only the cluster-local host and CA paths
     retargeted at the live server and freshly-minted cert."""
+    pytest.importorskip("cryptography")  # optional TLS test dependency
     from kubegpu_tpu.testing.tlsutil import make_self_signed
 
     api = InMemoryApiServer()
@@ -206,6 +207,7 @@ def test_bearer_token_gates_privileged_verbs(tmp_path):
     import urllib.error
     import urllib.request
 
+    pytest.importorskip("cryptography")  # optional TLS test dependency
     from kubegpu_tpu.testing import ExtenderConfig
     from kubegpu_tpu.testing.tlsutil import make_self_signed
 

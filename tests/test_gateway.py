@@ -687,8 +687,10 @@ def test_readyz_tracks_live_replicas_not_hardcoded():
 
 def test_readyz_503_when_data_plane_unwired():
     """A client that can reach nothing (no workers, no factory) keeps
-    /readyz at 503 however many replicas the registry sees — the
-    default in-cluster posture (no --sim-data-plane)."""
+    /readyz at 503 however many replicas the registry sees.  (The
+    in-cluster default is now the HTTP data plane — see
+    tests/test_http_data_plane.py for readiness driven by live replica
+    probes; this pins the degenerate no-data-plane posture.)"""
     import http.client
 
     c = make_serving_cluster(1)

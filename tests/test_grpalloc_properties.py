@@ -5,7 +5,14 @@ allocator coverage (SURVEY.md §4)."""
 
 from typing import Dict
 
-from hypothesis import given, settings, strategies as st
+import pytest
+
+# optional property-testing dependency: a box without it SKIPS the whole
+# module cleanly instead of erroring collection (noise drowning real
+# regressions in the tier-1 run)
+pytest.importorskip("hypothesis")
+
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from kubegpu_tpu.grpalloc import (
     build_slice_views,

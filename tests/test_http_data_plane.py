@@ -1,0 +1,551 @@
+"""The distributed data plane end to end, over REAL loopback sockets
+(ISSUE 10): replica HTTP serving (``ReplicaServer``) + the streaming
+``HttpReplicaClient`` with wire-level cancel.
+
+The acceptance claims:
+
+- gateway → 2 HTTP replicas serves token-IDENTICALLY to the in-memory
+  data plane (same tiny fp32 paged batchers both sides);
+- a mid-stream cancel — and a client that simply vanishes — frees the
+  sequence's pages ON THE REPLICA, across the wire;
+- a deadline-expired attempt cancels on the wire (the replica stops
+  decoding, not just the gateway);
+- a request's trace tree spans BOTH processes: replica-side serve spans
+  grafted under the gateway's dispatch span, one retire per serve
+  subtree still enforced;
+- in-cluster readiness is REAL: the registry's HTTP probe drains a
+  replica whose serving endpoint dies, and /readyz follows;
+- the GatewaySoak kill schedule holds page accounting across the wire
+  (SimBatcher lane fast; the paged spec+multiturn schedule slow).
+"""
+
+import http.client
+import json
+import socket
+import threading
+import time
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubegpu_tpu.gateway import (
+    FailoverPolicy,
+    Gateway,
+    GatewayRequest,
+    GatewayServer,
+    HttpReplicaClient,
+    InMemoryReplicaClient,
+    ReplicaServer,
+    SimBatcher,
+)
+from kubegpu_tpu.models import TransformerLM
+from kubegpu_tpu.models.paging import PagedContinuousBatcher
+from kubegpu_tpu.testing.fake_serving import build_fake_serving_stack
+from kubegpu_tpu.utils.metrics import Metrics
+from kubegpu_tpu.utils.tracing import (
+    serve_retire_violations,
+    validate_trace,
+)
+
+TINY = dict(vocab_size=61, num_layers=1, num_heads=2, hidden=16, max_seq=48)
+PAGED_KW = dict(slots=3, prompt_pad=12, page_size=4, pool_pages=32,
+                dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return TransformerLM(dtype=jnp.float32, **TINY).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32)
+    )["params"]
+
+
+def _paged(tiny_params, **over):
+    kw = dict(PAGED_KW, **TINY)
+    kw.update(over)
+    return PagedContinuousBatcher(tiny_params, **kw)
+
+
+def _req(rid, prompt, max_new, **kw):
+    return types.SimpleNamespace(
+        request_id=rid, prompt=list(map(int, prompt)),
+        max_new_tokens=max_new, temperature=0.0, session=None, **kw,
+    )
+
+
+def _wait(cond, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# protocol basics (SimBatcher: fast, deterministic token mill)
+# ---------------------------------------------------------------------------
+
+def test_replica_server_streams_incremental_batches_then_done():
+    srv = ReplicaServer(SimBatcher(slots=4), step_delay_s=0.001).start()
+    client = HttpReplicaClient(endpoints={"r0": srv.endpoint})
+    try:
+        deltas = []
+        a = client.submit("r0", _req(
+            "rq", [1, 2, 3], 8, on_tokens=lambda at, d: deltas.append(d)
+        ))
+        assert a.wait(10) and a.result().ok, a.result()
+        expect = [(0 * 31 + i) % 256 for i in range(8)]
+        assert a.result().tokens == expect
+        # incremental events reassemble EXACTLY into the final stream,
+        # and genuinely arrived in more than one flush
+        assert sum(deltas, []) == expect
+        assert len(deltas) > 1, deltas
+        assert client.decodes.get("rq") == 1
+    finally:
+        srv.stop()
+        client.stop()
+
+
+def test_replica_state_advertises_contract_and_connection_reuse():
+    srv = ReplicaServer(SimBatcher(slots=4, tp=1)).start()
+    client = HttpReplicaClient(endpoints={"r0": srv.endpoint})
+    try:
+        a1 = client.submit("r0", _req("a", [1], 3))
+        assert a1.wait(10) and a1.result().ok
+        # the completed stream returns its connection to the pool (the
+        # reader thread checks it in right after resolving the attempt);
+        # the second submit must reuse it (the pool holds exactly one)
+        def pooled_count():
+            with client._lock:
+                return len(client._pool.get("r0", []))
+        _wait(lambda: pooled_count() == 1, timeout=10,
+              msg="connection returned to the pool")
+        with client._lock:
+            pooled = client._pool["r0"][0]
+        a2 = client.submit("r0", _req("b", [2], 3))
+        assert a2.wait(10) and a2.result().ok
+        _wait(lambda: pooled_count() == 1, timeout=10,
+              msg="connection back in the pool after reuse")
+        with client._lock:
+            assert client._pool["r0"] == [pooled]
+        assert client.advertised() == {"r0": {"tp": 1}}
+        state = client._get_state("r0")
+        assert state["slots"] == 4 and state["active_streams"] == 0
+    finally:
+        srv.stop()
+        client.stop()
+
+
+def test_unreachable_and_killed_replica_resolve_as_errors():
+    srv = ReplicaServer(SimBatcher(slots=2), step_delay_s=0.02).start()
+    client = HttpReplicaClient(endpoints={"r0": srv.endpoint})
+    try:
+        a = client.submit("nowhere", _req("x", [1], 4))
+        assert a.wait(1) and not a.result().ok
+        assert "unreachable" in a.result().error
+        inflight = client.submit("r0", _req("y", [1], 400))
+        time.sleep(0.05)
+        srv.stop()  # process death: in-flight stream errors explicitly
+        assert inflight.wait(10), "attempt hung across replica death"
+        assert not inflight.result().ok
+    finally:
+        client.stop()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: gateway → 2 HTTP replicas ≡ in-memory data plane
+# ---------------------------------------------------------------------------
+
+def test_gateway_http_replicas_token_identical_to_inmemory(tiny_params):
+    rs = np.random.RandomState(5)
+    prompts = [
+        rs.randint(0, 61, size=rs.randint(3, 12)).astype(np.int32)
+        for _ in range(6)
+    ]
+    budgets = [6, 10, 4, 8, 5, 12]
+
+    def drive(make_client):
+        stack = build_fake_serving_stack(2)
+        registry = stack.registry
+        registry.refresh()
+        client, servers = make_client(registry)
+        registry.subscribe(client.sync_live)
+        registry.refresh()
+        gw = Gateway(
+            registry, client, metrics=Metrics(), dispatchers=4,
+            policy=FailoverPolicy(deadline_s=60.0, hedge_after_s=30.0),
+        )
+        gw.start()
+        try:
+            pendings = [
+                gw.submit(GatewayRequest(
+                    prompt=[int(t) for t in prompts[i]],
+                    max_new_tokens=budgets[i], request_id=f"r{i}",
+                ))
+                for i in range(len(prompts))
+            ]
+            assert gw.drain(120.0)
+            out = {}
+            for i, p in enumerate(pendings):
+                r = p.result()
+                assert r.status == "ok", (i, r.status, r.error)
+                out[i] = r.tokens
+            return out
+        finally:
+            gw.stop()
+            client.stop()
+            for srv in servers:
+                srv.stop()
+
+    def http_client(registry):
+        client = HttpReplicaClient()
+        servers = []
+        for rep in registry.live():
+            srv = ReplicaServer(_paged(tiny_params)).start()
+            servers.append(srv)
+            client.set_endpoint(rep.key, srv.endpoint)
+        return client, servers
+
+    def inmemory_client(registry):
+        client = InMemoryReplicaClient(
+            batcher_factory=lambda key: _paged(tiny_params)
+        )
+        for rep in registry.live():
+            client.add_replica(rep.key)
+        return client, []
+
+    over_wire = drive(http_client)
+    in_memory = drive(inmemory_client)
+    # greedy fp32 paged decode is a pure function of (prompt, budget):
+    # the wire must be a TRANSPORT, not a numerics or bookkeeping layer
+    assert over_wire == in_memory
+
+
+# ---------------------------------------------------------------------------
+# acceptance: wire-level cancel frees pages on the replica
+# ---------------------------------------------------------------------------
+
+def test_midstream_cancel_frees_pages_across_the_wire(tiny_params):
+    cb = _paged(tiny_params)
+    srv = ReplicaServer(cb).start()
+    client = HttpReplicaClient(endpoints={"r0": srv.endpoint})
+    try:
+        deltas = []
+        a = client.submit("r0", _req(
+            "long", [1, 2, 3], 30,
+            on_tokens=lambda at, d: deltas.append(d),
+        ))
+        _wait(lambda: deltas, msg="first streamed tokens")
+        client.cancel(a)
+        assert a.wait(15), "cancel did not resolve the attempt"
+        assert not a.result().ok
+        # the replica must actually STOP (pages freed), not finish the
+        # budget into a stream nobody reads
+        _wait(lambda: not cb.has_work(), msg="replica idle after cancel")
+        assert sum(len(d) for d in deltas) < 30
+        cb.assert_page_accounting()
+    finally:
+        srv.stop()
+        client.stop()
+
+
+def test_client_disconnect_cancels_sequence_on_replica(tiny_params):
+    cb = _paged(tiny_params)
+    srv = ReplicaServer(cb).start()
+    try:
+        host, port = srv.address
+        s = socket.create_connection((host, port), timeout=10)
+        body = json.dumps({
+            "request_id": "vanish", "prompt": [4, 5, 6],
+            "max_new_tokens": 30,
+        }).encode()
+        s.sendall(
+            b"POST /v1/submit HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: %d\r\n\r\n" % len(body) + body
+        )
+        s.recv(256)   # response headers arrived: the stream is live
+        time.sleep(0.05)
+        s.close()     # vanish — no /v1/cancel, no clean shutdown
+        _wait(lambda: not cb.has_work(),
+              msg="replica cancelled the abandoned stream")
+        cb.assert_page_accounting()
+        assert srv.metrics.get(
+            "replica_http_disconnect_cancels_total") >= 1
+    finally:
+        srv.stop()
+
+
+def test_deadline_expired_attempt_cancels_on_the_wire():
+    # slow mill: 2 steps/s means the 100-token budget cannot finish
+    # inside the deadline — the CLIENT must cancel wire-level
+    batcher = SimBatcher(slots=2)
+    srv = ReplicaServer(batcher, step_delay_s=0.05).start()
+    client = HttpReplicaClient(endpoints={"r0": srv.endpoint})
+    try:
+        a = client.submit("r0", _req(
+            "dl", [1], 100, deadline_s=0.4, enqueued_at=time.monotonic(),
+        ))
+        assert a.wait(10), "deadline attempt never resolved"
+        assert not a.result().ok
+        assert "deadline" in a.result().error
+        _wait(lambda: not batcher.has_work(), timeout=10,
+              msg="replica stopped decoding after wire cancel")
+    finally:
+        srv.stop()
+        client.stop()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: one trace tree across both processes
+# ---------------------------------------------------------------------------
+
+def test_trace_tree_spans_gateway_and_replica(tiny_params):
+    stack = build_fake_serving_stack(1)
+    registry = stack.registry
+    registry.refresh()
+    cb = _paged(tiny_params)
+    srv = ReplicaServer(cb).start()
+    client = HttpReplicaClient()
+    client.set_endpoint(registry.live()[0].key, srv.endpoint)
+    gw = Gateway(registry, client, metrics=Metrics(), dispatchers=2)
+    gw.start()
+    try:
+        p = gw.submit(GatewayRequest(
+            prompt=[1, 2, 3, 4], max_new_tokens=5, request_id="traced",
+        ))
+        assert gw.drain(60.0) and p.result().status == "ok"
+        assert gw.tracer.wait_quiescent(10.0)
+        spans = next(
+            s for s in gw.tracer.completed()
+            if any(x["attrs"].get("request_id") == "traced" for x in s
+                   if x["parent"] is None)
+        )
+        problems = validate_trace(spans) + serve_retire_violations(spans)
+        assert not problems, problems
+        by_id = {s["span"]: s for s in spans}
+        serve = next(s for s in spans if s["name"] == "serve")
+        # the serve subtree is REMOTE (replica-side, grafted) and hangs
+        # under this gateway's dispatch span via the replica root
+        assert serve["attrs"].get("remote") is True
+        hop = by_id[serve["parent"]]
+        assert hop["name"] == "replica_request"
+        dispatch = by_id[hop["parent"]]
+        assert dispatch["name"] == "dispatch"
+        assert not dispatch["attrs"].get("remote")
+        # phase spans crossed the wire too: the replica-side decode span
+        # with its first-token annotation nests under serve
+        names = {s["name"] for s in spans if s["attrs"].get("remote")}
+        assert {"serve", "queue", "decode", "retire"} <= names
+    finally:
+        gw.stop()
+        client.stop()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: in-cluster /readyz from live HTTP replica health
+# ---------------------------------------------------------------------------
+
+def test_registry_http_probe_drives_readyz():
+    stack = build_fake_serving_stack(2)
+    registry = stack.registry
+    registry.refresh()
+    client = HttpReplicaClient()
+    servers = {}
+    for rep in registry.live():
+        srv = ReplicaServer(SimBatcher(slots=4)).start()
+        servers[rep.key] = srv
+        client.set_endpoint(rep.key, srv.endpoint)
+    registry.probe = client.probe
+    registry.subscribe(client.sync_live)
+    registry.refresh()
+    gw = Gateway(registry, client, metrics=Metrics(), dispatchers=2)
+    server = GatewayServer(gw, listen=("127.0.0.1", 0), watch=False)
+    server.start()
+    host, port = server.address
+
+    def readyz():
+        c = http.client.HTTPConnection(host, port, timeout=5)
+        c.request("GET", "/readyz")
+        r = c.getresponse()
+        body = r.read()
+        c.close()
+        return r.status, body.decode()
+
+    try:
+        assert readyz()[0] == 200
+        keys = sorted(servers)
+        # the control plane still believes in this pod (annotations,
+        # chip health) but its serving process is GONE: only the HTTP
+        # probe can know — and /readyz must follow it
+        servers[keys[0]].stop()
+        registry.refresh()
+        assert len(registry.live()) == 1
+        dead = next(r for r in registry.all() if not r.healthy)
+        assert "data plane" in dead.reason
+        assert readyz()[0] == 200  # one live replica still serves
+        servers[keys[1]].stop()
+        registry.refresh()
+        status, body = readyz()
+        assert status == 503, (status, body)
+    finally:
+        server.stop()
+        client.stop()
+        for srv in servers.values():
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# gateway SSE pass-through to the caller
+# ---------------------------------------------------------------------------
+
+def test_gateway_streams_tokens_through_to_caller():
+    stack = build_fake_serving_stack(1)
+    registry = stack.registry
+    registry.refresh()
+    client = HttpReplicaClient()
+    srv = ReplicaServer(SimBatcher(slots=4), step_delay_s=0.001).start()
+    client.set_endpoint(registry.live()[0].key, srv.endpoint)
+    gw = Gateway(registry, client, metrics=Metrics(), dispatchers=2)
+    server = GatewayServer(gw, listen=("127.0.0.1", 0), watch=False)
+    server.start()
+    host, port = server.address
+    try:
+        c = http.client.HTTPConnection(host, port, timeout=15)
+        c.request(
+            "POST", "/v1/generate",
+            json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 8,
+                        "stream": True}),
+            {"Content-Type": "application/json"},
+        )
+        r = c.getresponse()
+        assert r.status == 200
+        assert r.getheader("Content-Type") == "text/event-stream"
+        streamed, terminal, ev = [], None, None
+        while True:
+            line = r.readline()
+            if not line:
+                break
+            line = line.strip().decode()
+            if line.startswith("event:"):
+                ev = line[6:].strip()
+            elif line.startswith("data:") and ev:
+                data = json.loads(line[5:].strip())
+                if ev == "tokens":
+                    streamed += data["tokens"]
+                elif ev in ("done", "error"):
+                    terminal = (ev, data)
+        c.close()
+        assert terminal is not None and terminal[0] == "done", terminal
+        assert terminal[1]["status"] == "ok"
+        # un-hedged stream: the relayed deltas ARE the final result
+        assert not terminal[1]["hedged"]
+        assert streamed == terminal[1]["tokens"]
+        assert gw.metrics.get("gateway_stream_requests_total") == 1
+        assert gw.metrics.get("gateway_stream_tokens_total") == len(streamed)
+    finally:
+        server.stop()
+        client.stop()
+        srv.stop()
+
+
+def test_gateway_stream_caller_disconnect_cancels_down_to_replica(
+        tiny_params):
+    stack = build_fake_serving_stack(1)
+    registry = stack.registry
+    registry.refresh()
+    cb = _paged(tiny_params)
+    client = HttpReplicaClient()
+    # a slow decode loop: the budget CANNOT finish before the gateway
+    # notices the dead caller, so the test deterministically exercises
+    # the abort path instead of racing a fast completion
+    srv = ReplicaServer(cb, step_delay_s=0.05).start()
+    client.set_endpoint(registry.live()[0].key, srv.endpoint)
+    gw = Gateway(registry, client, metrics=Metrics(), dispatchers=2)
+    server = GatewayServer(gw, listen=("127.0.0.1", 0), watch=False)
+    server.start()
+    host, port = server.address
+    try:
+        c = http.client.HTTPConnection(host, port, timeout=15)
+        c.request(
+            "POST", "/v1/generate",
+            json.dumps({"prompt": [1, 2], "max_new_tokens": 40,
+                        "stream": True}),
+            {"Content-Type": "application/json"},
+        )
+        r = c.getresponse()
+        # read until the first token event reaches us, then VANISH
+        ev = None
+        while True:
+            line = r.readline().strip().decode()
+            if line.startswith("event:"):
+                ev = line[6:].strip()
+            elif not line and ev == "tokens":
+                break
+        # a REAL disconnect: shutdown tears the fd down even though the
+        # response object still holds a reference to it (plain close()
+        # would leave the connection standing)
+        c.sock.shutdown(socket.SHUT_RDWR)
+        c.sock.close()
+        # the abort propagates: gateway cancels the attempt wire-level,
+        # the replica frees the sequence's pages
+        _wait(lambda: not cb.has_work(), timeout=20,
+              msg="replica idle after caller disconnect")
+        cb.assert_page_accounting()
+        _wait(lambda: gw.metrics.get(
+            "gateway_stream_disconnects_total") >= 1, timeout=10,
+            msg="disconnect counted")
+        assert gw.drain(30.0)
+    finally:
+        server.stop()
+        client.stop()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# soak: page accounting ACROSS THE WIRE under the kill schedule
+# ---------------------------------------------------------------------------
+
+def test_gateway_soak_http_sim_lane():
+    """Fast wire-chaos lane: SimBatcher replicas behind real loopback
+    sockets, kills = server death (connection refusal for new work,
+    reset for in-flight), plus raw mid-stream disconnects — I5 and the
+    trace oracles hold."""
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    soak = GatewaySoak(seed=13, n_replicas=4, http=True)
+    soak.run(50)
+
+
+@pytest.mark.slow
+def test_gateway_soak_http_paged_kill_schedule(tiny_params):
+    """The acceptance schedule ACROSS THE WIRE: real paged batchers
+    (speculation + multi-turn decode-page caching, fp32 sealing) behind
+    HTTP replica servers; kills, hedge-cancel losers and raw mid-stream
+    disconnects interleaved — at quiescence every surviving replica's
+    page pool balances, judged over the wire-driven batchers."""
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    tiny = dict(vocab_size=61, num_layers=1, num_heads=2, hidden=16,
+                max_seq=32)
+    params = TransformerLM(dtype=jnp.float32, **tiny).init(
+        jax.random.PRNGKey(1), jnp.ones((1, 4), jnp.int32)
+    )["params"]
+    soak = GatewaySoak(
+        seed=31, n_replicas=2, multiturn=True, follow_prompt_cap=12,
+        http=True,
+        batcher_factory=lambda key: PagedContinuousBatcher(
+            params, slots=4, prompt_pad=12, page_size=4, pool_pages=48,
+            station_slots=2, token_budget=8, dtype=jnp.float32,
+            decode_page_cache="fp32",
+            draft_params=params, speculate_k=2, draft_window=16,
+            draft_num_layers=tiny["num_layers"],
+            draft_num_heads=tiny["num_heads"],
+            draft_hidden=tiny["hidden"], **tiny,
+        ),
+    )
+    soak.run(steps=20)

@@ -419,6 +419,7 @@ def test_tls_stalled_client_does_not_block_other_requests(tmp_path):
     import socket
     import ssl
 
+    pytest.importorskip("cryptography")  # optional TLS test dependency
     from kubegpu_tpu.testing.tlsutil import make_self_signed
 
     api = fake_cluster()

@@ -232,13 +232,42 @@ def test_jax_serve_gateway_sample_schedules_gang_and_registers():
         "decode-replica-0", "decode-replica-1", "decode-replica-2"
     ]
 
+    # the DATA-PLANE contract: every replica serves the HTTP endpoint
+    # (--serve-http) on the port the gateway dispatches to
+    # (--replica-port), and its readiness probe hits the same /healthz
+    # the gateway registry probes
+    replica_ports = set()
+    for obj in pods:
+        c = obj["spec"]["containers"][0]
+        cmd = c["command"]
+        assert "--serving=paged" in cmd, cmd
+        flags = dict(
+            f.removeprefix("--").split("=", 1) for f in cmd if "=" in f
+        )
+        port = int(flags["serve-http"])
+        replica_ports.add(port)
+        assert port in [p["containerPort"] for p in c["ports"]]
+        probe = c["readinessProbe"]["httpGet"]
+        assert probe["path"] == "/healthz" and int(probe["port"]) == port
+        # the paged replica's cache geometry must fit its traffic
+        assert (int(flags["prompt-len"]) + int(flags["steps"])
+                <= int(flags["seq"]) + 1)
+
     # the gateway Deployment's entrypoint is a real module with a main()
     deployments = [d for d in docs if d and d.get("kind") == "Deployment"]
     assert len(deployments) == 1
-    cmd = deployments[0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    gw_container = deployments[0]["spec"]["template"]["spec"]["containers"][0]
+    cmd = gw_container["command"]
     assert cmd[:2] == ["python", "-m"]
     mod = importlib.import_module(cmd[2])
     assert hasattr(mod, "main")
+    gw_flags = dict(
+        f.removeprefix("--").split("=", 1) for f in cmd if "=" in f
+    )
+    assert replica_ports == {int(gw_flags["replica-port"])}
+    # /readyz gates Service membership on live HTTP replica health
+    assert (gw_container["readinessProbe"]["httpGet"]["path"]
+            == "/readyz")
 
 
 def test_multi_tenant_sample_both_gangs_fit():
