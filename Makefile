@@ -50,7 +50,11 @@ bench:
 # must stay within 5% tok/s of untraced on the same run), and the HTTP
 # data plane (the same warm batcher served through the in-memory client
 # vs the replica HTTP endpoint over loopback — token-identical, HTTP
-# tok/s within a fixed 0.5x tolerance) on tiny shapes; exits non-zero
+# tok/s within a fixed 0.5x tolerance), and KV migration (a session's
+# sealed chain exported/imported between warm batchers: restored
+# re-pin TTFT strictly below the cold-restart re-pin, fp32
+# token-identical, pages/s + wire bytes reported) on tiny shapes;
+# exits non-zero
 # if chunked ITL regresses >10% past monolithic (compute-bound tie on a
 # 1-core box; the strict gate flaked at seed), hits vanish, the batched
 # station's burst TTFT is not strictly below serial, spec decode is not
@@ -58,7 +62,8 @@ bench:
 # baseline, turn-2 TTFT with decode-page caching is not strictly below
 # prompt-only, tokens diverge on any of them (the HTTP lane included),
 # the TTFT phase decomposition breaks, tracing overhead blows the 5%
-# gate, or the HTTP path falls past its tolerance
+# gate, the HTTP path falls past its tolerance, or the restored re-pin
+# fails to beat (or match tokens with) the cold restart
 bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve-smoke
 
@@ -80,11 +85,16 @@ multichip-smoke:
 # dryrun_http_serving: spawn a REAL replica subprocess (worker
 # --serve-http), stream/cancel over loopback sockets, then SIGKILL it
 # mid-stream — the distributed-data-plane smoke
+# dryrun_kv_migration: TWO real replica subprocesses; a request streams
+# on A, migrates mid-stream to B over the export/import verbs, A is
+# SIGKILLed after the handoff — the stream must finish on B
+# token-identical to a never-migrated reference
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	  $(PY) -c "import __graft_entry__ as g; g.dryrun_gateway(); \
 	  g.dryrun_spec_serving(); g.dryrun_tracing(); \
-	  g.dryrun_http_serving(); g.dryrun_multichip(8)"
+	  g.dryrun_http_serving(); g.dryrun_kv_migration(); \
+	  g.dryrun_multichip(8)"
 
 image:
 	docker build -f deploy/Dockerfile -t kubegpu-tpu:latest .

@@ -2215,6 +2215,142 @@ def serving_http_overhead(extra: dict, tiny: bool = False) -> None:
     )
 
 
+def serving_migration(extra: dict, tiny: bool = False) -> None:
+    """Live KV-page migration as a latency primitive (ISSUE 11): a
+    session's turn-1 completes on replica A (sealing its pages,
+    ``decode_page_cache="fp32"``), A's sealed chain is EXPORTED and
+    IMPORTED into replica B — the failover/drain flow — and turn 2 is
+    measured on B (restored re-pin) vs on replica C with no import
+    (cold-restart re-pin, today's behavior).  All three batchers are
+    warm (every program compiled off the clock) so the delta is pure
+    prefill work: the restored re-pin prefills only the genuinely new
+    tokens, the cold one recomputes the whole stream.
+
+    Gates (tiny/CPU, make bench-smoke): restored re-pin TTFT strictly
+    below cold-restart re-pin (min-of-N probes, orders interleaved),
+    and fp32 token identity across never-migrated (turn 2 on A),
+    restored (B) and cold (C).  Also reports the transfer's economy:
+    pages moved, encoded wire bytes, pages/s through export+import."""
+    import json as _json
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubegpu_tpu.gateway.dataplane import (
+        decode_kv_payload,
+        encode_kv_payload,
+    )
+    from kubegpu_tpu.models import TransformerLM
+    from kubegpu_tpu.models.paging import PagedContinuousBatcher
+
+    if tiny:
+        vocab, layers, heads, hidden = 61, 2, 4, 32
+        page, prompt_pad, max_seq = 8, 40, 96
+        p1_len, t1_new, t2_new, n_probes = 16, 9, 6, 3
+    else:
+        vocab, layers, hidden = 32768, 4, 4096
+        heads = hidden // 128
+        page, prompt_pad, max_seq = 64, 320, 768
+        p1_len, t1_new, t2_new, n_probes = 128, 65, 32, 3
+    model = TransformerLM(
+        vocab_size=vocab, num_layers=layers, num_heads=heads,
+        hidden=hidden, max_seq=max_seq,
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32)
+    )["params"]
+
+    def mk():
+        return PagedContinuousBatcher(
+            params, vocab_size=vocab, num_layers=layers, num_heads=heads,
+            hidden=hidden, max_seq=max_seq, slots=4,
+            prompt_pad=prompt_pad, page_size=page, pool_pages=64,
+            dtype=jnp.float32, decode_page_cache="fp32",
+        )
+
+    home, restored, cold = mk(), mk(), mk()
+    rs = np.random.RandomState(17)
+    warm = rs.randint(0, vocab, size=p1_len).astype(np.int32)
+    for cb in (home, restored, cold):      # compile off the clock
+        cb.run([warm], [t1_new])
+
+    def drive_ttft(cb, seq, prompt, budget):
+        """submit → first committed token (the re-pin TTFT), then drain
+        to completion; returns (ttft_s, tokens)."""
+        t0 = time.perf_counter()
+        cb.submit(seq, prompt, budget)
+        t1, done = None, {}
+        while cb.has_work():
+            done.update(cb.serve_step())
+            if t1 is None and (
+                cb.live_tokens().get(seq) or done.get(seq)
+            ):
+                t1 = time.perf_counter()
+        return t1 - t0, done[seq]
+
+    ttft_restored, ttft_cold = [], []
+    identical = True
+    wire_bytes = pages_moved = 0
+    transfer_s = 0.0
+    for p in range(n_probes):
+        p1 = rs.randint(0, vocab, size=p1_len).astype(np.int32)
+        _, t1_toks = drive_ttft(home, 100 + p, p1, t1_new)
+        stream = [int(t) for t in p1] + t1_toks
+        salt = int(rs.randint(0, vocab))
+        p2 = np.asarray(stream + [salt], np.int32)
+        # the transfer: sealed-chain export off A, import into B —
+        # timed, and sized via the real wire codec
+        te0 = time.perf_counter()
+        payload = home.export_sealed_chain(stream)
+        assert payload is not None, "turn 1 sealed nothing"
+        wire = _json.dumps(encode_kv_payload(payload))
+        n = restored.import_sealed_chain(decode_kv_payload(
+            _json.loads(wire)
+        ))
+        transfer_s += time.perf_counter() - te0
+        wire_bytes += len(wire)
+        pages_moved += n
+        # re-pin TTFT, both fates — order alternates across probes so a
+        # slow wave penalizes both lanes symmetrically
+        lanes = [("restored", restored, ttft_restored),
+                 ("cold", cold, ttft_cold)]
+        if p % 2:
+            lanes = lanes[::-1]
+        outs = {}
+        for name, cb, sink in lanes:
+            t, toks = drive_ttft(cb, 200 + p, p2, t2_new)
+            sink.append(t)
+            outs[name] = toks
+        _, ref = drive_ttft(home, 300 + p, p2, t2_new)  # never-migrated
+        identical = identical and outs["restored"] == ref == outs["cold"]
+        for cb in (home, restored, cold):
+            cb.assert_page_accounting()
+    best_restored = min(ttft_restored)
+    best_cold = min(ttft_cold)
+    pages_per_s = pages_moved / max(transfer_s, 1e-9)
+    label = "tiny/CPU fp32" if tiny else "1.08B fp32"
+    log(
+        f"serving migration ({label}, {n_probes} probes, warm batchers): "
+        f"re-pin TTFT restored {best_restored * 1e3:.1f} ms vs cold "
+        f"{best_cold * 1e3:.1f} ms ({best_cold / max(best_restored, 1e-9):.2f}x); "
+        f"transfer {pages_moved} pages, {wire_bytes} wire bytes "
+        f"({wire_bytes / max(pages_moved, 1):.0f} B/page), "
+        f"{pages_per_s:.0f} pages/s through export+import; "
+        f"token-identical (never-migrated == restored == cold): {identical}"
+    )
+    extra["serve_migration_ttft_restored_ms"] = round(best_restored * 1e3, 3)
+    extra["serve_migration_ttft_cold_ms"] = round(best_cold * 1e3, 3)
+    extra["serve_migration_strictly_better"] = bool(
+        best_restored < best_cold
+    )
+    extra["serve_migration_token_identical"] = bool(identical)
+    extra["serve_migration_pages"] = int(pages_moved)
+    extra["serve_migration_wire_bytes"] = int(wire_bytes)
+    extra["serve_migration_pages_per_s"] = round(pages_per_s, 1)
+
+
 def serving_tp_paged(extra: dict, tiny: bool = False) -> None:
     """Tensor-parallel paged serving (ISSUE 9 acceptance): the whole
     ``PagedContinuousBatcher`` hot loop over a "model" mesh — KV page
@@ -3474,6 +3610,7 @@ def main() -> None:
         serving_multiturn(extra, tiny=True)
         serving_trace_report(extra, tiny=True)
         serving_http_overhead(extra, tiny=True)
+        serving_migration(extra, tiny=True)
         ok = (
             # chunked ITL must not SUBSTANTIALLY regress vs monolithic:
             # on the 1-core smoke box the two are compute-bound ties
@@ -3500,6 +3637,12 @@ def main() -> None:
             and extra["serve_trace_overhead_ok"]
             and extra["serve_http_token_identical"]
             and extra["serve_http_within_tolerance"]
+            # a restored re-pin must beat the cold restart it replaces,
+            # with fp32 identity to the never-migrated session, and the
+            # transfer must actually have moved pages
+            and extra["serve_migration_strictly_better"]
+            and extra["serve_migration_token_identical"]
+            and extra["serve_migration_pages"] > 0
         )
         print(json.dumps({
             "metric": "serve_smoke", "ok": ok, "extra": extra,

@@ -29,7 +29,11 @@ from kubegpu_tpu.gateway.dataplane import (
     ReplicaServer,
     ReplicaServingLoop,
 )
-from kubegpu_tpu.gateway.failover import Dispatcher, FailoverPolicy
+from kubegpu_tpu.gateway.failover import (
+    Dispatcher,
+    FailoverPolicy,
+    SessionKVStore,
+)
 from kubegpu_tpu.gateway.queue import AdmissionQueue, QueueClosed, QueueFull
 from kubegpu_tpu.gateway.registry import ReplicaInfo, ReplicaRegistry
 from kubegpu_tpu.gateway.router import (
@@ -62,5 +66,6 @@ __all__ = [
     "ReplicaRegistry",
     "Router",
     "SessionAffinityRouter",
+    "SessionKVStore",
     "SimBatcher",
 ]

@@ -23,6 +23,7 @@ by the control plane kills the data-plane connection the same cycle.
 from __future__ import annotations
 
 import inspect
+import queue as _queue
 import threading
 import time
 from collections import deque
@@ -37,15 +38,17 @@ from typing import Callable, Dict, FrozenSet, List, Optional
 DECODE_PAGE_CACHE_POLICIES = ("off", "fp32", "all")
 
 
-def _sniff_takes_trace(batcher) -> bool:
-    """Does this batcher speak the trace-context contract?  Duck-typed
-    once per worker/serving-loop so third-party batchers without the
-    kwarg still work (their requests simply serve untraced below the
-    dispatch span).  Shared with the HTTP data plane (gateway/
-    dataplane.py) so both drivers sniff identically."""
+def _sniff_takes_trace(batcher, method: str = "submit") -> bool:
+    """Does this batcher speak the trace-context contract (on ``submit``
+    or, for migration, ``import_pages``)?  Duck-typed once per
+    worker/serving-loop so third-party batchers without the kwarg still
+    work (their requests simply serve untraced below the dispatch
+    span).  Shared with the HTTP data plane (gateway/dataplane.py) so
+    both drivers sniff identically."""
     try:
-        return "trace" in inspect.signature(batcher.submit).parameters
-    except (TypeError, ValueError):
+        fn = getattr(batcher, method)
+        return "trace" in inspect.signature(fn).parameters
+    except (AttributeError, TypeError, ValueError):
         return False
 
 
@@ -65,6 +68,18 @@ class Attempt:
         self.replica = replica
         self.request_id = request_id
         self.cancelled = False
+        # the request this attempt carries (stashed by the clients at
+        # submit): live migration re-dispatches the SAME attempt handle
+        # against a new replica, which needs the original request
+        self.request = None
+        # set while a live migration owns this attempt's resolution: the
+        # exporter's stream ends with a "migrated" terminal the reader
+        # must NOT surface as a failure
+        self._migrating = False
+        # set by the reader when it SEES that terminal — the handshake
+        # migrate()'s export-failure path uses to resolve an attempt
+        # whose sequence detached but whose export response was lost
+        self._migrated_terminal = False
         self._done = threading.Event()
         self._result: Optional[AttemptResult] = None
         self._lock = threading.Lock()
@@ -107,6 +122,42 @@ class ReplicaClient:
         dead end must never join a Service, however many replicas the
         registry sees."""
         return True
+
+    # -- KV-page migration (optional capability) ---------------------------
+    # Data planes that speak the EXPORT/IMPORT verb pair override these;
+    # the defaults say "unsupported" so drains degrade to the pre-verb
+    # behavior (cold restarts) instead of erroring.
+
+    def inflight_on(self, replica_key: str) -> List[Attempt]:
+        """Live attempts currently dispatched to one replica — the drain
+        path's work list."""
+        return []
+
+    def migrate(self, attempt: Attempt, request, to_key: str,
+                _between: Optional[Callable[[], None]] = None) -> bool:
+        """Move a live in-flight sequence to another replica: export +
+        detach at the source, import + resume at the target; the SAME
+        attempt handle keeps streaming and eventually resolves with the
+        full token list.  False = migration not possible (the sequence
+        stays where it was, or normal failover takes over).  ``_between``
+        is a fault-injection hook invoked between the export and the
+        import dispatch (the soak's kill-mid-migration schedules)."""
+        return False
+
+    def export_sealed(self, replica_key: str, stream) -> Optional[dict]:
+        """Capture a finished stream's sealed prefix-chain pages from a
+        replica (the failover insurance payload), or None."""
+        return None
+
+    def import_sealed(self, replica_key: str, payload) -> bool:
+        """Warm a replica's prefix cache from a sealed-chain export."""
+        return False
+
+    def seals_decode(self, replica_key: str) -> bool:
+        """Does this replica seal decode pages at retirement?  Gates the
+        gateway's eager sealed-export captures (no point round-tripping
+        a replica whose policy never seals)."""
+        return False
 
 
 # ---------------------------------------------------------------------------
@@ -180,7 +231,12 @@ class SimBatcher:
         self._active: Dict[int, tuple] = {}  # seq -> (tokens, max_new)
         self._rr: deque = deque()            # active seqs in budget order
         self._spans: Dict[int, dict] = {}    # seq -> open span ctxs
-        self.stats = {"steps": 0, "admits": 0}
+        # migration keeps streams deterministic ACROSS replicas: token i
+        # is a function of (seed, i), and the seed rides the export
+        # payload — an imported sequence continues the ORIGINAL mill's
+        # stream even though the importer assigned it a fresh seq id
+        self._seed: Dict[int, int] = {}      # seq -> stream seed
+        self.stats = {"steps": 0, "admits": 0, "imports": 0}
 
     def submit(self, seq_id: int, prompt, max_new: int,
                temperature: float = 0.0,
@@ -224,9 +280,48 @@ class SimBatcher:
         # drop the ring entry too: a stale entry would double-count a
         # re-submitted seq_id against the budget forever
         self._rr.remove(seq_id)
+        self._seed.pop(seq_id, None)
         if seq_id in self._spans:
             self._trace_end(self._spans.pop(seq_id), "cancelled")
         return True
+
+    # -- KV migration twins (the paged batcher's verb pair, duck-typed):
+    # the mill has no pages, so the payload is the stream cursor alone —
+    # which is exactly what keeps soak streams deterministic across a
+    # migration (token i depends only on (seed, i))
+    def export_pages(self, seq_id: int) -> dict:
+        ent = self._active.get(seq_id)
+        if ent is None:
+            raise KeyError(f"sequence {seq_id} not active")
+        tokens, max_new = ent
+        return {
+            "kind": "live", "sim": True, "tokens": list(tokens),
+            "max_new": int(max_new),
+            "seed": int(self._seed.get(seq_id, seq_id)),
+        }
+
+    def import_pages(self, seq_id: int, payload: dict,
+                     trace=None) -> None:
+        if payload.get("kind") != "live" or not payload.get("sim"):
+            raise ValueError("not a sim-mill payload")
+        if seq_id in self._active or any(
+            sid == seq_id for sid, _ in self._pending
+        ):
+            raise ValueError(f"seq_id {seq_id} already in use")
+        if len(self._active) >= self.slots:
+            raise RuntimeError("import refused: no free slot")
+        self._active[seq_id] = (
+            list(payload["tokens"]), int(payload["max_new"])
+        )
+        self._seed[seq_id] = int(payload["seed"])
+        self._rr.append(seq_id)
+        self.stats["imports"] += 1
+        if trace is not None:
+            serve = trace.child("serve", seq_id=seq_id, sim=True,
+                                imported=True)
+            self._spans[seq_id] = {
+                "serve": serve, "decode": serve.child("decode"),
+            }
 
     def has_work(self) -> bool:
         return bool(self._pending) or bool(self._active)
@@ -258,6 +353,7 @@ class SimBatcher:
                 if seq not in self._active:
                     self._rr.append(seq)
                 self._active[seq] = ([], max_new)
+                self._seed[seq] = seq
         if self._active:
             self.stats["steps"] += 1
             n = len(self._active)
@@ -276,20 +372,23 @@ class SimBatcher:
                     continue  # cancelled: drop its stale ring entry
                 advanced += 1
                 tokens, max_new = self._active[seq]
+                seed = self._seed.get(seq, seq)
                 if self.speculate_k is None:
                     emit = 1
                 else:
                     # deterministic accepted-prefix length in [1, k+1],
-                    # a function of (seq, depth) only: re-running the
-                    # same request yields the same per-step emissions
-                    emit = 1 + (seq * 7 + len(tokens)) % (
+                    # a function of (seed, depth) only: re-running the
+                    # same request — or resuming it on another replica
+                    # after a migration — yields the same emissions
+                    emit = 1 + (seed * 7 + len(tokens)) % (
                         self.speculate_k + 1
                     )
                 for _ in range(min(emit, max_new - len(tokens))):
-                    tokens.append((seq * 31 + len(tokens)) % self.vocab)
+                    tokens.append((seed * 31 + len(tokens)) % self.vocab)
                 if len(tokens) >= max_new:
                     finished[seq] = tokens
                     del self._active[seq]
+                    self._seed.pop(seq, None)
                     if seq in self._spans:
                         self._trace_end(self._spans.pop(seq), "finished")
                 else:
@@ -311,6 +410,14 @@ class _ReplicaWorker:
         self.cond = threading.Condition(self.lock)
         self.inbox: deque = deque()          # (attempt, request)
         self.cancels: List[Attempt] = []
+        # control ops (export/import, sealed captures): closures run ON
+        # the worker thread between steps — the batchers are
+        # single-driver, and migration must never race serve_step
+        self.ops: deque = deque()            # (fn, reply queue)
+        # chaos knob: an armed worker refuses imports (the soak's
+        # importer-refusal schedule; the HTTP twin is the worker CLI's
+        # --serve-http-fail-migration)
+        self.fail_migration = False
         self.alive = True
         self.by_seq: Dict[int, Attempt] = {}
         # streaming parity with the HTTP data plane: per-sequence token
@@ -327,13 +434,19 @@ class _ReplicaWorker:
         while True:
             with self.cond:
                 while (self.alive and not self.inbox and not self.cancels
-                       and not self.batcher.has_work()):
+                       and not self.ops and not self.batcher.has_work()):
                     self.cond.wait(0.05)
                 if not self.alive:
                     dead = list(self.by_seq.values())
                     dead += [a for a, _ in self.inbox]
                     self.by_seq.clear()
                     self.inbox.clear()
+                    # blocked control callers must not hang on a corpse
+                    while self.ops:
+                        _, reply = self.ops.popleft()
+                        reply.put((False, RuntimeError(
+                            f"replica {self.key} died"
+                        )))
                     # the process dies with its spans: close every live
                     # request's subtree (retire reason "died") so the
                     # trace tree stays complete — the in-memory twin of
@@ -373,6 +486,12 @@ class _ReplicaWorker:
                         AttemptResult(False, error="cancelled")
                     )
                 self.cancels.clear()
+                while self.ops:
+                    fn, reply = self.ops.popleft()
+                    try:
+                        reply.put((True, fn()))
+                    except Exception as e:  # noqa: BLE001 - op result
+                        reply.put((False, e))
             # decode OUTSIDE the lock: a slow step (real JAX dispatch)
             # must not block submission/cancel delivery
             finished = self.batcher.serve_step()
@@ -431,6 +550,21 @@ class _ReplicaWorker:
         with self.cond:
             self.cancels.append(attempt)
             self.cond.notify()
+
+    def control(self, fn, timeout: float = 30.0):
+        """Run a closure on the worker thread between steps; returns its
+        value or re-raises its exception.  Raises RuntimeError when the
+        worker is dead (the caller treats it like a connection error)."""
+        reply: "_queue.Queue" = _queue.Queue(1)
+        with self.cond:
+            if not self.alive:
+                raise RuntimeError(f"replica {self.key} unreachable")
+            self.ops.append((fn, reply))
+            self.cond.notify()
+        ok, val = reply.get(timeout=timeout)
+        if not ok:
+            raise val
+        return val
 
     def kill(self) -> None:
         with self.cond:
@@ -542,6 +676,7 @@ class InMemoryReplicaClient(ReplicaClient):
     # -- ReplicaClient -----------------------------------------------------
     def submit(self, replica_key: str, request) -> Attempt:
         attempt = Attempt(replica_key, request.request_id)
+        attempt.request = request
         with self._lock:
             worker = self._workers.get(replica_key)
         if worker is None:
@@ -572,3 +707,138 @@ class InMemoryReplicaClient(ReplicaClient):
             worker.cancel(attempt)
         else:
             attempt.finish(AttemptResult(False, error="cancelled"))
+
+    # -- KV-page migration -------------------------------------------------
+    def set_fail_migration(self, key: str, flag: bool) -> None:
+        """Chaos knob: an armed replica refuses imports (the soak's
+        importer-refusal schedule)."""
+        with self._lock:
+            worker = self._workers.get(key)
+        if worker is not None:
+            worker.fail_migration = flag
+
+    def inflight_on(self, replica_key: str) -> List[Attempt]:
+        with self._lock:
+            worker = self._workers.get(replica_key)
+        if worker is None:
+            return []
+        with worker.lock:
+            return list(dict.fromkeys(worker.by_seq.values()))
+
+    def seals_decode(self, replica_key: str) -> bool:
+        with self._lock:
+            worker = self._workers.get(replica_key)
+        return worker is not None and bool(
+            getattr(worker.batcher, "_seal_decode", False)
+        )
+
+    def export_sealed(self, replica_key: str, stream) -> Optional[dict]:
+        with self._lock:
+            worker = self._workers.get(replica_key)
+        if worker is None or not hasattr(
+            worker.batcher, "export_sealed_chain"
+        ):
+            return None
+        try:
+            return worker.control(
+                lambda: worker.batcher.export_sealed_chain(stream)
+            )
+        except Exception:  # noqa: BLE001 - capture is best-effort
+            return None
+
+    def import_sealed(self, replica_key: str, payload) -> bool:
+        if payload is None:
+            return False
+        with self._lock:
+            worker = self._workers.get(replica_key)
+        if worker is None or not hasattr(
+            worker.batcher, "import_sealed_chain"
+        ):
+            return False
+        try:
+            return (
+                worker.control(
+                    lambda: worker.batcher.import_sealed_chain(payload)
+                )
+                or 0
+            ) > 0
+        except Exception:  # noqa: BLE001 - restore is best-effort
+            return False
+
+    def migrate(self, attempt: Attempt, request, to_key: str,
+                _between: Optional[Callable[[], None]] = None) -> bool:
+        """Live migration over the in-memory plane: export + detach on
+        the source worker's thread (atomic — no step can interleave),
+        then import + re-register the SAME attempt on the target's.  A
+        failed export leaves the sequence serving where it was; a
+        failed import resolves the attempt with an error so normal
+        failover re-dispatches it (cold — graceful, never wrong)."""
+        with self._lock:
+            src = self._workers.get(attempt.replica)
+            dst = self._workers.get(to_key)
+        if src is None or dst is None or src is dst or attempt.done:
+            return False
+        if not hasattr(src.batcher, "export_pages") or not hasattr(
+            dst.batcher, "import_pages"
+        ):
+            return False
+        trace = getattr(request, "trace", None)
+        mspan = (
+            trace.child("migrate", source=attempt.replica, target=to_key)
+            if trace is not None else None
+        )
+        attempt._migrating = True
+
+        def export_op():
+            seq = next(
+                (s for s, a in src.by_seq.items() if a is attempt), None
+            )
+            if seq is None:
+                raise KeyError("attempt not live on the source")
+            payload = src.batcher.export_pages(seq)
+            # flush any tokens the export's pipeline drain just
+            # committed, so the streaming relay misses nothing
+            src._flush_sinks()
+            src.batcher.cancel(seq)
+            del src.by_seq[seq]
+            src.sinks.pop(seq, None)
+            src.emitted.pop(seq, None)
+            return payload
+
+        try:
+            payload = src.control(export_op)
+        except Exception:  # noqa: BLE001 - export failure = no migration
+            if mspan is not None:
+                mspan.end(outcome="export_failed")
+            return False
+        if _between is not None:
+            _between()   # fault injection: kill-mid-migration schedules
+
+        def import_op():
+            if dst.fail_migration:
+                raise RuntimeError("migration refused (chaos knob)")
+            seq = dst._next_seq
+            dst._next_seq += 1
+            dst.batcher.import_pages(
+                seq, payload, trace=getattr(request, "trace", None)
+            )
+            dst.by_seq[seq] = attempt
+            sink = getattr(request, "on_tokens", None)
+            if sink is not None:
+                dst.sinks[seq] = sink
+                dst.emitted[seq] = len(payload.get("tokens") or [])
+
+        try:
+            dst.control(import_op)
+        except Exception as e:  # noqa: BLE001 - import failure = result
+            attempt.finish(AttemptResult(
+                False, error=f"migration import failed: {e}"
+            ))
+            if mspan is not None:
+                mspan.end(outcome="import_failed")
+            return False
+        attempt.replica = to_key
+        if mspan is not None:
+            mspan.end(outcome="ok",
+                      pages=len(payload.get("page_keys") or []))
+        return True

@@ -32,7 +32,11 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from kubegpu_tpu.gateway.client import ReplicaClient
-from kubegpu_tpu.gateway.failover import Dispatcher, FailoverPolicy
+from kubegpu_tpu.gateway.failover import (
+    Dispatcher,
+    FailoverPolicy,
+    SessionKVStore,
+)
 from kubegpu_tpu.gateway.queue import AdmissionQueue, QueueClosed, QueueFull
 from kubegpu_tpu.gateway.registry import ReplicaRegistry
 from kubegpu_tpu.gateway.router import LeastOutstandingRouter, Router
@@ -141,11 +145,19 @@ class Gateway:
         # serve_* histograms the replica batchers feed
         if router is not None and getattr(router, "metrics", False) is None:
             router.metrics = self.metrics
+        # sealed-session KV insurance: completed sessionful turns are
+        # recorded (and, when the serving replica seals decode pages,
+        # eagerly exported) so a later replica death or drain re-pins
+        # the session WITH its KV — the dispatcher restores the payload
+        # into the new target before the turn-2 attempt opens
+        self.session_store = SessionKVStore()
+        self._seals_cache: Dict[str, bool] = {}
         self.dispatcher = Dispatcher(
             client,
             router or LeastOutstandingRouter(),
             policy or FailoverPolicy(),
             metrics=self.metrics,
+            session_store=self.session_store,
         )
         self.n_dispatchers = dispatchers
         self._stop = threading.Event()
@@ -262,10 +274,14 @@ class Gateway:
                 started = time.monotonic()
                 queue_wait = started - request.enqueued_at
                 self.metrics.observe("gateway_queue_wait_seconds", queue_wait)
+                # ROUTABLE, not live: a DRAINING replica keeps serving
+                # its in-flight work but takes no new admissions
                 outcome = self.dispatcher.dispatch(
-                    request, self.registry.live
+                    request, self.registry.routable
                 )
                 total = time.monotonic() - request.enqueued_at
+                if outcome.status == "ok" and request.session:
+                    self._record_session(request, outcome)
                 if outcome.status == "ok":
                     self.metrics.observe("gateway_ttft_seconds", total)
                 self.metrics.inc(
@@ -287,6 +303,77 @@ class Gateway:
             finally:
                 with self._lock:
                     self._in_flight -= 1
+
+    def _record_session(self, request: GatewayRequest, outcome) -> None:
+        """A sessionful turn completed ok: record the session's home +
+        stream, and — when that replica actually seals decode pages —
+        eagerly capture its sealed export (the failover insurance
+        premium, paid while the replica is alive).  Best-effort and
+        gated per replica so SimBatcher/policy-off lanes never pay a
+        round-trip."""
+        try:
+            self.session_store.record(
+                request.session, outcome.replica,
+                list(request.prompt) + list(outcome.tokens),
+            )
+            seals = self._seals_cache.get(outcome.replica)
+            if seals is None:
+                seals = bool(self.client.seals_decode(outcome.replica))
+                self._seals_cache[outcome.replica] = seals
+            if seals:
+                self.session_store.capture(self.client, request.session)
+        except Exception:  # noqa: BLE001 - insurance must never fail serving
+            log.exception("sealed-session capture failed")
+
+    # -- replica lifecycle (DRAINING → released) ---------------------------
+    def drain_replica(self, key: str, migrate: bool = True) -> dict:
+        """The graceful half of the replica lifecycle: mark the replica
+        DRAINING (routing stops sending new admissions the same cycle),
+        capture sealed exports for the sessions it last served, migrate
+        its live in-flight sequences to healthy replicas, and unpin its
+        sessions (a planned move, not a KV loss — their next turn
+        restores from the captured exports).  Returns drain stats; the
+        caller releases the replica (deletes the pod) afterwards.
+        Everything degrades gracefully: a sequence that cannot migrate
+        keeps serving on the draining replica until it finishes or the
+        release kills it (normal failover then retries it cold)."""
+        self.registry.set_draining(key, True)
+        self.metrics.inc("gateway_replica_drains_total")
+        captured = 0
+        if self._seals_cache.get(key) or self.client.seals_decode(key):
+            for session in self.session_store.sessions_on(key):
+                if self.session_store.capture(self.client, session):
+                    captured += 1
+        migrated = failed = 0
+        if migrate:
+            targets = [
+                r for r in self.registry.routable() if r.key != key
+            ]
+            for attempt in self.client.inflight_on(key):
+                if attempt.done or not targets:
+                    continue
+                request = attempt.request
+                if request is None:
+                    failed += 1
+                    continue
+                target = min(
+                    targets,
+                    key=lambda r: self.dispatcher.outstanding.get(r.key, 0),
+                )
+                if self.client.migrate(attempt, request, target.key):
+                    migrated += 1
+                else:
+                    failed += 1
+        # planned unpin: the affinity router's next pick re-pins by load
+        # and the restored export keeps the KV warm
+        self.session_store.mark_lost(key)
+        forget = getattr(self.dispatcher.router, "forget_replica", None)
+        if forget is not None:
+            forget(key)
+        return {
+            "replica": key, "migrated": migrated, "failed": failed,
+            "captured": captured,
+        }
 
     # -- exactly-once delivery --------------------------------------------
     def _record(self, result: GatewayResult) -> None:
@@ -340,3 +427,10 @@ class Gateway:
 
     def _on_live_change(self, live) -> None:
         self.metrics.set_gauge("gateway_live_replicas", len(live))
+        # a replica leaving the live set strands its sessions' KV: mark
+        # them restorable (the dispatcher imports any captured sealed
+        # export into the re-pin target), and forget its sealing policy
+        # (a revived pod may come back configured differently)
+        self.session_store.sync_live(live)
+        for key in [k for k in self._seals_cache if k not in live]:
+            self._seals_cache.pop(key, None)

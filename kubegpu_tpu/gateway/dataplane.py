@@ -56,6 +56,7 @@ failover re-dispatches the same cycle.
 
 from __future__ import annotations
 
+import base64
 import http.client
 import json
 import logging
@@ -122,6 +123,63 @@ def _int_or(value, default: int) -> int:
 
 
 # ---------------------------------------------------------------------------
+# KV transfer payload codec (the EXPORT/IMPORT wire schema)
+# ---------------------------------------------------------------------------
+# A batcher-level transfer payload carries per-layer page arrays as host
+# numpy; on the wire they become base64 of the raw bytes plus an explicit
+# shape (dtype rides the payload's geometry).  The GATEWAY never decodes
+# layers — it relays export→import opaquely — so only replica processes
+# (which have jax's ml_dtypes for bfloat16) pay the codec.
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # jax's extended dtypes (bfloat16 et al.)
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_kv_payload(payload: dict) -> dict:
+    """JSON-safe encoding of a KV transfer payload (identity on
+    payloads without page arrays, e.g. SimBatcher's cursor-only ones)."""
+    out = {k: v for k, v in payload.items() if k != "layers"}
+    if "layers" in payload:
+        out["layers"] = [
+            {
+                "k": base64.b64encode(
+                    np.ascontiguousarray(k).tobytes()
+                ).decode("ascii"),
+                "v": base64.b64encode(
+                    np.ascontiguousarray(v).tobytes()
+                ).decode("ascii"),
+                "shape": [int(d) for d in np.shape(k)],
+            }
+            for k, v in payload["layers"]
+        ]
+    return out
+
+
+def decode_kv_payload(wire: dict) -> dict:
+    """The inverse: base64 page arrays back to host numpy."""
+    out = {k: v for k, v in wire.items() if k != "layers"}
+    if "layers" in wire:
+        dtype = _np_dtype(wire["geometry"]["dtype"])
+        out["layers"] = [
+            (
+                np.frombuffer(
+                    base64.b64decode(e["k"]), dtype=dtype
+                ).reshape(e["shape"]),
+                np.frombuffer(
+                    base64.b64decode(e["v"]), dtype=dtype
+                ).reshape(e["shape"]),
+            )
+            for e in wire["layers"]
+        ]
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Replica side
 # ---------------------------------------------------------------------------
 
@@ -152,7 +210,8 @@ class ReplicaServingLoop:
 
     def __init__(self, batcher, metrics: Optional[Metrics] = None,
                  tracer: Optional[Tracer] = None,
-                 step_delay_s: float = 0.0) -> None:
+                 step_delay_s: float = 0.0,
+                 fail_migration: bool = False) -> None:
         self.batcher = batcher
         self.metrics = metrics
         # the replica's own tracer: every request serves under a local
@@ -162,6 +221,9 @@ class ReplicaServingLoop:
             max_traces=64
         )
         self.step_delay_s = step_delay_s
+        # chaos knob (worker --serve-http-fail-migration): an armed
+        # replica refuses /v1/import — the soak's importer-refusal leg
+        self.fail_migration = fail_migration
         self._takes_trace = _sniff_takes_trace(batcher)
         # RLock: _finish mutates stream maps from both the serving
         # thread (already holding the condition's lock on the shutdown
@@ -171,6 +233,9 @@ class ReplicaServingLoop:
         self._inbox: deque = deque()        # (_Stream, payload dict)
         self._cancels: List[str] = []       # request ids
         self._evicted: List[_Stream] = []   # duplicate-id losers
+        # control ops (export/import): closures run ON the serving
+        # thread between steps — migration must never race serve_step
+        self._ops: deque = deque()          # (fn, reply queue)
         self._streams: Dict[str, _Stream] = {}
         self._by_seq: Dict[int, _Stream] = {}
         self._next_seq = 0
@@ -219,6 +284,109 @@ class ReplicaServingLoop:
         with self._lock:
             return sum(1 for s in self._streams.values() if not s.closed)
 
+    # -- KV-page migration verbs (handler-facing; run on the loop) ---------
+    def control(self, fn, timeout: float = 60.0):
+        """Run a closure on the serving thread between steps; returns
+        its value or re-raises its exception."""
+        reply: "queue.Queue" = queue.Queue(1)
+        with self._cond:
+            if not self.alive:
+                raise RuntimeError("replica shutting down")
+            self._ops.append((fn, reply))
+            self._cond.notify()
+        ok, val = reply.get(timeout=timeout)
+        if not ok:
+            raise val
+        return val
+
+    def export_live(self, request_id: str) -> dict:
+        """Export + DETACH one live stream's sequence — the migration
+        source half, atomic on the serving thread: the payload is
+        captured, freshly-committed tokens flush to the stream, the
+        sequence's pages free, and the stream ends with a ``migrated``
+        terminal whose span dicts ship for the gateway-side graft."""
+        def op():
+            st = self._streams.get(request_id)
+            if st is None or st.closed or st.seq is None:
+                raise KeyError(f"no live stream {request_id!r}")
+            if not hasattr(self.batcher, "export_pages"):
+                raise ValueError(
+                    "batcher does not speak the migration verbs"
+                )
+            payload = self.batcher.export_pages(st.seq)
+            self._flush({})   # the export drain may have committed tokens
+            self.batcher.cancel(st.seq)
+            self._finish(st, "error", "migrated")
+            return payload
+
+        return self.control(op)
+
+    def export_sealed(self, stream) -> Optional[dict]:
+        def op():
+            fn = getattr(self.batcher, "export_sealed_chain", None)
+            return fn(stream) if fn is not None else None
+
+        return self.control(op)
+
+    def import_live(self, st: _Stream, payload: dict,
+                    trace_id: str = "", span_id: str = "0") -> None:
+        """Resume a migrated sequence here: import the payload, register
+        the stream under its request id (duplicate-id eviction like
+        submit), and set the emit watermark past the tokens the exporter
+        already streamed — the continuation streams only NEW tokens,
+        while the terminal ``done`` carries the full authoritative
+        list."""
+        def op():
+            if self.fail_migration:
+                raise RuntimeError("migration refused (chaos knob)")
+            if not hasattr(self.batcher, "import_pages"):
+                raise ValueError(
+                    "batcher does not speak the migration verbs"
+                )
+            seq = self._next_seq
+            self._next_seq += 1
+            root = None
+            if self.tracer is not None:
+                root = self.tracer.start_trace(
+                    "replica_import", request_id=st.request_id,
+                    remote_trace=str(trace_id or ""),
+                    remote_span=_int_or(span_id, 0),
+                )
+            kwargs = {"trace": root} if (
+                root is not None
+                and _sniff_takes_trace(self.batcher, "import_pages")
+            ) else {}
+            try:
+                self.batcher.import_pages(seq, payload, **kwargs)
+            except Exception:
+                if root is not None:
+                    root.end(status="refused")
+                raise
+            old = self._streams.get(st.request_id)
+            if old is not None and not old.closed:
+                old.cancelled = True
+                self._evicted.append(old)
+            self._streams[st.request_id] = st
+            st.seq = seq
+            st.trace = root
+            st.emitted = len(payload.get("tokens") or [])
+            self._by_seq[seq] = st
+
+        self.control(op)
+
+    def import_sealed(self, payload) -> int:
+        def op():
+            if self.fail_migration:
+                raise RuntimeError("migration refused (chaos knob)")
+            fn = getattr(self.batcher, "import_sealed_chain", None)
+            if fn is None:
+                raise ValueError(
+                    "batcher does not speak the migration verbs"
+                )
+            return fn(payload)
+
+        return self.control(op)
+
     def state(self, ledger_limit: int = 0) -> dict:
         b = self.batcher
         active_streams = self.active_streams()
@@ -226,6 +394,10 @@ class ReplicaServingLoop:
             "tp": int(getattr(b, "tp", 1)),
             "slots": getattr(b, "slots", None),
             "decode_page_cache": getattr(b, "decode_page_cache", "off"),
+            # the RESOLVED sealing policy: gates the gateway's eager
+            # sealed-export captures (no point round-tripping a replica
+            # that never seals)
+            "seals_decode": bool(getattr(b, "_seal_decode", False)),
             "active_streams": active_streams,
         }
         rows_fn = getattr(b, "ledger_rows", None)
@@ -253,9 +425,15 @@ class ReplicaServingLoop:
         while True:
             with self._cond:
                 while (self.alive and not self._inbox and not self._cancels
-                       and not self.batcher.has_work()):
+                       and not self._ops and not self.batcher.has_work()):
                     self._cond.wait(0.05)
                 if not self.alive:
+                    # blocked control callers must not hang on a corpse
+                    while self._ops:
+                        _, reply = self._ops.popleft()
+                        reply.put((False, RuntimeError(
+                            "replica shutting down"
+                        )))
                     # process death: close the batcher's spans FIRST
                     # (every live serve subtree gets its ``died`` retire,
                     # the way a dead pod ends its connections), so the
@@ -298,6 +476,12 @@ class ReplicaServingLoop:
                             "replica_http_cancels_total"
                         )
                 self._cancels.clear()
+                while self._ops:
+                    fn, reply = self._ops.popleft()
+                    try:
+                        reply.put((True, fn()))
+                    except Exception as e:  # noqa: BLE001 - op result
+                        reply.put((False, e))
             # decode OUTSIDE the lock: a slow step (real JAX dispatch)
             # must not block submission/cancel delivery
             finished = (
@@ -456,6 +640,12 @@ def make_replica_handler(loop: ReplicaServingLoop,
                 ok = loop.cancel(str(body["request_id"]))
                 self._send_json(200, {"cancelled": ok})
                 return
+            if self.path == "/v1/export":
+                self._handle_export()
+                return
+            if self.path == "/v1/import":
+                self._handle_import()
+                return
             if self.path != "/v1/submit":
                 self._send_json(404, {"error": f"no route {self.path}"})
                 return
@@ -469,6 +659,12 @@ def make_replica_handler(loop: ReplicaServingLoop,
             body.setdefault("trace_id", self.headers.get("X-Trace-Id", ""))
             body.setdefault("span_id", self.headers.get("X-Span-Id", "0"))
             st = loop.submit(body, t_recv)
+            self._serve_stream(st)
+
+        def _serve_stream(self, st: _Stream) -> None:
+            """The SSE streaming tail shared by /v1/submit and a live
+            /v1/import: chunked event stream, disconnect ⇒ cancel pinned
+            to THIS stream object."""
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
@@ -497,6 +693,131 @@ def make_replica_handler(loop: ReplicaServingLoop,
                     metrics.set_gauge(
                         "replica_http_streams_active", loop.active_streams()
                     )
+
+        # -- KV-page migration verbs ------------------------------------
+        def _handle_export(self) -> None:
+            """POST /v1/export — {"request_id"}: export + detach a LIVE
+            sequence (its stream ends with a ``migrated`` terminal);
+            {"stream": [ints]}: read-only sealed-chain capture.  The
+            response is {"payload": <encoded or null>, "pages": n}."""
+            if metrics is not None:
+                metrics.inc("replica_http_requests_total", verb="export")
+            body = self._read_json()
+            if body is None:
+                self._send_json(400, {"error": "malformed JSON body"})
+                return
+            t0 = time.monotonic()
+            try:
+                if body.get("request_id"):
+                    payload = loop.export_live(str(body["request_id"]))
+                elif body.get("stream") is not None:
+                    payload = loop.export_sealed(
+                        [int(t) for t in body["stream"]]
+                    )
+                else:
+                    self._send_json(
+                        400, {"error": "request_id or stream required"}
+                    )
+                    return
+            except KeyError as e:
+                self._send_json(404, {"error": str(e)})
+                return
+            except (ValueError, RuntimeError) as e:
+                self._send_json(409, {"error": str(e)})
+                return
+            wire = (
+                encode_kv_payload(payload) if payload is not None else None
+            )
+            n_pages = (
+                len(payload.get("page_keys") or []) if payload else 0
+            )
+            out = json.dumps({"payload": wire, "pages": n_pages}).encode()
+            if metrics is not None and payload is not None:
+                metrics.observe(
+                    "replica_migrate_seconds", time.monotonic() - t0,
+                    dir="export",
+                )
+                metrics.inc(
+                    "replica_migrate_pages_total", n_pages, dir="export"
+                )
+                metrics.inc(
+                    "replica_migrate_wire_bytes_total", len(out),
+                    dir="export",
+                )
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def _handle_import(self) -> None:
+            """POST /v1/import — {"payload"}: sealed-chain cache warm
+            (JSON response); +{"request_id"}: live import whose response
+            IS the continuation SSE stream (tokens after the migration
+            point; the terminal ``done`` carries the full list)."""
+            if metrics is not None:
+                metrics.inc("replica_http_requests_total", verb="import")
+            t_recv = time.monotonic()
+            wire_bytes = _int_or(self.headers.get("Content-Length"), 0)
+            body = self._read_json()
+            if body is None or not isinstance(body.get("payload"), dict):
+                self._send_json(400, {"error": "payload required"})
+                return
+            try:
+                payload = decode_kv_payload(body["payload"])
+            except Exception as e:  # noqa: BLE001 - wire junk is a 400
+                self._send_json(
+                    400, {"error": f"undecodable payload: {e}"}
+                )
+                return
+            t0 = time.monotonic()
+            if not body.get("request_id"):
+                try:
+                    n = loop.import_sealed(payload)
+                except (ValueError, RuntimeError) as e:
+                    self._send_json(503, {"error": str(e)})
+                    return
+                if metrics is not None:
+                    metrics.observe(
+                        "replica_migrate_seconds",
+                        time.monotonic() - t0, dir="import",
+                    )
+                    metrics.inc(
+                        "replica_migrate_pages_total", n, dir="import"
+                    )
+                    metrics.inc(
+                        "replica_migrate_wire_bytes_total", wire_bytes,
+                        dir="import",
+                    )
+                self._send_json(200, {"imported": n})
+                return
+            st = _Stream(str(body["request_id"]), t_recv)
+            try:
+                loop.import_live(
+                    st, payload,
+                    trace_id=self.headers.get("X-Trace-Id", ""),
+                    span_id=self.headers.get("X-Span-Id", "0"),
+                )
+            except (KeyError, ValueError) as e:
+                self._send_json(409, {"error": str(e)})
+                return
+            except RuntimeError as e:
+                self._send_json(503, {"error": str(e)})
+                return
+            if metrics is not None:
+                metrics.observe(
+                    "replica_migrate_seconds", time.monotonic() - t0,
+                    dir="import",
+                )
+                metrics.inc(
+                    "replica_migrate_pages_total",
+                    len(payload.get("page_keys") or []), dir="import",
+                )
+                metrics.inc(
+                    "replica_migrate_wire_bytes_total", wire_bytes,
+                    dir="import",
+                )
+            self._serve_stream(st)
 
         def _stream(self, st: _Stream) -> None:
             while True:
@@ -544,11 +865,12 @@ class ReplicaServer:
     def __init__(self, batcher, listen: Tuple[str, int] = ("127.0.0.1", 0),
                  metrics: Optional[Metrics] = None,
                  tracer: Optional[Tracer] = None,
-                 step_delay_s: float = 0.0) -> None:
+                 step_delay_s: float = 0.0,
+                 fail_migration: bool = False) -> None:
         self.metrics = metrics if metrics is not None else Metrics()
         self.loop = ReplicaServingLoop(
             batcher, metrics=self.metrics, tracer=tracer,
-            step_delay_s=step_delay_s,
+            step_delay_s=step_delay_s, fail_migration=fail_migration,
         )
         self.httpd = _ReplicaHTTPServer(
             listen, make_replica_handler(self.loop, self.metrics)
@@ -747,9 +1069,143 @@ class HttpReplicaClient(ReplicaClient):
                 out[key] = state["ledger"]
         return out
 
+    # -- KV-page migration -------------------------------------------------
+    def inflight_on(self, replica_key: str) -> List[Attempt]:
+        with self._lock:
+            return list(self._inflight.get(replica_key, ()))
+
+    def seals_decode(self, replica_key: str) -> bool:
+        state = self._get_state(replica_key)
+        return bool(state and state.get("seals_decode"))
+
+    def _wire_export(self, addr: str, body: dict) -> Optional[dict]:
+        """POST /v1/export; returns the (still-encoded) payload dict or
+        None — the gateway relays it to /v1/import opaquely, so only
+        replica processes pay the codec."""
+        conn = _connect(addr, timeout=self.timeout_s)
+        try:
+            conn.request(
+                "POST", "/v1/export", json.dumps(body),
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                return None
+            return json.loads(data).get("payload")
+        except (OSError, ValueError):
+            return None
+        finally:
+            conn.close()
+
+    def export_sealed(self, replica_key: str, stream) -> Optional[dict]:
+        addr = self.endpoint_for(replica_key)
+        if addr is None:
+            return None
+        return self._wire_export(
+            addr, {"stream": [int(t) for t in stream]}
+        )
+
+    def import_sealed(self, replica_key: str, payload) -> bool:
+        addr = self.endpoint_for(replica_key)
+        if addr is None or payload is None:
+            return False
+        conn = _connect(addr, timeout=self.timeout_s)
+        try:
+            conn.request(
+                "POST", "/v1/import", json.dumps({"payload": payload}),
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            resp.read()
+            return resp.status == 200
+        except (OSError, ValueError):
+            return False
+        finally:
+            conn.close()
+
+    def migrate(self, attempt: Attempt, request, to_key: str,
+                _between: Optional[Callable[[], None]] = None) -> bool:
+        """Live migration over the wire: POST /v1/export on the source
+        (which detaches the sequence — its stream ends ``migrated``,
+        which the source's reader recognizes and leaves unresolved),
+        re-home the attempt, then stream the continuation from POST
+        /v1/import on the target.  The SAME attempt handle resolves
+        with the full token list from the target; a refused or dead
+        importer resolves it with an error so normal failover
+        re-dispatches cold — graceful, never wrong."""
+        if attempt.done:
+            return False
+        from_key = attempt.replica
+        from_addr = self.endpoint_for(from_key)
+        to_addr = self.endpoint_for(to_key)
+        if from_addr is None or to_addr is None or from_key == to_key:
+            return False
+        trace = getattr(request, "trace", None)
+        if not isinstance(trace, SpanCtx):
+            trace = None
+        mspan = (
+            trace.child("migrate", source=from_key, target=to_key)
+            if trace is not None else None
+        )
+        attempt._migrating = True
+        wire = self._wire_export(
+            from_addr, {"request_id": request.request_id}
+        )
+        if wire is None:
+            # nothing detached — or the export RESPONSE was lost after
+            # the replica already detached.  Clear the flag first: a
+            # "migrated" terminal arriving from now on resolves the
+            # attempt as a plain error (failover re-dispatches cold).
+            # If the reader ALREADY swallowed that terminal while the
+            # flag was up (it records _migrated_terminal before checking
+            # the flag — the handshake that closes the race), resolve
+            # the attempt here: the sequence is detached and no
+            # continuation is coming, and an unresolved attempt would
+            # otherwise hang until the dispatcher's full deadline.
+            attempt._migrating = False
+            if getattr(attempt, "_migrated_terminal", False):
+                attempt.finish(AttemptResult(
+                    False,
+                    error="migration export response lost: sequence "
+                    "detached at the source",
+                ))
+            if self.metrics is not None:
+                self.metrics.inc(
+                    "gateway_migrations_total", outcome="export_failed"
+                )
+            if mspan is not None:
+                mspan.end(outcome="export_failed")
+            return False
+        # re-home BEFORE any chaos can fire: if the source dies now,
+        # sync_live must not abort an attempt it no longer owns
+        with self._lock:
+            self._inflight.setdefault(to_key, set()).add(attempt)
+            bucket = self._inflight.get(from_key)
+            if bucket is not None:
+                bucket.discard(attempt)
+        attempt.replica = to_key
+        if _between is not None:
+            _between()   # fault injection: kill-mid-migration schedules
+        t = threading.Thread(
+            target=self._run_attempt,
+            args=(attempt, request, to_addr, to_key),
+            kwargs={"import_payload": wire},
+            daemon=True,
+        )
+        t.start()
+        if self.metrics is not None:
+            self.metrics.inc("gateway_migrations_total", outcome="ok")
+        if mspan is not None:
+            mspan.end(
+                outcome="ok", pages=len(wire.get("page_keys") or [])
+            )
+        return True
+
     # -- ReplicaClient -----------------------------------------------------
     def submit(self, replica_key: str, request) -> Attempt:
         attempt = Attempt(replica_key, request.request_id)
+        attempt.request = request
         addr = self.endpoint_for(replica_key)
         with self._lock:
             stopped = self._stopped
@@ -761,7 +1217,8 @@ class HttpReplicaClient(ReplicaClient):
         with self._lock:
             self._inflight.setdefault(replica_key, set()).add(attempt)
         t = threading.Thread(
-            target=self._run_attempt, args=(attempt, request, addr),
+            target=self._run_attempt,
+            args=(attempt, request, addr, replica_key),
             daemon=True,
         )
         t.start()
@@ -819,13 +1276,17 @@ class HttpReplicaClient(ReplicaClient):
                 return
         conn.close()
 
-    def _settle(self, attempt: Attempt) -> None:
+    def _settle(self, attempt: Attempt, replica_key: str) -> None:
+        # keyed by the replica THIS reader served, not attempt.replica:
+        # a migration re-homes the attempt to its target mid-flight, and
+        # the source's reader settling afterwards must not evict it from
+        # the target's bucket
         with self._lock:
-            bucket = self._inflight.get(attempt.replica)
+            bucket = self._inflight.get(replica_key)
             if bucket is not None:
                 bucket.discard(attempt)
                 if not bucket:
-                    self._inflight.pop(attempt.replica, None)
+                    self._inflight.pop(replica_key, None)
 
     def _deadline_of(self, request) -> Optional[float]:
         deadline_s = getattr(request, "deadline_s", None)
@@ -834,37 +1295,56 @@ class HttpReplicaClient(ReplicaClient):
         anchor = getattr(request, "enqueued_at", 0.0) or time.monotonic()
         return anchor + deadline_s
 
-    def _run_attempt(self, attempt: Attempt, request, addr: str) -> None:
+    def _run_attempt(self, attempt: Attempt, request, addr: str,
+                     replica_key: str,
+                     import_payload: Optional[dict] = None) -> None:
         """Reader thread: stream the attempt to completion.  The
         terminal event's span dicts are grafted into the gateway's trace
         BEFORE the attempt resolves, so the winner's tree is complete
-        when the dispatcher records the result."""
-        conn = self._checkout(attempt.replica, addr)
+        when the dispatcher records the result.  With
+        ``import_payload``, the attempt is a migration CONTINUATION:
+        POST /v1/import carries the exported payload and the stream
+        resumes mid-sequence on the target replica."""
+        conn = self._checkout(replica_key, addr)
         trace = getattr(request, "trace", None)
         if not isinstance(trace, SpanCtx):
             trace = None
         deadline = self._deadline_of(request)
         reusable = False
         try:
-            body = json.dumps({
-                "request_id": request.request_id,
-                "prompt": [int(t) for t in request.prompt],
-                "max_new_tokens": int(request.max_new_tokens),
-                "temperature": float(getattr(request, "temperature", 0.0)),
-                "session": getattr(request, "session", None),
-            })
+            if import_payload is not None:
+                path = "/v1/import"
+                body = json.dumps({
+                    "request_id": request.request_id,
+                    "payload": import_payload,
+                })
+            else:
+                path = "/v1/submit"
+                body = json.dumps({
+                    "request_id": request.request_id,
+                    "prompt": [int(t) for t in request.prompt],
+                    "max_new_tokens": int(request.max_new_tokens),
+                    "temperature": float(
+                        getattr(request, "temperature", 0.0)
+                    ),
+                    "session": getattr(request, "session", None),
+                })
             headers = {"Content-Type": "application/json"}
             if trace is not None:
                 headers["X-Trace-Id"] = trace.trace_id
                 headers["X-Span-Id"] = str(trace.span_id)
             attempt._stream_conn = conn
             t_send = time.monotonic()
-            conn.request("POST", "/v1/submit", body, headers)
+            conn.request("POST", path, body, headers)
             resp = conn.getresponse()
             if resp.status != 200:
                 err = resp.read()[:200].decode(errors="replace")
+                if import_payload is not None and self.metrics is not None:
+                    self.metrics.inc(
+                        "gateway_migrations_total", outcome="import_refused"
+                    )
                 attempt.finish(AttemptResult(
-                    False, error=f"replica {attempt.replica} refused "
+                    False, error=f"replica {replica_key} refused "
                     f"({resp.status}): {err}"
                 ))
                 return
@@ -872,7 +1352,7 @@ class HttpReplicaClient(ReplicaClient):
                 attempt, request, resp, trace, t_send, deadline
             )
         except socket.timeout:
-            self._wire_cancel(attempt.replica, request.request_id)
+            self._wire_cancel(replica_key, request.request_id)
             attempt.finish(AttemptResult(
                 False, error="attempt timed out on the wire"
             ))
@@ -882,13 +1362,13 @@ class HttpReplicaClient(ReplicaClient):
             # cancel() closed under us (fp already torn down)
             attempt.finish(AttemptResult(
                 False,
-                error=f"replica {attempt.replica} connection failed: {e}",
+                error=f"replica {replica_key} connection failed: {e}",
             ))
         finally:
             attempt._stream_conn = None
-            self._settle(attempt)
+            self._settle(attempt, replica_key)
             if reusable:
-                self._checkin(attempt.replica, conn)
+                self._checkin(replica_key, conn)
             else:
                 conn.close()
 
@@ -965,6 +1445,22 @@ class HttpReplicaClient(ReplicaClient):
                             self.decodes[request.request_id] = (
                                 self.decodes.get(request.request_id, 0) + 1
                             )
+                elif str(payload.get("error", "")) == "migrated":
+                    # the exporter detached this sequence.  Record that
+                    # FIRST (migrate()'s export-failure path checks it:
+                    # a lost /v1/export response must still resolve the
+                    # attempt — see the flag handshake there), THEN
+                    # decide: mid-migration the import continuation owns
+                    # the attempt's resolution, so leave it unresolved
+                    # (the exporter's spans were still grafted above and
+                    # the drain below leaves the connection reusable);
+                    # otherwise surface it as a plain error so failover
+                    # re-dispatches cold.
+                    attempt._migrated_terminal = True
+                    if not attempt._migrating:
+                        attempt.finish(AttemptResult(
+                            False, error="migrated"
+                        ))
                 else:
                     attempt.finish(AttemptResult(
                         False, error=str(payload.get("error", "error"))

@@ -21,9 +21,11 @@ backstop, exactly the scheduler's informer/resync split.
 from __future__ import annotations
 
 import logging
+import random
 import threading
+import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, FrozenSet, List, Optional
+from typing import Callable, Dict, FrozenSet, List, Optional, Set
 
 from kubegpu_tpu.types import annotations
 from kubegpu_tpu.types.topology import Coord
@@ -51,6 +53,11 @@ class ReplicaInfo:
     # holds the replica out of the live set, which is correct: a replica
     # without a routable address cannot serve)
     addr: Optional[str] = None
+    # DRAINING: still healthy and serving its in-flight work (the data
+    # plane keeps its connections), but the router must not place new
+    # admissions on it — the graceful half of the replica lifecycle:
+    # DRAINING → (sessions migrated / sealed exports captured) → released
+    draining: bool = False
 
 
 class ReplicaRegistry:
@@ -63,7 +70,9 @@ class ReplicaRegistry:
     """
 
     def __init__(self, api: ApiServer, group: Optional[str] = None,
-                 probe=None) -> None:
+                 probe=None, clock: Optional[Callable[[], float]] = None,
+                 probe_backoff_base_s: float = 0.5,
+                 probe_backoff_cap_s: float = 30.0) -> None:
         self.api = api
         self.group = group  # None = every serving group
         # optional DATA-PLANE health probe: called with each replica the
@@ -75,6 +84,21 @@ class ReplicaRegistry:
         # replica counts a gateway fronts; sample or parallelize before
         # pointing this at hundreds of replicas.
         self.probe = probe
+        # probe BACKOFF: a failing replica's probe retries exponentially
+        # (base * 2^(fails-1), capped) with per-try jitter in [0.5, 1.5)x
+        # instead of re-probing a dead address every refresh cycle — with
+        # watches coalescing refreshes, a dead pod would otherwise eat a
+        # connect timeout per cluster event.  A probe success resets the
+        # backoff; the clock is injectable for fake-clock unit tests.
+        self._clock = clock if clock is not None else time.monotonic
+        self.probe_backoff_base_s = probe_backoff_base_s
+        self.probe_backoff_cap_s = probe_backoff_cap_s
+        self._backoff_rng = random.Random(0)
+        # key -> {"fails", "next", "why"}; guarded by _refresh_lock (the
+        # only writer is the refresh cycle)
+        self._probe_backoff: Dict[str, dict] = {}
+        # keys an operator (or Gateway.drain_replica) marked DRAINING
+        self._draining: Set[str] = set()
         self._lock = threading.Lock()
         # serializes whole refresh cycles (LIST → join → swap): the watch
         # handlers and the periodic loop both call refresh(), and an older
@@ -100,6 +124,31 @@ class ReplicaRegistry:
         with self._refresh_lock:
             self._refresh_locked()
 
+    def _probe_with_backoff(self, key: str, info: "ReplicaInfo"):
+        """One backoff-gated probe attempt.  Inside the backoff window
+        the cached failure stands (no network touch); outside it the
+        probe runs — success clears the state, failure doubles the
+        window (jittered, capped)."""
+        now = self._clock()
+        st = self._probe_backoff.get(key)
+        if st is not None and now < st["next"]:
+            return False, (
+                f"{st['why']} (probe backing off, {st['fails']} fails)"
+            )
+        ok, why = self.probe(info)
+        if ok:
+            self._probe_backoff.pop(key, None)
+            return True, ""
+        fails = (st["fails"] + 1) if st is not None else 1
+        delay = min(
+            self.probe_backoff_cap_s,
+            self.probe_backoff_base_s * 2 ** (fails - 1),
+        ) * (0.5 + self._backoff_rng.random())
+        self._probe_backoff[key] = {
+            "fails": fails, "next": now + delay, "why": why,
+        }
+        return False, why
+
     def _refresh_locked(self) -> None:
         chip_health: Dict[tuple, bool] = {}
         advertised_slices = set()
@@ -110,6 +159,9 @@ class ReplicaRegistry:
             advertised_slices.add(info.slice_id)
             for ch in info.chips:
                 chip_health[(info.slice_id, ch.coords)] = ch.healthy
+
+        with self._lock:
+            draining = set(self._draining)
 
         replicas: Dict[str, ReplicaInfo] = {}
         for obj in self.api.list_pods():
@@ -150,20 +202,27 @@ class ReplicaRegistry:
             info = ReplicaInfo(
                 key=key, pod=name, namespace=ns, group=group, node=node,
                 slice_id=slice_id, coords=coords, healthy=healthy,
-                reason=reason, addr=addr,
+                reason=reason, addr=addr, draining=key in draining,
             )
             if healthy and self.probe is not None:
-                ok, why = self.probe(info)
+                ok, why = self._probe_with_backoff(key, info)
                 if not ok:
                     info = ReplicaInfo(
                         key=key, pod=name, namespace=ns, group=group,
                         node=node, slice_id=slice_id, coords=coords,
                         healthy=False, reason=f"data plane: {why}",
-                        addr=addr,
+                        addr=addr, draining=key in draining,
                     )
             replicas[key] = info
 
+        # prune state for replicas that left the cluster: a recreated
+        # pod under the same name starts with a clean slate (no stale
+        # backoff window, no inherited DRAINING mark)
+        self._probe_backoff = {
+            k: v for k, v in self._probe_backoff.items() if k in replicas
+        }
         with self._lock:
+            self._draining &= set(replicas)
             self._replicas = replicas
             live = frozenset(k for k, r in replicas.items() if r.healthy)
             changed = live != self._last_live
@@ -176,14 +235,40 @@ class ReplicaRegistry:
                 except Exception:  # noqa: BLE001 - observers are best-effort
                     log.exception("replica-set observer failed")
 
+    # -- replica lifecycle (DRAINING → released) ---------------------------
+    def set_draining(self, key: str, draining: bool = True) -> None:
+        """Mark a replica DRAINING (or clear it).  A draining replica
+        stays HEALTHY — its data-plane connections and in-flight
+        sequences keep serving, and the live-set observers do NOT fire
+        (firing would make clients abort the very streams a graceful
+        drain is migrating) — but ``routable()`` excludes it, so no new
+        admissions land there.  Refreshes immediately so routing sees
+        the state this cycle."""
+        with self._lock:
+            if draining:
+                self._draining.add(key)
+            else:
+                self._draining.discard(key)
+        self.refresh()
+
+    def draining_keys(self) -> FrozenSet[str]:
+        with self._lock:
+            return frozenset(self._draining)
+
     # -- views -------------------------------------------------------------
     def live(self) -> List[ReplicaInfo]:
-        """Routable replicas, name-sorted for deterministic iteration."""
+        """Healthy replicas (DRAINING included — their data plane is
+        alive), name-sorted for deterministic iteration."""
         with self._lock:
             return sorted(
                 (r for r in self._replicas.values() if r.healthy),
                 key=lambda r: r.key,
             )
+
+    def routable(self) -> List[ReplicaInfo]:
+        """Replicas new admissions may land on: healthy AND not
+        draining — the router's view of the world."""
+        return [r for r in self.live() if not r.draining]
 
     def all(self) -> List[ReplicaInfo]:
         with self._lock:

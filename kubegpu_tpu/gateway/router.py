@@ -154,6 +154,27 @@ class SessionAffinityRouter(Router):
         with self._lock:
             self._pins.pop(session, None)
 
+    def pins_on(self, replica_key: str) -> List[str]:
+        """Sessions currently pinned to one replica — the drain path's
+        capture list (their sealed KV should be exported before the
+        replica is released)."""
+        with self._lock:
+            return [
+                s for s, k in self._pins.items() if k == replica_key
+            ]
+
+    def forget_replica(self, replica_key: str) -> None:
+        """Drop every pin to a draining/released replica.  A PLANNED
+        unpin: the next turn re-pins by load (with the sealed-export
+        restore making the move a transfer) and is not counted as a
+        KV-loss re-pin — the loss metric is for replicas that die out
+        from under their sessions."""
+        with self._lock:
+            for s in [
+                s for s, k in self._pins.items() if k == replica_key
+            ]:
+                del self._pins[s]
+
 
 class _with_hint:
     """Request view carrying a routing hint without mutating the caller's

@@ -18,7 +18,8 @@ behavior is testable without sockets; this module only translates HTTP:
         → 502 {"error": ...}   all attempts failed
         → 504 {"error": ...}   deadline exceeded
     GET  /healthz       liveness (the process serves)
-    GET  /readyz        readiness (≥1 live replica to route to)
+    GET  /readyz        readiness (≥1 routable — live, not draining —
+                        replica to route new admissions to)
     GET  /metrics       Prometheus text (TTFT/queue-wait histograms,
                         queue-depth/live-replica gauges)
     GET  /state         debug dump (replicas, queue, outcome counts)
@@ -84,17 +85,22 @@ def make_handler(gateway: Gateway, registry: ReplicaRegistry):
             if self.path == "/healthz":
                 self._send(200, "ok", content_type="text/plain")
             elif self.path == "/readyz":
-                # ready ⇔ at least one replica to route to AND a data
-                # plane that can reach it; either gap means a gateway in
-                # the Service would eat traffic into guaranteed 5xx
+                # ready ⇔ at least one replica to route NEW work to AND
+                # a data plane that can reach it; either gap means a
+                # gateway in the Service would eat traffic into
+                # guaranteed 5xx.  ROUTABLE, not live: a fleet that is
+                # entirely DRAINING still serves its in-flight streams
+                # but can admit nothing — the load balancer must
+                # fast-fail instead of feeding requests into
+                # deadline-exceeded
                 if not gateway.client.ready():
                     self._send(503, "data plane not wired "
                                "(no replica client)",
                                content_type="text/plain")
-                elif registry.live():
+                elif registry.routable():
                     self._send(200, "ok", content_type="text/plain")
                 else:
-                    self._send(503, "no live replicas",
+                    self._send(503, "no routable replicas",
                                content_type="text/plain")
             elif self.path == "/metrics":
                 self._send(200, gateway.metrics.render(),

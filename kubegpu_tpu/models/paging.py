@@ -1346,13 +1346,14 @@ class PagedContinuousBatcher(_TracedBatcher):
             [np.asarray(s.prompt, np.int32),
              np.asarray(s.tokens, np.int32)]
         )
-        h = hashlib.sha256()
+        # ONE chain-key discipline (shared with the migration verbs —
+        # exported keys must hit sealed caches and vice versa)
+        keys = self._chain_keys(stream, n_full)
         for j in range(n_full):
-            h.update(stream[j * self.page: (j + 1) * self.page].tobytes())
             phys = s.pages[j]
             if phys in s.shared:
                 continue  # already cached (acquired hit or scatter-sealed)
-            key = h.digest()
+            key = keys[j]
             if self.prefix_cache.lookup(key) is not None:
                 continue  # a twin stream sealed this content first
             kind = "prompt" if j < n_prompt else "decode"
@@ -1931,8 +1932,443 @@ class PagedContinuousBatcher(_TracedBatcher):
             "prefix_hit_tokens": 0, "prefix_hit_tokens_prompt": 0,
             "prefix_hit_tokens_decode": 0, "prompt_tokens": 0,
             "decode_pages_sealed": 0, "spec_steps": 0, "spec_tokens": 0,
-            "draft_wraps": 0,
+            "draft_wraps": 0, "pages_exported": 0, "pages_imported": 0,
+            "imports": 0,
         }
+
+    # -- live KV-page migration (the EXPORT/IMPORT verb pair) ---------------
+    # The transfer primitive behind drains, failovers and session re-pins
+    # (ROADMAP item 1): a sequence's committed pages — plus the
+    # prefix-chain keys that make them shareable and the decode cursor
+    # that makes them resumable — serialize OUT of one batcher's pool and
+    # INTO another's, so replica lifecycle events move KV instead of
+    # cold-restarting prefill.  Export is READ-ONLY (the exporter keeps
+    # its pages until the caller detaches the sequence, so accounting
+    # holds on both ends mid-transfer by construction); import is ATOMIC
+    # (feasibility is checked before the first allocation, so a refused
+    # import leaves the pool byte-identical — a kill or refusal anywhere
+    # in a migration can never leak or double-free a page).  Under tensor
+    # parallelism the payload moves tp independent SHARD-LOCAL copies:
+    # each device's head shard is read and re-placed as-is — the same
+    # head-sharded layout both ends, no resharding, no collective.
+
+    def _chain_keys(self, stream: np.ndarray, n_full: int) -> List[bytes]:
+        """Prefix-chain keys of a stream's first ``n_full`` full pages —
+        the same cumulative sha256-with-snapshots discipline submit and
+        retirement sealing use, so exported keys hit imported caches."""
+        h = hashlib.sha256()
+        keys: List[bytes] = []
+        for j in range(n_full):
+            h.update(stream[j * self.page: (j + 1) * self.page].tobytes())
+            keys.append(h.copy().digest())
+        return keys
+
+    def _transfer_geometry(self) -> dict:
+        return {
+            "page": self.page, "layers": self.num_layers,
+            "heads": self.num_heads,
+            "head_dim": self.hidden // self.num_heads,
+            "dtype": str(jnp.dtype(self.dtype)), "tp": self.tp,
+        }
+
+    def _check_geometry(self, g: dict) -> None:
+        want = self._transfer_geometry()
+        for k in ("page", "layers", "heads", "head_dim", "dtype"):
+            if g.get(k) != want[k]:
+                raise ValueError(
+                    f"transfer geometry mismatch on {k}: payload "
+                    f"{g.get(k)!r} vs this batcher {want[k]!r} — KV pages "
+                    "move only between twins (same paged layout; tp may "
+                    "differ, the payload is layout-agnostic host bytes)"
+                )
+
+    def _pages_to_host(self, arr, idx) -> np.ndarray:
+        """Read pool pages ``idx`` to host numpy.  Unsharded: one
+        gather.  Sharded: per-device shard-local reads reassembled on
+        the heads axis — no all-gather; the wire carries exactly the
+        bytes each shard rests, in head order."""
+        sel = arr[idx]
+        if self.mesh is None or self.tp == 1:
+            return np.asarray(sel)
+        sel = jax.device_put(
+            sel, NamedSharding(self.mesh, paged_pool_spec())
+        )
+        shards = sorted(
+            sel.addressable_shards,
+            key=lambda sh: sh.index[1].start or 0,
+        )
+        return np.concatenate(
+            [np.asarray(sh.data) for sh in shards], axis=1
+        )
+
+    def _write_host_pages(self, arr, phys: np.ndarray, data: np.ndarray):
+        """Scatter transferred host pages into pool pages ``phys``.
+        Under a mesh the update is placed head-sharded FIRST, so every
+        device writes only its own shard of each page (the import twin
+        of the shard-local export read)."""
+        upd = jnp.asarray(data)
+        if self.mesh is not None:
+            upd = jax.device_put(
+                upd, NamedSharding(self.mesh, paged_pool_spec())
+            )
+        out = arr.at[jnp.asarray(phys)].set(upd)
+        if self.mesh is not None:
+            out = jax.device_put(
+                out, NamedSharding(self.mesh, paged_pool_spec())
+            )
+        return out
+
+    def export_pages(self, seq_id: int) -> dict:
+        """Serialize a LIVE sequence for migration: its committed pages'
+        K/V bytes, the prefix-chain keys + kinds that let the importer
+        replay them into its ``PrefixPageCache``, and the decode cursor
+        (tokens, remaining budget, sampling state) that lets it resume
+        at the same position.  READ-ONLY: the exporter's pool, slot and
+        accounting are untouched — the caller detaches (``cancel``)
+        once the importer acknowledged.  Drains the pipelined in-flight
+        iteration first so the host mirrors reflect every committed
+        token (the payload must never lag a token the device already
+        committed).  Raises ``KeyError`` for an unknown sequence,
+        ``ValueError`` for one that cannot migrate (mid-prefill:
+        nothing committed — cold-restart it on the target instead;
+        already finished: nothing left to decode)."""
+        slot = next(
+            (i for i, s in enumerate(self._seqs) if s.seq_id == seq_id),
+            None,
+        )
+        if slot is None:
+            raise KeyError(f"unknown sequence {seq_id}")
+        s = self._seqs[slot]
+        if s.prefilling:
+            raise ValueError(
+                f"sequence {seq_id} is mid-prefill: nothing committed "
+                "to move"
+            )
+        while self._inflight:
+            self._process_entry(self._inflight.popleft())
+        if not s.active:
+            raise ValueError(
+                f"sequence {seq_id} already finished: nothing to migrate"
+            )
+        committed = s.plen + len(s.tokens) - 1   # rows [0, committed)
+        n_pages = -(-committed // self.page) if committed else 0
+        n_full = committed // self.page
+        n_prompt = (s.plen - 1) // self.page
+        stream = np.concatenate([
+            np.asarray(s.prompt, np.int32),
+            np.asarray(s.tokens, np.int32),
+        ])
+        keys = self._chain_keys(stream, n_full)
+        idx = jnp.asarray(np.asarray(s.pages[:n_pages], np.int32))
+        layers = [
+            (self._pages_to_host(kp, idx), self._pages_to_host(vp, idx))
+            for kp, vp in self.pools
+        ]
+        self.stats["pages_exported"] += n_pages
+        return {
+            "kind": "live",
+            "geometry": self._transfer_geometry(),
+            "prompt": [int(t) for t in np.asarray(s.prompt)],
+            "tokens": list(s.tokens),
+            "remaining": int(s.remaining),
+            "temperature": float(np.asarray(self._temps)[slot]),
+            "base_key": [
+                int(x) for x in np.asarray(self._base_keys)[slot]
+            ],
+            "page_keys": [
+                keys[j].hex() if j < n_full else None
+                for j in range(n_pages)
+            ],
+            "page_kinds": [
+                ("prompt" if j < n_prompt else "decode")
+                if j < n_full else None
+                for j in range(n_pages)
+            ],
+            "layers": layers,
+        }
+
+    def import_pages(self, seq_id: int, payload: dict,
+                     trace: Optional[SpanCtx] = None) -> None:
+        """The inverse verb: re-acquire pool pages for a migrated
+        sequence, replay its prefix chain into the local
+        ``PrefixPageCache`` (content-addressing dedups against pages
+        this replica already holds — a double import SHARES, never
+        duplicates), write the transferred K/V, and resume decode at
+        the exported cursor.  ATOMIC: slot and pool feasibility are
+        checked before the first allocation, so a refusal
+        (``RuntimeError``) leaves this batcher's accounting
+        byte-identical.  ``ValueError`` means the payload itself cannot
+        be served here (geometry mismatch, seq_id in use, malformed)."""
+        if payload.get("kind") != "live" or "geometry" not in payload:
+            raise ValueError("not a live paged-KV payload")
+        self._check_geometry(payload["geometry"])
+        if seq_id < 0:
+            raise ValueError(f"seq_id must be >= 0, got {seq_id}")
+        if any(s.seq_id == seq_id for s in self._seqs) or any(
+            item[0] == seq_id for item in self._pending
+        ):
+            raise ValueError(f"seq_id {seq_id} already in use")
+        prompt = np.asarray(payload["prompt"], np.int32)
+        tokens = [int(t) for t in payload["tokens"]]
+        remaining = int(payload["remaining"])
+        if remaining <= 0:
+            raise ValueError("nothing left to decode")
+        temperature = float(payload.get("temperature", 0.0))
+        if self.speculate_k is not None and temperature > 0.0:
+            raise ValueError("speculative paged serving is greedy-only")
+        plen = self._validate(prompt, len(tokens) + remaining)
+        committed = plen + len(tokens) - 1
+        n_pages = -(-committed // self.page) if committed else 0
+        page_keys = list(payload.get("page_keys") or [None] * n_pages)
+        page_kinds = list(payload.get("page_kinds") or [None] * n_pages)
+        layers = payload["layers"]
+        hd = self.hidden // self.num_heads
+        want_shape = (n_pages, self.num_heads, self.page, hd)
+        if (len(layers) != self.num_layers or len(page_keys) != n_pages
+                or len(page_kinds) != n_pages):
+            raise ValueError("malformed payload: layer/page counts drift")
+        for k_np, v_np in layers:
+            if (tuple(np.shape(k_np)) != want_shape
+                    or tuple(np.shape(v_np)) != want_shape):
+                raise ValueError(
+                    f"malformed payload: page array shape "
+                    f"{np.shape(k_np)} != {want_shape}"
+                )
+        slot = next(
+            (i for i, s in enumerate(self._seqs) if s.seq_id < 0), None
+        )
+        if slot is None:
+            raise RuntimeError("import refused: no free sequence slot")
+        need = self._pages_for(plen, len(tokens) + remaining)
+        # feasibility — including the chain-dedup plan — BEFORE any
+        # mutation: the refusal path must not move a single refcount.
+        # EVERY transferred key is probed independently (no break at the
+        # first miss): a chain key alone guarantees its page's content,
+        # and the cache can legitimately hold a chain with a HOLE (LRU
+        # eviction pops the oldest entry — often the chain's first page)
+        # — a cached later page must be shared, never re-inserted (the
+        # insert would assert on the duplicate key mid-commit)
+        hits: Dict[int, int] = {}
+        if self.prefix_cache is not None:
+            for j in range(min(n_pages, need)):
+                key = page_keys[j]
+                if key is None:
+                    continue
+                page = self.prefix_cache.lookup(bytes.fromhex(key))
+                if page is not None:
+                    hits[j] = page
+        if need - len(hits) > self._available_pages(set(hits.values())):
+            raise RuntimeError(
+                f"import refused: needs {need - len(hits)} fresh pages, "
+                f"{self._available_pages(set(hits.values()))} available"
+            )
+        # ---- commit: no failure path below this line ----
+        # acquire EVERY hit before the first allocation: _alloc_page
+        # evicts idle LRU entries, and an idle page this import is about
+        # to share must never be the one evicted from under it
+        pages_by_j: Dict[int, int] = {}
+        shared: Set[int] = set()
+        for j, hit in hits.items():
+            got = self.prefix_cache.acquire(bytes.fromhex(page_keys[j]))
+            assert got == hit
+            pages_by_j[j] = got
+            shared.add(got)
+        for j in range(need):
+            if j not in pages_by_j:
+                pages_by_j[j] = self._alloc_page()
+        pages = [pages_by_j[j] for j in range(need)]
+        # replay the chain: freshly-transferred full pages register
+        # under their keys (kind-gated exactly like retirement sealing),
+        # so the session's NEXT prompt hits on this replica too
+        to_write = [j for j in range(n_pages) if j not in hits]
+        if self.prefix_cache is not None:
+            for j in to_write:
+                key, kind = page_keys[j], page_kinds[j]
+                if key is None or kind is None:
+                    continue
+                if kind == "decode" and not self._seal_decode:
+                    continue
+                if self.prefix_cache.lookup(bytes.fromhex(key)) is not None:
+                    continue  # belt-and-braces: never double-register a
+                    # key (the hit probe above should have claimed it)
+                self.prefix_cache.insert(
+                    bytes.fromhex(key), pages[j], kind=kind
+                )
+                shared.add(pages[j])
+        if to_write:
+            sel = np.asarray(to_write, np.intp)
+            phys = np.asarray([pages[j] for j in to_write], np.int32)
+            self.pools = [
+                (
+                    self._write_host_pages(kp, phys, np.asarray(k_np)[sel]),
+                    self._write_host_pages(vp, phys, np.asarray(v_np)[sel]),
+                )
+                for (kp, vp), (k_np, v_np) in zip(self.pools, layers)
+            ]
+        # the cursor: the slot resumes exactly where the exporter stopped
+        s = self._seqs[slot]
+        now = time.monotonic()
+        s.seq_id, s.active, s.prefilling = seq_id, True, False
+        s.gen += 1
+        s.tokens, s.remaining = list(tokens), remaining
+        s.pages, s.shared = pages, shared
+        s.submitted_at = now
+        s.last_emit_at = now
+        s.prompt, s.plen = prompt[:plen], plen
+        last = tokens[-1] if tokens else int(prompt[plen - 1])
+        self.tables[slot, :] = pages[0]
+        self.tables[slot, : len(pages)] = pages
+        self.pos[slot] = committed
+        self._last[slot] = last
+        base_key = np.asarray(
+            payload.get("base_key") or [0, 0], np.uint32
+        )
+        self._temps = self._temps.at[slot].set(temperature)
+        self._base_keys = self._base_keys.at[slot].set(
+            jnp.asarray(base_key)
+        )
+        self._tables_dev = self._tables_dev.at[slot].set(
+            jnp.asarray(self.tables[slot])
+        )
+        self._pos_dev = self._pos_dev.at[slot].set(committed)
+        self._last_dev = self._last_dev.at[slot].set(last)
+        self._active_dev = self._active_dev.at[slot].set(True)
+        self._remaining_dev = self._remaining_dev.at[slot].set(remaining)
+        self._counts_dev = self._counts_dev.at[slot].set(len(tokens))
+        if self.speculate_k is not None:
+            # the draft ring does NOT transfer (advisory state): re-admit
+            # the prompt so the draft has some context and park its
+            # cursor at the real position — ring rows the exporter's
+            # draft held are zeros here, so accept rate dips until the
+            # ring rebuilds (or wraps), but greedy verification is
+            # lossless for ANY draft, so the stream cannot change
+            row = np.zeros((self.prompt_pad,), np.int32)
+            row[:plen] = prompt[:plen]
+            self.d_caches = self._draft_admit(
+                self.draft_params, self.d_caches, jnp.asarray(row),
+                jnp.int32(slot),
+            )
+            self._step_collective_bytes += self._admit_psum_bytes
+            self._d_pos[slot] = committed
+            self._d_pos_dev = self._d_pos_dev.at[slot].set(committed)
+        # the imported sequence opens a FRESH serve subtree (the
+        # exporter's closed at detach with its own retire) that goes
+        # straight to the decode phase
+        self._trace_begin(seq_id, plen, len(tokens) + remaining, trace)
+        tr = self._traces.pop(seq_id, None)
+        if tr is not None:
+            tr.serve.annotate(imported=True, pages=len(pages),
+                              transferred=n_pages)
+            self._trace_phase_end(tr, "queue")
+            self._trace_phase_start(tr, "decode")
+            s.trace = tr
+        self.stats["imports"] += 1
+        self.stats["admits"] += 1
+        self.stats["pages_imported"] += len(to_write)
+        self.stats["peak_pages"] = max(
+            self.stats["peak_pages"], self.pages_in_use()
+        )
+
+    def export_sealed_chain(self, stream) -> Optional[dict]:
+        """Serialize the SEALED prefix-chain pages of a finished stream
+        (prompt + generated tokens) out of the cache — the failover
+        insurance verb: the gateway captures this after a sessionful
+        turn completes, and a replica death later restores the
+        session's turn-2 state on the new pin by importing it.
+        READ-ONLY (no refcount moves).  Returns None when the cache
+        holds nothing for this stream — the import side then degrades
+        cleanly to cold prefill (graceful, never wrong)."""
+        if self.prefix_cache is None:
+            return None
+        stream = np.asarray(stream, np.int32)
+        if stream.shape[0] < 2:
+            return None
+        committed = int(stream.shape[0]) - 1  # the sealing bound
+        n_full = committed // self.page
+        keys = self._chain_keys(stream, n_full)
+        phys: List[int] = []
+        page_keys: List[str] = []
+        page_kinds: List[str] = []
+        for key in keys:
+            page = self.prefix_cache.lookup(key)
+            if page is None:
+                break   # chain hits are prefix-contiguous
+            phys.append(page)
+            page_keys.append(key.hex())
+            page_kinds.append(self.prefix_cache.kind_of(page))
+        if not phys:
+            return None
+        idx = jnp.asarray(np.asarray(phys, np.int32))
+        layers = [
+            (self._pages_to_host(kp, idx), self._pages_to_host(vp, idx))
+            for kp, vp in self.pools
+        ]
+        self.stats["pages_exported"] += len(phys)
+        return {
+            "kind": "sealed",
+            "geometry": self._transfer_geometry(),
+            "page_keys": page_keys,
+            "page_kinds": page_kinds,
+            "layers": layers,
+        }
+
+    def import_sealed_chain(self, payload: dict) -> int:
+        """Warm this replica's ``PrefixPageCache`` from a sealed-chain
+        export: pages enter at refcount 0 (idle, LRU-evictable) under
+        their chain keys, kind-gated exactly like retirement sealing,
+        so the session's next prompt prefills only genuinely new
+        tokens.  Imports the longest chain prefix the pool can hold —
+        idle pages are a cache, not a reservation, so partial warmth is
+        still warmth — and dedups against keys already cached.
+        Returns the number of pages newly imported."""
+        if payload.get("kind") != "sealed" or "geometry" not in payload:
+            raise ValueError("not a sealed paged-KV payload")
+        self._check_geometry(payload["geometry"])
+        if self.prefix_cache is None:
+            return 0
+        page_keys = list(payload.get("page_keys") or [])
+        page_kinds = list(payload.get("page_kinds") or [])
+        layers = payload["layers"]
+        hd = self.hidden // self.num_heads
+        want_shape = (len(page_keys), self.num_heads, self.page, hd)
+        if len(layers) != self.num_layers or len(page_kinds) != len(
+            page_keys
+        ):
+            raise ValueError("malformed payload: layer/page counts drift")
+        for k_np, v_np in layers:
+            if (tuple(np.shape(k_np)) != want_shape
+                    or tuple(np.shape(v_np)) != want_shape):
+                raise ValueError(
+                    f"malformed payload: page array shape "
+                    f"{np.shape(k_np)} != {want_shape}"
+                )
+        fresh: List[tuple] = []      # (payload row, pool page)
+        for j, keyhex in enumerate(page_keys):
+            key = bytes.fromhex(keyhex)
+            kind = page_kinds[j]
+            if self.prefix_cache.lookup(key) is not None:
+                continue             # already warm here (dedup)
+            if kind == "decode" and not self._seal_decode:
+                break   # the policy gate; nothing past a skipped page
+                # can hit anyway (chain lookups stop at the first miss)
+            if self._available_pages(set()) < 1:
+                break   # partial warmth: import what fits
+            page = self._alloc_page()
+            self.prefix_cache.insert(key, page, kind=kind)
+            self.prefix_cache.release(page)  # idle from birth: cache-owned
+            fresh.append((j, page))
+        if fresh:
+            sel = np.asarray([j for j, _ in fresh], np.intp)
+            phys = np.asarray([p for _, p in fresh], np.int32)
+            self.pools = [
+                (
+                    self._write_host_pages(kp, phys, np.asarray(k_np)[sel]),
+                    self._write_host_pages(vp, phys, np.asarray(v_np)[sel]),
+                )
+                for (kp, vp), (k_np, v_np) in zip(self.pools, layers)
+            ]
+        self.stats["pages_imported"] += len(fresh)
+        return len(fresh)
 
     def _sweep(self, finished: Dict[int, List[int]]) -> None:
         progress = True

@@ -666,6 +666,7 @@ def _serve_http(args, cb, t0: float) -> int:
     server = ReplicaServer(
         cb, listen=("0.0.0.0", args.serve_http),
         step_delay_s=args.serve_http_step_delay,
+        fail_migration=args.serve_http_fail_migration,
     )
     server.start()
     print(
@@ -889,6 +890,11 @@ def main(argv=None) -> int:
                     "iterations (0 = flat out).  Chaos/test knob: slows "
                     "the loop so kill/cancel schedules land provably "
                     "mid-stream")
+    ap.add_argument("--serve-http-fail-migration", action="store_true",
+                    help="--serve-http: refuse POST /v1/import (chaos "
+                    "knob for the kill-mid-migration soak schedules: an "
+                    "importer that refuses must leave both pools "
+                    "byte-identical — the gateway retries cold)")
     ap.add_argument("--prompt-len", type=int, default=32,
                     help="decode: prompt tokens per request (prompt-len + "
                     "--steps must fit --seq + 1, the lm family's cache size)")

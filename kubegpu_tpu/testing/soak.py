@@ -449,11 +449,24 @@ class GatewaySoak:
     abandons a raw mid-stream socket so the replica's disconnect⇒cancel
     path runs under chaos.  The page-accounting invariant then holds
     ACROSS THE WIRE: whatever the kill/cancel/disconnect schedule did,
-    every surviving replica's pool must balance at quiescence."""
+    every surviving replica's pool must balance at quiescence.
+
+    ``migration=True`` arms the KV-migration op set (ISSUE 11): a
+    graceful ``drain`` (Gateway.drain_replica migrates live sequences +
+    captures sealed sessions, then the pod is released like a kill), a
+    bare ``migrate`` of one random in-flight sequence, the
+    ``kill-mid-migration`` schedule (exporter or importer dies between
+    the export and the import ack, via the client's ``_between`` hook),
+    and an importer-refusal leg (the target's ``fail_migration`` chaos
+    knob).  Whatever the schedule did, I5 must hold — a migration may
+    cost retries, never requests — and with paged batchers the
+    page-accounting invariant must balance on BOTH ends of every
+    transfer at quiescence."""
 
     def __init__(self, seed: int, n_replicas: int = 4,
                  batcher_factory=None, multiturn: bool = False,
-                 follow_prompt_cap: int = 12, http: bool = False):
+                 follow_prompt_cap: int = 12, http: bool = False,
+                 migration: bool = False):
         from kubegpu_tpu.gateway import (
             AdmissionQueue, FailoverPolicy, Gateway, HttpReplicaClient,
             InMemoryReplicaClient, ReplicaServer, SimBatcher,
@@ -510,6 +523,7 @@ class GatewaySoak:
         self.dead = set()    # replica keys currently killed
         self.ops = []
         self.multiturn = multiturn
+        self.migration = migration
         self.follow_prompt_cap = follow_prompt_cap
         self._session_prompts = {}  # request_id -> (session, prompt)
         self._followed = set()      # request_ids already extended
@@ -596,11 +610,9 @@ class GatewaySoak:
     def _live_keys(self):
         return [r.key for r in self.registry.live()]
 
-    def op_kill_replica(self):
-        live = self._live_keys()
-        if len(live) < 2:
-            return "kill (noop: must keep one replica)"
-        key = self.rng.choice(live)
+    def _kill_replica(self, key: str) -> None:
+        """The pod dies: serving process first, then its chips (shared
+        by the kill op and the kill-mid-migration schedules)."""
         if self.http:
             # the serving process dies: its HTTP server stops (in-flight
             # streams error out, new connections are refused — genuine
@@ -617,6 +629,13 @@ class GatewaySoak:
             a.advertise_once()
         self.registry.refresh()
         self.dead.add(key)
+
+    def op_kill_replica(self):
+        live = self._live_keys()
+        if len(live) < 2:
+            return "kill (noop: must keep one replica)"
+        key = self.rng.choice(live)
+        self._kill_replica(key)
         return f"kill {key}"
 
     def op_revive_replica(self):
@@ -628,11 +647,120 @@ class GatewaySoak:
             self.slices[rep.slice_id].revive_chip(coords)
         if self.http:
             self._start_server(key)  # cold restart on a fresh port
+        # a revived pod is a FRESH replica: any DRAINING mark from a
+        # pre-death drain does not survive the restart
+        self.registry.set_draining(key, False)
         for a in self.advs.values():
             a.advertise_once()
         self.registry.refresh()  # sync_live restarts the replica cold
         self.dead.discard(key)
         return f"revive {key}"
+
+    # -- KV-migration ops (migration=True) ---------------------------------
+    def _pick_migratable(self):
+        """One random live in-flight attempt and a distinct live target,
+        or None."""
+        live = [k for k in self._live_keys() if k not in self.dead]
+        if len(live) < 2:
+            return None
+        for key in self.rng.sample(live, len(live)):
+            attempts = [
+                a for a in self.client.inflight_on(key)
+                if not a.done and a.request is not None
+            ]
+            if attempts:
+                a = self.rng.choice(
+                    sorted(attempts, key=lambda x: x.request_id)
+                )
+                to = self.rng.choice(sorted(k for k in live if k != key))
+                return key, a, to
+        return None
+
+    def op_drain(self):
+        """Graceful scale-down: DRAIN one replica (admissions stop, live
+        sequences migrate, sealed sessions captured), then RELEASE it —
+        the pod dies like a kill, but nothing it was serving should
+        cold-restart."""
+        live = [k for k in self._live_keys() if k not in self.dead]
+        if len(live) < 2:
+            return "drain (noop: must keep one replica)"
+        key = self.rng.choice(live)
+        stats = self.gw.drain_replica(key)
+        self._kill_replica(key)
+        return (
+            f"drain+release {key} migrated={stats['migrated']} "
+            f"failed={stats['failed']} captured={stats['captured']}"
+        )
+
+    def op_migrate(self):
+        """Move one random live in-flight sequence between replicas —
+        the transfer primitive exercised under load, no drain."""
+        picked = self._pick_migratable()
+        if picked is None:
+            return "migrate (noop: nothing in flight)"
+        key, attempt, to = picked
+        ok = self.client.migrate(attempt, attempt.request, to)
+        return (
+            f"migrate {attempt.request_id} {key}->{to} "
+            f"{'ok' if ok else 'refused'}"
+        )
+
+    def op_kill_mid_migration(self):
+        """The acceptance schedule: a replica dies BETWEEN the export
+        and the import ack.  Exporter death: the payload is already in
+        the gateway's hands, the import lands anyway.  Importer death:
+        the continuation errors and failover retries the request cold.
+        Either way nothing may leak — every surviving pool balances at
+        quiescence."""
+        picked = self._pick_migratable()
+        if picked is None:
+            return "kill-mid-migration (noop: nothing in flight)"
+        key, attempt, to = picked
+        victim = self.rng.choice(["exporter", "importer"])
+
+        def between():
+            self._kill_replica(key if victim == "exporter" else to)
+
+        ok = self.client.migrate(
+            attempt, attempt.request, to, _between=between
+        )
+        return (
+            f"kill-mid-migration ({victim}) {attempt.request_id} "
+            f"{key}->{to} {'handed-off' if ok else 'refused'}"
+        )
+
+    def op_refuse_migration(self):
+        """Importer refusal: arm the target's chaos knob, attempt the
+        migration (the attempt must error → failover retries), disarm.
+        The refusal must be atomic on the importer — zero pages moved."""
+        picked = self._pick_migratable()
+        if picked is None:
+            return "refuse-migration (noop: nothing in flight)"
+        key, attempt, to = picked
+        if self.http:
+            srv = self.servers.get(to)
+            if srv is None:
+                return "refuse-migration (noop: target gone)"
+            srv.loop.fail_migration = True
+            try:
+                # over the wire the handoff is async: wait for the
+                # refused continuation to resolve the attempt before
+                # disarming, or the import POST could race the disarm
+                ok = self.client.migrate(attempt, attempt.request, to)
+                if ok:
+                    attempt.wait(5.0)
+            finally:
+                srv.loop.fail_migration = False
+        else:
+            self.client.set_fail_migration(to, True)
+            try:
+                ok = self.client.migrate(attempt, attempt.request, to)
+            finally:
+                self.client.set_fail_migration(to, False)
+        return (
+            f"refuse-migration {attempt.request_id} {key}->{to} "
+            f"{'handed-off' if ok else 'refused'}"
+        )
 
     def op_straggle(self):
         live = self._live_keys()
@@ -827,6 +955,17 @@ class GatewaySoak:
             # replica's disconnect⇒cancel path must hold page accounting
             # under kills and stragglers, not just in a quiet unit test
             ops.append((self.op_disconnect, 2))
+        if self.migration:
+            # the transfer primitive under chaos: drains, bare
+            # migrations, the kill-mid-migration acceptance schedules,
+            # and importer refusals — I5 and both-end page accounting
+            # must survive every interleaving
+            ops += [
+                (self.op_drain, 1),
+                (self.op_migrate, 3),
+                (self.op_kill_mid_migration, 1),
+                (self.op_refuse_migration, 1),
+            ]
         bag = [f for f, w in ops for _ in range(w)]
         try:
             for _ in range(steps):

@@ -85,7 +85,19 @@ CATALOG: Dict[str, MetricSpec] = {
     "gateway_duplicate_results_total": _c(
         (), "second terminal results dropped by exactly-once delivery"),
     "gateway_session_repin_total": _c(
-        (), "session re-pins after the pinned replica drained (KV loss)"),
+        (), "session re-pins after the pinned replica drained (KV loss "
+        "unless a sealed-export restore made the move a transfer — see "
+        "gateway_session_restores_total)"),
+    "gateway_session_restores_total": _c(
+        (), "sealed-chain KV restores imported into a re-pin target "
+        "before dispatch (the turn-2 state survived the replica)"),
+    "gateway_migrations_total": _c(
+        ("outcome",), "live KV-page migrations by outcome (ok = handoff "
+        "dispatched; export_failed = source refused/unreachable; "
+        "import_refused = target refused the payload)"),
+    "gateway_replica_drains_total": _c(
+        (), "graceful replica drains started (DRAINING -> released "
+        "lifecycles)"),
 
     # -- gateway streaming pass-through (gateway/server.py, failover.py)
     "gateway_stream_requests_total": _c(
@@ -115,6 +127,16 @@ CATALOG: Dict[str, MetricSpec] = {
     "replica_http_disconnect_cancels_total": _c(
         (), "sequences cancelled because their stream's client "
         "vanished mid-stream (disconnect ⇒ cancel; pages freed)"),
+    "replica_migrate_pages_total": _c(
+        ("dir",), "KV pages moved through the migration verbs by "
+        "direction (export: serialized out of this pool; import: "
+        "written into it)"),
+    "replica_migrate_seconds": _h(
+        ("dir",), "wall time of one export/import verb (serialize + "
+        "detach, or allocate + chain-replay + resume)"),
+    "replica_migrate_wire_bytes_total": _c(
+        ("dir",), "encoded transfer payload bytes through the "
+        "migration verbs by direction"),
 
     # -- serving data plane (models/serving.py, models/paging.py)
     "serve_ttft_seconds": _h((), "submit -> first generated token"),

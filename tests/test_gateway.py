@@ -649,9 +649,9 @@ def test_gateway_http_server_end_to_end():
 
 
 def test_readyz_tracks_live_replicas_not_hardcoded():
-    """/readyz with a wired data plane: 200 while >=1 live replica, 503
-    with "no live replicas" once the registry drains to zero, 200 again
-    on revival — readiness is the registry's live set, not a hardcode."""
+    """/readyz with a wired data plane: 200 while >=1 routable replica,
+    503 once the registry drains to zero, 200 again on revival —
+    readiness is the registry's routable set, not a hardcode."""
     import http.client
 
     c = make_serving_cluster(1)
@@ -674,7 +674,7 @@ def test_readyz_tracks_live_replicas_not_hardcoded():
         victim = c.registry.live()[0]
         kill_replica(c, victim)
         status, body = readyz()
-        assert status == 503 and "no live replicas" in body
+        assert status == 503 and "no routable replicas" in body
         for coords in victim.coords:
             c.slices[victim.slice_id].revive_chip(coords)
         advertise_all(c)

@@ -1,0 +1,677 @@
+"""Live KV-page migration (ISSUE 11): drains, failovers and re-pins
+become TRANSFERS instead of cold restarts.
+
+Layers under test:
+
+- the batcher verb pair — ``export_pages``/``import_pages`` (live
+  sequence: committed pages + chain keys + decode cursor) and
+  ``export_sealed_chain``/``import_sealed_chain`` (failover insurance)
+  — held to fp32 token identity of a migrated-mid-decode sequence vs a
+  never-migrated one, across page sizes × speculation × multi-turn
+  sealing, and to ATOMIC accounting: export is read-only, a refused
+  import moves zero refcounts, an orphaned export leaks nothing, a
+  double import SHARES chain pages instead of duplicating them;
+- tensor parallelism — a TP=2→TP=2 migration moves tp shard-local
+  copies (same head-sharded layout both ends) and stays token-identical
+  to the single-device stream; a TP=2→TP=1 import works too (the
+  payload is layout-agnostic host bytes);
+- the registry lifecycle — probe failures back off exponentially with
+  jitter (fake clock) and reset on success; DRAINING replicas leave
+  ``routable()`` without leaving ``live()``;
+- the gateway lifecycle — ``drain_replica`` migrates live sequences
+  (stream continuity proven by the SimBatcher's seed arithmetic) and
+  stops new admissions; a session whose pinned replica DIES restores
+  its turn-2 state from the captured sealed export on the new pin;
+- GatewaySoak ``migration=True`` — drains, bare migrates,
+  kill-mid-migration (exporter or importer dies between export and
+  import ack) and importer refusals, in the in-memory and HTTP lanes,
+  with ``assert_page_accounting`` holding on BOTH ends at quiescence
+  in the paged lanes.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubegpu_tpu.models import TransformerLM
+from kubegpu_tpu.models.paging import PagedContinuousBatcher
+from kubegpu_tpu.parallel import device_mesh
+
+# heads divisible by the tested TP widths; vocab by the lm_head split
+CFG = dict(vocab_size=64, num_layers=2, num_heads=8, hidden=32, max_seq=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = TransformerLM(dtype=jnp.float32, **CFG)
+    return model.init(
+        jax.random.PRNGKey(0), jnp.ones((2, 8), jnp.int32)
+    )["params"]
+
+
+def make_paged(params, tp=1, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("prompt_pad", 16)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("pool_pages", 48)
+    kw.setdefault("decode_page_cache", "fp32")
+    mesh = None
+    if tp > 1:
+        if jax.device_count() < tp:
+            pytest.skip(f"need {tp} devices, have {jax.device_count()}")
+        mesh = device_mesh({"model": tp}, devices=jax.devices()[:tp])
+    return PagedContinuousBatcher(
+        params, dtype=jnp.float32, mesh=mesh, **CFG, **kw
+    )
+
+
+def spec_kw(params, k=2):
+    return dict(
+        draft_params=params, speculate_k=k,
+        draft_num_layers=CFG["num_layers"],
+        draft_num_heads=CFG["num_heads"], draft_hidden=CFG["hidden"],
+    )
+
+
+def drive_until(cb, seq_id, n_tokens, max_steps=200):
+    """Step until the sequence committed >= n_tokens (still live)."""
+    for _ in range(max_steps):
+        cb.serve_step()
+        s = next((s for s in cb._seqs if s.seq_id == seq_id), None)
+        if s is not None and s.active and len(s.tokens) >= n_tokens:
+            return
+    raise AssertionError(
+        f"seq {seq_id} never reached {n_tokens} live tokens"
+    )
+
+
+def drain(cb):
+    done = {}
+    while cb.has_work():
+        done.update(cb.serve_step())
+    return done
+
+
+PROMPT = np.array([3, 1, 4, 1, 5, 9, 2, 6], np.int32)
+
+
+# ---------------------------------------------------------------------------
+# fp32 token identity: migrated mid-decode == never-migrated
+# ---------------------------------------------------------------------------
+
+def _identity_case(params, page_size, spec):
+    kw = dict(page_size=page_size)
+    if spec:
+        kw.update(spec_kw(params))
+    src = make_paged(params, **kw)
+    dst = make_paged(params, **kw)
+    budget = 20
+    ref = src.run([PROMPT], [budget])[0]     # never-migrated reference
+    assert len(ref) == budget
+    # same prompt again: admission may hit the sealed chain — migrating
+    # a sequence whose pages are partly CACHE-OWNED is the interesting
+    # case (export reads shared pages, detach decrefs them)
+    src.submit(1, PROMPT, budget)
+    drive_until(src, 1, 5)
+    payload = src.export_pages(1)
+    assert len(payload["tokens"]) >= 5
+    assert payload["tokens"] == ref[: len(payload["tokens"])]
+    src.cancel(1)                            # detach
+    src.assert_page_accounting()
+    dst.import_pages(11, payload)
+    dst.assert_page_accounting()             # mid-transfer, importer side
+    out = drain(dst)
+    assert out[11] == ref
+    src.assert_page_accounting()
+    dst.assert_page_accounting()
+
+
+@pytest.mark.parametrize("page_size,spec", [(4, False), (4, True)])
+def test_live_migration_identity(params, page_size, spec):
+    _identity_case(params, page_size, spec)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("page_size,spec", [(8, False), (8, True)])
+def test_live_migration_identity_page8(params, page_size, spec):
+    _identity_case(params, page_size, spec)
+
+
+def test_multiturn_sealed_migration(params):
+    """The multi-turn axis: turn 1 seals on the source; a turn-2
+    sequence (whose admission HITS the sealed chain) migrates
+    mid-decode and must finish token-identical to the never-migrated
+    turn 2."""
+    src = make_paged(params)
+    dst = make_paged(params)
+    t1 = src.run([PROMPT], [7])[0]
+    stream = [int(t) for t in PROMPT] + t1
+    p2 = np.asarray(stream[:14] + [11], np.int32)
+    ref = src.run([p2], [8])[0]              # never-migrated turn 2
+    src.submit(5, p2, 8)
+    drive_until(src, 5, 3)
+    payload = src.export_pages(5)
+    src.cancel(5)
+    dst.import_pages(50, payload)
+    out = drain(dst)
+    assert out[50] == ref
+    src.assert_page_accounting()
+    dst.assert_page_accounting()
+    # the replayed chain made the importer warm: a THIRD turn on dst
+    # hits through the imported region
+    p3 = np.asarray(stream[:12], np.int32)
+    dst.run([p3], [4])
+    assert dst.stats["prefix_hit_tokens"] > 0
+
+
+def test_sealed_chain_restore_roundtrip(params):
+    """The failover insurance flow at batcher level: capture turn 1's
+    sealed chain, import it into a cold replica, and turn 2 there must
+    hit the decode region and match the stayed-home turn 2."""
+    src = make_paged(params, prompt_pad=24)
+    dst = make_paged(params, prompt_pad=24)
+    t1 = src.run([PROMPT], [9])[0]
+    stream = [int(t) for t in PROMPT] + t1
+    payload = src.export_sealed_chain(stream)
+    assert payload is not None
+    assert len(payload["page_keys"]) == (len(stream) - 1) // 4
+    n = dst.import_sealed_chain(payload)
+    assert n == len(payload["page_keys"])
+    dst.assert_page_accounting()
+    # idempotent: a second import dedups to zero fresh pages
+    assert dst.import_sealed_chain(payload) == 0
+    p2 = np.asarray(stream + [13], np.int32)
+    ref = src.run([p2], [6])[0]
+    out = dst.run([p2], [6])[0]
+    assert out == ref
+    assert dst.stats["prefix_hit_tokens_decode"] > 0
+    src.assert_page_accounting()
+    dst.assert_page_accounting()
+
+
+def test_export_is_read_only_and_orphan_safe(params):
+    """Export must not perturb the exporter: a sequence exported
+    mid-decode and NOT detached finishes byte-identical, and an
+    orphaned payload (never imported) leaks nothing on either end."""
+    src = make_paged(params)
+    ref = src.run([PROMPT], [15])[0]
+    src.submit(2, PROMPT, 15)
+    drive_until(src, 2, 4)
+    payload = src.export_pages(2)
+    src.assert_page_accounting()             # mid-transfer, exporter side
+    out = drain(src)                         # keep serving: no detach
+    assert out[2] == ref
+    src.assert_page_accounting()
+    del payload                              # orphaned export: just bytes
+    src.assert_page_accounting()
+
+
+def test_double_import_shares_chain_pages(params):
+    src = make_paged(params)
+    dst = make_paged(params)
+    ref = src.run([PROMPT], [16])[0]
+    src.submit(1, PROMPT, 16)
+    drive_until(src, 1, 9)                   # past 2 full pages
+    payload = src.export_pages(1)
+    src.cancel(1)
+    dst.import_pages(21, payload)
+    dst.import_pages(22, payload)            # the double import
+    dst.assert_page_accounting()
+    shared = [
+        p for s in dst._seqs if s.seq_id in (21, 22) for p in s.shared
+    ]
+    assert len(shared) > len(set(shared)), (
+        "double import duplicated chain pages instead of sharing them"
+    )
+    out = drain(dst)
+    assert out[21] == ref and out[22] == ref
+    src.assert_page_accounting()
+    dst.assert_page_accounting()
+
+
+def test_import_into_chain_with_a_hole(params):
+    """LRU eviction can pop a chain's FIRST page while later pages stay
+    cached (entries are independent key→page maps).  An import meeting
+    that hole must SHARE the surviving pages and freshly register only
+    the missing one — never crash on a duplicate key, never leak
+    (regression: the insert used to assert mid-commit, stranding the
+    already-acquired pages)."""
+    src = make_paged(params)
+    dst = make_paged(params)
+    ref = src.run([PROMPT], [16])[0]
+    src.submit(1, PROMPT, 16)
+    drive_until(src, 1, 9)                   # >= 2 full chain pages
+    payload = src.export_pages(1)
+    src.cancel(1)
+    n_keys = sum(1 for k in payload["page_keys"] if k is not None)
+    assert n_keys >= 2
+    # warm dst with the full chain, then punch the hole: evict exactly
+    # the oldest entry — the chain's first page
+    assert dst.import_sealed_chain(
+        src.export_sealed_chain(
+            payload["prompt"] + payload["tokens"]
+        )
+    ) > 0
+    first = dst.prefix_cache.evict_lru()
+    assert first is not None
+    dst.free_pages.add(first)
+    dst.assert_page_accounting()
+    dst.import_pages(30, payload)            # used to AssertionError here
+    dst.assert_page_accounting()
+    s = next(s for s in dst._seqs if s.seq_id == 30)
+    assert len(s.shared) >= n_keys - 1       # survivors shared, not copied
+    out = drain(dst)
+    assert out[30] == ref
+    dst.assert_page_accounting()
+    src.assert_page_accounting()
+
+
+def test_import_refusal_is_atomic(params):
+    src = make_paged(params)
+    src.submit(1, PROMPT, 12)
+    drive_until(src, 1, 4)
+    payload = src.export_pages(1)
+
+    # no free slot
+    dst = make_paged(params, slots=1)
+    dst.submit(9, np.array([7, 7, 7], np.int32), 30)
+    drive_until(dst, 9, 1)
+    before = (set(dst.free_pages), len(dst.prefix_cache))
+    with pytest.raises(RuntimeError, match="no free sequence slot"):
+        dst.import_pages(40, payload)
+    assert (set(dst.free_pages), len(dst.prefix_cache)) == before
+    dst.assert_page_accounting()
+
+    # a payload that can NEVER fit this pool is a ValueError (the
+    # shared admission contract), still with zero refcounts moved
+    never = make_paged(params, pool_pages=4)
+    before = (set(never.free_pages), len(never.prefix_cache))
+    with pytest.raises(ValueError, match="pages"):
+        never.import_pages(41, payload)
+    assert (set(never.free_pages), len(never.prefix_cache)) == before
+    never.assert_page_accounting()
+
+    # pool PRESSURE (fits in principle, not right now) refuses with
+    # zero refcounts moved — the retriable case
+    tiny = make_paged(params, pool_pages=8)
+    tiny.submit(1, np.array([7, 7, 7], np.int32), 12)
+    drive_until(tiny, 1, 1)
+    before = (set(tiny.free_pages), len(tiny.prefix_cache))
+    with pytest.raises(RuntimeError, match="import refused"):
+        tiny.import_pages(41, payload)
+    assert (set(tiny.free_pages), len(tiny.prefix_cache)) == before
+    drain(tiny)
+    tiny.assert_page_accounting()
+
+    # geometry mismatch is a ValueError (not a refusal): pages only move
+    # between twins
+    other = make_paged(params, page_size=8)
+    with pytest.raises(ValueError, match="geometry mismatch"):
+        other.import_pages(42, payload)
+    other.assert_page_accounting()
+    src.assert_page_accounting()
+
+
+def test_export_rejects_unknown_and_mid_prefill(params):
+    cb = make_paged(params)
+    with pytest.raises(KeyError):
+        cb.export_pages(123)
+    # a long prompt chunk-prefills one page per iteration: after one
+    # step the admission is mid-prefill — nothing committed to move
+    long_prompt = np.arange(1, 13, dtype=np.int32)
+    cb.submit(3, long_prompt, 8)
+    cb.serve_step()
+    s = next(s for s in cb._seqs if s.seq_id == 3)
+    assert s.prefilling
+    with pytest.raises(ValueError, match="mid-prefill"):
+        cb.export_pages(3)
+    drain(cb)
+    cb.assert_page_accounting()
+
+
+# ---------------------------------------------------------------------------
+# tensor parallelism: shard-local transfers
+# ---------------------------------------------------------------------------
+
+def test_tp2_migration_identity(params):
+    ref = make_paged(params).run([PROMPT], [14])[0]
+    src = make_paged(params, tp=2)
+    dst = make_paged(params, tp=2)
+    src.submit(1, PROMPT, 14)
+    drive_until(src, 1, 5)
+    payload = src.export_pages(1)
+    assert payload["geometry"]["tp"] == 2
+    src.cancel(1)
+    dst.import_pages(10, payload)
+    out = drain(dst)
+    assert out[10] == ref
+    # both ends balanced INCLUDING the sharded-layout leg (the import
+    # scatter must leave the pool resting head-sharded)
+    src.assert_page_accounting()
+    dst.assert_page_accounting()
+
+
+def test_tp2_to_tp1_migration(params):
+    """The payload is layout-agnostic host bytes: a TP=2 export imports
+    into an unsharded twin and stays token-identical."""
+    ref = make_paged(params).run([PROMPT], [12])[0]
+    src = make_paged(params, tp=2)
+    dst = make_paged(params)
+    src.submit(1, PROMPT, 12)
+    drive_until(src, 1, 6)
+    payload = src.export_pages(1)
+    src.cancel(1)
+    dst.import_pages(10, payload)
+    assert drain(dst)[10] == ref
+    src.assert_page_accounting()
+    dst.assert_page_accounting()
+
+
+# ---------------------------------------------------------------------------
+# registry: probe backoff (fake clock) + DRAINING
+# ---------------------------------------------------------------------------
+
+def _registry_stack(probe, clock):
+    from kubegpu_tpu.gateway import ReplicaRegistry
+    from kubegpu_tpu.testing.fake_serving import build_fake_serving_stack
+
+    stack = build_fake_serving_stack(1)
+    return ReplicaRegistry(stack.api, probe=probe, clock=clock)
+
+
+def test_probe_backoff_exponential_with_jitter_and_reset():
+    clock = type("C", (), {"t": 0.0, "__call__": lambda s: s.t})()
+    calls = []
+    state = {"ok": False}
+
+    def probe(info):
+        calls.append(clock.t)
+        return (True, "") if state["ok"] else (False, "down")
+
+    reg = _registry_stack(probe, clock)
+    reg.refresh()
+    assert len(calls) == 1
+    (key,) = [r.key for r in reg.all()]
+    assert not reg.live_keys()
+    assert "data plane: down" in reg.get(key).reason
+
+    # inside the backoff window: refreshes do NOT re-probe, and the
+    # cached failure (annotated as backing off) stands
+    reg.refresh()
+    reg.refresh()
+    assert len(calls) == 1
+    assert "backing off" in reg.get(key).reason
+
+    # walk the windows: each expiry probes exactly once more, and the
+    # delays grow exponentially within the jitter envelope
+    delays = []
+    for _ in range(4):
+        window = reg._probe_backoff[key]["next"] - clock.t
+        delays.append(window)
+        clock.t = reg._probe_backoff[key]["next"] + 1e-6
+        n = len(calls)
+        reg.refresh()
+        assert len(calls) == n + 1
+    for i, d in enumerate(delays):
+        ideal = min(30.0, 0.5 * 2 ** i)
+        assert 0.5 * ideal <= d < 1.5 * ideal, (i, d, ideal)
+    assert delays[2] > delays[0]
+
+    # success resets: the replica goes live and the next failure backs
+    # off from the BASE again
+    state["ok"] = True
+    clock.t = reg._probe_backoff[key]["next"] + 1e-6
+    reg.refresh()
+    assert reg.live_keys() == frozenset({key})
+    assert key not in reg._probe_backoff
+    state["ok"] = False
+    reg.refresh()
+    fresh = reg._probe_backoff[key]["next"] - clock.t
+    assert fresh < 0.5 * 1.5, fresh
+
+
+def test_draining_leaves_routable_not_live():
+    from kubegpu_tpu.gateway import ReplicaRegistry
+    from kubegpu_tpu.testing.fake_serving import build_fake_serving_stack
+
+    stack = build_fake_serving_stack(2)
+    reg = ReplicaRegistry(stack.api)
+    fired = []
+    reg.subscribe(lambda live: fired.append(set(live)))
+    reg.refresh()
+    keys = sorted(r.key for r in reg.live())
+    assert len(keys) == 2
+    n_fired = len(fired)
+    reg.set_draining(keys[0])
+    # draining is NOT a live-set change: the data plane must keep its
+    # connections (an observer firing would abort in-flight streams)
+    assert len(fired) == n_fired
+    assert sorted(r.key for r in reg.live()) == keys
+    assert [r.key for r in reg.routable()] == [keys[1]]
+    assert reg.get(keys[0]).draining
+    reg.set_draining(keys[0], False)
+    assert sorted(r.key for r in reg.routable()) == keys
+
+
+# ---------------------------------------------------------------------------
+# gateway lifecycle: drain + sealed restore after death
+# ---------------------------------------------------------------------------
+
+def _gateway_stack(n_replicas, batcher_factory, router=None, **gw_kw):
+    from kubegpu_tpu.gateway import (
+        AdmissionQueue, FailoverPolicy, Gateway, InMemoryReplicaClient,
+    )
+    from kubegpu_tpu.testing.fake_serving import build_fake_serving_stack
+    from kubegpu_tpu.utils.metrics import Metrics
+
+    stack = build_fake_serving_stack(n_replicas, metrics=Metrics())
+    client = InMemoryReplicaClient(
+        batcher_factory=batcher_factory, step_delay_s=0.002,
+    )
+    stack.registry.subscribe(client.sync_live)
+    gw = Gateway(
+        stack.registry, client, router=router,
+        queue=AdmissionQueue(capacity=64),
+        policy=FailoverPolicy(
+            deadline_s=60.0, max_attempts=8,
+            retry_budget_ratio=1.0, budget_floor=100,
+        ),
+        metrics=Metrics(), dispatchers=4, **gw_kw,
+    )
+    stack.registry.refresh()
+    gw.start()
+    return stack, client, gw
+
+
+def test_drain_migrates_inflight_and_stops_admissions():
+    from kubegpu_tpu.gateway import GatewayRequest, SimBatcher
+
+    stack, client, gw = _gateway_stack(
+        3, lambda key: SimBatcher(slots=8, vocab=101)
+    )
+    try:
+        slow = gw.submit(GatewayRequest(
+            prompt=[1, 2, 3], max_new_tokens=120, request_id="slow",
+        ))
+        # find where it landed
+        home = None
+        deadline = time.monotonic() + 10
+        while home is None and time.monotonic() < deadline:
+            for rep in stack.registry.live():
+                if any(
+                    not a.done for a in client.inflight_on(rep.key)
+                ):
+                    home = rep.key
+            time.sleep(0.005)
+        assert home is not None
+        stats = gw.drain_replica(home)
+        assert stats["migrated"] == 1, stats
+        assert [r.key for r in stack.registry.routable()] == sorted(
+            r.key for r in stack.registry.live() if r.key != home
+        )
+        # new admissions avoid the draining replica entirely
+        quick = [
+            gw.submit(GatewayRequest(
+                prompt=[5], max_new_tokens=3, request_id=f"q{i}",
+            ))
+            for i in range(12)
+        ]
+        for p in quick:
+            assert p.wait(30) and p.result().status == "ok"
+        assert home not in gw.completed_by_replica
+        assert slow.wait(60) and slow.result().status == "ok"
+        tokens = slow.result().tokens
+        assert len(tokens) == 120
+        # stream CONTINUITY across the migration: one seed explains the
+        # whole stream (token i == (seed*31 + i) % vocab) — a restart
+        # would show a seam where the arithmetic re-anchors
+        seed31 = (tokens[0] - 0) % 101
+        assert all(
+            tokens[i] == (seed31 + i) % 101 for i in range(len(tokens))
+        ), "migrated stream is not one mill's arithmetic"
+        assert gw.metrics.get("gateway_replica_drains_total") == 1
+    finally:
+        gw.stop()
+        client.stop()
+
+
+def test_sealed_restore_after_replica_death(params):
+    """The acceptance flow: turn 1 pins a session to a paged replica
+    (which seals and is eagerly captured); the replica DIES; turn 2
+    re-pins, the dispatcher imports the captured export, and the new
+    replica serves it from warm decode pages — token-identical to an
+    undisturbed session."""
+    from kubegpu_tpu.gateway import GatewayRequest, SessionAffinityRouter
+
+    def factory(key):
+        return make_paged(params, prompt_pad=24)
+
+    stack, client, gw = _gateway_stack(
+        2, factory, router=SessionAffinityRouter(),
+    )
+    try:
+        p1 = [int(t) for t in PROMPT]
+        r1 = gw.submit(GatewayRequest(
+            prompt=p1, max_new_tokens=9, request_id="t1", session="s",
+        ))
+        assert r1.wait(120) and r1.result().status == "ok", r1.result()
+        home = r1.result().replica
+        stream = p1 + r1.result().tokens
+        # the insurance was captured while the replica lived
+        entry = gw.session_store._entries["s"]
+        assert entry["payload"] is not None
+        assert entry["replica"] == home
+
+        # never-migrated reference for turn 2 (fresh twin batcher)
+        ref_cb = make_paged(params, prompt_pad=24)
+        ref_cb.run([np.asarray(p1, np.int32)], [9])
+        p2 = stream + [13]
+        ref = ref_cb.run([np.asarray(p2, np.int32)], [6])[0]
+
+        # the pinned replica dies: process + chips, same advertise cycle
+        client.fail_replica(home)
+        rep = stack.registry.get(home)
+        for coords in rep.coords:
+            stack.slices[rep.slice_id].kill_chip(coords)
+        for adv in stack.advs.values():
+            adv.advertise_once()
+        stack.registry.refresh()
+        assert home not in stack.registry.live_keys()
+
+        r2 = gw.submit(GatewayRequest(
+            prompt=p2, max_new_tokens=6, request_id="t2", session="s",
+        ))
+        assert r2.wait(120) and r2.result().status == "ok", r2.result()
+        assert r2.result().replica != home
+        assert r2.result().tokens == ref
+        assert gw.metrics.get("gateway_session_restores_total") == 1
+        # the survivor actually served from warm pages
+        with client._lock:
+            survivor = client._workers[r2.result().replica].batcher
+        assert survivor.stats["prefix_hit_tokens_decode"] > 0
+        survivor.assert_page_accounting()
+    finally:
+        gw.stop()
+        client.stop()
+
+
+# ---------------------------------------------------------------------------
+# SimBatcher migration contract (no jax)
+# ---------------------------------------------------------------------------
+
+def test_simbatcher_migration_contract():
+    from kubegpu_tpu.gateway import SimBatcher
+
+    a, b = SimBatcher(slots=2, vocab=97), SimBatcher(slots=1, vocab=97)
+    a.submit(5, [1, 2], 10)
+    for _ in range(4):
+        a.serve_step()
+    payload = a.export_pages(5)
+    assert payload["sim"] and payload["seed"] == 5
+    with pytest.raises(KeyError):
+        a.export_pages(99)
+    a.cancel(5)
+    b.import_pages(0, payload, trace=None)
+    out = {}
+    while b.has_work():
+        out.update(b.serve_step())
+    assert out[0] == [(5 * 31 + i) % 97 for i in range(10)]
+    # refusal: no free slot
+    b.submit(7, [1], 5)
+    b.serve_step()
+    with pytest.raises(RuntimeError):
+        b.import_pages(8, payload)
+
+
+# ---------------------------------------------------------------------------
+# soak: the kill-mid-migration schedules
+# ---------------------------------------------------------------------------
+
+def test_gateway_soak_migration_inmemory():
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    GatewaySoak(seed=101, n_replicas=4, migration=True).run(70)
+
+
+def test_gateway_soak_migration_http():
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    GatewaySoak(seed=202, n_replicas=3, migration=True, http=True).run(45)
+
+
+@pytest.mark.slow
+def test_gateway_soak_migration_paged_kill_schedule(params):
+    """The acceptance schedule, in-memory lane: paged fp32 replicas
+    with sealing + multiturn traffic under drains, migrations,
+    kill-mid-migration and importer refusals — ``check()`` holds I5,
+    the trace oracles, and ``assert_page_accounting`` on every
+    surviving batcher (both ends of every transfer) at quiescence."""
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    def factory(key):
+        return make_paged(params, slots=8, prompt_pad=16, pool_pages=64)
+
+    GatewaySoak(
+        seed=303, n_replicas=3, batcher_factory=factory,
+        multiturn=True, migration=True,
+    ).run(24)
+
+
+@pytest.mark.slow
+def test_gateway_soak_migration_paged_http_kill_schedule(params):
+    """The same schedule ACROSS THE WIRE: every export/import is a real
+    /v1/export / /v1/import round-trip, kills are server deaths, and
+    the page-accounting claim holds through sockets."""
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    def factory(key):
+        return make_paged(params, slots=8, prompt_pad=16, pool_pages=64)
+
+    GatewaySoak(
+        seed=404, n_replicas=3, batcher_factory=factory,
+        multiturn=True, migration=True, http=True,
+    ).run(20)
