@@ -63,7 +63,11 @@ bench:
 # prompt-only, tokens diverge on any of them (the HTTP lane included),
 # the TTFT phase decomposition breaks, tracing overhead blows the 5%
 # gate, the HTTP path falls past its tolerance, or the restored re-pin
-# fails to beat (or match tokens with) the cold restart
+# fails to beat (or match tokens with) the cold restart.  Also the
+# gateway tier (serving_gateway_scaleout): 2 loopback gateways must
+# clear 1.5x aggregate tok/s over 1 on the shared-workload mixed
+# replay with fp32 token identity, and hedged-streaming p99 TTFT must
+# be strictly below unhedged under an injected straggler
 bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve-smoke
 
@@ -89,9 +93,14 @@ multichip-smoke:
 # on A, migrates mid-stream to B over the export/import verbs, A is
 # SIGKILLed after the handoff — the stream must finish on B
 # token-identical to a never-migrated reference
+# dryrun_gateway_tier: TWO gateways over one registry; a greedy stream's
+# home gateway is KILLED mid-stream and the client retries on the
+# survivor with the resume watermark — the stream completes via the
+# survivor, token-identical, each token delivered exactly once
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	  $(PY) -c "import __graft_entry__ as g; g.dryrun_gateway(); \
+	  g.dryrun_gateway_tier(); \
 	  g.dryrun_spec_serving(); g.dryrun_tracing(); \
 	  g.dryrun_http_serving(); g.dryrun_kv_migration(); \
 	  g.dryrun_multichip(8)"

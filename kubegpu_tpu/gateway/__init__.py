@@ -17,13 +17,16 @@ from kubegpu_tpu.gateway.client import (
     InMemoryReplicaClient,
     ReplicaClient,
     SimBatcher,
+    sim_stream_seed,
 )
 from kubegpu_tpu.gateway.core import (
     Gateway,
     GatewayRequest,
     GatewayResult,
     PendingRequest,
+    StreamRelay,
 )
+from kubegpu_tpu.gateway.hashring import ConsistentHashRing
 from kubegpu_tpu.gateway.dataplane import (
     HttpReplicaClient,
     ReplicaServer,
@@ -37,22 +40,27 @@ from kubegpu_tpu.gateway.failover import (
 from kubegpu_tpu.gateway.queue import AdmissionQueue, QueueClosed, QueueFull
 from kubegpu_tpu.gateway.registry import ReplicaInfo, ReplicaRegistry
 from kubegpu_tpu.gateway.router import (
+    ConsistentHashRouter,
     LeastOutstandingRouter,
     Router,
     SessionAffinityRouter,
 )
 from kubegpu_tpu.gateway.server import GatewayServer
+from kubegpu_tpu.gateway.tier import GatewayTier, is_gateway_death
 
 __all__ = [
     "AdmissionQueue",
     "Attempt",
     "AttemptResult",
+    "ConsistentHashRing",
+    "ConsistentHashRouter",
     "Dispatcher",
     "FailoverPolicy",
     "Gateway",
     "GatewayRequest",
     "GatewayResult",
     "GatewayServer",
+    "GatewayTier",
     "HttpReplicaClient",
     "InMemoryReplicaClient",
     "LeastOutstandingRouter",
@@ -68,4 +76,7 @@ __all__ = [
     "SessionAffinityRouter",
     "SessionKVStore",
     "SimBatcher",
+    "StreamRelay",
+    "is_gateway_death",
+    "sim_stream_seed",
 ]

@@ -38,18 +38,41 @@ from typing import Callable, Dict, FrozenSet, List, Optional
 DECODE_PAGE_CACHE_POLICIES = ("off", "fp32", "all")
 
 
-def _sniff_takes_trace(batcher, method: str = "submit") -> bool:
-    """Does this batcher speak the trace-context contract (on ``submit``
-    or, for migration, ``import_pages``)?  Duck-typed once per
-    worker/serving-loop so third-party batchers without the kwarg still
-    work (their requests simply serve untraced below the dispatch
-    span).  Shared with the HTTP data plane (gateway/dataplane.py) so
-    both drivers sniff identically."""
+def _sniff_takes(batcher, method: str, param: str) -> bool:
+    """Does this batcher's ``method`` accept keyword ``param``?
+    Duck-typed once per worker/serving-loop so third-party batchers
+    without the kwarg still work.  Shared with the HTTP data plane
+    (gateway/dataplane.py) so both drivers sniff identically."""
     try:
         fn = getattr(batcher, method)
-        return "trace" in inspect.signature(fn).parameters
+        return param in inspect.signature(fn).parameters
     except (AttributeError, TypeError, ValueError):
         return False
+
+
+def _sniff_takes_trace(batcher, method: str = "submit") -> bool:
+    """Trace-context contract sniff (on ``submit`` or, for migration,
+    ``import_pages``): requests on batchers without the kwarg simply
+    serve untraced below the dispatch span."""
+    return _sniff_takes(batcher, method, "trace")
+
+
+def sim_stream_seed(prompt) -> int:
+    """Request-deterministic stream seed for the SimBatcher mill.
+
+    Real replicas serving the same weights emit the SAME greedy stream
+    for the same prompt — the property hedged streaming's prefix dedup,
+    sibling-gateway retries and mid-stream migrations all lean on.  The
+    data planes model it by seeding the mill from the PROMPT (position-
+    weighted so permutations differ) instead of the replica-local slot
+    id: any replica, any resubmission, any continuation of the same
+    request mills the same tokens.  Direct SimBatcher use without a
+    ``stream_seed`` keeps the historical per-seq streams."""
+    toks = [int(t) for t in prompt]
+    return (
+        len(toks) * 131
+        + sum(t * (i + 1) for i, t in enumerate(toks))
+    ) % 1000003
 
 
 @dataclass
@@ -80,6 +103,12 @@ class Attempt:
         # migrate()'s export-failure path uses to resolve an attempt
         # whose sequence detached but whose export response was lost
         self._migrated_terminal = False
+        # absolute token index this attempt's FIRST streamed delta
+        # starts at: 0 normally; a hedge/retry fast-forwarded by a
+        # resume watermark starts past the caller's delivered prefix
+        # (set by the data-plane client that applied the watermark —
+        # the StreamRelay indexes deltas with it)
+        self.stream_base = 0
         self._done = threading.Event()
         self._result: Optional[AttemptResult] = None
         self._lock = threading.Lock()
@@ -240,9 +269,13 @@ class SimBatcher:
 
     def submit(self, seq_id: int, prompt, max_new: int,
                temperature: float = 0.0,
-               session_id: Optional[str] = None, trace=None) -> None:
+               session_id: Optional[str] = None, trace=None,
+               stream_seed: Optional[int] = None) -> None:
         # session_id is the gateway's session/prefix key; the token mill
-        # has no KV to reuse, so it only validates the widened contract
+        # has no KV to reuse, so it only validates the widened contract.
+        # stream_seed: the data planes pass sim_stream_seed(prompt) so
+        # streams are REQUEST-deterministic (identical on any replica,
+        # like real greedy decode); None keeps the per-seq mill
         if seq_id < 0:
             raise ValueError(f"seq_id must be >= 0, got {seq_id}")
         if trace is not None:
@@ -253,7 +286,10 @@ class SimBatcher:
             self._spans[seq_id] = {
                 "serve": serve, "queue": serve.child("queue"),
             }
-        self._pending.append((seq_id, int(max_new)))
+        self._pending.append((
+            seq_id, int(max_new),
+            seq_id if stream_seed is None else int(stream_seed),
+        ))
 
     def _trace_end(self, spans: dict, reason: str, **attrs) -> None:
         serve = spans.pop("serve")
@@ -269,7 +305,7 @@ class SimBatcher:
             self._trace_end(self._spans.pop(seq), "died", note=reason)
 
     def cancel(self, seq_id: int) -> bool:
-        for i, (sid, _) in enumerate(self._pending):
+        for i, (sid, *_rest) in enumerate(self._pending):
             if sid == seq_id:
                 del self._pending[i]
                 if sid in self._spans:
@@ -305,7 +341,7 @@ class SimBatcher:
         if payload.get("kind") != "live" or not payload.get("sim"):
             raise ValueError("not a sim-mill payload")
         if seq_id in self._active or any(
-            sid == seq_id for sid, _ in self._pending
+            sid == seq_id for sid, *_rest in self._pending
         ):
             raise ValueError(f"seq_id {seq_id} already in use")
         if len(self._active) >= self.slots:
@@ -336,7 +372,7 @@ class SimBatcher:
     def serve_step(self) -> Dict[int, List[int]]:
         finished: Dict[int, List[int]] = {}
         while self._pending and len(self._active) < self.slots:
-            seq, max_new = self._pending.popleft()
+            seq, max_new, seed = self._pending.popleft()
             self.stats["admits"] += 1
             spans = self._spans.get(seq)
             if spans is not None and "queue" in spans:
@@ -353,7 +389,7 @@ class SimBatcher:
                 if seq not in self._active:
                     self._rr.append(seq)
                 self._active[seq] = ([], max_new)
-                self._seed[seq] = seq
+                self._seed[seq] = seed
         if self._active:
             self.stats["steps"] += 1
             n = len(self._active)
@@ -406,6 +442,9 @@ class _ReplicaWorker:
         self.batcher = batcher
         self.step_delay_s = step_delay_s
         self._takes_trace = _sniff_takes_trace(batcher)
+        self._takes_stream_seed = _sniff_takes(
+            batcher, "submit", "stream_seed"
+        )
         self.lock = threading.Lock()
         self.cond = threading.Condition(self.lock)
         self.inbox: deque = deque()          # (attempt, request)
@@ -462,6 +501,12 @@ class _ReplicaWorker:
                     kwargs = {"session_id": getattr(req, "session", None)}
                     if self._takes_trace:
                         kwargs["trace"] = getattr(req, "trace", None)
+                    if self._takes_stream_seed:
+                        # request-deterministic mill streams: any replica
+                        # serves the same tokens for the same prompt,
+                        # like real greedy decode (hedge dedup, tier
+                        # retries and migrations all assume it)
+                        kwargs["stream_seed"] = sim_stream_seed(req.prompt)
                     try:
                         self.batcher.submit(
                             seq, req.prompt, req.max_new_tokens,
@@ -471,8 +516,16 @@ class _ReplicaWorker:
                         self.by_seq[seq] = attempt
                         sink = getattr(req, "on_tokens", None)
                         if sink is not None:
+                            # resume watermark: a hedge/retry/failover
+                            # re-dispatch fast-forwards its EMISSION
+                            # past tokens the caller already holds —
+                            # the sequence still decodes from 0
+                            base = int(getattr(
+                                req, "resume_watermark", 0
+                            ) or 0)
+                            attempt.stream_base = base
                             self.sinks[seq] = sink
-                            self.emitted[seq] = 0
+                            self.emitted[seq] = base
                     except Exception as e:  # noqa: BLE001 - bad request
                         attempt.finish(AttemptResult(False, error=str(e)))
                 for attempt in self.cancels:

@@ -114,6 +114,102 @@ class PendingRequest:
         return self._result
 
 
+class StreamRelay:
+    """Token-prefix-dedup fan-in for streaming delivery: every attempt's
+    ``on_tokens`` deltas flow through one relay that forwards each token
+    INDEX exactly once, whichever attempt supplies it first.
+
+    This is what makes hedged STREAMING sound: greedy decode is
+    deterministic, so a hedge twin's stream is byte-identical to the
+    primary's — the relay tracks a global emitted watermark and slices
+    every delta against it, so the caller's stream never duplicates a
+    token and never gaps, no matter how the two streams interleave (or
+    which one wins the terminal result).  The same watermark is what a
+    hedge/retry ships to the replica (``resume_watermark``) so the twin
+    fast-forwards its EMISSION past tokens the caller already has; an
+    attempt that was fast-forwarded declares its start offset in
+    ``attempt.stream_base``.
+
+    ``dedup=False`` is the sampled-traffic mode (temperature > 0:
+    replicas do NOT emit identical streams, so mixing them would be
+    incoherent): only the first attempt to deliver a delta may stream —
+    the pre-tier behavior — and the terminal result stays authoritative.
+    """
+
+    def __init__(self, metrics: Optional[Metrics] = None,
+                 dedup: bool = True) -> None:
+        import queue as _queue
+
+        self.metrics = metrics
+        self.dedup = dedup
+        self.q: "_queue.Queue[list]" = _queue.Queue()
+        self._lock = threading.Lock()
+        # keyed by the ATTEMPT OBJECT (identity hash), not id(): keeping
+        # the attempt referenced means a dead attempt's address can
+        # never be recycled into a new attempt that would inherit its
+        # position instead of its own stream_base.  Bounded by the
+        # attempts of one request.
+        self._positions: Dict[object, int] = {}  # attempt -> abs end
+        self._emitted = 0
+        self._pinned: Optional[object] = None  # dedup=False: the streamer
+
+    def on_tokens(self, attempt, delta) -> None:
+        """Deltas enqueue UNDER the lock: the dedup watermark decides
+        order, so the put must be atomic with it — two attempts racing
+        the queue after releasing the lock could deliver the caller's
+        stream out of order despite each token arriving exactly once."""
+        if not delta:
+            return
+        with self._lock:
+            if not self.dedup:
+                if self._pinned is None:
+                    self._pinned = attempt
+                if self._pinned is not attempt:
+                    return
+                self._emitted += len(delta)
+                self.q.put(list(delta))
+                return
+            start = self._positions.get(
+                attempt, int(getattr(attempt, "stream_base", 0) or 0)
+            )
+            end = start + len(delta)
+            self._positions[attempt] = end
+            if end <= self._emitted:
+                # a slower twin re-delivering tokens the caller has:
+                # drop, count — this is the "zero double-served" half
+                if self.metrics is not None:
+                    self.metrics.inc(
+                        "gateway_stream_dedup_tokens_total", len(delta)
+                    )
+                return
+            fresh = list(delta[max(self._emitted - start, 0):])
+            dropped = len(delta) - len(fresh)
+            if dropped and self.metrics is not None:
+                self.metrics.inc(
+                    "gateway_stream_dedup_tokens_total", dropped
+                )
+            self._emitted = end
+            self.q.put(fresh)
+
+    def emitted(self) -> int:
+        """Tokens delivered so far — the resume watermark a hedge, a
+        retry, or a sibling-gateway failover carries so the replica
+        fast-forwards emission past them."""
+        with self._lock:
+            return self._emitted
+
+    def drain(self) -> List[int]:
+        """Everything queued right now (non-blocking), flattened."""
+        import queue as _queue
+
+        out: List[int] = []
+        while True:
+            try:
+                out.extend(self.q.get_nowait())
+            except _queue.Empty:
+                return out
+
+
 class Gateway:
     def __init__(
         self,
@@ -127,11 +223,16 @@ class Gateway:
         max_results: int = 65536,
         tracer: Optional[Tracer] = None,
         trace: bool = True,
+        session_store: Optional[SessionKVStore] = None,
+        gateway_id: str = "",
     ) -> None:
         self.registry = registry
         self.client = client
         self.queue = queue or AdmissionQueue()
         self.metrics = metrics or default_metrics
+        # the tier's name for this instance (rides trace roots and drain
+        # stats); empty for a standalone gateway
+        self.gateway_id = gateway_id
         # request tracing is ON by default (bounded ring, a handful of
         # dict ops per request): every request yields one span tree —
         # admission_wait / route / dispatch / replica-side serve phases
@@ -149,8 +250,10 @@ class Gateway:
         # recorded (and, when the serving replica seals decode pages,
         # eagerly exported) so a later replica death or drain re-pins
         # the session WITH its KV — the dispatcher restores the payload
-        # into the new target before the turn-2 attempt opens
-        self.session_store = SessionKVStore()
+        # into the new target before the turn-2 attempt opens.  A tier
+        # passes ONE shared store into all its gateways: insurance a
+        # sibling captured must survive this gateway's death
+        self.session_store = session_store or SessionKVStore()
         self._seals_cache: Dict[str, bool] = {}
         self.dispatcher = Dispatcher(
             client,
@@ -164,6 +267,11 @@ class Gateway:
         self._threads: List[threading.Thread] = []
         self._lock = threading.Lock()
         self._pending: Dict[str, PendingRequest] = {}
+        # requests currently INSIDE a dispatcher thread (dequeued, not
+        # yet terminal), keyed by id: kill() sets their abort events so
+        # a dying gateway's in-flight attempts cancel wire-level — the
+        # in-process analog of a crashed pod's sockets closing
+        self._live_requests: Dict[str, GatewayRequest] = {}
         # FIFO-bounded: a long-lived gateway must not retain every result
         # (token lists included) for the life of the process
         self._results: "OrderedDict[str, GatewayResult]" = OrderedDict()
@@ -196,6 +304,7 @@ class Gateway:
     def stop(self) -> None:
         self._stop.set()
         self.queue.close()
+        self.registry.unsubscribe(self._on_live_change)
         for t in self._threads:
             t.join(timeout=5.0)
         # still-queued requests and any stragglers a wedged dispatcher
@@ -217,6 +326,43 @@ class Gateway:
                 rid, "error", error="gateway shutting down",
             ))
 
+    def kill(self) -> None:
+        """Abrupt death — the tier's chaos surface, distinct from the
+        graceful ``stop()``: no joins, no waiting.  Every in-flight
+        request's abort event fires (the dispatcher cancels its attempts
+        WIRE-LEVEL, so replicas free the sequences' pages — exactly what
+        a crashed gateway pod's closed sockets would cause via the
+        replica's disconnect⇒cancel path), queued and pending requests
+        resolve with an explicit "gateway died" error (the tier client's
+        retry-on-a-sibling trigger), and the registry subscription
+        detaches so the corpse stops observing the shared live set."""
+        self._stop.set()
+        self.queue.close()
+        self.registry.unsubscribe(self._on_live_change)
+        with self._lock:
+            live = list(self._live_requests.values())
+        for request in live:
+            abort = getattr(request, "abort", None)
+            if abort is not None:
+                abort.set()
+        while True:
+            request = self.queue.get(timeout=0)
+            if request is None:
+                break
+            self._record(GatewayResult(
+                request.request_id, "error", error="gateway died",
+            ))
+        with self._lock:
+            leftovers = list(self._pending)
+        for rid in leftovers:
+            self._record(GatewayResult(
+                rid, "error", error="gateway died",
+            ))
+
+    @property
+    def alive(self) -> bool:
+        return not self._stop.is_set()
+
     # -- submission (the HTTP handler's surface) ---------------------------
     def submit(self, request: GatewayRequest) -> PendingRequest:
         """Admit or refuse NOW.  Refusal still resolves the handle — with
@@ -231,18 +377,31 @@ class Gateway:
             self._pending[request.request_id] = pending
             self._n_submitted += 1
         if self.tracer is not None:
+            attrs = dict(request_id=request.request_id,
+                         tenant=request.tenant)
+            if self.gateway_id:
+                attrs["gateway"] = self.gateway_id
             request.trace = self.tracer.start_trace(
-                "gateway_request", request_id=request.request_id,
-                tenant=request.tenant,
+                "gateway_request", **attrs
             )
             pending._trace = request.trace
         request.enqueued_at = time.monotonic()
         try:
             self.queue.put(request)
-        except (QueueFull, QueueClosed) as e:
+        except QueueFull as e:
             self.metrics.inc("gateway_requests_total", outcome="rejected")
             self._record(GatewayResult(
                 request.request_id, "rejected", error=str(e),
+            ))
+            return pending
+        except QueueClosed as e:
+            # NOT backpressure: the queue only closes when this gateway
+            # is dying — a submit racing kill()/stop() must resolve with
+            # the RETRYABLE death error so a tier client re-submits on a
+            # sibling instead of surfacing a spurious rejection
+            self.metrics.inc("gateway_requests_total", outcome="error")
+            self._record(GatewayResult(
+                request.request_id, "error", error=f"gateway died: {e}",
             ))
             return pending
         self.metrics.set_gauge("gateway_queue_depth", self.queue.depth())
@@ -270,6 +429,7 @@ class Gateway:
                 continue
             with self._lock:
                 self._in_flight += 1
+                self._live_requests[request.request_id] = request
             try:
                 started = time.monotonic()
                 queue_wait = started - request.enqueued_at
@@ -303,6 +463,7 @@ class Gateway:
             finally:
                 with self._lock:
                     self._in_flight -= 1
+                    self._live_requests.pop(request.request_id, None)
 
     def _record_session(self, request: GatewayRequest, outcome) -> None:
         """A sessionful turn completed ok: record the session's home +

@@ -74,7 +74,9 @@ from kubegpu_tpu.gateway.client import (
     Attempt,
     AttemptResult,
     ReplicaClient,
+    _sniff_takes,
     _sniff_takes_trace,
+    sim_stream_seed,
 )
 from kubegpu_tpu.utils.metrics import Metrics
 from kubegpu_tpu.utils.tracing import SpanCtx, Tracer
@@ -225,6 +227,9 @@ class ReplicaServingLoop:
         # replica refuses /v1/import — the soak's importer-refusal leg
         self.fail_migration = fail_migration
         self._takes_trace = _sniff_takes_trace(batcher)
+        self._takes_stream_seed = _sniff_takes(
+            batcher, "submit", "stream_seed"
+        )
         # RLock: _finish mutates stream maps from both the serving
         # thread (already holding the condition's lock on the shutdown
         # path) and the flush path
@@ -507,13 +512,19 @@ class ReplicaServingLoop:
                 # serving thread (it only annotates the audit trail)
                 remote_span=_int_or(payload.get("span_id"), 0),
             )
+        prompt = np.asarray(payload.get("prompt") or [], np.int32)
         kwargs = {"session_id": payload.get("session")}
         if self._takes_trace:
             kwargs["trace"] = root
+        if self._takes_stream_seed:
+            # request-deterministic mill streams (real batchers decode
+            # deterministically from the prompt; the mill must too, or
+            # hedge dedup / sibling retries would mix unrelated streams)
+            kwargs["stream_seed"] = sim_stream_seed(prompt)
         try:
             self.batcher.submit(
                 seq,
-                np.asarray(payload.get("prompt") or [], np.int32),
+                prompt,
                 int(payload.get("max_new_tokens", 0)),
                 float(payload.get("temperature", 0.0)),
                 **kwargs,
@@ -526,6 +537,17 @@ class ReplicaServingLoop:
             return
         st.seq = seq
         st.trace = root
+        # resume watermark (hedged streaming / retry / tier failover):
+        # the caller already holds this many tokens — decode from 0 as
+        # always (greedy is deterministic), but EMIT only past the
+        # watermark.  The terminal done still carries the full list.
+        wm = max(0, _int_or(payload.get("watermark"), 0))
+        if wm:
+            st.emitted = wm
+            if self.metrics is not None:
+                self.metrics.inc(
+                    "replica_stream_fastforward_tokens_total", wm
+                )
         self._by_seq[seq] = st
 
     def _flush(self, finished: Dict[int, List[int]]) -> None:
@@ -568,12 +590,55 @@ class ReplicaServingLoop:
 
 
 def make_replica_handler(loop: ReplicaServingLoop,
-                         metrics: Optional[Metrics]):
+                         metrics: Optional[Metrics],
+                         auth_token: Optional[str] = None):
+    """``auth_token``: optional bearer token required on every ``/v1/*``
+    verb — the serving surface moves KV bytes and cancels sequences, so
+    once the endpoint is exposed beyond loopback (TLS on, multi-tenant
+    cluster) it must not be callable by any pod that can reach the
+    podIP.  ``/healthz`` and ``/metrics`` stay open: probes and scrapes
+    are read-only and gating them would drain replicas on token skew
+    (the scheduler/server.py discipline)."""
+
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
+        def setup(self):
+            # TLS: the listening socket is wrapped with
+            # do_handshake_on_connect=False, so the handshake happens
+            # HERE, on this connection's own thread, under a deadline —
+            # a silent client costs one worker thread for 10 s, never
+            # the accept loop (scheduler/server.py's pattern)
+            if hasattr(self.request, "do_handshake"):
+                prev = self.request.gettimeout()
+                self.request.settimeout(10.0)
+                try:
+                    self.request.do_handshake()
+                finally:
+                    self.request.settimeout(prev)
+            super().setup()
+
         def log_message(self, fmt, *args):
             log.debug("replica http: " + fmt, *args)
+
+        def _authorized(self, path: str) -> bool:
+            if not auth_token or not path.startswith("/v1/"):
+                return True
+            import hmac
+
+            sent = self.headers.get("Authorization", "")
+            # constant-time compare: the token gates exactly the
+            # callers a timing oracle would serve
+            if hmac.compare_digest(sent, f"Bearer {auth_token}"):
+                return True
+            # the refused request's body was never read: close the
+            # connection rather than let a pooling client's NEXT
+            # request be parsed out of the stale body bytes
+            self.close_connection = True
+            self._send_json(
+                401, {"error": "unauthorized (bearer token required)"}
+            )
+            return False
 
         def _read_json(self) -> Optional[dict]:
             try:
@@ -600,6 +665,8 @@ def make_replica_handler(loop: ReplicaServingLoop,
 
         def do_GET(self):
             path, _, query = self.path.partition("?")
+            if not self._authorized(path):
+                return
             if metrics is not None:
                 metrics.inc("replica_http_requests_total", verb="state"
                             if path == "/v1/state" else "get")
@@ -630,6 +697,8 @@ def make_replica_handler(loop: ReplicaServingLoop,
                 self._send_json(404, {"error": f"no route {path}"})
 
         def do_POST(self):
+            if not self._authorized(self.path):
+                return
             if self.path == "/v1/cancel":
                 if metrics is not None:
                     metrics.inc("replica_http_requests_total", verb="cancel")
@@ -866,15 +935,45 @@ class ReplicaServer:
                  metrics: Optional[Metrics] = None,
                  tracer: Optional[Tracer] = None,
                  step_delay_s: float = 0.0,
-                 fail_migration: bool = False) -> None:
+                 fail_migration: bool = False,
+                 tls_cert: Optional[str] = None,
+                 tls_key: Optional[str] = None,
+                 auth_token: Optional[str] = None) -> None:
+        if bool(tls_cert) != bool(tls_key):
+            # a half-configured pair must not come up silently as the
+            # plain-HTTP endpoint the operator believes is encrypted —
+            # checked BEFORE anything binds a socket, so the raise
+            # leaks nothing
+            raise ValueError(
+                "tls_cert and tls_key must be given together"
+            )
         self.metrics = metrics if metrics is not None else Metrics()
         self.loop = ReplicaServingLoop(
             batcher, metrics=self.metrics, tracer=tracer,
             step_delay_s=step_delay_s, fail_migration=fail_migration,
         )
         self.httpd = _ReplicaHTTPServer(
-            listen, make_replica_handler(self.loop, self.metrics)
+            listen,
+            make_replica_handler(self.loop, self.metrics,
+                                 auth_token=auth_token),
         )
+        self.tls = bool(tls_cert and tls_key)
+        if self.tls:
+            # the replica endpoint streams KV bytes and serves the
+            # migration verbs; exposed beyond loopback it gets the same
+            # treatment as the extender's privileged verbs: TLS on the
+            # wire (handshake deferred to the handler thread — see
+            # Handler.setup) + bearer auth on /v1/*.  Plain HTTP stays
+            # the default for loopback tests and single-tenant pods.
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert, tls_key)
+            self.httpd.socket = ctx.wrap_socket(
+                self.httpd.socket,
+                server_side=True,
+                do_handshake_on_connect=False,
+            )
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -933,11 +1032,23 @@ class HttpReplicaClient(ReplicaClient):
         default_port: int = 8700,
         timeout_s: float = 5.0,
         metrics: Optional[Metrics] = None,
+        tls_ca: Optional[str] = None,
+        auth_token: Optional[str] = None,
     ) -> None:
         self.resolver = resolver
         self.default_port = default_port
         self.timeout_s = timeout_s
         self.metrics = metrics
+        # transport security, matching the replica server's knobs:
+        # tls_ca = PEM bundle to verify replica certs against (HTTPS to
+        # every endpoint); auth_token = bearer sent on every request
+        # (the replica gates /v1/*).  Both None = plain loopback HTTP.
+        self.auth_token = auth_token
+        self._ssl_ctx = None
+        if tls_ca is not None:
+            import ssl
+
+            self._ssl_ctx = ssl.create_default_context(cafile=tls_ca)
         self._lock = threading.Lock()
         self._endpoints: Dict[str, str] = dict(endpoints or {})
         self._pool: Dict[str, List[http.client.HTTPConnection]] = {}
@@ -949,6 +1060,23 @@ class HttpReplicaClient(ReplicaClient):
         # wasted-hedge accounting — the in-memory client's `decodes`)
         self.decodes: Dict[str, int] = {}
         self._stopped = False
+
+    # -- transport ---------------------------------------------------------
+    def _connect(self, addr: str, timeout: float):
+        """The one connection constructor for this client: plain HTTP,
+        or HTTPS against the configured CA when ``tls_ca`` was given."""
+        if self._ssl_ctx is None:
+            return _connect(addr, timeout)
+        host, _, port = addr.rpartition(":")
+        return http.client.HTTPSConnection(
+            host, int(port), timeout=timeout, context=self._ssl_ctx
+        )
+
+    def _headers(self, extra: Optional[dict] = None) -> dict:
+        headers = dict(extra or {})
+        if self.auth_token:
+            headers["Authorization"] = f"Bearer {self.auth_token}"
+        return headers
 
     # -- endpoints ---------------------------------------------------------
     def set_endpoint(self, key: str, addr: str) -> None:
@@ -1022,7 +1150,7 @@ class HttpReplicaClient(ReplicaClient):
         addr = self._addr_of(info)
         if addr is None:
             return False, "no data-plane endpoint (pod IP unknown)"
-        conn = _connect(addr, timeout=1.0)
+        conn = self._connect(addr, timeout=1.0)
         try:
             conn.request("GET", "/healthz")
             resp = conn.getresponse()
@@ -1039,10 +1167,10 @@ class HttpReplicaClient(ReplicaClient):
         addr = self.endpoint_for(key)
         if addr is None:
             return None
-        conn = _connect(addr, timeout=1.0)
+        conn = self._connect(addr, timeout=1.0)
         try:
             path = "/v1/state" + (f"?ledger={ledger}" if ledger else "")
-            conn.request("GET", path)
+            conn.request("GET", path, headers=self._headers())
             resp = conn.getresponse()
             if resp.status != 200:
                 resp.read()
@@ -1082,11 +1210,11 @@ class HttpReplicaClient(ReplicaClient):
         """POST /v1/export; returns the (still-encoded) payload dict or
         None — the gateway relays it to /v1/import opaquely, so only
         replica processes pay the codec."""
-        conn = _connect(addr, timeout=self.timeout_s)
+        conn = self._connect(addr, timeout=self.timeout_s)
         try:
             conn.request(
                 "POST", "/v1/export", json.dumps(body),
-                {"Content-Type": "application/json"},
+                self._headers({"Content-Type": "application/json"}),
             )
             resp = conn.getresponse()
             data = resp.read()
@@ -1110,11 +1238,11 @@ class HttpReplicaClient(ReplicaClient):
         addr = self.endpoint_for(replica_key)
         if addr is None or payload is None:
             return False
-        conn = _connect(addr, timeout=self.timeout_s)
+        conn = self._connect(addr, timeout=self.timeout_s)
         try:
             conn.request(
                 "POST", "/v1/import", json.dumps({"payload": payload}),
-                {"Content-Type": "application/json"},
+                self._headers({"Content-Type": "application/json"}),
             )
             resp = conn.getresponse()
             resp.read()
@@ -1249,12 +1377,12 @@ class HttpReplicaClient(ReplicaClient):
         addr = self.endpoint_for(replica_key)
         if addr is None:
             return
-        conn = _connect(addr, timeout=2.0)
+        conn = self._connect(addr, timeout=2.0)
         try:
             conn.request(
                 "POST", "/v1/cancel",
                 json.dumps({"request_id": request_id}),
-                {"Content-Type": "application/json"},
+                self._headers({"Content-Type": "application/json"}),
             )
             conn.getresponse().read()
         except OSError:
@@ -1267,7 +1395,7 @@ class HttpReplicaClient(ReplicaClient):
             pool = self._pool.get(key)
             if pool:
                 return pool.pop()
-        return _connect(addr, timeout=self.timeout_s)
+        return self._connect(addr, timeout=self.timeout_s)
 
     def _checkin(self, key: str, conn: http.client.HTTPConnection) -> None:
         with self._lock:
@@ -1320,7 +1448,7 @@ class HttpReplicaClient(ReplicaClient):
                 })
             else:
                 path = "/v1/submit"
-                body = json.dumps({
+                payload = {
                     "request_id": request.request_id,
                     "prompt": [int(t) for t in request.prompt],
                     "max_new_tokens": int(request.max_new_tokens),
@@ -1328,8 +1456,16 @@ class HttpReplicaClient(ReplicaClient):
                         getattr(request, "temperature", 0.0)
                     ),
                     "session": getattr(request, "session", None),
-                })
-            headers = {"Content-Type": "application/json"}
+                }
+                wm = int(getattr(request, "resume_watermark", 0) or 0)
+                if wm > 0:
+                    # hedge/retry fast-forward: the replica emits only
+                    # past the caller's delivered prefix; stream_base
+                    # tells the relay where this attempt's deltas start
+                    payload["watermark"] = wm
+                    attempt.stream_base = wm
+                body = json.dumps(payload)
+            headers = self._headers({"Content-Type": "application/json"})
             if trace is not None:
                 headers["X-Trace-Id"] = trace.trace_id
                 headers["X-Span-Id"] = str(trace.span_id)

@@ -47,9 +47,16 @@ class SessionKVStore:
     death), restore is a clean no-op and the request cold-prefills —
     graceful, never wrong.
 
-    Bounded FIFO like the affinity router's pin map; restore only fires
-    for sessions whose home replica was LOST (left the live set or was
-    drained) — a healthy pin serves from its own cache.
+    Bounded FIFO like the affinity router's pin map; restore fires for
+    any non-hedge dispatch AWAY from the session's recorded home — a
+    lost home (left the live set, drained) always, a MISPIN (the
+    tier's consistent-hash ring moved the session on membership churn,
+    an affinity re-pin) only under affinity-style routing
+    (``mispin_restore``; a plain load balancer bounces sessions by
+    design and must not ship the payload per bounce).  A dispatch to
+    the healthy home is a no-op (the replica serves from its own
+    cache), and hedge twins never restore (the dispatcher skips them —
+    the primary usually holds the live KV).
 
     Payload bytes are bounded separately (``max_payload_bytes``, total
     across sessions): KV payloads are big — megabytes per page at real
@@ -163,18 +170,29 @@ class SessionKVStore:
                     e["lost"] = True
 
     def restore_for(self, request, target_key: str,
-                    client: ReplicaClient) -> bool:
+                    client: ReplicaClient,
+                    mispin_restore: bool = True) -> bool:
         """Called at dispatch time with the routed target: if this
-        request's session lost its home replica and a sealed export was
+        request's session is dispatching AWAY from its recorded home —
+        because the home was lost (death, drain) or, when
+        ``mispin_restore``, because routing deliberately moved it (a
+        consistent-hash ring rebalance or an affinity re-pin: the
+        tier's "mispinned session") — and a sealed export was
         captured, import it into the target (idempotent — the import
         dedups against pages already cached there) and re-home the
-        entry.  True only when a payload actually landed."""
+        entry.  ``mispin_restore=False`` is for load-balancing routers
+        with NO session affinity: every turn may land somewhere new by
+        design, and shipping the payload per bounce would be pure wire
+        waste — only a LOST home restores there.  True only when a
+        payload actually landed."""
         session = getattr(request, "session", None)
         if not session:
             return False
         with self._lock:
             e = self._entries.get(session)
-            if e is None or not e["lost"] or e["replica"] == target_key:
+            if e is None or e["replica"] == target_key:
+                return False
+            if not e["lost"] and not mispin_restore:
                 return False
             payload = e["payload"]
         if payload is None:
@@ -260,8 +278,15 @@ class Dispatcher:
         self.metrics = metrics
         # sealed-export restore at dispatch: when a routed session lost
         # its home replica, its captured KV is imported into the target
-        # BEFORE the attempt opens — the re-pin becomes a transfer
+        # BEFORE the attempt opens — the re-pin becomes a transfer.
+        # Mispin restores (away-dispatch with the home still healthy)
+        # only make sense when the router MEANS its away-dispatches —
+        # affinity/ring routers (duck-typed on forget_replica, which
+        # both define for the drain path).  A plain load balancer
+        # bounces sessions by design; restoring per bounce would ship
+        # the payload every turn.
         self.session_store = session_store
+        self._mispin_restore = hasattr(router, "forget_replica")
         self.retry_budget = _Budget(
             self.policy.retry_budget_ratio, self.policy.budget_floor
         )
@@ -289,13 +314,19 @@ class Dispatcher:
                 hedge: bool = False) -> Attempt:
         self._inc(replica.key)
         trace = getattr(request, "trace", None)
-        if self.session_store is not None:
-            # restore-before-dispatch: a session whose home replica was
-            # lost gets its sealed KV imported into THIS target first,
-            # so the very request that re-pins already hits warm pages
+        if self.session_store is not None and not hedge:
+            # restore-before-dispatch: a session dispatching away from
+            # its recorded KV home (lost home, or a ring-rebalance
+            # mispin) gets its sealed export imported into THIS target
+            # first, so the very request that moves already hits warm
+            # pages.  NOT for hedge twins: the primary (usually on the
+            # healthy home) would make the multi-megabyte transfer pure
+            # waste — and if the twin wins, the session's NEXT dispatch
+            # routes to it and restores then.
             try:
                 if self.session_store.restore_for(
-                    request, replica.key, self.client
+                    request, replica.key, self.client,
+                    mispin_restore=self._mispin_restore,
                 ):
                     if self.metrics:
                         self.metrics.inc("gateway_session_restores_total")
@@ -303,6 +334,23 @@ class Dispatcher:
                         trace.event("session_restore", replica=replica.key)
             except Exception:  # noqa: BLE001 - restore is best-effort
                 log.exception("sealed-session restore failed")
+        attrs: dict = {}
+        # streaming resume watermark: an attempt opened after the caller
+        # already received N tokens — a hedge twin, a retry, or a
+        # sibling gateway resuming a crashed gateway's stream — carries
+        # N down the wire, so the replica fast-forwards EMISSION past
+        # the already-delivered prefix (it still decodes it — greedy
+        # decode is deterministic, which is also why the relay's dedup
+        # is sound).  A fresh request's watermark is 0 and ships nothing.
+        wm_fn = getattr(request, "stream_watermark", None)
+        if wm_fn is not None:
+            try:
+                wm = int(wm_fn())
+            except Exception:  # noqa: BLE001 - watermark is advisory
+                wm = 0
+            if wm > 0:
+                attrs["resume_watermark"] = wm
+        span = None
         if trace is not None:
             # one dispatch span per attempt; the replica's serve subtree
             # nests under it (the worker passes the view's .trace into
@@ -313,12 +361,12 @@ class Dispatcher:
                 "dispatch", replica=replica.key, attempt=attempt_n,
                 hedge=hedge, overhang_ok=True,
             )
-            request = _TraceView(request, trace=span)
-            attempt = self.client.submit(replica.key, request)
-            attempt._dispatch_span = span
-            attempt._routed_key = replica.key
-            return attempt
+            attrs["trace"] = span
+        if attrs:
+            request = _TraceView(request, **attrs)
         attempt = self.client.submit(replica.key, request)
+        if span is not None:
+            attempt._dispatch_span = span
         attempt._routed_key = replica.key
         return attempt
 
@@ -380,7 +428,12 @@ class Dispatcher:
             req = request
             if trace is not None and route_spans_left[0] > 0:
                 route_spans_left[0] -= 1
-                span = trace.child("route", hedge=hedge)
+                # overhang_ok: a gateway kill (or caller abort) closes
+                # the ROOT the instant it records the terminal result —
+                # this microsecond span may legitimately still be open
+                # on the dispatcher thread at that moment, like the
+                # dispatch spans below
+                span = trace.child("route", hedge=hedge, overhang_ok=True)
                 req = _TraceView(request, route_span=span)
             target = self.router.pick(
                 req, replicas, self.outstanding, exclude
@@ -415,7 +468,13 @@ class Dispatcher:
                     if not a.done:
                         self.client.cancel(a)
                     self._settle(a)
-                if self.metrics:
+                # the disconnect metric is about STREAMING callers that
+                # vanished; the tier attaches abort events to unary
+                # requests too (a gateway kill fires them), and those
+                # must not inflate the caller-disconnect signal
+                if self.metrics and (
+                    getattr(request, "on_tokens", None) is not None
+                ):
                     self.metrics.inc("gateway_stream_disconnects_total")
                 return DispatchOutcome(
                     "error", error="cancelled: caller disconnected",
@@ -520,11 +579,13 @@ class Dispatcher:
                 and len(attempts) == 1
                 and hedge_at is not None
                 and now >= hedge_at
-                # a STREAMING request never hedges: its caller follows
-                # one attempt's token stream, and a twin racing it could
-                # win the terminal result with a stream nobody read
-                # (retries still apply — a failed stream re-dispatches,
-                # and the terminal result stays authoritative)
+                # no_hedge: SAMPLED streams (temperature > 0) never
+                # hedge — replicas do not emit identical sampled
+                # streams, so a twin's tokens could not be deduped
+                # coherently.  Greedy streams DO hedge: the StreamRelay
+                # dedups by token index and the resume watermark
+                # fast-forwards the twin, so tail latency is hedged
+                # exactly for the requests users watch token by token.
                 and not getattr(request, "no_hedge", False)
             ):
                 target = routed_pick(frozenset(tried), hedge=True)
@@ -539,6 +600,13 @@ class Dispatcher:
                     hedged = True
                     if self.metrics:
                         self.metrics.inc("gateway_hedges_total")
+                        if getattr(request, "on_tokens", None) is not None:
+                            # a STREAMING hedge — only safe because the
+                            # relay's prefix dedup keeps the caller's
+                            # stream exactly-once
+                            self.metrics.inc(
+                                "gateway_stream_hedges_total"
+                            )
                 else:
                     hedge_at = None  # budget denied; stop re-checking
 

@@ -286,6 +286,18 @@ class ReplicaRegistry:
         with self._lock:
             self._observers.append(fn)
 
+    def unsubscribe(self, fn: Callable[[FrozenSet[str]], None]) -> None:
+        """Detach an observer (idempotent).  A registry is SHARED across
+        a gateway tier; a killed gateway must stop observing the live
+        set — a corpse mutating the shared session store (or publishing
+        gauges) on every membership change is the kind of half-dead
+        process a real crash never leaves behind."""
+        with self._lock:
+            try:
+                self._observers.remove(fn)
+            except ValueError:
+                pass
+
     # -- event plumbing ----------------------------------------------------
     def _request_refresh(self) -> None:
         """Refresh now, or mark dirty for the coalescing refresher if one

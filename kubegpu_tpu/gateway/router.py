@@ -17,6 +17,15 @@ already failed or hedged on), and returns one replica or None.
   conversation's cache pages).  Falls back to least-outstanding — with the
   dead replica's slice as the locality hint — when the pinned replica
   drains, and re-pins to the new choice.
+- ``ConsistentHashRouter``: the multi-gateway tier's affinity policy —
+  session → replica via a consistent-hash ring over the routable replica
+  keys.  Routing is a pure function of (session, membership), so N
+  gateways sharing one registry view route every session IDENTICALLY
+  with zero coordination: any gateway can route any session, and a
+  sibling taking over a crashed gateway's sessions lands them on the
+  same replicas.  Membership changes move only the ring-bounded key
+  fraction; each moved session is a "mispin" the dispatcher's
+  SessionKVStore restore turns into a KV transfer.
 """
 
 from __future__ import annotations
@@ -24,6 +33,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, FrozenSet, List, Mapping, Optional
 
+from kubegpu_tpu.gateway.hashring import ConsistentHashRing
 from kubegpu_tpu.gateway.registry import ReplicaInfo
 from kubegpu_tpu.types.topology import coords_bounding_box
 from kubegpu_tpu.utils.metrics import Metrics
@@ -174,6 +184,77 @@ class SessionAffinityRouter(Router):
                 s for s, k in self._pins.items() if k == replica_key
             ]:
                 del self._pins[s]
+
+
+class ConsistentHashRouter(Router):
+    """Session → replica by consistent hashing over routable replicas.
+
+    Stateless where it matters: the ring is rebuilt from whatever
+    replica list the dispatcher passes (the shared registry view), so
+    every gateway instance holding its own ``ConsistentHashRouter``
+    computes the same route — the tier's no-coordination guarantee.
+    The only retained state is a bounded last-route memo used to COUNT
+    movement (``gateway_session_repin_total`` when a session's ring
+    target changed — the KV either moved with it via the sealed-export
+    restore or re-prefills cold) and to annotate route spans.
+
+    Sessionless requests fall back (LeastOutstanding default); the
+    exclude set walks the ring clockwise, so retries/hedges visit
+    replicas in the same deterministic order on every gateway.
+    """
+
+    def __init__(self, fallback: Optional[Router] = None,
+                 vnodes: int = 64, max_sessions: int = 65536,
+                 metrics: Optional[Metrics] = None) -> None:
+        self.fallback = fallback or LeastOutstandingRouter()
+        self.metrics = metrics
+        self.max_sessions = max_sessions
+        self._lock = threading.Lock()
+        self._ring = ConsistentHashRing(vnodes=vnodes)
+        self._last_route: Dict[str, str] = {}  # session -> replica key
+
+    def pick(self, request, replicas, outstanding, exclude=frozenset()):
+        session = getattr(request, "session", None)
+        if not session:
+            return self.fallback.pick(request, replicas, outstanding,
+                                      exclude)
+        by_key = {r.key: r for r in replicas}
+        route_span = getattr(request, "route_span", None)
+        # an exclude-walk (hedge twin, retry probe) is NOT a route
+        # change: the session's ring home is unchanged — it must
+        # neither overwrite the movement memo nor count as a repin
+        probing = bool(exclude)
+        with self._lock:
+            self._ring.rebuild(by_key)
+            target = self._ring.lookup(session, exclude=frozenset(exclude))
+            prev = self._last_route.get(session)
+            if target is not None and not probing:
+                self._last_route[session] = target
+                while len(self._last_route) > self.max_sessions:
+                    self._last_route.pop(next(iter(self._last_route)))
+        if target is None:
+            return None
+        moved = not probing and prev is not None and prev != target
+        if route_span is not None:
+            route_span.annotate(session=session, ring=True,
+                                repin=moved, lost_pin=prev or "")
+        if moved and self.metrics is not None:
+            # the ring target changed (membership churn): the session's
+            # KV is elsewhere — the sealed-export restore decides
+            # whether that is a transfer or a cold prefill
+            self.metrics.inc("gateway_session_repin_total")
+        return by_key[target]
+
+    def forget_replica(self, replica_key: str) -> None:
+        """Drain bookkeeping parity with SessionAffinityRouter: drop the
+        movement memos pointing at a released replica so its eventual
+        ring re-entry (same pod name, fresh process) is not counted as
+        a second move."""
+        with self._lock:
+            for s in [
+                s for s, k in self._last_route.items() if k == replica_key
+            ]:
+                del self._last_route[s]
 
 
 class _with_hint:

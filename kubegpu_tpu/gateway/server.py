@@ -171,28 +171,30 @@ def make_handler(gateway: Gateway, registry: ReplicaRegistry):
             """SSE pass-through: committed token batches relayed from
             the data plane as they stream off the replica, then the
             terminal result.  The done event's token list is
-            AUTHORITATIVE — with hedging, the attempt that streamed may
-            lose the race to a twin; the winner's full result closes the
-            stream either way.  A vanished caller fails the next write,
-            which sets the request's abort event: the dispatcher cancels
-            every in-flight attempt wire-level, so the replica frees the
-            sequence's pages."""
+            AUTHORITATIVE.  GREEDY streams hedge: the StreamRelay dedups
+            by absolute token index (greedy decode is deterministic, so
+            a hedge twin's stream is the primary's), and the relay's
+            emitted watermark rides down to the twin so it fast-forwards
+            past tokens the caller already has — each token arrives
+            exactly once whichever attempt supplies it.  SAMPLED streams
+            (temperature > 0) keep the one-attempt pin: replicas do not
+            emit identical sampled streams.  A vanished caller fails the
+            next write, which sets the request's abort event: the
+            dispatcher cancels every in-flight attempt wire-level, so
+            the replica frees the sequence's pages."""
             import queue as _queue
 
-            sink: "_queue.Queue" = _queue.Queue()
-            first = []  # the one attempt allowed to stream (hedge guard)
-
-            def on_tokens(attempt, delta):
-                if not first:
-                    first.append(attempt)
-                if first[0] is attempt:
-                    sink.put(delta)
-
+            from kubegpu_tpu.gateway.core import StreamRelay
             from kubegpu_tpu.gateway.dataplane import end_chunks, sse_event
 
-            request.on_tokens = on_tokens
+            greedy = float(getattr(request, "temperature", 0.0)) == 0.0
+            relay = StreamRelay(gateway.metrics, dedup=greedy)
+            request.on_tokens = relay.on_tokens
+            request.stream_watermark = relay.emitted
             request.abort = threading.Event()
-            request.no_hedge = True  # one caller, one stream
+            # sampled streams never hedge (incoherent twin streams);
+            # greedy streams hedge through the relay's dedup
+            request.no_hedge = not greedy
             gateway.metrics.inc("gateway_stream_requests_total")
             pending = gateway.submit(request)
             # ONLY a refusal short-circuits to plain JSON (429): any
@@ -214,7 +216,7 @@ def make_handler(gateway: Gateway, registry: ReplicaRegistry):
             try:
                 while not pending.wait(0.0):
                     try:
-                        delta = sink.get(timeout=0.2)
+                        delta = relay.q.get(timeout=0.2)
                     except _queue.Empty:
                         self._chunk(b": ping\n\n")
                         continue
@@ -222,6 +224,14 @@ def make_handler(gateway: Gateway, registry: ReplicaRegistry):
                         "gateway_stream_tokens_total", len(delta)
                     )
                     self._chunk(sse_event("tokens", {"tokens": delta}))
+                # late deltas queued between the winner's resolution and
+                # this check still belong to the caller's stream
+                tail = relay.drain()
+                if tail:
+                    gateway.metrics.inc(
+                        "gateway_stream_tokens_total", len(tail)
+                    )
+                    self._chunk(sse_event("tokens", {"tokens": tail}))
                 result = pending.result()
                 payload = {
                     "request_id": result.request_id,
@@ -484,6 +494,19 @@ def main(argv=None) -> None:
         "in-process SimBatcher planes here model only the multi-token "
         "step and its k+1-row budget accounting",
     )
+    ap.add_argument(
+        "--replica-tls-ca", default=None, metavar="PEM",
+        help="CA bundle to verify replica serving endpoints against: "
+        "the data plane dispatches over HTTPS instead of plain HTTP "
+        "(replicas run models.worker --serve-http-tls-cert/key).  "
+        "Omit for plain HTTP (loopback / single-tenant)",
+    )
+    ap.add_argument(
+        "--replica-auth-token-file", default=None, metavar="FILE",
+        help="bearer token (file contents) sent on every replica "
+        "data-plane request; replicas started with "
+        "--serve-http-auth-token-file gate /v1/* on it",
+    )
     ap.add_argument("--queue-capacity", type=int, default=256)
     ap.add_argument("--per-tenant-cap", type=int, default=None)
     ap.add_argument("--deadline", type=float, default=30.0,
@@ -548,9 +571,15 @@ def main(argv=None) -> None:
                     return None
                 return f"{info.addr}:{_port}"
 
+            replica_token = None
+            if args.replica_auth_token_file:
+                with open(args.replica_auth_token_file) as f:
+                    replica_token = f.read().strip()
             client = HttpReplicaClient(
                 resolver=_resolve,
                 default_port=args.replica_port,
+                tls_ca=args.replica_tls_ca,
+                auth_token=replica_token,
             )
             registry.probe = client.probe
             registry.subscribe(client.sync_live)

@@ -658,20 +658,32 @@ def _serve_http(args, cb, t0: float) -> int:
 
     from kubegpu_tpu.gateway.dataplane import ReplicaServer
 
+    if bool(args.serve_http_tls_cert) != bool(args.serve_http_tls_key):
+        raise SystemExit(
+            "--serve-http-tls-cert and --serve-http-tls-key must be "
+            "given together"
+        )
     # pay the program compiles BEFORE advertising the port: the first
     # real request must meet a warm batcher, not a compile wall
     cb.submit(0, np.asarray([1, 2, 3], np.int32), 2)
     while cb.has_work():
         cb.serve_step()
+    auth_token = None
+    if args.serve_http_auth_token_file:
+        with open(args.serve_http_auth_token_file) as f:
+            auth_token = f.read().strip()
     server = ReplicaServer(
         cb, listen=("0.0.0.0", args.serve_http),
         step_delay_s=args.serve_http_step_delay,
         fail_migration=args.serve_http_fail_migration,
+        tls_cert=args.serve_http_tls_cert,
+        tls_key=args.serve_http_tls_key,
+        auth_token=auth_token,
     )
     server.start()
     print(
         f"REPLICA_HTTP_SERVING port={server.port} serving={args.serving} "
-        f"seconds={time.monotonic() - t0:.2f}",
+        f"tls={int(server.tls)} seconds={time.monotonic() - t0:.2f}",
         flush=True,
     )
     shutdown = threading.Event()
@@ -895,6 +907,21 @@ def main(argv=None) -> int:
                     "knob for the kill-mid-migration soak schedules: an "
                     "importer that refuses must leave both pools "
                     "byte-identical — the gateway retries cold)")
+    ap.add_argument("--serve-http-tls-cert", default=None, metavar="PEM",
+                    help="--serve-http: serve the replica endpoint over "
+                    "HTTPS with this certificate (pair with "
+                    "--serve-http-tls-key; the gateway points "
+                    "--replica-tls-ca at the CA bundle).  Omit both for "
+                    "plain HTTP (loopback / single-tenant)")
+    ap.add_argument("--serve-http-tls-key", default=None, metavar="PEM",
+                    help="PEM private key for --serve-http-tls-cert")
+    ap.add_argument("--serve-http-auth-token-file", default=None,
+                    metavar="FILE",
+                    help="--serve-http: require 'Authorization: Bearer "
+                    "<token>' (file contents) on every /v1/* verb — "
+                    "submit/cancel/export/import/state move KV bytes "
+                    "and cancel sequences; /healthz and /metrics stay "
+                    "open for probes and scrapes")
     ap.add_argument("--prompt-len", type=int, default=32,
                     help="decode: prompt tokens per request (prompt-len + "
                     "--steps must fit --seq + 1, the lm family's cache size)")

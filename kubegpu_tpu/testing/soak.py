@@ -430,15 +430,15 @@ class GatewaySoak:
     ``assert_page_accounting`` is checked at quiescence — the kill/
     revive/hedge-cancel schedule must never leak KV pool pages.
 
-    ``multiturn=True`` adds the session-KV-reuse op: a completed
-    sessionful request spawns a TURN-2 request on the same session whose
-    prompt extends turn 1's prompt with its generated tokens plus new
-    text (capped at ``follow_prompt_cap`` so it stays inside the replica
-    batchers' prompt_pad) — exactly the traffic decode-page caching
-    serves from sealed pages.  With kills/hedge-cancels interleaved,
-    this is the schedule that hunts decode-page refcount leaks: a
-    session cancelled mid-turn must release every sealed page it
-    registered or acquired.
+    ``multiturn=True`` weights the workload mix toward chatty AGENT
+    sessions: follow turns extend a completed turn's prompt with its
+    generated tokens plus new text — exactly the traffic decode-page
+    caching serves from sealed pages.  With kills/hedge-cancels
+    interleaved, this is the schedule that hunts decode-page refcount
+    leaks: a session cancelled mid-turn must release every sealed page
+    it registered or acquired.  ``follow_prompt_cap`` bounds EVERY
+    workload prompt (follow turns included) — set it to the replica
+    batchers' prompt_pad.
 
     ``http=True`` swaps the data plane for the REAL wire: each replica
     is a ``ReplicaServer`` on a loopback socket (its own serving thread
@@ -461,17 +461,40 @@ class GatewaySoak:
     knob).  Whatever the schedule did, I5 must hold — a migration may
     cost retries, never requests — and with paged batchers the
     page-accounting invariant must balance on BOTH ends of every
-    transfer at quiescence."""
+    transfer at quiescence.
+
+    ``gateways > 1`` is the TIER chaos lane (ISSUE 12): N Gateway
+    instances over the same registry/client/session-store
+    (``GatewayTier``), routing sessions by consistent hashing, with new
+    ops — gateway kill/revive, hedged GREEDY streams through the
+    ``StreamRelay``, and mid-stream gateway failover (kill the home
+    gateway while its stream runs, retry on a sibling with the resume
+    watermark).  I5 extends tier-wide: at quiescence every request's
+    FINAL handle (after the documented client retry against siblings)
+    is ok or rejected, every streaming caller's relay delivered each
+    token index at most once and — for ok results — exactly the result
+    stream, and page accounting holds on every replica whatever the
+    combined gateway+replica kill schedule did.
+
+    Traffic comes from the shared ``testing/workload`` harness in every
+    lane: the bursty-diurnal arrival process paced by a virtual clock,
+    chatty agent sessions (follow turns materialized from parents'
+    results), long-context RAG prompts and best-of-n fan-out — the same
+    scenario matrix bench.py drives, instead of ad-hoc soak knobs."""
 
     def __init__(self, seed: int, n_replicas: int = 4,
                  batcher_factory=None, multiturn: bool = False,
                  follow_prompt_cap: int = 12, http: bool = False,
-                 migration: bool = False):
+                 migration: bool = False, gateways: int = 1):
         from kubegpu_tpu.gateway import (
-            AdmissionQueue, FailoverPolicy, Gateway, HttpReplicaClient,
-            InMemoryReplicaClient, ReplicaServer, SimBatcher,
+            AdmissionQueue, FailoverPolicy, Gateway, GatewayTier,
+            HttpReplicaClient, InMemoryReplicaClient, ReplicaServer,
+            SimBatcher,
         )
         from kubegpu_tpu.testing.fake_serving import build_fake_serving_stack
+        from kubegpu_tpu.testing.workload import (
+            WorkloadGenerator, WorkloadStream,
+        )
 
         self.rng = random.Random(seed)
         stack = build_fake_serving_stack(
@@ -505,28 +528,62 @@ class GatewaySoak:
         # requests — that is exactly what I5 holds the gateway to.  The
         # tracer ring is sized past any soak's request count so the
         # trace oracle judges EVERY request, not a sample.
-        self.gw = Gateway(
-            self.registry, self.client,
-            queue=AdmissionQueue(capacity=64),
-            policy=FailoverPolicy(
-                deadline_s=60.0, hedge_after_s=0.02, max_attempts=8,
-                retry_budget_ratio=1.0, budget_floor=1000,
-            ),
-            metrics=self.metrics, dispatchers=8,
-            tracer=Tracer(max_traces=65536),
+        policy = FailoverPolicy(
+            deadline_s=60.0, hedge_after_s=0.02, max_attempts=8,
+            retry_budget_ratio=1.0, budget_floor=1000,
         )
-        self.registry.refresh()
-        self.gw.start()
+        self.gateways_n = gateways
+        self._tracers = []   # every tracer ever built (corpses included)
+
+        def _tracer(_gid=""):
+            t = Tracer(max_traces=65536)
+            self._tracers.append(t)
+            return t
+
+        if gateways > 1:
+            self.tier = GatewayTier(
+                self.registry, self.client, n_gateways=gateways,
+                policy=policy, metrics=self.metrics, dispatchers=8,
+                queue_factory=lambda: AdmissionQueue(capacity=64),
+                tracer_factory=_tracer,
+            )
+            self.gw = None
+            self.registry.refresh()
+            self.tier.start()
+        else:
+            self.tier = None
+            self.gw = Gateway(
+                self.registry, self.client,
+                queue=AdmissionQueue(capacity=64),
+                policy=policy,
+                metrics=self.metrics, dispatchers=8,
+                tracer=_tracer(),
+            )
+            self.registry.refresh()
+            self.gw.start()
         self.n = 0
         self.n_replicas = n_replicas
-        self.pendings = {}   # request_id -> PendingRequest
+        self.pendings = {}   # request_id -> PendingRequest (latest handle)
         self.dead = set()    # replica keys currently killed
+        self.dead_gateways = set()
         self.ops = []
         self.multiturn = multiturn
         self.migration = migration
         self.follow_prompt_cap = follow_prompt_cap
-        self._session_prompts = {}  # request_id -> (session, prompt)
-        self._followed = set()      # request_ids already extended
+        # the shared workload harness: agent weight doubles in multiturn
+        # lanes so kills land while sealed decode pages are referenced
+        mix = {"burst": 5, "agent": 6 if multiturn else 2,
+               "rag": 1, "bestofn": 1}
+        gen = WorkloadGenerator(
+            seed=seed * 7 + 1, vocab=61, prompt_cap=follow_prompt_cap,
+            sessions=6, tenants=3, mix=mix, id_prefix="r",
+        )
+        self.workload = WorkloadStream(
+            gen.generate(4096), prompt_cap=follow_prompt_cap
+        )
+        self._wl_clock = 0.0
+        self._requests = {}  # request_id -> last-submitted GatewayRequest
+        self._streams = {}   # request_id -> StreamRelay (streaming ops)
 
     # -- http-lane plumbing ------------------------------------------------
     def _start_server(self, key: str) -> None:
@@ -544,68 +601,75 @@ class GatewaySoak:
         self.servers[key] = srv
         self.client.set_endpoint(key, srv.endpoint)
 
+    # -- shared front (single gateway or tier) ------------------------------
+    def _alive_gateways(self):
+        if self.tier is None:
+            return [self.gw]
+        return [
+            self.tier.gateways[gid] for gid in self.tier.alive_ids()
+        ]
+
+    def _front(self):
+        """Something with drain_replica/drain/results — the single
+        gateway, or the tier."""
+        return self.gw if self.tier is None else self.tier
+
+    def _results_view(self):
+        """Terminal results per request id, from the HANDLES — a killed
+        gateway's result table dies with it, the caller's handle does
+        not (the tier contract)."""
+        out = {}
+        for rid, p in self.pendings.items():
+            r = p.result()
+            if r is not None:
+                out[rid] = r
+        return out
+
+    def _submit(self, request):
+        from kubegpu_tpu.gateway import GatewayRequest  # noqa: F401
+
+        self._requests[request.request_id] = request
+        if self.tier is None:
+            p = self.gw.submit(request)
+        else:
+            _, p = self.tier.submit(request)
+        self.pendings[request.request_id] = p
+        return p
+
     # -- ops ---------------------------------------------------------------
     def op_burst(self):
+        """Drain the workload stream's next arrivals (the bursty-diurnal
+        process under a virtual clock): one-shot bursts, RAG
+        long-prompts, best-of-n twins, and agent FOLLOW turns whose
+        prompts materialize from their parents' results — the sealed-
+        decode-page traffic, when the replica batchers cache it."""
         from kubegpu_tpu.gateway import GatewayRequest
 
+        self._wl_clock += self.rng.choice([0.02, 0.05, 0.1, 0.3])
         k = self.rng.randint(4, 16)
-        accepted = 0
-        for _ in range(k):
-            rid = f"r{self.n}"
-            self.n += 1
-            session = (f"s{self.rng.randrange(6)}"
-                       if self.rng.random() < 0.4 else None)
-            prompt = [1, 2, 3]
-            p = self.gw.submit(GatewayRequest(
-                prompt=prompt,
-                max_new_tokens=self.rng.choice([0, 2, 5, 8, 12]),
-                request_id=rid,
-                tenant=f"t{self.rng.randrange(3)}",
-                session=session,
-            ))
-            self.pendings[rid] = p
-            if self.multiturn and session is not None:
-                self._session_prompts[rid] = (session, prompt)
-            accepted += 1
-        return f"burst x{k} (total {self.n})"
-
-    def op_multiturn(self):
-        """Session turn 2: extend a COMPLETED sessionful request's prompt
-        with its own generated tokens plus fresh text, on the same
-        session id.  With decode-page caching on, the replica that served
-        turn 1 serves this from sealed pages; with kills interleaved, the
-        cancel/retry path must balance their refcounts."""
-        from kubegpu_tpu.gateway import GatewayRequest
-
-        if not self.multiturn:
-            return "multiturn (noop: disabled)"
-        results = self.gw.results()
-        ready = [
-            rid for rid in self._session_prompts
-            if rid not in self._followed
-            and rid in results and results[rid].status == "ok"
-        ]
+        ready = self.workload.next_ready(
+            k, self._results_view(), now=self._wl_clock
+        )
         if not ready:
-            return "multiturn (noop: no completed session turn)"
-        rid = self.rng.choice(sorted(ready))
-        self._followed.add(rid)
-        session, prompt = self._session_prompts[rid]
-        salt = self.rng.randrange(4, 61)
-        follow = (list(prompt) + list(results[rid].tokens))[
-            : self.follow_prompt_cap - 1
-        ] + [salt]
-        rid2 = f"r{self.n}"
-        self.n += 1
-        p = self.gw.submit(GatewayRequest(
-            prompt=follow,
-            max_new_tokens=self.rng.choice([2, 5]),
-            request_id=rid2,
-            tenant=f"t{self.rng.randrange(3)}",
-            session=session,
-        ))
-        self.pendings[rid2] = p
-        self._session_prompts[rid2] = (session, follow)
-        return f"multiturn {rid}->{rid2} ({session}, plen {len(follow)})"
+            # the virtual clock lags the arrival process: jump to the
+            # next arrival instead of starving the soak of traffic
+            self._wl_clock += 1.0
+            ready = self.workload.next_ready(k, self._results_view())
+        follows = 0
+        for item, prompt in ready:
+            self.n += 1
+            follows += int(item.follow_of is not None)
+            self._submit(GatewayRequest(
+                prompt=prompt,
+                max_new_tokens=item.max_new_tokens,
+                request_id=item.request_id,
+                tenant=item.tenant,
+                session=item.session,
+            ))
+        return (
+            f"burst x{len(ready)} ({follows} follow turns, "
+            f"clock {self._wl_clock:.2f}s, total {self.n})"
+        )
 
     def _live_keys(self):
         return [r.key for r in self.registry.live()]
@@ -685,7 +749,7 @@ class GatewaySoak:
         if len(live) < 2:
             return "drain (noop: must keep one replica)"
         key = self.rng.choice(live)
-        stats = self.gw.drain_replica(key)
+        stats = self._front().drain_replica(key)
         self._kill_replica(key)
         return (
             f"drain+release {key} migrated={stats['migrated']} "
@@ -825,14 +889,152 @@ class GatewaySoak:
         time.sleep(self.rng.choice([0.005, 0.02, 0.05]))
         return "settle"
 
+    # -- gateway-tier ops (gateways > 1) ------------------------------------
+    def _retryable(self, result) -> bool:
+        """Did this request die WITH its gateway (retry on a sibling)?
+        Covers both race outcomes of a kill: the kill's own 'gateway
+        died' record, and the dispatcher's abort-path record when it
+        won the race (the soak never disconnects a caller itself, so
+        that error here always means the gateway was killed)."""
+        from kubegpu_tpu.gateway import is_gateway_death
+
+        return result is not None and result.status == "error" and (
+            is_gateway_death(result)
+            or "caller disconnected" in result.error
+        )
+
+    def _retry_on_sibling(self, rid: str) -> bool:
+        """The tier-client contract, one round: clone the request (fresh
+        abort event; the streaming relay and its watermark carry over)
+        and re-submit through the tier.  The replica-side duplicate-id
+        eviction keeps at most one live stream for the id."""
+        from kubegpu_tpu.gateway import GatewayTier
+
+        request = self._requests.get(rid)
+        if request is None or not self.tier.alive_ids():
+            return False
+        clone = GatewayTier._clone(request)
+        self.metrics.inc("gateway_tier_retries_total")
+        self._submit(clone)
+        return True
+
+    def op_kill_gateway(self):
+        """A gateway process dies abruptly mid-whatever: its in-flight
+        attempts cancel wire-level, its pendings resolve with the
+        retryable death error, the survivors absorb its keyspace."""
+        if self.tier is None:
+            return "kill-gateway (noop: single gateway)"
+        alive = self.tier.alive_ids()
+        if len(alive) < 2:
+            return "kill-gateway (noop: must keep one gateway)"
+        gid = self.rng.choice(alive)
+        self.tier.kill(gid)
+        self.dead_gateways.add(gid)
+        return f"kill-gateway {gid}"
+
+    def op_revive_gateway(self):
+        if self.tier is None or not self.dead_gateways:
+            return "revive-gateway (noop)"
+        gid = self.rng.choice(sorted(self.dead_gateways))
+        self.tier.revive(gid)
+        self.dead_gateways.discard(gid)
+        return f"revive-gateway {gid}"
+
+    def op_stream(self):
+        """A hedged GREEDY stream through the tier: the StreamRelay
+        dedups twin deltas by token index, and at quiescence the relay
+        must have delivered exactly the result stream — each token
+        once, no matter which attempts (primary, hedge, sibling-retry
+        continuation) supplied them."""
+        from kubegpu_tpu.gateway import GatewayRequest, StreamRelay
+
+        if self.tier is None:
+            return "stream (noop: single gateway)"
+        ready = self.workload.next_ready(1, self._results_view())
+        if not ready:
+            return "stream (noop: no ready workload item)"
+        item, prompt = ready[0]
+        # streaming a zero-budget item proves nothing; give it tokens
+        budget = max(item.max_new_tokens, 3)
+        relay = StreamRelay(self.metrics, dedup=True)
+        request = GatewayRequest(
+            prompt=prompt, max_new_tokens=budget,
+            request_id=item.request_id, tenant=item.tenant,
+            session=item.session,
+        )
+        request.on_tokens = relay.on_tokens
+        request.stream_watermark = relay.emitted
+        request.no_hedge = False
+        self.n += 1
+        self._streams[item.request_id] = relay
+        self._submit(request)
+        return f"stream {item.request_id} (budget {budget})"
+
+    def op_stream_failover(self):
+        """The acceptance schedule: a stream's HOME gateway dies while
+        tokens are flowing; the client retries on a sibling with the
+        relay's resume watermark — the combined stream must be the full
+        result exactly once (checked at quiescence like every stream)."""
+        import threading as _threading
+        import time as _time
+
+        from kubegpu_tpu.gateway import GatewayRequest, StreamRelay
+
+        if self.tier is None:
+            return "stream-failover (noop: single gateway)"
+        if len(self.tier.alive_ids()) < 2:
+            return "stream-failover (noop: must keep one gateway)"
+        ready = self.workload.next_ready(1, self._results_view())
+        if not ready:
+            return "stream-failover (noop: no ready workload item)"
+        item, prompt = ready[0]
+        budget = max(item.max_new_tokens, 8)
+        relay = StreamRelay(self.metrics, dedup=True)
+        request = GatewayRequest(
+            prompt=prompt, max_new_tokens=budget,
+            request_id=item.request_id, tenant=item.tenant,
+            session=item.session,
+        )
+        request.on_tokens = relay.on_tokens
+        request.stream_watermark = relay.emitted
+        request.no_hedge = False
+        self.n += 1
+        self._streams[item.request_id] = relay
+        gid = self.tier.gateway_for(request)
+        request.abort = _threading.Event()
+        pending = self.tier.gateways[gid].submit(request)
+        self._requests[item.request_id] = request
+        self.pendings[item.request_id] = pending
+        # let tokens flow (bounded — a straggling replica may stall the
+        # stream, in which case the kill lands pre-first-token, which
+        # is chaos too)
+        deadline = _time.monotonic() + 0.5
+        while relay.emitted() == 0 and _time.monotonic() < deadline:
+            if pending.wait(0.002):
+                break
+            _time.sleep(0.002)
+        self.tier.kill(gid)
+        self.dead_gateways.add(gid)
+        # the dead gateway resolves the handle with the retryable error;
+        # retry through a sibling NOW (mid-stream failover, not a
+        # quiescence-time cleanup)
+        if pending.wait(10.0) and self._retryable(pending.result()):
+            self._retry_on_sibling(item.request_id)
+        return (
+            f"stream-failover {item.request_id} (killed {gid} at "
+            f"{relay.emitted()} tokens)"
+        )
+
     # -- invariant ---------------------------------------------------------
     def check(self, trace: str):
-        """I5 at quiescence (call after quiesce())."""
-        results = self.gw.results()
+        """I5 at quiescence (call after quiesce()).  In the tier lane
+        the judged result per request is its FINAL handle — the one the
+        documented sibling-retry client contract leaves the caller
+        holding — and streaming callers' relays must have delivered
+        exactly the result stream."""
+        results = self._results_view()
         missing = set(self.pendings) - set(results)
         assert not missing, f"I5 silently dropped: {sorted(missing)}\n{trace}"
-        extra = set(results) - set(self.pendings)
-        assert not extra, f"I5 phantom results: {sorted(extra)}\n{trace}"
         for rid, pending in self.pendings.items():
             assert pending.wait(0), f"I5 {rid} handle never resolved\n{trace}"
             r = results[rid]
@@ -844,14 +1046,36 @@ class GatewaySoak:
                 assert self.client.decodes.get(rid, 0) >= 1, (
                     f"I5 {rid} reported ok but no decode delivered\n{trace}"
                 )
-        # never duplicated by a hedge: the exactly-once recorder saw no
-        # second terminal result for any request
-        dups = self.metrics.get("gateway_duplicate_results_total")
-        assert dups == 0, f"I5 duplicate deliveries: {dups}\n{trace}"
-        assert self.gw.queue.depth() == 0 and self.gw.in_flight() == 0, (
-            f"I5 not quiescent: depth={self.gw.queue.depth()} "
-            f"in_flight={self.gw.in_flight()}\n{trace}"
-        )
+        # streaming exactly-once, tier-wide: whatever mix of primary,
+        # hedge twin and sibling-retry attempts fed a relay, an ok
+        # stream's caller got EXACTLY the authoritative token list —
+        # nothing doubled, nothing gapped
+        for rid, relay in self._streams.items():
+            r = results.get(rid)
+            if r is None or r.status != "ok":
+                continue
+            delivered = relay.drain()
+            assert delivered == list(r.tokens), (
+                f"I5/stream {rid}: delivered {len(delivered)} tokens != "
+                f"result {len(r.tokens)} (dup or gap across "
+                f"hedge/failover)\n{trace}"
+            )
+        if self.tier is None:
+            # never duplicated by a hedge: the exactly-once recorder saw
+            # no second terminal result for any request.  (In the tier
+            # lane a kill RACES the dispatcher's own terminal for the
+            # same request — the loser is counted and dropped by design,
+            # so the counter is legitimately nonzero there.)
+            dups = self.metrics.get("gateway_duplicate_results_total")
+            assert dups == 0, f"I5 duplicate deliveries: {dups}\n{trace}"
+            extra = set(self.gw.results()) - set(self.pendings)
+            assert not extra, f"I5 phantom results: {sorted(extra)}\n{trace}"
+        for gw in self._alive_gateways():
+            assert gw.queue.depth() == 0 and gw.in_flight() == 0, (
+                f"I5 not quiescent ({gw.gateway_id or 'gw'}): "
+                f"depth={gw.queue.depth()} "
+                f"in_flight={gw.in_flight()}\n{trace}"
+            )
         # page-accounting invariant: at quiescence every surviving
         # replica's KV pool must balance — no page leaked by a kill,
         # cancel, or hedge loser anywhere in the schedule (duck-typed:
@@ -886,38 +1110,42 @@ class GatewaySoak:
         self.check_traces(trace)
 
     def check_traces(self, trace: str):
-        """I5 re-derived from spans: every request yields exactly one
-        COMPLETE, properly-nested span tree — zero orphans, zero
-        unclosed spans, exactly one retire per serve subtree — across
-        whatever kill/revive/hedge/cancel schedule just ran."""
+        """I5 re-derived from spans: every request yields COMPLETE,
+        properly-nested span trees — zero orphans, zero unclosed spans,
+        exactly one retire per serve subtree — across whatever
+        kill/revive/hedge/cancel schedule just ran.  Tier lane: EVERY
+        tracer ever built is judged, killed gateways' included (a crash
+        aborts requests, it must not leak half-open trees), and a
+        request may own one tree PER GATEWAY that carried it (the
+        sibling retry roots its own) — so coverage is 'every request
+        has at least one tree', not exact set equality."""
         from kubegpu_tpu.utils.tracing import (
             serve_retire_violations, validate_trace,
         )
 
-        tracer = self.gw.tracer
-        if tracer is None:
+        tracers = [t for t in self._tracers if t is not None]
+        if not tracers:
             return
-        # hedge-loser cancels drain asynchronously after the winner's
-        # result; give them their bounded moment before judging
-        assert tracer.wait_quiescent(10.0), (
-            f"I5/traces: {tracer.open_count()} traces still open after "
-            f"quiescence — spans leaked\n{trace}"
-        )
-        completed = tracer.completed()
-        problems = []
         seen_ids = set()
-        for spans in completed:
-            problems += validate_trace(spans)
-            problems += serve_retire_violations(spans)
-            root = next(s for s in spans if s["parent"] is None)
-            seen_ids.add(root["attrs"].get("request_id"))
+        problems = []
+        for tracer in tracers:
+            # hedge-loser cancels drain asynchronously after the
+            # winner's result; give them their bounded moment
+            assert tracer.wait_quiescent(10.0), (
+                f"I5/traces: {tracer.open_count()} traces still open "
+                f"after quiescence — spans leaked\n{trace}"
+            )
+            for spans in tracer.completed():
+                problems += validate_trace(spans)
+                problems += serve_retire_violations(spans)
+                root = next(s for s in spans if s["parent"] is None)
+                seen_ids.add(root["attrs"].get("request_id"))
         assert not problems, (
             "I5/traces: structural violations:\n"
             + "\n".join(problems[:20]) + f"\n{trace}"
         )
-        if tracer.evicted == 0:
-            # the ring retained everything: the tree set must cover the
-            # request set exactly — one tree per request, no phantoms
+        if all(t.evicted == 0 for t in tracers):
+            # the rings retained everything: every request has a tree
             missing = set(self.pendings) - seen_ids
             phantom = seen_ids - set(self.pendings)
             assert not missing, (
@@ -930,26 +1158,47 @@ class GatewaySoak:
             )
 
     def quiesce(self, timeout: float = 120.0):
-        """Restore all hardware, then wait out the in-flight work."""
+        """Restore all hardware (replicas AND gateways), drain, then —
+        tier lane — run the client retry contract to a fixed point:
+        every request whose gateway died under it is re-submitted
+        through a surviving sibling until its final handle is a real
+        terminal (ok / rejected / genuine failure)."""
         while self.dead:
             self.op_revive_replica()
+        while self.dead_gateways:
+            self.op_revive_gateway()
         for a in self.advs.values():
             a.advertise_once()
         self.registry.refresh()
-        assert self.gw.drain(timeout), "gateway failed to drain"
+        assert self._front().drain(timeout), "gateway failed to drain"
+        if self.tier is None:
+            return
+        for _ in range(10):
+            dead_rids = [
+                rid for rid, p in self.pendings.items()
+                if p.wait(0) and self._retryable(p.result())
+            ]
+            if not dead_rids:
+                return
+            for rid in dead_rids:
+                assert self._retry_on_sibling(rid), (
+                    f"could not retry {rid}: no alive gateway"
+                )
+            assert self._front().drain(timeout), (
+                "tier failed to drain retried requests"
+            )
+        raise AssertionError(
+            "tier retries did not settle in 10 rounds"
+        )
 
     def run(self, steps: int):
         ops = [
-            (self.op_burst, 5),
+            (self.op_burst, 5 + (4 if self.multiturn else 0)),
             (self.op_kill_replica, 1),
             (self.op_revive_replica, 1),
             (self.op_straggle, 2),
             (self.op_settle, 3),
         ]
-        if self.multiturn:
-            # weighted like the burst: turn 2s should be common enough
-            # that kills land while sealed decode pages are referenced
-            ops.append((self.op_multiturn, 4))
         if self.http:
             # mid-stream client disconnects belong in the chaos mix: the
             # replica's disconnect⇒cancel path must hold page accounting
@@ -966,6 +1215,16 @@ class GatewaySoak:
                 (self.op_kill_mid_migration, 1),
                 (self.op_refuse_migration, 1),
             ]
+        if self.tier is not None:
+            # the tier chaos lane: gateway deaths, hedged greedy
+            # streams, and mid-stream gateway failovers — I5 holds
+            # TIER-wide, streams deliver each token exactly once
+            ops += [
+                (self.op_kill_gateway, 1),
+                (self.op_revive_gateway, 1),
+                (self.op_stream, 3),
+                (self.op_stream_failover, 1),
+            ]
         bag = [f for f, w in ops for _ in range(w)]
         try:
             for _ in range(steps):
@@ -973,7 +1232,10 @@ class GatewaySoak:
             self.quiesce()
             self.check("\n".join(self.ops[-40:]))
         finally:
-            self.gw.stop()
+            if self.tier is not None:
+                self.tier.stop()
+            else:
+                self.gw.stop()
             self.client.stop()
             for srv in self.servers.values():
                 srv.stop()

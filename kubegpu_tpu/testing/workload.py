@@ -1,0 +1,277 @@
+"""Shared workload generator/replay: the scenario matrix as a test surface.
+
+Every serving harness so far rolled its own traffic knobs — the soak
+had ad-hoc burst/multiturn ops, each bench invented its own prompt
+mix.  The north star asks for a SCENARIO-diverse load story, and the
+gateway tier is judged under it: this module is the one place traffic
+shapes are defined, consumed by BOTH ``GatewaySoak`` and ``bench.py``
+so chaos testing and performance gating drive the same workloads.
+
+Scenarios (the mix is a weight dict, all seeded-deterministic):
+
+- ``burst``   — independent one-shot requests, short prompts, the
+  bread-and-butter API call; sometimes sessionful (affinity traffic).
+- ``agent``   — chatty multi-turn sessions: a short opening turn, then
+  1..3 FOLLOW turns whose prompt is the running conversation (parent
+  prompt + parent output + fresh tokens, capped) — exactly the traffic
+  session KV reuse and consistent-hash affinity serve.
+- ``rag``     — long-context one-shots: prompt at the cap (the
+  "retrieved documents" shape), short generation; stresses prefill and
+  the token-budget station.
+- ``bestofn`` — fan-out: n twins of one prompt under one fanout group,
+  distinct request ids, arriving together; stresses admission fairness
+  and (greedy) produces n identical streams — dedup-friendly traffic.
+
+Arrivals are a BURSTY DIURNAL process: a sinusoidal base intensity over
+the configured duration (the day squeezed into seconds), thinned
+per-item, with occasional clustered bursts on top.  Harnesses that
+measure saturation throughput ignore the offsets (arrival
+compression); the soak advances a virtual clock so kills land inside
+the diurnal peaks and troughs alike.
+
+``WorkloadStream`` is the consumption half: step-driven, dependency-
+aware.  ``next_ready(k, results)`` hands out up to ``k`` items whose
+dependencies are met — a follow turn materializes its prompt from the
+parent's RESULT (so it cannot be handed out before the parent
+completed), best-of-n twins come out together — and remembers what it
+handed out so a later follow can chain.  Both the soak's ops and the
+bench's waves drain the same stream.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_MIX = {"burst": 5, "agent": 3, "rag": 1, "bestofn": 1}
+
+
+@dataclass
+class WorkloadItem:
+    offset_s: float                 # arrival offset from replay start
+    request_id: str
+    tenant: str
+    session: Optional[str]
+    prompt: List[int]               # [] for follow turns (materialized)
+    max_new_tokens: int
+    scenario: str                   # burst | agent | rag | bestofn
+    follow_of: Optional[str] = None  # parent request_id (agent turns)
+    salt: List[int] = field(default_factory=list)  # the turn's new text
+    fanout_of: Optional[str] = None  # best-of-n group id
+    temperature: float = 0.0
+
+
+def materialize_follow(parent_prompt: List[int], parent_tokens: List[int],
+                       salt: List[int], prompt_cap: int) -> List[int]:
+    """A follow turn's prompt: the conversation so far plus the new
+    text, capped from the FRONT of the history so the salt (the part
+    that makes the turn a new request) always survives the cap."""
+    history = list(parent_prompt) + [int(t) for t in parent_tokens]
+    keep = max(prompt_cap - len(salt), 1)
+    return history[:keep] + list(salt)
+
+
+class WorkloadGenerator:
+    """Seeded scenario-mix generator.  ``prompt_cap`` bounds every
+    prompt (follow turns included) — harnesses set it to their replica
+    batchers' prompt budget.  Items come out in arrival order."""
+
+    def __init__(self, seed: int, vocab: int = 61, prompt_cap: int = 12,
+                 tenants: int = 3, sessions: int = 8,
+                 duration_s: float = 2.0, base_rate: float = 40.0,
+                 mix: Optional[Dict[str, int]] = None,
+                 id_prefix: str = "w") -> None:
+        if prompt_cap < 4:
+            raise ValueError(f"prompt_cap ({prompt_cap}) must be >= 4")
+        self.rng = random.Random(seed)
+        self.vocab = vocab
+        self.prompt_cap = prompt_cap
+        self.tenants = tenants
+        self.sessions = sessions
+        self.duration_s = duration_s
+        self.base_rate = base_rate
+        self.mix = dict(mix or DEFAULT_MIX)
+        unknown = set(self.mix) - {"burst", "agent", "rag", "bestofn"}
+        if unknown:
+            raise ValueError(f"unknown scenarios in mix: {sorted(unknown)}")
+        self.id_prefix = id_prefix
+        self._n = 0
+        self._clock = 0.0
+
+    # -- arrivals ----------------------------------------------------------
+    def _intensity(self, t: float) -> float:
+        """Diurnal intensity: one full day-cycle over duration_s, floor
+        at 20% of base so the trough still trickles."""
+        phase = 2.0 * math.pi * (t % self.duration_s) / self.duration_s
+        return self.base_rate * max(0.2, 0.5 * (1.0 + math.sin(phase)))
+
+    def _next_offset(self) -> float:
+        """Thinned Poisson draw against the diurnal intensity, with a
+        20% chance of a clustered burst (near-zero gap) — the 'everyone
+        hits refresh at 9am' shape."""
+        if self.rng.random() < 0.2:
+            self._clock += self.rng.random() * 0.002
+            return self._clock
+        while True:
+            self._clock += self.rng.expovariate(self.base_rate)
+            if (self.rng.random() * self.base_rate
+                    <= self._intensity(self._clock)):
+                return self._clock
+
+    # -- items -------------------------------------------------------------
+    def _rid(self) -> str:
+        self._n += 1
+        return f"{self.id_prefix}{self._n - 1}"
+
+    def _tokens(self, n: int) -> List[int]:
+        return [self.rng.randrange(self.vocab) for _ in range(n)]
+
+    def _tenant(self) -> str:
+        return f"t{self.rng.randrange(self.tenants)}"
+
+    def generate(self, n_items: int) -> List[WorkloadItem]:
+        """The next ``n_items`` of the arrival process (callable
+        repeatedly — the clock and ids carry on)."""
+        bag = [s for s, w in self.mix.items() for _ in range(w)]
+        items: List[WorkloadItem] = []
+        while len(items) < n_items:
+            scenario = self.rng.choice(bag)
+            at = self._next_offset()
+            short_hi = max(2, self.prompt_cap // 2)
+            if scenario == "burst":
+                session = (f"s{self.rng.randrange(self.sessions)}"
+                           if self.rng.random() < 0.4 else None)
+                items.append(WorkloadItem(
+                    at, self._rid(), self._tenant(), session,
+                    self._tokens(self.rng.randint(2, short_hi)),
+                    self.rng.choice([2, 5, 8, 12]), "burst",
+                ))
+            elif scenario == "rag":
+                # long context in, little out: the retrieval shape
+                items.append(WorkloadItem(
+                    at, self._rid(), self._tenant(), None,
+                    self._tokens(self.prompt_cap),
+                    self.rng.choice([2, 3, 4]), "rag",
+                ))
+            elif scenario == "bestofn":
+                fan = self.rng.randint(2, 3)
+                group = self._rid()
+                prompt = self._tokens(self.rng.randint(2, short_hi))
+                budget = self.rng.choice([4, 6, 8])
+                tenant = self._tenant()
+                items.append(WorkloadItem(
+                    at, group, tenant, None, list(prompt), budget,
+                    "bestofn", fanout_of=group,
+                ))
+                for _ in range(fan - 1):
+                    items.append(WorkloadItem(
+                        at, self._rid(), tenant, None, list(prompt),
+                        budget, "bestofn", fanout_of=group,
+                    ))
+            else:  # agent: opening turn + chained follows
+                session = f"s{self.rng.randrange(self.sessions)}"
+                tenant = self._tenant()
+                rid = self._rid()
+                items.append(WorkloadItem(
+                    at, rid, tenant, session,
+                    self._tokens(self.rng.randint(2, min(4, short_hi + 1))),
+                    self.rng.choice([2, 4, 6]), "agent",
+                ))
+                parent = rid
+                for _ in range(self.rng.randint(1, 3)):
+                    at = self._next_offset()
+                    rid = self._rid()
+                    items.append(WorkloadItem(
+                        at, rid, tenant, session, [],
+                        self.rng.choice([2, 4, 6]), "agent",
+                        follow_of=parent,
+                        salt=self._tokens(self.rng.randint(
+                            1, max(1, min(3, self.prompt_cap - 1))
+                        )),
+                    ))
+                    parent = rid
+        items.sort(key=lambda it: (it.offset_s, it.request_id))
+        return items[:n_items] if len(items) > n_items else items
+
+
+class WorkloadStream:
+    """Dependency-aware, step-driven consumption of a generated item
+    list — the interface GatewaySoak's ops and bench waves share.
+
+    ``next_ready(k, results, now)`` returns up to ``k`` (item, prompt)
+    pairs: non-follow items materialize immediately; a follow turn
+    waits until ``results`` holds its parent's terminal (only an "ok"
+    parent chains — a rejected/failed turn ends its conversation, which
+    is what a real agent client would do).  ``now`` (optional virtual
+    clock) additionally gates items on their arrival offset.  Handed-
+    out prompts are remembered so grandchildren can chain."""
+
+    def __init__(self, items: List[WorkloadItem],
+                 prompt_cap: Optional[int] = None) -> None:
+        from collections import deque
+
+        self._queue = deque(items)
+        self._blocked: List[WorkloadItem] = []
+        self.prompt_cap = prompt_cap
+        self._prompts: Dict[str, List[int]] = {}   # rid -> handed prompt
+        self._dead_parents = 0
+
+    def exhausted(self) -> bool:
+        return not self._queue and not self._blocked
+
+    def pending_follows(self) -> int:
+        return len(self._blocked)
+
+    def _materialize(self, item: WorkloadItem,
+                     results) -> Optional[List[int]]:
+        if item.follow_of is None:
+            return list(item.prompt)
+        parent = results.get(item.follow_of) if results else None
+        if parent is None or getattr(parent, "status", "ok") != "ok":
+            return None
+        cap = self.prompt_cap or (
+            len(self._prompts.get(item.follow_of, [])) + len(item.salt) + 8
+        )
+        return materialize_follow(
+            self._prompts.get(item.follow_of, []),
+            list(getattr(parent, "tokens", [])),
+            item.salt, cap,
+        )
+
+    def next_ready(self, k: int, results=None,
+                   now: Optional[float] = None
+                   ) -> List[Tuple[WorkloadItem, List[int]]]:
+        out: List[Tuple[WorkloadItem, List[int]]] = []
+        # blocked follows first: their parents may have completed since
+        still_blocked: List[WorkloadItem] = []
+        for item in self._blocked:
+            if len(out) >= k:
+                still_blocked.append(item)
+                continue
+            prompt = self._materialize(item, results)
+            if prompt is None:
+                parent = (results or {}).get(item.follow_of)
+                if parent is not None and (
+                    getattr(parent, "status", "ok") != "ok"
+                ):
+                    # conversation over: drop the turn, count it
+                    self._dead_parents += 1
+                    continue
+                still_blocked.append(item)
+                continue
+            self._prompts[item.request_id] = prompt
+            out.append((item, prompt))
+        self._blocked = still_blocked
+        while self._queue and len(out) < k:
+            if now is not None and self._queue[0].offset_s > now:
+                break
+            item = self._queue.popleft()
+            prompt = self._materialize(item, results)
+            if prompt is None:
+                self._blocked.append(item)
+                continue
+            self._prompts[item.request_id] = prompt
+            out.append((item, prompt))
+        return out

@@ -109,6 +109,26 @@ CATALOG: Dict[str, MetricSpec] = {
         (), "streaming callers that vanished mid-stream; each one "
         "cancelled its in-flight attempts wire-level (replica pages "
         "freed)"),
+    "gateway_stream_hedges_total": _c(
+        (), "hedged dispatches issued for STREAMING (greedy) requests "
+        "— safe because the StreamRelay dedups twin streams by token "
+        "index; sampled streams never hedge"),
+    "gateway_stream_dedup_tokens_total": _c(
+        (), "tokens a streaming attempt delivered that the caller "
+        "already had (hedge twin / retry overlap) — dropped by the "
+        "relay's dedup watermark, never surfaced twice"),
+
+    # -- gateway tier (gateway/tier.py): the N-gateway scale-out layer
+    "gateway_tier_gateways": _g(
+        (), "gateways alive in this tier right now"),
+    "gateway_tier_deaths_total": _c(
+        (), "gateway instances killed (chaos or crash); their in-flight "
+        "attempts cancel wire-level and their pendings fail with the "
+        "retryable 'gateway died' error"),
+    "gateway_tier_retries_total": _c(
+        (), "tier-client retries of a request on a sibling gateway "
+        "after its home gateway died (same request_id; replica-side "
+        "duplicate-id eviction keeps at most one live stream)"),
 
     # -- replica HTTP serving endpoint (gateway/dataplane.py): the
     #    pod-side half of the distributed data plane
@@ -137,6 +157,11 @@ CATALOG: Dict[str, MetricSpec] = {
     "replica_migrate_wire_bytes_total": _c(
         ("dir",), "encoded transfer payload bytes through the "
         "migration verbs by direction"),
+    "replica_stream_fastforward_tokens_total": _c(
+        (), "tokens a submit's resume watermark told this replica NOT "
+        "to emit (the caller already has them — hedge twins and "
+        "gateway-failover resumes decode them but fast-forward "
+        "emission)"),
 
     # -- serving data plane (models/serving.py, models/paging.py)
     "serve_ttft_seconds": _h((), "submit -> first generated token"),

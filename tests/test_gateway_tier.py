@@ -1,0 +1,584 @@
+"""Gateway tier (ISSUE 12): N-gateway scale-out without a SPOF.
+
+Layers under test:
+
+1. the consistent-hash ring — stability and bounded key movement under
+   join/leave (the property that makes membership churn cheap);
+2. ``ConsistentHashRouter`` — two gateway instances route every session
+   identically with zero shared state, and mispinned sessions restore
+   their KV instead of cold-prefilling;
+3. ``StreamRelay`` — token-prefix dedup: each token index delivered
+   exactly once whichever attempt (primary, hedge twin, sibling-retry
+   continuation) supplies it;
+4. ``GatewayTier`` — a gateway crash mid-stream is survivable: the
+   client retries on a sibling, the stream resumes at the watermark,
+   nothing is lost or double-served, and the span trees all close;
+5. the shared workload harness — deterministic scenario mix, follow
+   turns materialized from parents' results;
+6. GatewaySoak's multi-gateway chaos lane, in-memory and HTTP.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubegpu_tpu.gateway import (
+    ConsistentHashRing,
+    ConsistentHashRouter,
+    FailoverPolicy,
+    GatewayRequest,
+    GatewayTier,
+    InMemoryReplicaClient,
+    SessionKVStore,
+    SimBatcher,
+    StreamRelay,
+)
+from kubegpu_tpu.testing.fake_serving import build_fake_serving_stack
+from kubegpu_tpu.utils.metrics import Metrics
+
+
+def _wait(predicate, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# 1. consistent-hash ring properties
+# ---------------------------------------------------------------------------
+
+def test_ring_stability_and_bounded_movement():
+    """The failover story in two properties: removing a node moves ONLY
+    the keys it owned; adding a node steals a bounded fraction and
+    nothing else moves anywhere but onto the new node."""
+    nodes = [f"n{i}" for i in range(5)]
+    ring = ConsistentHashRing(nodes)
+    keys = [f"session-{i}" for i in range(1000)]
+    before = {k: ring.lookup(k) for k in keys}
+    owned = [k for k, n in before.items() if n == "n2"]
+    assert owned, "n2 owns nothing — vnode spread is broken"
+
+    ring.rebuild([n for n in nodes if n != "n2"])
+    after_leave = {k: ring.lookup(k) for k in keys}
+    moved = [k for k in keys if before[k] != after_leave[k]]
+    assert sorted(moved) == sorted(owned), (
+        "a leave moved keys its owner never held"
+    )
+
+    ring.rebuild(nodes + ["n5"])
+    after_join = {k: ring.lookup(k) for k in keys}
+    for k in keys:
+        if before[k] != after_join[k]:
+            assert after_join[k] == "n5", (
+                f"join moved {k} to {after_join[k]}, not the joiner"
+            )
+    stolen = sum(1 for k in keys if after_join[k] == "n5")
+    # expectation is len(keys)/6 ≈ 167; allow generous vnode variance
+    assert 0 < stolen < len(keys) / 2, stolen
+
+
+def test_ring_exclude_walks_clockwise_and_preference_order():
+    ring = ConsistentHashRing(["a", "b", "c"])
+    key = "some-session"
+    order = ring.preference(key)
+    assert sorted(order) == ["a", "b", "c"]
+    assert ring.lookup(key) == order[0]
+    assert ring.lookup(key, exclude=frozenset({order[0]})) == order[1]
+    assert ring.lookup(
+        key, exclude=frozenset({order[0], order[1]})
+    ) == order[2]
+    assert ring.lookup(key, exclude=frozenset(order)) is None
+    # determinism across instances (the cross-gateway agreement)
+    assert ConsistentHashRing(["c", "a", "b"]).preference(key) == order
+
+
+def test_ring_empty_and_vnode_validation():
+    assert ConsistentHashRing([]).lookup("x") is None
+    assert ConsistentHashRing([]).preference("x") == []
+    with pytest.raises(ValueError):
+        ConsistentHashRing(["a"], vnodes=0)
+
+
+# ---------------------------------------------------------------------------
+# 2. ConsistentHashRouter
+# ---------------------------------------------------------------------------
+
+class _Req:
+    def __init__(self, session=None):
+        self.session = session
+
+
+def _replicas(stack):
+    stack.registry.refresh()
+    return stack.registry.routable()
+
+
+def test_consistent_hash_router_agrees_across_instances():
+    """Two routers (two gateways) with no shared state route every
+    session identically — and the exclude set walks both to the SAME
+    next replica."""
+    stack = build_fake_serving_stack(4)
+    replicas = _replicas(stack)
+    r1, r2 = ConsistentHashRouter(), ConsistentHashRouter()
+    for i in range(50):
+        s = f"sess{i}"
+        a = r1.pick(_Req(s), replicas, {})
+        b = r2.pick(_Req(s), replicas, {})
+        assert a is not None and a.key == b.key
+        ex = frozenset({a.key})
+        a2 = r1.pick(_Req(s), replicas, {}, ex)
+        b2 = r2.pick(_Req(s), replicas, {}, ex)
+        assert a2.key == b2.key != a.key
+
+
+def test_consistent_hash_router_sessionless_falls_back_by_load():
+    stack = build_fake_serving_stack(3)
+    replicas = _replicas(stack)
+    router = ConsistentHashRouter()
+    outstanding = {replicas[0].key: 5, replicas[1].key: 0,
+                   replicas[2].key: 3}
+    pick = router.pick(_Req(None), replicas, outstanding)
+    assert pick.key == replicas[1].key
+
+
+def test_consistent_hash_router_counts_movement_as_repin():
+    stack = build_fake_serving_stack(4)
+    replicas = _replicas(stack)
+    m = Metrics()
+    router = ConsistentHashRouter(metrics=m)
+    # find a session owned by a specific replica, then remove that
+    # replica from the candidate list: the ring MUST move the session
+    # (counted), and re-offering the full list moves it back (counted)
+    session = next(
+        f"s{i}" for i in range(200)
+        if router.pick(_Req(f"s{i}"), replicas, {}).key == replicas[0].key
+    )
+    m2 = Metrics()
+    router = ConsistentHashRouter(metrics=m2)
+    assert router.pick(_Req(session), replicas, {}).key == replicas[0].key
+    shrunk = [r for r in replicas if r.key != replicas[0].key]
+    moved = router.pick(_Req(session), shrunk, {})
+    assert moved.key != replicas[0].key
+    assert m2.get("gateway_session_repin_total") == 1
+
+
+def test_mispinned_session_restores_before_dispatch():
+    """The tier's 'any gateway can route any session' guarantee: a
+    session whose KV home differs from the routed target — even with
+    the home ALIVE (ring moved it) — gets its sealed export imported
+    into the target before the attempt opens."""
+
+    class _FakeClient:
+        def __init__(self):
+            self.imports = []
+
+        def import_sealed(self, key, payload):
+            self.imports.append((key, payload["blob"]))
+            return True
+
+    store = SessionKVStore()
+    client = _FakeClient()
+    store.record("sess", "replica-A", [1, 2, 3])
+    e = store._entries["sess"]
+    store._set_payload_locked(e, {"blob": "kv"})
+    req = _Req("sess")
+    # dispatch to the home: no-op
+    assert not store.restore_for(req, "replica-A", client)
+    # dispatch elsewhere (mispin): restore fires and re-homes
+    assert store.restore_for(req, "replica-B", client)
+    assert client.imports == [("replica-B", "kv")]
+    assert store._entries["sess"]["replica"] == "replica-B"
+
+
+# ---------------------------------------------------------------------------
+# 3. StreamRelay dedup
+# ---------------------------------------------------------------------------
+
+class _Attempt:
+    def __init__(self, base=0):
+        self.stream_base = base
+
+
+def test_stream_relay_dedups_overlapping_twin_streams():
+    m = Metrics()
+    relay = StreamRelay(m, dedup=True)
+    primary, hedge = _Attempt(0), _Attempt(3)
+    relay.on_tokens(primary, [10, 11, 12])          # abs 0..2
+    relay.on_tokens(hedge, [13, 14])                # abs 3..4 (fast-fwd)
+    relay.on_tokens(primary, [13, 14, 15])          # abs 3..5: 13,14 dup
+    relay.on_tokens(hedge, [15, 16])                # abs 5..6: 15 dup
+    assert relay.drain() == [10, 11, 12, 13, 14, 15, 16]
+    assert relay.emitted() == 7
+    assert m.get("gateway_stream_dedup_tokens_total") == 3
+
+
+def test_stream_relay_pin_mode_for_sampled_streams():
+    relay = StreamRelay(dedup=False)
+    a, b = _Attempt(), _Attempt()
+    relay.on_tokens(a, [1, 2])
+    relay.on_tokens(b, [9, 9])      # a different sampled stream: dropped
+    relay.on_tokens(a, [3])
+    assert relay.drain() == [1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# 4. GatewayTier
+# ---------------------------------------------------------------------------
+
+def _build_tier(n_replicas=3, n_gateways=2, step_delay_s=0.001,
+                metrics=None):
+    stack = build_fake_serving_stack(n_replicas)
+    client = InMemoryReplicaClient(
+        batcher_factory=lambda key: SimBatcher(slots=8),
+        step_delay_s=step_delay_s,
+    )
+    stack.registry.subscribe(client.sync_live)
+    tier = GatewayTier(
+        stack.registry, client, n_gateways=n_gateways,
+        metrics=metrics or Metrics(),
+        policy=FailoverPolicy(
+            deadline_s=30.0, hedge_after_s=0.05, max_attempts=6,
+            retry_budget_ratio=1.0, budget_floor=100,
+        ),
+    )
+    stack.registry.refresh()
+    tier.start()
+    return stack, client, tier
+
+
+def test_tier_any_gateway_routes_a_session_to_the_same_replica():
+    stack, client, tier = _build_tier(n_replicas=4, n_gateways=3)
+    try:
+        homes = set()
+        for i, gid in enumerate(sorted(tier.gateways)):
+            _, p = tier.submit(GatewayRequest(
+                prompt=[1, 2, 3], max_new_tokens=4,
+                request_id=f"r-{gid}-{i}", session="shared-session",
+            ), via=gid)
+            assert p.wait(20) and p.result().status == "ok", p.result()
+            homes.add(p.result().replica)
+        assert len(homes) == 1, (
+            f"the same session landed on {sorted(homes)} via different "
+            "gateways — the consistent-hash agreement is broken"
+        )
+    finally:
+        tier.stop()
+        client.stop()
+
+
+def test_tier_death_mid_stream_sibling_resumes_exactly_once():
+    """The acceptance flow: a greedy stream's home gateway is killed
+    while tokens flow; the client retries the SAME request_id on the
+    sibling with the relay's watermark.  The caller's stream is the
+    full token list exactly once, and the final result matches it."""
+    metrics = Metrics()
+    stack, client, tier = _build_tier(
+        n_replicas=3, n_gateways=2, step_delay_s=0.004, metrics=metrics,
+    )
+    try:
+        relay = StreamRelay(metrics, dedup=True)
+        request = GatewayRequest(
+            prompt=[7, 8, 9], max_new_tokens=40, request_id="mig",
+            session="sess-f",
+        )
+        request.on_tokens = relay.on_tokens
+        request.stream_watermark = relay.emitted
+        request.no_hedge = False
+        gid, pending = tier.submit(request)
+        _wait(lambda: relay.emitted() >= 3, msg="first streamed tokens")
+        tier.kill(gid)
+        assert pending.wait(20), "dead gateway never resolved the handle"
+        first = pending.result()
+        assert first.status == "error", first
+        # the client contract: retry on the sibling (clone carries the
+        # relay + watermark)
+        clone = GatewayTier._clone(request)
+        gid2, pending2 = tier.submit(clone)
+        assert gid2 != gid
+        assert pending2.wait(30) and pending2.result().status == "ok", (
+            pending2.result()
+        )
+        result = pending2.result()
+        assert len(result.tokens) == 40
+        # drain any late deltas, then judge: exactly once, no gaps
+        time.sleep(0.05)
+        delivered = relay.drain()
+        assert delivered == result.tokens, (
+            f"stream across the failover delivered {len(delivered)} "
+            f"tokens vs result {len(result.tokens)}"
+        )
+        assert metrics.get("gateway_tier_deaths_total") == 1
+        # no double-serve: the replica-side duplicate-id eviction means
+        # at most one decode DELIVERY credited per request id
+        assert client.decodes.get("mig", 0) >= 1
+    finally:
+        tier.stop()
+        client.stop()
+
+
+def test_tier_submit_and_wait_retries_on_dead_gateway():
+    metrics = Metrics()
+    stack, client, tier = _build_tier(
+        n_replicas=3, n_gateways=3, step_delay_s=0.004, metrics=metrics,
+    )
+    try:
+        request = GatewayRequest(
+            prompt=[2, 4, 6], max_new_tokens=30, request_id="saw",
+            session="sess-w",
+        )
+        gid = tier.gateway_for(request)
+        box = {}
+
+        def call():
+            box["result"] = tier.submit_and_wait(request, timeout=30.0)
+
+        t = threading.Thread(target=call, daemon=True)
+        t.start()
+        _wait(
+            lambda: tier.gateways[gid].in_flight() > 0
+            or "result" in box,
+            msg="request in flight",
+        )
+        tier.kill(gid)
+        t.join(30.0)
+        assert not t.is_alive(), "submit_and_wait hung across the kill"
+        result = box["result"]
+        assert result.status == "ok", result
+        assert len(result.tokens) == 30
+        assert metrics.get("gateway_tier_retries_total") >= 1
+        assert metrics.get("gateway_tier_deaths_total") == 1
+    finally:
+        tier.stop()
+        client.stop()
+
+
+def test_submit_racing_kill_resolves_retryable_not_rejected():
+    """A submit that loses the race with kill() (admission queue already
+    closed) must resolve with the RETRYABLE death error — surfacing it
+    as 'rejected' would make the tier client hand the caller a spurious
+    backpressure answer while a sibling sits idle."""
+    from kubegpu_tpu.gateway import is_gateway_death
+
+    stack, client, tier = _build_tier(n_replicas=2, n_gateways=2)
+    try:
+        gid = sorted(tier.gateways)[0]
+        tier.kill(gid)
+        _, p = tier.submit(GatewayRequest(
+            prompt=[1], max_new_tokens=2, request_id="race",
+        ), via=gid)
+        assert p.wait(10)
+        assert is_gateway_death(p.result(), tier.gateways[gid]), p.result()
+        # the client contract then lands it on the sibling
+        result = tier.submit_and_wait(GatewayRequest(
+            prompt=[1], max_new_tokens=2, request_id="race2",
+        ), timeout=20.0)
+        assert result.status == "ok", result
+    finally:
+        tier.stop()
+        client.stop()
+
+
+def test_tier_revive_replaces_the_corpse_and_serves_again():
+    stack, client, tier = _build_tier(n_replicas=2, n_gateways=2)
+    try:
+        gid = sorted(tier.gateways)[0]
+        tier.kill(gid)
+        assert tier.alive_ids() == [sorted(tier.gateways)[1]]
+        tier.revive(gid)
+        assert sorted(tier.alive_ids()) == sorted(tier.gateways)
+        _, p = tier.submit(GatewayRequest(
+            prompt=[5], max_new_tokens=3, request_id="post-revive",
+        ), via=gid)
+        assert p.wait(20) and p.result().status == "ok", p.result()
+    finally:
+        tier.stop()
+        client.stop()
+
+
+def test_hedged_greedy_stream_beats_straggler_and_dedups():
+    """A straggling primary provokes a hedge; the twin's stream (fast-
+    forwarded past the watermark) completes the caller's stream — each
+    token exactly once, and the hedge was COUNTED as a streaming
+    hedge."""
+    metrics = Metrics()
+    stack, client, tier = _build_tier(
+        n_replicas=2, n_gateways=1, metrics=metrics,
+    )
+    try:
+        keys = [r.key for r in stack.registry.routable()]
+        relay = StreamRelay(metrics, dedup=True)
+        request = GatewayRequest(
+            prompt=[3, 1, 4], max_new_tokens=24, request_id="hst",
+        )
+        request.on_tokens = relay.on_tokens
+        request.stream_watermark = relay.emitted
+        request.no_hedge = False
+        # whichever replica takes the primary, it straggles: slow BOTH
+        # down asymmetrically after routing is load-based... simpler:
+        # slow one replica hard; if the primary lands there the hedge
+        # rescues TTLT, if not the request just finishes fast — so pin
+        # the outcome by slowing the one the router will pick first
+        # (deterministic: least-outstanding breaks ties by name)
+        client.set_step_delay(sorted(keys)[0], 0.2)
+        _, pending = tier.submit(request)
+        assert pending.wait(30) and pending.result().status == "ok", (
+            pending.result()
+        )
+        result = pending.result()
+        time.sleep(0.05)
+        delivered = relay.drain()
+        assert delivered == result.tokens
+        assert metrics.get("gateway_hedges_total") >= 1
+        assert metrics.get("gateway_stream_hedges_total") >= 1
+    finally:
+        tier.stop()
+        client.stop()
+
+
+# ---------------------------------------------------------------------------
+# 5. workload harness
+# ---------------------------------------------------------------------------
+
+def test_workload_generator_deterministic_scenario_mix():
+    from kubegpu_tpu.testing.workload import WorkloadGenerator
+
+    a = WorkloadGenerator(seed=3, prompt_cap=12).generate(200)
+    b = WorkloadGenerator(seed=3, prompt_cap=12).generate(200)
+    assert [(i.request_id, i.offset_s, i.prompt, i.scenario)
+            for i in a] == \
+           [(i.request_id, i.offset_s, i.prompt, i.scenario)
+            for i in b]
+    scenarios = {i.scenario for i in a}
+    assert scenarios == {"burst", "agent", "rag", "bestofn"}
+    offsets = [i.offset_s for i in a]
+    assert offsets == sorted(offsets)
+    ids = [i.request_id for i in a]
+    assert len(ids) == len(set(ids))
+    by_id = {i.request_id: i for i in a}
+    for item in a:
+        assert len(item.prompt) <= 12
+        if item.follow_of is not None:
+            assert item.scenario == "agent" and item.salt
+            parent = by_id.get(item.follow_of)
+            # parents precede children in arrival order (ids are
+            # allocation-ordered; a missing parent means the list was
+            # truncated mid-chain, which generate() never does)
+            assert parent is not None
+            assert parent.session == item.session
+        if item.scenario == "rag":
+            assert len(item.prompt) == 12
+    groups = {}
+    for item in a:
+        if item.fanout_of:
+            groups.setdefault(item.fanout_of, []).append(item)
+    assert groups, "no best-of-n groups generated"
+    for members in groups.values():
+        assert len({tuple(m.prompt) for m in members}) == 1
+        assert len({m.request_id for m in members}) == len(members)
+
+
+def test_workload_stream_gates_follows_on_parent_results():
+    from kubegpu_tpu.testing.workload import (
+        WorkloadGenerator, WorkloadStream, materialize_follow,
+    )
+
+    class _R:
+        def __init__(self, status, tokens=()):
+            self.status = status
+            self.tokens = list(tokens)
+
+    gen = WorkloadGenerator(seed=11, prompt_cap=10,
+                            mix={"agent": 1})
+    items = gen.generate(8)
+    stream = WorkloadStream(items, prompt_cap=10)
+    results = {}
+    handed = {}
+    # first drain: only opening turns come out
+    for item, prompt in stream.next_ready(50, results):
+        assert item.follow_of is None
+        handed[item.request_id] = (item, prompt)
+    assert stream.pending_follows() > 0
+    # complete one parent: exactly its children unblock, with the
+    # documented materialization
+    rid, (item, prompt) = next(iter(handed.items()))
+    results[rid] = _R("ok", [41, 42, 43])
+    ready = stream.next_ready(50, results)
+    for child, child_prompt in ready:
+        assert child.follow_of == rid
+        assert child_prompt == materialize_follow(
+            prompt, [41, 42, 43], child.salt, 10
+        )
+        assert len(child_prompt) <= 10
+    # a FAILED parent ends its conversation: the turn is dropped
+    rid2 = next(r for r in handed if r != rid)
+    results[rid2] = _R("error")
+    before = stream.pending_follows()
+    stream.next_ready(50, results)
+    assert stream.pending_follows() < before or before == 0
+
+
+# ---------------------------------------------------------------------------
+# 6. the multi-gateway chaos lanes
+# ---------------------------------------------------------------------------
+
+def test_gateway_soak_tier_inmemory_lane():
+    """Tier-wide I5 under combined gateway+replica chaos: gateway
+    kills mid-everything, hedged greedy streams, mid-stream gateway
+    failovers, replica kills/stragglers — every request's final handle
+    ok/rejected, every ok stream delivered exactly once."""
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    soak = GatewaySoak(seed=101, n_replicas=4, gateways=3)
+    soak.run(60)
+    assert soak._streams, "the schedule never exercised a stream"
+
+
+def test_gateway_soak_tier_http_lane():
+    """The same tier chaos ACROSS THE WIRE: SimBatcher replicas behind
+    real loopback ReplicaServers, gateway kills cancel their streams
+    wire-level, sibling retries meet the replica-side duplicate-id
+    eviction."""
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    soak = GatewaySoak(seed=103, n_replicas=4, gateways=2, http=True)
+    soak.run(45)
+
+
+@pytest.mark.slow
+def test_gateway_soak_tier_paged_kill_schedule():
+    """The acceptance schedule with REAL paged batchers: 2 gateways ×
+    2 replicas (speculation + fp32 decode-page sealing + migration
+    verbs), gateway kills, mispinned sessions (ring movement under
+    replica churn), hedged streams and mid-stream failovers — at
+    quiescence ``assert_page_accounting`` balances on every replica
+    and I5 holds tier-wide."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubegpu_tpu.models import TransformerLM
+    from kubegpu_tpu.models.paging import PagedContinuousBatcher
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    tiny = dict(vocab_size=61, num_layers=1, num_heads=2, hidden=16,
+                max_seq=32)
+    params = TransformerLM(dtype=jnp.float32, **tiny).init(
+        jax.random.PRNGKey(1), jnp.ones((1, 4), jnp.int32)
+    )["params"]
+    soak = GatewaySoak(
+        seed=107, n_replicas=2, gateways=2, multiturn=True,
+        follow_prompt_cap=12, migration=True,
+        batcher_factory=lambda key: PagedContinuousBatcher(
+            params, slots=4, prompt_pad=12, page_size=4, pool_pages=48,
+            station_slots=2, token_budget=8, dtype=jnp.float32,
+            decode_page_cache="fp32",
+            draft_params=params, speculate_k=2, draft_window=16,
+            draft_num_layers=tiny["num_layers"],
+            draft_num_heads=tiny["num_heads"],
+            draft_hidden=tiny["hidden"], **tiny,
+        ),
+    )
+    soak.run(steps=20)

@@ -259,7 +259,8 @@ def test_gateway_soak_kill_schedule_no_page_leaks():
         jax.random.PRNGKey(1), jnp.ones((1, 4), jnp.int32)
     )["params"]
     soak = GatewaySoak(
-        seed=11, n_replicas=2,
+        # workload prompts must fit the replicas' prompt_pad below
+        seed=11, n_replicas=2, follow_prompt_cap=4,
         batcher_factory=lambda key: PagedContinuousBatcher(
             params, slots=4, prompt_pad=4, page_size=4, pool_pages=20,
             dtype=jnp.float32, **tiny,

@@ -244,7 +244,8 @@ def test_gateway_soak_kill_schedule_station_half_full():
         jax.random.PRNGKey(1), jnp.ones((1, 4), jnp.int32)
     )["params"]
     soak = GatewaySoak(
-        seed=13, n_replicas=2,
+        # workload prompts must fit the replicas' prompt_pad below
+        seed=13, n_replicas=2, follow_prompt_cap=4,
         batcher_factory=lambda key: PagedContinuousBatcher(
             params, slots=4, prompt_pad=4, page_size=4, pool_pages=20,
             station_slots=2, token_budget=8, dtype=jnp.float32, **tiny,
