@@ -67,7 +67,12 @@ bench:
 # gateway tier (serving_gateway_scaleout): 2 loopback gateways must
 # clear 1.5x aggregate tok/s over 1 on the shared-workload mixed
 # replay with fp32 token identity, and hedged-streaming p99 TTFT must
-# be strictly below unhedged under an injected straggler
+# be strictly below unhedged under an injected straggler.  Also the
+# external session store (serving_store_failover): restored turn-2
+# TTFT through the external store within 1.2x of the in-process
+# backend on the same warm replicas, store-DOWN degradation bounded
+# (cold + one fast breaker trip, never a deadline-length stall), fp32
+# token identity across all three lanes
 bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve-smoke
 
@@ -97,12 +102,20 @@ multichip-smoke:
 # home gateway is KILLED mid-stream and the client retries on the
 # survivor with the resume watermark — the stream completes via the
 # survivor, token-identical, each token delivered exactly once
+# dryrun_gateway_pods: the MULTI-PROCESS deployment — one external
+# session-store subprocess + two real gateway subprocesses + one paged
+# worker; the home gateway is SIGKILLed mid-stream (sibling completes
+# exactly-once via the resume watermark), the worker cold-restarts and
+# the session's next turn restores sealed KV from the EXTERNAL store
+# (decode-page hits > 0, token-identical), and SIGTERM drains a gateway
+# gracefully (readyz 503, live stream finishes, exit 0)
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	  $(PY) -c "import __graft_entry__ as g; g.dryrun_gateway(); \
 	  g.dryrun_gateway_tier(); \
 	  g.dryrun_spec_serving(); g.dryrun_tracing(); \
 	  g.dryrun_http_serving(); g.dryrun_kv_migration(); \
+	  g.dryrun_gateway_pods(); \
 	  g.dryrun_multichip(8)"
 
 image:

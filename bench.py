@@ -2351,6 +2351,202 @@ def serving_migration(extra: dict, tiny: bool = False) -> None:
     extra["serve_migration_pages_per_s"] = round(pages_per_s, 1)
 
 
+def serving_store_failover(extra: dict, tiny: bool = False) -> None:
+    """External session-KV store as a latency primitive (ISSUE 13): a
+    session's turn 1 completes on replica HOME (sealing its pages,
+    ``decode_page_cache="fp32"``), the sealed chain is captured into
+    the insurance store, and turn 2 is measured on a DIFFERENT warm
+    replica three ways:
+
+    - restored through the IN-PROCESS backend (the PR 12 tier
+      semantics — the baseline);
+    - restored through the EXTERNAL ``StoreServer`` over loopback HTTP
+      (store GET + payload codec on the restore path — the price of
+      crash-durability);
+    - with the store DOWN — and not merely refusing: a socket that
+      accepts and then HANGS, the dangerous failure mode — so the
+      restore path eats its per-op deadline, the circuit breaker trips
+      once, and the session degrades to COLD prefill.
+
+    Gates (tiny/CPU, make bench-smoke): external-store restored TTFT
+    within 1.2x of the in-process backend on the same warm replicas;
+    with the store down, every probe's TTFT stays BOUNDED (well under
+    the request deadline: cold + at most one breaker trip's worth of
+    op deadlines — no deadline-length stall) and the breaker tripped
+    exactly once; fp32 token identity across all three lanes and the
+    never-migrated reference."""
+    import socket
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubegpu_tpu.gateway.sessionstore import (
+        HttpStoreClient,
+        SessionKVStore,
+        StoreServer,
+    )
+    from kubegpu_tpu.models import TransformerLM
+    from kubegpu_tpu.models.paging import PagedContinuousBatcher
+
+    if tiny:
+        vocab, layers, heads, hidden = 61, 2, 4, 32
+        page, prompt_pad, max_seq = 8, 40, 96
+        p1_len, t1_new, t2_new, n_probes = 16, 9, 6, 4
+    else:
+        vocab, layers, hidden = 32768, 4, 4096
+        heads = hidden // 128
+        page, prompt_pad, max_seq = 64, 320, 768
+        p1_len, t1_new, t2_new, n_probes = 128, 65, 32, 3
+    model = TransformerLM(
+        vocab_size=vocab, num_layers=layers, num_heads=heads,
+        hidden=hidden, max_seq=max_seq,
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32)
+    )["params"]
+
+    def mk():
+        return PagedContinuousBatcher(
+            params, vocab_size=vocab, num_layers=layers, num_heads=heads,
+            hidden=hidden, max_seq=max_seq, slots=4,
+            prompt_pad=prompt_pad, page_size=page, pool_pages=64,
+            dtype=jnp.float32, decode_page_cache="fp32",
+        )
+
+    batchers = {
+        "home": mk(), "r_in": mk(), "r_http": mk(), "r_down": mk(),
+    }
+    rs = np.random.RandomState(23)
+    warm = rs.randint(0, vocab, size=p1_len).astype(np.int32)
+    for cb in batchers.values():      # compile off the clock
+        cb.run([warm], [t1_new])
+
+    class _DirectClient:
+        """ReplicaClient's sealed-chain surface over local batchers —
+        the bench isolates the STORE's contribution, so the data plane
+        is direct calls."""
+
+        def export_sealed(self, key, stream):
+            return batchers[key].export_sealed_chain(list(stream))
+
+        def import_sealed(self, key, payload):
+            return (batchers[key].import_sealed_chain(payload) or 0) > 0
+
+    class _Req:
+        def __init__(self, session):
+            self.session = session
+
+    client = _DirectClient()
+    # the live external store + a hanging one (accepts, never answers)
+    server = StoreServer().start()
+    hang = socket.socket()
+    hang.bind(("127.0.0.1", 0))
+    hang.listen(1)
+    OP_TIMEOUT, RETRIES = 0.15, 1
+    down_client = HttpStoreClient(
+        f"http://127.0.0.1:{hang.getsockname()[1]}",
+        timeout_s=OP_TIMEOUT, retries=RETRIES,
+        backoff_base_s=0.02, backoff_cap_s=0.05,
+        breaker_threshold=2, breaker_cooldown_s=600.0,
+    )
+    kv_in = SessionKVStore()
+    kv_http = SessionKVStore(backend=HttpStoreClient(server.url))
+    kv_down = SessionKVStore(backend=down_client)
+
+    def drive_ttft(cb, seq, prompt, budget):
+        t0 = time.perf_counter()
+        cb.submit(seq, np.asarray(prompt, np.int32), budget)
+        t1, done = None, {}
+        while cb.has_work():
+            done.update(cb.serve_step())
+            if t1 is None and (
+                cb.live_tokens().get(seq) or done.get(seq)
+            ):
+                t1 = time.perf_counter()
+        return t1 - t0, done[seq]
+
+    ttft = {"r_in": [], "r_http": [], "r_down": []}
+    identical = True
+    restored_pages = 0
+    for p in range(n_probes):
+        sess = f"s{p}"
+        p1 = rs.randint(0, vocab, size=p1_len).astype(np.int32)
+        _, t1_toks = drive_ttft(batchers["home"], 100 + p, p1, t1_new)
+        stream = [int(t) for t in p1] + t1_toks
+        for kv in (kv_in, kv_http):
+            kv.record(sess, "home", stream)
+            assert kv.capture(client, sess), "capture failed"
+        entry = kv_http.entry(sess)
+        restored_pages += len(
+            (entry["payload"] or {}).get("page_keys") or []
+        )
+        p2 = stream + [int(t) for t in
+                       rs.randint(0, vocab, size=6)]
+        lanes = [("r_in", kv_in), ("r_http", kv_http),
+                 ("r_down", kv_down)]
+        if p % 2:
+            lanes = lanes[::-1]
+        outs = {}
+        for name, kv in lanes:
+            t0 = time.perf_counter()
+            # the dispatcher's restore-before-dispatch, then the turn-2
+            # drive: user-visible re-pin TTFT includes the store read
+            # (or its bounded failure) and the payload import
+            restored = kv.restore_for(_Req(sess), name, client)
+            if name == "r_down":
+                assert not restored, "down lane restored?!"
+            _, toks = drive_ttft(batchers[name], 200 + p, p2, t2_new)
+            ttft[name].append(time.perf_counter() - t0)
+            outs[name] = toks
+        _, ref = drive_ttft(batchers["home"], 300 + p, p2, t2_new)
+        identical = identical and all(
+            outs[name] == ref for name in outs
+        )
+        for cb in batchers.values():
+            cb.assert_page_accounting()
+    server.stop()
+    hang.close()
+    for kv in (kv_in, kv_http, kv_down):
+        kv.close()
+
+    best_in = min(ttft["r_in"])
+    best_http = min(ttft["r_http"])
+    best_down = min(ttft["r_down"])
+    worst_down = max(ttft["r_down"])
+    # bounded degradation: cold prefill + at most ONE breaker trip's
+    # worth of hung ops — orders of magnitude under the 30 s request
+    # deadline the old behavior would have eaten per request
+    down_bound = best_down * 3 + (RETRIES + 1) * OP_TIMEOUT + 0.35
+    trips = down_client.breaker.trips
+    degraded = len(kv_down.degraded_log)
+    label = "tiny/CPU fp32" if tiny else "1.08B fp32"
+    log(
+        f"serving store failover ({label}, {n_probes} probes, warm "
+        f"replicas): restored turn-2 TTFT in-process "
+        f"{best_in * 1e3:.1f} ms vs external store "
+        f"{best_http * 1e3:.1f} ms "
+        f"({best_http / max(best_in, 1e-9):.2f}x, gate 1.2x); store "
+        f"DOWN (hanging socket): worst {worst_down * 1e3:.1f} ms "
+        f"(bound {down_bound * 1e3:.0f} ms, deadline 30000 ms), "
+        f"breaker trips {trips}, {degraded} counted cold degradations; "
+        f"{restored_pages} pages restored; token-identical across "
+        f"in-process/external/degraded/reference: {identical}"
+    )
+    extra["serve_store_ttft_inproc_ms"] = round(best_in * 1e3, 3)
+    extra["serve_store_ttft_http_ms"] = round(best_http * 1e3, 3)
+    extra["serve_store_ttft_down_worst_ms"] = round(worst_down * 1e3, 3)
+    extra["serve_store_within_tolerance"] = bool(
+        best_http <= 1.2 * best_in
+    )
+    extra["serve_store_outage_bounded"] = bool(
+        worst_down <= down_bound and trips == 1 and degraded > 0
+    )
+    extra["serve_store_token_identical"] = bool(identical)
+    extra["serve_store_restored_pages"] = int(restored_pages)
+
+
 def serving_gateway_scaleout(extra: dict, tiny: bool = False) -> None:
     """Gateway-tier scale-out + hedged streaming (ISSUE 12 CI
     satellite), on real tiny fp32 paged batchers over the in-memory
@@ -3961,6 +4157,7 @@ def main() -> None:
         serving_trace_report(extra, tiny=True)
         serving_http_overhead(extra, tiny=True)
         serving_migration(extra, tiny=True)
+        serving_store_failover(extra, tiny=True)
         serving_gateway_scaleout(extra, tiny=True)
         ok = (
             # chunked ITL must not SUBSTANTIALLY regress vs monolithic:
@@ -3994,6 +4191,15 @@ def main() -> None:
             and extra["serve_migration_strictly_better"]
             and extra["serve_migration_token_identical"]
             and extra["serve_migration_pages"] > 0
+            # the external session store: crash-durability must cost
+            # ≤1.2x the in-process backend's restored turn-2 TTFT, a
+            # DEAD store must degrade to bounded cold prefill (one fast
+            # breaker trip, never a deadline-length stall), and all
+            # three lanes must stay fp32 token-identical
+            and extra["serve_store_within_tolerance"]
+            and extra["serve_store_outage_bounded"]
+            and extra["serve_store_token_identical"]
+            and extra["serve_store_restored_pages"] > 0
             # the gateway tier: 2 loopback gateways must clear 1.5x
             # aggregate tok/s on the mixed replay with fp32 token
             # identity, and hedged streaming's p99 TTFT must strictly

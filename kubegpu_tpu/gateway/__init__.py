@@ -46,15 +46,29 @@ from kubegpu_tpu.gateway.router import (
     SessionAffinityRouter,
 )
 from kubegpu_tpu.gateway.server import GatewayServer
+from kubegpu_tpu.gateway.sessionstore import (
+    CircuitBreaker,
+    HttpStoreClient,
+    InProcessStoreBackend,
+    SessionStoreBackend,
+    StoreResult,
+    StoreServer,
+)
 from kubegpu_tpu.gateway.tier import GatewayTier, is_gateway_death
 
 __all__ = [
     "AdmissionQueue",
     "Attempt",
     "AttemptResult",
+    "CircuitBreaker",
     "ConsistentHashRing",
     "ConsistentHashRouter",
     "Dispatcher",
+    "HttpStoreClient",
+    "InProcessStoreBackend",
+    "SessionStoreBackend",
+    "StoreResult",
+    "StoreServer",
     "FailoverPolicy",
     "Gateway",
     "GatewayRequest",

@@ -137,7 +137,7 @@ class StreamRelay:
     """
 
     def __init__(self, metrics: Optional[Metrics] = None,
-                 dedup: bool = True) -> None:
+                 dedup: bool = True, base: int = 0) -> None:
         import queue as _queue
 
         self.metrics = metrics
@@ -150,7 +150,11 @@ class StreamRelay:
         # position instead of its own stream_base.  Bounded by the
         # attempts of one request.
         self._positions: Dict[object, int] = {}  # attempt -> abs end
-        self._emitted = 0
+        # ``base``: tokens the CALLER already holds — a stream resumed
+        # on a sibling gateway after its home died starts its relay at
+        # the caller-supplied resume watermark, so the dispatcher ships
+        # it down the wire and dedup skips the delivered prefix
+        self._emitted = int(base)
         self._pinned: Optional[object] = None  # dedup=False: the streamer
 
     def on_tokens(self, attempt, delta) -> None:
@@ -248,12 +252,16 @@ class Gateway:
             router.metrics = self.metrics
         # sealed-session KV insurance: completed sessionful turns are
         # recorded (and, when the serving replica seals decode pages,
-        # eagerly exported) so a later replica death or drain re-pins
-        # the session WITH its KV — the dispatcher restores the payload
-        # into the new target before the turn-2 attempt opens.  A tier
-        # passes ONE shared store into all its gateways: insurance a
-        # sibling captured must survive this gateway's death
-        self.session_store = session_store or SessionKVStore()
+        # eagerly exported ASYNCHRONOUSLY) so a later replica death or
+        # drain re-pins the session WITH its KV — the dispatcher
+        # restores the payload into the new target before the turn-2
+        # attempt opens.  A tier passes ONE shared store into all its
+        # gateways; a multi-process deployment passes a store backed by
+        # the external StoreServer (gateway/sessionstore.py), which is
+        # what makes the insurance survive THIS pod's death.
+        self.session_store = session_store or SessionKVStore(
+            metrics=self.metrics
+        )
         self._seals_cache: Dict[str, bool] = {}
         self.dispatcher = Dispatcher(
             client,
@@ -264,6 +272,13 @@ class Gateway:
         )
         self.n_dispatchers = dispatchers
         self._stop = threading.Event()
+        # DRAINING (graceful shutdown, SIGTERM): new admissions refuse
+        # with the retryable shutdown error, in-flight work finishes,
+        # /readyz reports 503 so the load balancer stops sending —
+        # distinct from _stop (the dispatchers keep running until the
+        # drain completes)
+        self._draining = threading.Event()
+        self._started = False
         self._threads: List[threading.Thread] = []
         self._lock = threading.Lock()
         self._pending: Dict[str, PendingRequest] = {}
@@ -293,6 +308,7 @@ class Gateway:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
+        self._started = True
         for i in range(self.n_dispatchers):
             t = threading.Thread(
                 target=self._dispatch_loop, name=f"gw-dispatch-{i}",
@@ -363,6 +379,26 @@ class Gateway:
     def alive(self) -> bool:
         return not self._stop.is_set()
 
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    @property
+    def accepting(self) -> bool:
+        """Is this instance willing to take NEW admissions?  The
+        per-instance half of /readyz: alive, dispatcher pool started,
+        not draining.  (The other half — ≥1 routable replica and a wired
+        data plane — is the server's to check.)"""
+        return self.alive and self._started and not self.draining
+
+    def begin_drain(self) -> None:
+        """Graceful shutdown, step 1 (SIGTERM): stop accepting new
+        admissions (submit refuses with the RETRYABLE shutdown error, so
+        a tier client or load balancer re-routes to a sibling) while
+        in-flight requests — live streams included — run to completion.
+        The caller then waits on ``drain()`` and calls ``stop()``."""
+        self._draining.set()
+
     # -- submission (the HTTP handler's surface) ---------------------------
     def submit(self, request: GatewayRequest) -> PendingRequest:
         """Admit or refuse NOW.  Refusal still resolves the handle — with
@@ -385,6 +421,17 @@ class Gateway:
                 "gateway_request", **attrs
             )
             pending._trace = request.trace
+        if self._draining.is_set():
+            # graceful shutdown: refuse with the RETRYABLE death error
+            # (the tier client's re-submit trigger, and the analog of a
+            # pod whose /readyz already went 503 but that a racing
+            # client still reached) — in-flight work keeps serving
+            self.metrics.inc("gateway_requests_total", outcome="error")
+            self._record(GatewayResult(
+                request.request_id, "error",
+                error="gateway shutting down (draining)",
+            ))
+            return pending
         request.enqueued_at = time.monotonic()
         try:
             self.queue.put(request)
@@ -468,10 +515,13 @@ class Gateway:
     def _record_session(self, request: GatewayRequest, outcome) -> None:
         """A sessionful turn completed ok: record the session's home +
         stream, and — when that replica actually seals decode pages —
-        eagerly capture its sealed export (the failover insurance
-        premium, paid while the replica is alive).  Best-effort and
-        gated per replica so SimBatcher/policy-off lanes never pay a
-        round-trip."""
+        queue a sealed-export capture (the failover insurance premium,
+        paid while the replica is alive).  The capture runs
+        ASYNCHRONOUSLY off the result path (bounded queue, drop-oldest
+        — capture is insurance, never admission-blocking); the tiny
+        record itself is synchronous so the very next turn's restore
+        sees the session's home.  Best-effort and gated per replica so
+        SimBatcher/policy-off lanes never pay a round-trip."""
         try:
             self.session_store.record(
                 request.session, outcome.replica,
@@ -482,7 +532,9 @@ class Gateway:
                 seals = bool(self.client.seals_decode(outcome.replica))
                 self._seals_cache[outcome.replica] = seals
             if seals:
-                self.session_store.capture(self.client, request.session)
+                self.session_store.capture_async(
+                    self.client, request.session
+                )
         except Exception:  # noqa: BLE001 - insurance must never fail serving
             log.exception("sealed-session capture failed")
 

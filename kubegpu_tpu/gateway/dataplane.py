@@ -405,6 +405,16 @@ class ReplicaServingLoop:
             "seals_decode": bool(getattr(b, "_seal_decode", False)),
             "active_streams": active_streams,
         }
+        # the batcher's counter dict (admits, prefix hit tokens, sealed
+        # pages, ...), JSON-coerced: multi-process harnesses assert
+        # "the restored turn actually hit warm decode pages" through
+        # the wire, the way in-process tests read batcher.stats
+        stats = getattr(b, "stats", None)
+        if isinstance(stats, dict):
+            out["stats"] = {
+                k: v for k, v in stats.items()
+                if isinstance(v, (int, float, str, bool))
+            }
         rows_fn = getattr(b, "ledger_rows", None)
         if rows_fn is not None:
             rows = rows_fn(max(ledger_limit, 1))

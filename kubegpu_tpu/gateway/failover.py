@@ -21,190 +21,21 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Callable, List, Optional
 
 from kubegpu_tpu.gateway.client import Attempt, ReplicaClient
 from kubegpu_tpu.gateway.registry import ReplicaInfo
 from kubegpu_tpu.gateway.router import Router
+# SessionKVStore moved to gateway/sessionstore.py when it grew pluggable
+# backends (external HTTP store, PR 13); re-exported here because this
+# module is its historical home and half the stack imports it from here.
+from kubegpu_tpu.gateway.sessionstore import SessionKVStore  # noqa: F401
 from kubegpu_tpu.utils.metrics import Metrics
 
 log = logging.getLogger(__name__)
 
 _POLL_S = 0.002  # attempt-completion poll; decode steps are >> this
-
-
-class SessionKVStore:
-    """The gateway's failover insurance for session KV: per session, the
-    replica that last served it, the stream it ended on (prompt +
-    generated tokens — the chain identity), and the last SEALED EXPORT
-    captured from that replica (``client.export_sealed``).  When the
-    replica later dies — or is drained — and the session re-pins, the
-    dispatcher imports the stored payload into the new target BEFORE
-    dispatching, so turn 2 hits warm pages instead of cold-restarting
-    prefill.  When nothing was sealed (policy off, capture raced a
-    death), restore is a clean no-op and the request cold-prefills —
-    graceful, never wrong.
-
-    Bounded FIFO like the affinity router's pin map; restore fires for
-    any non-hedge dispatch AWAY from the session's recorded home — a
-    lost home (left the live set, drained) always, a MISPIN (the
-    tier's consistent-hash ring moved the session on membership churn,
-    an affinity re-pin) only under affinity-style routing
-    (``mispin_restore``; a plain load balancer bounces sessions by
-    design and must not ship the payload per bounce).  A dispatch to
-    the healthy home is a no-op (the replica serves from its own
-    cache), and hedge twins never restore (the dispatcher skips them —
-    the primary usually holds the live KV).
-
-    Payload bytes are bounded separately (``max_payload_bytes``, total
-    across sessions): KV payloads are big — megabytes per page at real
-    shapes — so an entry COUNT cap alone could retain tens of GB.  Over
-    budget, the OLDEST payloads are dropped while their (tiny) stream
-    records stay: those sessions degrade to cold prefill on restore,
-    which is the designed fallback, never an error."""
-
-    def __init__(self, max_sessions: int = 4096,
-                 max_payload_bytes: int = 256 << 20) -> None:
-        self.max_sessions = max_sessions
-        self.max_payload_bytes = max_payload_bytes
-        self._lock = threading.Lock()
-        # session -> {"replica", "stream", "payload", "bytes", "lost"}
-        self._entries: "OrderedDict[str, dict]" = OrderedDict()
-        self._payload_bytes = 0
-
-    @staticmethod
-    def _sizeof(payload) -> int:
-        """Approximate retained bytes of a payload — host-numpy layers
-        (in-memory lane) or base64 strings (wire lane)."""
-        if not isinstance(payload, dict):
-            return 0
-        total = 0
-        for entry in payload.get("layers") or []:
-            if isinstance(entry, dict):      # encoded wire payload
-                total += len(entry.get("k") or "")
-                total += len(entry.get("v") or "")
-            else:                            # (k, v) host arrays
-                for arr in entry:
-                    total += getattr(arr, "nbytes", 0)
-        return total
-
-    def _set_payload_locked(self, e: dict, payload) -> None:
-        self._payload_bytes -= e.get("bytes", 0)
-        e["payload"] = payload
-        e["bytes"] = self._sizeof(payload)
-        self._payload_bytes += e["bytes"]
-        # evict oldest PAYLOADS (streams stay) until under budget — the
-        # newest capture is the one a restore most likely needs
-        if self._payload_bytes > self.max_payload_bytes:
-            for other in self._entries.values():
-                if self._payload_bytes <= self.max_payload_bytes:
-                    break
-                if other is e or other.get("payload") is None:
-                    continue
-                self._payload_bytes -= other.get("bytes", 0)
-                other["payload"] = None
-                other["bytes"] = 0
-
-    def record(self, session: str, replica_key: str, stream) -> None:
-        """A sessionful turn completed: remember where and on what
-        stream.  A new turn supersedes the old entry (the chain grew)."""
-        with self._lock:
-            old = self._entries.pop(session, None)
-            if old is not None:
-                self._payload_bytes -= old.get("bytes", 0)
-            self._entries[session] = {
-                "replica": replica_key,
-                "stream": [int(t) for t in stream],
-                "payload": None,
-                "bytes": 0,
-                "lost": False,
-            }
-            while len(self._entries) > self.max_sessions:
-                _, dropped = self._entries.popitem(last=False)
-                self._payload_bytes -= dropped.get("bytes", 0)
-
-    def capture(self, client: ReplicaClient, session: str) -> bool:
-        """Eagerly export the session's sealed chain from its home
-        replica — the insurance premium, paid while the replica is
-        alive.  Best-effort: False leaves the entry payload-less (a
-        later death then degrades to cold prefill)."""
-        with self._lock:
-            e = self._entries.get(session)
-            if e is None:
-                return False
-            replica, stream = e["replica"], list(e["stream"])
-        payload = client.export_sealed(replica, stream)
-        if payload is None:
-            return False
-        with self._lock:
-            e = self._entries.get(session)
-            if e is None or e["replica"] != replica:
-                return False   # a newer turn moved the session on
-            self._set_payload_locked(e, payload)
-        return True
-
-    def sessions_on(self, replica_key: str) -> List[str]:
-        with self._lock:
-            return [
-                s for s, e in self._entries.items()
-                if e["replica"] == replica_key
-            ]
-
-    def mark_lost(self, replica_key: str) -> None:
-        """The replica is going (drain) or gone (death): its sessions'
-        next dispatch may restore elsewhere."""
-        with self._lock:
-            for e in self._entries.values():
-                if e["replica"] == replica_key:
-                    e["lost"] = True
-
-    def sync_live(self, live) -> None:
-        """Registry subscription: sessions homed on replicas that left
-        the live set become restorable."""
-        live = set(live)
-        with self._lock:
-            for e in self._entries.values():
-                if e["replica"] not in live:
-                    e["lost"] = True
-
-    def restore_for(self, request, target_key: str,
-                    client: ReplicaClient,
-                    mispin_restore: bool = True) -> bool:
-        """Called at dispatch time with the routed target: if this
-        request's session is dispatching AWAY from its recorded home —
-        because the home was lost (death, drain) or, when
-        ``mispin_restore``, because routing deliberately moved it (a
-        consistent-hash ring rebalance or an affinity re-pin: the
-        tier's "mispinned session") — and a sealed export was
-        captured, import it into the target (idempotent — the import
-        dedups against pages already cached there) and re-home the
-        entry.  ``mispin_restore=False`` is for load-balancing routers
-        with NO session affinity: every turn may land somewhere new by
-        design, and shipping the payload per bounce would be pure wire
-        waste — only a LOST home restores there.  True only when a
-        payload actually landed."""
-        session = getattr(request, "session", None)
-        if not session:
-            return False
-        with self._lock:
-            e = self._entries.get(session)
-            if e is None or e["replica"] == target_key:
-                return False
-            if not e["lost"] and not mispin_restore:
-                return False
-            payload = e["payload"]
-        if payload is None:
-            return False   # nothing sealed: cold prefill, by design
-        if not client.import_sealed(target_key, payload):
-            return False
-        with self._lock:
-            e = self._entries.get(session)
-            if e is not None:
-                e["replica"] = target_key
-                e["lost"] = False
-        return True
 
 
 class _TraceView:

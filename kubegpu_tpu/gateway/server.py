@@ -85,15 +85,21 @@ def make_handler(gateway: Gateway, registry: ReplicaRegistry):
             if self.path == "/healthz":
                 self._send(200, "ok", content_type="text/plain")
             elif self.path == "/readyz":
-                # ready ⇔ at least one replica to route NEW work to AND
-                # a data plane that can reach it; either gap means a
-                # gateway in the Service would eat traffic into
-                # guaranteed 5xx.  ROUTABLE, not live: a fleet that is
-                # entirely DRAINING still serves its in-flight streams
-                # but can admit nothing — the load balancer must
-                # fast-fail instead of feeding requests into
-                # deadline-exceeded
-                if not gateway.client.ready():
+                # ready ⇔ THIS instance is accepting (dispatcher pool
+                # up, not draining — a SIGTERM'd pod must drop out of
+                # the Service while it finishes its in-flight streams)
+                # AND at least one replica to route NEW work to AND a
+                # data plane that can reach it; any gap means a gateway
+                # in the Service would eat traffic into guaranteed 5xx.
+                # ROUTABLE, not live: a fleet that is entirely DRAINING
+                # still serves its in-flight streams but can admit
+                # nothing — the load balancer must fast-fail instead of
+                # feeding requests into deadline-exceeded
+                if not gateway.accepting:
+                    self._send(503, "draining" if gateway.draining
+                               else "not accepting (no dispatcher pool)",
+                               content_type="text/plain")
+                elif not gateway.client.ready():
                     self._send(503, "data plane not wired "
                                "(no replica client)",
                                content_type="text/plain")
@@ -139,7 +145,17 @@ def make_handler(gateway: Gateway, registry: ReplicaRegistry):
                 self._send(400, {"error": "bad request: empty prompt"})
                 return
             if body.get("stream"):
-                self._stream_generate(request)
+                try:
+                    resume = max(0, int(body.get("resume_watermark", 0)))
+                except (TypeError, ValueError):
+                    resume = 0
+                if body.get("request_id"):
+                    # a RESUMED stream keeps its identity: the sibling
+                    # retry after a gateway crash re-submits the SAME
+                    # request_id (replica-side duplicate-id eviction
+                    # keeps at most one live stream tier-wide)
+                    request.request_id = str(body["request_id"])
+                self._stream_generate(request, resume=resume)
                 return
             # blocking unary call: the handler thread IS the caller's
             # connection; backpressure resolves instantly, decode blocks
@@ -167,7 +183,7 @@ def make_handler(gateway: Gateway, registry: ReplicaRegistry):
 
             write_chunk(self.wfile, data)
 
-        def _stream_generate(self, request) -> None:
+        def _stream_generate(self, request, resume: int = 0) -> None:
             """SSE pass-through: committed token batches relayed from
             the data plane as they stream off the replica, then the
             terminal result.  The done event's token list is
@@ -188,7 +204,14 @@ def make_handler(gateway: Gateway, registry: ReplicaRegistry):
             from kubegpu_tpu.gateway.dataplane import end_chunks, sse_event
 
             greedy = float(getattr(request, "temperature", 0.0)) == 0.0
-            relay = StreamRelay(gateway.metrics, dedup=greedy)
+            # ``resume``: tokens the CALLER already holds — a client
+            # resuming a crashed sibling gateway's stream passes its
+            # received count as "resume_watermark", the relay skips
+            # that prefix, and the dispatcher ships it down the wire so
+            # the replica fast-forwards emission (greedy only; decode
+            # still runs from 0, determinism keeps it token-identical)
+            relay = StreamRelay(gateway.metrics, dedup=greedy,
+                                base=resume if greedy else 0)
             request.on_tokens = relay.on_tokens
             request.stream_watermark = relay.emitted
             request.abort = threading.Event()
@@ -364,6 +387,32 @@ class GatewayServer:
             except Exception:  # noqa: BLE001
                 log.exception("registry refresh failed; keeping stale set")
 
+    def begin_graceful_shutdown(self, grace_s: float = 30.0,
+                                done=None) -> None:
+        """The SIGTERM path: flip /readyz to 503 and refuse new
+        admissions NOW (``Gateway.begin_drain`` — the load balancer
+        stops sending, racing submits get the retryable shutdown
+        error), let in-flight requests — live streams included — finish
+        within ``grace_s``, then stop the process.  ``done`` (an Event)
+        is set after the final stop, so a caller blocked on it exits
+        cleanly."""
+        self.gateway.begin_drain()
+        log.info("SIGTERM: draining (readyz=503, %.0fs grace)", grace_s)
+
+        def _drain_then_stop():
+            drained = self.gateway.drain(grace_s)
+            log.info(
+                "drain %s; stopping",
+                "complete" if drained else f"timed out after {grace_s}s",
+            )
+            self.stop()
+            if done is not None:
+                done.set()
+
+        t = threading.Thread(target=_drain_then_stop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
     def stop(self) -> None:
         self._stop.set()
         close = getattr(self.registry.api, "close_watches", None)
@@ -427,6 +476,44 @@ def main(argv=None) -> None:
     )
     ap.add_argument("--replicas", type=int, default=3,
                     help="replica count for --fake-cluster mode")
+    ap.add_argument(
+        "--replica-endpoint", action="append", default=None,
+        metavar="HOST:PORT",
+        help="repeatable: dispatch to these replica HTTP serving "
+        "endpoints (models.worker --serve-http) over a fabricated "
+        "registry instead of discovering pods from a cluster — the "
+        "multi-process deployment smoke (make dryrun's "
+        "dryrun_gateway_pods) and any no-k8s bring-up.  Endpoints map "
+        "to fabricated replica keys in sorted order, identically in "
+        "every gateway process, so N gateways over the same endpoint "
+        "list route sessions identically",
+    )
+    ap.add_argument(
+        "--session-store", default=None, metavar="URL",
+        help="external session-KV store (python -m "
+        "kubegpu_tpu.gateway.sessionstore; deploy/session-store.yaml), "
+        "e.g. http://session-store:8650 — sealed-KV failover insurance "
+        "then survives THIS gateway pod's death, which is what makes "
+        "deploy/gateway.yaml replicas: 2 a real deployment.  Store "
+        "outages degrade sessions to cold prefill (counted as "
+        "gateway_session_store_degraded_total), never request errors.  "
+        "Default: in-process store (single-pod semantics)",
+    )
+    ap.add_argument(
+        "--router", default="least-outstanding",
+        choices=("least-outstanding", "consistent-hash", "affinity"),
+        help="routing policy: least-outstanding (default), "
+        "consistent-hash (the tier policy — N gateway pods route every "
+        "session identically with zero shared state; required for "
+        "multi-gateway deployments), or affinity (sticky per-instance "
+        "pins)",
+    )
+    ap.add_argument(
+        "--drain-grace", type=float, default=30.0,
+        help="SIGTERM grace: /readyz flips to 503 and new admissions "
+        "refuse immediately; in-flight requests (live streams "
+        "included) get this long to finish before the process exits",
+    )
     ap.add_argument(
         "--sim-data-plane", action="store_true",
         help="in-cluster mode: wire an in-process SimBatcher data "
@@ -542,7 +629,44 @@ def main(argv=None) -> None:
             )
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
 
-    if args.fake_cluster:
+    if args.replica_endpoint:
+        # explicit-endpoint mode: a fabricated registry (the shared
+        # fake-cluster bring-up, so replica keys are DETERMINISTIC —
+        # every gateway process over the same endpoint list agrees) in
+        # front of REAL replica HTTP serving endpoints.  This is the
+        # multi-process deployment smoke: N gateway processes + one
+        # worker + one session store, no k8s.
+        from kubegpu_tpu.gateway.dataplane import HttpReplicaClient
+        from kubegpu_tpu.testing.fake_serving import (
+            build_fake_serving_stack,
+        )
+
+        # SORTED endpoints: replica keys are assigned in sorted order,
+        # so the key→endpoint binding must not depend on CLI argument
+        # order — two gateways given the same endpoints in different
+        # order would otherwise route one session to different workers
+        endpoints = sorted(args.replica_endpoint)
+        stack = build_fake_serving_stack(
+            len(endpoints), group=args.group
+        )
+        registry = stack.registry
+        client = HttpReplicaClient()
+        registry.refresh()
+        keys = sorted(r.key for r in registry.all())
+        for key, addr in zip(keys, endpoints):
+            client.set_endpoint(key, addr)
+        # real data-plane health: the fabricated annotations say every
+        # replica is fine forever; the probe is what drains a replica
+        # whose serving process died (and re-admits it after a cold
+        # restart on the same endpoint)
+        registry.probe = client.probe
+        registry.subscribe(client.sync_live)
+        registry.refresh()
+        log.info(
+            "explicit-endpoint data plane: %s",
+            dict(zip(keys, endpoints)),
+        )
+    elif args.fake_cluster:
         _, registry, client = _build_fake_serving_cluster(
             args.fake_cluster, args.replicas, args.group,
             token_budget=args.token_budget, speculate_k=args.speculate_k,
@@ -611,15 +735,45 @@ def main(argv=None) -> None:
                 "--sim-data-plane: serving FABRICATED tokens from "
                 "in-process SimBatchers — cluster smoke only"
             )
-    from kubegpu_tpu.gateway.failover import FailoverPolicy
+    from kubegpu_tpu.gateway.failover import FailoverPolicy, SessionKVStore
+    from kubegpu_tpu.utils.metrics import default_metrics
+
+    session_store = None
+    if args.session_store:
+        # the external insurance store: sealed-KV captures survive this
+        # pod's death.  Short per-op deadlines + breaker: with the
+        # store down, every session degrades to cold prefill at full
+        # speed (one fast-fail per op, never a connect timeout per
+        # request)
+        from kubegpu_tpu.gateway.sessionstore import HttpStoreClient
+
+        session_store = SessionKVStore(
+            backend=HttpStoreClient(
+                args.session_store, metrics=default_metrics
+            ),
+            metrics=default_metrics,
+        )
+        log.info("external session store: %s", args.session_store)
+
+    router = None
+    if args.router == "consistent-hash":
+        from kubegpu_tpu.gateway.router import ConsistentHashRouter
+
+        router = ConsistentHashRouter()
+    elif args.router == "affinity":
+        from kubegpu_tpu.gateway.router import SessionAffinityRouter
+
+        router = SessionAffinityRouter()
 
     gateway = Gateway(
         registry, client,
+        router=router,
         queue=AdmissionQueue(args.queue_capacity, args.per_tenant_cap),
         policy=FailoverPolicy(
             deadline_s=args.deadline, hedge_after_s=args.hedge_after
         ),
         dispatchers=args.dispatchers,
+        session_store=session_store,
     )
     host, _, port = args.listen.rpartition(":")
     server = GatewayServer(
@@ -629,15 +783,30 @@ def main(argv=None) -> None:
     )
     server.start()
     log.info("gateway listening on http://%s:%d", *server.address)
+    # a parseable announce line (the worker's REPLICA_HTTP_SERVING
+    # shape): subprocess harnesses bind port 0 and read the real one
+    print(f"GATEWAY_HTTP_SERVING port={server.address[1]}", flush=True)
     import signal
 
     shutdown = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *_: shutdown.set())
+    # SIGTERM = GRACEFUL: readyz 503 + refuse new admissions, finish
+    # in-flight streams within --drain-grace, then exit 0 — the
+    # per-instance lifecycle a load balancer can act on
+    signal.signal(
+        signal.SIGTERM,
+        lambda *_: server.begin_graceful_shutdown(
+            args.drain_grace, done=shutdown
+        ),
+    )
     try:
-        shutdown.wait()
+        # wait in a timeout LOOP: a bare Event.wait() parks the main
+        # thread in an uninterruptible lock acquire and the SIGTERM
+        # handler never runs — the graceful drain needs the main thread
+        # to keep servicing signals
+        while not shutdown.wait(0.2):
+            pass
     except KeyboardInterrupt:
-        pass
-    server.stop()
+        server.stop()
 
 
 if __name__ == "__main__":

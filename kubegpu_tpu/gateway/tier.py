@@ -115,8 +115,12 @@ class GatewayTier:
         self.tracer_factory = tracer_factory
         self.trace = trace
         # ONE store across the tier: insurance captured by any gateway
-        # restores through any sibling
-        self.session_store = session_store or SessionKVStore()
+        # restores through any sibling.  In-process that is one shared
+        # instance; a real multi-pod tier passes a store backed by the
+        # external StoreServer (sessionstore.HttpStoreClient) instead.
+        self.session_store = session_store or SessionKVStore(
+            metrics=self.metrics
+        )
         self._lock = threading.Lock()
         self._rr = 0
         self._ring = ConsistentHashRing(gateway_ids)

@@ -503,6 +503,10 @@ def _run_decode_batched(args, params, max_seq: int, t0: float) -> int:
         hidden=args.hidden, max_seq=max_seq,
         slots=args.batch_per_chip, prompt_pad=args.prompt_len,
     )
+    if args.serve_fp32:
+        import jax.numpy as jnp
+
+        common["dtype"] = jnp.float32
     if args.tp > 1 and args.serving != "paged":
         raise SystemExit(
             f"--tp {args.tp} with --serving {args.serving}: tensor-"
@@ -531,8 +535,20 @@ def _run_decode_batched(args, params, max_seq: int, t0: float) -> int:
         from kubegpu_tpu.models.paging import PagedContinuousBatcher
 
         # page must divide prompt_pad (whole-page admit scatter): 128 when
-        # it divides, else one page spans the whole prompt pad
-        page = 128 if args.prompt_len % 128 == 0 else args.prompt_len
+        # it divides, else one page spans the whole prompt pad.
+        # --page-size overrides: multi-turn decode sealing needs pages
+        # SMALLER than the prompt pad (a chain seals only FULL pages,
+        # and a follow turn's prompt must still fit prompt_pad)
+        if args.page_size is not None:
+            if args.page_size < 1 or args.prompt_len % args.page_size:
+                raise SystemExit(
+                    f"--page-size {args.page_size} must be positive and "
+                    f"divide --prompt-len {args.prompt_len} (whole-page "
+                    "admit scatter)"
+                )
+            page = args.page_size
+        else:
+            page = 128 if args.prompt_len % 128 == 0 else args.prompt_len
         slots = args.batch_per_chip
         mesh = None
         if args.tp > 1:
@@ -763,7 +779,14 @@ def _run_decode(args, t0: float) -> int:
         params32 = create_train_state(model, rng, sample).params
     from kubegpu_tpu.models.decoding import bf16_cast
 
-    params = bf16_cast(params32)
+    if args.serve_fp32:
+        # fp32 serving: exact greedy determinism across processes and
+        # byte-identical sealed decode pages — the precision class the
+        # decode-page-cache "fp32" policy shares at, and what the
+        # multi-process dryruns gate token identity on
+        params = params32
+    else:
+        params = bf16_cast(params32)
     del params32
     if args.int8:
         # weight-only int8 serving: half the HBM bytes per decode step
@@ -915,6 +938,19 @@ def main(argv=None) -> int:
                     "plain HTTP (loopback / single-tenant)")
     ap.add_argument("--serve-http-tls-key", default=None, metavar="PEM",
                     help="PEM private key for --serve-http-tls-cert")
+    ap.add_argument("--page-size", type=int, default=None,
+                    help="paged serving: KV page rows (must divide "
+                    "--prompt-len).  Default: one page spans the prompt "
+                    "pad (128 when it divides).  Set it SMALLER to seal "
+                    "multi-turn decode chains — a retired stream seals "
+                    "only FULL pages")
+    ap.add_argument("--serve-fp32", action="store_true",
+                    help="serve float32 weights instead of the bf16 "
+                    "cast: exact cross-process greedy determinism (the "
+                    "decode-page-cache 'fp32' sharing class; the "
+                    "multi-process dryruns gate token identity on it). "
+                    "Costs 2x parameter HBM — tiny-shape smoke and "
+                    "numerics-sensitive deployments only")
     ap.add_argument("--serve-http-auth-token-file", default=None,
                     metavar="FILE",
                     help="--serve-http: require 'Authorization: Bearer "

@@ -476,6 +476,17 @@ class GatewaySoak:
     stream, and page accounting holds on every replica whatever the
     combined gateway+replica kill schedule did.
 
+    ``store_chaos=True`` is the EXTERNAL-SESSION-STORE outage lane
+    (ISSUE 13): the sealed-KV insurance lives in a real ``StoreServer``
+    on loopback shared by every gateway through ``HttpStoreClient``
+    (tight per-op deadlines, fast breaker), and the op mix kills and
+    revives the store mid-schedule, arms forced CAS conflicts, and
+    lapses every lease.  The audited contract: I5 holds with ZERO
+    request errors attributable to the store — every store failure
+    resolves as a cold prefill counted in
+    ``gateway_session_store_degraded_total{reason}`` (the degraded-
+    event log and the metric must agree exactly at quiescence).
+
     Traffic comes from the shared ``testing/workload`` harness in every
     lane: the bursty-diurnal arrival process paced by a virtual clock,
     chatty agent sessions (follow turns materialized from parents'
@@ -485,7 +496,8 @@ class GatewaySoak:
     def __init__(self, seed: int, n_replicas: int = 4,
                  batcher_factory=None, multiturn: bool = False,
                  follow_prompt_cap: int = 12, http: bool = False,
-                 migration: bool = False, gateways: int = 1):
+                 migration: bool = False, gateways: int = 1,
+                 store_chaos: bool = False):
         from kubegpu_tpu.gateway import (
             AdmissionQueue, FailoverPolicy, Gateway, GatewayTier,
             HttpReplicaClient, InMemoryReplicaClient, ReplicaServer,
@@ -540,12 +552,41 @@ class GatewaySoak:
             self._tracers.append(t)
             return t
 
+        # store-chaos lane (ISSUE 13): the session-KV insurance lives
+        # in a REAL external StoreServer on loopback, shared by every
+        # gateway through an HttpStoreClient with tight deadlines and a
+        # fast breaker — the op mix then kills/revives the store and
+        # injects CAS conflicts + lease expiry.  The contract under
+        # audit: every store failure resolves as a COUNTED cold
+        # degradation (gateway_session_store_degraded_total), never a
+        # request error — I5 must hold through a store outage.
+        self.store_server = None
+        self.session_store = None
+        self.store_dead = False
+        if store_chaos:
+            from kubegpu_tpu.gateway import (
+                HttpStoreClient, SessionKVStore, StoreServer,
+            )
+
+            self.store_server = StoreServer(lease_s=None).start()
+            self._store_port = self.store_server.port
+            self.session_store = SessionKVStore(
+                backend=HttpStoreClient(
+                    self.store_server.url, timeout_s=0.5, retries=1,
+                    backoff_base_s=0.01, backoff_cap_s=0.05,
+                    breaker_threshold=3, breaker_cooldown_s=0.2,
+                    metrics=self.metrics,
+                ),
+                metrics=self.metrics,
+            )
+
         if gateways > 1:
             self.tier = GatewayTier(
                 self.registry, self.client, n_gateways=gateways,
                 policy=policy, metrics=self.metrics, dispatchers=8,
                 queue_factory=lambda: AdmissionQueue(capacity=64),
                 tracer_factory=_tracer,
+                session_store=self.session_store,
             )
             self.gw = None
             self.registry.refresh()
@@ -558,6 +599,7 @@ class GatewaySoak:
                 policy=policy,
                 metrics=self.metrics, dispatchers=8,
                 tracer=_tracer(),
+                session_store=self.session_store,
             )
             self.registry.refresh()
             self.gw.start()
@@ -889,6 +931,51 @@ class GatewaySoak:
         time.sleep(self.rng.choice([0.005, 0.02, 0.05]))
         return "settle"
 
+    # -- session-store chaos ops (store_chaos=True) --------------------------
+    def op_kill_store(self):
+        """The insurance store's pod dies mid-schedule: every gateway's
+        record/capture/restore ops start failing — the breaker turns
+        them into fast-fails, and every affected session must degrade
+        to a COUNTED cold prefill, never a request error."""
+        if self.store_server is None or self.store_dead:
+            return "kill-store (noop)"
+        self.store_server.stop()
+        self.store_dead = True
+        return "kill-store"
+
+    def op_revive_store(self):
+        """A replacement store pod on the same address (the Service's
+        view): EMPTY — the old entries died with the process, which is
+        fine by design (insurance loss = cold prefill, not an error).
+        The clients' breakers half-open and reconnect on their own."""
+        if self.store_server is None or not self.store_dead:
+            return "revive-store (noop)"
+        from kubegpu_tpu.gateway import StoreServer
+
+        self.store_server = StoreServer(
+            listen=("127.0.0.1", self._store_port), lease_s=None,
+        ).start()
+        self.store_dead = False
+        return "revive-store"
+
+    def op_store_conflict(self):
+        """Arm forced CAS conflicts: the next few puts (captures,
+        records) lose their version race — the capture must drop its
+        stale payload (counted) instead of landing it."""
+        if self.store_server is None or self.store_dead:
+            return "store-conflict (noop)"
+        self.store_server.backend.force_conflicts += 2
+        return "store-conflict (armed 2)"
+
+    def op_store_expire(self):
+        """Every session's lease lapses at once: the next read of any
+        entry answers lease_expired and the session restores cold
+        (counted)."""
+        if self.store_server is None or self.store_dead:
+            return "store-expire (noop)"
+        self.store_server.backend.expire_all()
+        return "store-expire"
+
     # -- gateway-tier ops (gateways > 1) ------------------------------------
     def _retryable(self, result) -> bool:
         """Did this request die WITH its gateway (retry on a sibling)?
@@ -1107,7 +1194,50 @@ class GatewaySoak:
             check = getattr(b, "assert_page_accounting", None)
             if check is not None:
                 check()
+        self.check_store_degradation(trace)
         self.check_traces(trace)
+
+    def check_store_degradation(self, trace: str):
+        """Store-chaos audit: every store failure the schedule caused
+        resolved as a COUNTED cold degradation — the degraded-event log
+        and the labeled metric agree, every reason is a documented one,
+        every degraded session belongs to real traffic, and (via the I5
+        assertions that already ran) every one of its requests still
+        completed ok/rejected.  Zero request errors attributable to the
+        store is I5 itself — this check pins the accounting."""
+        if self.session_store is None:
+            return
+        from kubegpu_tpu.gateway.sessionstore import DEGRADE_REASONS
+
+        # settle the async capture queue first: a capture still in
+        # flight could append a degrade event between the log snapshot
+        # and the metric read (Gateway.drain covers requests, not the
+        # capture thread)
+        assert self.session_store.flush_captures(30.0), (
+            "capture queue failed to settle at quiescence"
+        )
+        log = list(self.session_store.degraded_log)
+        counted = sum(
+            self.metrics.get(
+                "gateway_session_store_degraded_total", reason=r
+            )
+            for r in DEGRADE_REASONS
+        )
+        assert counted == len(log), (
+            f"store degradations miscounted: metric {counted} != "
+            f"log {len(log)}\n{trace}"
+        )
+        known_sessions = {
+            getattr(r, "session", None)
+            for r in self._requests.values()
+        }
+        for session, reason in log:
+            assert reason in DEGRADE_REASONS, (
+                f"undocumented degrade reason {reason!r}\n{trace}"
+            )
+            assert session in known_sessions, (
+                f"degraded session {session!r} matches no request\n{trace}"
+            )
 
     def check_traces(self, trace: str):
         """I5 re-derived from spans: every request yields COMPLETE,
@@ -1163,6 +1293,8 @@ class GatewaySoak:
         every request whose gateway died under it is re-submitted
         through a surviving sibling until its final handle is a real
         terminal (ok / rejected / genuine failure)."""
+        if self.store_dead:
+            self.op_revive_store()
         while self.dead:
             self.op_revive_replica()
         while self.dead_gateways:
@@ -1215,6 +1347,17 @@ class GatewaySoak:
                 (self.op_kill_mid_migration, 1),
                 (self.op_refuse_migration, 1),
             ]
+        if self.store_server is not None:
+            # the store-outage lane: the insurance store dies and
+            # revives mid-schedule, captures lose CAS races, leases
+            # lapse — all of it must resolve as counted cold
+            # degradations with I5 intact
+            ops += [
+                (self.op_kill_store, 1),
+                (self.op_revive_store, 1),
+                (self.op_store_conflict, 1),
+                (self.op_store_expire, 1),
+            ]
         if self.tier is not None:
             # the tier chaos lane: gateway deaths, hedged greedy
             # streams, and mid-stream gateway failovers — I5 holds
@@ -1239,3 +1382,7 @@ class GatewaySoak:
             self.client.stop()
             for srv in self.servers.values():
                 srv.stop()
+            if self.session_store is not None:
+                self.session_store.close()
+            if self.store_server is not None and not self.store_dead:
+                self.store_server.stop()

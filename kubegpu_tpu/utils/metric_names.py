@@ -99,6 +99,45 @@ CATALOG: Dict[str, MetricSpec] = {
         (), "graceful replica drains started (DRAINING -> released "
         "lifecycles)"),
 
+    # -- session-KV store, gateway side (gateway/sessionstore.py):
+    #    degradation accounting for the external insurance store
+    "gateway_session_store_degraded_total": _c(
+        ("reason",), "sessions degraded to cold prefill by store "
+        "trouble, by reason (unreachable = store down/breaker open; "
+        "cas_conflict = a capture lost its versioned put race; "
+        "lease_expired = the session's store lease lapsed).  Never a "
+        "request error — degradation IS the contract"),
+    "gateway_session_store_retries_total": _c(
+        (), "store op retries after a transport failure (bounded, "
+        "exponential backoff + jitter)"),
+    "gateway_session_store_fastfail_total": _c(
+        (), "store ops fast-failed by the open circuit breaker (a dead "
+        "store costs microseconds per op, not a deadline)"),
+    "gateway_session_store_capture_drops_total": _c(
+        (), "queued sealed-KV captures dropped oldest-first by the "
+        "bounded async write-through queue (capture is insurance, "
+        "never admission-blocking)"),
+
+    # -- session-KV store, server side (gateway/sessionstore.py
+    #    StoreServer — the standalone store pod's own /metrics)
+    "session_store_requests_total": _c(
+        ("verb",), "store requests by verb (get/put/list/mark/delete)"),
+    "session_store_cas_conflicts_total": _c(
+        (), "versioned puts refused because the session's version "
+        "moved (a stale capture losing to a newer seal — the two-"
+        "gateway race the CAS exists for)"),
+    "session_store_lease_expired_total": _c(
+        (), "sessions dropped because their lease lapsed (reads after "
+        "expiry answer 404 reason=lease_expired; the gateway degrades "
+        "to cold prefill)"),
+    "session_store_payloads_dropped_total": _c(
+        (), "sealed-KV payloads evicted oldest-first by the byte-"
+        "bounded LRU (streams stay; those sessions restore cold)"),
+    "session_store_sessions": _g((), "session entries resident"),
+    "session_store_payload_bytes": _g(
+        (), "total retained sealed-KV payload bytes (bounded by "
+        "--max-payload-bytes, default 256 MiB)"),
+
     # -- gateway streaming pass-through (gateway/server.py, failover.py)
     "gateway_stream_requests_total": _c(
         (), "streaming (SSE) /v1/generate requests accepted"),

@@ -183,15 +183,14 @@ def test_mispinned_session_restores_before_dispatch():
     store = SessionKVStore()
     client = _FakeClient()
     store.record("sess", "replica-A", [1, 2, 3])
-    e = store._entries["sess"]
-    store._set_payload_locked(e, {"blob": "kv"})
+    assert store.set_payload("sess", {"blob": "kv"})
     req = _Req("sess")
     # dispatch to the home: no-op
     assert not store.restore_for(req, "replica-A", client)
     # dispatch elsewhere (mispin): restore fires and re-homes
     assert store.restore_for(req, "replica-B", client)
     assert client.imports == [("replica-B", "kv")]
-    assert store._entries["sess"]["replica"] == "replica-B"
+    assert store.entry("sess")["replica"] == "replica-B"
 
 
 # ---------------------------------------------------------------------------

@@ -561,8 +561,10 @@ def test_sealed_restore_after_replica_death(params):
         assert r1.wait(120) and r1.result().status == "ok", r1.result()
         home = r1.result().replica
         stream = p1 + r1.result().tokens
-        # the insurance was captured while the replica lived
-        entry = gw.session_store._entries["s"]
+        # the insurance was captured while the replica lived (the
+        # capture writes through asynchronously — flush it)
+        assert gw.session_store.flush_captures(30.0)
+        entry = gw.session_store.entry("s")
         assert entry["payload"] is not None
         assert entry["replica"] == home
 

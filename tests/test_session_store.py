@@ -1,0 +1,771 @@
+"""Crash-durable session-KV store (ISSUE 13).
+
+Layers under test:
+
+- the backend contract — versioned CAS puts (a stale capture loses to
+  a newer seal), per-session leases on a fake clock, the byte-bounded
+  payload LRU (oldest payloads drop, stream records stay), and the
+  session-count bound;
+- the standalone ``StoreServer`` — wire protocol round-trips (get /
+  put / 409 / list / mark / delete / healthz / metrics) and the
+  numpy↔base64 payload codec through ``HttpStoreClient``;
+- the failure discipline — bounded retry with exponential backoff +
+  jitter and the circuit breaker, unit-tested on a fake clock with a
+  fake transport (a dead store costs one fast-fail per op, never a
+  deadline per request);
+- equivalence — the SAME capture/restore sequence against the
+  in-process backend and the HTTP store yields the same
+  ``restore_for`` outcomes and byte-identical restored payloads;
+- ``SessionKVStore`` semantics — async write-through captures (bounded
+  queue, drop-oldest, per-session dedup), degradation accounting
+  (``gateway_session_store_degraded_total{reason}`` mirrors the
+  degraded-event log), restore into the SAME pod name after a cold
+  restart, and insurance surviving a gateway instance's death;
+- the gateway lifecycle — /readyz per-instance readiness and graceful
+  shutdown: a drain flips /readyz to 503 and refuses new admissions
+  with the retryable error while a LIVE STREAM runs to completion;
+- the store-outage soak — ``GatewaySoak(store_chaos=True)`` in the
+  in-memory and HTTP lanes (and a slow paged multiturn lane): kills /
+  revives of the store, forced CAS conflicts and lease expiry must
+  all resolve as counted cold degradations with I5 intact.
+"""
+
+import http.client
+import json
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubegpu_tpu.gateway import (
+    CircuitBreaker,
+    GatewayRequest,
+    HttpStoreClient,
+    InProcessStoreBackend,
+    SessionKVStore,
+    StoreServer,
+)
+from kubegpu_tpu.gateway.sessionstore import (
+    DEGRADE_REASONS,
+    payload_bytes,
+)
+from kubegpu_tpu.utils.metrics import Metrics
+
+
+class _Req:
+    def __init__(self, session):
+        self.session = session
+
+
+class _FakeReplicaClient:
+    """The sealed-chain client surface: exports a canned payload,
+    records imports."""
+
+    def __init__(self, payload=None):
+        self.payload = payload if payload is not None else {"blob": "kv"}
+        self.imports = []
+
+    def export_sealed(self, key, stream):
+        return dict(self.payload, exported_from=key,
+                    stream_len=len(stream))
+
+    def import_sealed(self, key, payload):
+        self.imports.append((key, payload))
+        return True
+
+
+# ---------------------------------------------------------------------------
+# 1. backend: CAS, leases, byte bound
+# ---------------------------------------------------------------------------
+
+def entry(replica="rA", stream=(1, 2, 3), payload=None, lost=False):
+    return {"replica": replica, "stream": list(stream),
+            "payload": payload, "lost": lost}
+
+
+def test_backend_versions_and_cas():
+    b = InProcessStoreBackend()
+    assert b.get("s").status == "absent"
+    r1 = b.put("s", entry())
+    assert (r1.status, r1.version) == ("ok", 1)
+    # unconditional put supersedes (a new turn)
+    r2 = b.put("s", entry(stream=[1, 2, 3, 4]))
+    assert (r2.status, r2.version) == ("ok", 2)
+    # a CAS against the superseded version LOSES — the stale-capture race
+    assert b.put("s", entry(payload={"old": 1}),
+                 if_version=1).status == "conflict"
+    got = b.get("s")
+    assert got.entry["payload"] is None and got.version == 2
+    # the CURRENT version wins
+    r3 = b.put("s", entry(payload={"new": 1}), if_version=2)
+    assert (r3.status, r3.version) == ("ok", 3)
+    # marks bump versions: a capture racing a lost-mark must lose too
+    b.mark_lost("rA")
+    got = b.get("s")
+    assert got.entry["lost"] and got.version == 4
+    assert b.put("s", entry(), if_version=3).status == "conflict"
+    # CAS against an absent session is a conflict, not a create
+    assert b.put("zzz", entry(), if_version=1).status == "conflict"
+    assert b.get("zzz").status == "absent"
+
+
+def test_backend_lease_expiry_on_fake_clock():
+    now = [0.0]
+    m = Metrics()
+    b = InProcessStoreBackend(lease_s=10.0, clock=lambda: now[0],
+                              metrics=m)
+    b.put("s", entry())
+    now[0] = 9.9
+    assert b.get("s").status == "ok"
+    # every put RENEWS the lease
+    b.put("s", entry(stream=[1]))
+    now[0] = 19.0
+    assert b.get("s").status == "ok"
+    now[0] = 30.0
+    assert b.get("s").status == "expired"
+    assert m.get("session_store_lease_expired_total") == 1
+    # expired is terminal: the entry is gone, a fresh put recreates at v1
+    assert b.get("s").status == "absent"
+    assert b.put("s", entry()).version == 1
+    # chaos knob: expire_all lapses every lease now
+    b.expire_all()
+    assert b.get("s").status == "expired"
+
+
+def test_backend_byte_bound_drops_oldest_payloads_property():
+    rng = random.Random(7)
+    m = Metrics()
+    cap = 4000
+    b = InProcessStoreBackend(max_payload_bytes=cap, metrics=m)
+    live_payloads = {}
+    for i in range(120):
+        s = f"s{rng.randrange(30)}"
+        size = rng.randrange(0, 900)
+        payload = (
+            {"layers": [{"k": "x" * size, "v": "y" * size}]}
+            if size else None
+        )
+        b.put(s, entry(stream=[i], payload=payload))
+        live_payloads[s] = payload
+        # invariant: retained payload bytes within budget, and every
+        # entry's STREAM record survived whatever was evicted
+        total = 0
+        for sess in list(live_payloads):
+            got = b.get(sess)
+            assert got.status == "ok"
+            assert got.entry["stream"], sess
+            total += payload_bytes(got.entry["payload"])
+        assert total <= cap
+        # the entry just written keeps its payload (evict-OLDEST)
+        assert payload_bytes(b.get(s).entry["payload"]) == \
+            payload_bytes(payload)
+    assert m.get("session_store_payloads_dropped_total") > 0
+
+
+def test_backend_session_count_bound():
+    b = InProcessStoreBackend(max_sessions=5)
+    for i in range(9):
+        b.put(f"s{i}", entry(stream=[i]))
+    assert b.get("s0").status == "absent"
+    assert b.get("s8").status == "ok"
+    assert b.stats()["sessions"] == 5
+
+
+# ---------------------------------------------------------------------------
+# 2. the HTTP store
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def store():
+    srv = StoreServer(lease_s=None).start()
+    try:
+        yield srv
+    finally:
+        srv.stop()
+
+
+def test_store_server_wire_roundtrip(store):
+    c = HttpStoreClient(store.url)
+    assert c.healthy()
+    assert c.get("s").status == "absent"
+    r = c.put("s", entry(replica="rA"))
+    assert (r.status, r.version) == ("ok", 1)
+    got = c.get("s")
+    assert got.status == "ok" and got.entry["replica"] == "rA"
+    assert c.put("s", entry(payload={"x": 1}),
+                 if_version=99).status == "conflict"
+    assert c.put("s", entry(replica="rB"), if_version=1).status == "ok"
+    assert c.sessions_on("rB") == ["s"]
+    assert c.sessions_on("rA") == []
+    assert c.mark_lost("rB")
+    assert c.get("s").entry["lost"] is True
+    assert c.sync_live(["rC"])           # rB not live -> stays lost
+    assert c.delete("s").status == "ok"
+    assert c.get("s").status == "absent"
+    # server-side metrics render (the store pod's own /metrics)
+    conn = http.client.HTTPConnection(*store.address, timeout=5)
+    conn.request("GET", "/metrics")
+    text = conn.getresponse().read().decode()
+    conn.close()
+    assert "session_store_requests_total" in text
+    assert "session_store_cas_conflicts_total" in text
+
+
+def test_http_payload_codec_roundtrips_numpy(store):
+    c = HttpStoreClient(store.url)
+    k = np.arange(24, dtype=np.float32).reshape(2, 12)
+    v = (k * 2).astype(np.float32)
+    payload = {
+        "kind": "sealed", "page_keys": ["a", "b"],
+        "geometry": {"dtype": "float32"},
+        "layers": [(k, v)],
+    }
+    assert c.put("np", entry(payload=payload)).status == "ok"
+    got = c.get("np").entry["payload"]
+    assert got["page_keys"] == ["a", "b"]
+    gk, gv = got["layers"][0]
+    np.testing.assert_array_equal(np.asarray(gk), k)
+    np.testing.assert_array_equal(np.asarray(gv), v)
+    # an ALREADY-wire payload (the HttpReplicaClient export shape)
+    # relays opaquely — no double-encode
+    wire = {"kind": "sealed", "layers": [{"k": "QUJD", "v": "REVG",
+                                          "shape": [1, 3]}]}
+    assert c.put("wire", entry(payload=wire)).status == "ok"
+    assert c.get("wire").entry["payload"]["layers"][0]["k"] == "QUJD"
+
+
+def test_store_lease_expiry_degrades_restore():
+    srv = StoreServer(lease_s=0.05).start()
+    try:
+        m = Metrics()
+        kv = SessionKVStore(backend=HttpStoreClient(srv.url), metrics=m)
+        client = _FakeReplicaClient()
+        kv.record("s", "rA", [1, 2, 3])
+        assert kv.capture(client, "s")
+        time.sleep(0.15)
+        assert not kv.restore_for(_Req("s"), "rB", client)
+        assert kv.degraded_log == [("s", "lease_expired")]
+        assert m.get("gateway_session_store_degraded_total",
+                     reason="lease_expired") == 1
+        assert client.imports == []
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# 3. breaker + backoff on a fake clock
+# ---------------------------------------------------------------------------
+
+def _fake_client(fail_plan, now, sleeps, **kw):
+    """HttpStoreClient with a scripted transport: each _do call pops
+    the next plan item — an Exception to raise or a (status, payload)
+    to return."""
+    kw.setdefault("timeout_s", 0.1)
+    kw.setdefault("backoff_base_s", 0.05)
+    kw.setdefault("backoff_cap_s", 0.4)
+    c = HttpStoreClient(
+        "http://127.0.0.1:1", clock=lambda: now[0],
+        sleep=sleeps.append, rng=random.Random(3), **kw
+    )
+    calls = []
+
+    def do(method, path, body=None):
+        calls.append((method, path))
+        action = fail_plan.pop(0)
+        if isinstance(action, Exception):
+            raise action
+        return action
+
+    c._do = do
+    c._calls = calls
+    return c
+
+
+def test_retry_backoff_shape_and_jitter():
+    now, sleeps = [0.0], []
+    plan = [OSError("down")] * 4
+    c = _fake_client(plan, now, sleeps, retries=3, breaker_threshold=99)
+    assert c.get("s").status == "unreachable"
+    assert len(c._calls) == 4          # 1 try + 3 retries, bounded
+    assert len(sleeps) == 3
+    # exponential shape with jitter in [0.5, 1.5)x of base * 2^k
+    for k, s in enumerate(sleeps):
+        base = min(0.05 * 2 ** k, 0.4)
+        assert 0.5 * base <= s < 1.5 * base, (k, s)
+
+
+def test_breaker_opens_fastfails_and_half_opens():
+    now, sleeps = [0.0], []
+    m = Metrics()
+    plan = [OSError("down")] * 3 + [(200, {"version": 1})]
+    c = _fake_client(plan, now, sleeps, retries=0, breaker_threshold=3,
+                     breaker_cooldown_s=5.0, metrics=m)
+    for _ in range(3):
+        assert c.put("s", entry()).status == "unreachable"
+    assert c.breaker.open and c.breaker.trips == 1
+    n_calls = len(c._calls)
+    # open window: fast-fail, the transport is NOT touched
+    for _ in range(5):
+        assert c.get("s").status == "unreachable"
+    assert len(c._calls) == n_calls
+    assert m.get("gateway_session_store_fastfail_total") == 5
+    # past the cooldown: one half-open trial; success closes
+    now[0] = 6.0
+    assert c.put("s", entry()).status == "ok"
+    assert not c.breaker.open and c.breaker.failures == 0
+
+
+def test_breaker_reopens_on_failed_half_open_trial():
+    now, sleeps = [0.0], []
+    plan = [OSError("down")] * 4
+    c = _fake_client(plan, now, sleeps, retries=0, breaker_threshold=3,
+                     breaker_cooldown_s=5.0)
+    for _ in range(3):
+        c.get("s")
+    assert c.breaker.open
+    now[0] = 5.5
+    assert c.get("s").status == "unreachable"   # trial fails
+    assert c.breaker.open and c.breaker.trips == 2
+
+
+def test_breaker_half_open_admits_exactly_one_trial():
+    """At cooldown expiry only ONE op may probe the store; the rest
+    keep fast-failing until the trial reports back — N dispatcher
+    threads must not all stall an op deadline against a hung store."""
+    now = [0.0]
+    b = CircuitBreaker(threshold=1, cooldown_s=5.0, clock=lambda: now[0])
+    b.failure()
+    assert b.open and not b.allow()
+    now[0] = 6.0
+    assert b.allow()              # the single half-open trial
+    assert not b.allow()          # concurrent callers: fast-fail
+    b.failure()                   # trial failed: re-open a full window
+    assert not b.allow()
+    now[0] = 12.0
+    assert b.allow()
+    b.success()                   # trial succeeded: closed
+    assert b.allow() and b.allow()
+
+
+def test_retries_do_not_burn_time_once_breaker_opens():
+    now, sleeps = [0.0], []
+    plan = [OSError("down")] * 2
+    c = _fake_client(plan, now, sleeps, retries=5, breaker_threshold=2,
+                     breaker_cooldown_s=60.0)
+    assert c.get("s").status == "unreachable"
+    # the 2nd failure tripped the breaker mid-retry-loop: the remaining
+    # retries are abandoned instead of sleeping through 4 more backoffs
+    assert len(c._calls) == 2
+    assert len(sleeps) == 1
+
+
+# ---------------------------------------------------------------------------
+# 4. HTTP-vs-in-process equivalence
+# ---------------------------------------------------------------------------
+
+def _capture_restore_script(kv, client):
+    """The same capture/restore life a gateway drives, as data."""
+    out = []
+    kv.record("sess", "rA", [1, 2, 3])
+    out.append(("capture", kv.capture(client, "sess")))
+    # healthy home: no restore
+    out.append(("home", kv.restore_for(_Req("sess"), "rA", client)))
+    # away-dispatch (ring mispin): restore + re-home
+    out.append(("mispin", kv.restore_for(_Req("sess"), "rB", client)))
+    out.append(("rehomed", kv.entry("sess")["replica"]))
+    # plain-LB mode: a healthy-home bounce must NOT ship the payload
+    kv.record("s2", "rA", [4, 5])
+    out.append(("cap2", kv.capture(client, "s2")))
+    out.append(("lb", kv.restore_for(_Req("s2"), "rB", client,
+                                     mispin_restore=False)))
+    # ... but a LOST home restores even under a plain LB
+    kv.mark_lost("rA")
+    out.append(("lost", kv.restore_for(_Req("s2"), "rB", client,
+                                       mispin_restore=False)))
+    # unknown session / payload-less session: clean no-ops
+    out.append(("unknown", kv.restore_for(_Req("nope"), "rB", client)))
+    kv.record("s3", "rC", [6])
+    out.append(("no-payload", kv.restore_for(_Req("s3"), "rB", client)))
+    out.append(("sessions_on", sorted(kv.sessions_on("rB"))))
+    return out
+
+
+def test_http_vs_inprocess_backend_equivalence():
+    k = np.arange(8, dtype=np.float32).reshape(1, 8)
+    payload = {
+        "kind": "sealed", "page_keys": ["p0"],
+        "geometry": {"dtype": "float32"}, "layers": [(k, k + 1)],
+    }
+    in_client = _FakeReplicaClient(payload)
+    kv_in = SessionKVStore()
+    script_in = _capture_restore_script(kv_in, in_client)
+    srv = StoreServer(lease_s=None).start()
+    try:
+        http_client = _FakeReplicaClient(payload)
+        kv_http = SessionKVStore(backend=HttpStoreClient(srv.url))
+        script_http = _capture_restore_script(kv_http, http_client)
+        assert script_in == script_http, (
+            "the HTTP store and the in-process backend diverged on the "
+            f"same sequence:\n{script_in}\nvs\n{script_http}"
+        )
+        assert len(in_client.imports) == len(http_client.imports)
+        for (k1, p1), (k2, p2) in zip(in_client.imports,
+                                      http_client.imports):
+            assert k1 == k2
+            np.testing.assert_array_equal(
+                np.asarray(p1["layers"][0][0]),
+                np.asarray(p2["layers"][0][0]),
+            )
+        assert kv_in.degraded_log == kv_http.degraded_log == []
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# 5. SessionKVStore semantics
+# ---------------------------------------------------------------------------
+
+def test_async_capture_is_bounded_drop_oldest_and_deduped():
+    m = Metrics()
+    kv = SessionKVStore(metrics=m, capture_queue=2)
+    gate = threading.Event()
+    captured = []
+
+    class _SlowClient:
+        def export_sealed(self, key, stream):
+            gate.wait(5.0)
+            captured.append(key)
+            return {"blob": key}
+
+        def import_sealed(self, key, payload):
+            return True
+
+    client = _SlowClient()
+    for i in range(5):
+        kv.record(f"s{i}", f"r{i}", [i])
+        kv.capture_async(client, f"s{i}")
+    # dedup: re-queueing a session folds, not grows
+    kv.capture_async(client, "s4")
+    gate.set()
+    assert kv.flush_captures(10.0)
+    # bounded at 2: the OLDEST queued captures dropped (the first may
+    # already be in flight when the queue clamps — so at least 2 drops)
+    assert m.get("gateway_session_store_capture_drops_total") >= 2
+    # the NEWEST sessions' insurance landed
+    assert kv.entry("s4")["payload"] == {"blob": "r4"}
+    kv.close()
+
+
+def test_restore_fires_into_same_pod_name_after_loss():
+    """A replica that cold-restarts under the SAME name (pod restart,
+    same Service endpoint) lost its cache: a LOST entry must restore
+    even when the routed target equals the recorded home."""
+    kv = SessionKVStore()
+    client = _FakeReplicaClient()
+    kv.record("s", "rA", [1, 2])
+    assert kv.capture(client, "s")
+    # healthy home: no-op (the replica has its own cache)
+    assert not kv.restore_for(_Req("s"), "rA", client)
+    kv.sync_live(["rB"])          # rA left the live set (died)...
+    kv.sync_live(["rA", "rB"])    # ...and came back, cold
+    assert kv.restore_for(_Req("s"), "rA", client)
+    assert client.imports and client.imports[0][0] == "rA"
+    # restored: the entry is no longer lost, the next turn is a no-op
+    assert not kv.restore_for(_Req("s"), "rA", client)
+
+
+def test_restore_noop_is_metadata_only_and_restores_fetch_full():
+    """restore_for runs on the dispatch hot path for EVERY sessionful
+    request: the common healthy-home no-op must decide on a metadata
+    read (no payload bytes), and only an actual restore pays the full
+    fetch."""
+    calls = []
+
+    class _Spy(InProcessStoreBackend):
+        def get(self, session, meta=False):
+            calls.append(meta)
+            return super().get(session, meta=meta)
+
+    kv = SessionKVStore(backend=_Spy())
+    client = _FakeReplicaClient()
+    kv.record("s", "rA", [1, 2])
+    assert kv.capture(client, "s")
+    calls.clear()
+    assert not kv.restore_for(_Req("s"), "rA", client)
+    assert calls == [True], "healthy-home no-op fetched the payload"
+    calls.clear()
+    assert kv.restore_for(_Req("s"), "rB", client)
+    assert calls == [True, False], "restore must re-read the full entry"
+
+
+def test_meta_get_strips_payload_on_both_backends(store):
+    payload = {"layers": [{"k": "x" * 64, "v": "y" * 64}]}
+    for backend in (InProcessStoreBackend(), HttpStoreClient(store.url)):
+        backend.put("s", entry(payload=payload))
+        got = backend.get("s", meta=True)
+        assert got.status == "ok" and got.version == 1
+        assert got.entry["payload"] is None
+        assert got.entry["payload_present"] is True
+        full = backend.get("s")
+        assert full.entry["payload"] == payload
+        assert "payload_present" not in full.entry
+
+
+def test_capture_cas_conflict_counts_and_keeps_newer_entry():
+    m = Metrics()
+    backend = InProcessStoreBackend()
+    kv = SessionKVStore(backend=backend, metrics=m)
+    client = _FakeReplicaClient()
+    kv.record("s", "rA", [1, 2, 3])
+    backend.force_conflicts = 1
+    assert not kv.capture(client, "s")
+    assert kv.degraded_log == [("s", "cas_conflict")]
+    assert m.get("gateway_session_store_degraded_total",
+                 reason="cas_conflict") == 1
+    assert kv.entry("s")["payload"] is None
+    # the next capture (no conflict) lands
+    assert kv.capture(client, "s")
+    assert kv.entry("s")["payload"] is not None
+
+
+def test_unreachable_store_degrades_and_counts():
+    m = Metrics()
+    kv = SessionKVStore(
+        backend=HttpStoreClient(
+            "http://127.0.0.1:9", timeout_s=0.2, retries=0,
+            breaker_threshold=2, breaker_cooldown_s=60.0, metrics=m,
+        ),
+        metrics=m,
+    )
+    client = _FakeReplicaClient()
+    kv.record("s", "rA", [1])            # degrade 1 (unreachable)
+    assert not kv.restore_for(_Req("s"), "rB", client)   # degrade 2
+    assert not kv.capture(client, "s")                   # degrade 3
+    assert [r for _, r in kv.degraded_log] == ["unreachable"] * 3
+    total = sum(
+        m.get("gateway_session_store_degraded_total", reason=r)
+        for r in DEGRADE_REASONS
+    )
+    assert total == len(kv.degraded_log) == 3
+    # the breaker opened after 2 failures: later ops fast-failed
+    assert m.get("gateway_session_store_fastfail_total") >= 1
+    assert client.imports == []
+
+
+def test_insurance_survives_gateway_instance_death():
+    """Two SessionKVStore INSTANCES (two gateway pods) over one
+    external store: pod A records + captures, pod A 'dies' (its store
+    object is simply dropped), pod B restores the session — the whole
+    point of the external store."""
+    srv = StoreServer(lease_s=None).start()
+    try:
+        client = _FakeReplicaClient()
+        kv_a = SessionKVStore(backend=HttpStoreClient(srv.url))
+        kv_a.record("s", "rA", [1, 2, 3])
+        assert kv_a.capture(client, "s")
+        del kv_a                       # the pod is gone
+        kv_b = SessionKVStore(backend=HttpStoreClient(srv.url))
+        kv_b.sync_live(["rB"])         # rA died with its pages
+        assert kv_b.restore_for(_Req("s"), "rB", client)
+        assert client.imports and client.imports[0][0] == "rB"
+        assert kv_b.entry("s")["replica"] == "rB"
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# 6. gateway lifecycle: per-instance /readyz + graceful shutdown
+# ---------------------------------------------------------------------------
+
+def _gateway_server(step_delay_s=0.01):
+    from kubegpu_tpu.gateway import (
+        Gateway, GatewayServer, InMemoryReplicaClient, SimBatcher,
+    )
+    from kubegpu_tpu.testing.fake_serving import build_fake_serving_stack
+
+    stack = build_fake_serving_stack(2)
+    client = InMemoryReplicaClient(
+        batcher_factory=lambda key: SimBatcher(slots=8),
+        step_delay_s=step_delay_s,
+    )
+    stack.registry.subscribe(client.sync_live)
+    gw = Gateway(stack.registry, client, metrics=Metrics(),
+                 dispatchers=4)
+    server = GatewayServer(gw, listen=("127.0.0.1", 0), watch=False)
+    server.start()
+    return stack, client, gw, server
+
+
+def _get(port, path, timeout=10):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def test_graceful_shutdown_finishes_live_stream_and_flips_readyz():
+    stack, client, gw, server = _gateway_server()
+    host, port = server.address
+    try:
+        assert _get(port, "/readyz")[0] == 200
+        # open a live greedy stream
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        conn.request(
+            "POST", "/v1/generate",
+            json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 40,
+                        "stream": True}),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        # wait for first tokens so the drain provably crosses a LIVE
+        # stream
+        got, done_payload = [], None
+        event = data = ""
+        saw_tokens = threading.Event()
+
+        def read_stream():
+            nonlocal done_payload, event, data
+            while True:
+                line = resp.readline()
+                if not line:
+                    return
+                line = line.strip().decode()
+                if line.startswith("event:"):
+                    event = line[6:].strip()
+                elif line.startswith("data:"):
+                    data = line[5:].strip()
+                elif not line and event:
+                    payload = json.loads(data) if data else {}
+                    if event == "tokens":
+                        got.extend(payload["tokens"])
+                        saw_tokens.set()
+                    else:
+                        done_payload = (event, payload)
+                        return
+                    event, data = "", ""
+
+        reader = threading.Thread(target=read_stream, daemon=True)
+        reader.start()
+        assert saw_tokens.wait(20.0), "no tokens before the drain"
+
+        done = threading.Event()
+        server.begin_graceful_shutdown(grace_s=30.0, done=done)
+        # draining: /readyz 503, new admissions refuse RETRYABLY
+        assert gw.draining and not gw.accepting
+        status, body = _get(port, "/readyz")
+        assert status == 503 and b"draining" in body
+        conn2 = http.client.HTTPConnection(host, port, timeout=10)
+        conn2.request(
+            "POST", "/v1/generate",
+            json.dumps({"prompt": [9], "max_new_tokens": 2}),
+            {"Content-Type": "application/json"},
+        )
+        r2 = conn2.getresponse()
+        refused = json.loads(r2.read())
+        conn2.close()
+        assert r2.status == 502
+        assert "shutting down" in refused["error"]
+        # the live stream FINISHES across the drain
+        reader.join(30.0)
+        assert done_payload is not None and done_payload[0] == "done", (
+            done_payload,
+        )
+        assert len(done_payload[1]["tokens"]) == 40
+        assert got == done_payload[1]["tokens"]
+        conn.close()
+        assert done.wait(30.0), "graceful shutdown never completed"
+        assert not gw.alive
+    finally:
+        client.stop()
+        if gw.alive:
+            server.stop()
+
+
+def test_readyz_reports_draining_before_replica_state():
+    stack, client, gw, server = _gateway_server()
+    port = server.address[1]
+    try:
+        assert _get(port, "/readyz")[0] == 200
+        gw.begin_drain()
+        status, body = _get(port, "/readyz")
+        assert (status, body) == (503, b"draining")
+        # draining refuses with the tier-retryable error
+        res = gw.submit_and_wait(GatewayRequest(
+            prompt=[1], max_new_tokens=1, request_id="late",
+        ))
+        assert res.status == "error" and "shutting down" in res.error
+        from kubegpu_tpu.gateway import is_gateway_death
+
+        assert is_gateway_death(res)
+    finally:
+        server.stop()
+        client.stop()
+
+
+# ---------------------------------------------------------------------------
+# 7. the store-outage soak (both lanes; paged lane slow)
+# ---------------------------------------------------------------------------
+
+def test_gateway_soak_store_chaos_inmemory_tier():
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    GatewaySoak(seed=1103, gateways=2, store_chaos=True).run(40)
+
+
+def test_gateway_soak_store_chaos_http():
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    GatewaySoak(seed=1104, http=True, store_chaos=True).run(30)
+
+
+@pytest.mark.slow
+def test_gateway_soak_store_chaos_paged_multiturn():
+    import jax
+    import jax.numpy as jnp
+
+    from kubegpu_tpu.models import TransformerLM
+    from kubegpu_tpu.models.paging import PagedContinuousBatcher
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    cfg = dict(vocab_size=64, num_layers=1, num_heads=2, hidden=16,
+               max_seq=64)
+    params = TransformerLM(dtype=jnp.float32, **cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32)
+    )["params"]
+
+    def factory(key):
+        return PagedContinuousBatcher(
+            params, dtype=jnp.float32, slots=4, prompt_pad=16,
+            page_size=4, pool_pages=48, decode_page_cache="fp32", **cfg,
+        )
+
+    GatewaySoak(
+        seed=1105, n_replicas=2, batcher_factory=factory,
+        multiturn=True, follow_prompt_cap=16, store_chaos=True,
+    ).run(25)
+
+
+# ---------------------------------------------------------------------------
+# 8. deployment manifests
+# ---------------------------------------------------------------------------
+
+def test_deploy_manifests_wire_the_store():
+    from pathlib import Path
+
+    deploy = Path(__file__).resolve().parent.parent / "deploy"
+    store_yaml = (deploy / "session-store.yaml").read_text()
+    assert "kubegpu_tpu.gateway.sessionstore" in store_yaml
+    assert "/healthz" in store_yaml
+    gateway_yaml = (deploy / "gateway.yaml").read_text()
+    assert "--session-store" in gateway_yaml
+    assert "replicas: 2" in gateway_yaml
+    # the entrypoint is a real module with a main()
+    from kubegpu_tpu.gateway import sessionstore
+
+    assert callable(sessionstore.main)
