@@ -109,13 +109,19 @@ multichip-smoke:
 # the session's next turn restores sealed KV from the EXTERNAL store
 # (decode-page hits > 0, token-identical), and SIGTERM drains a gateway
 # gracefully (readyz 503, live stream finishes, exit 0)
+# dryrun_controller: the self-reshaping fleet over a REAL subprocess
+# worker fleet — a surge's reconcile tick gang-schedules a second
+# serving pod by preempting a batch pod (checkpoint-and-requeue), the
+# launcher hook spawns its worker process, surge streams stay
+# token-identical across the reshape; the drought drains + releases it,
+# reaps the subprocess, and the freed chip re-binds the victim
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	  $(PY) -c "import __graft_entry__ as g; g.dryrun_gateway(); \
 	  g.dryrun_gateway_tier(); \
 	  g.dryrun_spec_serving(); g.dryrun_tracing(); \
 	  g.dryrun_http_serving(); g.dryrun_kv_migration(); \
-	  g.dryrun_gateway_pods(); \
+	  g.dryrun_gateway_pods(); g.dryrun_controller(); \
 	  g.dryrun_multichip(8)"
 
 image:

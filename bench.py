@@ -2897,6 +2897,327 @@ def serving_gateway_scaleout(extra: dict, tiny: bool = False) -> None:
     extra["serve_gwtier_stream_token_identical"] = bool(stream_identical)
 
 
+def serving_autoscale(extra: dict, tiny: bool = False) -> None:
+    """The serving↔scheduling loop (ISSUE 14 acceptance): a diurnal
+    traffic replay over a SELF-RESHAPING fleet vs a static allocation.
+
+    Cluster: one 2x4 slice (8 chips).  The autoscale lane starts at ONE
+    serving replica with every other chip bound to priority-10 batch
+    pods — a FleetController (virtual clock, real filter/bind) reshapes
+    it: the peak's queue pressure scale-ups gang-schedule new replicas
+    by PREEMPTING batch pods (checkpoint-and-requeue through the
+    write-ahead ledger), the drought drains them (DRAINING first,
+    release at quiescence) and the freed chips re-bind the requeued
+    batch pods.  The static lane serves the SAME replay on a fixed
+    2-replica fleet.
+
+    Replicas are real tiny fp32 paged batchers behind the in-memory
+    data plane with a modeled device step (6 ms — the
+    serving_gateway_scaleout rationale: on a 1-core box the measured
+    variable must be ALLOCATION, not GIL contention; real decode still
+    runs and fp32 token identity is gated on it).  Chip-hours integrate
+    (routable + draining) over the replay's VIRTUAL timeline — the
+    clock the diurnal schedule and the controller share.
+
+    Gates: SLO attainment (request latency <= target) STRICTLY above
+    static at <= static's chip-hours; >= 1 preemption with every victim
+    re-bound by the end; zero lost/double-served (every request ok,
+    every request decoded exactly once); page accounting on every
+    replica that ever served, scale-up/drain/preemption included."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubegpu_tpu.controller import ControllerConfig, FleetController
+    from kubegpu_tpu.gateway import (
+        AdmissionQueue,
+        FailoverPolicy,
+        Gateway,
+        GatewayRequest,
+        InMemoryReplicaClient,
+    )
+    from kubegpu_tpu.models import TransformerLM
+    from kubegpu_tpu.models.paging import PagedContinuousBatcher
+    from kubegpu_tpu.testing.fake_serving import build_fake_serving_stack
+    from kubegpu_tpu.types import RES_TPU, annotations
+    from kubegpu_tpu.utils.metrics import Metrics
+
+    SERVING_PRIO = 50
+    SLO_S = 1.0
+    VSTEP = 10.0                     # virtual seconds per replay step
+    vocab, layers, heads, hidden = 61, 1, 2, 16
+    page, prompt_pad, max_seq = 4, 12, 64
+    max_replicas = 4
+    # the diurnal shape: calm shoulders, a 3-step peak surge, a long
+    # drought tail the drains pay for themselves in
+    schedule = [2, 2, 24, 24, 24] + [2] * 19
+    model = TransformerLM(
+        vocab_size=vocab, num_layers=layers, num_heads=heads,
+        hidden=hidden, max_seq=max_seq,
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32)
+    )["params"]
+    pool = [
+        PagedContinuousBatcher(
+            params, vocab_size=vocab, num_layers=layers,
+            num_heads=heads, hidden=hidden, max_seq=max_seq,
+            slots=3, station_slots=2, prompt_pad=prompt_pad,
+            page_size=page, pool_pages=48, dtype=jnp.float32,
+            prefix_cache=False,
+        )
+        for _ in range(max_replicas)
+    ]
+    warm = np.asarray([1, 2, 3], np.int32)
+    for cb in pool:                  # compile off the clock
+        cb.run([warm], [3])
+
+    # the replay: fixed prompts, fixed budgets — both lanes serve
+    # byte-identical requests (greedy fp32 => identical tokens)
+    rng = np.random.RandomState(777)
+    replay = []
+    for step, k in enumerate(schedule):
+        replay.append([
+            {
+                "request_id": f"d{step}-{i}",
+                "prompt": [int(t) for t in rng.randint(
+                    1, vocab, size=int(rng.randint(3, prompt_pad - 2)))],
+                "max_new_tokens": 12,
+            }
+            for i in range(k)
+        ])
+    all_rids = {r["request_id"] for step in replay for r in step}
+
+    def run_lane(autoscale: bool):
+        """One lane over a fresh cluster + warm batchers from the pool.
+        Returns (tokens by rid, attained count, chip_units, lane info)."""
+        metrics = Metrics()
+        n_start = 1 if autoscale else 2
+        stack = build_fake_serving_stack(
+            n_start, slice_ids=("sa",), mesh=(2, 4), metrics=metrics,
+            priority=SERVING_PRIO,
+        )
+        assigned = {}
+
+        def factory(key):
+            if key not in assigned:
+                # the pool is sized by LIVE replicas (<= max_replicas),
+                # not by distinct names ever: a released replica's warm
+                # batcher is reused, so name churn (a drained seed
+                # replica plus a full asvc-* fleet) can't exhaust it
+                live = {r.key for r in stack.registry.all()}
+                in_use = {
+                    id(cb) for k, cb in assigned.items()
+                    if k != key and k in live
+                }
+                free = [cb for cb in pool if id(cb) not in in_use]
+                assert free, "warm batcher pool exhausted"
+                assigned[key] = free[0]
+            return assigned[key]
+
+        client = InMemoryReplicaClient(
+            batcher_factory=factory, step_delay_s=0.03,
+        )
+        stack.registry.subscribe(client.sync_live)
+        gw = Gateway(
+            stack.registry, client,
+            queue=AdmissionQueue(capacity=256),
+            policy=FailoverPolicy(
+                deadline_s=120.0, hedge_after_s=1e6, max_attempts=4,
+                retry_budget_ratio=1.0, budget_floor=1000,
+            ),
+            # dispatcher pool sized past the LARGEST fleet's slot
+            # capacity (4 replicas x 3 slots): the measured variable is
+            # replica allocation, so the gateway must never be the
+            # concurrency bound
+            metrics=metrics, dispatchers=16, trace=False,
+        )
+        stack.registry.refresh()
+        gw.start()
+        vnow = [0.0]
+        checkpointed = []
+        ctrl = None
+        n_batch = 0
+        if autoscale:
+            # bind batch pods on every remaining chip (priority 10 <
+            # serving 50: preemptible, exactly as many as fit)
+            nodes = sorted(
+                n["metadata"]["name"] for n in stack.api.list_nodes()
+            )
+            free = sum(
+                len(v.free) for v in stack.sched.cache.views().values()
+            )
+            for i in range(free):
+                name = f"batch-{i}"
+                stack.api.create_pod({
+                    "metadata": {"name": name, "namespace": "default",
+                                 "annotations": {
+                                     annotations.POD_PRIORITY: "10"}},
+                    "spec": {"containers": [{"name": "t", "resources": {
+                        "limits": {RES_TPU: "1"}}}]},
+                })
+                r = stack.sched.filter(
+                    stack.api.get_pod("default", name), nodes
+                )
+                assert r.nodes, f"{name}: no placement"
+                assert stack.sched.bind(
+                    "default", name, r.nodes[0]
+                ) is None
+                n_batch += 1
+            ctrl = FleetController(
+                api=stack.api, sched=stack.sched,
+                registry=stack.registry, gateway=gw, client=client,
+                metrics=metrics, clock=lambda: vnow[0],
+                checkpointer=lambda obj: (
+                    checkpointed.append(obj["metadata"]["name"])
+                    or {"bench": True}
+                ),
+                config=ControllerConfig(
+                    min_replicas=1, max_replicas=max_replicas,
+                    queue_target_per_replica=6.0, ttft_target_s=1e9,
+                    # damped like a real deployment, in VIRTUAL time:
+                    # surges scale up immediately, drains wait out the
+                    # cooldown (reversals pay double via the flap
+                    # window) so a clearing burst can't saw-tooth the
+                    # fleet between peak steps
+                    ewma_alpha=0.7, up_ticks=1, down_ticks=3,
+                    up_cooldown_s=0.0, down_cooldown_s=15.0,
+                    flap_window_s=30.0, drain_grace_s=30.0,
+                    serving_priority=SERVING_PRIO,
+                    # brownout out of scope here: shedding would trade
+                    # the zero-lost gate for latency
+                    brownout_threshold=1e9, grow_retry_s=0.0,
+                ),
+            )
+        tokens = {}
+        attained = 0
+        chip_units = 0.0
+        try:
+            for step_reqs in replay:
+                if ctrl is not None:
+                    ctrl.tick()      # calm-side tick: drains/releases
+                handles = []
+                for r in step_reqs:
+                    handles.append((r["request_id"], gw.submit(
+                        GatewayRequest(
+                            prompt=list(r["prompt"]),
+                            max_new_tokens=r["max_new_tokens"],
+                            request_id=r["request_id"],
+                        )
+                    ), time.perf_counter()))
+                if ctrl is not None:
+                    ctrl.tick()      # loaded-side tick: scale-ups
+                def _held():
+                    if not autoscale:
+                        return 2
+                    return (len(stack.registry.routable())
+                            + len(stack.registry.draining_keys()))
+                # charge the step at its PEAK fleet: mid-wait ticks can
+                # add replicas after this point, and sampling only here
+                # would let them serve the surge uncharged (flattering
+                # the chip-hours gate)
+                step_held = _held()
+                last_tick = time.perf_counter()
+                for rid, p, t_sub in handles:
+                    # the reconcile loop keeps running WHILE the surge
+                    # serves (a real controller is paced, not request-
+                    # synchronized): a deep backlog earns more replicas
+                    # mid-step, the drought tail keeps draining
+                    deadline = time.perf_counter() + 300.0
+                    while not p.wait(0.2):
+                        assert time.perf_counter() < deadline, (
+                            f"request {rid} stuck"
+                        )
+                        if ctrl is not None and (
+                            time.perf_counter() - last_tick > 0.2
+                        ):
+                            ctrl.tick()
+                            step_held = max(step_held, _held())
+                            last_tick = time.perf_counter()
+                    res = p.result()
+                    assert res.status == "ok", (rid, res.error)
+                    tokens[rid] = res.tokens
+                    if time.perf_counter() - t_sub <= SLO_S:
+                        attained += 1
+                chip_units += step_held * VSTEP
+                vnow[0] += VSTEP
+            if ctrl is not None:
+                # settle any in-flight reshape on the virtual clock
+                for _ in range(64):
+                    if not ctrl.reshaping:
+                        break
+                    vnow[0] += VSTEP
+                    ctrl.tick()
+                assert not ctrl.reshaping, "drains failed to settle"
+            # exactly-once: every replayed request decoded once,
+            # nowhere twice — through every reshape
+            assert set(tokens) == all_rids
+            for rid in all_rids:
+                assert client.decodes.get(rid, 0) == 1, (
+                    f"{rid} decoded {client.decodes.get(rid, 0)}x"
+                )
+            # page accounting on every replica that ever served
+            for key, cb in assigned.items():
+                cb.assert_page_accounting()
+            info = {
+                "replicas_assigned": len(assigned),
+                "checkpointed": list(checkpointed),
+                "scale_ups": metrics.get(
+                    "controller_scale_events_total", dir="up"),
+                "releases": metrics.get("controller_releases_total"),
+            }
+            if autoscale:
+                # the full circle: every preempted batch pod re-bound
+                bound_batch = sum(
+                    1 for o in stack.api.list_pods()
+                    if o["metadata"]["name"].startswith("batch-")
+                    and (o.get("spec") or {}).get("nodeName")
+                )
+                info["batch_bound_at_end"] = bound_batch
+                assert bound_batch == n_batch, (
+                    f"{n_batch - bound_batch} preempted batch pods "
+                    "never re-bound"
+                )
+            return tokens, attained, chip_units, info
+        finally:
+            gw.stop()
+            with client._lock:
+                workers = list(client._workers.values())
+            client.stop()
+            for w in workers:
+                w.thread.join(10.0)
+
+    static_tokens, static_att, static_chips, _ = run_lane(False)
+    auto_tokens, auto_att, auto_chips, info = run_lane(True)
+    n = len(all_rids)
+    log(
+        f"serving_autoscale: SLO attainment {auto_att}/{n} autoscaled "
+        f"vs {static_att}/{n} static; chip-units {auto_chips:.0f} vs "
+        f"{static_chips:.0f}; scale_ups={info['scale_ups']:.0f} "
+        f"preempted={len(info['checkpointed'])} "
+        f"releases={info['releases']:.0f}"
+    )
+    extra["serve_autoscale_attained"] = auto_att
+    extra["serve_autoscale_attained_static"] = static_att
+    extra["serve_autoscale_requests"] = n
+    extra["serve_autoscale_chip_units"] = round(auto_chips, 1)
+    extra["serve_autoscale_chip_units_static"] = round(static_chips, 1)
+    extra["serve_autoscale_slo_strictly_better"] = bool(
+        auto_att > static_att
+    )
+    extra["serve_autoscale_chip_hours_ok"] = bool(
+        auto_chips <= static_chips
+    )
+    extra["serve_autoscale_token_identical"] = bool(
+        auto_tokens == static_tokens
+    )
+    extra["serve_autoscale_preemptions"] = len(info["checkpointed"])
+    extra["serve_autoscale_scale_ups"] = info["scale_ups"]
+    extra["serve_autoscale_releases"] = info["releases"]
+
+
 def serving_tp_paged(extra: dict, tiny: bool = False) -> None:
     """Tensor-parallel paged serving (ISSUE 9 acceptance): the whole
     ``PagedContinuousBatcher`` hot loop over a "model" mesh — KV page
@@ -4159,6 +4480,7 @@ def main() -> None:
         serving_migration(extra, tiny=True)
         serving_store_failover(extra, tiny=True)
         serving_gateway_scaleout(extra, tiny=True)
+        serving_autoscale(extra, tiny=True)
         ok = (
             # chunked ITL must not SUBSTANTIALLY regress vs monolithic:
             # on the 1-core smoke box the two are compute-bound ties
@@ -4208,6 +4530,14 @@ def main() -> None:
             and extra["serve_gwtier_token_identical"]
             and extra["serve_gwtier_hedged_strictly_better"]
             and extra["serve_gwtier_stream_token_identical"]
+            # the self-reshaping fleet: SLO attainment on the diurnal
+            # replay strictly above static allocation at <= its
+            # chip-hours, with >= 1 preemption exercised, zero
+            # lost/double-served, fp32 token identity across lanes
+            and extra["serve_autoscale_slo_strictly_better"]
+            and extra["serve_autoscale_chip_hours_ok"]
+            and extra["serve_autoscale_token_identical"]
+            and extra["serve_autoscale_preemptions"] > 0
         )
         print(json.dumps({
             "metric": "serve_smoke", "ok": ok, "extra": extra,

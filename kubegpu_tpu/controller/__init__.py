@@ -1,0 +1,50 @@
+"""Serving↔scheduling control loop: the self-reshaping fleet.
+
+The repo's identity is a topology-aware gang scheduler that also owns a
+serving stack; this package is what CONNECTS them.  A reconcile-loop
+controller watches the serving tier's SLO pressure (admission-queue
+depth + TTFT, EWMA-smoothed with hysteresis and cooldowns) and reshapes
+the fleet through the machinery that already exists:
+
+- scale-UP gang-schedules new serving pods through the extender's
+  filter/bind path (grpalloc scoring, ICI-contiguous), preempting
+  lower-priority batch training jobs with checkpoint-and-requeue;
+- scale-DOWN drains a replica first (``Gateway.drain_replica``: KV
+  migrates over the PR 11 verbs — planned moves are transfers, never
+  cold restarts) and only then releases its chips back to batch;
+- when capacity cannot arrive in time, a BROWNOUT ladder degrades
+  gracefully instead of failing: disable hedging → shrink speculation
+  → shed lowest-priority/over-quota tenants with retryable 429s.
+
+Crash tolerance is the design rule: every decision is re-derivable from
+observed state (pod + assignment annotations, the registry's DRAINING
+marks, the write-ahead requeue ledger), so a restarted controller
+resumes mid-reshape without orphaning a drain or double-releasing
+chips.
+"""
+
+from kubegpu_tpu.controller.controller import (  # noqa: F401
+    ControllerConfig,
+    FleetController,
+    default_pod_factory,
+)
+from kubegpu_tpu.controller.requeue import (  # noqa: F401
+    JsonFileRequeueBackend,
+    RequeueLedger,
+)
+from kubegpu_tpu.controller.signals import (  # noqa: F401
+    EwmaSignal,
+    FleetObserver,
+    SignalSample,
+)
+
+__all__ = [
+    "ControllerConfig",
+    "FleetController",
+    "default_pod_factory",
+    "RequeueLedger",
+    "JsonFileRequeueBackend",
+    "EwmaSignal",
+    "FleetObserver",
+    "SignalSample",
+]

@@ -1,0 +1,626 @@
+"""FleetController: the reconcile loop that closes serving↔scheduling.
+
+One ``tick()`` = one reconcile: observe (registry refresh + pressure
+sample), resume any reshape already in flight (finish drains, replay
+unsettled requeue snapshots, re-bind pending batch pods onto freed
+chips), then decide — scale up, scale down, or walk the brownout
+ladder.  The loop is deliberately single-stepped: at most one fleet
+change per tick, never while a drain is still in progress, so the
+hysteresis/cooldown/flap-damping layers have a serialized decision
+stream to govern.
+
+State discipline (the crash-tolerance contract): the controller keeps
+NO durable state of its own beyond the write-ahead requeue ledger.
+Which replicas exist, which are DRAINING, which chips batch jobs hold,
+which pods are pending — all of it lives in the API server annotations
+and the registry, so a restarted controller re-derives the world on its
+first tick: in-progress drains are adopted (and finished exactly once —
+releasing an already-deleted pod is a no-op), unsettled preemption
+snapshots replay their diff-and-recreate, and the brownout level is
+read back from the gateway it was applied to.
+
+The clock is injectable; nothing here sleeps.  The caller paces ticks
+(a thread, a soak op, a bench loop, or ``run_forever`` below).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from kubegpu_tpu.controller.requeue import RequeueLedger
+from kubegpu_tpu.controller.signals import EwmaSignal, FleetObserver
+from kubegpu_tpu.grpalloc import fit_gang
+from kubegpu_tpu.scheduler.preemption import collect_units, find_victims
+from kubegpu_tpu.types import RES_TPU, annotations
+from kubegpu_tpu.utils.apiserver import NotFound
+from kubegpu_tpu.utils.metrics import Metrics, default_metrics
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class ControllerConfig:
+    # -- fleet shape -------------------------------------------------------
+    group: str = "decode"            # serving group the controller owns
+    namespace: str = "default"
+    pod_prefix: str = "asvc"         # scale-up pod names: asvc-0, asvc-1...
+    chips_per_replica: int = 1
+    serving_priority: int = 100      # must out-rank batch for preemption
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # -- pressure targets (signals.py derives the terms) -------------------
+    queue_target_per_replica: float = 8.0
+    ttft_target_s: float = 0.5
+    ewma_alpha: float = 0.5
+    # -- hysteresis / cooldowns / flap damping -----------------------------
+    up_threshold: float = 1.0        # pressure above = SLO at risk
+    down_threshold: float = 0.25     # pressure below = fleet oversized
+    up_ticks: int = 2                # consecutive ticks over before acting
+    down_ticks: int = 5              # consecutive ticks under before acting
+    up_cooldown_s: float = 30.0
+    down_cooldown_s: float = 60.0
+    # a direction REVERSAL inside this window doubles the applicable
+    # cooldown: the diurnal shoulder must not saw-tooth the fleet
+    flap_window_s: float = 120.0
+    drain_grace_s: float = 30.0      # un-migratable work gets this long
+    # -- brownout ladder ---------------------------------------------------
+    brownout_threshold: float = 2.0  # pressure with nowhere to grow
+    brownout_clear_threshold: float = 0.8
+    brownout_clear_ticks: int = 3
+    brownout_step_s: float = 5.0     # min seconds between rung changes
+    shed_tenants: Tuple[str, ...] = ()   # lowest-priority, shed first
+    # a failed scale-up blocks growth (and arms brownout) this long
+    grow_retry_s: float = 10.0
+
+
+def default_pod_factory(config: ControllerConfig) -> Callable[[str], dict]:
+    """Scale-up pod spec: a serving-group member at serving priority —
+    exactly what the registry discovers and the filter path places (and
+    preempts for)."""
+
+    def build(name: str) -> dict:
+        return {
+            "metadata": {
+                "name": name,
+                "namespace": config.namespace,
+                "annotations": {
+                    annotations.POD_SERVING_GROUP: config.group,
+                    annotations.POD_PRIORITY: str(config.serving_priority),
+                },
+            },
+            "spec": {"containers": [{
+                "name": "serve",
+                "resources": {
+                    "limits": {RES_TPU: str(config.chips_per_replica)}
+                },
+            }]},
+        }
+
+    return build
+
+
+class FleetController:
+    """See the module docstring.  Collaborators are the stack that
+    already exists: the API server + Scheduler (placement, preemption),
+    the ReplicaRegistry (membership + DRAINING), the Gateway or
+    GatewayTier (drain_replica, brownout surface), and the data-plane
+    client (in-flight visibility; in harnesses its factory also brings
+    new replicas' batchers up when the registry live set grows).
+
+    ``launcher(key, pod_obj)`` / ``terminator(key)`` are the kubelet
+    hooks for deployments where binding a pod does not by itself start
+    a serving process (the dryrun's subprocess fleet); in-process
+    harnesses leave them None.  ``checkpointer(pod_obj) -> dict`` runs
+    once per evicted batch pod at requeue — the stand-in for the job's
+    checkpoint-on-SIGTERM — and its return value rides the recreated
+    pod's requeue annotation so the resumed job restores from it."""
+
+    def __init__(
+        self,
+        api,
+        sched,
+        registry,
+        gateway,
+        client,
+        config: Optional[ControllerConfig] = None,
+        metrics: Optional[Metrics] = None,
+        clock: Callable[[], float] = time.monotonic,
+        pod_factory: Optional[Callable[[str], dict]] = None,
+        checkpointer: Optional[Callable[[dict], dict]] = None,
+        requeue_ledger: Optional[RequeueLedger] = None,
+        launcher: Optional[Callable[[str, dict], None]] = None,
+        terminator: Optional[Callable[[str], None]] = None,
+        observer: Optional[FleetObserver] = None,
+    ) -> None:
+        self.api = api
+        self.sched = sched
+        self.registry = registry
+        self.gateway = gateway
+        self.client = client
+        self.config = config or ControllerConfig()
+        self.metrics = metrics or default_metrics
+        self.clock = clock
+        self.pod_factory = pod_factory or default_pod_factory(self.config)
+        self.checkpointer = checkpointer or (lambda obj: {})
+        self.requeue = requeue_ledger or RequeueLedger()
+        self.launcher = launcher
+        self.terminator = terminator
+        self.observer = observer or FleetObserver(
+            registry, gateway, self.metrics, client=client
+        )
+        self.signal = EwmaSignal(self.config.ewma_alpha)
+        self._over_ticks = 0
+        self._under_ticks = 0
+        self._last_scale_at: Optional[float] = None
+        self._last_scale_dir = ""
+        self._grow_blocked_until = 0.0
+        # key -> grace deadline for replicas this controller is draining
+        self._drains: Dict[str, float] = {}
+        self._clear_ticks = 0
+        self._last_brownout_change: Optional[float] = None
+        self._resume()
+
+    # -- crash-resume ------------------------------------------------------
+    def _resume(self) -> None:
+        """Re-derive in-flight work from observed state: unsettled
+        requeue snapshots replay, DRAINING replicas are adopted (their
+        grace restarts — the only state a restart loses is how long the
+        old controller had already waited), and the brownout level is
+        read back from the gateway it lives on."""
+        for token, pods in self.requeue.pending():
+            self._requeue_snapshot(token, pods)
+        for key in self.registry.draining_keys():
+            if key not in self._drains:
+                self._drains[key] = self.clock() + self.config.drain_grace_s
+                self.metrics.inc("controller_drains_resumed_total")
+        self._brownout = int(getattr(self._front(), "brownout_level", 0))
+
+    # -- small views -------------------------------------------------------
+    def _front(self):
+        """The object carrying drain_replica/set_brownout: the tier when
+        there is one, else the single gateway."""
+        return self.gateway
+
+    def _gateways(self) -> List[object]:
+        return self.observer.gateways()
+
+    @property
+    def pressure(self) -> float:
+        return self.signal.value or 0.0
+
+    @property
+    def brownout(self) -> int:
+        return self._brownout
+
+    @property
+    def reshaping(self) -> bool:
+        return bool(self._drains)
+
+    def _outstanding(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for gw in self._gateways():
+            for key, n in gw.dispatcher.outstanding.items():
+                out[key] = out.get(key, 0) + n
+        return out
+
+    # -- the reconcile tick ------------------------------------------------
+    def tick(self) -> dict:
+        """One reconcile.  Returns a summary dict (harness/debug
+        surface); every effect also lands in controller_* metrics."""
+        now = self.clock()
+        self.metrics.inc("controller_reconciles_total")
+        self.registry.refresh()
+        sample = self.observer.sample()
+        cfg = self.config
+        # backlog = admitted-not-finished: queued PLUS in dispatcher
+        # hands — a deep dispatcher pool must not hide the surge from
+        # the pressure signal by draining the queue into in-flight
+        queue_term = (sample.queue_depth + sample.in_flight) / (
+            cfg.queue_target_per_replica * max(1, sample.routable)
+        )
+        ttft_term = sample.ttft_mean_s / cfg.ttft_target_s
+        pressure = self.signal.update(max(queue_term, ttft_term))
+        self.metrics.set_gauge("controller_pressure", pressure)
+        self.metrics.set_gauge(
+            "controller_serving_replicas", sample.routable
+        )
+        self.metrics.set_gauge(
+            "controller_draining_replicas", len(self._drains)
+        )
+        self.metrics.set_gauge("controller_fleet_util", sample.ledger_util)
+        if pressure >= cfg.up_threshold:
+            self._over_ticks += 1
+        else:
+            self._over_ticks = 0
+        if pressure <= cfg.down_threshold:
+            self._under_ticks += 1
+        else:
+            self._under_ticks = 0
+
+        # resume/finish in-flight reshapes before any new decision
+        self._finish_drains(now)
+        requeued_bound = self._requeue_sweep()
+
+        action = ""
+        if not self._drains:
+            action = self._decide(sample, now)
+        self._brownout_tick(pressure, sample, now)
+        desired = sample.routable + (
+            1 if action == "up" else -1 if action == "down" else 0
+        )
+        self.metrics.set_gauge("controller_desired_replicas", desired)
+        return {
+            "pressure": round(pressure, 4),
+            "routable": sample.routable,
+            "queue_depth": sample.queue_depth,
+            "action": action,
+            "draining": sorted(self._drains),
+            "brownout": self._brownout,
+            "requeued_bound": requeued_bound,
+        }
+
+    def run_forever(self, interval_s: float = 2.0,
+                    stop: Optional[threading.Event] = None) -> None:
+        """Convenience pacing loop for real deployments (the CLI/dryrun
+        path); harnesses call ``tick`` directly."""
+        stop = stop or threading.Event()
+        while not stop.wait(interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 - the loop must survive
+                log.exception("reconcile tick failed")
+
+    # -- decisions ---------------------------------------------------------
+    def _cooldown(self, direction: str, now: float) -> float:
+        cfg = self.config
+        base = cfg.up_cooldown_s if direction == "up" else cfg.down_cooldown_s
+        if (
+            self._last_scale_at is not None
+            and self._last_scale_dir not in ("", direction)
+            and now - self._last_scale_at < cfg.flap_window_s
+        ):
+            return base * 2.0    # flap damping: reversals pay double
+        return base
+
+    def _cooled(self, direction: str, now: float) -> bool:
+        if self._last_scale_at is None:
+            return True
+        return now - self._last_scale_at >= self._cooldown(direction, now)
+
+    def _decide(self, sample, now: float) -> str:
+        cfg = self.config
+        if (
+            self._over_ticks >= cfg.up_ticks
+            and sample.routable < cfg.max_replicas
+            and now >= self._grow_blocked_until
+            and self._cooled("up", now)
+        ):
+            if self._scale_up(now):
+                self._over_ticks = 0
+                return "up"
+            return ""
+        if (
+            self._under_ticks >= cfg.down_ticks
+            and sample.routable > cfg.min_replicas
+            and sample.queue_depth == 0
+            and self._cooled("down", now)
+        ):
+            if self._scale_down(now):
+                self._under_ticks = 0
+                return "down"
+        return ""
+
+    # -- scale-up (gang-schedule, preempt, checkpoint-and-requeue) ---------
+    def capacity_feasible(self) -> bool:
+        """Could one more serving replica land RIGHT NOW — on free
+        chips (grpalloc ``fit_gang`` over the scheduler cache's views),
+        or by evicting strictly-lower-priority units (the preemption
+        victim search, ``scheduler/preemption.find_victims``)?  This is
+        the brownout arming signal: high pressure while this is False
+        means capacity cannot arrive in time and the fleet must degrade
+        instead of fail.  Pure read — no pod objects churned."""
+        try:
+            probe = annotations.pod_from_k8s(
+                self.pod_factory(f"{self.config.pod_prefix}-probe")
+            )
+        except Exception:  # noqa: BLE001 - a bad factory is a config bug
+            log.exception("capacity probe could not parse the pod spec")
+            return True
+        views = self.sched.cache.views()
+        for view in views.values():
+            if fit_gang(view, [probe]).success:
+                return True
+        pods_raw = self.api.list_pods()
+        assignments = {}
+        for obj in pods_raw:
+            a = annotations.assignment_from_pod(obj)
+            if a is not None:
+                meta = obj.get("metadata") or {}
+                assignments[
+                    f"{meta.get('namespace', 'default')}/"
+                    f"{meta.get('name', '')}"
+                ] = a
+        units = collect_units(pods_raw, assignments)
+        return find_victims(
+            views, units, [probe], self.config.serving_priority
+        ) is not None
+
+    def _next_pod_name(self) -> str:
+        taken = {
+            (obj.get("metadata") or {}).get("name", "")
+            for obj in self.api.list_pods(self.config.namespace)
+        }
+        i = 0
+        while f"{self.config.pod_prefix}-{i}" in taken:
+            i += 1
+        return f"{self.config.pod_prefix}-{i}"
+
+    def _preemptible_bound_pods(self) -> List[dict]:
+        """Bound batch pods a serving placement could evict: holding an
+        assignment, strictly below serving priority, not serving-group
+        members.  This is the write-ahead snapshot the requeue ledger
+        records before the filter's preemption can delete any of them."""
+        out = []
+        for obj in self.api.list_pods():
+            meta = obj.get("metadata") or {}
+            ann = dict(meta.get("annotations") or {})
+            if annotations.POD_SERVING_GROUP in ann:
+                continue
+            if not (obj.get("spec") or {}).get("nodeName"):
+                continue
+            phase = ((obj.get("status") or {}).get("phase") or "")
+            if phase in ("Succeeded", "Failed"):
+                continue
+            try:
+                prio = int(ann.get(annotations.POD_PRIORITY, "0"))
+            except ValueError:
+                prio = 0
+            if prio >= self.config.serving_priority:
+                continue
+            if annotations.assignment_from_pod(obj) is None:
+                continue
+            out.append(copy.deepcopy(obj))
+        return out
+
+    def _scale_up(self, now: float) -> bool:
+        cfg = self.config
+        if not self.capacity_feasible():
+            # nowhere for a replica to come from, even with preemption:
+            # fail fast (no pod-object churn), block growth, arm the
+            # brownout path — "capacity cannot arrive in time"
+            self.metrics.inc("controller_scale_up_failed_total")
+            self._grow_blocked_until = now + cfg.grow_retry_s
+            return False
+        name = self._next_pod_name()
+        self.api.create_pod(self.pod_factory(name))
+        obj = self.api.get_pod(cfg.namespace, name)
+        nodes = sorted(
+            n["metadata"]["name"] for n in self.api.list_nodes()
+        )
+        # write-ahead: record every pod the placement MIGHT evict before
+        # the filter runs — the crash window between eviction and
+        # requeue is exactly what the ledger closes
+        snapshot = self._preemptible_bound_pods()
+        token = self.requeue.begin(snapshot) if snapshot else None
+        result = self.sched.filter(obj, nodes)
+        if token is not None:
+            self._requeue_snapshot(token, snapshot)
+        if not result.nodes:
+            # withdraw the aspirant: a pending serving pod squatting the
+            # queue would shadow the next attempt's name scan
+            self._delete_pod_quietly(cfg.namespace, name)
+            self.metrics.inc("controller_scale_up_failed_total")
+            self._grow_blocked_until = now + cfg.grow_retry_s
+            log.warning("scale-up found no placement: %s", result.failed)
+            return False
+        err = self.sched.bind(cfg.namespace, name, result.nodes[0])
+        if err is not None:
+            self._delete_pod_quietly(cfg.namespace, name)
+            self.metrics.inc("controller_scale_up_failed_total")
+            self._grow_blocked_until = now + cfg.grow_retry_s
+            log.warning("scale-up bind failed: %s", err)
+            return False
+        self.metrics.inc("controller_scale_events_total", dir="up")
+        self._last_scale_at, self._last_scale_dir = now, "up"
+        self.registry.refresh()
+        if self.launcher is not None:
+            key = f"{cfg.namespace}/{name}"
+            try:
+                self.launcher(key, self.api.get_pod(cfg.namespace, name))
+            except Exception:  # noqa: BLE001 - kubelet hook is external
+                log.exception("replica launcher failed for %s", key)
+        return True
+
+    def _requeue_snapshot(self, token: str, pods: List[dict]) -> int:
+        """Diff a write-ahead snapshot against the API server: survivors
+        drop out, evicted pods are checkpointed and recreated PENDING
+        (assignment stripped, requeue annotation attached) so the next
+        sweep re-schedules them when chips free up.  Idempotent — safe
+        to replay after a crash."""
+        requeued = 0
+        for obj in pods:
+            meta = obj.get("metadata") or {}
+            ns = meta.get("namespace", "default")
+            name = meta.get("name", "")
+            try:
+                self.api.get_pod(ns, name)
+                continue          # survived — the eviction skipped it
+            except (NotFound, KeyError):
+                pass
+            ckpt: dict = {}
+            try:
+                ckpt = self.checkpointer(obj) or {}
+            except Exception:  # noqa: BLE001 - checkpoint is best-effort
+                log.exception("checkpointer failed for %s/%s", ns, name)
+            ann = dict(meta.get("annotations") or {})
+            ann.pop(annotations.POD_ASSIGNMENT, None)
+            ann[annotations.POD_REQUEUE_CHECKPOINT] = json.dumps(
+                {"preempted": True, **ckpt}, sort_keys=True
+            )
+            self.api.create_pod({
+                "metadata": {
+                    "name": name, "namespace": ns, "annotations": ann,
+                },
+                "spec": {
+                    "containers": copy.deepcopy(
+                        (obj.get("spec") or {}).get("containers") or []
+                    ),
+                },
+            })
+            requeued += 1
+            self.metrics.inc("controller_requeued_pods_total")
+        self.requeue.settle(token)
+        return requeued
+
+    def _requeue_sweep(self) -> int:
+        """Bind pending BATCH pods (below serving priority) onto free
+        chips — the release-back-to-batch half of the loop.  Stands in
+        for kube-scheduler's sweep in harnesses; a real cluster's
+        scheduler does this on its own, and running it here too is
+        harmless (the bind path is optimistic-concurrency safe)."""
+        bound = 0
+        nodes = None
+        for obj in self.api.list_pods():
+            if (obj.get("spec") or {}).get("nodeName"):
+                continue
+            ann = dict((obj.get("metadata") or {}).get("annotations") or {})
+            if annotations.POD_SERVING_GROUP in ann:
+                continue
+            try:
+                prio = int(ann.get(annotations.POD_PRIORITY, "0"))
+            except ValueError:
+                prio = 0
+            if prio >= self.config.serving_priority:
+                continue
+            if nodes is None:
+                nodes = sorted(
+                    n["metadata"]["name"] for n in self.api.list_nodes()
+                )
+            meta = obj["metadata"]
+            result = self.sched.filter(obj, nodes)
+            if not result.nodes:
+                continue
+            if self.sched.bind(
+                meta.get("namespace", "default"), meta["name"],
+                result.nodes[0],
+            ) is None:
+                bound += 1
+        return bound
+
+    # -- scale-down (drain BEFORE release) ---------------------------------
+    def _scale_down(self, now: float) -> bool:
+        routable = self.registry.routable()
+        if len(routable) <= self.config.min_replicas:
+            return False
+        outstanding = self._outstanding()
+        victim = min(
+            routable, key=lambda r: (outstanding.get(r.key, 0), r.key)
+        )
+        try:
+            stats = self._front().drain_replica(victim.key)
+        except Exception:  # noqa: BLE001 - a failed drain is a no-op
+            log.exception("drain_replica failed for %s", victim.key)
+            return False
+        self._drains[victim.key] = now + self.config.drain_grace_s
+        self.metrics.inc("controller_scale_events_total", dir="down")
+        self._last_scale_at, self._last_scale_dir = now, "down"
+        log.info("draining %s: %s", victim.key, stats)
+        return True
+
+    def _finish_drains(self, now: float) -> None:
+        """Release drained replicas: immediately once nothing is in
+        flight there, at the grace deadline otherwise (stragglers that
+        could not migrate fail over cold — graceful, never wrong)."""
+        for key, deadline in sorted(self._drains.items()):
+            inflight = [
+                a for a in self.client.inflight_on(key) if not a.done
+            ]
+            if inflight and now < deadline:
+                continue
+            self._release(key)
+            self._drains.pop(key, None)
+
+    def _release(self, key: str) -> None:
+        """Delete the drained pod (chips return to the pool) — exactly
+        once: a pod already gone (a crashed predecessor released it, or
+        the soak killed it and the registry pruned it) is a no-op, never
+        a double free (the scheduler's delete path frees assignments
+        through the cache, which is idempotent by pod identity)."""
+        ns, _, name = key.partition("/")
+        try:
+            obj = self.api.get_pod(ns, name)
+        except (NotFound, KeyError):
+            self.registry.set_draining(key, False)
+            return
+        ann = dict((obj.get("metadata") or {}).get("annotations") or {})
+        if ann.get(annotations.POD_SERVING_GROUP) != self.config.group:
+            log.warning("refusing to release non-%s pod %s",
+                        self.config.group, key)
+            return
+        if self.terminator is not None:
+            try:
+                self.terminator(key)
+            except Exception:  # noqa: BLE001 - kubelet hook is external
+                log.exception("replica terminator failed for %s", key)
+        self.api.delete_pod(ns, name)
+        self.sched.on_pod_deleted(obj)
+        self.metrics.inc("controller_releases_total")
+        self.registry.refresh()
+
+    def _delete_pod_quietly(self, ns: str, name: str) -> None:
+        try:
+            self.api.delete_pod(ns, name)
+        except (NotFound, KeyError):
+            pass
+
+    # -- brownout ladder ---------------------------------------------------
+    def _brownout_tick(self, pressure: float, sample, now: float) -> None:
+        """Degrade gracefully when capacity cannot arrive in time: the
+        ladder climbs one rung per ``brownout_step_s`` while pressure
+        stays extreme AND the fleet cannot grow (at max, or the last
+        scale-up found no placement even with preemption); it steps
+        back down one rung after ``brownout_clear_ticks`` calm ticks.
+        Every rung is applied through the gateway's brownout surface —
+        the shed accounting (``gateway_shed_total{reason}``) lives
+        there, next to the requests it refuses."""
+        cfg = self.config
+        blocked = (
+            sample.routable >= cfg.max_replicas
+            or now < self._grow_blocked_until
+        )
+        if pressure >= cfg.brownout_threshold and not blocked:
+            # pressure is extreme and the fleet LOOKS growable — ask
+            # grpalloc/preemption whether a replica could actually land
+            blocked = not self.capacity_feasible()
+        if pressure >= cfg.brownout_threshold and blocked and not self._drains:
+            self._clear_ticks = 0
+            stepped = (
+                self._last_brownout_change is None
+                or now - self._last_brownout_change >= cfg.brownout_step_s
+            )
+            if self._brownout < 3 and stepped:
+                self._apply_brownout(self._brownout + 1, now)
+        elif pressure <= cfg.brownout_clear_threshold and self._brownout > 0:
+            self._clear_ticks += 1
+            if self._clear_ticks >= cfg.brownout_clear_ticks:
+                self._apply_brownout(self._brownout - 1, now)
+                self._clear_ticks = 0
+        else:
+            self._clear_ticks = 0
+
+    def _apply_brownout(self, level: int, now: float) -> None:
+        self._brownout = max(0, min(3, level))
+        self._last_brownout_change = now
+        front = self._front()
+        set_brownout = getattr(front, "set_brownout", None)
+        if set_brownout is not None:
+            set_brownout(self._brownout,
+                         shed_tenants=self.config.shed_tenants)
+        self.metrics.set_gauge("controller_brownout_level", self._brownout)
+        log.info("brownout level -> %d", self._brownout)

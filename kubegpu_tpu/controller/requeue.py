@@ -1,0 +1,99 @@
+"""Checkpoint-and-requeue: the write-ahead ledger preemption leans on.
+
+Preempting a batch training gang EVICTS its pods — the scheduler's
+filter path deletes them through the API server, exactly like
+kube-scheduler's preemption verb.  The job-controller half of the
+contract (checkpoint the victim, recreate it pending so it re-schedules
+when chips free up) is the controller's, and it must survive a
+controller crash between the eviction and the recreation: that window
+is the only place a preempted job could be LOST, because the deleted
+pod no longer exists anywhere.
+
+The ledger closes it write-ahead: BEFORE triggering a placement that
+may preempt, the controller records a snapshot of every bound
+preemptible pod; after the placement it diffs the snapshot against the
+API server — pods that survived are dropped, pods that were evicted
+are checkpointed and recreated pending — and settles the entry.  A
+restarted controller replays unsettled entries the same way, so the
+diff-and-recreate is idempotent whether it runs once, twice, or across
+a crash (a recreation that finds the name already present is a no-op).
+
+The backend is pluggable: in-memory for tests and in-process harnesses
+(where "controller restart" means a new object over the same stack),
+``JsonFileRequeueBackend`` for real processes (the dryrun's controller
+subprocess story; a production deployment would point it at a PVC).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Dict, List, Tuple
+
+log = logging.getLogger(__name__)
+
+
+class InMemoryRequeueBackend:
+    def __init__(self) -> None:
+        self._entries: Dict[str, List[dict]] = {}
+
+    def load(self) -> Dict[str, List[dict]]:
+        return dict(self._entries)
+
+    def store(self, entries: Dict[str, List[dict]]) -> None:
+        self._entries = dict(entries)
+
+
+class JsonFileRequeueBackend:
+    """Durable backend: one JSON file, written whole on every change
+    (entries are a handful of pod specs — atomicity via rename)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+
+    def load(self) -> Dict[str, List[dict]]:
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    def store(self, entries: Dict[str, List[dict]]) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(entries, f)
+        os.replace(tmp, self.path)
+
+
+class RequeueLedger:
+    """Write-ahead snapshots of preemptible pods, keyed by a monotonic
+    token.  ``begin`` records durably BEFORE any eviction can happen;
+    ``settle`` clears after the diff-and-recreate ran; ``pending``
+    hands a restarted controller everything still unsettled."""
+
+    def __init__(self, backend=None) -> None:
+        self.backend = backend or InMemoryRequeueBackend()
+        self._lock = threading.Lock()
+        self._entries = self.backend.load()
+        self._n = max(
+            [int(k.split("-")[-1]) for k in self._entries] or [0]
+        )
+
+    def begin(self, pods: List[dict]) -> str:
+        with self._lock:
+            self._n += 1
+            token = f"rq-{self._n}"
+            self._entries[token] = [json.loads(json.dumps(p)) for p in pods]
+            self.backend.store(self._entries)
+            return token
+
+    def settle(self, token: str) -> None:
+        with self._lock:
+            if self._entries.pop(token, None) is not None:
+                self.backend.store(self._entries)
+
+    def pending(self) -> List[Tuple[str, List[dict]]]:
+        with self._lock:
+            return sorted(self._entries.items())

@@ -1,0 +1,125 @@
+"""SLO pressure derivation: what the reconcile loop actually watches.
+
+The input signals all pre-exist (PR 6 laid them down): the gateway's
+admission queue depth IS the backlog ledger, ``gateway_ttft_seconds``
+is the end-to-end latency the phase spans attribute, and the paged
+batchers' per-iteration ledger rows say how saturated each replica's
+token budget is.  This module turns them into ONE smoothed pressure
+number the controller thresholds:
+
+    backlog    = queue_depth + in_flight   (admitted, not finished)
+    queue_term = backlog / (queue_target_per_replica * routable)
+    ttft_term  = recent_ttft_mean / ttft_target
+    pressure   = EWMA(max(queue_term, ttft_term))
+
+Recent TTFT is a WINDOWED mean — the diff of the histogram's count/sum
+between ticks — because a cumulative quantile would remember yesterday
+forever and the controller must react to the last few seconds.  The
+EWMA plus the controller's hysteresis/cooldown layers are what keep
+probe blips and diurnal noise from flapping the fleet.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+
+class EwmaSignal:
+    """Exponentially-weighted moving average; the first sample seeds it
+    (no zero-bias warmup — a controller restarting into a storm must
+    see the storm on tick one)."""
+
+    def __init__(self, alpha: float = 0.5) -> None:
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha ({alpha}) must be in (0, 1]")
+        self.alpha = alpha
+        self.value: Optional[float] = None
+
+    def update(self, x: float) -> float:
+        if self.value is None:
+            self.value = float(x)
+        else:
+            self.value = self.alpha * float(x) + (1 - self.alpha) * self.value
+        return self.value
+
+
+@dataclass
+class SignalSample:
+    """One tick's raw observation of the serving tier."""
+
+    queue_depth: int = 0          # admitted-not-yet-dispatched, tier-wide
+    in_flight: int = 0            # inside dispatcher threads right now
+    routable: int = 0             # replicas new admissions may land on
+    draining: int = 0             # replicas mid-drain (still serving)
+    ttft_mean_s: float = 0.0      # recent-window mean; 0 when no completions
+    completed: int = 0            # completions in the window
+    ledger_util: float = 0.0      # max replica token-budget saturation [0,1]
+
+
+class FleetObserver:
+    """Samples the serving tier: gateway queues, the shared metrics
+    registry's TTFT histogram (windowed by diffing count/sum between
+    ticks), the replica registry, and — when the data-plane client
+    exposes per-iteration ledgers (paged batchers) — token-budget
+    utilization.  Works over a single ``Gateway`` or a ``GatewayTier``
+    (duck-typed on ``.gateways``)."""
+
+    def __init__(self, registry, gateway, metrics, client=None) -> None:
+        self.registry = registry
+        self.gateway = gateway
+        self.metrics = metrics
+        self.client = client
+        self._prev_count = None
+        self._prev_sum = 0.0
+
+    def gateways(self) -> List[object]:
+        tier = getattr(self.gateway, "gateways", None)
+        if tier is None:
+            return [self.gateway]
+        return [gw for gw in tier.values() if gw.alive]
+
+    def _ledger_util(self) -> float:
+        ledgers = getattr(self.client, "ledgers", None)
+        if ledgers is None:
+            return 0.0
+        util = 0.0
+        try:
+            for rows in ledgers(limit=1).values():
+                if not rows:
+                    continue
+                row = rows[-1]
+                budget = row.get("budget") or 0
+                if budget > 0:
+                    util = max(util, min(1.0, row.get("rows", 0) / budget))
+        except Exception:  # noqa: BLE001 - ledgers are advisory
+            return 0.0
+        return util
+
+    def sample(self) -> SignalSample:
+        depth = in_flight = 0
+        for gw in self.gateways():
+            try:
+                depth += gw.queue.depth()
+                in_flight += gw.in_flight()
+            except Exception:  # noqa: BLE001 - a dying gateway reads as idle
+                continue
+        count = self.metrics.histogram_count("gateway_ttft_seconds")
+        total = self.metrics.histogram_sum("gateway_ttft_seconds")
+        if self._prev_count is None:
+            d_count, d_sum = 0, 0.0
+        else:
+            d_count = max(0, count - self._prev_count)
+            d_sum = max(0.0, total - self._prev_sum)
+        self._prev_count, self._prev_sum = count, total
+        routable = len(self.registry.routable())
+        draining = len(self.registry.draining_keys())
+        return SignalSample(
+            queue_depth=depth,
+            in_flight=in_flight,
+            routable=routable,
+            draining=draining,
+            ttft_mean_s=(d_sum / d_count) if d_count else 0.0,
+            completed=d_count,
+            ledger_util=self._ledger_util(),
+        )

@@ -188,6 +188,16 @@ class ReplicaClient:
         a replica whose policy never seals)."""
         return False
 
+    def set_speculation(self, cap: Optional[int]) -> int:
+        """Brownout rung 2: cap (or restore, ``None``) speculative
+        decode fleet-wide — the verify window's extra budget rows go
+        back to admissions under overload.  Returns how many replicas
+        actually adjusted; the default says "unsupported" (0), which
+        degrades the rung to a no-op rather than an error.  Greedy
+        output is lossless for ANY draft, so the cap never changes
+        tokens — only per-step emission batching."""
+        return 0
+
 
 # ---------------------------------------------------------------------------
 # SimBatcher: pure-python stand-in with the ContinuousBatcher serving API
@@ -254,6 +264,11 @@ class SimBatcher:
         self.vocab = vocab
         self.token_budget = token_budget
         self.speculate_k = speculate_k
+        # the CONFIGURED width; set_speculation_cap clamps/restores the
+        # live speculate_k against it (brownout rung 2).  Token VALUES
+        # are a function of (seed, index) only, so capping speculation
+        # never changes a stream — just how many tokens a step emits.
+        self._spec_configured = speculate_k
         self.decode_page_cache = decode_page_cache
         self.tp = tp
         self._pending: deque = deque()
@@ -358,6 +373,22 @@ class SimBatcher:
             self._spans[seq_id] = {
                 "serve": serve, "decode": serve.child("decode"),
             }
+
+    def set_speculation_cap(self, cap: Optional[int]) -> bool:
+        """Live speculation cap (brownout rung 2): ``None`` restores
+        the configured width, 0 disables speculation, k clamps to
+        min(configured, k).  Returns whether anything changed."""
+        if self._spec_configured is None:
+            return False
+        if cap is None:
+            new = self._spec_configured
+        elif cap <= 0:
+            new = None
+        else:
+            new = min(self._spec_configured, int(cap))
+        changed = new != self.speculate_k
+        self.speculate_k = new
+        return changed
 
     def has_work(self) -> bool:
         return bool(self._pending) or bool(self._active)
@@ -496,6 +527,19 @@ class _ReplicaWorker:
                     break
                 while self.inbox:
                     attempt, req = self.inbox.popleft()
+                    dl = getattr(req, "deadline_s", None)
+                    anchor = getattr(req, "enqueued_at", 0.0) or 0.0
+                    if dl is not None and anchor and (
+                        time.monotonic() >= anchor + dl
+                    ):
+                        # shed-before-work parity with the wire plane:
+                        # a request that aged past its deadline in this
+                        # worker's inbox is refused before any decode
+                        attempt.finish(AttemptResult(
+                            False, error="deadline expired before "
+                            "admission (backpressure)",
+                        ))
+                        continue
                     seq = self._next_seq
                     self._next_seq += 1
                     kwargs = {"session_id": getattr(req, "session", None)}
@@ -639,6 +683,11 @@ class InMemoryReplicaClient(ReplicaClient):
         self.step_delay_s = step_delay_s
         self._lock = threading.Lock()
         self._workers: Dict[str, _ReplicaWorker] = {}
+        # the brownout rung-2 cap in force (None = none): a replica that
+        # cold-restarts WHILE the fleet is browned out must come up
+        # capped too — set_brownout only fires on level crossings, so
+        # the client re-applies the remembered cap at worker bring-up
+        self._spec_cap: Optional[int] = None
         # request_id -> completed decode deliveries (soak's wasted-hedge
         # and exactly-once accounting reads this)
         self.decodes: Dict[str, int] = {}
@@ -652,12 +701,21 @@ class InMemoryReplicaClient(ReplicaClient):
             batcher = self.batcher_factory(key)
         with self._lock:
             old = self._workers.get(key)
-            self._workers[key] = _ReplicaWorker(
+            worker = _ReplicaWorker(
                 key, batcher,
                 self.step_delay_s if step_delay_s is None else step_delay_s,
             )
+            self._workers[key] = worker
+            cap = self._spec_cap
         if old is not None:
             old.kill()
+        if cap is not None:
+            fn = getattr(worker.batcher, "set_speculation_cap", None)
+            if fn is not None:
+                try:
+                    worker.control(lambda fn=fn: fn(cap))
+                except Exception:  # noqa: BLE001 - advisory knob
+                    pass
 
     def fail_replica(self, key: str) -> None:
         with self._lock:
@@ -769,6 +827,26 @@ class InMemoryReplicaClient(ReplicaClient):
             worker = self._workers.get(key)
         if worker is not None:
             worker.fail_migration = flag
+
+    def set_speculation(self, cap: Optional[int]) -> int:
+        """Brownout rung 2 over the in-memory plane: apply a live
+        speculation cap on every replica whose batcher supports one
+        (duck-typed ``set_speculation_cap``), ON the serving thread —
+        the batchers are single-driver."""
+        with self._lock:
+            self._spec_cap = cap
+            workers = list(self._workers.values())
+        adjusted = 0
+        for w in workers:
+            fn = getattr(w.batcher, "set_speculation_cap", None)
+            if fn is None:
+                continue
+            try:
+                if w.control(lambda fn=fn: fn(cap)):
+                    adjusted += 1
+            except Exception:  # noqa: BLE001 - advisory knob
+                continue
+        return adjusted
 
     def inflight_on(self, replica_key: str) -> List[Attempt]:
         with self._lock:

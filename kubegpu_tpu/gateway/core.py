@@ -102,6 +102,7 @@ class PendingRequest:
         self._done = threading.Event()
         self._result: Optional[GatewayResult] = None
         self._trace = None  # root SpanCtx; closed by Gateway._record
+        self._tenant = ""   # stamped by submit; keys the quota release
 
     def _resolve(self, result: GatewayResult) -> None:
         self._result = result
@@ -278,6 +279,16 @@ class Gateway:
         # distinct from _stop (the dispatchers keep running until the
         # drain completes)
         self._draining = threading.Event()
+        # overload BROWNOUT ladder (the fleet controller's degrade-don't-
+        # fail surface, applied when capacity cannot arrive in time):
+        #   0 none; 1 hedging disabled; 2 + speculation shrunk;
+        #   3 + lowest-priority/over-quota tenants shed with retryable
+        #   429s, every shed counted in gateway_shed_total{reason}
+        self._brownout_level = 0
+        self._shed_tenants: frozenset = frozenset()
+        # tenant -> outstanding (submitted, not yet terminal): the
+        # over-quota shed rule's input, maintained under _lock
+        self._tenant_outstanding: Dict[str, int] = {}
         self._started = False
         self._threads: List[threading.Thread] = []
         self._lock = threading.Lock()
@@ -399,6 +410,58 @@ class Gateway:
         The caller then waits on ``drain()`` and calls ``stop()``."""
         self._draining.set()
 
+    # -- overload brownout (the controller's degrade surface) --------------
+    @property
+    def brownout_level(self) -> int:
+        return self._brownout_level
+
+    def set_brownout(self, level: int,
+                     shed_tenants=frozenset()) -> None:
+        """Apply one rung of the overload brownout ladder.  Level 1
+        disables hedged dispatch (a browned-out fleet must not amplify
+        its own load 2x); level 2 additionally shrinks speculation on
+        replicas that support a live cap (the verify window's extra
+        budget rows go back to admissions; greedy output is lossless
+        for ANY draft, so tokens are unchanged); level 3 additionally
+        sheds lowest-priority (``shed_tenants``) and over-quota tenants
+        at ADMISSION with retryable 429s — every shed counted and
+        auditable in ``gateway_shed_total{reason="brownout"}``.  Level
+        0 restores everything.  Idempotent; the controller re-reads
+        ``brownout_level`` after a restart."""
+        level = max(0, min(3, int(level)))
+        prev = self._brownout_level
+        self._brownout_level = level
+        self._shed_tenants = frozenset(shed_tenants)
+        self.dispatcher.hedge_disabled = level >= 1
+        if (level >= 2) != (prev >= 2):
+            shrink = getattr(self.client, "set_speculation", None)
+            if shrink is not None:
+                try:
+                    shrink(1 if level >= 2 else None)
+                except Exception:  # noqa: BLE001 - advisory knob
+                    log.exception("speculation shrink failed")
+        self.metrics.set_gauge("gateway_brownout_level", level)
+
+    def _shed_locked(self, request: GatewayRequest) -> bool:
+        """Level-3 admission shed (called under _lock, BEFORE this
+        request's own outstanding count lands): lowest-priority tenants
+        always; otherwise a tenant already holding at least its fair
+        share of the queue's capacity (capacity // active tenants,
+        floor 1) is over quota — the hog pays for the brownout, the
+        light tenants keep flowing."""
+        tenant = request.tenant
+        if not tenant:
+            return False
+        if tenant in self._shed_tenants:
+            return True
+        mine = self._tenant_outstanding.get(tenant, 0)
+        if mine == 0:
+            return False
+        quota = max(
+            1, self.queue.capacity // max(1, len(self._tenant_outstanding))
+        )
+        return mine >= quota
+
     # -- submission (the HTTP handler's surface) ---------------------------
     def submit(self, request: GatewayRequest) -> PendingRequest:
         """Admit or refuse NOW.  Refusal still resolves the handle — with
@@ -410,8 +473,17 @@ class Gateway:
                 raise ValueError(
                     f"duplicate request_id {request.request_id}"
                 )
+            # brownout shed decided BEFORE this request's own count
+            # lands (the quota judges what the tenant already holds)
+            shed = (self._brownout_level >= 3
+                    and self._shed_locked(request))
             self._pending[request.request_id] = pending
             self._n_submitted += 1
+            pending._tenant = request.tenant
+            if request.tenant:
+                self._tenant_outstanding[request.tenant] = (
+                    self._tenant_outstanding.get(request.tenant, 0) + 1
+                )
         if self.tracer is not None:
             attrs = dict(request_id=request.request_id,
                          tenant=request.tenant)
@@ -430,6 +502,18 @@ class Gateway:
             self._record(GatewayResult(
                 request.request_id, "error",
                 error="gateway shutting down (draining)",
+            ))
+            return pending
+        if shed:
+            # brownout load-shed: an explicit, RETRYABLE 429 — the
+            # degrade-don't-fail half of the controller's contract when
+            # capacity cannot arrive in time.  Counted and auditable.
+            self.metrics.inc("gateway_requests_total", outcome="rejected")
+            self.metrics.inc("gateway_shed_total", reason="brownout")
+            self._record(GatewayResult(
+                request.request_id, "rejected",
+                error="brownout: lowest-priority/over-quota traffic "
+                "shed; retry with backoff",
             ))
             return pending
         request.enqueued_at = time.monotonic()
@@ -601,6 +685,12 @@ class Gateway:
                 self._results.popitem(last=False)
             pending = self._pending.pop(result.request_id, None)
             self._n_resolved += 1
+            if pending is not None and pending._tenant:
+                n = self._tenant_outstanding.get(pending._tenant, 1) - 1
+                if n <= 0:
+                    self._tenant_outstanding.pop(pending._tenant, None)
+                else:
+                    self._tenant_outstanding[pending._tenant] = n
             if result.status == "ok" and result.replica:
                 self.completed_by_replica[result.replica] = (
                     self.completed_by_replica.get(result.replica, 0) + 1

@@ -507,6 +507,28 @@ class ReplicaServingLoop:
                 time.sleep(self.step_delay_s)
 
     def _admit(self, st: _Stream, payload: dict) -> None:
+        remaining = payload.get("deadline_s")
+        if remaining is not None:
+            try:
+                remaining = float(remaining)
+            except (TypeError, ValueError):
+                remaining = None
+        if remaining is not None and (
+            time.monotonic() - st.t_recv >= remaining
+        ):
+            # shed-before-work, replica side: the request's remaining
+            # deadline (shipped by the gateway) elapsed while it queued
+            # in this loop's inbox — admitting it would burn prefill on
+            # an answer nobody will wait for.  A counted, retryable
+            # refusal; the gateway's own deadline path owns the caller-
+            # facing terminal.
+            if self.metrics is not None:
+                self.metrics.inc("replica_http_expired_refusals_total")
+            self._finish(
+                st, "error",
+                "deadline expired before admission (backpressure)",
+            )
+            return
         seq = self._next_seq
         self._next_seq += 1
         root = None
@@ -1474,6 +1496,15 @@ class HttpReplicaClient(ReplicaClient):
                     # tells the relay where this attempt's deltas start
                     payload["watermark"] = wm
                     attempt.stream_base = wm
+                if deadline is not None:
+                    # remaining deadline rides the wire: the replica can
+                    # refuse a DOOMED admission (one that aged past its
+                    # budget in the serving loop's inbox) before burning
+                    # prefill compute on it — the shed-before-work rule,
+                    # enforced on both ends
+                    payload["deadline_s"] = max(
+                        0.0, deadline - time.monotonic()
+                    )
                 body = json.dumps(payload)
             headers = self._headers({"Content-Type": "application/json"})
             if trace is not None:

@@ -127,6 +127,9 @@ class Dispatcher:
         # replica key -> in-flight count, shared with the router's callers
         self.outstanding = outstanding if outstanding is not None else {}
         self._out_lock = outstanding_lock or threading.Lock()
+        # brownout rung 1 (Gateway.set_brownout): a browned-out fleet
+        # must not amplify its own overload with duplicate dispatches
+        self.hedge_disabled = False
 
     # -- outstanding bookkeeping ------------------------------------------
     def _inc(self, key: str) -> None:
@@ -237,6 +240,20 @@ class Dispatcher:
         deadline = start + (
             getattr(request, "deadline_s", None) or policy.deadline_s
         )
+        if time.monotonic() >= deadline:
+            # shed-before-work: the deadline already expired while this
+            # request sat in the admission queue — dispatching it would
+            # burn prefill compute on an answer its caller has abandoned.
+            # Resolve as a COUNTED, RETRYABLE backpressure result (the
+            # 429 shape, not a timeout: nothing was attempted).
+            if self.metrics:
+                self.metrics.inc(
+                    "gateway_shed_total", reason="deadline_expired"
+                )
+            return DispatchOutcome(
+                "rejected",
+                error="deadline expired in queue; retry with backoff",
+            )
         tried = set()
         attempts: List[Attempt] = []
         n_attempts = 0
@@ -407,6 +424,7 @@ class Dispatcher:
             # without ever issuing one.
             if (
                 not hedged
+                and not self.hedge_disabled
                 and len(attempts) == 1
                 and hedge_at is not None
                 and now >= hedge_at
@@ -444,7 +462,7 @@ class Dispatcher:
 
 @dataclass
 class DispatchOutcome:
-    status: str                      # "ok" | "error" | "timeout"
+    status: str          # "ok" | "error" | "timeout" | "rejected" (shed)
     tokens: List[int] = None         # type: ignore[assignment]
     replica: str = ""
     error: str = ""

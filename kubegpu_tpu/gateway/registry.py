@@ -173,6 +173,14 @@ class ReplicaRegistry:
             ns = meta.get("namespace", "default")
             name = meta.get("name", "")
             key = f"{ns}/{name}"
+            # adopt the durable DRAINING mark: a restarted process's
+            # fresh registry must not re-admit a half-drained replica
+            # (the in-memory set dies with the process; the annotation
+            # doesn't — and a recreated pod starts without it)
+            if ann.get(annotations.POD_DRAINING) == "true":
+                draining.add(key)
+                with self._lock:
+                    self._draining.add(key)
             node = (obj.get("spec") or {}).get("nodeName") or ""
             a = annotations.assignment_from_pod(obj)
             status = obj.get("status") or {}
@@ -249,6 +257,19 @@ class ReplicaRegistry:
                 self._draining.add(key)
             else:
                 self._draining.discard(key)
+        # persist the mark on the pod (best-effort): the in-memory set
+        # dies with this process, and a restarted controller adopts an
+        # in-progress drain from the annotation at its first refresh
+        patch = getattr(self.api, "patch_pod_annotations", None)
+        if patch is not None:
+            ns, _, name = key.partition("/")
+            try:
+                patch(ns, name, {
+                    annotations.POD_DRAINING: "true" if draining else "",
+                })
+            except Exception:  # noqa: BLE001 - the mark still holds
+                log.debug("draining annotation patch failed for %s", key,
+                          exc_info=True)
         self.refresh()
 
     def draining_keys(self) -> FrozenSet[str]:

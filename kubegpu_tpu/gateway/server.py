@@ -75,6 +75,12 @@ def make_handler(gateway: Gateway, registry: ReplicaRegistry):
             self.send_response(code)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            if code == 429:
+                # every 429 is RETRYABLE backpressure by contract —
+                # queue-full, brownout shed, deadline shed-before-work —
+                # and well-behaved clients honor Retry-After instead of
+                # hammering a browned-out fleet
+                self.send_header("Retry-After", "1")
             self.end_headers()
             self.wfile.write(body)
 
@@ -296,6 +302,10 @@ def _debug_state(gateway: Gateway, registry: ReplicaRegistry) -> dict:
         "queue_depth": gateway.queue.depth(),
         "in_flight": gateway.in_flight(),
         "outstanding": dict(gateway.dispatcher.outstanding),
+        # the overload ladder rung the controller holds this instance at
+        # (0 = none): operators see a browned-out gateway at a glance
+        "brownout": gateway.brownout_level,
+        "draining_replicas": sorted(registry.draining_keys()),
         "outcomes": outcomes,
         "completed_by_replica": dict(gateway.completed_by_replica),
         # each wired replica's advertised serving mesh (tensor-parallel
@@ -429,7 +439,8 @@ class GatewayServer:
 
 def _build_fake_serving_cluster(preset: str, replicas: int, group: str,
                                 token_budget=None, speculate_k=None,
-                                decode_page_cache="off", tp=1):
+                                decode_page_cache="off", tp=1,
+                                priority=None):
     """Fabricated cluster + scheduled decode replicas + SimBatcher-backed
     in-memory data plane: the full serving path with zero dependencies."""
     from kubegpu_tpu.gateway.client import InMemoryReplicaClient, SimBatcher
@@ -442,7 +453,8 @@ def _build_fake_serving_cluster(preset: str, replicas: int, group: str,
     sched.cache.refresh()
     try:
         schedule_decode_replicas(
-            api, sched, replicas, group, name_prefix=group
+            api, sched, replicas, group, name_prefix=group,
+            priority=priority,
         )
     except AssertionError as e:
         raise SystemExit(str(e))
@@ -459,7 +471,7 @@ def _build_fake_serving_cluster(preset: str, replicas: int, group: str,
     )
     registry.subscribe(client.sync_live)
     registry.refresh()
-    return api, registry, client
+    return api, sched, registry, client
 
 
 def main(argv=None) -> None:
@@ -513,6 +525,61 @@ def main(argv=None) -> None:
         help="SIGTERM grace: /readyz flips to 503 and new admissions "
         "refuse immediately; in-flight requests (live streams "
         "included) get this long to finish before the process exits",
+    )
+    ap.add_argument(
+        "--autoscale", action="store_true",
+        help="run the serving↔scheduling FleetController in this "
+        "process (kubegpu_tpu/controller): reconcile ticks watch SLO "
+        "pressure (admission backlog + TTFT, EWMA-smoothed with "
+        "hysteresis/cooldowns) and reshape the fleet — scale-ups "
+        "gang-schedule new serving pods through grpalloc, preempting "
+        "batch jobs with checkpoint-and-requeue; scale-downs drain "
+        "(KV migrates) before releasing chips; overload walks the "
+        "brownout ladder instead of failing.  Run it on exactly ONE "
+        "gateway instance of a deployment (a sidecar leader elector "
+        "gates the flag; two controllers would race reshape "
+        "decisions).  Works in-cluster and with --fake-cluster; "
+        "incompatible with --replica-endpoint (no cluster to reshape)",
+    )
+    ap.add_argument("--autoscale-min", type=int, default=1,
+                    help="replica floor for --autoscale")
+    ap.add_argument("--autoscale-max", type=int, default=4,
+                    help="replica ceiling for --autoscale")
+    ap.add_argument(
+        "--autoscale-queue-target", type=float, default=8.0,
+        help="backlog (queued + in-flight) per replica the pressure "
+        "signal normalizes against",
+    )
+    ap.add_argument(
+        "--autoscale-ttft-target", type=float, default=0.5,
+        help="TTFT SLO target (seconds) the pressure signal "
+        "normalizes against",
+    )
+    ap.add_argument("--autoscale-interval", type=float, default=2.0,
+                    help="seconds between reconcile ticks")
+    ap.add_argument(
+        "--autoscale-chips-per-replica", type=int, default=1,
+        help="chip request stamped on scale-up pods",
+    )
+    ap.add_argument(
+        "--autoscale-priority", type=int, default=100,
+        help="priority of scale-up serving pods — must out-rank the "
+        "batch jobs they may preempt, and EXISTING serving replicas "
+        "must be deployed at it too (a replica below it reads as a "
+        "preemption victim)",
+    )
+    ap.add_argument(
+        "--autoscale-requeue-file", default=None, metavar="PATH",
+        help="durable write-ahead requeue ledger (a PVC path): a "
+        "controller restarted between a preemption's eviction and its "
+        "checkpoint-and-requeue replays the snapshot instead of "
+        "losing the batch job.  Default: in-memory (single-process "
+        "lifetimes)",
+    )
+    ap.add_argument(
+        "--autoscale-shed-tenants", default="", metavar="T1,T2",
+        help="lowest-priority tenants the brownout ladder's rung 3 "
+        "sheds first (comma list)",
     )
     ap.add_argument(
         "--sim-data-plane", action="store_true",
@@ -629,6 +696,11 @@ def main(argv=None) -> None:
             )
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
 
+    # the serving↔scheduling loop's inputs: an API server handle + a
+    # Scheduler over it (both stateless over annotations, so a
+    # controller-embedded instance re-derives the same world the
+    # extender sees).  Populated by the modes that have a cluster.
+    ctrl_api = ctrl_sched = None
     if args.replica_endpoint:
         # explicit-endpoint mode: a fabricated registry (the shared
         # fake-cluster bring-up, so replica keys are DETERMINISTIC —
@@ -667,15 +739,31 @@ def main(argv=None) -> None:
             dict(zip(keys, endpoints)),
         )
     elif args.fake_cluster:
-        _, registry, client = _build_fake_serving_cluster(
-            args.fake_cluster, args.replicas, args.group,
-            token_budget=args.token_budget, speculate_k=args.speculate_k,
-            decode_page_cache=args.decode_page_cache, tp=args.tp,
+        ctrl_api, ctrl_sched, registry, client = (
+            _build_fake_serving_cluster(
+                args.fake_cluster, args.replicas, args.group,
+                token_budget=args.token_budget,
+                speculate_k=args.speculate_k,
+                decode_page_cache=args.decode_page_cache, tp=args.tp,
+                # the preemption contract: serving replicas must be
+                # deployed AT the controller's serving priority, or an
+                # unstamped replica (default 0) reads as a victim and a
+                # surge's scale-up cannibalizes the live serving fleet
+                priority=(
+                    args.autoscale_priority if args.autoscale else None
+                ),
+            )
         )
     else:
         from kubegpu_tpu.utils.apiserver import KubeApiServer
 
-        registry = ReplicaRegistry(KubeApiServer(), group=args.group)
+        ctrl_api = KubeApiServer()
+        registry = ReplicaRegistry(ctrl_api, group=args.group)
+        if args.autoscale:
+            from kubegpu_tpu.scheduler import Scheduler
+
+            ctrl_sched = Scheduler(ctrl_api)
+            ctrl_sched.resync()
         from kubegpu_tpu.gateway.client import InMemoryReplicaClient
 
         if not args.sim_data_plane:
@@ -789,6 +877,56 @@ def main(argv=None) -> None:
     import signal
 
     shutdown = threading.Event()
+    if args.autoscale:
+        if ctrl_api is None or ctrl_sched is None:
+            raise SystemExit(
+                "--autoscale needs a cluster to reshape: run it "
+                "in-cluster or with --fake-cluster, not "
+                "--replica-endpoint"
+            )
+        from kubegpu_tpu.controller import (
+            ControllerConfig,
+            FleetController,
+            JsonFileRequeueBackend,
+            RequeueLedger,
+        )
+
+        ledger = None
+        if args.autoscale_requeue_file:
+            ledger = RequeueLedger(
+                JsonFileRequeueBackend(args.autoscale_requeue_file)
+            )
+        controller = FleetController(
+            api=ctrl_api, sched=ctrl_sched, registry=registry,
+            gateway=gateway, client=client,
+            requeue_ledger=ledger,
+            config=ControllerConfig(
+                group=args.group,
+                chips_per_replica=args.autoscale_chips_per_replica,
+                serving_priority=args.autoscale_priority,
+                min_replicas=args.autoscale_min,
+                max_replicas=args.autoscale_max,
+                queue_target_per_replica=args.autoscale_queue_target,
+                ttft_target_s=args.autoscale_ttft_target,
+                shed_tenants=tuple(
+                    t for t in args.autoscale_shed_tenants.split(",")
+                    if t
+                ),
+            ),
+        )
+        threading.Thread(
+            target=controller.run_forever,
+            args=(args.autoscale_interval, shutdown),
+            daemon=True, name="fleet-controller",
+        ).start()
+        log.info(
+            "fleet controller: reconciling every %.1fs "
+            "(replicas %d..%d, queue target %.1f/replica, "
+            "TTFT target %.2fs)",
+            args.autoscale_interval, args.autoscale_min,
+            args.autoscale_max, args.autoscale_queue_target,
+            args.autoscale_ttft_target,
+        )
     # SIGTERM = GRACEFUL: readyz 503 + refuse new admissions, finish
     # in-flight streams within --drain-grace, then exit 0 — the
     # per-instance lifecycle a load balancer can act on

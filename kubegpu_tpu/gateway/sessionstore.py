@@ -724,6 +724,20 @@ class SessionKVStore:
         )
         self.metrics = metrics
         self.capture_queue = capture_queue
+        # per-GATEWAY session→home hint cache: the healthy-home no-op is
+        # the dispatch hot path's common case, and against an external
+        # store it costs a metadata GET per dispatch.  A hint records
+        # "this session's entry is home=replica, not lost" so repeat
+        # dispatches to the SAME replica skip the store round-trip
+        # entirely.  Invalidation is aggressive — any ring movement
+        # (mark_lost/sync_live), any degrade, any restore, any read that
+        # contradicts the hint drops it — because a stale hint costs at
+        # most one skipped mispin-restore (a perf blip), never
+        # correctness: the replica's own caches and the cold-prefill
+        # fallback stay sound.  In the multi-process deployment each
+        # gateway pod holds its own SessionKVStore, so this cache is
+        # per-gateway by construction.
+        self._hints: Dict[str, str] = {}
         # every degrade event, in order: (session, reason) — the soak's
         # audit trail ("every degraded session completed cold, counted")
         self.degraded_log: List[Tuple[str, str]] = []
@@ -737,6 +751,7 @@ class SessionKVStore:
     def _degrade(self, session: str, reason: str) -> None:
         with self._cond:
             self.degraded_log.append((session, reason))
+            self._hints.pop(session, None)
         if self.metrics is not None:
             self.metrics.inc(
                 "gateway_session_store_degraded_total", reason=reason
@@ -757,6 +772,13 @@ class SessionKVStore:
         res = self.backend.put(session, entry, if_version=None)
         if res.status == "unreachable":
             self._degrade(session, "unreachable")
+            return
+        # the turn just completed HERE: the healthy home is known
+        # without asking the store again
+        with self._cond:
+            self._hints[session] = replica_key
+            if len(self._hints) > self.max_sessions:
+                self._hints.clear()
 
     def capture(self, client, session: str) -> bool:
         """Export the session's sealed chain from its home replica and
@@ -872,12 +894,19 @@ class SessionKVStore:
     def mark_lost(self, replica_key: str) -> None:
         """The replica is going (drain) or gone (death): its sessions'
         next dispatch may restore elsewhere — or back into the SAME pod
-        name once it cold-restarts."""
+        name once it cold-restarts.  Ring movement invalidates the
+        whole hint cache: a hint that survived a membership change
+        could mask the very restore the movement calls for."""
+        with self._cond:
+            self._hints.clear()
         self.backend.mark_lost(replica_key)
 
     def sync_live(self, live) -> None:
         """Registry subscription: sessions homed on replicas that left
-        the live set become restorable."""
+        the live set become restorable.  Clears the hint cache (ring
+        movement — see ``mark_lost``)."""
+        with self._cond:
+            self._hints.clear()
         self.backend.sync_live(live)
 
     # -- restore (the read-through, on the dispatch path) ------------------
@@ -897,10 +926,18 @@ class SessionKVStore:
         Two-phase read: this runs on the DISPATCH hot path for every
         sessionful request, and the common case is the healthy-home
         no-op — so the decision is made on a METADATA read (no payload
-        bytes moved), and only an actual restore pays the full fetch."""
+        bytes moved), and only an actual restore pays the full fetch.
+        Hotter still, the per-gateway hint cache skips even that GET
+        when the routed target IS the session's last known healthy home
+        (hints drop on any ring movement, degrade, or restore — see
+        ``_hints``), so steady healthy-home traffic costs the store
+        nothing per dispatch."""
         session = getattr(request, "session", None)
         if not session:
             return False
+        with self._cond:
+            if self._hints.get(session) == target_key:
+                return False    # healthy home, hinted: skip the store GET
         res = self.backend.get(session, meta=True)
         if res.status == "unreachable":
             self._degrade(session, "unreachable")
@@ -912,7 +949,15 @@ class SessionKVStore:
             return False
         lost = bool(res.entry.get("lost"))
         if res.entry.get("replica") == target_key and not lost:
-            return False    # healthy home: the replica has its own cache
+            # healthy home: the replica has its own cache.  Remember it
+            # so the next dispatch here skips the metadata GET entirely.
+            with self._cond:
+                self._hints[session] = target_key
+                if len(self._hints) > self.max_sessions:
+                    self._hints.clear()
+            return False
+        with self._cond:
+            self._hints.pop(session, None)
         if not lost and not mispin_restore:
             return False
         if not res.entry.get("payload_present"):

@@ -129,6 +129,11 @@ class GatewayTier:
             gid: self._build(gid) for gid in gateway_ids
         }
         self._started = False
+        # tier-wide brownout state: applied to every alive gateway, and
+        # re-applied to revived replacements (a fresh pod joining a
+        # browned-out tier must not hedge while its siblings shed)
+        self._brownout: int = 0
+        self._shed_tenants: frozenset = frozenset()
 
     def _build(self, gid: str) -> Gateway:
         kwargs: dict = {}
@@ -194,8 +199,28 @@ class GatewayTier:
         self.gateways[gid] = gw
         if self._started:
             gw.start()
+        if self._brownout:
+            gw.set_brownout(self._brownout,
+                            shed_tenants=self._shed_tenants)
         self._publish_gauge()
         return gw
+
+    # -- overload brownout (controller surface, fanned tier-wide) ----------
+    @property
+    def brownout_level(self) -> int:
+        return self._brownout
+
+    def set_brownout(self, level: int,
+                     shed_tenants=frozenset()) -> None:
+        """Apply a brownout rung to every alive gateway (see
+        ``Gateway.set_brownout``); remembered so revived gateways join
+        at the tier's current level."""
+        self._brownout = max(0, min(3, int(level)))
+        self._shed_tenants = frozenset(shed_tenants)
+        for gid in self.alive_ids():
+            self.gateways[gid].set_brownout(
+                self._brownout, shed_tenants=self._shed_tenants
+            )
 
     # -- routing (the load-balancer stand-in) ------------------------------
     def gateway_for(self, request,

@@ -27,14 +27,23 @@ def schedule_decode_replicas(
     group: str = "decode",
     pin_slices: Optional[Sequence[str]] = None,
     name_prefix: str = "dec",
+    priority: Optional[int] = None,
 ) -> list:
     """Create + filter + bind ``n_replicas`` single-chip serving pods
-    through the real control plane; returns the pod names."""
+    through the real control plane; returns the pod names.
+
+    ``priority`` stamps POD_PRIORITY — harnesses that run the fleet
+    controller MUST deploy serving replicas at the controller's
+    ``serving_priority`` (the preemption contract: a scale-up placement
+    evicts strictly-lower-priority units, and an unstamped replica at
+    the default 0 would read as a victim)."""
     nodes = sorted(node["metadata"]["name"] for node in api.list_nodes())
     names = []
     for i in range(n_replicas):
         name = f"{name_prefix}-{i}"
         ann = {annotations.POD_SERVING_GROUP: group}
+        if priority is not None:
+            ann[annotations.POD_PRIORITY] = str(priority)
         if pin_slices:
             ann[annotations.POD_SLICE_SELECTOR] = pin_slices[i]
         api.create_pod({
@@ -58,11 +67,15 @@ def build_fake_serving_stack(
     mesh: Tuple[int, int] = (4, 4),
     pin_slices: Optional[Sequence[str]] = None,
     metrics=None,
+    priority: Optional[int] = None,
 ) -> SimpleNamespace:
     """Fabricated multi-slice cluster with scheduled decode replicas and a
     ReplicaRegistry over them.  Returns (api, slices, advs, sched,
     registry) — the data-plane client and Gateway stay the caller's
-    choice (SimBatcher vs real ContinuousBatcher, policy knobs)."""
+    choice (SimBatcher vs real ContinuousBatcher, policy knobs).
+    ``priority`` stamps the replicas' POD_PRIORITY (see
+    ``schedule_decode_replicas`` — required when a FleetController runs
+    over the stack)."""
     from kubegpu_tpu.gateway import ReplicaRegistry
 
     api = InMemoryApiServer()
@@ -78,7 +91,8 @@ def build_fake_serving_stack(
     sched = Scheduler(api, metrics=metrics) if metrics is not None \
         else Scheduler(api)
     sched.cache.refresh()
-    schedule_decode_replicas(api, sched, n_replicas, group, pin_slices)
+    schedule_decode_replicas(api, sched, n_replicas, group, pin_slices,
+                             priority=priority)
     registry = ReplicaRegistry(api, group=group)
     return SimpleNamespace(
         api=api, slices=slices, advs=advs, sched=sched, registry=registry

@@ -487,6 +487,18 @@ class GatewaySoak:
     ``gateway_session_store_degraded_total{reason}`` (the degraded-
     event log and the metric must agree exactly at quiescence).
 
+    ``controller=True`` is the SELF-RESHAPING lane (ISSUE 14): a
+    ``FleetController`` runs over the same stack — reconcile ops tick
+    it against real pressure (the surge op floods the queue), so the
+    fleet scales up (new pods genuinely scheduled through filter/bind,
+    the client's factory bringing their batchers up cold), drains and
+    releases replicas on the way down, and walks the brownout ladder
+    when pinned at max — all while the kill/revive/straggle schedule
+    runs.  I5, page accounting and the trace oracles must hold at
+    quiescence whatever the controller reshaped mid-chaos.  In-memory
+    lane only (the HTTP lane's replica processes are the harness's to
+    spawn, not the controller's).
+
     Traffic comes from the shared ``testing/workload`` harness in every
     lane: the bursty-diurnal arrival process paced by a virtual clock,
     chatty agent sessions (follow turns materialized from parents'
@@ -497,7 +509,7 @@ class GatewaySoak:
                  batcher_factory=None, multiturn: bool = False,
                  follow_prompt_cap: int = 12, http: bool = False,
                  migration: bool = False, gateways: int = 1,
-                 store_chaos: bool = False):
+                 store_chaos: bool = False, controller: bool = False):
         from kubegpu_tpu.gateway import (
             AdmissionQueue, FailoverPolicy, Gateway, GatewayTier,
             HttpReplicaClient, InMemoryReplicaClient, ReplicaServer,
@@ -510,7 +522,11 @@ class GatewaySoak:
 
         self.rng = random.Random(seed)
         stack = build_fake_serving_stack(
-            n_replicas, mesh=MESH, metrics=Metrics()
+            n_replicas, mesh=MESH, metrics=Metrics(),
+            # the controller lane's preemption contract: serving
+            # replicas deploy AT serving_priority, so a scale-up's
+            # victim search can never read an existing replica as prey
+            priority=50 if controller else None,
         )
         self.api = stack.api
         self.slices = stack.slices
@@ -603,10 +619,40 @@ class GatewaySoak:
             )
             self.registry.refresh()
             self.gw.start()
+        self.controller = None
+        if controller:
+            if http:
+                raise ValueError(
+                    "controller lane is in-memory only: the HTTP lane's "
+                    "replica servers are the harness's to spawn"
+                )
+            from kubegpu_tpu.controller import (
+                ControllerConfig, FleetController,
+            )
+
+            self.controller = FleetController(
+                api=self.api, sched=self.sched, registry=self.registry,
+                gateway=self._front(), client=self.client,
+                metrics=self.metrics,
+                config=ControllerConfig(
+                    group="decode", min_replicas=1,
+                    max_replicas=n_replicas + 2,
+                    queue_target_per_replica=6.0, ttft_target_s=0.5,
+                    ewma_alpha=0.6, up_ticks=1, down_ticks=2,
+                    up_cooldown_s=0.0, down_cooldown_s=0.0,
+                    flap_window_s=0.0, drain_grace_s=1.0,
+                    brownout_threshold=3.0, brownout_clear_threshold=0.5,
+                    brownout_clear_ticks=1, brownout_step_s=0.0,
+                    serving_priority=50,
+                ),
+            )
         self.n = 0
         self.n_replicas = n_replicas
         self.pendings = {}   # request_id -> PendingRequest (latest handle)
         self.dead = set()    # replica keys currently killed
+        self.dead_info = {}  # key -> (slice_id, coords) for revival — a
+        # released pod's registry entry is pruned, but its killed chips
+        # still need reviving at quiescence
         self.dead_gateways = set()
         self.ops = []
         self.multiturn = multiturn
@@ -679,7 +725,7 @@ class GatewaySoak:
         return p
 
     # -- ops ---------------------------------------------------------------
-    def op_burst(self):
+    def op_burst(self, k=None, label: str = "burst"):
         """Drain the workload stream's next arrivals (the bursty-diurnal
         process under a virtual clock): one-shot bursts, RAG
         long-prompts, best-of-n twins, and agent FOLLOW turns whose
@@ -688,7 +734,8 @@ class GatewaySoak:
         from kubegpu_tpu.gateway import GatewayRequest
 
         self._wl_clock += self.rng.choice([0.02, 0.05, 0.1, 0.3])
-        k = self.rng.randint(4, 16)
+        if k is None:
+            k = self.rng.randint(4, 16)
         ready = self.workload.next_ready(
             k, self._results_view(), now=self._wl_clock
         )
@@ -709,8 +756,31 @@ class GatewaySoak:
                 session=item.session,
             ))
         return (
-            f"burst x{len(ready)} ({follows} follow turns, "
+            f"{label} x{len(ready)} ({follows} follow turns, "
             f"clock {self._wl_clock:.2f}s, total {self.n})"
+        )
+
+    # -- self-reshaping ops (controller=True) --------------------------------
+    def op_surge(self):
+        """A traffic SURGE: a burst big enough to flood the admission
+        queue past the controller's per-replica target, so reconcile
+        ticks that follow see genuine pressure and reshape the fleet."""
+        return self.op_burst(k=self.rng.randint(24, 48), label="surge")
+
+    def op_reconcile(self):
+        """One controller tick against live state: advertise + refresh
+        (the cluster breathes), then reconcile — scale-ups genuinely
+        schedule pods, drains run the PR 11 verbs, releases free chips."""
+        if self.controller is None:
+            return "reconcile (noop: no controller)"
+        for a in self.advs.values():
+            a.advertise_once()
+        summary = self.controller.tick()
+        return (
+            f"reconcile (pressure={summary['pressure']:.2f} "
+            f"replicas={summary['routable']} action={summary['action']!r} "
+            f"draining={len(summary['draining'])} "
+            f"brownout={summary['brownout']})"
         )
 
     def _live_keys(self):
@@ -735,6 +805,7 @@ class GatewaySoak:
             a.advertise_once()
         self.registry.refresh()
         self.dead.add(key)
+        self.dead_info[key] = (rep.slice_id, set(rep.coords))
 
     def op_kill_replica(self):
         live = self._live_keys()
@@ -748,9 +819,19 @@ class GatewaySoak:
         if not self.dead:
             return "revive (noop)"
         key = self.rng.choice(sorted(self.dead))
-        rep = self.registry.get(key)
-        for coords in rep.coords:
-            self.slices[rep.slice_id].revive_chip(coords)
+        slice_id, coords_set = self.dead_info[key]
+        for coords in coords_set:
+            self.slices[slice_id].revive_chip(coords)
+        if self.registry.get(key) is None:
+            # the controller RELEASED the pod while its chips were dead
+            # (a drain caught mid-kill): the pod is gone for good —
+            # revive the hardware, drop the corpse from the dead set
+            for a in self.advs.values():
+                a.advertise_once()
+            self.registry.refresh()
+            self.dead.discard(key)
+            self.dead_info.pop(key, None)
+            return f"revive {key} (pod released; chips only)"
         if self.http:
             self._start_server(key)  # cold restart on a fresh port
         # a revived pod is a FRESH replica: any DRAINING mark from a
@@ -760,6 +841,7 @@ class GatewaySoak:
             a.advertise_once()
         self.registry.refresh()  # sync_live restarts the replica cold
         self.dead.discard(key)
+        self.dead_info.pop(key, None)
         return f"revive {key}"
 
     # -- KV-migration ops (migration=True) ---------------------------------
@@ -1302,6 +1384,20 @@ class GatewaySoak:
         for a in self.advs.values():
             a.advertise_once()
         self.registry.refresh()
+        if self.controller is not None:
+            # finish any in-flight reshape: drains release once their
+            # grace lapses (bounded by drain_grace_s), and the fleet
+            # must settle so the quiescence checks judge a still world
+            import time as _time
+
+            deadline = _time.monotonic() + 30.0
+            while (self.controller.reshaping
+                   and _time.monotonic() < deadline):
+                self.controller.tick()
+                _time.sleep(0.05)
+            assert not self.controller.reshaping, (
+                "controller drains failed to settle at quiescence"
+            )
         assert self._front().drain(timeout), "gateway failed to drain"
         if self.tier is None:
             return
@@ -1367,6 +1463,15 @@ class GatewaySoak:
                 (self.op_revive_gateway, 1),
                 (self.op_stream, 3),
                 (self.op_stream_failover, 1),
+            ]
+        if self.controller is not None:
+            # the self-reshaping lane: surges flood the queue, reconcile
+            # ticks scale the fleet up and down THROUGH the real
+            # filter/bind + drain/release paths while kills land — I5
+            # and page accounting must hold whatever got reshaped
+            ops += [
+                (self.op_reconcile, 4),
+                (self.op_surge, 2),
             ]
         bag = [f for f, w in ops for _ in range(w)]
         try:

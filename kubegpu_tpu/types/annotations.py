@@ -55,6 +55,18 @@ POD_SLICE_SELECTOR = f"{PREFIX}/slice-selector" # comma list of slice ids the
 # to them once their assignment annotation exists and their assigned chips
 # are advertised healthy.
 POD_SERVING_GROUP = f"{PREFIX}/serving-group"
+# Pod side (written by the fleet controller's checkpoint-and-requeue):
+# stamped on a batch pod recreated PENDING after preemption evicted it.
+# The value is JSON — {"preempted": true, ...checkpointer metadata...} —
+# so the resumed job knows to restore from its checkpoint instead of
+# starting cold.
+POD_REQUEUE_CHECKPOINT = f"{PREFIX}/requeue-checkpoint"
+# Pod side (written by ReplicaRegistry.set_draining): durable DRAINING
+# mark — "true" while a drain is in progress.  Persisted on the pod so a
+# RESTARTED controller/gateway process (fresh registry over the same API
+# server) adopts an in-flight drain instead of silently re-admitting the
+# half-drained replica.  A recreated pod starts without it (clean slate).
+POD_DRAINING = f"{PREFIX}/draining"
 # Pod side (written by the extender at bind, read by the CRI shim).
 POD_ASSIGNMENT = f"{PREFIX}/assignment"         # JSON: Assignment
 # Pod side (written by the extender for gang coordination/observability).

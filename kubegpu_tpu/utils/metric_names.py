@@ -98,6 +98,17 @@ CATALOG: Dict[str, MetricSpec] = {
     "gateway_replica_drains_total": _c(
         (), "graceful replica drains started (DRAINING -> released "
         "lifecycles)"),
+    "gateway_shed_total": _c(
+        ("reason",), "requests shed with an explicit retryable "
+        "backpressure result instead of being served (brownout = the "
+        "overload ladder's level-3 admission shed of lowest-priority/"
+        "over-quota tenants; deadline_expired = shed-before-work, a "
+        "request whose deadline lapsed while queued was never "
+        "dispatched to burn prefill)"),
+    "gateway_brownout_level": _g(
+        (), "overload brownout ladder rung in force (0 none; 1 hedging "
+        "disabled; 2 + speculation shrunk; 3 + tenant shedding) — set "
+        "by the fleet controller when capacity cannot arrive in time"),
 
     # -- session-KV store, gateway side (gateway/sessionstore.py):
     #    degradation accounting for the external insurance store
@@ -196,11 +207,52 @@ CATALOG: Dict[str, MetricSpec] = {
     "replica_migrate_wire_bytes_total": _c(
         ("dir",), "encoded transfer payload bytes through the "
         "migration verbs by direction"),
+    "replica_http_expired_refusals_total": _c(
+        (), "admissions the replica refused because the remaining "
+        "deadline the gateway shipped on the wire elapsed while the "
+        "request queued in the serving loop's inbox (shed-before-work, "
+        "replica side: no prefill burned for an abandoned caller)"),
     "replica_stream_fastforward_tokens_total": _c(
         (), "tokens a submit's resume watermark told this replica NOT "
         "to emit (the caller already has them — hedge twins and "
         "gateway-failover resumes decode them but fast-forward "
         "emission)"),
+
+    # -- fleet controller (controller/): the serving↔scheduling loop
+    "controller_reconciles_total": _c((), "reconcile ticks run"),
+    "controller_pressure": _g(
+        (), "EWMA-smoothed SLO pressure: max(backlog (queued + "
+        "in-flight) / per-replica target, recent TTFT / target) — the "
+        "one number the scale and brownout thresholds judge"),
+    "controller_serving_replicas": _g(
+        (), "routable serving replicas observed this tick"),
+    "controller_desired_replicas": _g(
+        (), "replica count this tick's decision aims at (observed "
+        "+/- 1; the loop reshapes one step at a time)"),
+    "controller_draining_replicas": _g(
+        (), "replicas mid-drain (DRAINING, not yet released)"),
+    "controller_fleet_util": _g(
+        (), "max replica token-budget saturation from the per-step "
+        "ledgers (0 when replicas keep no ledger)"),
+    "controller_scale_events_total": _c(
+        ("dir",), "fleet reshape decisions by direction (up = a new "
+        "serving pod gang-scheduled, preempting batch if needed; down "
+        "= a replica drained before its chips release to batch)"),
+    "controller_scale_up_failed_total": _c(
+        (), "scale-ups that found no placement even with preemption "
+        "(arms the brownout ladder: capacity cannot arrive in time)"),
+    "controller_releases_total": _c(
+        (), "drained replicas released (pod deleted, chips returned "
+        "to the pool) — exactly once per drain, restarts included"),
+    "controller_requeued_pods_total": _c(
+        (), "preempted batch pods checkpointed and recreated PENDING "
+        "(checkpoint-and-requeue; they re-bind when chips free up)"),
+    "controller_drains_resumed_total": _c(
+        (), "in-progress drains adopted by a restarted controller "
+        "(re-derived from the registry's DRAINING marks)"),
+    "controller_brownout_level": _g(
+        (), "brownout rung the controller currently holds the "
+        "gateway(s) at (mirrors gateway_brownout_level)"),
 
     # -- serving data plane (models/serving.py, models/paging.py)
     "serve_ttft_seconds": _h((), "submit -> first generated token"),

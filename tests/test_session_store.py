@@ -478,9 +478,10 @@ def test_restore_fires_into_same_pod_name_after_loss():
 
 def test_restore_noop_is_metadata_only_and_restores_fetch_full():
     """restore_for runs on the dispatch hot path for EVERY sessionful
-    request: the common healthy-home no-op must decide on a metadata
-    read (no payload bytes), and only an actual restore pays the full
-    fetch."""
+    request — three cost tiers, cheapest first: a HINTED healthy home
+    (the turn just completed here) skips the store entirely; an
+    unhinted healthy-home no-op decides on a metadata read (no payload
+    bytes); only an actual restore pays the full fetch."""
     calls = []
 
     class _Spy(InProcessStoreBackend):
@@ -493,11 +494,57 @@ def test_restore_noop_is_metadata_only_and_restores_fetch_full():
     kv.record("s", "rA", [1, 2])
     assert kv.capture(client, "s")
     calls.clear()
+    # record() just learned the healthy home: the hint makes repeat
+    # dispatches to rA free — zero store round-trips
     assert not kv.restore_for(_Req("s"), "rA", client)
-    assert calls == [True], "healthy-home no-op fetched the payload"
+    assert calls == [], "hinted healthy home must not touch the store"
+    # ring movement drops every hint; the next healthy-home dispatch
+    # decides on ONE metadata read and re-arms the hint
+    kv.sync_live(["rA", "rB"])
+    assert not kv.restore_for(_Req("s"), "rA", client)
+    assert calls == [True], "unhinted no-op must be metadata-only"
+    calls.clear()
+    assert not kv.restore_for(_Req("s"), "rA", client)
+    assert calls == [], "the no-op must re-arm the hint"
     calls.clear()
     assert kv.restore_for(_Req("s"), "rB", client)
     assert calls == [True, False], "restore must re-read the full entry"
+
+
+def test_hint_cache_invalidates_on_restore_degrade_and_movement():
+    """A stale hint may only ever cost one skipped mispin-restore — so
+    every event that could move a session's KV drops it: the restore
+    itself (the entry re-homed), any degrade (the entry's state is in
+    doubt), and ring movement (mark_lost / sync_live)."""
+    calls = []
+
+    class _Spy(InProcessStoreBackend):
+        def get(self, session, meta=False):
+            calls.append(meta)
+            return super().get(session, meta=meta)
+
+    backend = _Spy()
+    kv = SessionKVStore(backend=backend)
+    client = _FakeReplicaClient()
+    kv.record("s", "rA", [1, 2])
+    assert kv.capture(client, "s")
+    # restore away re-homes to rB — the rA hint must NOT survive it
+    assert kv.restore_for(_Req("s"), "rB", client)
+    calls.clear()
+    assert not kv.restore_for(_Req("s"), "rB", client)
+    assert calls == [True], (
+        "post-restore dispatch must re-verify via the store once"
+    )
+    # mark_lost (a drain/death) drops hints: the next dispatch to the
+    # SAME key must consult the store and see the loss
+    kv.mark_lost("rB")
+    calls.clear()
+    assert kv.restore_for(_Req("s"), "rB", client)
+    assert calls and calls[0] is True
+    # a degrade drops the session's hint too
+    kv._hints["s"] = "rB"
+    kv._degrade("s", "unreachable")
+    assert "s" not in kv._hints
 
 
 def test_meta_get_strips_payload_on_both_backends(store):
