@@ -72,7 +72,14 @@ bench:
 # TTFT through the external store within 1.2x of the in-process
 # backend on the same warm replicas, store-DOWN degradation bounded
 # (cold + one fast breaker trip, never a deadline-length stall), fp32
-# token identity across all three lanes
+# token identity across all three lanes.  Also the quantized page pool
+# (serving_quantized_pool): at EQUAL pool byte budget the int8 pool
+# must serve the same warm traffic strictly faster than the bf16 pool
+# with >= 1.8x the effective rows, deterministic int8 streams, a
+# token-identical export->import round trip at well under the bf16
+# wire bytes, the fp32 full-width lane token-identical to the dense
+# oracle, and an int8-pool soak kill schedule holding page accounting;
+# token agreement / divergence margins / ppl delta are REPORTED
 bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve-smoke
 
@@ -98,6 +105,10 @@ multichip-smoke:
 # on A, migrates mid-stream to B over the export/import verbs, A is
 # SIGKILLed after the handoff — the stream must finish on B
 # token-identical to a never-migrated reference
+# dryrun_quantized_serving: TWO real replica subprocesses serving with
+# --kv-dtype int8 — deterministic int8 streams, /v1/state advertising
+# the per-dtype page-byte economy, and a mid-flight migration over the
+# quantized (int8 pages + scales) wire schema, token-identical
 # dryrun_gateway_tier: TWO gateways over one registry; a greedy stream's
 # home gateway is KILLED mid-stream and the client retries on the
 # survivor with the resume watermark — the stream completes via the
@@ -121,6 +132,7 @@ dryrun:
 	  g.dryrun_gateway_tier(); \
 	  g.dryrun_spec_serving(); g.dryrun_tracing(); \
 	  g.dryrun_http_serving(); g.dryrun_kv_migration(); \
+	  g.dryrun_quantized_serving(); \
 	  g.dryrun_gateway_pods(); g.dryrun_controller(); \
 	  g.dryrun_multichip(8)"
 

@@ -2351,6 +2351,303 @@ def serving_migration(extra: dict, tiny: bool = False) -> None:
     extra["serve_migration_pages_per_s"] = round(pages_per_s, 1)
 
 
+def serving_quantized_pool(extra: dict, tiny: bool = False) -> None:
+    """The int8 KV page pool as a CAPACITY and throughput lever
+    (ISSUE 15): two paged batchers serve the SAME warm traffic at the
+    SAME pool byte budget — one storing full-width bf16 pages, one
+    storing int8 pages + per-page per-head scales (half the bytes per
+    page, so nearly 2x the pool pages fit the budget).  The byte-
+    starved bf16 pool defers admissions under pool pressure while the
+    int8 pool runs the whole burst concurrently — exactly how the
+    capacity lever cashes out as throughput on production traffic.
+
+    Gates (tiny/CPU, make bench-smoke):
+    - int8-pool paged decode tok/s STRICTLY above the bf16 pool on the
+      same warm traffic (min-of-N interleaved passes);
+    - effective pool rows at equal byte budget >= 1.8x (computed from
+      the constructed pools' ACTUAL resting nbytes, scales included);
+    - fp32 full-width pool (kv_dtype=None) token-identical to the
+      dense serial oracle — the machinery must not perturb today's
+      full-width path;
+    - int8 streams deterministic (two fresh batchers, identical
+      traffic, identical tokens);
+    - one live export→import round trip between int8 pools:
+      continuation token-identical to never-migrated, page accounting
+      (incl. the per-dtype bytes leg) on both ends, and the encoded
+      wire payload well under the bf16 pool's for the same pages;
+    - a GatewaySoak kill schedule over kv_dtype=int8 batchers holding
+      page accounting at quiescence.
+
+    Reported, not assumed (the PR 4/PR 5 instrumentation discipline):
+    int8-vs-bf16 token agreement, top1-top2 logit margin at first
+    divergence, and the teacher-forced eval NLL delta of the two
+    streams (the eval_ppl_delta_int8 recipe applied to the pool)."""
+    import json as _json
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubegpu_tpu.gateway.dataplane import (
+        decode_kv_payload,
+        encode_kv_payload,
+    )
+    from kubegpu_tpu.models import TransformerLM
+    from kubegpu_tpu.models.paging import PagedContinuousBatcher
+    from kubegpu_tpu.models.serving import (
+        ContinuousBatcher,
+        record_quant_quality,
+    )
+    from kubegpu_tpu.utils.metrics import Metrics
+
+    if tiny:
+        vocab, layers, heads, hidden = 61, 2, 4, 32
+        page, prompt_pad, max_seq = 8, 32, 96
+        n_req, budget, n_passes = 8, 24, 3
+    else:
+        vocab, layers, hidden = 32768, 4, 4096
+        heads = hidden // 128
+        page, prompt_pad, max_seq = 64, 256, 768
+        n_req, budget, n_passes = 16, 192, 3
+    model = TransformerLM(
+        vocab_size=vocab, num_layers=layers, num_heads=heads,
+        hidden=hidden, max_seq=max_seq,
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32)
+    )["params"]
+    cfg = dict(
+        vocab_size=vocab, num_layers=layers, num_heads=heads,
+        hidden=hidden, max_seq=max_seq, slots=n_req,
+        prompt_pad=prompt_pad, page_size=page,
+    )
+    rs = np.random.RandomState(11)
+    prompts = [
+        rs.randint(
+            0, vocab, size=int(rs.randint(2 * page, 3 * page + 1))
+        ).astype(np.int32)
+        for _ in range(n_req)
+    ]
+    budgets = [budget] * n_req
+    need_pages = max(
+        -(-(len(p) + budget) // page) for p in prompts
+    ) * n_req
+
+    # -- equal BYTE budget, different page counts -------------------------
+    # the budget is what an int8 pool of `need_pages` pages rests; the
+    # bf16 pool gets however many full-width pages fit the same bytes
+    quant = PagedContinuousBatcher(
+        params, dtype=jnp.bfloat16, kv_dtype="int8",
+        pool_pages=need_pages + 1, metrics=Metrics(), **cfg,
+    )
+
+    def _pool_nbytes(cb):
+        total = 0
+        for kent, vent in cb.pools:
+            for ent in (kent, vent):
+                if cb.kv_quant:
+                    total += ent[0].nbytes + ent[1].nbytes
+                else:
+                    total += ent.nbytes
+        return total
+
+    q_total = _pool_nbytes(quant)
+    q_page_bytes = q_total / (need_pages + 1)
+    # a bf16 page rests the int8 page's data bytes at 2 B/elem, no scales
+    scale_per_page = 2 * layers * heads * 4
+    f_page_bytes = (
+        (q_page_bytes - scale_per_page)
+        * jnp.dtype(jnp.bfloat16).itemsize
+    )
+    bf_pages = int(q_total // f_page_bytes)
+    full = PagedContinuousBatcher(
+        params, dtype=jnp.bfloat16, pool_pages=bf_pages + 1, **cfg,
+    )
+    assert bf_pages * f_page_bytes <= need_pages * q_page_bytes + f_page_bytes
+    rows_ratio = (need_pages * page) / (bf_pages * page)
+
+    # warm every program off the clock (both lanes, same traffic shape)
+    warm = rs.randint(0, vocab, size=2 * page + 3).astype(np.int32)
+    for cb in (quant, full):
+        cb.run([warm, warm.copy()], [4, 4])
+
+    def one_pass(cb):
+        t0 = time.perf_counter()
+        out = cb.run([p.copy() for p in prompts], budgets)
+        dt = time.perf_counter() - t0
+        toks = sum(len(v) for v in out.values())
+        return out, toks / dt
+
+    # min-of-N interleaved passes (the shared-box de-noising every
+    # serving gate uses).  Outputs are captured on the FIRST pass: a
+    # later pass sees its own pass-1 pages in the prefix cache, and an
+    # int8 hit gathers DEQUANTIZED bytes into the station — the
+    # measured quantized-sharing class, deliberately not mixed into
+    # the fresh-traffic agreement numbers below
+    q_tokps, f_tokps = 0.0, 0.0
+    q_out: dict = {}
+    f_out: dict = {}
+    for p in range(n_passes):
+        lanes = [(quant, "q"), (full, "f")]
+        if p % 2:
+            lanes = lanes[::-1]
+        for cb, tag in lanes:
+            out, tokps = one_pass(cb)
+            if tag == "q":
+                q_tokps = max(q_tokps, tokps)
+                q_out = q_out or out
+            else:
+                f_tokps = max(f_tokps, tokps)
+                f_out = f_out or out
+    quant.assert_page_accounting()
+    full.assert_page_accounting()
+
+    # -- measured quality: agreement, margins, ppl delta ------------------
+    agree = total = 0
+    for i in f_out:
+        a, b = f_out[i], q_out.get(i, [])
+        total += len(a)
+        agree += sum(x == y for x, y in zip(a, b))
+    agreement = agree / max(total, 1)
+    kw = dict(
+        vocab_size=vocab, num_layers=layers, num_heads=heads,
+        hidden=hidden, max_seq=max_seq,
+    )
+    margins = []
+    if agreement < 1.0:
+        margins = _spec_divergence_margins(
+            params, kw, prompts, f_out, q_out
+        )
+
+    def mean_nll(outs):
+        # teacher-forced NLL of each continuation under the fp32
+        # reference forward — the eval_ppl_delta_int8 discipline
+        tot, n = 0.0, 0
+        for i, toks in sorted(outs.items()):
+            seq = np.concatenate([
+                prompts[i], np.asarray(toks, np.int32)
+            ])[None, :]
+            logits = model.apply(
+                {"params": params}, jnp.asarray(seq)
+            ).astype(jnp.float32)
+            lp = jax.nn.log_softmax(logits, axis=-1)
+            plen = len(prompts[i])
+            for j, t in enumerate(toks):
+                tot -= float(lp[0, plen + j - 1, int(t)])
+                n += 1
+        return tot / max(n, 1)
+
+    ppl_delta = mean_nll(q_out) - mean_nll(f_out)
+    record_quant_quality(
+        quant.metrics, agreement=agreement,
+        margin=(margins[0] if margins else None), ppl_delta=ppl_delta,
+    )
+
+    # -- int8 determinism: a fresh pool, same traffic, same tokens --------
+    quant2 = PagedContinuousBatcher(
+        params, dtype=jnp.bfloat16, kv_dtype="int8",
+        pool_pages=need_pages + 1, **cfg,
+    )
+    quant2.run([warm, warm.copy()], [4, 4])
+    out2, _ = one_pass(quant2)
+    deterministic = out2 == q_out
+
+    # -- fp32 full-width lane: token-identical to the dense oracle --------
+    fp32_paged = PagedContinuousBatcher(
+        params, dtype=jnp.float32, pool_pages=need_pages + 1, **cfg,
+    )
+    fp32_dense = ContinuousBatcher(
+        params, dtype=jnp.float32,
+        **{k: v for k, v in cfg.items() if k != "page_size"},
+    )
+    sub = prompts[:4]
+    fp32_identical = (
+        fp32_paged.run([p.copy() for p in sub], budgets[:4])
+        == fp32_dense.run([p.copy() for p in sub], budgets[:4])
+    )
+
+    # -- live export→import round trip + halved wire bytes ----------------
+    imp = PagedContinuousBatcher(
+        params, dtype=jnp.bfloat16, kv_dtype="int8",
+        pool_pages=need_pages + 1, **cfg,
+    )
+    ref = PagedContinuousBatcher(
+        params, dtype=jnp.bfloat16, kv_dtype="int8",
+        pool_pages=need_pages + 1, **cfg,
+    )
+    for cb in (imp, ref):
+        cb.run([warm.copy()], [4])
+    mig_prompt = prompts[0]
+    quant.submit(900, mig_prompt.copy(), budget)
+    for _ in range(page + 6):
+        quant.serve_step()
+    payload = quant.export_pages(900)
+    wire_q = _json.dumps(encode_kv_payload(payload))
+    quant.cancel(900)
+    imp.import_pages(900, decode_kv_payload(_json.loads(wire_q)))
+    done_imp: dict = {}
+    while imp.has_work():
+        done_imp.update(imp.serve_step())
+    ref_out = ref.run([mig_prompt.copy()], [budget])
+    migrate_identical = done_imp.get(900) == ref_out[0]
+    quant.assert_page_accounting()
+    imp.assert_page_accounting()
+    # the SAME stream's pages off the bf16 pool, for the wire ratio
+    full.submit(901, mig_prompt.copy(), budget)
+    for _ in range(page + 6):
+        full.serve_step()
+    wire_f = _json.dumps(encode_kv_payload(full.export_pages(901)))
+    full.cancel(901)
+    n_mig_pages = len(payload["layers"][0][0])
+    wire_ratio = len(wire_q) / max(len(wire_f), 1)
+
+    # -- soak: kill schedule over int8-pool batchers ----------------------
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    fp32_params = params  # fp32 compute keeps the soak fast on CPU
+    soak = GatewaySoak(
+        seed=23, n_replicas=2, multiturn=True,
+        batcher_factory=lambda key: PagedContinuousBatcher(
+            fp32_params, slots=4, prompt_pad=16, page_size=8,
+            pool_pages=48, station_slots=2, dtype=jnp.float32,
+            kv_dtype="int8", decode_page_cache="quantized",
+            vocab_size=vocab, num_layers=layers, num_heads=heads,
+            hidden=hidden, max_seq=max_seq,
+        ),
+    )
+    soak.run(steps=12)
+    soak_ok = True  # GatewaySoak raises on any violated invariant
+
+    label = "tiny/CPU bf16" if tiny else "1.08B bf16"
+    log(
+        f"serving quantized pool ({label}, {n_req} reqs x {budget} new, "
+        f"equal byte budget {q_total} B): int8 pool {need_pages} pages "
+        f"({q_tokps:.0f} tok/s) vs bf16 pool {bf_pages} pages "
+        f"({f_tokps:.0f} tok/s) = {q_tokps / max(f_tokps, 1e-9):.2f}x; "
+        f"rows ratio {rows_ratio:.2f}x; agreement {agreement * 100:.1f}% "
+        f"margins {[round(m, 4) for m in margins] or 'n/a'}; "
+        f"ppl delta {ppl_delta:+.4f}; deterministic {deterministic}; "
+        f"fp32 lane identical {fp32_identical}; migrated {n_mig_pages} "
+        f"pages identical {migrate_identical}, wire {len(wire_q)} B vs "
+        f"bf16 {len(wire_f)} B ({wire_ratio:.2f}x); soak ok {soak_ok}"
+    )
+    extra["serve_qpool_tok_s_int8"] = round(q_tokps, 1)
+    extra["serve_qpool_tok_s_bf16"] = round(f_tokps, 1)
+    extra["serve_qpool_strictly_better"] = bool(q_tokps > f_tokps)
+    extra["serve_qpool_rows_ratio"] = round(rows_ratio, 3)
+    extra["serve_qpool_rows_ok"] = bool(rows_ratio >= 1.8)
+    extra["serve_qpool_agreement"] = round(agreement, 4)
+    extra["serve_qpool_margins"] = [round(m, 5) for m in margins]
+    extra["serve_qpool_ppl_delta"] = round(float(ppl_delta), 5)
+    extra["serve_qpool_deterministic"] = bool(deterministic)
+    extra["serve_qpool_fp32_token_identical"] = bool(fp32_identical)
+    extra["serve_qpool_migrate_identical"] = bool(migrate_identical)
+    extra["serve_qpool_migrate_pages"] = int(n_mig_pages)
+    extra["serve_qpool_wire_ratio"] = round(wire_ratio, 3)
+    extra["serve_qpool_soak_ok"] = bool(soak_ok)
+
+
 def serving_store_failover(extra: dict, tiny: bool = False) -> None:
     """External session-KV store as a latency primitive (ISSUE 13): a
     session's turn 1 completes on replica HOME (sealing its pages,
@@ -4478,6 +4775,7 @@ def main() -> None:
         serving_trace_report(extra, tiny=True)
         serving_http_overhead(extra, tiny=True)
         serving_migration(extra, tiny=True)
+        serving_quantized_pool(extra, tiny=True)
         serving_store_failover(extra, tiny=True)
         serving_gateway_scaleout(extra, tiny=True)
         serving_autoscale(extra, tiny=True)
@@ -4513,6 +4811,21 @@ def main() -> None:
             and extra["serve_migration_strictly_better"]
             and extra["serve_migration_token_identical"]
             and extra["serve_migration_pages"] > 0
+            # the quantized page pool: at EQUAL pool byte budget the
+            # int8 pool must serve the same warm traffic strictly
+            # faster (capacity → throughput) with >= 1.8x the rows,
+            # deterministic streams, a token-identical export→import
+            # round trip, the fp32 full-width lane untouched, and the
+            # soak kill schedule holding page accounting (agreement /
+            # margins / ppl delta are REPORTED above, not assumed)
+            and extra["serve_qpool_strictly_better"]
+            and extra["serve_qpool_rows_ok"]
+            and extra["serve_qpool_deterministic"]
+            and extra["serve_qpool_fp32_token_identical"]
+            and extra["serve_qpool_migrate_identical"]
+            and extra["serve_qpool_migrate_pages"] > 0
+            and extra["serve_qpool_wire_ratio"] < 0.7
+            and extra["serve_qpool_soak_ok"]
             # the external session store: crash-durability must cost
             # ≤1.2x the in-process backend's restored turn-2 TTFT, a
             # DEAD store must degrade to bounded cold prefill (one fast

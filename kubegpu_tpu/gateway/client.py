@@ -30,12 +30,14 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, FrozenSet, List, Optional
 
-# the paged batchers' session-KV-reuse policy values.  Canonically
-# declared in models/serving.py (DECODE_PAGE_CACHE_POLICIES); mirrored
-# here because the gateway layer is deliberately jax-free and must not
-# import the model stack for a three-string tuple.  The two tuples are
-# pinned equal by tests/test_multiturn_kv.py.
-DECODE_PAGE_CACHE_POLICIES = ("off", "fp32", "all")
+# the paged batchers' session-KV-reuse policy values and KV page-pool
+# storage formats.  Canonically declared in models/serving.py
+# (DECODE_PAGE_CACHE_POLICIES / KV_DTYPES); mirrored here because the
+# gateway layer is deliberately jax-free and must not import the model
+# stack for a few string tuples.  The pairs are pinned equal by
+# tests/test_multiturn_kv.py and tests/test_quantized_pool.py.
+DECODE_PAGE_CACHE_POLICIES = ("off", "fp32", "quantized", "all")
+KV_DTYPES = ("bf16", "fp32", "int8")
 
 
 def _sniff_takes(batcher, method: str, param: str) -> bool:
@@ -226,9 +228,15 @@ class SimBatcher:
     verify window is k+1 rows wide regardless of acceptance).
 
     ``decode_page_cache`` is the paged batchers' session-KV-reuse policy
-    ({"off", "fp32", "all"}): the mill has no KV to seal, so it only
-    validates the widened contract — a policy typo must die at replica
-    construction here exactly as it would on a real batcher.
+    ({"off", "fp32", "quantized", "all"}): the mill has no KV to seal,
+    so it only validates the widened contract — a policy typo must die
+    at replica construction here exactly as it would on a real batcher.
+
+    ``kv_dtype`` is the paged batchers' page-pool storage format
+    ({None, "bf16", "fp32", "int8"}): the mill stores no KV, so it
+    validates the contract, advertises the format, and stamps it into
+    its migration payloads — an importer on a different format refuses
+    exactly like a real batcher's geometry check.
 
     ``submit(..., trace=)`` takes the caller's span context like the
     real batchers and emits the same minimal subtree (serve → queue →
@@ -239,6 +247,7 @@ class SimBatcher:
                  token_budget: Optional[int] = None,
                  speculate_k: Optional[int] = None,
                  decode_page_cache: str = "off",
+                 kv_dtype: Optional[str] = None,
                  tp: int = 1) -> None:
         if token_budget is not None and token_budget <= 0:
             raise ValueError(
@@ -252,6 +261,11 @@ class SimBatcher:
             raise ValueError(
                 f"decode_page_cache must be one of "
                 f"{DECODE_PAGE_CACHE_POLICIES}, got {decode_page_cache!r}"
+            )
+        if kv_dtype is not None and kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype must be one of {KV_DTYPES} or None, got "
+                f"{kv_dtype!r}"
             )
         if tp < 1:
             # the paged batchers' tensor-parallel width contract: the
@@ -270,6 +284,15 @@ class SimBatcher:
         # never changes a stream — just how many tokens a step emits.
         self._spec_configured = speculate_k
         self.decode_page_cache = decode_page_cache
+        # canonical storage name for /state and migration payloads —
+        # the REAL batcher advertises numpy-style names
+        # ("bfloat16"/"float32"/"int8"), so the mill maps the CLI knob
+        # the same way or a mixed fleet would read as a spurious
+        # kv_dtype skew (the mill "computes" nothing; its full-width
+        # twin is bf16)
+        self.kv_dtype = {
+            "bf16": "bfloat16", "fp32": "float32", "int8": "int8",
+        }[kv_dtype or "bf16"]
         self.tp = tp
         self._pending: deque = deque()
         self._active: Dict[int, tuple] = {}  # seq -> (tokens, max_new)
@@ -349,12 +372,21 @@ class SimBatcher:
             "kind": "live", "sim": True, "tokens": list(tokens),
             "max_new": int(max_new),
             "seed": int(self._seed.get(seq_id, seq_id)),
+            "kv_dtype": self.kv_dtype,
         }
 
     def import_pages(self, seq_id: int, payload: dict,
                      trace=None) -> None:
         if payload.get("kind") != "live" or not payload.get("sim"):
             raise ValueError("not a sim-mill payload")
+        if payload.get("kv_dtype", "bfloat16") != self.kv_dtype:
+            # the real batchers' geometry refusal, mill-modeled: pages
+            # stored in one format are not importable into another
+            raise ValueError(
+                f"transfer geometry mismatch on kv_dtype: payload "
+                f"{payload.get('kv_dtype')!r} vs this batcher "
+                f"{self.kv_dtype!r}"
+            )
         if seq_id in self._active or any(
             sid == seq_id for sid, *_rest in self._pending
         ):

@@ -142,42 +142,65 @@ def _np_dtype(name: str) -> np.dtype:
         return np.dtype(getattr(ml_dtypes, name))
 
 
+def _encode_pairs(pairs) -> list:
+    return [
+        {
+            "k": base64.b64encode(
+                np.ascontiguousarray(k).tobytes()
+            ).decode("ascii"),
+            "v": base64.b64encode(
+                np.ascontiguousarray(v).tobytes()
+            ).decode("ascii"),
+            "shape": [int(d) for d in np.shape(k)],
+        }
+        for k, v in pairs
+    ]
+
+
+def _decode_pairs(entries, dtype) -> list:
+    return [
+        (
+            np.frombuffer(
+                base64.b64decode(e["k"]), dtype=dtype
+            ).reshape(e["shape"]),
+            np.frombuffer(
+                base64.b64decode(e["v"]), dtype=dtype
+            ).reshape(e["shape"]),
+        )
+        for e in entries
+    ]
+
+
 def encode_kv_payload(payload: dict) -> dict:
     """JSON-safe encoding of a KV transfer payload (identity on
-    payloads without page arrays, e.g. SimBatcher's cursor-only ones)."""
-    out = {k: v for k, v in payload.items() if k != "layers"}
+    payloads without page arrays, e.g. SimBatcher's cursor-only ones).
+    Schema v2 (quantized pools): ``layers`` carry int8 page bytes —
+    HALF the wire per page of a bf16 pool — and a ``scales`` section
+    carries the (pages, heads) float32 per-page per-head scales."""
+    out = {
+        k: v for k, v in payload.items() if k not in ("layers", "scales")
+    }
     if "layers" in payload:
-        out["layers"] = [
-            {
-                "k": base64.b64encode(
-                    np.ascontiguousarray(k).tobytes()
-                ).decode("ascii"),
-                "v": base64.b64encode(
-                    np.ascontiguousarray(v).tobytes()
-                ).decode("ascii"),
-                "shape": [int(d) for d in np.shape(k)],
-            }
-            for k, v in payload["layers"]
-        ]
+        out["layers"] = _encode_pairs(payload["layers"])
+    if "scales" in payload:
+        out["scales"] = _encode_pairs(payload["scales"])
     return out
 
 
 def decode_kv_payload(wire: dict) -> dict:
-    """The inverse: base64 page arrays back to host numpy."""
-    out = {k: v for k, v in wire.items() if k != "layers"}
+    """The inverse: base64 page arrays back to host numpy.  The page
+    arrays' dtype is the geometry's STORAGE format (``kv_dtype``, v2)
+    — int8 for quantized pools — falling back to the compute ``dtype``
+    for schema-1 payloads; scales are always float32."""
+    out = {
+        k: v for k, v in wire.items() if k not in ("layers", "scales")
+    }
     if "layers" in wire:
-        dtype = _np_dtype(wire["geometry"]["dtype"])
-        out["layers"] = [
-            (
-                np.frombuffer(
-                    base64.b64decode(e["k"]), dtype=dtype
-                ).reshape(e["shape"]),
-                np.frombuffer(
-                    base64.b64decode(e["v"]), dtype=dtype
-                ).reshape(e["shape"]),
-            )
-            for e in wire["layers"]
-        ]
+        geom = wire["geometry"]
+        dtype = _np_dtype(geom.get("kv_dtype") or geom["dtype"])
+        out["layers"] = _decode_pairs(wire["layers"], dtype)
+    if "scales" in wire:
+        out["scales"] = _decode_pairs(wire["scales"], np.float32)
     return out
 
 
@@ -415,6 +438,12 @@ class ReplicaServingLoop:
                 k: v for k, v in stats.items()
                 if isinstance(v, (int, float, str, bool))
             }
+        # the pool's declared storage format rides the contract surface:
+        # the gateway can see a fleet's kv_dtype skew without reading
+        # ledgers, and migration tooling can pre-check compatibility
+        kv_dtype = getattr(b, "kv_dtype", None)
+        if kv_dtype is not None:
+            out["kv_dtype"] = kv_dtype
         rows_fn = getattr(b, "ledger_rows", None)
         if rows_fn is not None:
             rows = rows_fn(max(ledger_limit, 1))
@@ -425,6 +454,16 @@ class ReplicaServingLoop:
                     "live": last.get("pages_live", 0),
                     "cached": last.get("pages_cached", 0),
                 }
+                # per-dtype byte economy (the quantized-pool capacity
+                # audit): what the pool RESTS by storage format
+                if "kv_dtype" in last:
+                    out["pages"]["kv_dtype"] = last["kv_dtype"]
+                    out["pages"]["kv_bytes"] = last.get(
+                        "pool_kv_bytes", 0
+                    )
+                    out["pages"]["scale_bytes"] = last.get(
+                        "pool_scale_bytes", 0
+                    )
             if ledger_limit > 0:
                 out["ledger"] = rows[-ledger_limit:]
         return out

@@ -439,8 +439,8 @@ class GatewayServer:
 
 def _build_fake_serving_cluster(preset: str, replicas: int, group: str,
                                 token_budget=None, speculate_k=None,
-                                decode_page_cache="off", tp=1,
-                                priority=None):
+                                decode_page_cache="off", kv_dtype=None,
+                                tp=1, priority=None):
     """Fabricated cluster + scheduled decode replicas + SimBatcher-backed
     in-memory data plane: the full serving path with zero dependencies."""
     from kubegpu_tpu.gateway.client import InMemoryReplicaClient, SimBatcher
@@ -465,7 +465,8 @@ def _build_fake_serving_cluster(preset: str, replicas: int, group: str,
     client = InMemoryReplicaClient(
         batcher_factory=lambda key: SimBatcher(
             slots=8, token_budget=token_budget, speculate_k=speculate_k,
-            decode_page_cache=decode_page_cache, tp=tp,
+            decode_page_cache=decode_page_cache, kv_dtype=kv_dtype,
+            tp=tp,
         ),
         step_delay_s=0.002,
     )
@@ -613,7 +614,10 @@ def main(argv=None) -> None:
         "budget rows per speculative slot; the SimBatcher data planes "
         "here model exactly that accounting",
     )
-    from kubegpu_tpu.gateway.client import DECODE_PAGE_CACHE_POLICIES
+    from kubegpu_tpu.gateway.client import (
+        DECODE_PAGE_CACHE_POLICIES,
+        KV_DTYPES,
+    )
 
     ap.add_argument(
         "--decode-page-cache", default="off",
@@ -628,6 +632,19 @@ def main(argv=None) -> None:
         "near-tie argmaxes — measured in bench.py serving_multiturn).  "
         "Consumed replica-side by the real paged batchers; the "
         "in-process SimBatcher planes here only validate the contract",
+    )
+    ap.add_argument(
+        "--kv-dtype", default=None, choices=list(KV_DTYPES),
+        help="replica batchers' KV page-pool STORAGE format: int8 = "
+        "per-page per-head-scaled int8 pages (half the resting pool "
+        "bytes, ~2x the pool rows per byte budget, half the migration "
+        "wire bytes per page; agreement/margins measured by bench.py "
+        "serving_quantized_pool), bf16/fp32 = explicit full width "
+        "(must match the serving dtype).  Consumed replica-side by the "
+        "real paged batchers (models.worker --kv-dtype); the in-process "
+        "SimBatcher planes here validate the contract, advertise the "
+        "format, and refuse dtype-mismatched migrations like the real "
+        "geometry check.  Default: full width at the serving dtype",
     )
     ap.add_argument(
         "--tp", type=int, default=1,
@@ -744,7 +761,8 @@ def main(argv=None) -> None:
                 args.fake_cluster, args.replicas, args.group,
                 token_budget=args.token_budget,
                 speculate_k=args.speculate_k,
-                decode_page_cache=args.decode_page_cache, tp=args.tp,
+                decode_page_cache=args.decode_page_cache,
+                kv_dtype=args.kv_dtype, tp=args.tp,
                 # the preemption contract: serving replicas must be
                 # deployed AT the controller's serving priority, or an
                 # unstamped replica (default 0) reads as a victim and a
@@ -814,6 +832,7 @@ def main(argv=None) -> None:
                     slots=8, token_budget=args.token_budget,
                     speculate_k=args.speculate_k,
                     decode_page_cache=args.decode_page_cache,
+                    kv_dtype=args.kv_dtype,
                     tp=args.tp,
                 ),
                 step_delay_s=0.002,

@@ -141,17 +141,21 @@ class SessionStoreBackend:
 
 def payload_bytes(payload) -> int:
     """Approximate retained bytes of a KV payload — host-numpy layers
-    (in-memory lane) or base64 strings (wire lane)."""
+    (in-memory lane) or base64 strings (wire lane).  Quantized
+    (schema-v2) payloads carry a ``scales`` section next to their int8
+    ``layers``; both count, or the byte budget would silently
+    under-charge every quantized session."""
     if not isinstance(payload, dict):
         return 0
     total = 0
-    for entry in payload.get("layers") or []:
-        if isinstance(entry, dict):      # encoded wire payload
-            total += len(entry.get("k") or "")
-            total += len(entry.get("v") or "")
-        else:                            # (k, v) host arrays
-            for arr in entry:
-                total += getattr(arr, "nbytes", 0)
+    for section in ("layers", "scales"):
+        for entry in payload.get(section) or []:
+            if isinstance(entry, dict):      # encoded wire payload
+                total += len(entry.get("k") or "")
+                total += len(entry.get("v") or "")
+            else:                            # (k, v) host arrays
+                for arr in entry:
+                    total += getattr(arr, "nbytes", 0)
     return total
 
 

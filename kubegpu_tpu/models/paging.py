@@ -99,6 +99,7 @@ from kubegpu_tpu.models.serving import (
     _SeqTrace,
     _validate_request,
     resolve_decode_page_cache,
+    resolve_kv_dtype,
 )
 from kubegpu_tpu.parallel.sharding import (
     MODEL_AXIS,
@@ -111,12 +112,48 @@ from kubegpu_tpu.parallel.sharding import (
 )
 from kubegpu_tpu.utils.tracing import SpanCtx, Tracer
 from kubegpu_tpu.ops.paged_attention import (
+    dequantize_pages,
     paged_chunk_attention,
     paged_chunk_attention_sharded,
     paged_decode_attention,
     paged_decode_attention_sharded,
+    quantize_pages,
 )
 from kubegpu_tpu.utils.metrics import Metrics
+
+
+def _quant_write_row(data, scale, page_ids, offs, rows):
+    """Commit one decode row per slot into a QUANTIZED pool page.
+
+    ``data`` (P, h, page, hd) int8, ``scale`` (P, h) f32, ``page_ids``/
+    ``offs`` (b,) — the slot's current tail page and row — ``rows``
+    (b, h, hd) the new K or V values.  Per-page per-head scales with
+    incremental row writes need the GROW-AND-RESCALE rule: the page's
+    scale only ever grows (new_scale = max(old, row_amax/127)), and
+    when it grows the page's existing int8 values requantize by
+    old/new in the same program — one page-sized gather/rescale/
+    scatter per slot, a O(page) write against the kernel's O(live
+    pages) read, so the write amplification is 1/live-pages of the
+    step's traffic.  Rejected-speculation junk rows can inflate a
+    scale the committed rows never needed; seal-time requantization
+    (``_seal_finished_pages``) recovers that precision when the page
+    enters the shared chain.  Deterministic: same history of writes ⇒
+    bit-identical page bytes, which is what keeps the quantized pool's
+    streams reproducible (and its prefix sharing exact in-mode)."""
+    b = rows.shape[0]
+    rowf = rows.astype(jnp.float32)                      # (b, h, hd)
+    amax = jnp.max(jnp.abs(rowf), axis=-1)               # (b, h)
+    cur_s = scale[page_ids]                              # (b, h)
+    new_s = jnp.maximum(cur_s, amax / 127.0)
+    safe = jnp.where(new_s > 0, new_s, 1.0)
+    ratio = cur_s / safe                                 # <= 1
+    cur = data[page_ids].astype(jnp.float32)             # (b, h, page, hd)
+    cur = jnp.round(cur * ratio[:, :, None, None])
+    qrow = jnp.clip(jnp.round(rowf / safe[:, :, None]), -127, 127)
+    cur = cur.at[jnp.arange(b), :, offs, :].set(qrow)
+    data = data.at[page_ids].set(cur.astype(jnp.int8))
+    scale = scale.at[page_ids].set(new_s)
+    return data, scale
 
 
 class PagedDecodeAttention(nn.Module):
@@ -139,21 +176,30 @@ class PagedDecodeAttention(nn.Module):
     the pool).  The K/V writes stay outside: their sharded heads dim is
     never an indexed dim, so GSPMD partitions the scatter locally.  The
     one all-reduce per block stays in the row-parallel o_proj matmul
-    (the Megatron discipline)."""
+    (the Megatron discipline).
+
+    With ``kv_quant`` (the int8 page pool), each pool operand is a
+    ``(data, scale)`` pair — int8 pages plus (P, h) per-page per-head
+    scales — writes go through the grow-and-rescale quantizer
+    (``_quant_write_row``) and the kernels dequantize in-VMEM via
+    their scale operands.  Scales shard their heads dim like the pages
+    they describe, so the write stays shard-local under TP too."""
 
     num_heads: int
     dtype: jnp.dtype = jnp.bfloat16
     quant: bool = False
+    kv_quant: bool = False
     mesh: Optional[Mesh] = None
 
     @nn.compact
     def __call__(self, x, k_pool, v_pool, table, pos):
-        # x: (b, L, d); pools: (P, h, page, hd); table: (b, n_pages);
-        # pos: (b,) cache row of x's FIRST token
+        # x: (b, L, d); pools: (P, h, page, hd), or ((P, h, page, hd)
+        # int8, (P, h) f32 scale) pairs when kv_quant; table:
+        # (b, n_pages); pos: (b,) cache row of x's FIRST token
         b, L, d = x.shape
         h = self.num_heads
         hd = d // h
-        page = k_pool.shape[2]
+        page = (k_pool[0] if self.kv_quant else k_pool).shape[2]
         dense = (
             partial(QuantDense, dtype=self.dtype)
             if self.quant
@@ -173,6 +219,35 @@ class PagedDecodeAttention(nn.Module):
             decode_attn = paged_decode_attention
             chunk_attn = paged_chunk_attention
         rows = jnp.arange(b)
+        if self.kv_quant:
+            # quantized pool: every window row commits through the
+            # grow-and-rescale quantizer into the slot's own (always
+            # private) tail page, then the kernels read int8 + scales.
+            # The L>1 (speculative verify) window writes one row at a
+            # time — rows may straddle a page boundary, so a fused
+            # single-scale write would need per-row page grouping; L =
+            # k+1 is small, a mid-window scale growth re-rounds at most
+            # L-1 times (each ≤ half a step, and seal-time
+            # requantization restores sealed pages to tight scales), so
+            # the simple unroll is the deliberate trade.
+            kd, ks = k_pool
+            vd, vs = v_pool
+            for j in range(L):
+                page_ids = table[rows, (pos + j) // page]
+                offs = (pos + j) % page
+                kd, ks = _quant_write_row(kd, ks, page_ids, offs, k[:, j])
+                vd, vs = _quant_write_row(vd, vs, page_ids, offs, v[:, j])
+            if L == 1:
+                out = decode_attn(
+                    q[:, 0], kd, vd, table, pos + 1,
+                    k_scale=ks, v_scale=vs,
+                ).reshape(b, 1, d)
+            else:
+                out = chunk_attn(
+                    q, kd, vd, table, pos + 1, k_scale=ks, v_scale=vs
+                ).reshape(b, L, d)
+            out = dense(d, name="o_proj")(out)
+            return out, (kd, ks), (vd, vs)
         if L == 1:
             # the proven decode-step path, byte-for-byte: one write, the
             # single-query kernel (non-speculative serving never changes
@@ -206,6 +281,7 @@ class PagedDecodeBlock(nn.Module):
     mlp_ratio: int = 4
     dtype: jnp.dtype = jnp.bfloat16
     quant: bool = False
+    kv_quant: bool = False
     mesh: Optional[Mesh] = None
 
     @nn.compact
@@ -218,7 +294,8 @@ class PagedDecodeBlock(nn.Module):
         )
         y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
         attn_out, k_pool, v_pool = PagedDecodeAttention(
-            self.num_heads, self.dtype, self.quant, mesh=self.mesh,
+            self.num_heads, self.dtype, self.quant,
+            kv_quant=self.kv_quant, mesh=self.mesh,
             name="attn"
         )(y, k_pool, v_pool, table, pos)
         x = x + attn_out
@@ -243,13 +320,15 @@ class PagedDecodeLM(nn.Module):
     max_seq: int = 2048
     dtype: jnp.dtype = jnp.bfloat16
     quant: bool = False
+    kv_quant: bool = False
     all_logits: bool = False
     mesh: Optional[Mesh] = None
 
     @nn.compact
     def __call__(self, tokens, pools, table, pos):
-        # tokens: (b, L); pools: [(k_pool, v_pool)] per layer; pos: (b,)
-        # cache row of the FIRST window token
+        # tokens: (b, L); pools: [(k_pool, v_pool)] per layer (each pool
+        # a (data, scale) pair under kv_quant); pos: (b,) cache row of
+        # the FIRST window token
         L = tokens.shape[1]
         x = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype, name="embed")(
             tokens
@@ -262,7 +341,7 @@ class PagedDecodeLM(nn.Module):
             kp, vp = pools[i]
             x, kp, vp = PagedDecodeBlock(
                 self.num_heads, dtype=self.dtype, quant=self.quant,
-                mesh=self.mesh, name=f"layer{i}"
+                kv_quant=self.kv_quant, mesh=self.mesh, name=f"layer{i}"
             )(x, kp, vp, table, pos)
             new_pools.append((kp, vp))
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
@@ -463,9 +542,22 @@ class PagedContinuousBatcher(_TracedBatcher):
     ``False`` selects the synchronous host-driven loop (state
     re-uploaded from host mirrors every step) — the bench baseline and
     the property-test oracle.
-    ``decode_page_cache`` ({"off", "fp32", "all"}, default off) lets
-    retirement seal complete DECODE-produced pages into the chain for
-    session KV reuse — see the module docstring for the dtype policy.
+    ``decode_page_cache`` ({"off", "fp32", "quantized", "all"}, default
+    off) lets retirement seal complete DECODE-produced pages into the
+    chain for session KV reuse — see the module docstring for the
+    dtype policy.
+    ``kv_dtype`` ({None, "bf16", "fp32", "int8"}, default None) is the
+    page pool's STORAGE format: None (or the name matching the compute
+    dtype) keeps today's full-width pool; "int8" stores per-page,
+    per-head-scaled symmetric int8 pages — the paged kernels
+    dequantize in-VMEM, station scatters quantize whole pages at their
+    tight scale, decode commits go through grow-and-rescale row
+    writes, and sealing requantizes pages to their tight scale before
+    they enter the shared chain.  Half the resting pool bytes ⇒ ~2x
+    the pool rows per byte budget (bench.py serving_quantized_pool
+    gates the capacity and throughput claims and MEASURES token
+    agreement / divergence margins / ppl delta vs the full-width
+    pool; full-width lanes are bit-untouched by the machinery).
     ``session_id`` on ``submit`` is advisory — sharing is content-
     addressed, so same-session turns and cross-session shared system
     prompts both hit without coordination (upstream, the gateway's
@@ -513,6 +605,7 @@ class PagedContinuousBatcher(_TracedBatcher):
         token_budget: Optional[int] = None,
         prefix_cache: bool = True,
         decode_page_cache: str = "off",
+        kv_dtype: Optional[str] = None,
         pipeline_decode: bool = True,
         eos_id: Optional[int] = None,
         dtype=jnp.bfloat16,
@@ -600,6 +693,16 @@ class PagedContinuousBatcher(_TracedBatcher):
                 f"token_budget ({token_budget}) must be positive or None"
             )
         self.token_budget = token_budget
+        # KV page-pool storage format (the shared worker/gateway/
+        # SimBatcher contract in models/serving.py): None/"bf16"/"fp32"
+        # keep today's full-width pool at the serving dtype; "int8"
+        # stores per-page per-head-scaled symmetric int8 — half the
+        # resting bytes per page, so the same byte budget carries ~2x
+        # the pool ROWS (the capacity lever ROADMAP items 1/3/5
+        # compound on).  Resolved HERE, before any pool or program is
+        # built: a malformed knob dies at construction.
+        self.kv_quant = resolve_kv_dtype(kv_dtype, dtype)
+        self.kv_dtype = "int8" if self.kv_quant else str(jnp.dtype(dtype))
         if speculate_k is not None:
             if speculate_k < 1:
                 raise ValueError(
@@ -687,7 +790,7 @@ class PagedContinuousBatcher(_TracedBatcher):
         self.model = PagedDecodeLM(
             vocab_size=vocab_size, num_layers=num_layers,
             num_heads=num_heads, hidden=hidden, max_seq=max_seq, dtype=dtype,
-            quant=quant, mesh=mesh,
+            quant=quant, kv_quant=self.kv_quant, mesh=mesh,
         )
         # the dense twin handles admit prefill (same param tree)
         self.dense_model = DecodeLM(
@@ -700,6 +803,19 @@ class PagedContinuousBatcher(_TracedBatcher):
         self.hidden = hidden
         self.dtype = dtype
         def _pool_zeros():
+            if self.kv_quant:
+                # the quantized pool: int8 pages + (P, h) f32 per-page
+                # per-head scales; both shard heads over "model" (a
+                # per-head scale is per-head state)
+                z = jnp.zeros(
+                    (pool_pages, num_heads, page_size, hd), jnp.int8
+                )
+                s = jnp.zeros((pool_pages, num_heads), jnp.float32)
+                if mesh is not None:
+                    sh = NamedSharding(mesh, paged_pool_spec())
+                    z = jax.device_put(z, sh)
+                    s = jax.device_put(s, sh)
+                return (z, s)
             z = jnp.zeros((pool_pages, num_heads, page_size, hd), dtype)
             if mesh is not None:
                 # heads over "model": every device holds 1/tp of each
@@ -727,7 +843,8 @@ class PagedContinuousBatcher(_TracedBatcher):
         # trusts, not a hope
         self.decode_page_cache = decode_page_cache
         self._seal_decode = (
-            resolve_decode_page_cache(decode_page_cache, dtype)
+            resolve_decode_page_cache(decode_page_cache, dtype,
+                                      self.kv_quant)
             and self.prefix_cache is not None
         )
         # host-side MIRRORS of the decode loop state (bookkeeping,
@@ -764,9 +881,12 @@ class PagedContinuousBatcher(_TracedBatcher):
         self._inflight: deque = deque()
         self._sync_wait_s = 0.0
         # bucketed multi-page gather/scatter programs, keyed by padded
-        # page-run width (lazily built; see _page_bucket)
+        # page-run width (lazily built; see _page_bucket); the quantized
+        # pool adds the seal-time requantization program per width
         self._write_pages: Dict[int, object] = {}
         self._gather_pages: Dict[int, object] = {}
+        self._requant_pages: Dict[int, object] = {}
+        self._zero_scales: Dict[int, object] = {}
         # the prefill station: ONE persistent dense cache with
         # station_slots rows-of-prompt_pad slots; chunked prompts flow
         # through their own slot before their pages scatter into the
@@ -823,8 +943,27 @@ class PagedContinuousBatcher(_TracedBatcher):
                 )
                 return out if len(out) > 1 else out[0]
 
+            kv_quant = self.kv_quant
+
             def _pin_kv(caches, dense=False):
                 sh = _dense_sh if dense else _pool_sh
+                if not dense and kv_quant:
+                    # quantized pool entries are (data, scale) pairs:
+                    # pin both — a scale drifting to replicated is the
+                    # same silent capacity lie as a page doing so
+                    return [
+                        (
+                            (
+                                jax.lax.with_sharding_constraint(kd, sh),
+                                jax.lax.with_sharding_constraint(ks_, sh),
+                            ),
+                            (
+                                jax.lax.with_sharding_constraint(vd, sh),
+                                jax.lax.with_sharding_constraint(vs_, sh),
+                            ),
+                        )
+                        for (kd, ks_), (vd, vs_) in caches
+                    ]
                 return [
                     (
                         jax.lax.with_sharding_constraint(k_, sh),
@@ -870,16 +1009,28 @@ class PagedContinuousBatcher(_TracedBatcher):
             2 * num_layers * station_slots * page_size * hidden * dsize,
         )
         # the pool's resting bytes per DEVICE: heads shard 1/tp of every
-        # page, so per-device page economy is the aggregate divided by tp
+        # page, so per-device page economy is the aggregate divided by
+        # tp.  Per-DTYPE split: a quantized pool rests int8 page bytes
+        # plus f32 scale bytes — the byte column the capacity claim
+        # (and assert_page_accounting's bytes leg) is audited against.
+        kv_item = 1 if self.kv_quant else dsize
+        self._pool_kv_bytes = (
+            2 * num_layers * pool_pages * num_heads * page_size * hd
+            * kv_item
+        )
+        self._pool_scale_bytes = (
+            2 * num_layers * pool_pages * num_heads * 4
+            if self.kv_quant else 0
+        )
         self._pool_bytes_per_device = (
-            2 * num_layers * pool_pages * num_heads * page_size * hd * dsize
-            // self.tp
+            (self._pool_kv_bytes + self._pool_scale_bytes) // self.tp
         )
         self._step_collective_bytes = 0
-        # both TP gauges are construction CONSTANTS — set once here, off
-        # the per-step path (the serve_draft_cache_rows discipline); a
-        # registry attached after construction gets them from the first
-        # ledger record, flag-guarded
+        # both TP gauges and the per-dtype pool-bytes gauges are
+        # construction CONSTANTS — set once here, off the per-step path
+        # (the serve_draft_cache_rows discipline); a registry attached
+        # after construction gets them from the first ledger record,
+        # flag-guarded
         self._tp_gauges_set = False
         if metrics is not None:
             metrics.set_gauge("serve_tp_devices", float(self.tp))
@@ -887,6 +1038,7 @@ class PagedContinuousBatcher(_TracedBatcher):
                 "serve_tp_pool_bytes_per_device",
                 float(self._pool_bytes_per_device),
             )
+            self._set_pool_bytes_gauges()
             self._tp_gauges_set = True
 
         from kubegpu_tpu.models.decoding import pick_tokens
@@ -953,7 +1105,8 @@ class PagedContinuousBatcher(_TracedBatcher):
             self.verify_model = PagedDecodeLM(
                 vocab_size=vocab_size, num_layers=num_layers,
                 num_heads=num_heads, hidden=hidden, max_seq=max_seq,
-                dtype=dtype, quant=quant, all_logits=True, mesh=mesh,
+                dtype=dtype, quant=quant, kv_quant=self.kv_quant,
+                all_logits=True, mesh=mesh,
             )
             # dense per-slot draft RING: slots x draft_window rows (was
             # slots x max_seq — the dense memory shape speculation was
@@ -1179,6 +1332,27 @@ class PagedContinuousBatcher(_TracedBatcher):
 
         self._chunk = jax.jit(chunk, donate_argnums=(1,))
 
+    def _set_pool_bytes_gauges(self) -> None:
+        """Resting pool bytes by STORAGE dtype (mesh-wide aggregates,
+        like the serve_pool_pages_* counts; the per-device half is
+        serve_tp_pool_bytes_per_device).  A quantized pool reports two
+        series — its int8 page bytes and its float32 scale bytes — so
+        the capacity dashboards see exactly what the pool rests."""
+        if self.kv_quant:
+            self.metrics.set_gauge(
+                "serve_pool_kv_bytes", float(self._pool_kv_bytes),
+                dtype="int8",
+            )
+            self.metrics.set_gauge(
+                "serve_pool_kv_bytes", float(self._pool_scale_bytes),
+                dtype="float32",
+            )
+        else:
+            self.metrics.set_gauge(
+                "serve_pool_kv_bytes", float(self._pool_kv_bytes),
+                dtype=self.kv_dtype,
+            )
+
     # -- bucketed multi-page gather/scatter ---------------------------------
     # A prefix-cache hit of H pages or a chunk flush of C ready pages
     # used to cost O(pages) separate jit dispatches; these programs move
@@ -1211,12 +1385,61 @@ class PagedContinuousBatcher(_TracedBatcher):
             fn = self._gather_pages[width] = self._build_gather_pages(width)
         return fn
 
+    def _get_requant_pages(self, width: int):
+        fn = self._requant_pages.get(width)
+        if fn is None:
+            fn = self._requant_pages[width] = self._build_requant_pages(
+                width
+            )
+        return fn
+
+    def _build_requant_pages(self, width: int):
+        """Seal-time requantization program (quantized pool only): for a
+        padded run of ``width`` pool pages, stretch each page's int8
+        values back to full range and shrink its scale accordingly —
+        new_int = round(int * 127 / max|int|), new_scale = scale *
+        max|int| / 127 — so the dequantized values are preserved to
+        rounding while the quantization step size tightens to the
+        page's ACTUAL content (undoing rejected-window scale
+        inflation).  Pages whose range is already full (max|int| =
+        127, every scatter-quantized page) pass through bit-identical;
+        padded/invalid lanes are untouched."""
+        pin_kv = self._pin_kv
+
+        def requant(pools, phys_vec, n_valid):
+            valid = jnp.arange(width, dtype=jnp.int32) < n_valid  # (w,)
+            out = []
+            for kent, vent in pools:
+                new_ent = []
+                for data, scale in (kent, vent):
+                    blk = data[phys_vec].astype(jnp.float32)  # (w,h,p,hd)
+                    mx = jnp.max(jnp.abs(blk), axis=(2, 3))   # (w,h)
+                    cur = scale[phys_vec]
+                    mxs = jnp.where(mx > 0, mx, 127.0)
+                    newd = jnp.clip(
+                        jnp.round(blk * (127.0 / mxs)[:, :, None, None]),
+                        -127, 127,
+                    )
+                    news = cur * mxs / 127.0
+                    ok = valid[:, None] & (mx > 0)
+                    newd = jnp.where(ok[:, :, None, None], newd, blk)
+                    news = jnp.where(ok, news, cur)
+                    new_ent.append((
+                        data.at[phys_vec].set(newd.astype(jnp.int8)),
+                        scale.at[phys_vec].set(news),
+                    ))
+                out.append(tuple(new_ent))
+            return pin_kv(out)
+
+        return jax.jit(requant, donate_argnums=(0,))
+
     def _build_write_pages(self, width: int):
         page = self.page
         pad = self.prompt_pad
         pin_kv = self._pin_kv
+        kv_quant = self.kv_quant
 
-        def write_pages(pools, station, slot, phys_vec, base_row):
+        def write_pages(pools, station, slot, phys_vec, base_row, n_valid):
             # scatter `width` consecutive completed station pages (the
             # slot's rows [base_row + j*page, ...)) into pool pages
             # phys_vec[j] in ONE program.  Padded lanes carry phys 0
@@ -1224,16 +1447,49 @@ class PagedContinuousBatcher(_TracedBatcher):
             # end — misaligned junk the dump absorbs; valid lanes always
             # fit, so they never clamp.  Duplicate dump indices in the
             # scatter race only against each other (junk over junk).
+            # Quantized pool: each page quantizes at scatter time with
+            # its TIGHT per-head scale (amax/127 over the page's VALID
+            # rows — station rows at/past ``n_valid`` still hold a
+            # previous occupant's bytes; in the full-width pool that
+            # junk is masked at read and harmless, but here it would
+            # inflate the page's persistent SCALE and make the real
+            # rows' quantization depend on station-slot history.  The
+            # masked rows quantize to exact zeros, so scattered bytes
+            # are a pure function of the prompt).  Full pages quantize
+            # whole from full-width station rows — the best scale they
+            # can ever get.
             starts = base_row + jnp.arange(width, dtype=jnp.int32) * page
             starts = jnp.clip(starts, 0, pad - page)
             idx = starts[:, None] + jnp.arange(page, dtype=jnp.int32)[None]
+            if kv_quant:
+                # validity by UNCLAMPED station row: lane j row r is
+                # base_row + j*page + r (padded lanes fall past
+                # n_valid entirely)
+                rows_g = (
+                    base_row
+                    + jnp.arange(width, dtype=jnp.int32)[:, None] * page
+                    + jnp.arange(page, dtype=jnp.int32)[None, :]
+                )
+                row_ok = (rows_g < n_valid)[:, None, :, None]
             out = []
-            for (kp, vp), (ck, cv) in zip(pools, station):
+            for entry, (ck, cv) in zip(pools, station):
                 bk = jnp.swapaxes(jnp.take(ck, slot, axis=0)[idx], 1, 2)
                 bv = jnp.swapaxes(jnp.take(cv, slot, axis=0)[idx], 1, 2)
-                out.append((
-                    kp.at[phys_vec].set(bk), vp.at[phys_vec].set(bv)
-                ))
+                if kv_quant:
+                    (kd, ks), (vd, vs) = entry
+                    qk, sk = quantize_pages(jnp.where(row_ok, bk, 0))
+                    qv, sv = quantize_pages(jnp.where(row_ok, bv, 0))
+                    out.append((
+                        (kd.at[phys_vec].set(qk),
+                         ks.at[phys_vec].set(sk)),
+                        (vd.at[phys_vec].set(qv),
+                         vs.at[phys_vec].set(sv)),
+                    ))
+                else:
+                    kp, vp = entry
+                    out.append((
+                        kp.at[phys_vec].set(bk), vp.at[phys_vec].set(bv)
+                    ))
             return pin_kv(out)
 
         return jax.jit(write_pages, donate_argnums=(0,))
@@ -1242,6 +1498,8 @@ class PagedContinuousBatcher(_TracedBatcher):
         page = self.page
         n_rows = width * page
         pin_kv = self._pin_kv
+        kv_quant = self.kv_quant
+        st_dtype = self.dtype
 
         def gather_pages(station, pools, slot, phys_vec, n_valid):
             # the reverse copy: a prefix-cache HIT's first n_valid pages
@@ -1250,15 +1508,28 @@ class PagedContinuousBatcher(_TracedBatcher):
             # recompute (the COW "copy").  Hits are always a PREFIX, so
             # the station destination starts at row 0; padded lanes read
             # the dump page and are masked out of the write-back so
-            # station rows past the run keep their bytes.
+            # station rows past the run keep their bytes.  Quantized
+            # pool: the gather DEQUANTIZES into the full-width station
+            # (int8 * scale, cast to the compute dtype) — chunk prefill
+            # then attends the dequantized prefix, deterministically.
             rows_ok = (
                 jnp.arange(n_rows, dtype=jnp.int32) < n_valid * page
             )[:, None, None]
             out = []
-            for (ck, cv), (kp, vp) in zip(station, pools):
+            for (ck, cv), entry in zip(station, pools):
                 h, hd = ck.shape[-2], ck.shape[-1]
-                bk = jnp.swapaxes(kp[phys_vec], 1, 2).reshape(n_rows, h, hd)
-                bv = jnp.swapaxes(vp[phys_vec], 1, 2).reshape(n_rows, h, hd)
+                if kv_quant:
+                    (kd, ks), (vd, vs) = entry
+                    bk = dequantize_pages(
+                        kd[phys_vec], ks[phys_vec], st_dtype
+                    )
+                    bv = dequantize_pages(
+                        vd[phys_vec], vs[phys_vec], st_dtype
+                    )
+                else:
+                    bk, bv = entry[0][phys_vec], entry[1][phys_vec]
+                bk = jnp.swapaxes(bk, 1, 2).reshape(n_rows, h, hd)
+                bv = jnp.swapaxes(bv, 1, 2).reshape(n_rows, h, hd)
                 ck_cur = jax.lax.dynamic_slice(
                     ck, (slot, 0, 0, 0), (1, n_rows, h, hd)
                 )[0]
@@ -1317,6 +1588,64 @@ class PagedContinuousBatcher(_TracedBatcher):
                 self.free_pages.add(p)
         s.pages, s.shared = [], set()
 
+    def _zero_page_scales(self, phys) -> None:
+        """Quantized pool only: reset the per-head scales of freshly
+        allocated pages.  A page coming off the free list (or evicted
+        out of the cache) still carries its PREVIOUS occupant's scale,
+        and grow-and-rescale only ever grows — without the reset, a
+        new sequence's first decode commit into the page would
+        quantize at an arbitrary inherited step size, making the int8
+        bytes depend on allocation HISTORY and breaking the
+        same-traffic ⇒ bit-identical determinism contract.  Station
+        scatters overwrite their pages' scales anyway; the reset is
+        what makes decode-region pages start from a clean slate (a
+        zero scale makes the first row write behave exactly like a
+        fresh page: ratio 0 wipes the stale int8 junk in-program).
+        ONE bucketed program per padded run width covers every layer's
+        k/v scales in a single dispatch (the multi-page scatter/gather
+        discipline — this sits on the admission path); padded lanes
+        point at the dump page and write back their own values."""
+        if not self.kv_quant or not phys:
+            return
+        uniq = sorted(set(phys))
+        # fresh-page runs can exceed the station's page capacity (they
+        # include the decode budget), so bucket against the TABLE width
+        width, cap = 1, self.max_pages
+        while width < len(uniq):
+            width *= 2
+        width = min(width, cap)
+        pv = np.zeros((width,), np.int32)
+        pv[: len(uniq)] = uniq
+        self.pools = self._get_zero_scales(width)(
+            self.pools, jnp.asarray(pv), jnp.int32(len(uniq))
+        )
+
+    def _get_zero_scales(self, width: int):
+        fn = self._zero_scales.get(width)
+        if fn is None:
+            fn = self._zero_scales[width] = self._build_zero_scales(width)
+        return fn
+
+    def _build_zero_scales(self, width: int):
+        pin_kv = self._pin_kv
+
+        def zero_scales(pools, phys_vec, n_valid):
+            valid = (
+                jnp.arange(width, dtype=jnp.int32) < n_valid
+            )[:, None]
+            out = []
+            for (kd, ks), (vd, vs) in pools:
+                ksn = ks.at[phys_vec].set(
+                    jnp.where(valid, 0.0, ks[phys_vec])
+                )
+                vsn = vs.at[phys_vec].set(
+                    jnp.where(valid, 0.0, vs[phys_vec])
+                )
+                out.append(((kd, ksn), (vd, vsn)))
+            return pin_kv(out)
+
+        return jax.jit(zero_scales, donate_argnums=(0,))
+
     def _seal_finished_pages(self, s: _Seq) -> None:
         """Session KV reuse: seal a retiring sequence's complete pages —
         prompt AND generated — into the content-hash chain, so a later
@@ -1349,6 +1678,7 @@ class PagedContinuousBatcher(_TracedBatcher):
         # ONE chain-key discipline (shared with the migration verbs —
         # exported keys must hit sealed caches and vice versa)
         keys = self._chain_keys(stream, n_full)
+        to_seal = []
         for j in range(n_full):
             phys = s.pages[j]
             if phys in s.shared:
@@ -1357,6 +1687,34 @@ class PagedContinuousBatcher(_TracedBatcher):
             if self.prefix_cache.lookup(key) is not None:
                 continue  # a twin stream sealed this content first
             kind = "prompt" if j < n_prompt else "decode"
+            to_seal.append((phys, key, kind))
+        if not to_seal:
+            return
+        if self.kv_quant:
+            # SEAL-TIME REQUANTIZATION: pages filled row-by-row carry
+            # whatever scale the grow-and-rescale writes left them with
+            # — a rejected-speculation junk row (overwritten later by a
+            # smaller committed value) can have inflated it for good.
+            # Before the page becomes immutable shared prefix state,
+            # requantize it to its TIGHT scale (max|int8| stretches
+            # back to 127), recovering the precision the junk row
+            # squeezed out.  Scatter-sealed prompt pages are tight
+            # already — the program is a no-op for them.  All sealing
+            # pages are private here (s.shared excluded), so no reader
+            # observes the rewrite mid-flight.
+            phys_list = [p for p, _, _ in to_seal]
+            width = self._page_bucket(len(phys_list))
+            pv = np.zeros((width,), np.int32)
+            pv[: len(phys_list)] = phys_list
+            self.pools = self._get_requant_pages(width)(
+                self.pools, jnp.asarray(pv), jnp.int32(len(phys_list))
+            )
+            self.stats["seal_requants"] += len(phys_list)
+            if self.metrics is not None:
+                self.metrics.inc(
+                    "serve_kv_quant_seal_requants_total", len(phys_list)
+                )
+        for phys, key, kind in to_seal:
             self.prefix_cache.insert(key, phys, kind=kind)
             s.shared.add(phys)
             if kind == "decode":
@@ -1422,6 +1780,67 @@ class PagedContinuousBatcher(_TracedBatcher):
                         f"page {p} sealed as decode with "
                         f"decode_page_cache={self.decode_page_cache!r}"
                     )
+        # the per-DTYPE bytes leg: the pool, station and draft ring must
+        # REST the storage format the batcher declares, or the capacity
+        # claim (half the page bytes at kv_dtype=int8, 2x the rows per
+        # byte budget) silently dies — a full-width allocation wearing
+        # an int8 label would pass every refcount check above while
+        # resting double the bytes.  nbytes is the logical (mesh-wide)
+        # size, consistent across TP widths.
+        hd = self.hidden // self.num_heads
+        dsize = jnp.dtype(self.dtype).itemsize
+        page_elems = self.num_heads * self.page * hd
+        if self.kv_quant:
+            for li, (kent, vent) in enumerate(self.pools):
+                for nm, (data, scale) in (("k", kent), ("v", vent)):
+                    assert data.dtype == jnp.dtype(jnp.int8), (
+                        f"layer {li} {nm}_pool stores {data.dtype}, "
+                        f"declared kv_dtype int8"
+                    )
+                    assert scale.dtype == jnp.dtype(jnp.float32), (
+                        f"layer {li} {nm}_pool scales are {scale.dtype}"
+                    )
+                    assert data.nbytes == self.pool_pages * page_elems, (
+                        f"layer {li} {nm}_pool rests {data.nbytes} B, "
+                        f"int8 pages promise {self.pool_pages * page_elems}"
+                    )
+                    assert scale.nbytes == (
+                        self.pool_pages * self.num_heads * 4
+                    ), f"layer {li} {nm}_pool scale bytes drifted"
+        else:
+            for li, (kp, vp) in enumerate(self.pools):
+                for nm, arr in (("k", kp), ("v", vp)):
+                    assert arr.dtype == jnp.dtype(self.dtype), (
+                        f"layer {li} {nm}_pool stores {arr.dtype}, "
+                        f"declared kv_dtype {self.kv_dtype}"
+                    )
+                    assert arr.nbytes == (
+                        self.pool_pages * page_elems * dsize
+                    ), (
+                        f"layer {li} {nm}_pool rests {arr.nbytes} B, "
+                        f"{self.kv_dtype} pages promise "
+                        f"{self.pool_pages * page_elems * dsize}"
+                    )
+        # the station and the draft ring rest FULL-WIDTH at the compute
+        # dtype by design (transient per-admission state, dequantized
+        # prefix gathers land here) — their bytes are part of the same
+        # declared economy
+        st_elems = self.prompt_pad * self.num_heads * hd
+        for li, (ck, cv) in enumerate(self._station):
+            for nm, arr in (("k", ck), ("v", cv)):
+                assert arr.dtype == jnp.dtype(self.dtype), (
+                    f"station layer {li} {nm} stores {arr.dtype}, "
+                    f"compute dtype is {jnp.dtype(self.dtype).name}"
+                )
+                assert arr.nbytes == (
+                    self.station_slots * st_elems * dsize
+                ), f"station layer {li} {nm} bytes drifted"
+        if self.speculate_k is not None:
+            for li, (ck, cv) in enumerate(self.d_caches):
+                for nm, arr in (("k", ck), ("v", cv)):
+                    assert arr.dtype == jnp.dtype(self.dtype), (
+                        f"draft ring layer {li} {nm} stores {arr.dtype}"
+                    )
         if self.mesh is not None:
             # the sharded-pool leg: under TP the invariant above is
             # mesh-WIDE (tables replicate, every page spans all shards)
@@ -1429,11 +1848,17 @@ class PagedContinuousBatcher(_TracedBatcher):
             # RESTING head-sharded — a program whose output sharding
             # drifted to replicated would silently cost tp x the
             # per-device bytes the page math promises.  The station and
-            # draft ring carry the same layout.
+            # draft ring carry the same layout; a quantized pool's
+            # scales rest head-sharded like their pages.
             pool_want = NamedSharding(self.mesh, paged_pool_spec())
             dense_want = NamedSharding(self.mesh, dense_cache_spec())
-            for li, (kp, vp) in enumerate(self.pools):
-                for nm, arr in (("k", kp), ("v", vp)):
+            for li, (kent, vent) in enumerate(self.pools):
+                if self.kv_quant:
+                    arrs = [("k", kent[0]), ("k_scale", kent[1]),
+                            ("v", vent[0]), ("v_scale", vent[1])]
+                else:
+                    arrs = [("k", kent), ("v", vent)]
+                for nm, arr in arrs:
                     assert arr.sharding.is_equivalent_to(
                         pool_want, arr.ndim
                     ), (
@@ -1538,6 +1963,7 @@ class PagedContinuousBatcher(_TracedBatcher):
             acquired = self.prefix_cache.acquire(key)
             assert acquired == hits[j]
         fresh = [self._alloc_page() for _ in range(need - len(hits))]
+        self._zero_page_scales(fresh)  # no inherited quantization state
         pages = hits + fresh
         # the slot's table stays parked on the dump page until
         # ACTIVATION: the step program writes K/V for every slot each
@@ -1634,6 +2060,7 @@ class PagedContinuousBatcher(_TracedBatcher):
         self.pools = self._get_write_pages(width)(
             self.pools, self._station, jnp.int32(job.station),
             jnp.asarray(phys), jnp.int32(first * self.page),
+            jnp.int32(job.pos),
         )
         for j in range(first, hi):
             if (
@@ -1933,7 +2360,7 @@ class PagedContinuousBatcher(_TracedBatcher):
             "prefix_hit_tokens_decode": 0, "prompt_tokens": 0,
             "decode_pages_sealed": 0, "spec_steps": 0, "spec_tokens": 0,
             "draft_wraps": 0, "pages_exported": 0, "pages_imported": 0,
-            "imports": 0,
+            "imports": 0, "seal_requants": 0,
         }
 
     # -- live KV-page migration (the EXPORT/IMPORT verb pair) ---------------
@@ -1968,18 +2395,29 @@ class PagedContinuousBatcher(_TracedBatcher):
             "page": self.page, "layers": self.num_layers,
             "heads": self.num_heads,
             "head_dim": self.hidden // self.num_heads,
-            "dtype": str(jnp.dtype(self.dtype)), "tp": self.tp,
+            "dtype": str(jnp.dtype(self.dtype)),
+            # schema v2: the pool STORAGE format rides the geometry — a
+            # quantized payload's layer arrays are int8 and it carries a
+            # "scales" section; importers on a different storage format
+            # refuse cleanly (the bytes are not interchangeable)
+            "kv_dtype": self.kv_dtype, "schema": 2, "tp": self.tp,
         }
 
     def _check_geometry(self, g: dict) -> None:
         want = self._transfer_geometry()
-        for k in ("page", "layers", "heads", "head_dim", "dtype"):
-            if g.get(k) != want[k]:
+        got = dict(g)
+        # schema-1 payloads (pre-quantization) stored full width at the
+        # compute dtype — their implied kv_dtype IS their dtype
+        got.setdefault("kv_dtype", got.get("dtype"))
+        for k in ("page", "layers", "heads", "head_dim", "dtype",
+                  "kv_dtype"):
+            if got.get(k) != want[k]:
                 raise ValueError(
                     f"transfer geometry mismatch on {k}: payload "
-                    f"{g.get(k)!r} vs this batcher {want[k]!r} — KV pages "
-                    "move only between twins (same paged layout; tp may "
-                    "differ, the payload is layout-agnostic host bytes)"
+                    f"{got.get(k)!r} vs this batcher {want[k]!r} — KV pages "
+                    "move only between twins (same paged layout AND pool "
+                    "storage format; tp may differ, the payload is "
+                    "layout-agnostic host bytes)"
                 )
 
     def _pages_to_host(self, arr, idx) -> np.ndarray:
@@ -2001,11 +2439,95 @@ class PagedContinuousBatcher(_TracedBatcher):
             [np.asarray(sh.data) for sh in shards], axis=1
         )
 
+    def _export_layers(self, idx):
+        """Per-layer host copies of pool pages ``idx`` — plus their
+        (n, h) scales when the pool is quantized (``None`` otherwise).
+        Scales ride ``_pages_to_host`` too: they are (pages, heads)
+        arrays, so the shard-local read/reassemble discipline applies
+        unchanged (heads is axis 1 either way)."""
+        if self.kv_quant:
+            layers = [
+                (self._pages_to_host(kd, idx), self._pages_to_host(vd, idx))
+                for (kd, _), (vd, _) in self.pools
+            ]
+            scales = [
+                (self._pages_to_host(ks, idx), self._pages_to_host(vs, idx))
+                for (_, ks), (_, vs) in self.pools
+            ]
+            return layers, scales
+        layers = [
+            (self._pages_to_host(kp, idx), self._pages_to_host(vp, idx))
+            for kp, vp in self.pools
+        ]
+        return layers, None
+
+    def _validate_scales(self, scales, n_pages: int) -> None:
+        """Shape-check a quantized transfer's ``scales`` section — the
+        shared import-verb precondition, run BEFORE any refcount moves
+        (both refusal paths must leave accounting byte-identical)."""
+        sshape = (n_pages, self.num_heads)
+        if not isinstance(scales, list) or len(scales) != self.num_layers:
+            raise ValueError(
+                "malformed payload: quantized transfer is missing "
+                "its per-layer scales"
+            )
+        for ks_np, vs_np in scales:
+            if (tuple(np.shape(ks_np)) != sshape
+                    or tuple(np.shape(vs_np)) != sshape):
+                raise ValueError(
+                    f"malformed payload: scale array shape "
+                    f"{np.shape(ks_np)} != {sshape}"
+                )
+
+    def _scatter_imported(self, sel: np.ndarray, phys: np.ndarray,
+                          layers, scales) -> None:
+        """Write transferred host pages (rows ``sel`` of each layer
+        array) into pool pages ``phys`` — the one import-side scatter
+        both verbs share, storage-format aware: a quantized pool
+        writes int8 data + scales, a full-width pool its page arrays."""
+        if self.kv_quant:
+            self.pools = [
+                (
+                    (
+                        self._write_host_pages(
+                            kd, phys, np.asarray(k_np)[sel]
+                        ),
+                        self._write_host_pages(
+                            ks, phys, np.asarray(ks_np)[sel]
+                        ),
+                    ),
+                    (
+                        self._write_host_pages(
+                            vd, phys, np.asarray(v_np)[sel]
+                        ),
+                        self._write_host_pages(
+                            vs, phys, np.asarray(vs_np)[sel]
+                        ),
+                    ),
+                )
+                for ((kd, ks), (vd, vs)), (k_np, v_np), (ks_np, vs_np)
+                in zip(self.pools, layers, scales)
+            ]
+        else:
+            self.pools = [
+                (
+                    self._write_host_pages(
+                        kp, phys, np.asarray(k_np)[sel]
+                    ),
+                    self._write_host_pages(
+                        vp, phys, np.asarray(v_np)[sel]
+                    ),
+                )
+                for (kp, vp), (k_np, v_np) in zip(self.pools, layers)
+            ]
+
     def _write_host_pages(self, arr, phys: np.ndarray, data: np.ndarray):
         """Scatter transferred host pages into pool pages ``phys``.
         Under a mesh the update is placed head-sharded FIRST, so every
         device writes only its own shard of each page (the import twin
-        of the shard-local export read)."""
+        of the shard-local export read).  Works for page arrays and for
+        a quantized pool's (pages, heads) scales alike — the sharded
+        axis (heads) is axis 1 in both layouts."""
         upd = jnp.asarray(data)
         if self.mesh is not None:
             upd = jax.device_put(
@@ -2060,12 +2582,9 @@ class PagedContinuousBatcher(_TracedBatcher):
         ])
         keys = self._chain_keys(stream, n_full)
         idx = jnp.asarray(np.asarray(s.pages[:n_pages], np.int32))
-        layers = [
-            (self._pages_to_host(kp, idx), self._pages_to_host(vp, idx))
-            for kp, vp in self.pools
-        ]
+        layers, scales = self._export_layers(idx)
         self.stats["pages_exported"] += n_pages
-        return {
+        payload = {
             "kind": "live",
             "geometry": self._transfer_geometry(),
             "prompt": [int(t) for t in np.asarray(s.prompt)],
@@ -2086,6 +2605,9 @@ class PagedContinuousBatcher(_TracedBatcher):
             ],
             "layers": layers,
         }
+        if scales is not None:
+            payload["scales"] = scales
+        return payload
 
     def import_pages(self, seq_id: int, payload: dict,
                      trace: Optional[SpanCtx] = None) -> None:
@@ -2134,6 +2656,12 @@ class PagedContinuousBatcher(_TracedBatcher):
                     f"malformed payload: page array shape "
                     f"{np.shape(k_np)} != {want_shape}"
                 )
+        scales = payload.get("scales")
+        if self.kv_quant:
+            # geometry already matched kv_dtype=int8, so the scales
+            # section is mandatory and shape-checked BEFORE any
+            # mutation (the refusal path moves zero refcounts)
+            self._validate_scales(scales, n_pages)
         slot = next(
             (i for i, s in enumerate(self._seqs) if s.seq_id < 0), None
         )
@@ -2177,6 +2705,12 @@ class PagedContinuousBatcher(_TracedBatcher):
             if j not in pages_by_j:
                 pages_by_j[j] = self._alloc_page()
         pages = [pages_by_j[j] for j in range(need)]
+        # fresh pages must not inherit a previous occupant's scale; the
+        # transferred pages' real scales are written just below, and
+        # the decode-headroom pages start clean
+        self._zero_page_scales(
+            [pages_by_j[j] for j in range(need) if j not in hits]
+        )
         # replay the chain: freshly-transferred full pages register
         # under their keys (kind-gated exactly like retirement sealing),
         # so the session's NEXT prompt hits on this replica too
@@ -2196,15 +2730,11 @@ class PagedContinuousBatcher(_TracedBatcher):
                 )
                 shared.add(pages[j])
         if to_write:
-            sel = np.asarray(to_write, np.intp)
-            phys = np.asarray([pages[j] for j in to_write], np.int32)
-            self.pools = [
-                (
-                    self._write_host_pages(kp, phys, np.asarray(k_np)[sel]),
-                    self._write_host_pages(vp, phys, np.asarray(v_np)[sel]),
-                )
-                for (kp, vp), (k_np, v_np) in zip(self.pools, layers)
-            ]
+            self._scatter_imported(
+                np.asarray(to_write, np.intp),
+                np.asarray([pages[j] for j in to_write], np.int32),
+                layers, scales,
+            )
         # the cursor: the slot resumes exactly where the exporter stopped
         s = self._seqs[slot]
         now = time.monotonic()
@@ -2299,18 +2829,18 @@ class PagedContinuousBatcher(_TracedBatcher):
         if not phys:
             return None
         idx = jnp.asarray(np.asarray(phys, np.int32))
-        layers = [
-            (self._pages_to_host(kp, idx), self._pages_to_host(vp, idx))
-            for kp, vp in self.pools
-        ]
+        layers, scales = self._export_layers(idx)
         self.stats["pages_exported"] += len(phys)
-        return {
+        payload = {
             "kind": "sealed",
             "geometry": self._transfer_geometry(),
             "page_keys": page_keys,
             "page_kinds": page_kinds,
             "layers": layers,
         }
+        if scales is not None:
+            payload["scales"] = scales
+        return payload
 
     def import_sealed_chain(self, payload: dict) -> int:
         """Warm this replica's ``PrefixPageCache`` from a sealed-chain
@@ -2329,6 +2859,7 @@ class PagedContinuousBatcher(_TracedBatcher):
         page_keys = list(payload.get("page_keys") or [])
         page_kinds = list(payload.get("page_kinds") or [])
         layers = payload["layers"]
+        scales = payload.get("scales")
         hd = self.hidden // self.num_heads
         want_shape = (len(page_keys), self.num_heads, self.page, hd)
         if len(layers) != self.num_layers or len(page_kinds) != len(
@@ -2342,6 +2873,8 @@ class PagedContinuousBatcher(_TracedBatcher):
                     f"malformed payload: page array shape "
                     f"{np.shape(k_np)} != {want_shape}"
                 )
+        if self.kv_quant:
+            self._validate_scales(scales, len(page_keys))
         fresh: List[tuple] = []      # (payload row, pool page)
         for j, keyhex in enumerate(page_keys):
             key = bytes.fromhex(keyhex)
@@ -2358,15 +2891,11 @@ class PagedContinuousBatcher(_TracedBatcher):
             self.prefix_cache.release(page)  # idle from birth: cache-owned
             fresh.append((j, page))
         if fresh:
-            sel = np.asarray([j for j, _ in fresh], np.intp)
-            phys = np.asarray([p for _, p in fresh], np.int32)
-            self.pools = [
-                (
-                    self._write_host_pages(kp, phys, np.asarray(k_np)[sel]),
-                    self._write_host_pages(vp, phys, np.asarray(v_np)[sel]),
-                )
-                for (kp, vp), (k_np, v_np) in zip(self.pools, layers)
-            ]
+            self._scatter_imported(
+                np.asarray([j for j, _ in fresh], np.intp),
+                np.asarray([p for _, p in fresh], np.int32),
+                layers, scales,
+            )
         self.stats["pages_imported"] += len(fresh)
         return len(fresh)
 
@@ -2718,6 +3247,13 @@ class PagedContinuousBatcher(_TracedBatcher):
             "tp": self.tp,
             "collective_bytes": self._step_collective_bytes,
             "pool_bytes_per_device": self._pool_bytes_per_device,
+            # per-DTYPE byte economy: what the pool RESTS, by storage
+            # format (int8 page bytes + f32 scale bytes when quantized;
+            # one full-width figure otherwise) — the /v1/state surface
+            # the capacity claim is audited against
+            "kv_dtype": self.kv_dtype,
+            "pool_kv_bytes": self._pool_kv_bytes,
+            "pool_scale_bytes": self._pool_scale_bytes,
         }
         self._ledger.append(row)
         if self.metrics is not None:
@@ -2753,6 +3289,7 @@ class PagedContinuousBatcher(_TracedBatcher):
                     "serve_tp_pool_bytes_per_device",
                     float(self._pool_bytes_per_device),
                 )
+                self._set_pool_bytes_gauges()
                 self._tp_gauges_set = True
             if self._step_collective_bytes:
                 self.metrics.inc(

@@ -59,20 +59,65 @@ from kubegpu_tpu.utils.tracing import SpanCtx, Tracer
 # request will attend, so sharing is gated per dtype:
 #   off  — prompt (dense-prefill) pages only, the conservative default;
 #   fp32 — decode pages too, but only when the serving dtype is float32
-#          (property-tested greedy-token-identical to a fresh prefill);
-#   all  — decode pages at any dtype (bf16 may flip near-tie argmaxes —
-#          drift is MEASURED in bench.py serving_multiturn, not assumed).
+#          AND the pool stores it full-width (property-tested greedy-
+#          token-identical to a fresh prefill; a quantized pool is a
+#          different numerics class, so "fp32" quietly stays prompt-only
+#          there — the policy names the class it trusts, not a hope);
+#   quantized — decode pages only when the pool IS quantized
+#          (kv_dtype="int8"): within the quantized mode, sealed bytes
+#          are the exact int8 pages every reader dequantizes, so
+#          sharing is deterministic in-mode; cross-mode agreement is
+#          MEASURED (bench.py serving_quantized_pool), not assumed;
+#   all  — decode pages at any dtype/storage (bf16 may flip near-tie
+#          argmaxes — drift is MEASURED in bench.py serving_multiturn).
 # Lives here (not paging.py) because it is the shared serving contract:
 # the worker CLI, the gateway CLI, and the paged batcher must resolve
 # the knob identically or a deployed policy would silently diverge.
-DECODE_PAGE_CACHE_POLICIES = ("off", "fp32", "all")
+DECODE_PAGE_CACHE_POLICIES = ("off", "fp32", "quantized", "all")
+
+# KV page-pool storage formats (the ``kv_dtype`` contract shared by the
+# worker CLI, the gateway CLI, SimBatcher and the paged batcher):
+# "bf16"/"fp32" = full-width storage at the serving dtype (must MATCH
+# it — a pool stored wider or narrower than the compute dtype is a
+# config error, not a silent cast); "int8" = per-page, per-head-scaled
+# symmetric int8 (models/paging.py's quantized pool).  None = the
+# serving dtype, i.e. today's full-width default.
+KV_DTYPES = ("bf16", "fp32", "int8")
 
 
-def resolve_decode_page_cache(policy: str, dtype) -> bool:
+def resolve_kv_dtype(kv_dtype, dtype) -> bool:
+    """Resolve the ``kv_dtype`` page-pool storage knob against the
+    serving dtype: returns whether the pool stores QUANTIZED (int8 +
+    scales) pages.  ``None`` (and the matching full-width name) selects
+    today's full-width pool; a full-width name that contradicts the
+    serving dtype raises — malformed serving knobs die at construction,
+    never mid-serve-loop."""
+    if kv_dtype is None:
+        return False
+    if kv_dtype not in KV_DTYPES:
+        raise ValueError(
+            f"kv_dtype must be one of {KV_DTYPES} or None, got "
+            f"{kv_dtype!r}"
+        )
+    if kv_dtype == "int8":
+        return True
+    want = {"bf16": jnp.bfloat16, "fp32": jnp.float32}[kv_dtype]
+    if jnp.dtype(dtype) != jnp.dtype(want):
+        raise ValueError(
+            f"kv_dtype {kv_dtype!r} contradicts the serving dtype "
+            f"{jnp.dtype(dtype).name}: full-width pools store the "
+            "compute dtype (pick the matching name, or 'int8')"
+        )
+    return False
+
+
+def resolve_decode_page_cache(policy: str, dtype,
+                              kv_quant: bool = False) -> bool:
     """Resolve the ``decode_page_cache`` policy knob against the serving
-    dtype: returns whether decode-produced pages may enter the shared
-    prefix cache.  Raises on an unknown policy (malformed serving knobs
-    die at construction, never mid-serve-loop)."""
+    dtype and the pool storage format: returns whether decode-produced
+    pages may enter the shared prefix cache.  Raises on an unknown
+    policy (malformed serving knobs die at construction, never
+    mid-serve-loop)."""
     if policy not in DECODE_PAGE_CACHE_POLICIES:
         raise ValueError(
             f"decode_page_cache must be one of "
@@ -82,7 +127,27 @@ def resolve_decode_page_cache(policy: str, dtype) -> bool:
         return False
     if policy == "all":
         return True
-    return jnp.dtype(dtype) == jnp.dtype(jnp.float32)
+    if policy == "quantized":
+        return kv_quant
+    return jnp.dtype(dtype) == jnp.dtype(jnp.float32) and not kv_quant
+
+
+def record_quant_quality(metrics: Optional[Metrics], *,
+                         agreement: float,
+                         margin: Optional[float] = None,
+                         ppl_delta: Optional[float] = None) -> None:
+    """Publish the quantized pool's MEASURED quality (bench.py
+    serving_quantized_pool's token agreement vs the full-width pool,
+    the top1-top2 logit margin at first divergence, and the
+    eval-ppl delta) as gauges, so the numbers the int8 capacity claim
+    rests on are visible wherever the pool itself is."""
+    if metrics is None:
+        return
+    metrics.set_gauge("serve_kv_quant_agreement", float(agreement))
+    if margin is not None:
+        metrics.set_gauge("serve_kv_quant_divergence_margin", float(margin))
+    if ppl_delta is not None:
+        metrics.set_gauge("serve_kv_quant_ppl_delta", float(ppl_delta))
 
 
 def load_draft_checkpoint(ckpt_dir: str, *, vocab_size: int,

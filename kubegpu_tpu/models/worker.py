@@ -513,6 +513,12 @@ def _run_decode_batched(args, params, max_seq: int, t0: float) -> int:
             "parallel serving is the PAGED batcher's mesh (--serving "
             "paged); dense/speculative batchers are single-device"
         )
+    if args.kv_dtype is not None and args.serving != "paged":
+        raise SystemExit(
+            f"--kv-dtype {args.kv_dtype} with --serving {args.serving}: "
+            "the KV storage format is the PAGED pool's knob "
+            "(--serving paged)"
+        )
     if args.serving == "continuous":
         from kubegpu_tpu.models.serving import ContinuousBatcher
 
@@ -608,10 +614,23 @@ def _run_decode_batched(args, params, max_seq: int, t0: float) -> int:
         pool = slots * -(
             -(args.prompt_len + args.steps + k_extra) // page
         ) + 1
+        if args.kv_dtype is not None:
+            # die crisply on a contradictory knob pair (e.g. --kv-dtype
+            # bf16 with --serve-fp32) like the other CLI geometry checks
+            from kubegpu_tpu.models.serving import resolve_kv_dtype
+
+            import jax.numpy as jnp
+
+            try:
+                resolve_kv_dtype(
+                    args.kv_dtype, common.get("dtype", jnp.bfloat16)
+                )
+            except ValueError as e:
+                raise SystemExit(str(e))
         cb = PagedContinuousBatcher(
             params, **common, quant=args.int8, page_size=page,
             pool_pages=pool, decode_page_cache=args.decode_page_cache,
-            mesh=mesh, **spec_kw,
+            kv_dtype=args.kv_dtype, mesh=mesh, **spec_kw,
         )
 
     if args.serve_http is not None:
@@ -984,7 +1003,10 @@ def main(argv=None) -> int:
                     "decode through the page pool (--spec-k deep; OFF by "
                     "default — greedy-lossless, so output is identical "
                     "either way)")
-    from kubegpu_tpu.models.serving import DECODE_PAGE_CACHE_POLICIES
+    from kubegpu_tpu.models.serving import (
+        DECODE_PAGE_CACHE_POLICIES,
+        KV_DTYPES,
+    )
 
     ap.add_argument("--decode-page-cache", default="off",
                     choices=list(DECODE_PAGE_CACHE_POLICIES),
@@ -993,9 +1015,23 @@ def main(argv=None) -> int:
                     "so a session's turn 2 skips re-prefilling turn 1's "
                     "output (session KV reuse).  off = prompt pages only "
                     "(default); fp32 = share only when serving float32 "
-                    "(property-tested greedy-token-identical); all = any "
-                    "dtype (bf16 may flip near-tie argmaxes — drift is "
-                    "measured in bench.py serving_multiturn)")
+                    "full-width (property-tested greedy-token-identical); "
+                    "quantized = share only on an int8 pool (--kv-dtype "
+                    "int8; deterministic in-mode, agreement measured); "
+                    "all = any dtype (bf16 may flip near-tie argmaxes — "
+                    "drift is measured in bench.py serving_multiturn)")
+    ap.add_argument("--kv-dtype", default=None,
+                    choices=list(KV_DTYPES),
+                    help="paged serving: KV page-pool STORAGE format.  "
+                    "Default: full width at the serving dtype.  int8 = "
+                    "per-page per-head-scaled symmetric int8 pages (the "
+                    "paged kernels dequantize in-kernel; sealing "
+                    "requantizes to tight scales) — half the resting "
+                    "pool bytes, ~2x the pool rows per byte budget, and "
+                    "half the migration wire bytes per page; quality is "
+                    "MEASURED by bench.py serving_quantized_pool.  "
+                    "bf16/fp32 must match the serving dtype (an "
+                    "explicit full-width declaration)")
     ap.add_argument(
         "--draft-ckpt-dir", default="",
         help="orbax checkpoint root for the DRAFT model "

@@ -32,6 +32,18 @@ over a natural tile dim ((page_size, hd) tiles per head) and no
 transposes or batched dots reach mosaic (which rejects dot_general batch
 dims).  Decode attention at one token per slot is bandwidth-bound, so
 the VPU formulation costs nothing against the MXU one.
+
+Quantized pools: both kernels take optional ``k_scale``/``v_scale``
+operands — (pool_pages, heads) float32, one symmetric scale per page
+per head — and dequantize IN-KERNEL: the pool arrays then carry int8
+and the kernel streams HALF the bytes per live page (the entire win of
+an int8 pool on a bandwidth-bound kernel), multiplying each page block
+by its per-head scale right after the f32 cast.  The scale blocks ride
+the same table-indexed index_map as their pages, so dead pages' scale
+DMAs are elided identically.  Being bandwidth-bound is also why the
+dequant multiply is free.  (On real TPUs int8 VMEM tiles want
+(32, 128) — size page_size x head_dim accordingly; the CPU sim's
+interpret mode has no such constraint.)
 """
 
 from __future__ import annotations
@@ -49,6 +61,33 @@ from jax.sharding import Mesh, PartitionSpec as P
 from kubegpu_tpu.parallel.sharding import MODEL_AXIS, shard_map_compat
 
 NEG_INF = float("-inf")
+
+
+def dequantize_pages(data: jax.Array, scale: jax.Array,
+                     dtype=jnp.float32) -> jax.Array:
+    """Expand a quantized pool to full width: ``data`` (P, h, page, hd)
+    int8 times ``scale`` (P, h) broadcast over (page, hd).  The oracle
+    half of the in-kernel dequant — property tests compare the
+    quantized kernels against ``reference_paged_attention`` over THIS
+    expansion, so the kernel's dequant math has an independent twin."""
+    return (
+        data.astype(jnp.float32) * scale[:, :, None, None]
+    ).astype(dtype)
+
+
+def quantize_pages(pages: jax.Array):
+    """The inverse: per-page, per-head symmetric int8 quantization of
+    full-width pages (n, h, page, hd) → (int8 data, (n, h) f32 scales).
+    scale = amax/127 over each page's (page, hd) block per head; an
+    all-zero block keeps scale 0 (dequantizes to exact zeros)."""
+    f = pages.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(f), axis=(2, 3))
+    scale = amax / 127.0
+    safe = jnp.where(scale > 0, scale, 1.0)
+    data = jnp.clip(
+        jnp.round(f / safe[:, :, None, None]), -127, 127
+    ).astype(jnp.int8)
+    return data, scale
 
 
 def reference_paged_attention(q, k_pool, v_pool, page_table, lengths):
@@ -93,11 +132,18 @@ def reference_paged_chunk_attention(q, k_pool, v_pool, page_table, lengths):
     return out.astype(q.dtype)
 
 
-def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_ref, l_ref, acc_ref, *, sm_scale: float, page: int):
+def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+                  sm_scale: float, page: int, quant: bool = False):
     """One (slot, logical-page) grid step: fold this page into the slot's
     running softmax state.  The page dim is sequential, so m/l/acc
-    scratch persists across it for a fixed slot."""
+    scratch persists across it for a fixed slot.  ``quant`` inserts the
+    per-page per-head scale refs after the pools and dequantizes the
+    int8 page blocks right after their f32 cast — the rest of the fold
+    is byte-identical to the full-width kernel."""
+    if quant:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
     b_i = pl.program_id(0)
     p_i = pl.program_id(1)
     n_p = pl.num_programs(1)
@@ -118,6 +164,9 @@ def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0].astype(jnp.float32)               # (h, hd)
         k = k_ref[0].astype(jnp.float32)               # (h, page, hd)
         v = v_ref[0].astype(jnp.float32)
+        if quant:
+            k = k * ks_ref[0][:, None, None]           # (h,) per-head scale
+            v = v * vs_ref[0][:, None, None]
         # per-head scores without transposes or batched dots (mosaic
         # rejects dot_general batch dims): broadcast-multiply and reduce
         # the MINOR hd lanes -> (h, page)
@@ -157,16 +206,24 @@ def paged_decode_attention(
     page_table: jax.Array,
     lengths: jax.Array,
     interpret: Optional[bool] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Single-token attention over paged KV for every slot.
 
     q: (b, h, hd); k_pool/v_pool: (n_pool_pages, h, page_size, hd);
     page_table: (b, n_pages) int32 physical page ids (tail entries may
     point anywhere valid — masked); lengths: (b,) int32 attendable rows.
+    ``k_scale``/``v_scale`` (given together or not at all): the
+    quantized pool's (n_pool_pages, h) per-page per-head scales — the
+    pools then carry int8 and the kernel dequantizes in-VMEM (property-
+    tested against dequantize-then-``reference_paged_attention``).
     Returns (b, h, hd) in q's dtype."""
     b, h, hd = q.shape
     _, hp, page, hdp = k_pool.shape
     assert (hp, hdp) == (h, hd), (k_pool.shape, q.shape)
+    quant = k_scale is not None
+    assert quant == (v_scale is not None), "scales come in k/v pairs"
     n_pages = page_table.shape[1]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -182,14 +239,30 @@ def paged_decode_attention(
         p_eff = jnp.minimum(p_i, live_pages - 1)
         return (tbl[b_i, p_eff], 0, 0, 0)
 
+    def scale_map(b_i, p_i, tbl, ln):
+        # the page's scale rides the same table walk (and the same
+        # dead-page DMA elision) as its bytes
+        live_pages = jnp.maximum((ln[b_i] + page - 1) // page, 1)
+        p_eff = jnp.minimum(p_i, live_pages - 1)
+        return (tbl[b_i, p_eff], 0)
+
+    in_specs = [
+        pl.BlockSpec((1, h, hd), lambda b_i, p_i, tbl, ln: (b_i, 0, 0)),
+        pl.BlockSpec((1, h, page, hd), kv_map),
+        pl.BlockSpec((1, h, page, hd), kv_map),
+    ]
+    operands = [q, k_pool, v_pool]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, h), scale_map),
+            pl.BlockSpec((1, h), scale_map),
+        ]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,   # page_table, lengths
         grid=(b, n_pages),
-        in_specs=[
-            pl.BlockSpec((1, h, hd), lambda b_i, p_i, tbl, ln: (b_i, 0, 0)),
-            pl.BlockSpec((1, h, page, hd), kv_map),
-            pl.BlockSpec((1, h, page, hd), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, h, hd), lambda b_i, p_i, tbl, ln: (b_i, 0, 0)
         ),
@@ -200,11 +273,12 @@ def paged_decode_attention(
         ],
     )
     return pl.pallas_call(
-        partial(_paged_kernel, sm_scale=1.0 / math.sqrt(hd), page=page),
+        partial(_paged_kernel, sm_scale=1.0 / math.sqrt(hd), page=page,
+                quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, hd), q.dtype),
         interpret=interpret,
-    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32), q, k_pool, v_pool)
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32), *operands)
 
 
 # ---------------------------------------------------------------------------
@@ -229,21 +303,43 @@ def paged_decode_attention_sharded(
     lengths: jax.Array,
     mesh: Mesh,
     axis: str = MODEL_AXIS,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """``paged_decode_attention`` with the heads dim sharded over
     ``axis``: q (b, h, hd) and the pools (P, h, page, hd) carry h/tp
     local heads per device; table/lengths replicate.  Byte-identical to
-    the unsharded kernel (per-head math is untouched)."""
+    the unsharded kernel (per-head math is untouched).  A quantized
+    pool's (P, h) scales shard their heads dim exactly like the pages
+    they describe — per-head scales are per-head state."""
+    if k_scale is None:
+        fn = shard_map_compat(
+            paged_decode_attention,
+            mesh,
+            in_specs=(
+                P(None, axis, None), P(None, axis, None, None),
+                P(None, axis, None, None), P(None, None), P(None),
+            ),
+            out_specs=P(None, axis, None),
+        )
+        return fn(q, k_pool, v_pool, page_table, lengths)
+
+    def _quant(q_, kp, vp, tbl, ln, ks, vs):
+        return paged_decode_attention(
+            q_, kp, vp, tbl, ln, k_scale=ks, v_scale=vs
+        )
+
     fn = shard_map_compat(
-        paged_decode_attention,
+        _quant,
         mesh,
         in_specs=(
             P(None, axis, None), P(None, axis, None, None),
             P(None, axis, None, None), P(None, None), P(None),
+            P(None, axis), P(None, axis),
         ),
         out_specs=P(None, axis, None),
     )
-    return fn(q, k_pool, v_pool, page_table, lengths)
+    return fn(q, k_pool, v_pool, page_table, lengths, k_scale, v_scale)
 
 
 def paged_chunk_attention_sharded(
@@ -254,30 +350,57 @@ def paged_chunk_attention_sharded(
     lengths: jax.Array,
     mesh: Mesh,
     axis: str = MODEL_AXIS,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """``paged_chunk_attention`` (the speculative-verify multi-query
     kernel) head-sharded over ``axis``; same contract as the decode
-    wrapper with q (b, L, h, hd)."""
+    wrapper with q (b, L, h, hd), scales head-sharded like their
+    pages."""
+    if k_scale is None:
+        fn = shard_map_compat(
+            paged_chunk_attention,
+            mesh,
+            in_specs=(
+                P(None, None, axis, None), P(None, axis, None, None),
+                P(None, axis, None, None), P(None, None), P(None),
+            ),
+            out_specs=P(None, None, axis, None),
+        )
+        return fn(q, k_pool, v_pool, page_table, lengths)
+
+    def _quant(q_, kp, vp, tbl, ln, ks, vs):
+        return paged_chunk_attention(
+            q_, kp, vp, tbl, ln, k_scale=ks, v_scale=vs
+        )
+
     fn = shard_map_compat(
-        paged_chunk_attention,
+        _quant,
         mesh,
         in_specs=(
             P(None, None, axis, None), P(None, axis, None, None),
             P(None, axis, None, None), P(None, None), P(None),
+            P(None, axis), P(None, axis),
         ),
         out_specs=P(None, None, axis, None),
     )
-    return fn(q, k_pool, v_pool, page_table, lengths)
+    return fn(q, k_pool, v_pool, page_table, lengths, k_scale, v_scale)
 
 
-def _paged_chunk_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                        m_ref, l_ref, acc_ref, *, sm_scale: float, page: int,
-                        L: int):
+def _paged_chunk_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+                        sm_scale: float, page: int, L: int,
+                        quant: bool = False):
     """One (slot, logical-page) grid step of the MULTI-QUERY kernel: fold
     this page into L independent online-softmax states — one per query
     row, stacked along the scratch's leading (L*h) dim.  The L loop is a
     static unroll (L = k+1 is small), so every row's fold is the exact
-    single-query recipe with its own causal limit ``base + j``."""
+    single-query recipe with its own causal limit ``base + j``.
+    ``quant`` dequantizes the int8 page blocks in-kernel (per-page
+    per-head scales), once per page — shared by all L rows' folds."""
+    if quant:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
     b_i = pl.program_id(0)
     p_i = pl.program_id(1)
     n_p = pl.num_programs(1)
@@ -296,6 +419,9 @@ def _paged_chunk_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     def _compute():
         k = k_ref[0].astype(jnp.float32)               # (h, page, hd)
         v = v_ref[0].astype(jnp.float32)
+        if quant:
+            k = k * ks_ref[0][:, None, None]           # (h,) per-head scale
+            v = v * vs_ref[0][:, None, None]
         h_ = k.shape[0]
         for j in range(L):
             lo = j * h_
@@ -344,6 +470,8 @@ def paged_chunk_attention(
     page_table: jax.Array,
     lengths: jax.Array,
     interpret: Optional[bool] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Multi-query paged attention: L query tokens per slot over the paged
     KV pool — the q-length-(k+1) extension of ``paged_decode_attention``
@@ -366,6 +494,8 @@ def paged_chunk_attention(
     _, hp, page, hdp = k_pool.shape
     assert (hp, hdp) == (h, hd), (k_pool.shape, q.shape)
     assert L >= 1, L
+    quant = k_scale is not None
+    assert quant == (v_scale is not None), "scales come in k/v pairs"
     n_pages = page_table.shape[1]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -378,16 +508,30 @@ def paged_chunk_attention(
         p_eff = jnp.minimum(p_i, live_pages - 1)
         return (tbl[b_i, p_eff], 0, 0, 0)
 
+    def scale_map(b_i, p_i, tbl, ln):
+        live_pages = jnp.maximum((ln[b_i] + L - 1 + page - 1) // page, 1)
+        p_eff = jnp.minimum(p_i, live_pages - 1)
+        return (tbl[b_i, p_eff], 0)
+
+    in_specs = [
+        pl.BlockSpec(
+            (1, L, h, hd), lambda b_i, p_i, tbl, ln: (b_i, 0, 0, 0)
+        ),
+        pl.BlockSpec((1, h, page, hd), kv_map),
+        pl.BlockSpec((1, h, page, hd), kv_map),
+    ]
+    operands = [q, k_pool, v_pool]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, h), scale_map),
+            pl.BlockSpec((1, h), scale_map),
+        ]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,   # page_table, lengths
         grid=(b, n_pages),
-        in_specs=[
-            pl.BlockSpec(
-                (1, L, h, hd), lambda b_i, p_i, tbl, ln: (b_i, 0, 0, 0)
-            ),
-            pl.BlockSpec((1, h, page, hd), kv_map),
-            pl.BlockSpec((1, h, page, hd), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, L, h, hd), lambda b_i, p_i, tbl, ln: (b_i, 0, 0, 0)
         ),
@@ -399,9 +543,10 @@ def paged_chunk_attention(
     )
     return pl.pallas_call(
         partial(
-            _paged_chunk_kernel, sm_scale=1.0 / math.sqrt(hd), page=page, L=L
+            _paged_chunk_kernel, sm_scale=1.0 / math.sqrt(hd), page=page,
+            L=L, quant=quant,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, L, h, hd), q.dtype),
         interpret=interpret,
-    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32), q, k_pool, v_pool)
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32), *operands)

@@ -307,6 +307,35 @@ CATALOG: Dict[str, MetricSpec] = {
         (), "KV pool pages resident in the prefix cache (shared or "
         "idle-evictable; mesh-wide count under tensor parallelism)"),
 
+    # -- quantized KV page pool (models/paging.py kv_dtype="int8"):
+    #    per-dtype byte economy + measured quantization quality
+    "serve_pool_kv_bytes": _g(
+        ("dtype",), "KV page pool bytes RESTING by storage dtype "
+        "(mesh-wide aggregate, like the page counts; per-device is "
+        "serve_tp_pool_bytes_per_device).  A quantized pool reports "
+        "two series — int8 page bytes and float32 scale bytes; a "
+        "full-width pool one series at its compute dtype.  The gauge "
+        "the int8 capacity claim (2x rows per byte budget) is audited "
+        "against"),
+    "serve_kv_quant_seal_requants_total": _c(
+        (), "pool pages run through seal-time requantization before "
+        "entering the shared prefix chain (int8 pool: stretch int8 "
+        "range back to 127, shrink the scale — recovers precision a "
+        "rejected speculative row's grow-and-rescale inflation "
+        "squeezed out; a no-op for already-tight pages)"),
+    "serve_kv_quant_agreement": _g(
+        (), "measured token agreement of the int8 pool vs the "
+        "full-width pool on identical traffic (bench.py "
+        "serving_quantized_pool; models/serving.record_quant_quality)"),
+    "serve_kv_quant_divergence_margin": _g(
+        (), "top1-top2 logit margin at the first int8-vs-full-width "
+        "token divergence (near-tie ⇒ the expected quantization "
+        "rounding class; a wide margin would mean a real bug)"),
+    "serve_kv_quant_ppl_delta": _g(
+        (), "teacher-forced eval NLL delta of the int8-pool stream vs "
+        "the full-width pool's (the eval_ppl_delta_int8 discipline "
+        "applied to the page pool)"),
+
     # -- tensor-parallel serving (models/paging.py with a mesh): the
     #    per-DEVICE half of the pool economy plus the collective traffic
     #    the Megatron psums cost per iteration

@@ -1,0 +1,720 @@
+"""Quantized KV page pool (ISSUE 15): int8 pages + per-page per-head
+scales through the whole paged serving hot loop.
+
+The contract under test: with ``kv_dtype="int8"`` the pool stores int8
+pages and (P, h) float32 scales — the paged kernels dequantize
+IN-KERNEL (property-tested against a dequantize-then-reference oracle),
+station scatters quantize whole pages at their tight scale, decode
+commits go through grow-and-rescale row writes, and sealing
+REQUANTIZES pages to their tight scale before they enter the shared
+chain.  Streams are deterministic in-mode (same traffic ⇒ identical
+tokens), page accounting grows a per-dtype BYTES leg (a full-width
+allocation wearing an int8 label must fail loudly), and the migration
+verbs carry dtype + scales with an atomic refusal on mismatch.  The
+full-width paths stay bit-untouched — the fp32 identity oracles
+elsewhere in tier-1 keep their teeth, and this file pins the fp32 lane
+against the dense serial oracle too.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubegpu_tpu.models import TransformerLM
+from kubegpu_tpu.models.paging import PagedContinuousBatcher
+from kubegpu_tpu.models.serving import (
+    ContinuousBatcher,
+    DECODE_PAGE_CACHE_POLICIES,
+    KV_DTYPES,
+    resolve_decode_page_cache,
+    resolve_kv_dtype,
+)
+from kubegpu_tpu.ops.paged_attention import (
+    dequantize_pages,
+    paged_chunk_attention,
+    paged_decode_attention,
+    quantize_pages,
+    reference_paged_attention,
+    reference_paged_chunk_attention,
+)
+from kubegpu_tpu.utils.metrics import Metrics
+
+CFG = dict(vocab_size=61, num_layers=2, num_heads=4, hidden=32, max_seq=64)
+
+
+def trained_params():
+    model = TransformerLM(dtype=jnp.float32, **CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.ones((2, 8), jnp.int32))[
+        "params"
+    ]
+
+
+def make_paged(params, **kw):
+    kw.setdefault("slots", 3)
+    kw.setdefault("prompt_pad", 24)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("pool_pages", 40)
+    kw.setdefault("dtype", jnp.float32)
+    return PagedContinuousBatcher(params, **CFG, **kw)
+
+
+def spec_kw(params, k=2, **kw):
+    return dict(
+        draft_params=params, speculate_k=k,
+        draft_num_layers=CFG["num_layers"],
+        draft_num_heads=CFG["num_heads"], draft_hidden=CFG["hidden"],
+        **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Contract resolution (fast — tier-1)
+# ---------------------------------------------------------------------------
+
+def test_kv_dtype_contract_resolution():
+    assert not resolve_kv_dtype(None, jnp.bfloat16)
+    assert not resolve_kv_dtype("bf16", jnp.bfloat16)
+    assert not resolve_kv_dtype("fp32", jnp.float32)
+    assert resolve_kv_dtype("int8", jnp.bfloat16)
+    assert resolve_kv_dtype("int8", jnp.float32)
+    with pytest.raises(ValueError):
+        resolve_kv_dtype("fp16", jnp.float32)       # unknown format
+    with pytest.raises(ValueError):
+        resolve_kv_dtype("bf16", jnp.float32)       # contradicts dtype
+    with pytest.raises(ValueError):
+        resolve_kv_dtype("fp32", jnp.bfloat16)
+
+
+def test_decode_page_cache_quantized_policy():
+    # "quantized" seals only on a quantized pool; "fp32" names the
+    # FULL-WIDTH float32 trust class, so a quantized pool demotes it
+    assert resolve_decode_page_cache("quantized", jnp.float32, True)
+    assert resolve_decode_page_cache("quantized", jnp.bfloat16, True)
+    assert not resolve_decode_page_cache("quantized", jnp.float32, False)
+    assert not resolve_decode_page_cache("fp32", jnp.float32, True)
+    assert resolve_decode_page_cache("fp32", jnp.float32, False)
+    assert resolve_decode_page_cache("all", jnp.bfloat16, True)
+    assert not resolve_decode_page_cache("off", jnp.float32, True)
+
+
+def test_gateway_mirrors_pin_the_contract_tuples():
+    from kubegpu_tpu.gateway import client
+
+    assert client.DECODE_PAGE_CACHE_POLICIES == DECODE_PAGE_CACHE_POLICIES
+    assert client.KV_DTYPES == KV_DTYPES
+
+
+# ---------------------------------------------------------------------------
+# Kernels: in-kernel dequant vs the dequantize-then-reference oracle
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("page,hd", [(4, 8), (8, 16)])
+def test_quantized_kernels_match_dequantize_oracle(page, hd):
+    rs = np.random.RandomState(3)
+    P, h, b, npg = 12, 4, 3, 3
+    kf = jnp.asarray(rs.randn(P, h, page, hd).astype(np.float32))
+    vf = jnp.asarray(rs.randn(P, h, page, hd).astype(np.float32))
+    kd, ks = quantize_pages(kf)
+    vd, vs = quantize_pages(vf)
+    assert kd.dtype == jnp.int8 and ks.dtype == jnp.float32
+    tbl = jnp.stack([
+        jnp.asarray(
+            rs.choice(np.arange(1, P), size=npg, replace=False)
+        ).astype(jnp.int32)
+        for _ in range(b)
+    ])
+    ln = jnp.asarray(
+        rs.randint(1, npg * page, size=b).astype(np.int32)
+    )
+    q = jnp.asarray(rs.randn(b, h, hd).astype(np.float32))
+    out = paged_decode_attention(q, kd, vd, tbl, ln, k_scale=ks, v_scale=vs)
+    ref = reference_paged_attention(
+        q, dequantize_pages(kd, ks), dequantize_pages(vd, vs), tbl, ln
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # the multi-query (speculative verify) twin, per ROW
+    L = 3
+    qc = jnp.asarray(rs.randn(b, L, h, hd).astype(np.float32))
+    ln_c = jnp.asarray(
+        rs.randint(1, npg * page - L, size=b).astype(np.int32)
+    )
+    outc = paged_chunk_attention(
+        qc, kd, vd, tbl, ln_c, k_scale=ks, v_scale=vs
+    )
+    refc = reference_paged_chunk_attention(
+        qc, dequantize_pages(kd, ks), dequantize_pages(vd, vs), tbl, ln_c
+    )
+    np.testing.assert_allclose(np.asarray(outc), np.asarray(refc),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quantize_pages_roundtrip_properties():
+    rs = np.random.RandomState(7)
+    pages = jnp.asarray(rs.randn(6, 3, 4, 8).astype(np.float32)) * 3.0
+    data, scale = quantize_pages(pages)
+    deq = dequantize_pages(data, scale)
+    # error bounded by half a quantization step per element
+    err = np.abs(np.asarray(deq) - np.asarray(pages))
+    bound = np.asarray(scale)[:, :, None, None] * 0.5 + 1e-7
+    assert (err <= bound).all()
+    # tight: every (page, head) block with content reaches full range
+    mx = np.abs(np.asarray(data)).max(axis=(2, 3))
+    assert ((mx == 127) | (np.asarray(scale) == 0.0)).all()
+    # all-zero block quantizes to exact zeros at scale 0
+    zd, zs = quantize_pages(jnp.zeros((2, 3, 4, 8)))
+    assert not np.asarray(zd).any() and not np.asarray(zs).any()
+
+
+# ---------------------------------------------------------------------------
+# The int8 pool end to end: determinism, agreement, accounting
+# ---------------------------------------------------------------------------
+
+def _traffic(rs, n=5, lo=4, hi=20):
+    return [
+        rs.randint(0, CFG["vocab_size"], size=rs.randint(lo, hi))
+        .astype(np.int32)
+        for _ in range(n)
+    ]
+
+
+def test_int8_pool_deterministic_and_agrees_with_fullwidth():
+    params = trained_params()
+    rs = np.random.RandomState(0)
+    prompts = _traffic(rs)
+    budgets = [9, 12, 5, 8, 11]
+    full = make_paged(params)
+    q1 = make_paged(params, kv_dtype="int8")
+    q2 = make_paged(params, kv_dtype="int8")
+    out_f = full.run([p.copy() for p in prompts], budgets)
+    out_1 = q1.run([p.copy() for p in prompts], budgets)
+    out_2 = q2.run([p.copy() for p in prompts], budgets)
+    assert out_1 == out_2, "int8 streams must be deterministic in-mode"
+    for cb in (full, q1, q2):
+        cb.assert_page_accounting()   # incl. the per-dtype bytes leg
+    assert q1.kv_dtype == "int8" and full.kv_dtype == "float32"
+    # lengths match request-for-request; agreement is MEASURED (the
+    # quantized numerics class), and on this trained tiny config it is
+    # high — a collapse would mean a real plumbing bug, not rounding
+    total = agree = 0
+    for i in out_f:
+        assert len(out_1[i]) == len(out_f[i])
+        total += len(out_f[i])
+        agree += sum(a == b for a, b in zip(out_f[i], out_1[i]))
+    assert agree / total > 0.5, f"agreement collapsed: {agree}/{total}"
+
+
+def test_fp32_fullwidth_lane_token_identical_to_dense_oracle():
+    # the machinery must not perturb today's full-width path
+    params = trained_params()
+    rs = np.random.RandomState(1)
+    prompts = _traffic(rs, n=4)
+    budgets = [7, 10, 6, 9]
+    paged = make_paged(params)
+    dense = ContinuousBatcher(
+        params, slots=3, prompt_pad=24, dtype=jnp.float32, **CFG
+    )
+    assert (
+        paged.run([p.copy() for p in prompts], budgets)
+        == dense.run([p.copy() for p in prompts], budgets)
+    )
+
+
+@pytest.mark.parametrize("page_size,spec", [(4, False), (8, True)])
+def test_int8_agreement_property_multiturn_spec_churn(page_size, spec):
+    """Page sizes x speculation x multi-turn sealing x cancel/LRU
+    churn: the int8 pool holds accounting (bytes leg included) at every
+    quiescent point, multi-turn turn-2 prompts HIT through sealed
+    decode pages, and the whole schedule replayed on a fresh batcher is
+    token-identical (in-mode determinism under churn)."""
+    params = trained_params()
+    kw = dict(
+        kv_dtype="int8", decode_page_cache="quantized",
+        page_size=page_size, pool_pages=46, station_slots=2,
+    )
+    if spec:
+        kw.update(spec_kw(params, k=2, draft_window=32))
+
+    def run_schedule():
+        cb = make_paged(params, **kw)
+        rs = np.random.RandomState(13)
+        outs = {}
+        # turn 1s
+        p0 = rs.randint(0, CFG["vocab_size"], size=11).astype(np.int32)
+        outs.update(cb.run([p0], [8]))
+        # turn 2 extends turn 1's stream through the sealed region
+        stream = [int(t) for t in p0] + outs[0]
+        p2 = np.asarray(stream + [3], np.int32)
+        cb.submit(10, p2, 6)
+        # churn: enough traffic to force LRU eviction, plus a cancel
+        extra = _traffic(rs, n=6, lo=4, hi=16)
+        for j, p in enumerate(extra):
+            cb.submit(20 + j, p, 7)
+        cb.submit(99, extra[0].copy(), 9)
+        stepped = 0
+        while cb.has_work():
+            outs.update(cb.serve_step())
+            stepped += 1
+            if stepped == 4:
+                cb.cancel(99)
+            if stepped % 7 == 0:
+                cb.assert_page_accounting()
+        cb.assert_page_accounting()
+        return outs, dict(cb.stats)
+
+    outs1, stats1 = run_schedule()
+    outs2, _ = run_schedule()
+    assert outs1 == outs2, "int8 schedule not deterministic"
+    assert stats1["decode_pages_sealed"] > 0
+    assert stats1["prefix_hit_tokens_decode"] > 0, (
+        "turn-2 prompt never hit the sealed decode region"
+    )
+    assert stats1["seal_requants"] > 0
+
+
+def test_seal_time_requantization_leaves_tight_scales():
+    """After retirement sealing, every cache-owned page's int8 content
+    reaches full range (max|int8| == 127 per head, or the head is
+    all-zero): the requantization undid any grow-and-rescale inflation
+    before the page became immutable shared state."""
+    params = trained_params()
+    cb = make_paged(
+        params, kv_dtype="int8", decode_page_cache="quantized",
+        **spec_kw(params, k=2, draft_window=32),
+    )
+    rs = np.random.RandomState(5)
+    p0 = rs.randint(0, CFG["vocab_size"], size=13).astype(np.int32)
+    cb.run([p0], [10])
+    cb.assert_page_accounting()
+    assert cb.stats["seal_requants"] > 0
+    cached = sorted(cb.prefix_cache.pages())
+    assert cached
+    for kent, vent in cb.pools:
+        for data, scale in (kent, vent):
+            d = np.abs(np.asarray(data)[cached]).max(axis=(2, 3))
+            s = np.asarray(scale)[cached]
+            assert ((d == 127) | (s == 0.0)).all(), (d, s)
+
+
+def test_accounting_bytes_leg_catches_fullwidth_imposter():
+    params = trained_params()
+    cb = make_paged(params, kv_dtype="int8")
+    cb.assert_page_accounting()
+    (kd, ks), vent = cb.pools[0]
+    # a silent full-width allocation wearing the int8 label
+    cb.pools[0] = ((kd.astype(jnp.float32), ks), vent)
+    with pytest.raises(AssertionError):
+        cb.assert_page_accounting()
+    cb.pools[0] = ((kd, ks), vent)
+    cb.assert_page_accounting()
+    # and the full-width twin: an int8 imposter in a declared-bf16 pool
+    full = make_paged(params)
+    kp, vp = full.pools[0]
+    full.pools[0] = (kp.astype(jnp.bfloat16), vp)
+    with pytest.raises(AssertionError):
+        full.assert_page_accounting()
+
+
+def test_pool_bytes_gauges_ledger_and_state_surface():
+    params = trained_params()
+    m = Metrics()
+    cb = make_paged(params, kv_dtype="int8", metrics=m)
+    kv = m.gauge("serve_pool_kv_bytes", dtype="int8")
+    sc = m.gauge("serve_pool_kv_bytes", dtype="float32")
+    hd = CFG["hidden"] // CFG["num_heads"]
+    assert kv == 2 * CFG["num_layers"] * 40 * CFG["num_heads"] * 8 * hd
+    assert sc == 2 * CFG["num_layers"] * 40 * CFG["num_heads"] * 4
+    rs = np.random.RandomState(2)
+    cb.run(_traffic(rs, n=2), [4, 4])
+    row = cb.ledger_rows()[-1]
+    assert row["kv_dtype"] == "int8"
+    assert row["pool_kv_bytes"] == kv
+    assert row["pool_scale_bytes"] == sc
+    assert row["pool_bytes_per_device"] == kv + sc
+    # the /v1/state surface (dataplane serving loop)
+    from kubegpu_tpu.gateway.dataplane import ReplicaServingLoop
+
+    loop = ReplicaServingLoop(cb)
+    try:
+        state = loop.state()
+        assert state["kv_dtype"] == "int8"
+        assert state["pages"]["kv_dtype"] == "int8"
+        assert state["pages"]["kv_bytes"] == kv
+        assert state["pages"]["scale_bytes"] == sc
+    finally:
+        loop.stop()
+    # full-width pools declare their own dtype, one series
+    m2 = Metrics()
+    make_paged(params, metrics=m2)
+    assert m2.gauge("serve_pool_kv_bytes", dtype="float32") > 0
+
+
+# ---------------------------------------------------------------------------
+# Migration: schema v2 (dtype + scales), refusal atomicity, wire codec
+# ---------------------------------------------------------------------------
+
+def test_int8_live_migration_roundtrip_token_identical():
+    params = trained_params()
+    rs = np.random.RandomState(4)
+    src = make_paged(params, kv_dtype="int8")
+    dst = make_paged(params, kv_dtype="int8")
+    ref = make_paged(params, kv_dtype="int8")
+    warm = rs.randint(0, CFG["vocab_size"], size=9).astype(np.int32)
+    for cb in (src, dst, ref):
+        cb.run([warm.copy()], [3])
+    prompt = rs.randint(0, CFG["vocab_size"], size=17).astype(np.int32)
+    src.submit(7, prompt.copy(), 12)
+    for _ in range(6):
+        src.serve_step()
+    payload = src.export_pages(7)
+    assert payload["geometry"]["kv_dtype"] == "int8"
+    assert payload["geometry"]["schema"] == 2
+    assert len(payload["scales"]) == CFG["num_layers"]
+    # the wire codec round-trips int8 bytes + f32 scales exactly
+    import json
+
+    from kubegpu_tpu.gateway.dataplane import (
+        decode_kv_payload,
+        encode_kv_payload,
+    )
+
+    wire = json.loads(json.dumps(encode_kv_payload(payload)))
+    back = decode_kv_payload(wire)
+    for (k0, v0), (k1, v1) in zip(payload["layers"], back["layers"]):
+        assert np.asarray(k1).dtype == np.int8
+        assert (np.asarray(k0) == np.asarray(k1)).all()
+        assert (np.asarray(v0) == np.asarray(v1)).all()
+    for (k0, v0), (k1, v1) in zip(payload["scales"], back["scales"]):
+        assert np.asarray(k1).dtype == np.float32
+        assert (np.asarray(k0) == np.asarray(k1)).all()
+        assert (np.asarray(v0) == np.asarray(v1)).all()
+    src.cancel(7)
+    dst.import_pages(7, back)
+    done = {}
+    while dst.has_work():
+        done.update(dst.serve_step())
+    assert done[7] == ref.run([prompt.copy()], [12])[0]
+    src.assert_page_accounting()
+    dst.assert_page_accounting()
+
+
+def test_dtype_mismatched_import_refuses_atomically():
+    params = trained_params()
+    rs = np.random.RandomState(6)
+    src = make_paged(params, kv_dtype="int8")
+    prompt = rs.randint(0, CFG["vocab_size"], size=14).astype(np.int32)
+    src.submit(1, prompt, 10)
+    for _ in range(5):
+        src.serve_step()
+    payload = src.export_pages(1)
+    # a full-width batcher must refuse the quantized payload with ZERO
+    # refcounts moved — live import AND sealed twin
+    full = make_paged(params, decode_page_cache="fp32")
+    free0 = set(full.free_pages)
+    cache0 = len(full.prefix_cache)
+    with pytest.raises(ValueError, match="kv_dtype"):
+        full.import_pages(5, payload)
+    assert full.free_pages == free0 and len(full.prefix_cache) == cache0
+    full.assert_page_accounting()
+    # and the reverse direction: a legacy full-width payload into int8
+    sealed_src = make_paged(params, decode_page_cache="fp32")
+    out = sealed_src.run([prompt.copy()], [10])
+    sealed = sealed_src.export_sealed_chain(
+        [int(t) for t in prompt] + out[0]
+    )
+    assert sealed is not None
+    q = make_paged(params, kv_dtype="int8",
+                   decode_page_cache="quantized")
+    free0 = set(q.free_pages)
+    with pytest.raises(ValueError, match="kv_dtype"):
+        q.import_sealed_chain(sealed)
+    assert q.free_pages == free0
+    q.assert_page_accounting()
+
+
+def test_int8_sealed_chain_roundtrip_warms_the_importer():
+    params = trained_params()
+    rs = np.random.RandomState(8)
+    a = make_paged(params, kv_dtype="int8", decode_page_cache="quantized")
+    b = make_paged(params, kv_dtype="int8", decode_page_cache="quantized")
+    p0 = rs.randint(0, CFG["vocab_size"], size=12).astype(np.int32)
+    out = a.run([p0], [9])
+    stream = [int(t) for t in p0] + out[0]
+    payload = a.export_sealed_chain(stream)
+    assert payload is not None and payload["geometry"]["kv_dtype"] == "int8"
+    n = b.import_sealed_chain(payload)
+    assert n > 0
+    b.submit(2, np.asarray(stream + [1], np.int32), 5)
+    while b.has_work():
+        b.serve_step()
+    assert b.stats["prefix_hit_tokens"] > 0
+    a.assert_page_accounting()
+    b.assert_page_accounting()
+
+
+def test_session_store_budget_charges_quantized_scales():
+    """The store's byte budget must charge a quantized payload's
+    ``scales`` section too — retained-but-unbilled bytes would let the
+    resident set silently exceed ``max_payload_bytes``."""
+    from kubegpu_tpu.gateway.sessionstore import payload_bytes
+
+    k = np.zeros((2, 4, 8, 8), np.int8)
+    s = np.zeros((2, 4), np.float32)
+    host = {"layers": [(k, k)], "scales": [(s, s)]}
+    assert payload_bytes(host) == 2 * k.nbytes + 2 * s.nbytes
+    wire = {"layers": [{"k": "aa", "v": "bb"}],
+            "scales": [{"k": "cc", "v": "dd"}]}
+    assert payload_bytes(wire) == 8
+
+
+def test_simbatcher_kv_dtype_contract():
+    from kubegpu_tpu.gateway.client import SimBatcher
+
+    with pytest.raises(ValueError):
+        SimBatcher(kv_dtype="fp16")
+    sim8 = SimBatcher(kv_dtype="int8")
+    sim16 = SimBatcher()
+    # the mill advertises the REAL batchers' numpy-style names, so a
+    # mixed SimBatcher/real fleet never reads as a kv_dtype skew
+    assert sim8.kv_dtype == "int8" and sim16.kv_dtype == "bfloat16"
+    sim8.submit(0, [1, 2, 3], 4)
+    sim8.serve_step()
+    payload = sim8.export_pages(0)
+    assert payload["kv_dtype"] == "int8"
+    with pytest.raises(ValueError, match="kv_dtype"):
+        sim16.import_pages(1, payload)
+    sim8b = SimBatcher(kv_dtype="int8")
+    sim8b.import_pages(1, payload)   # twins transfer fine
+
+
+def test_worker_cli_rejects_kv_dtype_off_the_paged_path():
+    from kubegpu_tpu.models import worker
+
+    tiny = ["--vocab", "61", "--layers", "1", "--heads", "2",
+            "--hidden", "16", "--seq", "32", "--prompt-len", "8",
+            "--batch-per-chip", "2", "--steps", "2"]
+    with pytest.raises(SystemExit):
+        worker.main(["--model", "decode", "--serving", "continuous",
+                     "--kv-dtype", "int8"] + tiny)
+    with pytest.raises(SystemExit):
+        # contradictory pair: bf16 pool label on an fp32 server
+        worker.main(["--model", "decode", "--serving", "paged",
+                     "--serve-fp32", "--kv-dtype", "bf16"] + tiny)
+
+
+def test_worker_cli_serves_paged_int8(capsys):
+    from kubegpu_tpu.models import worker
+
+    tiny = ["--vocab", "61", "--layers", "1", "--heads", "2",
+            "--hidden", "16", "--seq", "32", "--prompt-len", "8",
+            "--batch-per-chip", "2", "--steps", "2"]
+    rc = worker.main(["--model", "decode", "--serving", "paged",
+                      "--kv-dtype", "int8"] + tiny)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "DECODE_DONE" in out and "serving=paged" in out
+
+
+# ---------------------------------------------------------------------------
+# Compile stability: one jit entry per quantized program
+# ---------------------------------------------------------------------------
+
+def test_compile_stability_quantized_40_steps():
+    """40 steps of admits, cancels, prefix hits, speculation, sealing
+    and station churn on an int8 pool: exactly ONE compiled entry per
+    program — the quantized step/draft/verify programs, each bucketed
+    scatter/gather width, and each seal-time requant width."""
+    params = trained_params()
+    rng = np.random.RandomState(9)
+    cb = make_paged(
+        params, kv_dtype="int8", decode_page_cache="quantized",
+        station_slots=2, token_budget=11, prefill_chunk=8,
+        **spec_kw(params, k=2, draft_window=32),
+    )
+    seq, live = 0, []
+    for _ in range(40):
+        roll = rng.rand()
+        if roll < 0.5:
+            n = int(rng.randint(1, 13))
+            max_new = int(rng.randint(1, 6))
+            prompt = (
+                np.arange(n, dtype=np.int32) % 7 if roll < 0.15
+                else np.array(
+                    rng.randint(0, CFG["vocab_size"], size=n), np.int32
+                )
+            )  # the arange prompts repeat -> prefix-cache hits
+            cb.submit(seq, prompt, max_new)
+            live.append(seq)
+            seq += 1
+        elif roll < 0.6 and live:
+            cb.cancel(live.pop(rng.randint(len(live))))
+        else:
+            for s in cb.serve_step():
+                live.remove(s)
+    while cb.has_work():
+        for s in cb.serve_step():
+            live.remove(s)
+    cb.assert_page_accounting()
+    for name in ("_spec_draft", "_spec_verify", "_draft_admit", "_chunk"):
+        assert getattr(cb, name)._cache_size() == 1, (
+            f"{name}: {getattr(cb, name)._cache_size()} compiled entries"
+        )
+    assert cb._write_pages, "no multi-page scatter ran"
+    for w, fn in cb._write_pages.items():
+        assert fn._cache_size() == 1, f"scatter width {w} recompiled"
+    for w, fn in cb._gather_pages.items():
+        assert fn._cache_size() == 1, f"gather width {w} recompiled"
+    assert cb._requant_pages, "no seal-time requant ran"
+    for w, fn in cb._requant_pages.items():
+        assert fn._cache_size() == 1, f"requant width {w} recompiled"
+    assert cb._zero_scales, "no admission scale-zeroing ran"
+    for w, fn in cb._zero_scales.items():
+        assert fn._cache_size() == 1, f"zero-scales width {w} recompiled"
+
+
+def test_fresh_pages_start_with_clean_scales():
+    """Page-reuse regression (review finding): a page coming off the
+    free list still carries its previous occupant's scale, and
+    grow-and-rescale only ever grows — so without the admission-time
+    reset, a new sequence's int8 bytes would depend on allocation
+    HISTORY.  Pool sized so the second request can only get reused
+    pages; its decode-headroom page must start at scale 0."""
+    params = trained_params()
+    cb = make_paged(
+        params, kv_dtype="int8", prefix_cache=False, slots=1,
+        station_slots=1, pool_pages=5,
+    )
+    rs = np.random.RandomState(21)
+    p1 = rs.randint(0, CFG["vocab_size"], size=20).astype(np.int32)
+    cb.run([p1], [10])
+    ks = np.asarray(cb.pools[0][0][1])
+    freed = sorted(cb.free_pages)
+    assert ks[freed].max() > 0, "no stale scale to inherit — vacuous"
+    p2 = rs.randint(0, CFG["vocab_size"], size=6).astype(np.int32)
+    cb.submit(5, p2, 10)
+    cb.serve_step()   # admission + first chunk; headroom page untouched
+    s = next(s for s in cb._seqs if s.seq_id == 5)
+    for kent, vent in cb.pools:
+        for _, scale in (kent, vent):
+            assert np.asarray(scale)[s.pages[-1]].max() == 0.0, (
+                "fresh page inherited a previous occupant's scale"
+            )
+    while cb.has_work():
+        cb.serve_step()
+    cb.assert_page_accounting()
+
+
+def test_reused_batcher_streams_identical_to_fresh():
+    """The determinism contract across BOTH review findings (inherited
+    pool scales, station-slot junk above the prompt inflating the tail
+    page's scatter scale): a request served on a heavily-reused
+    batcher must emit exactly the stream a fresh batcher emits —
+    quantized state can never leak between sequences."""
+    params = trained_params()
+    rs = np.random.RandomState(22)
+    prompts = _traffic(rs, n=4, lo=5, hi=22)
+    budgets = [10, 7, 12, 9]
+    kw = dict(kv_dtype="int8", prefix_cache=False, slots=1,
+              station_slots=1, pool_pages=6)
+    reused = make_paged(params, **kw)
+    for i, (p, b) in enumerate(zip(prompts, budgets)):
+        got = reused.run([p.copy()], [b])
+        want = make_paged(params, **kw).run([p.copy()], [b])
+        assert got[0] == want[0], (
+            f"request {i}'s stream depends on allocation/station history"
+        )
+        reused.assert_page_accounting()
+
+
+# ---------------------------------------------------------------------------
+# Tensor parallelism: int8 pool + scales head-sharded over a mesh
+# ---------------------------------------------------------------------------
+
+def test_tp2_int8_pool_token_identity_and_sharded_scales():
+    """TP=2 over the 8-way host sim: the int8 pool (pages AND scales)
+    rests head-sharded, the quantized kernels run per head-shard under
+    shard_map token-identically to the single-device int8 batcher, the
+    layout+bytes accounting legs compose, and a TP=2 export imports
+    into a TP=1 twin (shard-local scale reads reassemble in head
+    order)."""
+    from kubegpu_tpu.parallel import device_mesh
+
+    if jax.device_count() < 2:
+        pytest.skip("need 2 devices")
+    # vocab/heads divisible by tp (lm_head is column-parallel)
+    tcfg = dict(vocab_size=64, num_layers=2, num_heads=8, hidden=32,
+                max_seq=32)
+    model = TransformerLM(dtype=jnp.float32, **tcfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.ones((2, 8), jnp.int32)
+    )["params"]
+
+    def mk(tp):
+        mesh = (
+            device_mesh({"model": tp}, devices=jax.devices()[:tp])
+            if tp > 1 else None
+        )
+        return PagedContinuousBatcher(
+            params, slots=3, prompt_pad=12, page_size=4, pool_pages=32,
+            dtype=jnp.float32, kv_dtype="int8", mesh=mesh, **tcfg,
+        )
+
+    rs = np.random.RandomState(2)
+    prompts = [
+        rs.randint(0, 64, size=n).astype(np.int32) for n in (3, 7, 11)
+    ]
+    budgets = [6, 5, 7]
+    one = mk(1)
+    two = mk(2)
+    out1 = one.run([p.copy() for p in prompts], budgets)
+    out2 = two.run([p.copy() for p in prompts], budgets)
+    assert out1 == out2, "TP=2 int8 tokens diverged from TP=1"
+    two.assert_page_accounting()   # layout leg incl. scale sharding
+    assert two._pool_bytes_per_device == one._pool_bytes_per_device // 2
+    # migration across widths: TP=2 export → TP=1 import, resumable
+    two.submit(50, prompts[0].copy(), 8)
+    for _ in range(5):
+        two.serve_step()
+    payload = two.export_pages(50)
+    two.cancel(50)
+    dst = mk(1)
+    dst.run([prompts[1].copy()], [3])
+    dst.import_pages(50, payload)
+    done = {}
+    while dst.has_work():
+        done.update(dst.serve_step())
+    ref = mk(1).run([prompts[0].copy()], [8])
+    assert done[50] == ref[0]
+    dst.assert_page_accounting()
+    two.assert_page_accounting()
+
+
+# ---------------------------------------------------------------------------
+# Soak: the acceptance kill schedule over int8 pools (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_gateway_soak_int8_kill_schedule():
+    """The GatewaySoak kill/revive/hedge schedule with multi-turn
+    sessions over REAL int8-pool batchers (quantized decode-page
+    sealing AND speculation on): invariant I5, and page accounting —
+    including the per-dtype bytes leg — on every surviving replica at
+    quiescence."""
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    params = trained_params()
+    soak = GatewaySoak(
+        seed=31, n_replicas=2, multiturn=True, follow_prompt_cap=12,
+        batcher_factory=lambda key: PagedContinuousBatcher(
+            params, slots=4, prompt_pad=16, page_size=4, pool_pages=56,
+            station_slots=2, token_budget=8, dtype=jnp.float32,
+            kv_dtype="int8", decode_page_cache="quantized",
+            draft_params=params, speculate_k=2, draft_window=24,
+            draft_num_layers=CFG["num_layers"],
+            draft_num_heads=CFG["num_heads"],
+            draft_hidden=CFG["hidden"], **CFG,
+        ),
+    )
+    soak.run(steps=20)
