@@ -120,6 +120,12 @@ multichip-smoke:
 # the session's next turn restores sealed KV from the EXTERNAL store
 # (decode-page hits > 0, token-identical), and SIGTERM drains a gateway
 # gracefully (readyz 503, live stream finishes, exit 0)
+# dryrun_prefix_tier: the fleet-wide prefix tier over REAL processes —
+# one store, two workers, two gateways each fronting ONE worker;
+# replica A prefills an agent scaffold once, the sealed chain lands in
+# the store under its content hash, and the COLD replica B imports it
+# pre-prefill (decode-page hit tokens > 0, token-identical to the
+# warm-local reference)
 # dryrun_controller: the self-reshaping fleet over a REAL subprocess
 # worker fleet — a surge's reconcile tick gang-schedules a second
 # serving pod by preempting a batch pod (checkpoint-and-requeue), the
@@ -133,7 +139,8 @@ dryrun:
 	  g.dryrun_spec_serving(); g.dryrun_tracing(); \
 	  g.dryrun_http_serving(); g.dryrun_kv_migration(); \
 	  g.dryrun_quantized_serving(); \
-	  g.dryrun_gateway_pods(); g.dryrun_controller(); \
+	  g.dryrun_gateway_pods(); g.dryrun_prefix_tier(); \
+	  g.dryrun_controller(); \
 	  g.dryrun_multichip(8)"
 
 image:

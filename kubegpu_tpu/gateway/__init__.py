@@ -37,11 +37,13 @@ from kubegpu_tpu.gateway.failover import (
     FailoverPolicy,
     SessionKVStore,
 )
+from kubegpu_tpu.gateway.prefixtier import PrefixTier, prompt_chain_keys
 from kubegpu_tpu.gateway.queue import AdmissionQueue, QueueClosed, QueueFull
 from kubegpu_tpu.gateway.registry import ReplicaInfo, ReplicaRegistry
 from kubegpu_tpu.gateway.router import (
     ConsistentHashRouter,
     LeastOutstandingRouter,
+    PrefixLocalityRouter,
     Router,
     SessionAffinityRouter,
 )
@@ -81,6 +83,9 @@ __all__ = [
     "ReplicaServer",
     "ReplicaServingLoop",
     "PendingRequest",
+    "PrefixLocalityRouter",
+    "PrefixTier",
+    "prompt_chain_keys",
     "QueueClosed",
     "QueueFull",
     "ReplicaClient",

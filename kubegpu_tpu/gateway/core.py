@@ -229,6 +229,7 @@ class Gateway:
         tracer: Optional[Tracer] = None,
         trace: bool = True,
         session_store: Optional[SessionKVStore] = None,
+        prefix_tier=None,
         gateway_id: str = "",
     ) -> None:
         self.registry = registry
@@ -264,12 +265,24 @@ class Gateway:
             metrics=self.metrics
         )
         self._seals_cache: Dict[str, bool] = {}
+        # fleet-wide shared-prefix tier (gateway/prefixtier.PrefixTier):
+        # sealed chains from ok completions publish to the store under
+        # their content hash, and cold dispatch targets import the
+        # longest stored prefix before prefill.  Optional — None keeps
+        # the pre-tier behavior exactly.  A GatewayTier passes ONE
+        # shared tier into all its gateways, same as the session store.
+        self.prefix_tier = prefix_tier
+        if prefix_tier is not None and getattr(
+            prefix_tier, "metrics", False
+        ) is None:
+            prefix_tier.metrics = self.metrics
         self.dispatcher = Dispatcher(
             client,
             router or LeastOutstandingRouter(),
             policy or FailoverPolicy(),
             metrics=self.metrics,
             session_store=self.session_store,
+            prefix_tier=self.prefix_tier,
         )
         self.n_dispatchers = dispatchers
         self._stop = threading.Event()
@@ -573,6 +586,16 @@ class Gateway:
                 total = time.monotonic() - request.enqueued_at
                 if outcome.status == "ok" and request.session:
                     self._record_session(request, outcome)
+                if outcome.status == "ok" and (
+                    self.prefix_tier is not None and outcome.replica
+                ):
+                    # ANY ok completion may have sealed a publishable
+                    # chain (sessionless agent scaffolds included) —
+                    # queue it off the result path; the tier dedups
+                    self.prefix_tier.publish_async(
+                        self.client, outcome.replica,
+                        list(request.prompt) + list(outcome.tokens),
+                    )
                 if outcome.status == "ok":
                     self.metrics.observe("gateway_ttft_seconds", total)
                 self.metrics.inc(
@@ -664,6 +687,8 @@ class Gateway:
         # planned unpin: the affinity router's next pick re-pins by load
         # and the restored export keeps the KV warm
         self.session_store.mark_lost(key)
+        if self.prefix_tier is not None:
+            self.prefix_tier.forget_replica(key)
         forget = getattr(self.dispatcher.router, "forget_replica", None)
         if forget is not None:
             forget(key)
@@ -735,5 +760,7 @@ class Gateway:
         # export into the re-pin target), and forget its sealing policy
         # (a revived pod may come back configured differently)
         self.session_store.sync_live(live)
+        if self.prefix_tier is not None:
+            self.prefix_tier.sync_live(live)
         for key in [k for k in self._seals_cache if k not in live]:
             self._seals_cache.pop(key, None)

@@ -438,6 +438,16 @@ class ReplicaServingLoop:
                 k: v for k, v in stats.items()
                 if isinstance(v, (int, float, str, bool))
             }
+        # the prefix-cache economy (cached chains, resident pages by
+        # kind, hit/miss tokens per prompt|decode kind): the warmth
+        # surface the PrefixLocalityRouter and the FleetController read
+        # per replica — duck-typed, absent for batchers with no cache
+        economy_fn = getattr(b, "prefix_cache_stats", None)
+        if economy_fn is not None:
+            try:
+                out["prefix_cache"] = economy_fn()
+            except Exception:  # noqa: BLE001 - state must always serve
+                pass
         # the pool's declared storage format rides the contract surface:
         # the gateway can see a fleet's kv_dtype skew without reading
         # ledgers, and migration tooling can pre-check compatibility

@@ -102,6 +102,7 @@ class Dispatcher:
         outstanding=None,
         outstanding_lock: Optional[threading.Lock] = None,
         session_store: Optional[SessionKVStore] = None,
+        prefix_tier=None,
     ) -> None:
         self.client = client
         self.router = router
@@ -117,6 +118,11 @@ class Dispatcher:
         # bounces sessions by design; restoring per bounce would ship
         # the payload every turn.
         self.session_store = session_store
+        # fleet-wide prefix tier (prefixtier.PrefixTier): before a cold
+        # attempt opens, import the longest fleet-published prefix of the
+        # prompt into the target, so a hot system prompt prefills once
+        # fleet-wide instead of once per replica.
+        self.prefix_tier = prefix_tier
         self._mispin_restore = hasattr(router, "forget_replica")
         self.retry_budget = _Budget(
             self.policy.retry_budget_ratio, self.policy.budget_floor
@@ -148,6 +154,7 @@ class Dispatcher:
                 hedge: bool = False) -> Attempt:
         self._inc(replica.key)
         trace = getattr(request, "trace", None)
+        restored = False
         if self.session_store is not None and not hedge:
             # restore-before-dispatch: a session dispatching away from
             # its recorded KV home (lost home, or a ring-rebalance
@@ -162,12 +169,26 @@ class Dispatcher:
                     request, replica.key, self.client,
                     mispin_restore=self._mispin_restore,
                 ):
+                    restored = True
                     if self.metrics:
                         self.metrics.inc("gateway_session_restores_total")
                     if trace is not None:
                         trace.event("session_restore", replica=replica.key)
             except Exception:  # noqa: BLE001 - restore is best-effort
                 log.exception("sealed-session restore failed")
+        if self.prefix_tier is not None and not hedge and not restored:
+            # tier probe + pre-prefill import: skipped for hedge twins
+            # (the primary usually lands on warm pages already) and when
+            # a session restore just shipped the same chain.  Best-effort
+            # under the full degradation contract — a tier outage means a
+            # counted cold prefill, never a request error.
+            try:
+                if self.prefix_tier.ensure_warm(
+                    request, replica.key, self.client
+                ) and trace is not None:
+                    trace.event("prefix_tier_import", replica=replica.key)
+            except Exception:  # noqa: BLE001 - tier import is best-effort
+                log.exception("prefix-tier import failed")
         attrs: dict = {}
         # streaming resume watermark: an attempt opened after the caller
         # already received N tokens — a hedge twin, a retry, or a

@@ -17,6 +17,14 @@ already failed or hedged on), and returns one replica or None.
   conversation's cache pages).  Falls back to least-outstanding — with the
   dead replica's slice as the locality hint — when the pinned replica
   drains, and re-pins to the new choice.
+- ``PrefixLocalityRouter``: route by longest LOCALLY-cached prefix (PR
+  16's fleet-wide prefix tier keeps an advisory warmth map of which
+  sealed chains live on which replica), so an agent fleet sharing one
+  scaffold packs onto warm replicas instead of spraying cold imports.
+  Ties break by least-outstanding among the equally-warm; no warmth at
+  all falls back to the consistent-hash ring — which keeps session
+  routing deterministic across gateways, and keeps follow turns sticky
+  anyway (the session's own replica holds its longest chain).
 - ``ConsistentHashRouter``: the multi-gateway tier's affinity policy —
   session → replica via a consistent-hash ring over the routable replica
   keys.  Routing is a pure function of (session, membership), so N
@@ -255,6 +263,61 @@ class ConsistentHashRouter(Router):
                 s for s, k in self._last_route.items() if k == replica_key
             ]:
                 del self._last_route[s]
+
+
+class PrefixLocalityRouter(Router):
+    """Prefix-locality routing over the fleet-wide prefix tier.
+
+    Scores every routable replica by how many pages of the request's
+    prompt are already warm there (``PrefixTier.locality_scores`` — the
+    advisory warmth map fed by sealed-here/imported-here events) and
+    routes to the warmest; equal warmth breaks by least-outstanding,
+    zero warmth falls back (``ConsistentHashRouter`` default, so
+    sessionful traffic stays ring-deterministic tier-wide).
+
+    The warmth map is ADVISORY: a stale score routes one request to a
+    replica that then probes the tier or prefills cold — a perf blip,
+    never wrong tokens, because the replica's content-keyed cache is
+    the ground truth at admission.  ``forget_replica`` drops both the
+    tier's warmth and the fallback's memos, which also keeps the
+    dispatcher's mispin-restore duck-typing intact."""
+
+    def __init__(self, tier, fallback: Optional[Router] = None,
+                 metrics: Optional[Metrics] = None) -> None:
+        self.tier = tier
+        self.fallback = fallback or ConsistentHashRouter(metrics=metrics)
+        self.metrics = metrics
+
+    def pick(self, request, replicas, outstanding, exclude=frozenset()):
+        candidates = [r for r in replicas if r.key not in exclude]
+        if not candidates:
+            return None
+        prompt = getattr(request, "prompt", None)
+        scores: Mapping[str, int] = {}
+        if prompt:
+            scores = self.tier.locality_scores(
+                prompt, [r.key for r in candidates]
+            )
+        best = max(scores.values(), default=0)
+        if best > 0:
+            warm = [r for r in candidates if scores.get(r.key, 0) == best]
+            choice = min(
+                warm, key=lambda r: (outstanding.get(r.key, 0), r.key)
+            )
+            route_span = getattr(request, "route_span", None)
+            if route_span is not None:
+                route_span.annotate(prefix_locality=True,
+                                    warm_pages=best)
+            if self.metrics is not None:
+                self.metrics.inc("gateway_prefix_route_warm_total")
+            return choice
+        return self.fallback.pick(request, replicas, outstanding, exclude)
+
+    def forget_replica(self, replica_key: str) -> None:
+        self.tier.forget_replica(replica_key)
+        forget = getattr(self.fallback, "forget_replica", None)
+        if forget is not None:
+            forget(replica_key)
 
 
 class _with_hint:

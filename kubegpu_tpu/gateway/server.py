@@ -514,12 +514,31 @@ def main(argv=None) -> None:
     )
     ap.add_argument(
         "--router", default="least-outstanding",
-        choices=("least-outstanding", "consistent-hash", "affinity"),
+        choices=("least-outstanding", "consistent-hash", "affinity",
+                 "prefix-locality"),
         help="routing policy: least-outstanding (default), "
         "consistent-hash (the tier policy — N gateway pods route every "
         "session identically with zero shared state; required for "
-        "multi-gateway deployments), or affinity (sticky per-instance "
-        "pins)",
+        "multi-gateway deployments), affinity (sticky per-instance "
+        "pins), or prefix-locality (route by longest locally-cached "
+        "prefix, consistent-hash fallback; requires --prefix-tier)",
+    )
+    ap.add_argument(
+        "--prefix-tier", action="store_true",
+        help="fleet-wide shared-prefix KV tier (gateway/prefixtier): "
+        "sealed chains publish to the store keyed by cumulative "
+        "content hash (popularity-weighted LRU, payload bytes "
+        "refcounted/deduped); cold dispatch targets import the longest "
+        "stored prefix before prefill, so a hot system prompt "
+        "prefills ONCE fleet-wide.  Uses --session-store when given "
+        "(the same store serves both key classes), else an in-process "
+        "backend.  Tier outages degrade to counted cold prefill "
+        "(gateway_prefix_tier_degraded_total), never request errors",
+    )
+    ap.add_argument(
+        "--prefix-page", type=int, default=8,
+        help="KV page size the prefix tier hashes prompts at — must "
+        "match the replicas' --page-size or probes never hit",
     )
     ap.add_argument(
         "--drain-grace", type=float, default=30.0,
@@ -862,6 +881,34 @@ def main(argv=None) -> None:
         )
         log.info("external session store: %s", args.session_store)
 
+    prefix_tier = None
+    if args.prefix_tier:
+        # the SAME external store serves both key classes (session
+        # leases + prefix chains) when --session-store is given; else
+        # an in-process backend (single-pod semantics)
+        from kubegpu_tpu.gateway.prefixtier import PrefixTier
+
+        if args.session_store:
+            from kubegpu_tpu.gateway.sessionstore import HttpStoreClient
+
+            tier_backend = HttpStoreClient(
+                args.session_store, metrics=default_metrics
+            )
+        else:
+            from kubegpu_tpu.gateway.sessionstore import (
+                InProcessStoreBackend,
+            )
+
+            tier_backend = InProcessStoreBackend()
+        prefix_tier = PrefixTier(
+            backend=tier_backend, page=args.prefix_page,
+            metrics=default_metrics,
+        )
+        log.info(
+            "prefix tier: page=%d store=%s", args.prefix_page,
+            args.session_store or "in-process",
+        )
+
     router = None
     if args.router == "consistent-hash":
         from kubegpu_tpu.gateway.router import ConsistentHashRouter
@@ -871,6 +918,12 @@ def main(argv=None) -> None:
         from kubegpu_tpu.gateway.router import SessionAffinityRouter
 
         router = SessionAffinityRouter()
+    elif args.router == "prefix-locality":
+        if prefix_tier is None:
+            raise SystemExit("--router prefix-locality needs --prefix-tier")
+        from kubegpu_tpu.gateway.router import PrefixLocalityRouter
+
+        router = PrefixLocalityRouter(prefix_tier)
 
     gateway = Gateway(
         registry, client,
@@ -881,6 +934,7 @@ def main(argv=None) -> None:
         ),
         dispatchers=args.dispatchers,
         session_store=session_store,
+        prefix_tier=prefix_tier,
     )
     host, _, port = args.listen.rpartition(":")
     server = GatewayServer(

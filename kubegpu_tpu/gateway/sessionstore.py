@@ -58,11 +58,32 @@ never decodes KV bytes):
     POST   /v1/chaos              (only with --chaos) {"conflicts": n} |
                                   {"expire_all": true}
     GET    /healthz, /metrics
+
+Prefix-tier namespace (PR 16 — ``gateway/prefixtier.py``): sealed
+chains keyed by CUMULATIVE CONTENT HASH, a key class distinct from
+session leases.  Prefixes are immortal-while-hot: every probe hit or
+fetch renews their (separate) TTL and bumps popularity, and eviction
+under the prefix byte budget is popularity-weighted LRU — the coldest
+entry goes first, a hot system prompt never does.  Payload BYTES are
+deduplicated store-wide by content hash with refcounted references
+(shared between the session and prefix namespaces), so a prefix
+published by N replicas — or a chain captured by N sessions — rests
+once:
+
+    PUT    /v1/prefix/<chain_key> {"entry": {"payload", "page_keys",
+                                   "pages"}}
+                                  -> 200 {"stored": bool, "refs": n}
+    POST   /v1/prefix/probe       {"keys": [k0..kn]}  (metadata-first)
+                                  -> 200 {"chain", "match_pages",
+                                          "pages"} | 404
+    GET    /v1/prefix/<chain_key> (?meta=1)
+                                  -> 200 {"entry"} | 404
 """
 
 from __future__ import annotations
 
 import argparse
+import hashlib
 import http.client
 import json
 import logging
@@ -131,6 +152,26 @@ class SessionStoreBackend:
         """Mark every entry whose replica is NOT in ``live`` lost."""
         raise NotImplementedError
 
+    # -- prefix-tier namespace (PR 16) ------------------------------------
+    def put_prefix(self, chain_key: str, entry: dict) -> StoreResult:
+        """Publish a sealed chain under its cumulative content hash.
+        ``entry``: ``{"payload", "page_keys", "pages"}``.  Idempotent —
+        re-publishing an existing chain bumps its popularity instead of
+        storing a duplicate."""
+        raise NotImplementedError
+
+    def probe_prefix(self, keys: List[str]) -> StoreResult:
+        """Metadata-first: the LONGEST stored chain sharing a prefix
+        with the prompt whose cumulative page keys are ``keys`` (in
+        order).  Cumulative hashing makes this a point lookup per key,
+        walked longest-first.  ``ok`` entries carry ``{"chain",
+        "match_pages", "pages"}`` and the hit bumps popularity +
+        renews the TTL (immortal-while-hot)."""
+        raise NotImplementedError
+
+    def get_prefix(self, chain_key: str, meta: bool = False) -> StoreResult:
+        raise NotImplementedError
+
     def healthy(self) -> bool:
         return True
 
@@ -159,6 +200,28 @@ def payload_bytes(payload) -> int:
     return total
 
 
+def payload_key(payload) -> Optional[str]:
+    """Content address of a sealed-chain payload, REPRESENTATION-
+    INDEPENDENT: the page keys are already cumulative content hashes of
+    the token stream (PR 5), so hashing them — plus the geometry, which
+    distinguishes pools that hold the same tokens in different shapes
+    (kv_dtype, page size, tp) — identifies the payload bytes whether
+    the layers arrived as host numpy or base64 wire strings.  ``None``
+    for payloads that carry no page keys (nothing to dedup by)."""
+    if not isinstance(payload, dict):
+        return None
+    keys = payload.get("page_keys")
+    if not keys:
+        return None
+    h = hashlib.sha256()
+    for k in keys:
+        h.update(str(k).encode())
+    h.update(json.dumps(
+        payload.get("geometry") or {}, sort_keys=True, default=str
+    ).encode())
+    return h.hexdigest()
+
+
 class InProcessStoreBackend(SessionStoreBackend):
     """Versioned, leased, byte-bounded session-entry map.
 
@@ -176,17 +239,41 @@ class InProcessStoreBackend(SessionStoreBackend):
     def __init__(self, max_sessions: int = 4096,
                  max_payload_bytes: int = 256 << 20,
                  lease_s: Optional[float] = None,
+                 max_prefix_bytes: int = 256 << 20,
+                 prefix_lease_s: Optional[float] = None,
                  clock: Callable[[], float] = time.monotonic,
                  metrics: Optional[Metrics] = None) -> None:
         self.max_sessions = max_sessions
         self.max_payload_bytes = max_payload_bytes
         self.lease_s = lease_s
+        self.max_prefix_bytes = max_prefix_bytes
+        # the prefix TTL is a SEPARATE eviction class from session
+        # leases: renewed on every probe hit / fetch / re-publish, so a
+        # hot prefix is immortal while traffic touches it and only a
+        # genuinely cold one ages out
+        self.prefix_lease_s = prefix_lease_s
         self.clock = clock
         self.metrics = metrics
         self._lock = threading.Lock()
-        # session -> {"entry", "version", "expires", "bytes"}
+        # session -> {"entry", "version", "expires", "bytes", "pkey"}
         self._records: "OrderedDict[str, dict]" = OrderedDict()
         self._payload_bytes = 0
+        # content-addressed payload table: identical payload bytes rest
+        # ONCE no matter how many sessions captured them or how many
+        # replicas published them as a prefix.  pkey ->
+        # {"payload", "bytes", "srefs", "prefs"} — per-namespace
+        # refcounts so each budget charges a payload exactly once while
+        # ANY of its refs live
+        self._payloads: Dict[str, dict] = {}
+        # chain_key -> {"pkey", "pages", "page_keys", "hits", "expires",
+        # "last"} — the prefix namespace, popularity-weighted
+        self._prefixes: "OrderedDict[str, dict]" = OrderedDict()
+        # page_key -> set of chain keys whose chain contains that page:
+        # the probe's point-lookup index (cumulative hashing means a
+        # page-key match IS a shared-prefix match up to that page)
+        self._page_index: Dict[str, set] = {}
+        self._prefix_bytes = 0
+        self._prefix_seq = 0
         # chaos knobs (soak/tests): fail the next N puts with a CAS
         # conflict; force-expire every lease
         self.force_conflicts = 0
@@ -195,8 +282,56 @@ class InProcessStoreBackend(SessionStoreBackend):
     def _expired_locked(self, rec: dict) -> bool:
         return rec["expires"] is not None and self.clock() >= rec["expires"]
 
+    def _payload_ref_locked(self, pkey: str, payload, ns: str):
+        """Reference ``payload`` under ``pkey`` from namespace ``ns``
+        (``srefs`` = sessions, ``prefs`` = prefixes); returns the
+        CANONICAL payload object so duplicate bytes are garbage the
+        moment the caller drops its copy.  Byte budgets charge on a
+        namespace's 0->1 transition only."""
+        field = "srefs" if ns == "session" else "prefs"
+        rec = self._payloads.get(pkey)
+        if rec is None:
+            rec = {"payload": payload, "bytes": payload_bytes(payload),
+                   "srefs": 0, "prefs": 0}
+            self._payloads[pkey] = rec
+        elif self.metrics is not None:
+            self.metrics.inc("session_store_payload_dedup_total")
+        if rec[field] == 0:
+            if field == "srefs":
+                self._payload_bytes += rec["bytes"]
+            else:
+                self._prefix_bytes += rec["bytes"]
+        rec[field] += 1
+        return rec["payload"]
+
+    def _payload_unref_locked(self, pkey: Optional[str], ns: str) -> None:
+        if pkey is None:
+            return
+        rec = self._payloads.get(pkey)
+        if rec is None:
+            return
+        field = "srefs" if ns == "session" else "prefs"
+        rec[field] -= 1
+        if rec[field] == 0:
+            if field == "srefs":
+                self._payload_bytes -= rec["bytes"]
+            else:
+                self._prefix_bytes -= rec["bytes"]
+        if rec["srefs"] <= 0 and rec["prefs"] <= 0:
+            self._payloads.pop(pkey, None)
+
+    def _session_payload_drop_locked(self, rec: dict) -> None:
+        """Detach a session record's payload (LRU drop / overwrite):
+        unref content-addressed payloads, un-charge direct ones."""
+        if rec.get("pkey") is not None:
+            self._payload_unref_locked(rec["pkey"], "session")
+            rec["pkey"] = None
+        else:
+            self._payload_bytes -= rec["bytes"]
+        rec["bytes"] = 0
+
     def _drop_locked(self, session: str, rec: dict) -> None:
-        self._payload_bytes -= rec["bytes"]
+        self._session_payload_drop_locked(rec)
         self._records.pop(session, None)
 
     def _gauges_locked(self) -> None:
@@ -205,6 +340,10 @@ class InProcessStoreBackend(SessionStoreBackend):
                                    len(self._records))
             self.metrics.set_gauge("session_store_payload_bytes",
                                    self._payload_bytes)
+            self.metrics.set_gauge("session_store_prefixes",
+                                   len(self._prefixes))
+            self.metrics.set_gauge("prefix_tier_resident_bytes",
+                                   self._prefix_bytes)
 
     def _reap_locked(self, session: str) -> Optional[dict]:
         """The session's record, lease-checked: an expired record is
@@ -255,22 +394,35 @@ class InProcessStoreBackend(SessionStoreBackend):
                     self.metrics.inc("session_store_cas_conflicts_total")
                 return StoreResult("conflict")
             version = (rec["version"] if rec is not None else 0) + 1
-            nbytes = payload_bytes(entry.get("payload"))
+            entry = dict(entry)
+            payload = entry.get("payload")
+            pkey = payload_key(payload) if payload is not None else None
             if rec is not None:
-                self._payload_bytes -= rec["bytes"]
+                self._session_payload_drop_locked(rec)
+            if pkey is not None:
+                # content-addressed: identical bytes captured by N
+                # sessions rest once (refcounted — satellite fix)
+                entry["payload"] = self._payload_ref_locked(
+                    pkey, payload, "session"
+                )
+                nbytes = 0   # charged through the payload table
+            else:
+                nbytes = payload_bytes(payload)
+                self._payload_bytes += nbytes
             self._records[session] = {
-                "entry": dict(entry), "version": version,
+                "entry": entry, "version": version,
                 "expires": (
                     self.clock() + self.lease_s
                     if self.lease_s is not None else None
                 ),
-                "bytes": nbytes,
+                "bytes": nbytes, "pkey": pkey,
             }
             self._records.move_to_end(session)
-            self._payload_bytes += nbytes
             # byte-bounded LRU: oldest PAYLOADS drop, streams stay —
             # those sessions degrade to cold prefill on restore, which
-            # is the designed fallback, never an error
+            # is the designed fallback, never an error.  A shared
+            # payload's bytes only free when its LAST reference drops,
+            # so the walk may shed several references per overage.
             if self._payload_bytes > self.max_payload_bytes:
                 for other_session, other in self._records.items():
                     if self._payload_bytes <= self.max_payload_bytes:
@@ -278,9 +430,8 @@ class InProcessStoreBackend(SessionStoreBackend):
                     if (other_session == session
                             or other["entry"].get("payload") is None):
                         continue
-                    self._payload_bytes -= other["bytes"]
+                    self._session_payload_drop_locked(other)
                     other["entry"]["payload"] = None
-                    other["bytes"] = 0
                     if self.metrics is not None:
                         self.metrics.inc(
                             "session_store_payloads_dropped_total"
@@ -340,6 +491,139 @@ class InProcessStoreBackend(SessionStoreBackend):
             )
             return True
 
+    # -- prefix-tier namespace (PR 16) ------------------------------------
+    def _prefix_touch_locked(self, rec: dict) -> None:
+        """A hit IS heat: bump popularity, renew the TTL (immortal-
+        while-hot), and advance the recency sequence."""
+        rec["hits"] += 1
+        self._prefix_seq += 1
+        rec["last"] = self._prefix_seq
+        if self.prefix_lease_s is not None:
+            rec["expires"] = self.clock() + self.prefix_lease_s
+
+    def _prefix_evict_locked(self, chain_key: str, rec: dict) -> None:
+        self._prefixes.pop(chain_key, None)
+        for pk in rec["page_keys"]:
+            chains = self._page_index.get(pk)
+            if chains is not None:
+                chains.discard(chain_key)
+                if not chains:
+                    self._page_index.pop(pk, None)
+        self._payload_unref_locked(rec["pkey"], "prefix")
+        if self.metrics is not None:
+            self.metrics.inc("session_store_prefix_evicted_total")
+
+    def _reap_prefix_locked(self, chain_key: str) -> Optional[dict]:
+        rec = self._prefixes.get(chain_key)
+        if rec is None:
+            return None
+        if rec["expires"] is not None and self.clock() >= rec["expires"]:
+            self._prefix_evict_locked(chain_key, rec)
+            return None
+        return rec
+
+    def put_prefix(self, chain_key: str, entry: dict) -> StoreResult:
+        payload = entry.get("payload") if isinstance(entry, dict) else None
+        page_keys = [
+            str(k) for k in (entry.get("page_keys") or [])
+        ] if isinstance(entry, dict) else []
+        if payload is None or not page_keys:
+            return StoreResult("absent")
+        with self._lock:
+            rec = self._reap_prefix_locked(chain_key)
+            if rec is not None:
+                # double publish: a popularity signal, never a second
+                # copy — the payload's refcount and bytes are unchanged
+                self._prefix_touch_locked(rec)
+                pl = self._payloads.get(rec["pkey"]) or {}
+                self._gauges_locked()
+                return StoreResult("ok", {
+                    "stored": False, "hits": rec["hits"],
+                    "refs": pl.get("srefs", 0) + pl.get("prefs", 0),
+                }, 1)
+            pkey = payload_key(payload) or f"chain:{chain_key}"
+            self._payload_ref_locked(pkey, payload, "prefix")
+            self._prefix_seq += 1
+            self._prefixes[chain_key] = {
+                "pkey": pkey, "page_keys": page_keys,
+                "pages": int(entry.get("pages") or len(page_keys)),
+                # the payload rides opaquely; its wire-codec tag must
+                # ride with it or the GET side can't decode
+                "payload_codec": entry.get("payload_codec"),
+                "hits": 0, "last": self._prefix_seq,
+                "expires": (
+                    self.clock() + self.prefix_lease_s
+                    if self.prefix_lease_s is not None else None
+                ),
+            }
+            for pk in page_keys:
+                self._page_index.setdefault(pk, set()).add(chain_key)
+            # popularity-weighted LRU under the prefix byte budget: the
+            # COLDEST chain goes first (fewest hits, least recent) — a
+            # brand-new publish into a budget full of hotter chains
+            # bounces, a hot one is never the victim
+            while self._prefix_bytes > self.max_prefix_bytes and (
+                self._prefixes
+            ):
+                victim = min(
+                    self._prefixes.items(),
+                    key=lambda kv: (kv[1]["hits"], kv[1]["last"]),
+                )
+                self._prefix_evict_locked(*victim)
+            pl = self._payloads.get(pkey) or {}
+            stored = chain_key in self._prefixes
+            self._gauges_locked()
+            return StoreResult("ok", {
+                "stored": stored,
+                "refs": pl.get("srefs", 0) + pl.get("prefs", 0),
+            }, 1)
+
+    def probe_prefix(self, keys: List[str]) -> StoreResult:
+        with self._lock:
+            for j in range(len(keys) - 1, -1, -1):
+                chains = self._page_index.get(str(keys[j]))
+                if not chains:
+                    continue
+                best: Optional[Tuple[str, dict]] = None
+                for ck in list(chains):
+                    rec = self._reap_prefix_locked(ck)
+                    if rec is None:
+                        continue
+                    if best is None or (
+                        (rec["hits"], rec["last"])
+                        > (best[1]["hits"], best[1]["last"])
+                    ):
+                        best = (ck, rec)
+                if best is None:
+                    continue
+                ck, rec = best
+                self._prefix_touch_locked(rec)
+                return StoreResult("ok", {
+                    "chain": ck, "match_pages": j + 1,
+                    "pages": rec["pages"],
+                }, 1)
+            return StoreResult("absent")
+
+    def get_prefix(self, chain_key: str, meta: bool = False) -> StoreResult:
+        with self._lock:
+            rec = self._reap_prefix_locked(chain_key)
+            if rec is None:
+                return StoreResult("absent")
+            entry = {"pages": rec["pages"],
+                     "page_keys": list(rec["page_keys"]),
+                     "hits": rec["hits"]}
+            if rec.get("payload_codec") is not None:
+                entry["payload_codec"] = rec["payload_codec"]
+            if meta:
+                entry["payload_present"] = rec["pkey"] in self._payloads
+                return StoreResult("ok", entry, 1)
+            pl = self._payloads.get(rec["pkey"])
+            if pl is None:   # pragma: no cover - prefix refs pin payloads
+                return StoreResult("absent")
+            self._prefix_touch_locked(rec)
+            entry["payload"] = pl["payload"]
+            return StoreResult("ok", entry, 1)
+
     # -- chaos (soak/tests) ------------------------------------------------
     def expire_all(self) -> None:
         """Force every lease to lapse NOW (the soak's lease-expiry op)."""
@@ -357,7 +641,18 @@ class InProcessStoreBackend(SessionStoreBackend):
                     1 for r in self._records.values()
                     if r["entry"].get("payload") is not None
                 ),
+                "unique_payloads": len(self._payloads),
+                "prefixes": len(self._prefixes),
+                "prefix_bytes": self._prefix_bytes,
             }
+
+    def payload_refs(self, payload) -> int:
+        """Total references (sessions + prefixes) held on this
+        payload's content address — the dedup tests' oracle."""
+        pkey = payload_key(payload)
+        with self._lock:
+            rec = self._payloads.get(pkey) if pkey else None
+            return (rec["srefs"] + rec["prefs"]) if rec else 0
 
 
 # ---------------------------------------------------------------------------
@@ -671,6 +966,52 @@ class HttpStoreClient(SessionStoreBackend):
         except _Unreachable:
             return False
         return status == 200
+
+    # -- prefix-tier namespace --------------------------------------------
+    def put_prefix(self, chain_key: str, entry: dict) -> StoreResult:
+        try:
+            status, payload = self._call(
+                "PUT", f"/v1/prefix/{quote(chain_key, safe='')}",
+                {"entry": _encode_entry_for_wire(entry)},
+            )
+        except _Unreachable:
+            return StoreResult("unreachable")
+        if status == 200:
+            return StoreResult("ok", dict(payload), 1)
+        return StoreResult("unreachable" if status >= 500 else "absent")
+
+    def probe_prefix(self, keys: List[str]) -> StoreResult:
+        try:
+            status, payload = self._call(
+                "POST", "/v1/prefix/probe",
+                {"keys": [str(k) for k in keys]},
+            )
+        except _Unreachable:
+            return StoreResult("unreachable")
+        if status == 200:
+            return StoreResult("ok", dict(payload), 1)
+        if status == 404:
+            return StoreResult("absent")
+        return StoreResult("unreachable")
+
+    def get_prefix(self, chain_key: str, meta: bool = False) -> StoreResult:
+        try:
+            status, payload = self._call(
+                "GET",
+                f"/v1/prefix/{quote(chain_key, safe='')}"
+                + ("?meta=1" if meta else ""),
+            )
+        except _Unreachable:
+            return StoreResult("unreachable")
+        if status == 200:
+            return StoreResult(
+                "ok",
+                _decode_entry_from_wire(payload.get("entry") or {}),
+                1,
+            )
+        if status == 404:
+            return StoreResult("absent")
+        return StoreResult("unreachable")
 
     def healthy(self) -> bool:
         try:
@@ -1057,6 +1398,12 @@ def make_store_handler(backend: InProcessStoreBackend, metrics: Metrics,
                 return None
             return unquote(path[len(prefix):])
 
+        def _prefix_of(self, path: str) -> Optional[str]:
+            prefix = "/v1/prefix/"
+            if not path.startswith(prefix) or len(path) <= len(prefix):
+                return None
+            return unquote(path[len(prefix):])
+
         def do_GET(self):
             path, _, query = self.path.partition("?")
             if path == "/healthz":
@@ -1070,6 +1417,18 @@ def make_store_handler(backend: InProcessStoreBackend, metrics: Metrics,
                 replica = (parse_qs(query).get("replica") or [""])[0]
                 sessions = backend.sessions_on(replica)
                 self._send(200, {"sessions": sessions or []})
+                return
+            chain = self._prefix_of(path)
+            if chain is not None:
+                metrics.inc("session_store_requests_total",
+                            verb="prefix_get")
+                meta = (parse_qs(query).get("meta") or ["0"])[0] == "1"
+                res = backend.get_prefix(chain, meta=meta)
+                if res.status == "ok":
+                    self._send(200, {"entry": res.entry})
+                else:
+                    self._send(404, {"error": f"no prefix {chain!r}",
+                                     "reason": "absent"})
                 return
             session = self._session_of(path)
             if session is None:
@@ -1091,6 +1450,22 @@ def make_store_handler(backend: InProcessStoreBackend, metrics: Metrics,
                                  "reason": "absent"})
 
         def do_PUT(self):
+            chain = self._prefix_of(self.path.partition("?")[0])
+            if chain is not None:
+                metrics.inc("session_store_requests_total",
+                            verb="prefix_put")
+                body = self._read_json()
+                if body is None or not isinstance(body.get("entry"), dict):
+                    self._send(400, {"error": "entry required"})
+                    return
+                # the payload rides opaquely (wire-encoded by the
+                # client) — the store never decodes KV bytes
+                res = backend.put_prefix(chain, body["entry"])
+                if res.status == "ok":
+                    self._send(200, dict(res.entry or {}))
+                else:
+                    self._send(400, {"error": "unusable prefix entry"})
+                return
             session = self._session_of(self.path.partition("?")[0])
             if session is None:
                 self._send(404, {"error": f"no route {self.path}"})
@@ -1127,6 +1502,21 @@ def make_store_handler(backend: InProcessStoreBackend, metrics: Metrics,
 
         def do_POST(self):
             path = self.path.partition("?")[0]
+            if path == "/v1/prefix/probe":
+                metrics.inc("session_store_requests_total",
+                            verb="prefix_probe")
+                body = self._read_json()
+                if body is None or not isinstance(body.get("keys"), list):
+                    self._send(400, {"error": "keys required"})
+                    return
+                res = backend.probe_prefix(
+                    [str(k) for k in body["keys"]]
+                )
+                if res.status == "ok":
+                    self._send(200, dict(res.entry or {}))
+                else:
+                    self._send(404, {"reason": "absent"})
+                return
             if path == "/v1/mark":
                 metrics.inc("session_store_requests_total", verb="mark")
                 body = self._read_json()
@@ -1168,6 +1558,8 @@ class StoreServer:
                  max_sessions: int = 4096,
                  max_payload_bytes: int = 256 << 20,
                  lease_s: Optional[float] = 3600.0,
+                 max_prefix_bytes: int = 256 << 20,
+                 prefix_lease_s: Optional[float] = None,
                  metrics: Optional[Metrics] = None,
                  backend: Optional[InProcessStoreBackend] = None,
                  chaos: bool = False) -> None:
@@ -1187,6 +1579,8 @@ class StoreServer:
                 max_sessions=max_sessions,
                 max_payload_bytes=max_payload_bytes,
                 lease_s=lease_s,
+                max_prefix_bytes=max_prefix_bytes,
+                prefix_lease_s=prefix_lease_s,
                 metrics=self.metrics,
             )
         )
@@ -1251,6 +1645,19 @@ def main(argv=None) -> None:
         "<= 0 disables leasing",
     )
     ap.add_argument(
+        "--max-prefix-bytes", type=int, default=256 << 20,
+        help="retained bytes for the shared-prefix tier (a SEPARATE "
+        "budget from session payloads); over budget the COLDEST chain "
+        "evicts first — popularity-weighted LRU, a hot system prompt "
+        "is never the victim",
+    )
+    ap.add_argument(
+        "--prefix-lease", type=float, default=0.0,
+        help="prefix-chain TTL seconds, renewed on every probe hit / "
+        "fetch / re-publish (immortal-while-hot).  <= 0 disables "
+        "expiry (the default — eviction is then purely byte-budgeted)",
+    )
+    ap.add_argument(
         "--chaos", action="store_true",
         help="enable POST /v1/chaos (forced CAS conflicts, lease "
         "expiry) — soak/test harnesses only, never production",
@@ -1266,6 +1673,8 @@ def main(argv=None) -> None:
         max_sessions=args.max_sessions,
         max_payload_bytes=args.max_payload_bytes,
         lease_s=args.lease if args.lease > 0 else None,
+        max_prefix_bytes=args.max_prefix_bytes,
+        prefix_lease_s=args.prefix_lease if args.prefix_lease > 0 else None,
         chaos=args.chaos,
     )
     server.start()

@@ -94,6 +94,7 @@ class GatewayTier:
         router_factory: Optional[Callable[[], Router]] = None,
         tracer_factory: Optional[Callable[[str], object]] = None,
         session_store: Optional[SessionKVStore] = None,
+        prefix_tier=None,
         trace: bool = True,
     ) -> None:
         if gateway_ids is None:
@@ -121,6 +122,10 @@ class GatewayTier:
         self.session_store = session_store or SessionKVStore(
             metrics=self.metrics
         )
+        # ONE prefix tier across the tier, same shape: a chain published
+        # by any gateway imports through any sibling (and the advisory
+        # warmth map every PrefixLocalityRouter scores by is shared).
+        self.prefix_tier = prefix_tier
         self._lock = threading.Lock()
         self._rr = 0
         self._ring = ConsistentHashRing(gateway_ids)
@@ -149,6 +154,7 @@ class GatewayTier:
             dispatchers=self.dispatchers,
             trace=self.trace,
             session_store=self.session_store,
+            prefix_tier=self.prefix_tier,
             gateway_id=gid,
             **kwargs,
         )

@@ -379,6 +379,12 @@ class PrefixPageCache:
         self._refs: Dict[int, int] = {}
         self._key_of: Dict[int, bytes] = {}
         self._kind_of: Dict[int, str] = {}
+        # content-chain predecessor per entry (key j-1 of the same
+        # cumulative hash chain; None for a chain head) — feeds the
+        # cached-chain count in the /v1/state prefix-cache economy.
+        # Advisory: eviction can punch LRU holes mid-chain, which just
+        # splits the chain in the count, exactly as admission sees it.
+        self._prev: Dict[bytes, Optional[bytes]] = {}
 
     def lookup(self, key: bytes) -> Optional[int]:
         """Peek without taking a reference (admission feasibility)."""
@@ -392,8 +398,10 @@ class PrefixPageCache:
         self._refs[page] += 1
         return page
 
-    def insert(self, key: bytes, page: int, kind: str = "prompt") -> None:
-        """Register a freshly-sealed page; the caller holds one ref."""
+    def insert(self, key: bytes, page: int, kind: str = "prompt",
+               prev: Optional[bytes] = None) -> None:
+        """Register a freshly-sealed page; the caller holds one ref.
+        ``prev`` is the chain's preceding page key (None for page 0)."""
         assert key not in self._entries, "duplicate prefix key"
         assert page not in self._refs, "page already cached"
         assert kind in ("prompt", "decode"), f"unknown page kind {kind!r}"
@@ -401,6 +409,7 @@ class PrefixPageCache:
         self._refs[page] = 1
         self._key_of[page] = key
         self._kind_of[page] = kind
+        self._prev[key] = prev
 
     def release(self, page: int) -> None:
         self._refs[page] -= 1
@@ -424,11 +433,30 @@ class PrefixPageCache:
                 del self._refs[page]
                 del self._key_of[page]
                 del self._kind_of[page]
+                self._prev.pop(key, None)
                 return page
         return None
 
     def pages(self) -> Set[int]:
         return set(self._refs)
+
+    def chains(self) -> int:
+        """Distinct cached chains: entries no PRESENT entry names as its
+        predecessor (chain tails; divergent suffixes over one shared
+        prefix count once each, LRU holes split a chain in two — both
+        exactly how admission's longest-unbroken-prefix probe sees the
+        cache)."""
+        referenced = {
+            p for k, p in self._prev.items()
+            if k in self._entries and p is not None and p in self._entries
+        }
+        return sum(1 for k in self._entries if k not in referenced)
+
+    def pages_by_kind(self) -> Dict[str, int]:
+        out = {"prompt": 0, "decode": 0}
+        for kind in self._kind_of.values():
+            out[kind] += 1
+        return out
 
     def assert_consistent(self) -> None:
         """Internal-map alignment (the page-accounting invariant's cache
@@ -1687,7 +1715,7 @@ class PagedContinuousBatcher(_TracedBatcher):
             if self.prefix_cache.lookup(key) is not None:
                 continue  # a twin stream sealed this content first
             kind = "prompt" if j < n_prompt else "decode"
-            to_seal.append((phys, key, kind))
+            to_seal.append((phys, key, kind, keys[j - 1] if j else None))
         if not to_seal:
             return
         if self.kv_quant:
@@ -1702,7 +1730,7 @@ class PagedContinuousBatcher(_TracedBatcher):
             # already — the program is a no-op for them.  All sealing
             # pages are private here (s.shared excluded), so no reader
             # observes the rewrite mid-flight.
-            phys_list = [p for p, _, _ in to_seal]
+            phys_list = [p for p, _, _, _ in to_seal]
             width = self._page_bucket(len(phys_list))
             pv = np.zeros((width,), np.int32)
             pv[: len(phys_list)] = phys_list
@@ -1714,8 +1742,8 @@ class PagedContinuousBatcher(_TracedBatcher):
                 self.metrics.inc(
                     "serve_kv_quant_seal_requants_total", len(phys_list)
                 )
-        for phys, key, kind in to_seal:
-            self.prefix_cache.insert(key, phys, kind=kind)
+        for phys, key, kind, prev in to_seal:
+            self.prefix_cache.insert(key, phys, kind=kind, prev=prev)
             s.shared.add(phys)
             if kind == "decode":
                 self.stats["decode_pages_sealed"] += 1
@@ -1730,6 +1758,28 @@ class PagedContinuousBatcher(_TracedBatcher):
             if self.prefix_cache is not None else 0
         )
         return self.pool_pages - 1 - len(self.free_pages) - idle
+
+    def prefix_cache_stats(self) -> dict:
+        """The prefix-cache economy one replica exposes at ``/v1/state``:
+        cached chains, resident pages by kind, and the hit/miss token
+        counters split per ``prompt|decode`` kind — what the router's
+        locality scoring and the FleetController read as warmth."""
+        if self.prefix_cache is None:
+            chains, by_kind, idle = 0, {"prompt": 0, "decode": 0}, 0
+        else:
+            chains = self.prefix_cache.chains()
+            by_kind = self.prefix_cache.pages_by_kind()
+            idle = self.prefix_cache.idle_count()
+        return {
+            "chains": chains,
+            "pages": by_kind,
+            "idle_pages": idle,
+            "hit_tokens": {
+                "prompt": self.stats["prefix_hit_tokens_prompt"],
+                "decode": self.stats["prefix_hit_tokens_decode"],
+            },
+            "miss_tokens": self.stats["prefix_miss_tokens"],
+        }
 
     def assert_page_accounting(self) -> None:
         """Invariant check (tests, soak): every allocatable page is
@@ -1989,6 +2039,11 @@ class PagedContinuousBatcher(_TracedBatcher):
         self.stats["prefix_hit_tokens"] += hit_rows
         self.stats["prefix_hit_tokens_prompt"] += prompt_hit_rows
         self.stats["prefix_hit_tokens_decode"] += decode_hit_rows
+        # miss rows: sharable prompt pages the cache did NOT resolve —
+        # the prefill compute the prefix economy failed to save
+        self.stats["prefix_miss_tokens"] += (len(keys) - len(hits)) * (
+            self.page
+        )
         self.stats["prompt_tokens"] += plen
         if self.metrics is not None:
             # kind-labeled ONLY: an unlabeled sibling series in the same
@@ -2070,7 +2125,8 @@ class PagedContinuousBatcher(_TracedBatcher):
                 and self.prefix_cache.lookup(job.keys[j]) is None
             ):
                 self.prefix_cache.insert(
-                    job.keys[j], s.pages[j], kind="prompt"
+                    job.keys[j], s.pages[j], kind="prompt",
+                    prev=job.keys[j - 1] if j else None,
                 )
                 s.shared.add(s.pages[j])
         job.next_scatter = hi
@@ -2357,7 +2413,8 @@ class PagedContinuousBatcher(_TracedBatcher):
         self.stats = {
             "steps": 0, "admits": 0, "peak_pages": 0, "prefill_chunks": 0,
             "prefix_hit_tokens": 0, "prefix_hit_tokens_prompt": 0,
-            "prefix_hit_tokens_decode": 0, "prompt_tokens": 0,
+            "prefix_hit_tokens_decode": 0, "prefix_miss_tokens": 0,
+            "prompt_tokens": 0,
             "decode_pages_sealed": 0, "spec_steps": 0, "spec_tokens": 0,
             "draft_wraps": 0, "pages_exported": 0, "pages_imported": 0,
             "imports": 0, "seal_requants": 0,
@@ -2725,8 +2782,10 @@ class PagedContinuousBatcher(_TracedBatcher):
                 if self.prefix_cache.lookup(bytes.fromhex(key)) is not None:
                     continue  # belt-and-braces: never double-register a
                     # key (the hit probe above should have claimed it)
+                prev = page_keys[j - 1] if j else None
                 self.prefix_cache.insert(
-                    bytes.fromhex(key), pages[j], kind=kind
+                    bytes.fromhex(key), pages[j], kind=kind,
+                    prev=bytes.fromhex(prev) if prev else None,
                 )
                 shared.add(pages[j])
         if to_write:
@@ -2876,6 +2935,13 @@ class PagedContinuousBatcher(_TracedBatcher):
         if self.kv_quant:
             self._validate_scales(scales, len(page_keys))
         fresh: List[tuple] = []      # (payload row, pool page)
+        # Budget fixed at entry: pages we import land idle and would
+        # count as "available" again, so a live availability check
+        # never stops — past the budget, _alloc_page would evict our
+        # own chain HEAD to admit its tail, leaving a prefix with a
+        # hole that no admission lookup can walk.  Capping up front
+        # keeps the longest chain PREFIX that fits instead.
+        budget = self._available_pages(set())
         for j, keyhex in enumerate(page_keys):
             key = bytes.fromhex(keyhex)
             kind = page_kinds[j]
@@ -2884,10 +2950,14 @@ class PagedContinuousBatcher(_TracedBatcher):
             if kind == "decode" and not self._seal_decode:
                 break   # the policy gate; nothing past a skipped page
                 # can hit anyway (chain lookups stop at the first miss)
-            if self._available_pages(set()) < 1:
-                break   # partial warmth: import what fits
+            if budget < 1:
+                break   # partial warmth: the longest prefix that fits
+            budget -= 1
             page = self._alloc_page()
-            self.prefix_cache.insert(key, page, kind=kind)
+            self.prefix_cache.insert(
+                key, page, kind=kind,
+                prev=bytes.fromhex(page_keys[j - 1]) if j else None,
+            )
             self.prefix_cache.release(page)  # idle from birth: cache-owned
             fresh.append((j, page))
         if fresh:

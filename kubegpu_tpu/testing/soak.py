@@ -509,7 +509,8 @@ class GatewaySoak:
                  batcher_factory=None, multiturn: bool = False,
                  follow_prompt_cap: int = 12, http: bool = False,
                  migration: bool = False, gateways: int = 1,
-                 store_chaos: bool = False, controller: bool = False):
+                 store_chaos: bool = False, controller: bool = False,
+                 prefix_tier: bool = False, prefix_page: int = 8):
         from kubegpu_tpu.gateway import (
             AdmissionQueue, FailoverPolicy, Gateway, GatewayTier,
             HttpReplicaClient, InMemoryReplicaClient, ReplicaServer,
@@ -596,13 +597,39 @@ class GatewaySoak:
                 metrics=self.metrics,
             )
 
+        # prefix-tier lane (ISSUE 16): sealed chains publish to the
+        # store under their content hash and cold targets import before
+        # prefill, with the PrefixLocalityRouter packing traffic onto
+        # warm replicas.  The kill/revive schedule then runs over it —
+        # page accounting and I5 must hold with fleet-wide imports in
+        # the mix, and every tier failure must be a counted degradation.
+        self.prefix = None
+        router_factory = None
+        router = None
+        if prefix_tier:
+            from kubegpu_tpu.gateway import PrefixTier
+            from kubegpu_tpu.gateway.router import PrefixLocalityRouter
+
+            backend = (
+                self.session_store.backend
+                if self.session_store is not None else None
+            )
+            self.prefix = PrefixTier(
+                backend=backend, page=prefix_page, metrics=self.metrics,
+            )
+            router_factory = lambda: PrefixLocalityRouter(  # noqa: E731
+                self.prefix, metrics=self.metrics,
+            )
+            router = router_factory()
         if gateways > 1:
             self.tier = GatewayTier(
                 self.registry, self.client, n_gateways=gateways,
                 policy=policy, metrics=self.metrics, dispatchers=8,
                 queue_factory=lambda: AdmissionQueue(capacity=64),
+                router_factory=router_factory,
                 tracer_factory=_tracer,
                 session_store=self.session_store,
+                prefix_tier=self.prefix,
             )
             self.gw = None
             self.registry.refresh()
@@ -611,11 +638,13 @@ class GatewaySoak:
             self.tier = None
             self.gw = Gateway(
                 self.registry, self.client,
+                router=router,
                 queue=AdmissionQueue(capacity=64),
                 policy=policy,
                 metrics=self.metrics, dispatchers=8,
                 tracer=_tracer(),
                 session_store=self.session_store,
+                prefix_tier=self.prefix,
             )
             self.registry.refresh()
             self.gw.start()
@@ -1277,7 +1306,41 @@ class GatewaySoak:
             if check is not None:
                 check()
         self.check_store_degradation(trace)
+        self.check_prefix_tier_degradation(trace)
         self.check_traces(trace)
+
+    def check_prefix_tier_degradation(self, trace: str):
+        """Prefix-tier audit at quiescence: the async publish queue has
+        settled, and every tier failure the schedule caused (store dead
+        during a probe/fetch/publish) is a COUNTED degradation — the
+        degraded-event log and the labeled metric agree, and every
+        reason is a documented one.  I5 already proved none of them
+        became a request error."""
+        if self.prefix is None:
+            return
+        from kubegpu_tpu.gateway.prefixtier import PREFIX_DEGRADE_REASONS
+
+        assert self.prefix.flush_publishes(30.0), (
+            "prefix-tier publish queue failed to settle at quiescence"
+        )
+        log = list(self.prefix.degraded_log)
+        counted = sum(
+            self.metrics.get(
+                "gateway_prefix_tier_degraded_total", reason=r
+            )
+            for r in PREFIX_DEGRADE_REASONS
+        )
+        assert counted == len(log), (
+            f"prefix-tier degradations miscounted: metric {counted} != "
+            f"log {len(log)}\n{trace}"
+        )
+        for op, reason in log:
+            assert reason in PREFIX_DEGRADE_REASONS, (
+                f"undocumented prefix degrade reason {reason!r}\n{trace}"
+            )
+            assert op in ("probe", "fetch", "publish"), (
+                f"unknown prefix degrade op {op!r}\n{trace}"
+            )
 
     def check_store_degradation(self, trace: str):
         """Store-chaos audit: every store failure the schedule caused
@@ -1487,6 +1550,8 @@ class GatewaySoak:
             self.client.stop()
             for srv in self.servers.values():
                 srv.stop()
+            if self.prefix is not None:
+                self.prefix.close()
             if self.session_store is not None:
                 self.session_store.close()
             if self.store_server is not None and not self.store_dead:

@@ -148,6 +148,55 @@ CATALOG: Dict[str, MetricSpec] = {
     "session_store_payload_bytes": _g(
         (), "total retained sealed-KV payload bytes (bounded by "
         "--max-payload-bytes, default 256 MiB)"),
+    "session_store_payload_dedup_total": _c(
+        (), "sealed-KV payload writes answered by an existing content-"
+        "addressed record instead of a second copy (a payload captured "
+        "by N sessions and published as a prefix rests ONCE; refcounts "
+        "track the sharers)"),
+    "session_store_prefixes": _g(
+        (), "fleet prefix chains resident in the store's prefix "
+        "namespace"),
+    "session_store_prefix_evicted_total": _c(
+        (), "prefix chains evicted by the popularity-weighted LRU "
+        "(fewest probe hits first, oldest touch breaking ties) or "
+        "reaped by the idle lease — prefixes are immortal only while "
+        "hot, never leased like sessions"),
+    "prefix_tier_resident_bytes": _g(
+        (), "payload bytes the prefix namespace keeps resident (its "
+        "share of the content-addressed payload table; bounded by "
+        "--max-prefix-bytes)"),
+
+    # -- fleet-wide shared-prefix KV tier (gateway/prefixtier.py,
+    #    router.py): prefill any hot prefix once, ever
+    "gateway_prefix_tier_hits_total": _c(
+        (), "admission-time tier probes that found a stored chain "
+        "sharing at least one full page with the prompt (the payload "
+        "then imports into the target replica before prefill)"),
+    "gateway_prefix_tier_misses_total": _c(
+        (), "tier probes that found no stored prefix (cold prefill as "
+        "usual; the completion's publish may seed the tier for the "
+        "next caller)"),
+    "gateway_prefix_tier_publishes_total": _c(
+        (), "sealed chains published into the tier's prefix namespace "
+        "(post-dedup: the gateway's published-set and the store's "
+        "metadata-first probe both gate re-uploads)"),
+    "gateway_prefix_tier_imports_total": _c(
+        (), "tier payloads imported into a replica's PrefixPageCache "
+        "before prefill (the fleet-warm pages the admission then hits)"),
+    "gateway_prefix_tier_degraded_total": _c(
+        ("reason",), "tier ops resolved as counted cold prefill by "
+        "store trouble, by reason (unreachable = store down/breaker "
+        "open; error = malformed or refused op).  Never a request "
+        "error — the session-store degradation contract, applied to "
+        "prefixes"),
+    "gateway_prefix_tier_publish_drops_total": _c(
+        (), "queued publishes dropped oldest-first by the bounded "
+        "async publish queue (publishing is opportunistic seeding, "
+        "never result-path-blocking)"),
+    "gateway_prefix_route_warm_total": _c(
+        (), "requests the PrefixLocalityRouter routed by longest "
+        "locally-warm prefix instead of the consistent-hash ring "
+        "fallback (agent fleets packing onto warm replicas)"),
 
     # -- gateway streaming pass-through (gateway/server.py, failover.py)
     "gateway_stream_requests_total": _c(

@@ -1,0 +1,681 @@
+"""Fleet-wide shared-prefix KV tier (ISSUE 16).
+
+Layers under test:
+
+- chain-key parity — ``prompt_chain_keys`` computed gateway-side equals
+  the ``page_keys`` a replica's ``export_sealed_chain`` seals under, so
+  a tier probe keyed off the raw prompt hits chains the replica sealed;
+- the store's prefix namespace — payload dedup by content hash with
+  refcounted references (a payload captured by N sessions and published
+  as a prefix rests ONCE), double publish as a popularity bump (never a
+  duplicate), popularity-weighted LRU eviction (hot chains outlive
+  colder newer ones), and the longest-match probe;
+- the ``PrefixTier`` engine — publish → probe → pre-prefill import over
+  a fake client, local-warmth skip, miss accounting, and the full
+  degradation contract (store unreachable ⇒ counted cold prefill,
+  ``degraded_log`` mirroring the labeled metric, never an exception);
+- ``PrefixLocalityRouter`` — routes to the warmest replica, breaks ties
+  by least-outstanding, falls back to the consistent-hash ring when
+  nothing is warm, and drops warmth on ``forget_replica``;
+- REAL paged batchers — fp32 token identity of the tier-imported lane
+  against a local-prefill reference across page sizes x fp32/int8/bf16
+  pools, longest-prefix-that-fits under a small pool and re-import
+  through LRU holes, ``/v1/state``'s prefix-cache economy surface, and
+  page accounting after every import;
+- the chaos lane — ``GatewaySoak(prefix_tier=True)``: the kill/revive
+  schedule over paged replicas with the tier and locality router in the
+  dispatch path, ``assert_page_accounting`` at quiescence, and (with
+  ``store_chaos``) store outages resolving as counted tier degradations.
+"""
+
+import threading
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubegpu_tpu.gateway import (
+    HttpStoreClient,
+    InProcessStoreBackend,
+    PrefixTier,
+    prompt_chain_keys,
+)
+from kubegpu_tpu.gateway.prefixtier import PREFIX_DEGRADE_REASONS
+from kubegpu_tpu.gateway.router import PrefixLocalityRouter
+from kubegpu_tpu.gateway.sessionstore import payload_key
+from kubegpu_tpu.models import TransformerLM
+from kubegpu_tpu.models.paging import PagedContinuousBatcher
+from kubegpu_tpu.utils.metrics import Metrics
+
+CFG = dict(vocab_size=61, num_layers=2, num_heads=4, hidden=32, max_seq=96)
+
+_params_cache = {}
+
+
+def trained_params():
+    if "p" not in _params_cache:
+        model = TransformerLM(dtype=jnp.float32, **CFG)
+        _params_cache["p"] = model.init(
+            jax.random.PRNGKey(0), jnp.ones((2, 8), jnp.int32)
+        )["params"]
+    return _params_cache["p"]
+
+
+def make_paged(params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("prompt_pad", 48)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("pool_pages", 48)
+    kw.setdefault("dtype", jnp.float32)
+    kw.setdefault("decode_page_cache", "fp32")
+    return PagedContinuousBatcher(params, **CFG, **kw)
+
+
+class _BatcherClient:
+    """The two-verb client surface the tier drives, over named local
+    batchers — the in-process twin of ``InMemoryReplicaClient``'s
+    export_sealed/import_sealed."""
+
+    def __init__(self, batchers):
+        self.batchers = batchers
+        self.imports = []
+
+    def export_sealed(self, key, stream):
+        fn = getattr(self.batchers[key], "export_sealed_chain", None)
+        return fn(np.asarray(stream, np.int32)) if fn else None
+
+    def import_sealed(self, key, payload):
+        fn = getattr(self.batchers[key], "import_sealed_chain", None)
+        if fn is None:
+            return False
+        pages = fn(payload)
+        self.imports.append((key, pages))
+        return pages > 0
+
+
+class _CannedClient:
+    """Replays one canned sealed payload — the no-jax tier harness."""
+
+    def __init__(self, payload):
+        self.payload = payload
+        self.imports = []
+
+    def export_sealed(self, key, stream):
+        return self.payload
+
+    def import_sealed(self, key, payload):
+        self.imports.append((key, payload))
+        return True
+
+
+def canned_payload(stream, page):
+    keys = prompt_chain_keys(stream, page)
+    n = len(keys)
+    return {
+        "kind": "sealed",
+        "geometry": {"page": page, "layers": 1, "heads": 2, "head_dim": 4,
+                     "dtype": "float32", "kv_dtype": "float32",
+                     "schema": 2, "tp": 1},
+        "page_keys": keys,
+        "page_kinds": ["prompt"] * n,
+        "layers": [(np.zeros((n, page, 2, 4), np.float32),
+                    np.zeros((n, page, 2, 4), np.float32))],
+    }
+
+
+# ---------------------------------------------------------------------------
+# 1. chain-key parity: gateway-side hashing == replica-side sealing
+# ---------------------------------------------------------------------------
+
+def test_prompt_chain_keys_match_sealed_export():
+    """Keys computed from the raw token stream gateway-side must equal
+    the page_keys the replica seals (same cumulative sha256 windows) —
+    the property the whole probe path rests on."""
+    params = trained_params()
+    cb = make_paged(params)
+    rng = np.random.RandomState(3)
+    prompt = np.array(rng.randint(0, CFG["vocab_size"], size=9), np.int32)
+    out = cb.run([prompt], [8])[0]
+    stream = np.concatenate([prompt, np.asarray(out, np.int32)])
+    payload = cb.export_sealed_chain(stream)
+    # export seals COMMITTED rows only (len-1): mirror that window
+    committed = len(stream) - 1
+    want = prompt_chain_keys(stream[:committed], cb.page)
+    assert payload["page_keys"] == want
+    # the partial tail page never gets a key
+    assert len(want) == committed // cb.page
+
+
+def test_prompt_chain_keys_edges():
+    assert prompt_chain_keys([], 4) == []
+    assert prompt_chain_keys([1, 2, 3], 4) == []        # no full page
+    assert prompt_chain_keys([1, 2, 3], 0) == []        # degenerate page
+    a = prompt_chain_keys([1, 2, 3, 4, 5], 4)
+    b = prompt_chain_keys([1, 2, 3, 4, 9], 4)           # same full page
+    assert len(a) == 1 and a == b
+    c = prompt_chain_keys([1, 2, 3, 9, 5], 4)           # diverges inside
+    assert c != a
+
+
+# ---------------------------------------------------------------------------
+# 2. store: payload dedup + the prefix namespace
+# ---------------------------------------------------------------------------
+
+def sealed_entry(stream, page=4, replica="rA"):
+    payload = canned_payload(np.asarray(stream, np.int32), page)
+    return {"replica": replica, "stream": list(stream),
+            "payload": payload, "lost": False}, payload
+
+
+def test_session_payload_dedup_refcounted():
+    """The satellite bugfix: two sessions capturing byte-identical
+    payloads rest ONCE store-side — refcount 2, unique payload 1, and
+    the payload outlives either single session."""
+    b = InProcessStoreBackend()
+    e1, payload = sealed_entry([1, 2, 3, 4, 5, 6, 7, 8, 9])
+    e2, _ = sealed_entry([1, 2, 3, 4, 5, 6, 7, 8, 9], replica="rB")
+    assert b.put("s1", e1, if_version=None).status == "ok"
+    assert b.put("s2", e2, if_version=None).status == "ok"
+    assert b.payload_refs(payload) == 2
+    st = b.stats()
+    assert st["unique_payloads"] == 1
+    # one session dies: the payload survives for the other
+    b.delete("s1")
+    assert b.payload_refs(payload) == 1
+    got = b.get("s2").entry["payload"]
+    assert got["page_keys"] == payload["page_keys"]
+    b.delete("s2")
+    assert b.payload_refs(payload) == 0
+    assert b.stats()["unique_payloads"] == 0
+
+
+def test_prefix_publish_dedup_and_popularity():
+    """Double publish is a popularity bump, never a duplicate; the
+    payload is shared by refcount across the session and prefix
+    namespaces."""
+    b = InProcessStoreBackend()
+    e, payload = sealed_entry([5, 4, 3, 2, 1, 0, 6, 7, 8])
+    chain = payload["page_keys"][-1]
+    r1 = b.put_prefix(chain, {"payload": payload,
+                              "page_keys": payload["page_keys"],
+                              "pages": len(payload["page_keys"])})
+    assert r1.status == "ok" and r1.entry["stored"]
+    r2 = b.put_prefix(chain, {"payload": payload,
+                              "page_keys": payload["page_keys"],
+                              "pages": len(payload["page_keys"])})
+    assert r2.status == "ok" and not r2.entry["stored"]
+    assert b.payload_refs(payload) == 1          # prefix namespace: once
+    assert b.stats()["prefixes"] == 1
+    # a session capturing the same bytes shares the record: refs 2,
+    # unique payload still 1
+    assert b.put("s1", e, if_version=None).status == "ok"
+    assert b.payload_refs(payload) == 2
+    assert b.stats()["unique_payloads"] == 1
+    # the prefix keeps the payload alive past the session's delete
+    b.delete("s1")
+    assert b.payload_refs(payload) == 1
+    full = b.get_prefix(chain)
+    assert full.status == "ok"
+    assert full.entry["payload"]["page_keys"] == payload["page_keys"]
+
+
+def test_prefix_popularity_weighted_lru_eviction():
+    """Under byte pressure the COLDEST chain (fewest hits, oldest
+    touch) evicts first — a hot old chain outlives a cold newer one."""
+    b = InProcessStoreBackend(max_prefix_bytes=1)  # every put overflows
+    streams = ([1] * 9, [2] * 9, [3] * 9)
+    chains = []
+    for i, s in enumerate(streams):
+        _, payload = sealed_entry(s)
+        chain = payload["page_keys"][-1]
+        chains.append(chain)
+        b.put_prefix(chain, {"payload": payload,
+                             "page_keys": payload["page_keys"],
+                             "pages": len(payload["page_keys"])})
+        if i == 0:
+            # make chain 0 HOT before the next publishes arrive
+            for _ in range(3):
+                b.probe_prefix(payload["page_keys"])
+    # byte budget of 1: at most the newest/hottest survives each put;
+    # the hot chain-0 must have outlived the cold chain-1
+    assert b.get_prefix(chains[0], meta=True).status in ("ok", "absent")
+    st = b.stats()
+    assert st["prefixes"] <= 2
+    evicted = b.metrics_evictions if hasattr(b, "metrics_evictions") else None
+    # the direct oracle: chain 1 (cold, older than 2) cannot have
+    # survived while 0 and 2 are present
+    present = [
+        c for c in chains if b.get_prefix(c, meta=True).status == "ok"
+    ]
+    assert chains[1] not in present or len(present) == 1
+
+
+def test_prefix_probe_longest_match():
+    b = InProcessStoreBackend()
+    stream = [7, 7, 1, 2, 3, 4, 5, 6, 9, 9, 9, 9, 0]
+    _, payload = sealed_entry(stream)
+    chain = payload["page_keys"][-1]
+    b.put_prefix(chain, {"payload": payload,
+                         "page_keys": payload["page_keys"],
+                         "pages": len(payload["page_keys"])})
+    # a prompt sharing 2 full pages then diverging probes to match 2
+    probe_keys = prompt_chain_keys(stream[:8] + [42, 43, 44, 45], 4)
+    res = b.probe_prefix(probe_keys)
+    assert res.status == "ok"
+    assert res.entry["chain"] == chain
+    assert res.entry["match_pages"] == 2
+    assert res.entry["pages"] == 3
+    # nothing shared: absent
+    res = b.probe_prefix(prompt_chain_keys([40] * 12, 4))
+    assert res.status == "absent"
+
+
+def test_prefix_ttl_reaps_idle_chains():
+    b = InProcessStoreBackend(prefix_lease_s=0.0)  # instant lapse
+    _, payload = sealed_entry([1] * 9)
+    chain = payload["page_keys"][-1]
+    b.put_prefix(chain, {"payload": payload,
+                         "page_keys": payload["page_keys"], "pages": 2})
+    # TTL 0: the very next probe sees it reaped (immortal only while hot)
+    assert b.probe_prefix(payload["page_keys"]).status == "absent"
+    assert b.get_prefix(chain).status == "absent"
+
+
+# ---------------------------------------------------------------------------
+# 3. PrefixTier engine (no jax): publish/probe/import + degradation
+# ---------------------------------------------------------------------------
+
+def test_tier_publish_then_import_on_cold_replica():
+    metrics = Metrics()
+    tier = PrefixTier(page=4, metrics=metrics)
+    stream = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    payload = canned_payload(np.asarray(stream, np.int32), 4)
+    client = _CannedClient(payload)
+    assert tier.publish(client, "rA", stream)
+    assert metrics.get("gateway_prefix_tier_publishes_total") == 1
+    # rA sealed it: advisory warmth says rA is warm, probe skipped
+    req = SimpleNamespace(prompt=stream)
+    assert not tier.ensure_warm(req, "rA", client)
+    assert metrics.get("gateway_prefix_tier_hits_total") == 0
+    # rB is cold: probe hits, payload imports
+    assert tier.ensure_warm(req, "rB", client)
+    assert metrics.get("gateway_prefix_tier_hits_total") == 1
+    assert metrics.get("gateway_prefix_tier_imports_total") == 1
+    assert client.imports and client.imports[0][0] == "rB"
+    # now rB is warm too: the same prompt skips the probe entirely
+    assert not tier.ensure_warm(req, "rB", client)
+    assert metrics.get("gateway_prefix_tier_hits_total") == 1
+    # an unrelated prompt misses (counted)
+    assert not tier.ensure_warm(
+        SimpleNamespace(prompt=[40] * 12), "rB", client
+    )
+    assert metrics.get("gateway_prefix_tier_misses_total") == 1
+    assert tier.degraded_log == []
+    tier.close()
+
+
+def test_tier_publish_is_deduped_and_metadata_first():
+    """The second publish of the same chain (same gateway or a sibling)
+    must not re-upload the payload: the gateway's published-set gates
+    first, the store meta-GET second."""
+    metrics = Metrics()
+    backend = InProcessStoreBackend()
+    stream = [9, 8, 7, 6, 5, 4, 3, 2, 1]
+    payload = canned_payload(np.asarray(stream, np.int32), 4)
+    client = _CannedClient(payload)
+    gw1 = PrefixTier(backend=backend, page=4, metrics=metrics)
+    gw2 = PrefixTier(backend=backend, page=4, metrics=metrics)
+    assert gw1.publish(client, "rA", stream)
+    # same gateway: gated by the published set, zero store traffic
+    assert not gw1.publish(client, "rA", stream)
+    # sibling gateway: meta-GET sees it stored, skips the upload
+    assert not gw2.publish(client, "rB", stream)
+    assert metrics.get("gateway_prefix_tier_publishes_total") == 1
+    assert backend.stats()["prefixes"] == 1
+    gw1.close()
+    gw2.close()
+
+
+def test_tier_async_publish_queue_flushes():
+    metrics = Metrics()
+    tier = PrefixTier(page=4, metrics=metrics)
+    stream = [3, 1, 4, 1, 5, 9, 2, 6, 5]
+    client = _CannedClient(canned_payload(np.asarray(stream, np.int32), 4))
+    tier.publish_async(client, "rA", stream)
+    assert tier.flush_publishes(10.0)
+    assert metrics.get("gateway_prefix_tier_publishes_total") == 1
+    # re-queueing the published stream is a no-op pre-gated off the
+    # queue (chain already in the published set)
+    tier.publish_async(client, "rA", stream)
+    assert tier.flush_publishes(10.0)
+    assert metrics.get("gateway_prefix_tier_publishes_total") == 1
+    tier.close()
+
+
+def test_tier_store_outage_degrades_counted_never_raises():
+    """The degradation contract: with the store dead every probe and
+    publish resolves as a COUNTED cold prefill — log and labeled metric
+    agree, reasons are documented, nothing raises."""
+    metrics = Metrics()
+    # a port nothing listens on: connect refuses instantly
+    dead = HttpStoreClient(
+        "http://127.0.0.1:9", timeout_s=0.2, retries=0,
+        breaker_threshold=2, breaker_cooldown_s=60.0,
+    )
+    tier = PrefixTier(backend=dead, page=4, metrics=metrics)
+    stream = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    client = _CannedClient(canned_payload(np.asarray(stream, np.int32), 4))
+    req = SimpleNamespace(prompt=stream)
+    assert not tier.ensure_warm(req, "rB", client)   # probe degrades
+    assert not tier.publish(client, "rA", [11, 12, 13, 14, 15])
+    assert len(tier.degraded_log) == 2
+    ops = [op for op, _ in tier.degraded_log]
+    assert ops == ["probe", "publish"]
+    for op, reason in tier.degraded_log:
+        assert reason in PREFIX_DEGRADE_REASONS
+    counted = sum(
+        metrics.get("gateway_prefix_tier_degraded_total", reason=r)
+        for r in PREFIX_DEGRADE_REASONS
+    )
+    assert counted == len(tier.degraded_log)
+    # no hit/miss accounting polluted by the outage
+    assert metrics.get("gateway_prefix_tier_hits_total") == 0
+    assert metrics.get("gateway_prefix_tier_misses_total") == 0
+    tier.close()
+
+
+def test_tier_warmth_lifecycle():
+    tier = PrefixTier(page=4)
+    keys = prompt_chain_keys([1, 2, 3, 4, 5, 6, 7, 8, 9], 4)
+    tier.note_warm("rA", keys)
+    assert tier.warm_pages("rA", keys) == 2
+    assert tier.warm_pages("rA", keys[:1]) == 1
+    scores = tier.locality_scores([1, 2, 3, 4, 5, 6, 7, 8, 9],
+                                  ["rA", "rB"])
+    assert scores == {"rA": 2, "rB": 0}
+    tier.forget_replica("rA")
+    assert tier.warm_pages("rA", keys) == 0
+    tier.note_warm("rA", keys)
+    tier.sync_live(["rB"])       # rA left the live set
+    assert tier.warm_pages("rA", keys) == 0
+    tier.close()
+
+
+# ---------------------------------------------------------------------------
+# 4. PrefixLocalityRouter
+# ---------------------------------------------------------------------------
+
+def _replicas(*keys):
+    return [SimpleNamespace(key=k) for k in keys]
+
+
+def test_locality_router_routes_warm_falls_back_cold():
+    metrics = Metrics()
+    tier = PrefixTier(page=4, metrics=metrics)
+    router = PrefixLocalityRouter(tier, metrics=metrics)
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    keys = prompt_chain_keys(prompt, 4)
+    replicas = _replicas("rA", "rB", "rC")
+    # nothing warm: the ring fallback answers (deterministically)
+    req = SimpleNamespace(prompt=prompt, session=None, request_id="q1")
+    cold_pick = router.pick(req, replicas, {})
+    assert cold_pick is not None
+    assert metrics.get("gateway_prefix_route_warm_total") == 0
+    # warm rB: the router must route there regardless of the ring
+    tier.note_warm("rB", keys)
+    assert router.pick(req, replicas, {}).key == "rB"
+    assert metrics.get("gateway_prefix_route_warm_total") == 1
+    # equal warmth breaks by least outstanding
+    tier.note_warm("rC", keys)
+    assert router.pick(req, replicas, {"rB": 5, "rC": 1}).key == "rC"
+    # excluded warm replicas are not candidates
+    assert router.pick(
+        req, replicas, {}, exclude=frozenset({"rB", "rC"})
+    ).key == "rA"
+    # forget drops warmth (and keeps the dispatcher's mispin duck-type)
+    router.forget_replica("rB")
+    router.forget_replica("rC")
+    assert router.pick(req, replicas, {}).key == cold_pick.key
+    assert hasattr(router, "forget_replica")
+    tier.close()
+
+
+# ---------------------------------------------------------------------------
+# 5. real paged batchers: identity, longest-that-fits, /v1/state
+# ---------------------------------------------------------------------------
+
+POOLS = {
+    "fp32": dict(decode_page_cache="fp32"),
+    "int8": dict(kv_dtype="int8", decode_page_cache="quantized"),
+    "bf16": dict(dtype=jnp.bfloat16, decode_page_cache="all"),
+}
+
+
+def _tier_identity(page, pool_kw, exact_cold=True):
+    """Three lanes on the same pool config: tier-imported (replica A
+    seals a scaffold, cold replica B imports it through the tier),
+    locally-warm (replica A continues its own stream), and never-cached
+    (cache-less prefill).  Tier-imported must ALWAYS equal locally-warm
+    — the wire round-trip adds zero drift, whatever the pool dtype.
+    Where page bytes are exact against recomputation (fp32 pools, int8
+    pools whose requantization both lanes share), the never-cached lane
+    must match too; bf16 pools carry decode-computed KV whose rounding
+    legitimately differs from a fresh prefill's, so there the cache-less
+    lane is a different numerical program (same reason the local
+    multiturn identity suite runs fp32 serving only)."""
+    params = trained_params()
+    A = make_paged(params, page_size=page, **pool_kw)
+    B = make_paged(params, page_size=page, **pool_kw)
+    client = _BatcherClient({"A": A, "B": B})
+    tier = PrefixTier(page=page, metrics=Metrics())
+    rng = np.random.RandomState(11 + page)
+    scaffold = np.array(
+        rng.randint(0, CFG["vocab_size"], size=10), np.int32
+    )
+    out1 = A.run([scaffold], [10])[0]
+    stream = list(scaffold) + list(out1)
+    assert tier.publish(client, "A", stream)
+    # the agent-turn prompt: the full sealed stream + a fresh delta
+    prompt2 = np.asarray(
+        stream + [int(x) for x in rng.randint(0, CFG["vocab_size"], 3)],
+        np.int32,
+    )
+    req = SimpleNamespace(prompt=[int(t) for t in prompt2])
+    assert tier.ensure_warm(req, "B", client), "tier import refused"
+    got = B.run([prompt2], [6])[0]
+    # locally-warm lane: A still holds its own sealed pages
+    warm = A.run([prompt2], [6])[0]
+    assert A.stats["prefix_hit_tokens"] > 0
+    assert got == warm, (page, pool_kw, got, warm)
+    if exact_cold:
+        ref = make_paged(
+            params, page_size=page, prefix_cache=False, **pool_kw
+        )
+        expected = ref.run([prompt2], [6])[0]
+        assert got == expected, (page, pool_kw, got, expected)
+    # admission on B actually hit the imported pages (decode kind
+    # included — every pool here seals decode)
+    assert B.stats["prefix_hit_tokens"] > 0
+    assert B.stats["prefix_hit_tokens_decode"] > 0
+    A.assert_page_accounting()
+    B.assert_page_accounting()
+    tier.close()
+    return tier
+
+
+def test_tier_import_token_identity_fp32_page4():
+    _tier_identity(4, POOLS["fp32"])
+
+
+@pytest.mark.slow
+def test_tier_import_token_identity_matrix():
+    for page in (4, 8):
+        for name, kw in POOLS.items():
+            _tier_identity(page, dict(kw), exact_cold=(name != "bf16"))
+
+
+def test_tier_import_longest_that_fits_and_lru_holes():
+    """A cramped importer takes the longest chain PREFIX that fits
+    (never a mid-chain fragment), admission hits exactly that prefix,
+    and tokens stay identical.  Then: an LRU hole punched into a warm
+    cache re-imports through the tier and heals (import dedups present
+    pages, fills the missing one)."""
+    params = trained_params()
+    A = make_paged(params)
+    B = make_paged(params, slots=1, pool_pages=12)
+    ref = make_paged(params, prefix_cache=False)
+    client = _BatcherClient({"A": A, "B": B})
+    tier = PrefixTier(page=4, metrics=Metrics())
+    rng = np.random.RandomState(23)
+    scaffold = np.array(rng.randint(0, CFG["vocab_size"], size=12),
+                        np.int32)
+    out1 = A.run([scaffold], [12])[0]
+    stream = list(scaffold) + list(out1)
+    assert tier.publish(client, "A", stream)
+    n_chain = (len(stream) - 1) // 4
+    # squeeze B's pool mid-import: hold all but 3 free pages so the
+    # importer's budget is 3 of the 5-page chain (restored after)
+    held = [B.free_pages.pop() for _ in range(len(B.free_pages) - 3)]
+    req = SimpleNamespace(prompt=stream)
+    assert tier.ensure_warm(req, "B", client)
+    B.free_pages.update(held)
+    imported = client.imports[-1][1]
+    assert 0 < imported < n_chain, (
+        f"expected a partial import, got {imported}/{n_chain}"
+    )
+    assert imported == 3
+    # the imported pages are the chain's PREFIX: admission hits exactly
+    # imported*page rows and recomputes the tail
+    prompt2 = np.asarray(stream, np.int32)
+    expected = ref.run([prompt2], [5])[0]
+    got = B.run([prompt2], [5])[0]
+    assert got == expected
+    assert B.stats["prefix_hit_tokens"] == imported * 4
+    B.assert_page_accounting()
+
+    # -- LRU hole: evict one mid-chain page from a ROOMY warm cache ----
+    C = make_paged(params, pool_pages=48)
+    client2 = _BatcherClient({"A": A, "C": C})
+    req2 = SimpleNamespace(prompt=stream)
+    assert tier.ensure_warm(req2, "C", client2)
+    full = client2.imports[-1][1]
+    assert full == n_chain
+    # punch the hole: pin every idle page except the second, evict it
+    cache = C.prefix_cache
+    keys = [k for k in cache._entries]
+    hole_key = keys[1]
+    pinned = [cache.acquire(k) for k in keys if k != hole_key]
+    hole_page = cache.evict_lru()             # the hole
+    assert hole_page is not None
+    C.free_pages.add(hole_page)               # eviction frees the page
+    for p in pinned:
+        cache.release(p)
+    # the tier still believes C warm — a replica lifecycle event resets
+    # that (advisory map), after which the probe re-imports and heals
+    tier.forget_replica("C")
+    assert tier.ensure_warm(req2, "C", client2)
+    healed = client2.imports[-1][1]
+    assert healed == 1, "re-import must fill exactly the hole"
+    got = C.run([prompt2], [5])[0]
+    assert got == expected
+    assert C.stats["prefix_hit_tokens"] == n_chain * 4
+    C.assert_page_accounting()
+    tier.close()
+
+
+def test_v1_state_grows_prefix_cache_economy():
+    """The warmth surface: /v1/state exposes cached chains, pages by
+    kind, and hit/miss tokens split per prompt|decode kind."""
+    from kubegpu_tpu.gateway.dataplane import ReplicaServingLoop
+
+    params = trained_params()
+    cb = make_paged(params)
+    rng = np.random.RandomState(7)
+    t1 = np.array(rng.randint(0, CFG["vocab_size"], size=9), np.int32)
+    out1 = cb.run([t1], [8])[0]
+    turn2 = np.concatenate([t1, np.asarray(out1, np.int32),
+                            np.array([5, 6], np.int32)])
+    cb.run([turn2], [4])
+    econ = cb.prefix_cache_stats()
+    assert econ["chains"] >= 1
+    assert econ["pages"]["prompt"] > 0
+    assert econ["pages"]["decode"] > 0
+    assert econ["hit_tokens"]["prompt"] > 0
+    assert econ["hit_tokens"]["decode"] > 0
+    assert set(econ) == {"chains", "pages", "idle_pages", "hit_tokens",
+                         "miss_tokens"}
+    # ...and it rides the wire surface
+    loop = ReplicaServingLoop(cb)
+    state = loop.state()
+    assert state["prefix_cache"] == econ
+    # stats carries the new miss counter too (turn 1 was all misses)
+    assert state["stats"]["prefix_miss_tokens"] >= 0
+    cb.assert_page_accounting()
+
+
+def test_prefix_cache_chain_count_with_divergence_and_holes():
+    from kubegpu_tpu.models.paging import PrefixPageCache
+
+    c = PrefixPageCache()
+    c.insert(b"a", 1, kind="prompt", prev=None)
+    c.insert(b"b", 2, kind="prompt", prev=b"a")
+    assert c.chains() == 1
+    c.insert(b"c", 3, kind="decode", prev=b"b")
+    c.insert(b"d", 4, kind="decode", prev=b"b")     # divergent suffixes
+    assert c.chains() == 2
+    assert c.pages_by_kind() == {"prompt": 2, "decode": 2}
+    # a hole splits the chain exactly as admission would see it
+    for p in (1, 2, 3, 4):
+        c.release(p)
+    c.acquire(b"a")
+    c.acquire(b"c")
+    c.acquire(b"d")
+    assert c.evict_lru() == 2                        # b evicts
+    assert c.chains() == 3
+
+
+# ---------------------------------------------------------------------------
+# 6. the chaos lane: GatewaySoak(prefix_tier=True)
+# ---------------------------------------------------------------------------
+
+def test_gateway_soak_prefix_tier_inmemory():
+    """The tier + locality router in the dispatch path over SimBatcher
+    replicas (no sealed verbs: publishes no-op cleanly) under the kill
+    schedule — I5 and zero degradations must hold."""
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    GatewaySoak(
+        seed=1601, n_replicas=3, gateways=2, prefix_tier=True,
+    ).run(25)
+
+
+@pytest.mark.slow
+def test_gateway_soak_prefix_tier_paged_store_chaos():
+    """The acceptance lane: paged replicas sealing real chains, the
+    tier publishing/importing through a REAL external store that dies
+    and revives mid-schedule, the locality router routing by warmth —
+    kill/revive replicas throughout.  At quiescence: I5, page
+    accounting on every surviving pool, and every tier failure counted
+    as a degradation."""
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    cfg = dict(vocab_size=64, num_layers=1, num_heads=2, hidden=16,
+               max_seq=64)
+    params = TransformerLM(dtype=jnp.float32, **cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32)
+    )["params"]
+
+    def factory(key):
+        return PagedContinuousBatcher(
+            params, dtype=jnp.float32, slots=4, prompt_pad=16,
+            page_size=4, pool_pages=48, decode_page_cache="fp32", **cfg,
+        )
+
+    GatewaySoak(
+        seed=1607, n_replicas=2, batcher_factory=factory,
+        multiturn=True, follow_prompt_cap=16, store_chaos=True,
+        prefix_tier=True, prefix_page=4,
+    ).run(25)
