@@ -126,6 +126,12 @@ multichip-smoke:
 # the store under its content hash, and the COLD replica B imports it
 # pre-prefill (decode-page hit tokens > 0, token-identical to the
 # warm-local reference)
+# dryrun_disaggregation: prefill/decode role split over REAL worker
+# subprocesses — one spawned --role prefill, one --role decode; a
+# RAG-length prompt seals and PARKS on the prefill replica (zero tokens
+# streamed), hands off over the wire verbs to the decode replica, and
+# the same attempt streams to the end token-identical to a co-located
+# reference (prefill worker flipped to flex over POST /v1/role)
 # dryrun_controller: the self-reshaping fleet over a REAL subprocess
 # worker fleet — a surge's reconcile tick gang-schedules a second
 # serving pod by preempting a batch pod (checkpoint-and-requeue), the
@@ -140,6 +146,7 @@ dryrun:
 	  g.dryrun_http_serving(); g.dryrun_kv_migration(); \
 	  g.dryrun_quantized_serving(); \
 	  g.dryrun_gateway_pods(); g.dryrun_prefix_tier(); \
+	  g.dryrun_disaggregation(); \
 	  g.dryrun_controller(); \
 	  g.dryrun_multichip(8)"
 

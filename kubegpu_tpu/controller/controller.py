@@ -69,6 +69,19 @@ class ControllerConfig:
     # cooldown: the diurnal shoulder must not saw-tooth the fleet
     flap_window_s: float = 120.0
     drain_grace_s: float = 30.0      # un-migratable work gets this long
+    # -- prefill:decode ratio actuator (disaggregation) --------------------
+    ratio_enabled: bool = False      # role reshaping on/off
+    itl_target_s: float = 0.05       # per-token latency budget (ITL term)
+    ratio_up_ticks: int = 2          # TTFT-pressure ticks before flex→prefill
+    ratio_down_ticks: int = 2        # ITL-pressure ticks before prefill→flex
+    ratio_cooldown_s: float = 10.0   # min seconds between role reshapes
+    max_prefill_fraction: float = 0.5   # prefill pool ceiling
+    # handoff health gates the whole mode: when more than this fraction
+    # of the window's handoffs fell back or failed, handoff capacity IS
+    # the bottleneck — collapse to co-located (the brownout ladder's
+    # disaggregation rung)
+    handoff_fail_fraction: float = 0.5
+    collapse_clear_ticks: int = 5    # clean ticks before re-arming
     # -- brownout ladder ---------------------------------------------------
     brownout_threshold: float = 2.0  # pressure with nowhere to grow
     brownout_clear_threshold: float = 0.8
@@ -164,6 +177,13 @@ class FleetController:
         self._drains: Dict[str, float] = {}
         self._clear_ticks = 0
         self._last_brownout_change: Optional[float] = None
+        # ratio actuator state (disaggregation)
+        self._ttft_ticks = 0
+        self._itl_ticks = 0
+        self._last_ratio_at: Optional[float] = None
+        self._collapsed = False
+        self._collapse_clear = 0
+        self._prev_handoffs: Dict[str, float] = {}
         self._resume()
 
     # -- crash-resume ------------------------------------------------------
@@ -250,6 +270,9 @@ class FleetController:
         action = ""
         if not self._drains:
             action = self._decide(sample, now)
+        role_action = ""
+        if cfg.ratio_enabled and not self._drains:
+            role_action = self._ratio_tick(sample, now)
         self._brownout_tick(pressure, sample, now)
         desired = sample.routable + (
             1 if action == "up" else -1 if action == "down" else 0
@@ -260,6 +283,7 @@ class FleetController:
             "routable": sample.routable,
             "queue_depth": sample.queue_depth,
             "action": action,
+            "role_action": role_action,
             "draining": sorted(self._drains),
             "brownout": self._brownout,
             "requeued_bound": requeued_bound,
@@ -578,6 +602,165 @@ class FleetController:
             self.api.delete_pod(ns, name)
         except (NotFound, KeyError):
             pass
+
+    # -- prefill:decode ratio actuator (disaggregation) --------------------
+    def _set_role(self, key: str, role: str) -> bool:
+        """Apply one role flip everywhere it lives: the pod annotation
+        (the registry's durable source of truth — a restarted controller
+        re-reads the fleet's ratio from it) AND the running replica's
+        serving loop (so the batcher's prefill-only mode flips without a
+        pod restart)."""
+        ok = True
+        try:
+            self.registry.set_role(key, role)
+        except Exception:  # noqa: BLE001 - annotation patch is advisory
+            log.exception("registry set_role failed for %s", key)
+            ok = False
+        push = getattr(self.client, "set_role", None)
+        if push is not None:
+            try:
+                if not push(key, role):
+                    ok = False
+            except Exception:  # noqa: BLE001 - live flip is advisory
+                log.exception("client set_role failed for %s", key)
+                ok = False
+        return ok
+
+    def _set_disagg(self, enabled: bool) -> None:
+        for gw in self._gateways():
+            fn = getattr(gw, "set_disaggregation", None)
+            if fn is not None:
+                fn(enabled)
+
+    def _handoff_window(self) -> Tuple[float, float]:
+        """This tick's handoff outcomes (diff of the gateway counters,
+        same window discipline as the observer's TTFT): (ok, degraded)
+        where degraded = fallbacks + failures."""
+        cur = {
+            o: self.metrics.get("gateway_phase_handoff_total", outcome=o)
+            for o in ("ok", "fallback", "failed")
+        }
+        prev, self._prev_handoffs = self._prev_handoffs, cur
+        d = {o: max(0.0, cur[o] - prev.get(o, 0.0)) for o in cur}
+        return d["ok"], d["fallback"] + d["failed"]
+
+    def _ratio_tick(self, sample, now: float) -> str:
+        """The second actuator: reshape the prefill:decode RATIO from
+        the same pressure signal that drives replica count.  TTFT
+        pressure (prompts queueing for prefill) shifts a flex replica
+        toward prefill; ITL pressure (decode iterations starving)
+        returns one toward decode.  A degraded handoff window — most
+        handoffs falling back or failing — means handoff capacity is
+        the bottleneck, and the mode COLLAPSES to co-located: every
+        prefill role reverts to flex and the dispatcher resolves any
+        straggler seals locally; it re-arms after a clean stretch."""
+        cfg = self.config
+        routable = self.registry.routable()
+        prefill = [
+            r for r in routable
+            if getattr(r, "role", "flex") == "prefill"
+        ]
+        flex = [
+            r for r in routable if getattr(r, "role", "flex") == "flex"
+        ]
+        self.metrics.set_gauge(
+            "controller_prefill_replicas", len(prefill)
+        )
+        ok_n, bad_n = self._handoff_window()
+        if self._collapsed:
+            if bad_n == 0:
+                self._collapse_clear += 1
+                if self._collapse_clear >= cfg.collapse_clear_ticks:
+                    self._set_disagg(True)
+                    self._collapsed = False
+                    self._collapse_clear = 0
+                    log.info("disaggregation re-armed")
+            else:
+                self._collapse_clear = 0
+            return ""
+        total = ok_n + bad_n
+        if total > 0 and bad_n / total > cfg.handoff_fail_fraction:
+            for r in prefill:
+                self._set_role(r.key, "flex")
+            self._set_disagg(False)
+            self._collapsed = True
+            self._collapse_clear = 0
+            self._ttft_ticks = self._itl_ticks = 0
+            self._last_ratio_at = now
+            self.metrics.inc(
+                "controller_role_reshapes_total", dir="collapse"
+            )
+            log.info(
+                "disaggregation collapsed to co-located "
+                "(handoffs degraded: %d/%d)", int(bad_n), int(total),
+            )
+            return "collapse"
+        # pressure terms, mutually exclusive by construction: a tick
+        # where BOTH are hot is a capacity problem (the replica-count
+        # actuator's job), not a ratio problem
+        ttft_hot = (
+            sample.completed > 0
+            and sample.ttft_mean_s >= cfg.ttft_target_s
+        )
+        itl_hot = (
+            sample.completed > 0
+            and sample.itl_mean_s >= cfg.itl_target_s
+        )
+        self._ttft_ticks = (
+            self._ttft_ticks + 1 if ttft_hot and not itl_hot else 0
+        )
+        self._itl_ticks = (
+            self._itl_ticks + 1 if itl_hot and not ttft_hot else 0
+        )
+        if (
+            self._last_ratio_at is not None
+            and now - self._last_ratio_at < cfg.ratio_cooldown_s
+        ):
+            return ""
+        max_prefill = max(
+            1, int(cfg.max_prefill_fraction * len(routable))
+        )
+        outstanding = self._outstanding()
+        if (
+            self._ttft_ticks >= cfg.ratio_up_ticks
+            and flex
+            and len(prefill) < max_prefill
+            # never strand decode: at least one non-prefill must remain
+            # AFTER the flip
+            and len(routable) - len(prefill) > 1
+        ):
+            victim = min(
+                flex, key=lambda r: (outstanding.get(r.key, 0), r.key)
+            )
+            if self._set_role(victim.key, "prefill"):
+                self._ttft_ticks = 0
+                self._last_ratio_at = now
+                self.metrics.inc(
+                    "controller_role_reshapes_total", dir="prefill"
+                )
+                self.metrics.set_gauge(
+                    "controller_prefill_replicas", len(prefill) + 1
+                )
+                log.info("role reshape: %s -> prefill", victim.key)
+                return "prefill"
+            return ""
+        if self._itl_ticks >= cfg.ratio_down_ticks and prefill:
+            victim = min(
+                prefill,
+                key=lambda r: (outstanding.get(r.key, 0), r.key),
+            )
+            if self._set_role(victim.key, "flex"):
+                self._itl_ticks = 0
+                self._last_ratio_at = now
+                self.metrics.inc(
+                    "controller_role_reshapes_total", dir="decode"
+                )
+                self.metrics.set_gauge(
+                    "controller_prefill_replicas", len(prefill) - 1
+                )
+                log.info("role reshape: %s -> flex (decode)", victim.key)
+                return "decode"
+        return ""
 
     # -- brownout ladder ---------------------------------------------------
     def _brownout_tick(self, pressure: float, sample, now: float) -> None:

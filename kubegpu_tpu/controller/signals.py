@@ -53,6 +53,7 @@ class SignalSample:
     routable: int = 0             # replicas new admissions may land on
     draining: int = 0             # replicas mid-drain (still serving)
     ttft_mean_s: float = 0.0      # recent-window mean; 0 when no completions
+    itl_mean_s: float = 0.0       # recent-window mean inter-token latency
     completed: int = 0            # completions in the window
     ledger_util: float = 0.0      # max replica token-budget saturation [0,1]
 
@@ -72,6 +73,10 @@ class FleetObserver:
         self.client = client
         self._prev_count = None
         self._prev_sum = 0.0
+        # the ITL window, same diff discipline (unlabeled aggregate —
+        # the role-labeled series are independent and excluded)
+        self._prev_itl_count = None
+        self._prev_itl_sum = 0.0
 
     def gateways(self) -> List[object]:
         tier = getattr(self.gateway, "gateways", None)
@@ -112,6 +117,14 @@ class FleetObserver:
             d_count = max(0, count - self._prev_count)
             d_sum = max(0.0, total - self._prev_sum)
         self._prev_count, self._prev_sum = count, total
+        itl_count = self.metrics.histogram_count("gateway_itl_seconds")
+        itl_total = self.metrics.histogram_sum("gateway_itl_seconds")
+        if self._prev_itl_count is None:
+            di_count, di_sum = 0, 0.0
+        else:
+            di_count = max(0, itl_count - self._prev_itl_count)
+            di_sum = max(0.0, itl_total - self._prev_itl_sum)
+        self._prev_itl_count, self._prev_itl_sum = itl_count, itl_total
         routable = len(self.registry.routable())
         draining = len(self.registry.draining_keys())
         return SignalSample(
@@ -120,6 +133,7 @@ class FleetObserver:
             routable=routable,
             draining=draining,
             ttft_mean_s=(d_sum / d_count) if d_count else 0.0,
+            itl_mean_s=(di_sum / di_count) if di_count else 0.0,
             completed=d_count,
             ledger_util=self._ledger_util(),
         )

@@ -59,6 +59,20 @@ def _sniff_takes_trace(batcher, method: str = "submit") -> bool:
     return _sniff_takes(batcher, method, "trace")
 
 
+def _payload_nbytes(payload: dict) -> int:
+    """Approximate transfer size of a KV payload over the in-memory
+    plane (no wire): the page/scale array bytes plus a nominal cursor
+    overhead — the handoff wire-bytes metric's in-process stand-in,
+    same order as the HTTP codec's encoded body."""
+    total = 256   # cursor fields (tokens, keys, geometry) — nominal
+    for sect in ("layers", "scales"):
+        for k_arr, v_arr in payload.get(sect) or []:
+            total += int(getattr(k_arr, "nbytes", 0) or 0)
+            total += int(getattr(v_arr, "nbytes", 0) or 0)
+    total += 4 * len(payload.get("tokens") or [])
+    return total
+
+
 def sim_stream_seed(prompt) -> int:
     """Request-deterministic stream seed for the SimBatcher mill.
 
@@ -111,6 +125,18 @@ class Attempt:
         # (set by the data-plane client that applied the watermark —
         # the StreamRelay indexes deltas with it)
         self.stream_base = 0
+        # prefill/decode disaggregation: set by the replica when the
+        # prompt's pages SEAL with zero tokens emitted (a prefill-only
+        # batcher parked the sequence) — the dispatcher reacts by
+        # handing the sequence off to a decode replica over the
+        # migration verbs.  Sealed ⇒ parked ⇒ a handoff is REQUIRED:
+        # the sequence decodes nowhere until one lands (or falls back)
+        self.sealed = threading.Event()
+        # where the handoff ended up: "" (none yet) | "ok" (decode
+        # replica took it) | "fallback" (decode side refused/died;
+        # the sequence resumed ON the prefill replica)
+        self.handoff_outcome = ""
+        self.handoff_wire_bytes = 0
         self._done = threading.Event()
         self._result: Optional[AttemptResult] = None
         self._lock = threading.Lock()
@@ -165,14 +191,18 @@ class ReplicaClient:
         return []
 
     def migrate(self, attempt: Attempt, request, to_key: str,
-                _between: Optional[Callable[[], None]] = None) -> bool:
+                _between: Optional[Callable[[], None]] = None,
+                fallback: bool = False) -> bool:
         """Move a live in-flight sequence to another replica: export +
         detach at the source, import + resume at the target; the SAME
         attempt handle keeps streaming and eventually resolves with the
         full token list.  False = migration not possible (the sequence
         stays where it was, or normal failover takes over).  ``_between``
         is a fault-injection hook invoked between the export and the
-        import dispatch (the soak's kill-mid-migration schedules)."""
+        import dispatch (the soak's kill-mid-migration schedules).
+        ``fallback`` (the post-prefill handoff contract): an import
+        refusal/death re-imports the held payload into the SOURCE and
+        resumes decode there instead of erroring the attempt."""
         return False
 
     def export_sealed(self, replica_key: str, stream) -> Optional[dict]:
@@ -248,7 +278,7 @@ class SimBatcher:
                  speculate_k: Optional[int] = None,
                  decode_page_cache: str = "off",
                  kv_dtype: Optional[str] = None,
-                 tp: int = 1) -> None:
+                 tp: int = 1, prefill_only: bool = False) -> None:
         if token_budget is not None and token_budget <= 0:
             raise ValueError(
                 f"token_budget ({token_budget}) must be positive or None"
@@ -303,6 +333,16 @@ class SimBatcher:
         # payload — an imported sequence continues the ORIGINAL mill's
         # stream even though the importer assigned it a fresh seq id
         self._seed: Dict[int, int] = {}      # seq -> stream seed
+        # prefill-only serving mode (disaggregation): the mill's
+        # "prefill" is instant, so a fresh admission SEALS immediately
+        # and PARKS — it never enters the decode ring until the gateway
+        # exports it (drain_sealed surfaces the seq ids) or the mode
+        # flips off.  Imported sequences bypass parking: the fallback
+        # contract resumes decode HERE when the decode side refuses.
+        self.prefill_only = bool(prefill_only)
+        self._parked: set = set()            # parked (sealed) seq ids
+        self._imported: set = set()          # seqs that arrived via import
+        self._sealed_pending: List[int] = [] # sealed-but-unannounced
         self.stats = {"steps": 0, "admits": 0, "imports": 0}
 
     def submit(self, seq_id: int, prompt, max_new: int,
@@ -352,8 +392,16 @@ class SimBatcher:
         if self._active.pop(seq_id, None) is None:
             return False
         # drop the ring entry too: a stale entry would double-count a
-        # re-submitted seq_id against the budget forever
-        self._rr.remove(seq_id)
+        # re-submitted seq_id against the budget forever (a PARKED
+        # sequence never entered the ring — the export+detach path
+        # cancels exactly these)
+        if seq_id in self._parked:
+            self._parked.discard(seq_id)
+            if seq_id in self._sealed_pending:
+                self._sealed_pending.remove(seq_id)
+        else:
+            self._rr.remove(seq_id)
+        self._imported.discard(seq_id)
         self._seed.pop(seq_id, None)
         if seq_id in self._spans:
             self._trace_end(self._spans.pop(seq_id), "cancelled")
@@ -397,6 +445,10 @@ class SimBatcher:
             list(payload["tokens"]), int(payload["max_new"])
         )
         self._seed[seq_id] = int(payload["seed"])
+        # imported sequences DECODE even in prefill-only mode: the
+        # handoff-fallback contract resumes a refused sequence on the
+        # prefill replica that exported it
+        self._imported.add(seq_id)
         self._rr.append(seq_id)
         self.stats["imports"] += 1
         if trace is not None:
@@ -420,6 +472,29 @@ class SimBatcher:
             new = min(self._spec_configured, int(cap))
         changed = new != self.speculate_k
         self.speculate_k = new
+        return changed
+
+    # -- disaggregation verbs (duck-typed; the paged batcher's twins) ------
+    def drain_sealed(self) -> List[int]:
+        """Seq ids whose prompts sealed with zero tokens emitted since
+        the last drain — the serving loop announces each one upstream
+        exactly once (the dispatcher's handoff trigger)."""
+        out, self._sealed_pending = self._sealed_pending, []
+        return out
+
+    def set_prefill_only(self, flag: bool) -> bool:
+        """Flip the serving mode live (the controller's role actuator).
+        Disabling UNPARKS every sealed sequence into the decode ring —
+        collapse-to-colocated must never strand a parked stream."""
+        flag = bool(flag)
+        changed = flag != self.prefill_only
+        self.prefill_only = flag
+        if not flag:
+            for seq in sorted(self._parked):
+                if seq in self._active:
+                    self._rr.append(seq)
+            self._parked.clear()
+            self._sealed_pending = []
         return changed
 
     def has_work(self) -> bool:
@@ -449,8 +524,21 @@ class SimBatcher:
             else:
                 # a re-submitted still-active seq restarts its stream but
                 # must NOT gain a second ring entry (double budget draw)
-                if seq not in self._active:
+                park = self.prefill_only and seq not in self._imported
+                in_ring = seq in self._active and seq not in self._parked
+                if park and in_ring:
+                    self._rr.remove(seq)
+                elif not park and not in_ring:
                     self._rr.append(seq)
+                if park:
+                    # prefill-only: the mill's prefill is instant, so
+                    # the prompt seals AT admission and the sequence
+                    # parks awaiting its handoff export
+                    self._parked.add(seq)
+                    if seq not in self._sealed_pending:
+                        self._sealed_pending.append(seq)
+                else:
+                    self._parked.discard(seq)
                 self._active[seq] = ([], max_new)
                 self._seed[seq] = seed
         if self._active:
@@ -625,6 +713,14 @@ class _ReplicaWorker:
             # must not block submission/cancel delivery
             finished = self.batcher.serve_step()
             self._flush_sinks()
+            # disaggregation: announce freshly-sealed (parked) sequences
+            # to their attempt handles — the dispatcher's handoff trigger
+            drain = getattr(self.batcher, "drain_sealed", None)
+            if drain is not None:
+                for seq in drain():
+                    a = self.by_seq.get(seq)
+                    if a is not None:
+                        a.sealed.set()
             for seq, tokens in finished.items():
                 # flush the tail BEFORE dropping by_seq: the sink gets
                 # its attempt handle alongside the final delta
@@ -860,6 +956,26 @@ class InMemoryReplicaClient(ReplicaClient):
         if worker is not None:
             worker.fail_migration = flag
 
+    def set_role(self, key: str, role: str) -> bool:
+        """Disaggregation role actuator over the in-memory plane: flip
+        one replica's batcher into (or out of) prefill-only serving
+        mode, ON the serving thread.  Duck-typed — batchers without
+        ``set_prefill_only`` (the dense batcher) stay co-located and
+        report False.  The FleetController's ratio actuator pairs this
+        with the registry's POD_ROLE annotation patch."""
+        with self._lock:
+            worker = self._workers.get(key)
+        if worker is None:
+            return False
+        fn = getattr(worker.batcher, "set_prefill_only", None)
+        if fn is None:
+            return False
+        try:
+            worker.control(lambda: fn(role == "prefill"))
+            return True
+        except Exception:  # noqa: BLE001 - advisory knob
+            return False
+
     def set_speculation(self, cap: Optional[int]) -> int:
         """Brownout rung 2 over the in-memory plane: apply a live
         speculation cap on every replica whose batcher supports one
@@ -929,25 +1045,40 @@ class InMemoryReplicaClient(ReplicaClient):
             return False
 
     def migrate(self, attempt: Attempt, request, to_key: str,
-                _between: Optional[Callable[[], None]] = None) -> bool:
+                _between: Optional[Callable[[], None]] = None,
+                fallback: bool = False) -> bool:
         """Live migration over the in-memory plane: export + detach on
         the source worker's thread (atomic — no step can interleave),
         then import + re-register the SAME attempt on the target's.  A
         failed export leaves the sequence serving where it was; a
         failed import resolves the attempt with an error so normal
-        failover re-dispatches it (cold — graceful, never wrong)."""
+        failover re-dispatches it (cold — graceful, never wrong) —
+        UNLESS ``fallback`` is set (the post-prefill handoff contract):
+        then the held payload re-imports into the SOURCE, the sequence
+        resumes decode where it prefilled, and the caller sees no error
+        (``attempt.handoff_outcome`` says which way it went).  The
+        source==target degenerate case is allowed under ``fallback``
+        (detach-and-resume locally: a prefill replica with no decode
+        peer unparks its own sequence through the same verb pair)."""
         with self._lock:
             src = self._workers.get(attempt.replica)
             dst = self._workers.get(to_key)
-        if src is None or dst is None or src is dst or attempt.done:
+        if src is None or dst is None or attempt.done:
+            return False
+        if src is dst and not fallback:
             return False
         if not hasattr(src.batcher, "export_pages") or not hasattr(
             dst.batcher, "import_pages"
         ):
             return False
         trace = getattr(request, "trace", None)
+        # overhang_ok: the migrated continuation's serve subtree nests
+        # under this span, and its teardown may land after a hedge twin
+        # already closed the request root — the same asynchrony the
+        # dispatch spans carry
         mspan = (
-            trace.child("migrate", source=attempt.replica, target=to_key)
+            trace.child("migrate", source=attempt.replica, target=to_key,
+                        overhang_ok=True)
             if trace is not None else None
         )
         attempt._migrating = True
@@ -977,23 +1108,52 @@ class InMemoryReplicaClient(ReplicaClient):
         if _between is not None:
             _between()   # fault injection: kill-mid-migration schedules
 
-        def import_op():
-            if dst.fail_migration:
-                raise RuntimeError("migration refused (chaos knob)")
-            seq = dst._next_seq
-            dst._next_seq += 1
-            dst.batcher.import_pages(
-                seq, payload, trace=getattr(request, "trace", None)
-            )
-            dst.by_seq[seq] = attempt
-            sink = getattr(request, "on_tokens", None)
-            if sink is not None:
-                dst.sinks[seq] = sink
-                dst.emitted[seq] = len(payload.get("tokens") or [])
+        attempt.handoff_wire_bytes = _payload_nbytes(payload)
+
+        def import_into(w: "_ReplicaWorker", chaos: bool):
+            def op():
+                if chaos and w.fail_migration:
+                    raise RuntimeError("migration refused (chaos knob)")
+                seq = w._next_seq
+                w._next_seq += 1
+                # the continuation's serve subtree nests under the
+                # migrate span (overhang-exempt): its teardown may
+                # legitimately outlive the request root
+                w.batcher.import_pages(seq, payload, trace=mspan)
+                w.by_seq[seq] = attempt
+                sink = getattr(request, "on_tokens", None)
+                if sink is not None:
+                    w.sinks[seq] = sink
+                    w.emitted[seq] = len(payload.get("tokens") or [])
+
+            w.control(op)
 
         try:
-            dst.control(import_op)
+            import_into(dst, chaos=True)
         except Exception as e:  # noqa: BLE001 - import failure = result
+            if fallback:
+                # the handoff-fallback contract: the decode side refused
+                # (pool pressure, dtype skew, chaos) or died between
+                # export and import ack — resume decode ON the source.
+                # The source's import_pages marks the sequence imported,
+                # so a prefill-only batcher decodes it instead of
+                # re-parking (never a request error).
+                try:
+                    import_into(src, chaos=False)
+                except Exception as e2:  # noqa: BLE001 - now it IS one
+                    attempt.finish(AttemptResult(
+                        False,
+                        error=f"migration import failed: {e}; "
+                              f"fallback failed: {e2}",
+                    ))
+                    if mspan is not None:
+                        mspan.end(outcome="fallback_failed")
+                    return False
+                attempt.handoff_outcome = "fallback"
+                if mspan is not None:
+                    mspan.end(outcome="fallback",
+                              pages=len(payload.get("page_keys") or []))
+                return True
             attempt.finish(AttemptResult(
                 False, error=f"migration import failed: {e}"
             ))
@@ -1001,6 +1161,12 @@ class InMemoryReplicaClient(ReplicaClient):
                 mspan.end(outcome="import_failed")
             return False
         attempt.replica = to_key
+        if fallback:
+            # a post-prefill handoff landed; src==dst (the collapse
+            # rung's local unpark) crossed no replica boundary and must
+            # not read as a disaggregated handoff.  Plain migrations
+            # (drains, the soak's bare op) leave the outcome untouched.
+            attempt.handoff_outcome = "fallback" if src is dst else "ok"
         if mspan is not None:
             mspan.end(outcome="ok",
                       pages=len(payload.get("page_keys") or []))

@@ -455,6 +455,16 @@ class Gateway:
                     log.exception("speculation shrink failed")
         self.metrics.set_gauge("gateway_brownout_level", level)
 
+    def set_disaggregation(self, enabled: bool) -> None:
+        """Collapse (or restore) prefill/decode disaggregation — the
+        brownout ladder's handoff rung.  Disabled, the dispatcher still
+        reacts to sealed announcements (a parked sequence must decode
+        SOMEWHERE) but resolves them locally on the prefill replica
+        instead of shipping KV to a decode peer; the controller flips
+        the replicas' roles back to flex alongside, so new admissions
+        stop sealing at all."""
+        self.dispatcher.disaggregation = bool(enabled)
+
     def _shed_locked(self, request: GatewayRequest) -> bool:
         """Level-3 admission shed (called under _lock, BEFORE this
         request's own outstanding count lands): lowest-priority tenants
@@ -597,7 +607,25 @@ class Gateway:
                         list(request.prompt) + list(outcome.tokens),
                     )
                 if outcome.status == "ok":
+                    # both the unlabeled aggregate (the FleetObserver's
+                    # window diffs read it) AND the role-split series:
+                    # "disaggregated" = prefilled on one replica, decoded
+                    # on another; the handoff-fallback path counts as
+                    # colocated (one replica did all the work)
+                    role = (
+                        "disaggregated" if outcome.handed_off
+                        else "colocated"
+                    )
                     self.metrics.observe("gateway_ttft_seconds", total)
+                    self.metrics.observe(
+                        "gateway_ttft_seconds", total, role=role
+                    )
+                    if outcome.tokens:
+                        itl = total / max(1, len(outcome.tokens))
+                        self.metrics.observe("gateway_itl_seconds", itl)
+                        self.metrics.observe(
+                            "gateway_itl_seconds", itl, role=role
+                        )
                 self.metrics.inc(
                     "gateway_requests_total", outcome=outcome.status
                 )

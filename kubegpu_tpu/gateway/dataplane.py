@@ -236,9 +236,18 @@ class ReplicaServingLoop:
     def __init__(self, batcher, metrics: Optional[Metrics] = None,
                  tracer: Optional[Tracer] = None,
                  step_delay_s: float = 0.0,
-                 fail_migration: bool = False) -> None:
+                 fail_migration: bool = False,
+                 role: str = "flex") -> None:
         self.batcher = batcher
         self.metrics = metrics
+        # disaggregation role: a "prefill" replica runs chunked prefill
+        # only — sequences PARK at seal instead of decoding, and the
+        # stream announces a non-terminal ``sealed`` event so the
+        # gateway can hand the sequence off to a decode replica
+        self.role = role if role in ("prefill", "decode", "flex") else "flex"
+        prefill_fn = getattr(batcher, "set_prefill_only", None)
+        if prefill_fn is not None:
+            prefill_fn(self.role == "prefill")
         # the replica's own tracer: every request serves under a local
         # root whose finished span dicts ride the terminal event back to
         # the gateway for grafting
@@ -415,11 +424,30 @@ class ReplicaServingLoop:
 
         return self.control(op)
 
+    def set_role(self, role: str) -> bool:
+        """Flip the replica's serving role at runtime (the fleet
+        controller's ratio actuator).  Runs on the serving thread: the
+        prefill-only flag must never flip mid-``serve_step``.  Leaving
+        "prefill" unparks every sealed sequence so it resumes decoding
+        locally — a collapse back to co-located loses nothing."""
+        if role not in ("prefill", "decode", "flex"):
+            return False
+
+        def op():
+            self.role = role
+            fn = getattr(self.batcher, "set_prefill_only", None)
+            if fn is not None:
+                fn(role == "prefill")
+            return True
+
+        return bool(self.control(op))
+
     def state(self, ledger_limit: int = 0) -> dict:
         b = self.batcher
         active_streams = self.active_streams()
         out = {
             "tp": int(getattr(b, "tp", 1)),
+            "role": self.role,
             "slots": getattr(b, "slots", None),
             "decode_page_cache": getattr(b, "decode_page_cache", "off"),
             # the RESOLVED sealing policy: gates the gateway's eager
@@ -552,6 +580,15 @@ class ReplicaServingLoop:
                 self.batcher.serve_step() if self.batcher.has_work() else {}
             )
             self._flush(finished)
+            # prefill-only parking: a sequence whose prompt pages just
+            # sealed announces it on the stream (non-terminal event) so
+            # the gateway's dispatcher can trigger the handoff
+            drain = getattr(self.batcher, "drain_sealed", None)
+            if drain is not None:
+                for seq in drain():
+                    st = self._by_seq.get(seq)
+                    if st is not None and not st.closed:
+                        st.q.put(("sealed",))
             if self.step_delay_s:
                 time.sleep(self.step_delay_s)
 
@@ -790,6 +827,19 @@ def make_replica_handler(loop: ReplicaServingLoop,
                 ok = loop.cancel(str(body["request_id"]))
                 self._send_json(200, {"cancelled": ok})
                 return
+            if self.path == "/v1/role":
+                if metrics is not None:
+                    metrics.inc("replica_http_requests_total", verb="role")
+                body = self._read_json()
+                role = str((body or {}).get("role") or "")
+                if role not in ("prefill", "decode", "flex"):
+                    self._send_json(
+                        400, {"error": "role must be prefill|decode|flex"}
+                    )
+                    return
+                loop.set_role(role)
+                self._send_json(200, {"role": loop.role})
+                return
             if self.path == "/v1/export":
                 self._handle_export()
                 return
@@ -982,6 +1032,12 @@ def make_replica_handler(loop: ReplicaServingLoop,
                 if kind == "tokens":
                     self._chunk(sse_event("tokens", {"tokens": ev[1]}))
                     continue
+                if kind == "sealed":
+                    # non-terminal: the prompt's pages sealed on a
+                    # prefill-only replica and the sequence is parked
+                    # awaiting handoff — the stream stays open
+                    self._chunk(sse_event("sealed", {}))
+                    continue
                 if kind == "done":
                     self._chunk(sse_event("done", {
                         "tokens": ev[1], "spans": ev[2], "t_recv": ev[3],
@@ -1019,7 +1075,8 @@ class ReplicaServer:
                  fail_migration: bool = False,
                  tls_cert: Optional[str] = None,
                  tls_key: Optional[str] = None,
-                 auth_token: Optional[str] = None) -> None:
+                 auth_token: Optional[str] = None,
+                 role: str = "flex") -> None:
         if bool(tls_cert) != bool(tls_key):
             # a half-configured pair must not come up silently as the
             # plain-HTTP endpoint the operator believes is encrypted —
@@ -1032,6 +1089,7 @@ class ReplicaServer:
         self.loop = ReplicaServingLoop(
             batcher, metrics=self.metrics, tracer=tracer,
             step_delay_s=step_delay_s, fail_migration=fail_migration,
+            role=role,
         )
         self.httpd = _ReplicaHTTPServer(
             listen,
@@ -1333,8 +1391,29 @@ class HttpReplicaClient(ReplicaClient):
         finally:
             conn.close()
 
+    def set_role(self, key: str, role: str) -> bool:
+        """POST /v1/role: flip a replica's serving role at runtime (the
+        fleet controller's ratio actuator, wire flavor)."""
+        addr = self.endpoint_for(key)
+        if addr is None:
+            return False
+        conn = self._connect(addr, timeout=2.0)
+        try:
+            conn.request(
+                "POST", "/v1/role", json.dumps({"role": role}),
+                self._headers({"Content-Type": "application/json"}),
+            )
+            resp = conn.getresponse()
+            resp.read()
+            return resp.status == 200
+        except (OSError, ValueError):
+            return False
+        finally:
+            conn.close()
+
     def migrate(self, attempt: Attempt, request, to_key: str,
-                _between: Optional[Callable[[], None]] = None) -> bool:
+                _between: Optional[Callable[[], None]] = None,
+                fallback: bool = False) -> bool:
         """Live migration over the wire: POST /v1/export on the source
         (which detaches the sequence — its stream ends ``migrated``,
         which the source's reader recognizes and leaves unresolved),
@@ -1342,19 +1421,32 @@ class HttpReplicaClient(ReplicaClient):
         /v1/import on the target.  The SAME attempt handle resolves
         with the full token list from the target; a refused or dead
         importer resolves it with an error so normal failover
-        re-dispatches cold — graceful, never wrong."""
+        re-dispatches cold — graceful, never wrong.
+
+        ``fallback=True`` (the disaggregation handoff contract): if the
+        target refuses or dies BEFORE streaming, the held payload is
+        re-imported into the SOURCE so the sequence resumes decode where
+        it prefilled — counted, never a request error.  It also permits
+        ``to_key == from_key``: detach-and-resume locally, the collapse
+        path when no decode replica is available at all."""
         if attempt.done:
             return False
         from_key = attempt.replica
         from_addr = self.endpoint_for(from_key)
         to_addr = self.endpoint_for(to_key)
-        if from_addr is None or to_addr is None or from_key == to_key:
+        if from_addr is None or to_addr is None or (
+            from_key == to_key and not fallback
+        ):
             return False
         trace = getattr(request, "trace", None)
         if not isinstance(trace, SpanCtx):
             trace = None
+        # overhang_ok: a handoff's continuation (and its teardown) may
+        # resolve after a hedge twin already closed the request root —
+        # the same asynchrony the dispatch spans carry
         mspan = (
-            trace.child("migrate", source=from_key, target=to_key)
+            trace.child("migrate", source=from_key, target=to_key,
+                        overhang_ok=True)
             if trace is not None else None
         )
         attempt._migrating = True
@@ -1394,12 +1486,21 @@ class HttpReplicaClient(ReplicaClient):
             if bucket is not None:
                 bucket.discard(attempt)
         attempt.replica = to_key
+        if fallback:
+            # src==dst (the collapse rung's local unpark) crossed no
+            # wire: it must not read as a disaggregated handoff
+            attempt.handoff_outcome = (
+                "fallback" if to_key == from_key else "ok"
+            )
         if _between is not None:
             _between()   # fault injection: kill-mid-migration schedules
         t = threading.Thread(
             target=self._run_attempt,
             args=(attempt, request, to_addr, to_key),
-            kwargs={"import_payload": wire},
+            kwargs={
+                "import_payload": wire,
+                "fallback": (from_key, from_addr) if fallback else None,
+            },
             daemon=True,
         )
         t.start()
@@ -1504,22 +1605,53 @@ class HttpReplicaClient(ReplicaClient):
         anchor = getattr(request, "enqueued_at", 0.0) or time.monotonic()
         return anchor + deadline_s
 
+    def _rescue(self, attempt: Attempt, request, import_payload,
+                fallback) -> bool:
+        """The handoff fallback: the decode-side import refused or died
+        BEFORE streaming — re-import the held payload into the SOURCE
+        replica so the sequence resumes decode where it prefilled.
+        Counted, never a request error.  Runs the continuation inline on
+        this reader thread (fallback=None below: one rescue, no loop)."""
+        if import_payload is None or fallback is None or attempt.done:
+            return False
+        fb_key, fb_addr = fallback
+        with self._lock:
+            bucket = self._inflight.get(attempt.replica)
+            if bucket is not None:
+                bucket.discard(attempt)
+            self._inflight.setdefault(fb_key, set()).add(attempt)
+        attempt.replica = fb_key
+        attempt.handoff_outcome = "fallback"
+        if self.metrics is not None:
+            self.metrics.inc(
+                "gateway_migrations_total", outcome="fallback"
+            )
+        self._run_attempt(
+            attempt, request, fb_addr, fb_key,
+            import_payload=import_payload,
+        )
+        return True
+
     def _run_attempt(self, attempt: Attempt, request, addr: str,
                      replica_key: str,
-                     import_payload: Optional[dict] = None) -> None:
+                     import_payload: Optional[dict] = None,
+                     fallback: Optional[Tuple[str, str]] = None) -> None:
         """Reader thread: stream the attempt to completion.  The
         terminal event's span dicts are grafted into the gateway's trace
         BEFORE the attempt resolves, so the winner's tree is complete
         when the dispatcher records the result.  With
         ``import_payload``, the attempt is a migration CONTINUATION:
         POST /v1/import carries the exported payload and the stream
-        resumes mid-sequence on the target replica."""
+        resumes mid-sequence on the target replica; ``fallback``
+        ((key, addr) of the SOURCE) re-imports there if the target
+        refuses or dies before streaming."""
         conn = self._checkout(replica_key, addr)
         trace = getattr(request, "trace", None)
         if not isinstance(trace, SpanCtx):
             trace = None
         deadline = self._deadline_of(request)
         reusable = False
+        streaming = False
         try:
             if import_payload is not None:
                 path = "/v1/import"
@@ -1527,6 +1659,7 @@ class HttpReplicaClient(ReplicaClient):
                     "request_id": request.request_id,
                     "payload": import_payload,
                 })
+                attempt.handoff_wire_bytes = len(body)
             else:
                 path = "/v1/submit"
                 payload = {
@@ -1569,15 +1702,22 @@ class HttpReplicaClient(ReplicaClient):
                     self.metrics.inc(
                         "gateway_migrations_total", outcome="import_refused"
                     )
+                if self._rescue(attempt, request, import_payload, fallback):
+                    return
                 attempt.finish(AttemptResult(
                     False, error=f"replica {replica_key} refused "
                     f"({resp.status}): {err}"
                 ))
                 return
+            streaming = True
             reusable = self._read_stream(
                 attempt, request, resp, trace, t_send, deadline
             )
         except socket.timeout:
+            if not streaming and self._rescue(
+                attempt, request, import_payload, fallback
+            ):
+                return
             self._wire_cancel(replica_key, request.request_id)
             attempt.finish(AttemptResult(
                 False, error="attempt timed out on the wire"
@@ -1585,7 +1725,14 @@ class HttpReplicaClient(ReplicaClient):
         except (OSError, ValueError, AttributeError,
                 http.client.HTTPException) as e:
             # AttributeError: http.client reading a connection that
-            # cancel() closed under us (fp already torn down)
+            # cancel() closed under us (fp already torn down).
+            # The rescue only fires pre-stream: once the target has
+            # emitted tokens, a re-import would replay them — normal
+            # failover owns that path.
+            if not streaming and self._rescue(
+                attempt, request, import_payload, fallback
+            ):
+                return
             attempt.finish(AttemptResult(
                 False,
                 error=f"replica {replica_key} connection failed: {e}",
@@ -1649,7 +1796,11 @@ class HttpReplicaClient(ReplicaClient):
                 payload = json.loads(data) if data else {}
             except json.JSONDecodeError:
                 payload = {}
-            if event == "tokens":
+            if event == "sealed":
+                # non-terminal: the prompt's pages sealed on a
+                # prefill-only replica — wake the dispatcher's handoff
+                attempt.sealed.set()
+            elif event == "tokens":
                 delta = payload.get("tokens") or []
                 tokens.extend(delta)
                 if on_tokens is not None and delta:

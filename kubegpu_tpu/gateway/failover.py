@@ -26,7 +26,7 @@ from typing import Callable, List, Optional
 
 from kubegpu_tpu.gateway.client import Attempt, ReplicaClient
 from kubegpu_tpu.gateway.registry import ReplicaInfo
-from kubegpu_tpu.gateway.router import Router
+from kubegpu_tpu.gateway.router import Router, _mesh_distance
 # SessionKVStore moved to gateway/sessionstore.py when it grew pluggable
 # backends (external HTTP store, PR 13); re-exported here because this
 # module is its historical home and half the stack imports it from here.
@@ -136,6 +136,17 @@ class Dispatcher:
         # brownout rung 1 (Gateway.set_brownout): a browned-out fleet
         # must not amplify its own overload with duplicate dispatches
         self.hedge_disabled = False
+        # prefill/decode disaggregation: when a prefill-only replica
+        # announces a sequence SEALED (parked, zero tokens emitted), the
+        # loop below hands it off to a decode replica over the migration
+        # verbs.  The controller's brownout ladder flips this off
+        # (collapse to co-located) when handoff capacity is the
+        # bottleneck; sealed events still arriving from a replica whose
+        # role hasn't flipped yet MUST still be handled — a parked
+        # sequence decodes nowhere until a handoff (or the local
+        # fallback) lands, so the collapse only changes the TARGET
+        # ranking, never whether we act.
+        self.disaggregation = True
 
     # -- outstanding bookkeeping ------------------------------------------
     def _inc(self, key: str) -> None:
@@ -225,10 +236,91 @@ class Dispatcher:
         attempt._routed_key = replica.key
         return attempt
 
+    # -- post-prefill handoff (disaggregation) -----------------------------
+    def _do_handoff(self, attempt: Attempt, request,
+                    replicas: List[ReplicaInfo]) -> None:
+        """The sequence's prompt pages sealed on a prefill-only replica
+        and it PARKED (zero tokens emitted): hand it off to a decode
+        replica through the migration verbs — slice locality first (ICI
+        beats DCN on handoff wire time), then mesh distance, then load.
+        With no decode peer (or disaggregation collapsed), the source
+        itself is the target: detach-and-resume locally through the same
+        verb pair, so a parked sequence NEVER decodes nowhere."""
+        attempt._handed_off = True
+        migrate = getattr(self.client, "migrate", None)
+        if migrate is None:
+            return
+        src = attempt.replica
+        anchor = next((r for r in replicas if r.key == src), None)
+        cand: List[ReplicaInfo] = []
+        if self.disaggregation:
+            cand = [
+                r for r in replicas
+                if r.key != src
+                and getattr(r, "role", "flex") != "prefill"
+            ] or [r for r in replicas if r.key != src]
+
+        def rank(r: ReplicaInfo):
+            return (
+                0 if (
+                    anchor is not None and r.slice_id == anchor.slice_id
+                ) else 1,
+                _mesh_distance(r, anchor) if (
+                    anchor is not None and r.slice_id == anchor.slice_id
+                ) else 0,
+                self.outstanding.get(r.key, 0),
+                r.key,
+            )
+
+        target_key = min(cand, key=rank).key if cand else src
+        trace = getattr(request, "trace", None)
+        if trace is not None:
+            trace.event("phase_handoff", source=src, target=target_key)
+        t0 = time.monotonic()
+        ok = False
+        try:
+            ok = migrate(attempt, request, target_key, fallback=True)
+        except Exception:  # noqa: BLE001 - handoff is best-effort
+            log.exception("phase handoff failed")
+        if not ok and target_key != src and not attempt.done:
+            # the decode-side leg never started (export lost, target
+            # unresolvable): unpark locally instead
+            try:
+                ok = migrate(attempt, request, src, fallback=True)
+            except Exception:  # noqa: BLE001 - same contract
+                log.exception("local handoff fallback failed")
+        if self.metrics:
+            self.metrics.observe(
+                "gateway_phase_handoff_seconds", time.monotonic() - t0
+            )
+        if not ok:
+            attempt.handoff_outcome = "failed"
+
+    def _record_handoff(self, attempt: Attempt) -> None:
+        """Once per handed-off attempt, at settlement (the HTTP client
+        resolves the fallback-vs-ok outcome on its reader thread, so
+        the counts are only authoritative when the attempt is done)."""
+        if not getattr(attempt, "_handed_off", False):
+            return
+        if getattr(attempt, "_handoff_recorded", False):
+            return
+        attempt._handoff_recorded = True
+        if self.metrics:
+            outcome = getattr(attempt, "handoff_outcome", "") or "failed"
+            self.metrics.inc(
+                "gateway_phase_handoff_total", outcome=outcome
+            )
+            wire = int(getattr(attempt, "handoff_wire_bytes", 0) or 0)
+            if wire:
+                self.metrics.inc(
+                    "gateway_phase_handoff_wire_bytes_total", wire
+                )
+
     def _settle(self, attempt: Attempt) -> None:
         # the key the _inc above charged — attempt.replica may have been
         # re-homed by a live migration mid-flight
         self._dec(getattr(attempt, "_routed_key", attempt.replica))
+        self._record_handoff(attempt)
         span = getattr(attempt, "_dispatch_span", None)
         if span is not None:
             res = attempt.result()
@@ -412,6 +504,14 @@ class Dispatcher:
                 hedge_at = time.monotonic() + policy.hedge_after_s
                 continue
 
+            # post-prefill handoff: a sealed announcement means the
+            # sequence is PARKED on a prefill-only replica — act on it
+            # unconditionally (it decodes nowhere until this lands)
+            for a in list(attempts):
+                if (a.sealed.is_set() and not a.done
+                        and not getattr(a, "_handed_off", False)):
+                    self._do_handoff(a, request, live())
+
             winner = None
             for a in attempts:
                 if a.wait(_POLL_S / max(len(attempts), 1)):
@@ -430,6 +530,9 @@ class Dispatcher:
                     return DispatchOutcome(
                         "ok", tokens=res.tokens, replica=winner.replica,
                         attempts=n_attempts, hedged=hedged,
+                        handed_off=(
+                            getattr(winner, "handoff_outcome", "") == "ok"
+                        ),
                     )
                 # failed (replica died / refused / cancelled): drop it;
                 # if nothing else is in flight the empty-attempts branch
@@ -489,6 +592,10 @@ class DispatchOutcome:
     error: str = ""
     attempts: int = 0
     hedged: bool = False
+    # served disaggregated: prefilled on one replica, decoded on another
+    # (False covers co-located AND the handoff-fallback path, where the
+    # prefill replica ended up decoding after a refused import)
+    handed_off: bool = False
 
     def __post_init__(self) -> None:
         if self.tokens is None:

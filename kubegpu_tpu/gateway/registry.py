@@ -58,6 +58,11 @@ class ReplicaInfo:
     # admissions on it — the graceful half of the replica lifecycle:
     # DRAINING → (sessions migrated / sealed exports captured) → released
     draining: bool = False
+    # serving ROLE in a disaggregated fleet (POD_ROLE annotation):
+    # "prefill" | "decode" | "flex".  The router prefers prefill replicas
+    # for fresh admissions and keeps new work OFF pure-prefill replicas'
+    # decode path; absent annotation = flex (co-located, the default)
+    role: str = "flex"
 
 
 class ReplicaRegistry:
@@ -207,10 +212,14 @@ class ReplicaRegistry:
                     )
                     if dead:
                         healthy, reason = False, f"dead chips {dead}"
+            role = ann.get(annotations.POD_ROLE) or "flex"
+            if role not in ("prefill", "decode", "flex"):
+                role = "flex"   # unknown role values degrade to co-located
             info = ReplicaInfo(
                 key=key, pod=name, namespace=ns, group=group, node=node,
                 slice_id=slice_id, coords=coords, healthy=healthy,
                 reason=reason, addr=addr, draining=key in draining,
+                role=role,
             )
             if healthy and self.probe is not None:
                 ok, why = self._probe_with_backoff(key, info)
@@ -219,7 +228,7 @@ class ReplicaRegistry:
                         key=key, pod=name, namespace=ns, group=group,
                         node=node, slice_id=slice_id, coords=coords,
                         healthy=False, reason=f"data plane: {why}",
-                        addr=addr, draining=key in draining,
+                        addr=addr, draining=key in draining, role=role,
                     )
             replicas[key] = info
 
@@ -275,6 +284,29 @@ class ReplicaRegistry:
     def draining_keys(self) -> FrozenSet[str]:
         with self._lock:
             return frozenset(self._draining)
+
+    def set_role(self, key: str, role: str) -> None:
+        """Reassign a replica's serving role (the FleetController's
+        prefill:decode ratio actuator).  Persisted on the pod like the
+        DRAINING mark — a restarted gateway adopts the fleet's current
+        role split from annotations at its first refresh — then
+        refreshed immediately so routing sees the new role this cycle.
+        In-flight sequences are untouched: a prefill→flex flip only
+        changes where NEW admissions land and whether the replica's
+        batcher parks post-seal."""
+        if role not in ("prefill", "decode", "flex"):
+            raise ValueError(f"unknown role {role!r}")
+        patch = getattr(self.api, "patch_pod_annotations", None)
+        if patch is not None:
+            ns, _, name = key.partition("/")
+            try:
+                patch(ns, name, {
+                    annotations.POD_ROLE: "" if role == "flex" else role,
+                })
+            except Exception:  # noqa: BLE001 - best-effort, like draining
+                log.debug("role annotation patch failed for %s", key,
+                          exc_info=True)
+        self.refresh()
 
     # -- views -------------------------------------------------------------
     def live(self) -> List[ReplicaInfo]:

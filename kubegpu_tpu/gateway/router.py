@@ -58,6 +58,25 @@ class Router:
         raise NotImplementedError
 
 
+def _phase_candidates(candidates: List[ReplicaInfo]) -> List[ReplicaInfo]:
+    """Phase-aware narrowing for FRESH submissions (= the prefill
+    phase) in a role-split fleet: prefill-role replicas take them when
+    any are routable (shortest-queue/warmth ranking then applies WITHIN
+    the prefill pool), and decode-role replicas are avoided while a
+    flex replica stands — decode capacity is reserved for handed-off
+    sequences.  An all-decode candidate set still serves (availability
+    over purity: a lone surviving replica takes the request co-located
+    rather than refusing it).  Uniform fleets (every role "flex", the
+    default) pass through unchanged."""
+    pref = [r for r in candidates
+            if getattr(r, "role", "flex") == "prefill"]
+    if pref:
+        return pref
+    flex = [r for r in candidates
+            if getattr(r, "role", "flex") != "decode"]
+    return flex or candidates
+
+
 def _mesh_distance(a: ReplicaInfo, b: ReplicaInfo) -> int:
     """Manhattan distance between the two replicas' chip-block origins —
     the ICI hop-count proxy the contiguity scorer uses; only meaningful
@@ -71,7 +90,9 @@ def _mesh_distance(a: ReplicaInfo, b: ReplicaInfo) -> int:
 
 class LeastOutstandingRouter(Router):
     def pick(self, request, replicas, outstanding, exclude=frozenset()):
-        candidates = [r for r in replicas if r.key not in exclude]
+        candidates = _phase_candidates(
+            [r for r in replicas if r.key not in exclude]
+        )
         if not candidates:
             return None
         hint_slice = getattr(request, "preferred_slice", None)
@@ -289,7 +310,9 @@ class PrefixLocalityRouter(Router):
         self.metrics = metrics
 
     def pick(self, request, replicas, outstanding, exclude=frozenset()):
-        candidates = [r for r in replicas if r.key not in exclude]
+        candidates = _phase_candidates(
+            [r for r in replicas if r.key not in exclude]
+        )
         if not candidates:
             return None
         prompt = getattr(request, "prompt", None)

@@ -578,6 +578,20 @@ def main(argv=None) -> None:
     ap.add_argument("--autoscale-interval", type=float, default=2.0,
                     help="seconds between reconcile ticks")
     ap.add_argument(
+        "--autoscale-ratio", action="store_true",
+        help="let the controller also steer the prefill:decode role "
+        "RATIO (disaggregated serving): sustained TTFT pressure "
+        "converts a flex replica to a dedicated prefill front-end, "
+        "sustained ITL pressure converts it back, and a failing "
+        "handoff path collapses the fleet to co-located serving "
+        "until it clears",
+    )
+    ap.add_argument(
+        "--autoscale-itl-target", type=float, default=0.05,
+        help="--autoscale-ratio: inter-token-latency SLO target "
+        "(seconds) — the decode-side pressure term",
+    )
+    ap.add_argument(
         "--autoscale-chips-per-replica", type=int, default=1,
         help="chip request stamped on scale-up pods",
     )
@@ -981,6 +995,8 @@ def main(argv=None) -> None:
                 max_replicas=args.autoscale_max,
                 queue_target_per_replica=args.autoscale_queue_target,
                 ttft_target_s=args.autoscale_ttft_target,
+                ratio_enabled=args.autoscale_ratio,
+                itl_target_s=args.autoscale_itl_target,
                 shed_tenants=tuple(
                     t for t in args.autoscale_shed_tenants.split(",")
                     if t

@@ -500,6 +500,10 @@ class _Seq:
     # slot-owned trace state from admission to retirement (see
     # _TracedBatcher's ownership model); None when untraced
     trace: Optional[_SeqTrace] = None
+    # prefill-only serving mode (disaggregation): the prompt's pages
+    # sealed with ZERO tokens emitted and the slot is excluded from the
+    # decode candidate set — it waits for export (handoff) or unpark
+    parked: bool = False
 
 
 @dataclass
@@ -650,6 +654,7 @@ class PagedContinuousBatcher(_TracedBatcher):
         speculate_k: Optional[int] = None,
         draft_window: Optional[int] = None,
         mesh: Optional[Mesh] = None,
+        prefill_only: bool = False,
     ) -> None:
         # tensor-parallel serving: a mesh with a "model" axis shards the
         # KV page pool, the prefill station and the draft ring on their
@@ -934,6 +939,13 @@ class PagedContinuousBatcher(_TracedBatcher):
                 for ck, cv in self._station
             ]
         self._jobs: "OrderedDict[int, _PrefillJob]" = OrderedDict()
+        # prefill-only serving mode (disaggregation, worker --role
+        # prefill): activations PARK instead of entering the decode
+        # candidate set; _sealed_pending announces each seal upstream
+        # exactly once (drain_sealed), where the gateway's dispatcher
+        # turns it into a post-prefill handoff over the migration verbs
+        self.prefill_only = bool(prefill_only)
+        self._sealed_pending: List[int] = []
         # each queued entry CARRIES its own prefix chain keys (computed
         # at submit): a seq_id may legally be queued twice — keys living
         # on the entry, not in a per-id map, means the two admissions
@@ -2153,7 +2165,13 @@ class PagedContinuousBatcher(_TracedBatcher):
         )
         self._pos_dev = self._pos_dev.at[slot].set(job.plen - 1)
         self._last_dev = self._last_dev.at[slot].set(last_tok)
-        self._active_dev = self._active_dev.at[slot].set(True)
+        # prefill-only mode: the prompt's pages just sealed in the pool
+        # with ZERO tokens emitted — park the slot (device lane stays
+        # inactive, decode candidacy withheld) and announce the seal;
+        # the gateway exports it to a decode replica from exactly this
+        # cursor, or set_prefill_only(False) unparks it locally
+        park = self.prefill_only and s.remaining > 0
+        self._active_dev = self._active_dev.at[slot].set(not park)
         self._remaining_dev = self._remaining_dev.at[slot].set(s.remaining)
         self._counts_dev = self._counts_dev.at[slot].set(0)
         # retirement sealing needs the committed stream's prompt half
@@ -2172,6 +2190,9 @@ class PagedContinuousBatcher(_TracedBatcher):
             self._d_pos[slot] = job.plen - 1
             self._d_pos_dev = self._d_pos_dev.at[slot].set(job.plen - 1)
         s.prefilling, s.active = False, True
+        if park:
+            s.parked = True
+            self._sealed_pending.append(s.seq_id)
         tr = s.trace
         if tr is not None:
             t = time.monotonic()
@@ -2201,7 +2222,12 @@ class PagedContinuousBatcher(_TracedBatcher):
             if self.token_budget is None:
                 pages_left = None
             else:
-                n_active = sum(1 for s in self._seqs if s.active)
+                # parked slots consume no decode rows — their budget
+                # share goes straight back to prefill (the whole point
+                # of a prefill-only replica)
+                n_active = sum(
+                    1 for s in self._seqs if s.active and not s.parked
+                )
                 if self.speculate_k is not None:
                     # a speculative slot consumes k+1 budget rows per
                     # iteration (its verify window is k+1 tokens wide);
@@ -2373,6 +2399,12 @@ class PagedContinuousBatcher(_TracedBatcher):
         self._trace_retire_slot(s, reason)
         self._seal_finished_pages(s)
         self._release_pages(s)
+        if s.parked:
+            # a parked sequence leaving before its seal was drained
+            # must not announce a handoff for a dead cursor
+            s.parked = False
+            if s.seq_id in self._sealed_pending:
+                self._sealed_pending.remove(s.seq_id)
         s.seq_id = -1
         s.prompt, s.plen = None, 0
         self.tables[i, :] = 0
@@ -2396,6 +2428,32 @@ class PagedContinuousBatcher(_TracedBatcher):
 
     def has_work(self) -> bool:
         return bool(self._pending) or any(s.seq_id >= 0 for s in self._seqs)
+
+    # -- disaggregation verbs (prefill-only serving mode) -------------------
+    def drain_sealed(self) -> List[int]:
+        """Seq ids whose prompts sealed (parked) since the last drain —
+        the serving loop announces each exactly once; the gateway's
+        dispatcher turns the announcement into a post-prefill handoff
+        through export_pages/import_pages."""
+        out, self._sealed_pending = self._sealed_pending, []
+        return out
+
+    def set_prefill_only(self, flag: bool) -> bool:
+        """Flip prefill-only serving live (the controller's role
+        actuator).  Disabling UNPARKS every sealed slot into the decode
+        candidate set — collapse-to-colocated must never strand a
+        parked stream.  Single-driver like every mutating verb: call
+        on the serving thread (worker control op)."""
+        flag = bool(flag)
+        changed = flag != self.prefill_only
+        self.prefill_only = flag
+        if not flag:
+            for i, s in enumerate(self._seqs):
+                if s.seq_id >= 0 and s.parked:
+                    s.parked = False
+                    self._active_dev = self._active_dev.at[i].set(True)
+            self._sealed_pending = []
+        return changed
 
     def live_tokens(self) -> Dict[int, List[int]]:
         """Committed tokens of every live sequence — the incremental
@@ -2798,6 +2856,9 @@ class PagedContinuousBatcher(_TracedBatcher):
         s = self._seqs[slot]
         now = time.monotonic()
         s.seq_id, s.active, s.prefilling = seq_id, True, False
+        # an imported sequence always DECODES here — on a prefill-only
+        # replica this is exactly the handoff-fallback resume path
+        s.parked = False
         s.gen += 1
         s.tokens, s.remaining = list(tokens), remaining
         s.pages, s.shared = pages, shared
@@ -3039,7 +3100,9 @@ class PagedContinuousBatcher(_TracedBatcher):
             self.metrics.set_gauge(
                 "serve_station_slots_busy", float(len(self._jobs))
             )
-        n_active = sum(1 for s in self._seqs if s.active)
+        n_active = sum(
+            1 for s in self._seqs if s.active and not s.parked
+        )
         if n_active:
             if self.speculate_k is not None:
                 self._dispatch_spec()
@@ -3051,7 +3114,10 @@ class PagedContinuousBatcher(_TracedBatcher):
         keep = 1 if (
             self.pipeline_decode
             and n_active
-            and not any(s.active and not s.tokens for s in self._seqs)
+            and not any(
+                s.active and not s.parked and not s.tokens
+                for s in self._seqs
+            )
         ) else 0
         while len(self._inflight) > keep:
             spec_emitted += self._process_entry(self._inflight.popleft())
@@ -3092,7 +3158,9 @@ class PagedContinuousBatcher(_TracedBatcher):
         # assembly + device uploads of every loop input, every token —
         # exactly the serialization the device-resident loop deletes
         counts = np.array([len(s.tokens) for s in self._seqs], np.int32)
-        active = np.array([s.active for s in self._seqs], bool)
+        active = np.array(
+            [s.active and not s.parked for s in self._seqs], bool
+        )
         remaining = np.array(
             [s.remaining for s in self._seqs], np.int32
         )
@@ -3108,7 +3176,10 @@ class PagedContinuousBatcher(_TracedBatcher):
         """Launch one plain decode iteration: the program consumes the
         previous iteration's on-device state and returns the next —
         no host upload, no readback (that is ``_process_entry``'s)."""
-        cand = {i: s.gen for i, s in enumerate(self._seqs) if s.active}
+        cand = {
+            i: s.gen for i, s in enumerate(self._seqs)
+            if s.active and not s.parked
+        }
         last, table, pos, active, remaining, counts, _ = self._loop_state()
         (toks, self.pools, self._last_dev, self._pos_dev,
          self._active_dev, self._remaining_dev, self._counts_dev) = (
@@ -3129,7 +3200,10 @@ class PagedContinuousBatcher(_TracedBatcher):
         With pipelining on, the draft/verify timers measure dispatch
         windows (async tails overlap the next iteration); the
         synchronous mode keeps the fenced per-program timings."""
-        cand = {i: s.gen for i, s in enumerate(self._seqs) if s.active}
+        cand = {
+            i: s.gen for i, s in enumerate(self._seqs)
+            if s.active and not s.parked
+        }
         last, table, pos, active, remaining, _, d_pos = self._loop_state()
         if self.metrics is not None:
             draft_ctx = self.metrics.timer("serve_spec_draft_seconds")

@@ -714,10 +714,12 @@ def _serve_http(args, cb, t0: float) -> int:
         tls_cert=args.serve_http_tls_cert,
         tls_key=args.serve_http_tls_key,
         auth_token=auth_token,
+        role=args.role,
     )
     server.start()
     print(
         f"REPLICA_HTTP_SERVING port={server.port} serving={args.serving} "
+        f"role={args.role} "
         f"tls={int(server.tls)} seconds={time.monotonic() - t0:.2f}",
         flush=True,
     )
@@ -938,6 +940,15 @@ def main(argv=None) -> int:
                     "SSE, /v1/cancel frees pages wire-level, /healthz "
                     "answers the gateway's probe.  The gateway "
                     "(gateway/server.py --replica-port) dispatches here")
+    ap.add_argument("--role", choices=("prefill", "decode", "flex"),
+                    default="flex",
+                    help="--serve-http: this replica's serving role in a "
+                    "disaggregated fleet.  'prefill' parks sequences the "
+                    "moment their prompt pages seal (the gateway hands "
+                    "them off over /v1/export -> /v1/import); 'decode' "
+                    "advertises itself as a handoff target; 'flex' (the "
+                    "default) serves both phases co-located.  Mutable at "
+                    "runtime via POST /v1/role")
     ap.add_argument("--serve-http-step-delay", type=float, default=0.0,
                     metavar="S",
                     help="--serve-http: sleep this long between serving "

@@ -28,6 +28,7 @@ def schedule_decode_replicas(
     pin_slices: Optional[Sequence[str]] = None,
     name_prefix: str = "dec",
     priority: Optional[int] = None,
+    roles: Optional[Sequence[str]] = None,
 ) -> list:
     """Create + filter + bind ``n_replicas`` single-chip serving pods
     through the real control plane; returns the pod names.
@@ -36,7 +37,9 @@ def schedule_decode_replicas(
     controller MUST deploy serving replicas at the controller's
     ``serving_priority`` (the preemption contract: a scale-up placement
     evicts strictly-lower-priority units, and an unstamped replica at
-    the default 0 would read as a victim)."""
+    the default 0 would read as a victim).  ``roles`` stamps POD_ROLE
+    per replica (prefill|decode|flex) for disaggregated harnesses —
+    omitted entries default to the registry's 'flex'."""
     nodes = sorted(node["metadata"]["name"] for node in api.list_nodes())
     names = []
     for i in range(n_replicas):
@@ -44,6 +47,8 @@ def schedule_decode_replicas(
         ann = {annotations.POD_SERVING_GROUP: group}
         if priority is not None:
             ann[annotations.POD_PRIORITY] = str(priority)
+        if roles is not None and i < len(roles) and roles[i]:
+            ann[annotations.POD_ROLE] = roles[i]
         if pin_slices:
             ann[annotations.POD_SLICE_SELECTOR] = pin_slices[i]
         api.create_pod({
@@ -68,6 +73,7 @@ def build_fake_serving_stack(
     pin_slices: Optional[Sequence[str]] = None,
     metrics=None,
     priority: Optional[int] = None,
+    roles: Optional[Sequence[str]] = None,
 ) -> SimpleNamespace:
     """Fabricated multi-slice cluster with scheduled decode replicas and a
     ReplicaRegistry over them.  Returns (api, slices, advs, sched,
@@ -92,7 +98,7 @@ def build_fake_serving_stack(
         else Scheduler(api)
     sched.cache.refresh()
     schedule_decode_replicas(api, sched, n_replicas, group, pin_slices,
-                             priority=priority)
+                             priority=priority, roles=roles)
     registry = ReplicaRegistry(api, group=group)
     return SimpleNamespace(
         api=api, slices=slices, advs=advs, sched=sched, registry=registry

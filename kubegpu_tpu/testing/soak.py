@@ -503,14 +503,26 @@ class GatewaySoak:
     lane: the bursty-diurnal arrival process paced by a virtual clock,
     chatty agent sessions (follow turns materialized from parents'
     results), long-context RAG prompts and best-of-n fan-out — the same
-    scenario matrix bench.py drives, instead of ad-hoc soak knobs."""
+    scenario matrix bench.py drives, instead of ad-hoc soak knobs.
+
+    ``disaggregation=True`` is the PREFILL/DECODE role-split lane
+    (ISSUE 17): the first replica deploys as a dedicated prefill
+    front-end (its batcher parks every sequence the moment the prompt
+    seals), the rest stay flex, and EVERY request's decode phase rides
+    a post-prefill handoff through the migration verbs — with the
+    kill/refuse/kill-mid-migration schedule landing on both ends of
+    those transfers.  The audited contract: a refused or orphaned
+    handoff resumes decode ON the prefill replica (counted fallback,
+    never a request error), so I5 and both-end page accounting hold
+    whatever the chaos did to the handoff path."""
 
     def __init__(self, seed: int, n_replicas: int = 4,
                  batcher_factory=None, multiturn: bool = False,
                  follow_prompt_cap: int = 12, http: bool = False,
                  migration: bool = False, gateways: int = 1,
                  store_chaos: bool = False, controller: bool = False,
-                 prefix_tier: bool = False, prefix_page: int = 8):
+                 prefix_tier: bool = False, prefix_page: int = 8,
+                 disaggregation: bool = False):
         from kubegpu_tpu.gateway import (
             AdmissionQueue, FailoverPolicy, Gateway, GatewayTier,
             HttpReplicaClient, InMemoryReplicaClient, ReplicaServer,
@@ -528,7 +540,15 @@ class GatewaySoak:
             # replicas deploy AT serving_priority, so a scale-up's
             # victim search can never read an existing replica as prey
             priority=50 if controller else None,
+            # disaggregation lane: one dedicated prefill front-end,
+            # the rest flex — every request's decode then rides a
+            # post-prefill handoff through the migration verbs
+            roles=(
+                ("prefill",) + ("flex",) * (n_replicas - 1)
+                if disaggregation else None
+            ),
         )
+        self.disaggregation = disaggregation
         self.api = stack.api
         self.slices = stack.slices
         self.advs = stack.advs
@@ -648,6 +668,13 @@ class GatewaySoak:
             )
             self.registry.refresh()
             self.gw.start()
+        if disaggregation and not http:
+            # the in-memory data plane mirrors the role annotations:
+            # prefill-role batchers flip into prefill-only serving
+            # (the HTTP lane applies roles at server construction)
+            for rep in self.registry.live():
+                if getattr(rep, "role", "flex") == "prefill":
+                    self.client.set_role(rep.key, "prefill")
         self.controller = None
         if controller:
             if http:
@@ -712,8 +739,13 @@ class GatewaySoak:
         old = self.servers.pop(key, None)
         if old is not None:
             old.stop()
+        # disaggregation: a (re)started server comes up IN its
+        # annotated role — a prefill front-end cold-restarts as one
+        rep = self.registry.get(key)
         srv = ReplicaServer(
-            self.batcher_factory(key), step_delay_s=0.001
+            self.batcher_factory(key), step_delay_s=0.001,
+            role=getattr(rep, "role", "flex") if rep is not None
+            else "flex",
         ).start()
         self.servers[key] = srv
         self.client.set_endpoint(key, srv.endpoint)
@@ -869,6 +901,12 @@ class GatewaySoak:
         for a in self.advs.values():
             a.advertise_once()
         self.registry.refresh()  # sync_live restarts the replica cold
+        if self.disaggregation and not self.http:
+            # a cold restart forgets the serving mode; re-apply the
+            # annotated role so the prefill front-end stays one
+            rep = self.registry.get(key)
+            if rep is not None and getattr(rep, "role", "flex") == "prefill":
+                self.client.set_role(key, "prefill")
         self.dead.discard(key)
         self.dead_info.pop(key, None)
         return f"revive {key}"

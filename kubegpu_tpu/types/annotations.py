@@ -55,6 +55,12 @@ POD_SLICE_SELECTOR = f"{PREFIX}/slice-selector" # comma list of slice ids the
 # to them once their assignment annotation exists and their assigned chips
 # are advertised healthy.
 POD_SERVING_GROUP = f"{PREFIX}/serving-group"
+# Pod side (written by users / the fleet controller's ratio actuator, read
+# by the registry): the replica's serving ROLE in a disaggregated fleet —
+# "prefill" | "decode" | "flex".  A prefill replica runs chunked prefill
+# only and hands sequences off post-seal; a decode replica receives them;
+# flex (the default when absent) serves both phases co-located.
+POD_ROLE = f"{PREFIX}/role"
 # Pod side (written by the fleet controller's checkpoint-and-requeue):
 # stamped on a batch pod recreated PENDING after preemption evicted it.
 # The value is JSON — {"preempted": true, ...checkpointer metadata...} —

@@ -70,7 +70,27 @@ CATALOG: Dict[str, MetricSpec] = {
     "gateway_queue_wait_seconds": _h(
         (), "enqueue -> dispatcher pickup wait"),
     "gateway_ttft_seconds": _h(
-        (), "enqueue -> full response (unary data plane: TTFT == TTLT)"),
+        ("role",), "enqueue -> full response (unary data plane: TTFT == "
+        "TTLT).  Emitted twice per ok request: unlabeled aggregate (the "
+        "FleetObserver's window diffs) plus role=colocated|disaggregated "
+        "(disaggregated = prefilled on one replica, decoded on another "
+        "after the post-prefill handoff)"),
+    "gateway_itl_seconds": _h(
+        ("role",), "mean inter-token latency per ok request (total / "
+        "tokens).  Unlabeled aggregate plus role=colocated|disaggregated "
+        "— the pair the disaggregation bench gates on (decode iterations "
+        "no longer stalled behind long prefills)"),
+    "gateway_phase_handoff_total": _c(
+        ("outcome",), "post-prefill KV handoffs by outcome (ok = decode "
+        "replica took the sequence; fallback = decode side refused/died "
+        "and the prefill replica resumed decode locally; failed = "
+        "neither leg landed, normal failover re-dispatched cold)"),
+    "gateway_phase_handoff_seconds": _h(
+        (), "sealed announcement -> handoff dispatched (export + "
+        "re-home + import kickoff wall time)"),
+    "gateway_phase_handoff_wire_bytes_total": _c(
+        (), "serialized KV payload bytes shipped by post-prefill "
+        "handoffs (int8 pools halve this per page vs bf16)"),
     "gateway_live_replicas": _g((), "replicas routable right now"),
     "gateway_deadline_exceeded_total": _c(
         (), "requests failed by the end-to-end deadline"),
@@ -302,6 +322,14 @@ CATALOG: Dict[str, MetricSpec] = {
     "controller_brownout_level": _g(
         (), "brownout rung the controller currently holds the "
         "gateway(s) at (mirrors gateway_brownout_level)"),
+    "controller_role_reshapes_total": _c(
+        ("dir",), "prefill:decode ratio actuator decisions (prefill = "
+        "a flex replica re-roled toward prefill under TTFT pressure; "
+        "decode = one returned toward decode under ITL pressure; "
+        "collapse = disaggregation folded back to co-located because "
+        "handoff capacity was the bottleneck)"),
+    "controller_prefill_replicas": _g(
+        (), "replicas currently holding the prefill role"),
 
     # -- serving data plane (models/serving.py, models/paging.py)
     "serve_ttft_seconds": _h((), "submit -> first generated token"),

@@ -1,0 +1,639 @@
+"""Prefill/decode disaggregation (ISSUE 17): role-split replicas with
+post-prefill KV handoff over the migration verbs.
+
+Layers under test:
+
+- the batcher parking contract — ``prefill_only`` parks a sequence the
+  moment its prompt pages seal (zero tokens emitted), ``drain_sealed``
+  announces it exactly once, ``set_prefill_only(False)`` unparks
+  locally, and imported sequences DECODE even in prefill-only mode (the
+  fallback resume path);
+- phase-aware routing — new admissions prefer prefill-role replicas,
+  fall back to flex, and never strand on an all-decode candidate list;
+- the gateway handoff — a sealed signal triggers an export→import
+  transfer to a decode-side replica, the caller's stream is
+  UNINTERRUPTED, and fp32 token identity holds disaggregated ≡
+  co-located across page sizes × {fp32, int8} pools × speculation
+  on/off, at exact page-boundary and sub-page prompt lengths;
+- the fallback contract — a refused or dead importer resumes decode ON
+  the prefill replica (counted ``fallback``, never a request error),
+  and collapse (``set_disaggregation(False)``) unparks locally;
+- the controller's ratio actuator — TTFT pressure converts flex →
+  prefill, ITL pressure converts back, a failing handoff path collapses
+  the fleet to co-located and re-arms after clean ticks;
+- the role surfaces — worker ``/v1/state`` advertises the role, POST
+  ``/v1/role`` flips it live, the registry reads POD_ROLE;
+- GatewaySoak ``disaggregation=True`` — the kill/refuse/
+  kill-mid-migration schedule lands on both ends of the handoff path
+  with I5 and both-end page accounting intact.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubegpu_tpu.models import TransformerLM
+from kubegpu_tpu.models.paging import PagedContinuousBatcher
+
+CFG = dict(vocab_size=64, num_layers=2, num_heads=8, hidden=32, max_seq=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = TransformerLM(dtype=jnp.float32, **CFG)
+    return model.init(
+        jax.random.PRNGKey(0), jnp.ones((2, 8), jnp.int32)
+    )["params"]
+
+
+def make_paged(params, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("prompt_pad", 16)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("pool_pages", 48)
+    kw.setdefault("decode_page_cache", "fp32")
+    return PagedContinuousBatcher(
+        params, dtype=jnp.float32, **CFG, **kw
+    )
+
+
+def spec_kw(params, k=2):
+    return dict(
+        draft_params=params, speculate_k=k,
+        draft_num_layers=CFG["num_layers"],
+        draft_num_heads=CFG["num_heads"], draft_hidden=CFG["hidden"],
+    )
+
+
+PROMPT = [3, 1, 4, 1, 5, 9, 2, 6]        # 2 exact pages at page_size=4
+SUBPAGE_PROMPT = [3, 1, 4]               # under one page
+
+
+# ---------------------------------------------------------------------------
+# batcher parking contract (no jax: SimBatcher twins)
+# ---------------------------------------------------------------------------
+
+def test_simbatcher_prefill_only_parks_and_announces_once():
+    from kubegpu_tpu.gateway import SimBatcher
+
+    b = SimBatcher(slots=4, vocab=97, prefill_only=True)
+    b.submit(5, [1, 2, 3], 10)
+    b.serve_step()
+    assert b.drain_sealed() == [5]
+    assert b.drain_sealed() == []            # announced exactly once
+    for _ in range(3):
+        b.serve_step()
+    assert b.live_tokens() == {5: []}        # parked: zero tokens emitted
+    # unpark locally: the collapse rung must never strand a stream
+    assert b.set_prefill_only(False)
+    out = {}
+    while b.has_work():
+        out.update(b.serve_step())
+    assert out[5] == [(5 * 31 + i) % 97 for i in range(10)]
+
+
+def test_simbatcher_imported_sequence_decodes_in_prefill_mode():
+    from kubegpu_tpu.gateway import SimBatcher
+
+    src = SimBatcher(slots=2, vocab=97, prefill_only=True)
+    src.submit(1, [1, 2], 8)
+    src.serve_step()
+    assert src.drain_sealed() == [1]
+    payload = src.export_pages(1)
+    src.cancel(1)
+    # the fallback contract: re-import into the SAME prefill-only
+    # batcher — the sequence must decode, not re-park
+    src.import_pages(9, payload)
+    out = {}
+    while src.has_work():
+        out.update(src.serve_step())
+    assert out[9] == [(1 * 31 + i) % 97 for i in range(8)]
+
+
+def test_paged_prefill_only_parks_at_seal(params):
+    """The real batcher: a prefill-only admission chunk-prefills, seals
+    its prompt pages, and PARKS with zero tokens emitted; exporting and
+    importing into a decode twin finishes token-identical; flipping the
+    mode off unparks locally instead."""
+    ref = make_paged(params).run([np.asarray(PROMPT, np.int32)], [10])[0]
+    src = make_paged(params, prefill_only=True)
+    dst = make_paged(params)
+    src.submit(1, np.asarray(PROMPT, np.int32), 10)
+    deadline = time.monotonic() + 30
+    sealed = []
+    while not sealed and time.monotonic() < deadline:
+        src.serve_step()
+        sealed = src.drain_sealed()
+    assert sealed == [1]
+    s = next(s for s in src._seqs if s.seq_id == 1)
+    assert s.parked and len(s.tokens) == 0   # zero tokens emitted
+    payload = src.export_pages(1)
+    src.cancel(1)
+    src.assert_page_accounting()
+    dst.import_pages(11, payload)
+    out = {}
+    while dst.has_work():
+        out.update(dst.serve_step())
+    assert out[11] == ref
+    dst.assert_page_accounting()
+
+    # the collapse leg: park, then flip the mode off — local unpark
+    src.submit(2, np.asarray(PROMPT, np.int32), 10)
+    while not src.drain_sealed():
+        src.serve_step()
+    assert src.set_prefill_only(False)
+    out = {}
+    while src.has_work():
+        out.update(src.serve_step())
+    assert out[2] == ref
+    src.assert_page_accounting()
+
+
+# ---------------------------------------------------------------------------
+# phase-aware routing
+# ---------------------------------------------------------------------------
+
+def test_router_prefers_prefill_candidates():
+    from types import SimpleNamespace
+
+    from kubegpu_tpu.gateway.router import _phase_candidates
+
+    def rep(key, role):
+        return SimpleNamespace(key=key, role=role)
+
+    pre, dec, flex = rep("a", "prefill"), rep("b", "decode"), rep("c", "flex")
+    # prefill replicas win the prefill phase outright
+    assert _phase_candidates([dec, flex, pre]) == [pre]
+    # no prefill: flex serves both phases, decode stays decode-side
+    assert _phase_candidates([dec, flex]) == [flex]
+    # all-decode fleet: availability beats purity
+    assert _phase_candidates([dec]) == [dec]
+    # uniform flex fleet passes through unchanged
+    assert _phase_candidates([flex, flex]) == [flex, flex]
+
+
+# ---------------------------------------------------------------------------
+# gateway stack helpers
+# ---------------------------------------------------------------------------
+
+def _disagg_stack(n_replicas, batcher_factory, roles, policy=None,
+                  dispatchers=2):
+    from kubegpu_tpu.gateway import (
+        AdmissionQueue, FailoverPolicy, Gateway, InMemoryReplicaClient,
+    )
+    from kubegpu_tpu.testing.fake_serving import build_fake_serving_stack
+    from kubegpu_tpu.utils.metrics import Metrics
+
+    stack = build_fake_serving_stack(
+        n_replicas, metrics=Metrics(), roles=roles,
+    )
+    client = InMemoryReplicaClient(
+        batcher_factory=batcher_factory, step_delay_s=0.0,
+    )
+    stack.registry.subscribe(client.sync_live)
+    gw = Gateway(
+        stack.registry, client, queue=AdmissionQueue(capacity=32),
+        policy=policy or FailoverPolicy(
+            deadline_s=120.0, hedge_after_s=60.0, max_attempts=4,
+        ),
+        metrics=Metrics(), dispatchers=dispatchers,
+    )
+    stack.registry.refresh()
+    for rep in stack.registry.live():
+        if rep.role == "prefill":
+            client.set_role(rep.key, "prefill")
+    gw.start()
+    return stack, client, gw
+
+
+def _pools_balanced(client):
+    with client._lock:
+        batchers = [w.batcher for w in client._workers.values()]
+    for b in batchers:
+        check = getattr(b, "assert_page_accounting", None)
+        if check is not None:
+            check()
+    return batchers
+
+
+# ---------------------------------------------------------------------------
+# fp32 token identity: disaggregated == co-located
+# ---------------------------------------------------------------------------
+
+def _identity_case(params, prompt, budget, **paged_kw):
+    from kubegpu_tpu.gateway import GatewayRequest
+
+    ref = make_paged(params, **paged_kw).run(
+        [np.asarray(prompt, np.int32)], [budget]
+    )[0]
+    stack, client, gw = _disagg_stack(
+        2, lambda key: make_paged(params, **paged_kw),
+        roles=("prefill", "flex"),
+    )
+    try:
+        p = gw.submit(GatewayRequest(
+            prompt=list(prompt), max_new_tokens=budget, request_id="d0",
+        ))
+        assert p.wait(180), "disaggregated request timed out"
+        r = p.result()
+        assert r.status == "ok", (r.status, r.error)
+        assert list(r.tokens) == ref, (r.tokens, ref)
+        assert gw.metrics.get(
+            "gateway_phase_handoff_total", outcome="ok"
+        ) == 1
+        assert gw.metrics.get(
+            "gateway_phase_handoff_wire_bytes_total"
+        ) > 0
+        # the caller's stream is attributed to the disaggregated path
+        assert gw.metrics.histogram_count(
+            "gateway_ttft_seconds", role="disaggregated"
+        ) == 1
+        assert gw.metrics.histogram_count(
+            "gateway_itl_seconds", role="disaggregated"
+        ) == 1
+        assert gw.drain(60)
+        _pools_balanced(client)              # BOTH replicas at quiescence
+    finally:
+        gw.stop()
+        client.stop()
+
+
+def test_disaggregated_identity_fp32(params):
+    _identity_case(params, PROMPT, 10)
+
+
+def test_disaggregated_identity_subpage_prompt(params):
+    _identity_case(params, SUBPAGE_PROMPT, 8)
+
+
+def test_disaggregated_identity_int8_pool(params):
+    _identity_case(params, PROMPT, 10, kv_dtype="int8",
+                   decode_page_cache="quantized")
+
+
+def test_disaggregated_identity_speculative(params):
+    _identity_case(params, PROMPT, 10, **spec_kw(params))
+
+
+@pytest.mark.slow
+def test_disaggregated_identity_page8(params):
+    _identity_case(params, PROMPT, 12, page_size=8)
+
+
+@pytest.mark.slow
+def test_disaggregated_identity_int8_speculative(params):
+    _identity_case(params, PROMPT, 10, kv_dtype="int8",
+                   decode_page_cache="quantized", **spec_kw(params))
+
+
+# ---------------------------------------------------------------------------
+# fallback contract: refusal / importer death / collapse
+# ---------------------------------------------------------------------------
+
+def test_refusal_falls_back_to_prefill_replica(params):
+    """The decode side refuses the import (chaos knob): the sequence
+    must resume decode ON the prefill replica — counted fallback, same
+    tokens, never a request error."""
+    from kubegpu_tpu.gateway import GatewayRequest
+
+    ref = make_paged(params).run([np.asarray(PROMPT, np.int32)], [10])[0]
+    stack, client, gw = _disagg_stack(
+        2, lambda key: make_paged(params), roles=("prefill", "flex"),
+    )
+    try:
+        for rep in stack.registry.live():
+            if rep.role != "prefill":
+                client.set_fail_migration(rep.key, True)
+        p = gw.submit(GatewayRequest(
+            prompt=PROMPT, max_new_tokens=10, request_id="fb0",
+        ))
+        assert p.wait(180)
+        r = p.result()
+        assert r.status == "ok", (r.status, r.error)
+        assert list(r.tokens) == ref
+        assert gw.metrics.get(
+            "gateway_phase_handoff_total", outcome="fallback"
+        ) == 1
+        assert gw.metrics.get(
+            "gateway_phase_handoff_total", outcome="ok"
+        ) == 0
+        # a fallback is co-located work: one replica did it all
+        assert gw.metrics.histogram_count(
+            "gateway_ttft_seconds", role="colocated"
+        ) == 1
+        assert gw.drain(60)
+        _pools_balanced(client)
+    finally:
+        gw.stop()
+        client.stop()
+
+
+def test_importer_death_between_export_and_import(params):
+    """The target dies BETWEEN the export and the import ack: the held
+    payload re-imports into the source (the decode-even-when-parked
+    leg) and the stream finishes there — never a request error.  Driven
+    on the client directly so the kill lands at the exact window the
+    contract names (under a gateway the dispatcher's own handoff would
+    race the injection)."""
+    from types import SimpleNamespace
+
+    from kubegpu_tpu.gateway import InMemoryReplicaClient
+
+    ref = make_paged(params).run([np.asarray(PROMPT, np.int32)], [10])[0]
+    client = InMemoryReplicaClient(step_delay_s=0.0)
+    client.add_replica("pre", make_paged(params, prefill_only=True))
+    client.add_replica("dec", make_paged(params))
+    try:
+        got = []
+        req = SimpleNamespace(
+            request_id="kd0", prompt=list(PROMPT), max_new_tokens=10,
+            temperature=0.0, session=None,
+            on_tokens=lambda a, toks: got.extend(toks),
+        )
+        attempt = client.submit("pre", req)
+        assert attempt.sealed.wait(60), "prompt never sealed"
+        ok = client.migrate(
+            attempt, req, "dec",
+            _between=lambda: client.fail_replica("dec"),
+            fallback=True,
+        )
+        assert ok, "fallback migrate refused"
+        assert attempt.handoff_outcome == "fallback"
+        assert attempt.wait(120)
+        res = attempt.result()
+        assert res.ok, res.error
+        assert list(res.tokens) == ref
+        assert got == ref                    # uninterrupted stream
+        with client._lock:
+            src = client._workers["pre"].batcher
+        deadline = time.monotonic() + 30
+        while src.has_work() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        src.assert_page_accounting()
+    finally:
+        client.stop()
+
+
+def test_collapse_unparks_locally(params):
+    """Disaggregation OFF (the controller's collapse rung) with a
+    prefill-role replica still in the fleet: the sealed signal must
+    still be handled — the handoff targets the source itself and the
+    sequence decodes where it prefilled."""
+    from kubegpu_tpu.gateway import GatewayRequest
+
+    ref = make_paged(params).run([np.asarray(PROMPT, np.int32)], [10])[0]
+    stack, client, gw = _disagg_stack(
+        2, lambda key: make_paged(params), roles=("prefill", "flex"),
+    )
+    try:
+        gw.set_disaggregation(False)
+        p = gw.submit(GatewayRequest(
+            prompt=PROMPT, max_new_tokens=10, request_id="c0",
+        ))
+        assert p.wait(180)
+        r = p.result()
+        assert r.status == "ok", (r.status, r.error)
+        assert list(r.tokens) == ref
+        # local unpark counts with the fallback outcomes, never "ok"
+        assert gw.metrics.get(
+            "gateway_phase_handoff_total", outcome="ok"
+        ) == 0
+        assert gw.metrics.get(
+            "gateway_phase_handoff_total", outcome="fallback"
+        ) == 1
+        assert gw.drain(60)
+        _pools_balanced(client)
+    finally:
+        gw.stop()
+        client.stop()
+
+
+# ---------------------------------------------------------------------------
+# controller: the prefill:decode ratio actuator
+# ---------------------------------------------------------------------------
+
+def _controller_stack(n_replicas=4, **cfg_kw):
+    from kubegpu_tpu.controller import ControllerConfig, FleetController
+    from kubegpu_tpu.gateway import (
+        AdmissionQueue, FailoverPolicy, Gateway, InMemoryReplicaClient,
+        SimBatcher,
+    )
+    from kubegpu_tpu.testing.fake_serving import build_fake_serving_stack
+    from kubegpu_tpu.utils.metrics import Metrics
+
+    metrics = Metrics()
+    stack = build_fake_serving_stack(
+        n_replicas, metrics=Metrics(), priority=50,
+    )
+    client = InMemoryReplicaClient(
+        batcher_factory=lambda key: SimBatcher(slots=8),
+        step_delay_s=0.001,
+    )
+    stack.registry.subscribe(client.sync_live)
+    gw = Gateway(
+        stack.registry, client, queue=AdmissionQueue(capacity=64),
+        policy=FailoverPolicy(deadline_s=30.0),
+        metrics=metrics, dispatchers=2,
+    )
+    stack.registry.refresh()
+    gw.start()
+    cfg = dict(
+        group="decode", min_replicas=1, max_replicas=n_replicas,
+        serving_priority=50, ttft_target_s=0.5,
+        ratio_enabled=True, itl_target_s=0.05,
+        ratio_up_ticks=2, ratio_down_ticks=2, ratio_cooldown_s=0.0,
+        up_cooldown_s=0.0, down_cooldown_s=0.0, flap_window_s=0.0,
+    )
+    cfg.update(cfg_kw)
+    ctrl = FleetController(
+        api=stack.api, sched=stack.sched, registry=stack.registry,
+        gateway=gw, client=client, metrics=metrics,
+        config=ControllerConfig(**cfg),
+    )
+    return stack, client, gw, ctrl, metrics
+
+
+def _roles(stack):
+    return sorted(
+        (r.key, r.role) for r in stack.registry.all()
+    )
+
+
+def test_ratio_reshape_under_ttft_pressure():
+    stack, client, gw, ctrl, metrics = _controller_stack()
+    try:
+        metrics.observe("gateway_ttft_seconds", 0.9)
+        ctrl.tick()                          # primes the TTFT window
+        actions = []
+        for _ in range(3):
+            metrics.observe("gateway_ttft_seconds", 0.9)
+            actions.append(ctrl.tick().get("role_action"))
+        assert any(a and a.startswith("prefill") for a in actions), actions
+        assert metrics.get(
+            "controller_role_reshapes_total", dir="prefill"
+        ) == 1
+        roles = dict(_roles(stack))
+        assert list(roles.values()).count("prefill") == 1
+        # ITL pressure converts it back
+        for _ in range(4):
+            metrics.observe("gateway_itl_seconds", 0.2)
+            metrics.observe("gateway_ttft_seconds", 0.001)
+            ctrl.tick()
+        assert "prefill" not in dict(_roles(stack)).values()
+        assert metrics.get(
+            "controller_role_reshapes_total", dir="decode"
+        ) == 1
+    finally:
+        gw.stop()
+        client.stop()
+
+
+def test_ratio_never_strands_decode_capacity():
+    """A single-replica fleet can never convert to prefill (the floor:
+    at least one non-prefill replica must remain AFTER a flip — with
+    one routable replica, ``len(routable) - prefill > 1`` never holds),
+    no matter how long TTFT pressure persists."""
+    stack, client, gw, ctrl, metrics = _controller_stack(n_replicas=1)
+    try:
+        metrics.observe("gateway_ttft_seconds", 0.9)
+        ctrl.tick()
+        for _ in range(4):
+            metrics.observe("gateway_ttft_seconds", 0.9)
+            ctrl.tick()
+        assert "prefill" not in dict(_roles(stack)).values()
+        assert metrics.get(
+            "controller_role_reshapes_total", dir="prefill"
+        ) == 0
+    finally:
+        gw.stop()
+        client.stop()
+
+
+def test_ratio_collapse_on_handoff_failures_and_rearm():
+    stack, client, gw, ctrl, metrics = _controller_stack(
+        collapse_clear_ticks=2,
+    )
+    try:
+        # reshape one replica to prefill first
+        metrics.observe("gateway_ttft_seconds", 0.9)
+        ctrl.tick()
+        for _ in range(3):
+            metrics.observe("gateway_ttft_seconds", 0.9)
+            ctrl.tick()
+        assert "prefill" in dict(_roles(stack)).values()
+        # now the handoff path starts failing hard
+        for _ in range(3):
+            metrics.inc("gateway_phase_handoff_total", outcome="failed")
+        summary = ctrl.tick()
+        assert summary.get("role_action") == "collapse"
+        assert "prefill" not in dict(_roles(stack)).values()
+        assert not gw.dispatcher.disaggregation
+        assert metrics.get(
+            "controller_role_reshapes_total", dir="collapse"
+        ) == 1
+        # clean ticks re-arm disaggregated serving
+        for _ in range(3):
+            metrics.inc("gateway_phase_handoff_total", outcome="ok")
+            ctrl.tick()
+        assert gw.dispatcher.disaggregation
+    finally:
+        gw.stop()
+        client.stop()
+
+
+# ---------------------------------------------------------------------------
+# role surfaces: /v1/state, POST /v1/role, registry annotation
+# ---------------------------------------------------------------------------
+
+def test_replica_server_role_surface():
+    from kubegpu_tpu.gateway import ReplicaServer, SimBatcher
+
+    srv = ReplicaServer(
+        SimBatcher(slots=4), step_delay_s=0.001, role="prefill",
+    ).start()
+    try:
+        st = json.loads(urllib.request.urlopen(
+            f"http://{srv.endpoint}/v1/state", timeout=5,
+        ).read())
+        assert st["role"] == "prefill"
+        req = urllib.request.Request(
+            f"http://{srv.endpoint}/v1/role",
+            data=json.dumps({"role": "decode"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = json.loads(urllib.request.urlopen(req, timeout=5).read())
+        assert resp["role"] == "decode"
+        st = json.loads(urllib.request.urlopen(
+            f"http://{srv.endpoint}/v1/state", timeout=5,
+        ).read())
+        assert st["role"] == "decode"
+        # an unknown role is a 400, not a silent flex
+        bad = urllib.request.Request(
+            f"http://{srv.endpoint}/v1/role",
+            data=json.dumps({"role": "turbo"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(bad, timeout=5)
+    finally:
+        srv.stop()
+
+
+def test_registry_reads_and_patches_role():
+    from kubegpu_tpu.gateway import ReplicaRegistry
+    from kubegpu_tpu.testing.fake_serving import build_fake_serving_stack
+
+    stack = build_fake_serving_stack(
+        2, roles=("prefill", None),
+    )
+    reg = ReplicaRegistry(stack.api)
+    reg.refresh()
+    roles = {r.key: r.role for r in reg.all()}
+    assert sorted(roles.values()) == ["flex", "prefill"]
+    pre = next(k for k, v in roles.items() if v == "prefill")
+    reg.set_role(pre, "flex")
+    assert reg.get(pre).role == "flex"
+
+
+# ---------------------------------------------------------------------------
+# soak: the kill schedules over the handoff path
+# ---------------------------------------------------------------------------
+
+def test_gateway_soak_disaggregation_inmemory():
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    GatewaySoak(
+        seed=515, n_replicas=4, migration=True, disaggregation=True,
+    ).run(60)
+
+
+def test_gateway_soak_disaggregation_http():
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    GatewaySoak(
+        seed=616, n_replicas=3, migration=True, http=True,
+        disaggregation=True,
+    ).run(40)
+
+
+@pytest.mark.slow
+def test_gateway_soak_disaggregation_paged_kill_schedule(params):
+    """The acceptance schedule: paged fp32 replicas, one a dedicated
+    prefill front-end, under drains, migrations, kill-mid-migration and
+    importer refusals — I5, the trace oracles and both-end page
+    accounting hold at quiescence."""
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    def factory(key):
+        return make_paged(params, slots=8, prompt_pad=16, pool_pages=64)
+
+    GatewaySoak(
+        seed=717, n_replicas=3, batcher_factory=factory,
+        multiturn=True, migration=True, disaggregation=True,
+    ).run(24)
