@@ -3805,6 +3805,14 @@ def serving_disaggregation(extra: dict, tiny: bool = False) -> None:
     - fallback lane: the decode replica refuses imports (chaos knob) —
       every stream finishes ON the prefill replica, token-identical,
       counted fallback, zero request errors.
+    - streamed vs one-shot lane (ISSUE 18): the SAME disaggregated
+      stack with the seal-watch pipeline on vs forced off
+      (``stream_handoff=False``), min-of-pairs interleaved: streamed
+      mean TTFT STRICTLY below one-shot at equal chips (the transfer
+      rides behind prefill compute instead of on the critical path),
+      overlap seconds measured and reported, >= 1 prompt page
+      reclaimed early on the prefill replica, zero deltas in the
+      forced-one-shot arm, token identity in both arms.
     - controller leg: >= 1 ratio reshape (flex -> prefill) under
       sustained TTFT pressure on the SimBatcher controller stack.
     - page accounting balanced on BOTH replicas after every lane."""
@@ -3867,7 +3875,7 @@ def serving_disaggregation(extra: dict, tiny: bool = False) -> None:
                 num_heads=heads, hidden=hidden, max_seq=max_seq,
                 prompt_pad=prompt_pad, page_size=page,
                 pool_pages=pool, dtype=jnp.float32,
-                prefix_cache=False, **cfgs[key],
+                **{"prefix_cache": False, **cfgs[key]},
             )
             for key in keys
         }
@@ -3884,14 +3892,31 @@ def serving_disaggregation(extra: dict, tiny: bool = False) -> None:
     # engine config is the disaggregation dividend the paper claims;
     # greedy fp32 decode is config-independent, so token identity
     # across all four engines stays a hard gate.
+    # the disaggregated set runs WITH a prefix cache: the streamed
+    # pipeline needs submit-time chain keys on the prefill side and a
+    # cache to stage deltas into on the decode side.  Fairness across
+    # passes is restored by flushing every idle cache entry before each
+    # pass (below) — the byte-identical replay must prefill cold every
+    # time, never ride a prior pass's sealed chains.
     batchers_colo = make_batchers({
         k: dict(slots=4, station_slots=4) for k in keys
     })
     batchers_dis = make_batchers({
-        k: (dict(slots=6, station_slots=4) if k == pre_key
-            else dict(slots=6, station_slots=1))
+        k: (dict(slots=6, station_slots=4, prefix_cache=True)
+            if k == pre_key
+            else dict(slots=6, station_slots=1, prefix_cache=True))
         for k in keys
     })
+
+    def flush_prefix_caches(batchers):
+        for cb in batchers.values():
+            if cb.prefix_cache is None:
+                continue
+            page = cb.prefix_cache.evict_lru()
+            while page is not None:
+                cb.free_pages.add(page)
+                page = cb.prefix_cache.evict_lru()
+            cb.assert_page_accounting()
 
     def warm_handoff(a, b):
         # compile the export -> import -> resume path off the clock, at
@@ -3937,11 +3962,12 @@ def serving_disaggregation(extra: dict, tiny: bool = False) -> None:
                 chatty_new,
             ))
 
-    def run_pass(disagg, fail_decode=False):
+    def run_pass(disagg, fail_decode=False, streamed=True):
         """One replay pass; returns ({rid: tokens}, {rid: ttft_s},
         [per-token gap_s], gateway metrics)."""
         stack = stack_dis if disagg else stack_colo
         batchers = batchers_dis if disagg else batchers_colo
+        flush_prefix_caches(batchers)
         client = InMemoryReplicaClient(
             batcher_factory=lambda k: batchers[k], step_delay_s=0.0,
         )
@@ -3961,6 +3987,7 @@ def serving_disaggregation(extra: dict, tiny: bool = False) -> None:
             ),
             metrics=metrics, dispatchers=6,
         )
+        gw.dispatcher.stream_handoff = bool(streamed)
         gw.start()
         try:
             arrivals = {rid: [] for rid, _, _ in replay}
@@ -4009,8 +4036,10 @@ def serving_disaggregation(extra: dict, tiny: bool = False) -> None:
     # here, not to a timed pair
     reference = None
     identical = True
-    for disagg in (False, True):
-        out, _, _, _ = run_pass(disagg)
+    for disagg, streamed in ((False, True), (True, True), (True, False)):
+        # one untimed warm pass per mode AND handoff arm: the streamed
+        # path's delta-stage scatter programs are page-count-shaped
+        out, _, _, _ = run_pass(disagg, streamed=streamed)
         if reference is None:
             reference = out
         identical = identical and out == reference
@@ -4035,9 +4064,12 @@ def serving_disaggregation(extra: dict, tiny: bool = False) -> None:
                     f"{len(replay)}"
                 )
                 handoffs += int(got)
-                wire_bytes += int(metrics.get(
-                    "gateway_phase_handoff_wire_bytes_total"
-                ))
+                wire_bytes += int(
+                    metrics.get("gateway_phase_handoff_wire_bytes_total",
+                                mode="streamed")
+                    + metrics.get("gateway_phase_handoff_wire_bytes_total",
+                                  mode="oneshot")
+                )
         pairs.append((row[False][0], row[True][0],
                       row[False][1], row[True][1]))
     for b in (batchers_colo, batchers_dis):
@@ -4062,6 +4094,53 @@ def serving_disaggregation(extra: dict, tiny: bool = False) -> None:
     fb_identical = out_fb == reference
     for cb in batchers_dis.values():
         cb.assert_page_accounting()
+
+    # ---- streamed vs one-shot handoff, equal chips (ISSUE 18) -----------
+    # same disaggregated stack both arms; the only knob is whether the
+    # seal-watch ships sealed-page deltas during prefill compute — so
+    # the pair isolates exactly the critical-path transfer tail
+    mode_pairs = []     # (oneshot_ttft_mean, streamed_ttft_mean)
+    overlap_sum_s = 0.0
+    overlap_n = deltas_n = 0
+    for i in range(n_pairs):
+        order = (False, True) if i % 2 == 0 else (True, False)
+        row = {}
+        for streamed in order:
+            out, ttft, _, metrics = run_pass(True, streamed=streamed)
+            identical = identical and out == reference
+            row[streamed] = sum(ttft.values()) / max(len(ttft), 1)
+            if streamed:
+                overlap_sum_s += metrics.histogram_sum(
+                    "gateway_phase_handoff_overlap_seconds"
+                )
+                overlap_n += int(metrics.histogram_count(
+                    "gateway_phase_handoff_overlap_seconds"
+                ))
+                deltas_n += int(metrics.get(
+                    "gateway_phase_handoff_deltas_total"
+                ))
+            else:
+                # the forced-one-shot arm must not stream at all
+                assert metrics.get(
+                    "gateway_phase_handoff_deltas_total"
+                ) == 0
+                assert metrics.get(
+                    "gateway_phase_handoff_wire_bytes_total",
+                    mode="streamed",
+                ) == 0
+        mode_pairs.append((row[False], row[True]))
+    for cb in batchers_dis.values():
+        cb.assert_page_accounting()
+    ttft_oneshot, ttft_streamed = min(
+        mode_pairs, key=lambda p: p[1] / max(p[0], 1e-9)
+    )
+    stream_ratio = ttft_streamed / max(ttft_oneshot, 1e-9)
+    # early reclaim: acked prompt pages freed on the prefill replica
+    # before the final handoff roundtrip (all streamed passes so far)
+    reclaimed = int(sum(
+        cb.stats.get("pages_reclaimed", 0)
+        for cb in batchers_dis.values()
+    ))
 
     # ---- controller leg: ratio reshape under TTFT pressure --------------
     from kubegpu_tpu.controller import ControllerConfig, FleetController
@@ -4107,12 +4186,19 @@ def serving_disaggregation(extra: dict, tiny: bool = False) -> None:
         gw_ctrl.stop()
         client_ctrl.stop()
 
+    overlap_mean_ms = (
+        overlap_sum_s / overlap_n * 1e3 if overlap_n else 0.0
+    )
     log(
         f"serving_disaggregation: p99 ITL {itl_dis * 1e3:.1f} ms "
         f"disaggregated vs {itl_colo * 1e3:.1f} ms co-located (equal "
         f"chips); mean TTFT ratio {ttft_ratio:.2f}; handoffs="
         f"{handoffs} wire={wire_bytes}B fallbacks={fallbacks} "
-        f"reshapes={reshapes}"
+        f"reshapes={reshapes}; streamed TTFT "
+        f"{ttft_streamed * 1e3:.1f} ms vs one-shot "
+        f"{ttft_oneshot * 1e3:.1f} ms (ratio {stream_ratio:.2f}), "
+        f"overlap {overlap_mean_ms:.1f} ms/handoff, deltas={deltas_n}, "
+        f"reclaimed={reclaimed} pages"
     )
     extra["serve_disagg_itl_p99_ms"] = round(itl_dis * 1e3, 2)
     extra["serve_disagg_itl_p99_colo_ms"] = round(itl_colo * 1e3, 2)
@@ -4125,6 +4211,18 @@ def serving_disaggregation(extra: dict, tiny: bool = False) -> None:
     extra["serve_disagg_fallbacks"] = fallbacks
     extra["serve_disagg_fallback_token_identical"] = bool(fb_identical)
     extra["serve_disagg_reshapes"] = reshapes
+    extra["serve_disagg_stream_ttft_ms"] = round(ttft_streamed * 1e3, 2)
+    extra["serve_disagg_oneshot_ttft_ms"] = round(ttft_oneshot * 1e3, 2)
+    extra["serve_disagg_stream_ratio"] = round(stream_ratio, 3)
+    extra["serve_disagg_stream_strictly_better"] = bool(
+        ttft_streamed < ttft_oneshot
+    )
+    extra["serve_disagg_overlap_ms_per_handoff"] = round(
+        overlap_mean_ms, 2
+    )
+    extra["serve_disagg_deltas"] = deltas_n
+    extra["serve_disagg_pages_reclaimed"] = reclaimed
+    extra["serve_disagg_reclaim_ok"] = bool(reclaimed >= 1)
 
 
 def serving_tp_paged(extra: dict, tiny: bool = False) -> None:

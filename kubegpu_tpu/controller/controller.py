@@ -82,6 +82,15 @@ class ControllerConfig:
     # disaggregation rung)
     handoff_fail_fraction: float = 0.5
     collapse_clear_ticks: int = 5    # clean ticks before re-arming
+    # TTFT pressure splits two ways: compute-bound (prompts queueing
+    # for prefill chips — more prefill bandwidth helps) vs
+    # handoff-bound (the transfer's CRITICAL-PATH tail dominates —
+    # flipping more replicas to prefill cannot shrink it).  The
+    # streamed pipeline exposes the split: exposed tax per handoff =
+    # (handoff_seconds - overlap_seconds) / handoffs over the tick's
+    # window.  Above this fraction of the TTFT target, a hot-TTFT tick
+    # does NOT count toward the flex->prefill flip.
+    handoff_tax_fraction: float = 0.5
     # -- brownout ladder ---------------------------------------------------
     brownout_threshold: float = 2.0  # pressure with nowhere to grow
     brownout_clear_threshold: float = 0.8
@@ -184,6 +193,7 @@ class FleetController:
         self._collapsed = False
         self._collapse_clear = 0
         self._prev_handoffs: Dict[str, float] = {}
+        self._prev_handoff_times: Dict[str, float] = {}
         self._resume()
 
     # -- crash-resume ------------------------------------------------------
@@ -644,6 +654,28 @@ class FleetController:
         d = {o: max(0.0, cur[o] - prev.get(o, 0.0)) for o in cur}
         return d["ok"], d["fallback"] + d["failed"]
 
+    def _handoff_exposed_tax(self) -> float:
+        """This tick's mean CRITICAL-PATH handoff seconds per handoff:
+        window diff of total handoff time minus the part the streamed
+        pipeline overlapped with prefill compute.  0.0 when no handoff
+        landed this window."""
+        cur = {
+            "sum": self.metrics.histogram_sum(
+                "gateway_phase_handoff_seconds"
+            ),
+            "overlap": self.metrics.histogram_sum(
+                "gateway_phase_handoff_overlap_seconds"
+            ),
+            "count": self.metrics.histogram_count(
+                "gateway_phase_handoff_seconds"
+            ),
+        }
+        prev, self._prev_handoff_times = self._prev_handoff_times, cur
+        d = {k: max(0.0, cur[k] - prev.get(k, 0.0)) for k in cur}
+        if d["count"] <= 0:
+            return 0.0
+        return max(0.0, d["sum"] - d["overlap"]) / d["count"]
+
     def _ratio_tick(self, sample, now: float) -> str:
         """The second actuator: reshape the prefill:decode RATIO from
         the same pressure signal that drives replica count.  TTFT
@@ -667,6 +699,12 @@ class FleetController:
             "controller_prefill_replicas", len(prefill)
         )
         ok_n, bad_n = self._handoff_window()
+        # diffed every tick alongside the outcome window so the two
+        # stay aligned even across collapsed stretches
+        exposed_tax = self._handoff_exposed_tax()
+        self.metrics.set_gauge(
+            "controller_handoff_exposed_tax_s", exposed_tax
+        )
         if self._collapsed:
             if bad_n == 0:
                 self._collapse_clear += 1
@@ -706,8 +744,19 @@ class FleetController:
             sample.completed > 0
             and sample.itl_mean_s >= cfg.itl_target_s
         )
+        # handoff-bound TTFT: the critical-path transfer tail (total
+        # handoff time minus the streamed overlap) dominates the TTFT
+        # budget.  More prefill bandwidth cannot shrink a wire tail, so
+        # the tick does not count toward the flex->prefill flip — the
+        # pressure clears by streaming more (or is a capacity problem).
+        handoff_bound = (
+            ttft_hot
+            and exposed_tax >= cfg.handoff_tax_fraction * cfg.ttft_target_s
+        )
         self._ttft_ticks = (
-            self._ttft_ticks + 1 if ttft_hot and not itl_hot else 0
+            self._ttft_ticks + 1
+            if ttft_hot and not itl_hot and not handoff_bound
+            else 0
         )
         self._itl_ticks = (
             self._itl_ticks + 1 if itl_hot and not ttft_hot else 0

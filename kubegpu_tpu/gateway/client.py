@@ -192,7 +192,7 @@ class ReplicaClient:
 
     def migrate(self, attempt: Attempt, request, to_key: str,
                 _between: Optional[Callable[[], None]] = None,
-                fallback: bool = False) -> bool:
+                fallback: bool = False, cursor: int = 0) -> bool:
         """Move a live in-flight sequence to another replica: export +
         detach at the source, import + resume at the target; the SAME
         attempt handle keeps streaming and eventually resolves with the
@@ -202,8 +202,36 @@ class ReplicaClient:
         import dispatch (the soak's kill-mid-migration schedules).
         ``fallback`` (the post-prefill handoff contract): an import
         refusal/death re-imports the held payload into the SOURCE and
-        resumes decode there instead of erroring the attempt."""
+        resumes decode there instead of erroring the attempt.
+        ``cursor`` (the streamed handoff): the first ``cursor`` pages
+        already shipped as acked deltas, so the final export carries
+        keys for every page but bytes only from ``cursor`` on."""
         return False
+
+    # -- streamed seal-time handoff (optional capability) ------------------
+    # Data planes that can ship sealed pages DURING prefill override
+    # these; the defaults say "unsupported", which degrades a handoff
+    # to the one-shot post-seal transfer.
+
+    def export_delta(self, attempt: Attempt, request,
+                     cursor: int) -> Optional[dict]:
+        """Pages of the attempt's sequence sealed since page index
+        ``cursor`` (chain keys included), or None (nothing new sealed,
+        sequence not streamable right now, or unsupported)."""
+        return None
+
+    def import_delta(self, replica_key: str, payload) -> Optional[int]:
+        """Stage a streamed-handoff delta on a replica's prefix cache.
+        Returns the staged-page count (an ACK — the exporter may
+        reclaim through the delta's pages), or None when refused or
+        unsupported (the dispatcher falls back to one-shot)."""
+        return None
+
+    def reclaim(self, attempt: Attempt, request, upto: int) -> int:
+        """Release the attempt's first ``upto`` pages on its (parked)
+        prefill replica — they were acked by the importer.  Returns
+        pages freed; 0 = nothing to do or unsupported (best-effort)."""
+        return 0
 
     def export_sealed(self, replica_key: str, stream) -> Optional[dict]:
         """Capture a finished stream's sealed prefix-chain pages from a
@@ -343,7 +371,15 @@ class SimBatcher:
         self._parked: set = set()            # parked (sealed) seq ids
         self._imported: set = set()          # seqs that arrived via import
         self._sealed_pending: List[int] = [] # sealed-but-unannounced
-        self.stats = {"steps": 0, "admits": 0, "imports": 0}
+        # streamed-handoff twins: the mill's "sealed chain" is one sim
+        # page per prompt token (no KV — pure cursor bookkeeping, so
+        # the dispatcher's seal-watch/delta/ack/reclaim machinery runs
+        # against the mill too)
+        self._plen: Dict[int, int] = {}      # seq -> prompt length
+        self._reclaimed: Dict[int, int] = {} # seq -> reclaim watermark
+        self._staged: set = set()            # delta page keys staged here
+        self.stats = {"steps": 0, "admits": 0, "imports": 0,
+                      "pages_reclaimed": 0}
 
     def submit(self, seq_id: int, prompt, max_new: int,
                temperature: float = 0.0,
@@ -364,6 +400,10 @@ class SimBatcher:
             self._spans[seq_id] = {
                 "serve": serve, "queue": serve.child("queue"),
             }
+        try:
+            self._plen[seq_id] = len(prompt)
+        except TypeError:
+            self._plen[seq_id] = 0
         self._pending.append((
             seq_id, int(max_new),
             seq_id if stream_seed is None else int(stream_seed),
@@ -386,6 +426,7 @@ class SimBatcher:
         for i, (sid, *_rest) in enumerate(self._pending):
             if sid == seq_id:
                 del self._pending[i]
+                self._plen.pop(sid, None)
                 if sid in self._spans:
                     self._trace_end(self._spans.pop(sid), "cancelled")
                 return True
@@ -403,6 +444,8 @@ class SimBatcher:
             self._rr.remove(seq_id)
         self._imported.discard(seq_id)
         self._seed.pop(seq_id, None)
+        self._plen.pop(seq_id, None)
+        self._reclaimed.pop(seq_id, None)
         if seq_id in self._spans:
             self._trace_end(self._spans.pop(seq_id), "cancelled")
         return True
@@ -411,16 +454,18 @@ class SimBatcher:
     # the mill has no pages, so the payload is the stream cursor alone —
     # which is exactly what keeps soak streams deterministic across a
     # migration (token i depends only on (seed, i))
-    def export_pages(self, seq_id: int) -> dict:
+    def export_pages(self, seq_id: int, cursor: int = 0) -> dict:
         ent = self._active.get(seq_id)
         if ent is None:
             raise KeyError(f"sequence {seq_id} not active")
         tokens, max_new = ent
+        # cursor (streamed handoff): the mill ships no bytes, so the
+        # final export just records the offset — importers ignore it
         return {
             "kind": "live", "sim": True, "tokens": list(tokens),
             "max_new": int(max_new),
             "seed": int(self._seed.get(seq_id, seq_id)),
-            "kv_dtype": self.kv_dtype,
+            "kv_dtype": self.kv_dtype, "layer_base": int(cursor),
         }
 
     def import_pages(self, seq_id: int, payload: dict,
@@ -485,17 +530,110 @@ class SimBatcher:
     def set_prefill_only(self, flag: bool) -> bool:
         """Flip the serving mode live (the controller's role actuator).
         Disabling UNPARKS every sealed sequence into the decode ring —
-        collapse-to-colocated must never strand a parked stream."""
+        collapse-to-colocated must never strand a parked stream.
+        Mirror of the paged contract: a sequence whose handoff stream
+        already RECLAIMED pages stays parked (its handoff completes or
+        falls back through the import path instead)."""
         flag = bool(flag)
         changed = flag != self.prefill_only
         self.prefill_only = flag
         if not flag:
-            for seq in sorted(self._parked):
+            keep = {s for s in self._parked if self._reclaimed.get(s)}
+            for seq in sorted(self._parked - keep):
                 if seq in self._active:
                     self._rr.append(seq)
-            self._parked.clear()
+            self._parked = keep
             self._sealed_pending = []
         return changed
+
+    # -- streamed seal-time handoff twins ----------------------------------
+    def export_sealed_delta(self, seq_id: int,
+                            cursor: int) -> Optional[dict]:
+        """The paged batcher's streaming export, mill-modeled: the sim
+        chain is one page per prompt token (sealed in full the moment
+        the sequence parks — the mill's prefill is instant), each page
+        keyed by (stream seed, index) so staging dedups exactly like
+        content addressing."""
+        if seq_id not in self._active:
+            raise KeyError(f"sequence {seq_id} not active")
+        if seq_id not in self._parked:
+            raise ValueError(f"sequence {seq_id} is decoding")
+        n = self._plen.get(seq_id, 0)
+        cursor = int(cursor)
+        if cursor < 0 or cursor > n:
+            raise ValueError(
+                f"delta cursor {cursor} outside sealed bound {n}"
+            )
+        if cursor < self._reclaimed.get(seq_id, 0):
+            raise ValueError(
+                f"delta cursor {cursor} below reclaim watermark "
+                f"{self._reclaimed[seq_id]}"
+            )
+        if cursor == n:
+            return None
+        seed = self._seed.get(seq_id, seq_id)
+        return {
+            "kind": "delta", "sim": True, "cursor": cursor,
+            "page_keys": [f"{seed:x}:{j:x}" for j in range(cursor, n)],
+            "sealed": True, "kv_dtype": self.kv_dtype,
+        }
+
+    def import_sealed_delta(self, payload: dict) -> int:
+        """Stage a delta's sim pages (dedup by key, like the paged
+        cache); the count returned is the exporter's ACK."""
+        if payload.get("kind") != "delta" or not payload.get("sim"):
+            raise ValueError("not a sim-mill delta payload")
+        if payload.get("kv_dtype", "bfloat16") != self.kv_dtype:
+            raise ValueError(
+                f"transfer geometry mismatch on kv_dtype: payload "
+                f"{payload.get('kv_dtype')!r} vs this batcher "
+                f"{self.kv_dtype!r}"
+            )
+        fresh = [
+            k for k in payload.get("page_keys") or []
+            if k not in self._staged
+        ]
+        self._staged.update(fresh)
+        return len(fresh)
+
+    def reclaim_handoff_pages(self, seq_id: int, upto: int) -> int:
+        """Advance a parked sequence's reclaim watermark (the mill has
+        no pool — the counter IS the contract under test)."""
+        if seq_id not in self._active:
+            raise KeyError(f"sequence {seq_id} not active")
+        if seq_id not in self._parked:
+            return 0
+        upto = min(int(upto), self._plen.get(seq_id, 0))
+        freed = max(0, upto - self._reclaimed.get(seq_id, 0))
+        if freed:
+            self._reclaimed[seq_id] = upto
+            self.stats["pages_reclaimed"] += freed
+        return freed
+
+    def assert_page_accounting(self) -> None:
+        """The mill's invariant twin of the paged batcher's check —
+        what the soak's both-ends oracle holds a sim replica to:
+        every active sequence sits in exactly one of the decode ring
+        or the parked set, every pending seal announcement names a
+        parked sequence, and reclaim watermarks only ever cover a
+        parked sequence's sealed sim chain."""
+        ring = set(self._rr)
+        for seq in self._active:
+            parked = seq in self._parked
+            assert parked != (seq in ring), (
+                f"seq {seq}: parked={parked}, in_ring={seq in ring}"
+            )
+        for seq in self._sealed_pending:
+            assert seq in self._parked, (
+                f"seal announced for unparked seq {seq}"
+            )
+        for seq, upto in self._reclaimed.items():
+            assert seq in self._parked, (
+                f"reclaim watermark on unparked seq {seq}"
+            )
+            assert upto <= self._plen.get(seq, 0), (
+                f"seq {seq} reclaimed {upto} past its chain"
+            )
 
     def has_work(self) -> bool:
         return bool(self._pending) or bool(self._active)
@@ -520,6 +658,7 @@ class SimBatcher:
             if max_new <= 0:
                 if spans is not None:
                     self._trace_end(self._spans.pop(seq), "finished")
+                self._plen.pop(seq, None)
                 finished[seq] = []
             else:
                 # a re-submitted still-active seq restarts its stream but
@@ -543,7 +682,11 @@ class SimBatcher:
                 self._seed[seq] = seed
         if self._active:
             self.stats["steps"] += 1
-            n = len(self._active)
+            # parked sequences run ZERO decode steps: counting them
+            # against the token budget would starve the ring of rows
+            # they never use (the real batcher's admission budget
+            # excludes parked slots the same way)
+            n = sum(1 for s in self._active if s not in self._parked)
             if self.token_budget is not None:
                 # a speculative sequence bills its whole k+1-row verify
                 # window; at least one sequence always advances (the
@@ -576,6 +719,8 @@ class SimBatcher:
                     finished[seq] = tokens
                     del self._active[seq]
                     self._seed.pop(seq, None)
+                    self._plen.pop(seq, None)
+                    self._reclaimed.pop(seq, None)
                     if seq in self._spans:
                         self._trace_end(self._spans.pop(seq), "finished")
                 else:
@@ -1044,9 +1189,82 @@ class InMemoryReplicaClient(ReplicaClient):
         except Exception:  # noqa: BLE001 - restore is best-effort
             return False
 
+    # -- streamed seal-time handoff ----------------------------------------
+    def export_delta(self, attempt: Attempt, request,
+                     cursor: int) -> Optional[dict]:
+        """Pages sealed since ``cursor`` on the attempt's replica, run
+        ON its serving thread (read-only; single-driver batchers).
+        None = nothing new, not streamable right now, or the replica
+        is gone — streaming is best-effort by contract, so every
+        failure mode degrades to the one-shot handoff."""
+        with self._lock:
+            src = self._workers.get(attempt.replica)
+        if src is None or not hasattr(
+            src.batcher, "export_sealed_delta"
+        ):
+            return None
+
+        def op():
+            seq = next(
+                (s for s, a in src.by_seq.items() if a is attempt), None
+            )
+            if seq is None:
+                return None
+            return src.batcher.export_sealed_delta(seq, cursor)
+
+        try:
+            return src.control(op)
+        except Exception:  # noqa: BLE001 - streaming is best-effort
+            return None
+
+    def import_delta(self, replica_key: str, payload) -> Optional[int]:
+        """Stage a delta on the target's serving thread.  The returned
+        count is the ACK the exporter's early reclaim keys off; None =
+        refused (chaos knob, pool pressure, geometry) or unreachable —
+        the dispatcher falls back to the one-shot transfer."""
+        if payload is None:
+            return None
+        with self._lock:
+            dst = self._workers.get(replica_key)
+        if dst is None or not hasattr(
+            dst.batcher, "import_sealed_delta"
+        ):
+            return None
+
+        def op():
+            if dst.fail_migration:
+                raise RuntimeError("delta import refused (chaos knob)")
+            return dst.batcher.import_sealed_delta(payload)
+
+        try:
+            return dst.control(op)
+        except Exception:  # noqa: BLE001 - refusal = fall back
+            return None
+
+    def reclaim(self, attempt: Attempt, request, upto: int) -> int:
+        with self._lock:
+            src = self._workers.get(attempt.replica)
+        if src is None or not hasattr(
+            src.batcher, "reclaim_handoff_pages"
+        ):
+            return 0
+
+        def op():
+            seq = next(
+                (s for s, a in src.by_seq.items() if a is attempt), None
+            )
+            if seq is None:
+                return 0
+            return src.batcher.reclaim_handoff_pages(seq, upto)
+
+        try:
+            return src.control(op) or 0
+        except Exception:  # noqa: BLE001 - reclaim is best-effort
+            return 0
+
     def migrate(self, attempt: Attempt, request, to_key: str,
                 _between: Optional[Callable[[], None]] = None,
-                fallback: bool = False) -> bool:
+                fallback: bool = False, cursor: int = 0) -> bool:
         """Live migration over the in-memory plane: export + detach on
         the source worker's thread (atomic — no step can interleave),
         then import + re-register the SAME attempt on the target's.  A
@@ -1089,7 +1307,8 @@ class InMemoryReplicaClient(ReplicaClient):
             )
             if seq is None:
                 raise KeyError("attempt not live on the source")
-            payload = src.batcher.export_pages(seq)
+            payload = (src.batcher.export_pages(seq, cursor)
+                       if cursor else src.batcher.export_pages(seq))
             # flush any tokens the export's pipeline drain just
             # committed, so the streaming relay misses nothing
             src._flush_sinks()

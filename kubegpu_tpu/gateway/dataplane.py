@@ -336,12 +336,15 @@ class ReplicaServingLoop:
             raise val
         return val
 
-    def export_live(self, request_id: str) -> dict:
+    def export_live(self, request_id: str, cursor: int = 0) -> dict:
         """Export + DETACH one live stream's sequence — the migration
         source half, atomic on the serving thread: the payload is
         captured, freshly-committed tokens flush to the stream, the
         sequence's pages free, and the stream ends with a ``migrated``
-        terminal whose span dicts ship for the gateway-side graft."""
+        terminal whose span dicts ship for the gateway-side graft.
+        A nonzero ``cursor`` ships layers only for pages >= cursor —
+        the streamed-handoff final hop, where earlier pages were
+        already delta-staged (and possibly reclaimed) on the target."""
         def op():
             st = self._streams.get(request_id)
             if st is None or st.closed or st.seq is None:
@@ -350,7 +353,8 @@ class ReplicaServingLoop:
                 raise ValueError(
                     "batcher does not speak the migration verbs"
                 )
-            payload = self.batcher.export_pages(st.seq)
+            payload = (self.batcher.export_pages(st.seq, cursor)
+                       if cursor else self.batcher.export_pages(st.seq))
             self._flush({})   # the export drain may have committed tokens
             self.batcher.cancel(st.seq)
             self._finish(st, "error", "migrated")
@@ -421,6 +425,46 @@ class ReplicaServingLoop:
                     "batcher does not speak the migration verbs"
                 )
             return fn(payload)
+
+        return self.control(op)
+
+    # -- streamed seal-time handoff ------------------------------------
+    def export_delta(self, request_id: str, cursor: int) -> Optional[dict]:
+        """Pages sealed since ``cursor`` for a live stream's sequence,
+        without detaching anything — the seal-watch read.  None means
+        nothing new yet (or the batcher doesn't stream)."""
+        def op():
+            st = self._streams.get(request_id)
+            if st is None or st.closed or st.seq is None:
+                raise KeyError(f"no live stream {request_id!r}")
+            fn = getattr(self.batcher, "export_sealed_delta", None)
+            return fn(st.seq, cursor) if fn is not None else None
+
+        return self.control(op)
+
+    def import_delta(self, payload) -> int:
+        def op():
+            if self.fail_migration:
+                raise RuntimeError("delta import refused (chaos knob)")
+            fn = getattr(self.batcher, "import_sealed_delta", None)
+            if fn is None:
+                raise ValueError(
+                    "batcher does not speak the streaming verbs"
+                )
+            return fn(payload)
+
+        return self.control(op)
+
+    def reclaim(self, request_id: str, upto: int) -> int:
+        """Release the first ``upto`` pages of a parked sequence — the
+        importer acked their delta copies, so the originals go back to
+        the pool and a queued prefill can admit DURING the handoff."""
+        def op():
+            st = self._streams.get(request_id)
+            if st is None or st.closed or st.seq is None:
+                raise KeyError(f"no live stream {request_id!r}")
+            fn = getattr(self.batcher, "reclaim_handoff_pages", None)
+            return fn(st.seq, upto) if fn is not None else 0
 
         return self.control(op)
 
@@ -908,8 +952,40 @@ def make_replica_handler(loop: ReplicaServingLoop,
                 return
             t0 = time.monotonic()
             try:
+                if body.get("request_id") and body.get("reclaim") is not None:
+                    # early reclaim: the importer acked a delta, so the
+                    # parked source releases those pages to its pool
+                    n = loop.reclaim(
+                        str(body["request_id"]), int(body["reclaim"])
+                    )
+                    self._send_json(200, {"reclaimed": n})
+                    return
+                if body.get("request_id") and body.get("delta"):
+                    payload = loop.export_delta(
+                        str(body["request_id"]),
+                        int(body.get("cursor") or 0),
+                    )
+                    wire = (
+                        encode_kv_payload(payload)
+                        if payload is not None else None
+                    )
+                    out = json.dumps({"payload": wire}).encode()
+                    if metrics is not None and payload is not None:
+                        metrics.inc(
+                            "replica_migrate_wire_bytes_total",
+                            len(out), dir="export",
+                        )
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(out)))
+                    self.end_headers()
+                    self.wfile.write(out)
+                    return
                 if body.get("request_id"):
-                    payload = loop.export_live(str(body["request_id"]))
+                    payload = loop.export_live(
+                        str(body["request_id"]),
+                        int(body.get("cursor") or 0),
+                    )
                 elif body.get("stream") is not None:
                     payload = loop.export_sealed(
                         [int(t) for t in body["stream"]]
@@ -971,6 +1047,21 @@ def make_replica_handler(loop: ReplicaServingLoop,
                 )
                 return
             t0 = time.monotonic()
+            if payload.get("kind") == "delta":
+                # stage a streamed-handoff delta in the prefix cache;
+                # the {"staged": n} ack licenses the source's reclaim
+                try:
+                    n = loop.import_delta(payload)
+                except (ValueError, RuntimeError) as e:
+                    self._send_json(503, {"error": str(e)})
+                    return
+                if metrics is not None:
+                    metrics.inc(
+                        "replica_migrate_wire_bytes_total", wire_bytes,
+                        dir="import",
+                    )
+                self._send_json(200, {"staged": n})
+                return
             if not body.get("request_id"):
                 try:
                     n = loop.import_sealed(payload)
@@ -1391,6 +1482,64 @@ class HttpReplicaClient(ReplicaClient):
         finally:
             conn.close()
 
+    # -- streamed seal-time handoff ------------------------------------
+    def export_delta(self, attempt: Attempt, request,
+                     cursor: int) -> Optional[dict]:
+        """POST /v1/export {"delta": true}: pages sealed since
+        ``cursor``, kept wire-encoded — like migrate's payload, the
+        gateway relays deltas opaquely and only replicas pay the
+        codec."""
+        addr = self.endpoint_for(attempt.replica)
+        if addr is None:
+            return None
+        return self._wire_export(addr, {
+            "request_id": request.request_id,
+            "delta": True, "cursor": int(cursor),
+        })
+
+    def import_delta(self, replica_key: str, payload) -> Optional[int]:
+        addr = self.endpoint_for(replica_key)
+        if addr is None or payload is None:
+            return None
+        conn = self._connect(addr, timeout=self.timeout_s)
+        try:
+            conn.request(
+                "POST", "/v1/import", json.dumps({"payload": payload}),
+                self._headers({"Content-Type": "application/json"}),
+            )
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                return None
+            staged = json.loads(data).get("staged")
+            return int(staged) if staged is not None else None
+        except (OSError, ValueError, TypeError):
+            return None
+        finally:
+            conn.close()
+
+    def reclaim(self, attempt: Attempt, request, upto: int) -> int:
+        addr = self.endpoint_for(attempt.replica)
+        if addr is None:
+            return 0
+        conn = self._connect(addr, timeout=self.timeout_s)
+        try:
+            conn.request(
+                "POST", "/v1/export",
+                json.dumps({"request_id": request.request_id,
+                            "reclaim": int(upto)}),
+                self._headers({"Content-Type": "application/json"}),
+            )
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                return 0
+            return int(json.loads(data).get("reclaimed") or 0)
+        except (OSError, ValueError, TypeError):
+            return 0
+        finally:
+            conn.close()
+
     def set_role(self, key: str, role: str) -> bool:
         """POST /v1/role: flip a replica's serving role at runtime (the
         fleet controller's ratio actuator, wire flavor)."""
@@ -1413,7 +1562,7 @@ class HttpReplicaClient(ReplicaClient):
 
     def migrate(self, attempt: Attempt, request, to_key: str,
                 _between: Optional[Callable[[], None]] = None,
-                fallback: bool = False) -> bool:
+                fallback: bool = False, cursor: int = 0) -> bool:
         """Live migration over the wire: POST /v1/export on the source
         (which detaches the sequence — its stream ends ``migrated``,
         which the source's reader recognizes and leaves unresolved),
@@ -1450,9 +1599,12 @@ class HttpReplicaClient(ReplicaClient):
             if trace is not None else None
         )
         attempt._migrating = True
-        wire = self._wire_export(
-            from_addr, {"request_id": request.request_id}
-        )
+        export_body = {"request_id": request.request_id}
+        if cursor:
+            # streamed handoff's final hop: layers below the cursor
+            # were already delta-staged on the target
+            export_body["cursor"] = int(cursor)
+        wire = self._wire_export(from_addr, export_body)
         if wire is None:
             # nothing detached — or the export RESPONSE was lost after
             # the replica already detached.  Clear the flag first: a

@@ -26,7 +26,7 @@ from typing import Callable, List, Optional
 
 from kubegpu_tpu.gateway.client import Attempt, ReplicaClient
 from kubegpu_tpu.gateway.registry import ReplicaInfo
-from kubegpu_tpu.gateway.router import Router, _mesh_distance
+from kubegpu_tpu.gateway.router import Router, handoff_rank_key
 # SessionKVStore moved to gateway/sessionstore.py when it grew pluggable
 # backends (external HTTP store, PR 13); re-exported here because this
 # module is its historical home and half the stack imports it from here.
@@ -147,6 +147,15 @@ class Dispatcher:
         # fallback) lands, so the collapse only changes the TARGET
         # ranking, never whether we act.
         self.disaggregation = True
+        # streamed seal-time handoff: the dispatcher loop ships pages
+        # sealed so far to the adjacency-picked decode target DURING the
+        # remaining prefill compute, so only the final delta + cursor
+        # handoff rides the TTFT critical path.  ``stream_handoff=False``
+        # forces the one-shot transfer (the bench's comparison lane);
+        # ``delta_poll_s`` bounds seal-watch poll pressure on the
+        # source's serving thread.
+        self.stream_handoff = True
+        self.delta_poll_s = 0.01
 
     # -- outstanding bookkeeping ------------------------------------------
     def _inc(self, key: str) -> None:
@@ -237,62 +246,165 @@ class Dispatcher:
         return attempt
 
     # -- post-prefill handoff (disaggregation) -----------------------------
+    def _pick_handoff_target(self, src: str,
+                             replicas: List[ReplicaInfo]) -> Optional[str]:
+        """Best decode-side peer for a handoff from ``src``, by the
+        shared adjacency score (same slice → mesh distance → load).
+        None = no peer at all (the caller co-locates on the source)."""
+        if not self.disaggregation:
+            return None
+        anchor = next((r for r in replicas if r.key == src), None)
+        cand = [
+            r for r in replicas
+            if r.key != src and getattr(r, "role", "flex") != "prefill"
+        ] or [r for r in replicas if r.key != src]
+        if not cand:
+            return None
+        return min(
+            cand,
+            key=lambda r: handoff_rank_key(r, anchor, self.outstanding),
+        ).key
+
+    def _stream_deltas(self, attempt: Attempt, request, replicas_fn,
+                       force: bool = False) -> None:
+        """Seal-watch step: ship pages sealed since the last poll to the
+        pre-picked handoff target, overlapping the wire with remaining
+        prefill compute.  Strictly best-effort — a refused or lost delta
+        abandons streaming and the seal-time one-shot handoff takes
+        over (nothing was reclaimed yet: reclaim only runs at seal,
+        against the acked watermark).  ``force`` skips the poll rate
+        limit (the seal-time drain of whatever never got streamed)."""
+        if not self.stream_handoff or not self.disaggregation:
+            return
+        client = self.client
+        if getattr(client, "export_delta", None) is None:
+            return
+        st = getattr(attempt, "_handoff_stream", None)
+        now = time.monotonic()
+        if st is None:
+            replicas = (
+                replicas_fn() if callable(replicas_fn) else replicas_fn
+            )
+            src = next(
+                (r for r in replicas if r.key == attempt.replica), None
+            )
+            target = (
+                self._pick_handoff_target(attempt.replica, replicas)
+                if src is not None
+                and getattr(src, "role", "flex") == "prefill"
+                else None
+            )
+            st = attempt._handoff_stream = {
+                # failed=True doubles as "don't stream": non-prefill
+                # source (nothing parks → nothing seals early) or no
+                # decode peer to stream toward
+                "target": target, "cursor": 0, "acked": 0, "deltas": 0,
+                "overlap_s": 0.0, "failed": target is None,
+                "next_poll": 0.0,
+            }
+        if st["failed"] or (not force and now < st["next_poll"]):
+            return
+        st["next_poll"] = now + self.delta_poll_s
+        sealed_already = attempt.sealed.is_set()
+        t0 = time.monotonic()
+        try:
+            payload = client.export_delta(attempt, request, st["cursor"])
+        except Exception:  # noqa: BLE001 - streaming is best-effort
+            payload = None
+        if payload is None:
+            return
+        n = len(payload.get("page_keys") or [])
+        if n == 0:
+            return
+        try:
+            staged = client.import_delta(st["target"], payload)
+        except Exception:  # noqa: BLE001 - refusal = fall back
+            staged = None
+        if staged is None:
+            # target refused or died: its staged prefix is unreliable,
+            # so forget the acked watermark and let the one-shot
+            # handoff ship everything (no reclaim has happened yet)
+            st["failed"] = True
+            st["acked"] = 0
+            return
+        st["cursor"] += n
+        st["acked"] = st["cursor"]
+        st["deltas"] += 1
+        if not sealed_already:
+            st["overlap_s"] += time.monotonic() - t0
+        if self.metrics:
+            self.metrics.inc("gateway_phase_handoff_deltas_total")
+
     def _do_handoff(self, attempt: Attempt, request,
                     replicas: List[ReplicaInfo]) -> None:
         """The sequence's prompt pages sealed on a prefill-only replica
         and it PARKED (zero tokens emitted): hand it off to a decode
-        replica through the migration verbs — slice locality first (ICI
-        beats DCN on handoff wire time), then mesh distance, then load.
-        With no decode peer (or disaggregation collapsed), the source
-        itself is the target: detach-and-resume locally through the same
-        verb pair, so a parked sequence NEVER decodes nowhere."""
+        replica through the migration verbs — adjacency-scored (slice
+        locality first: ICI beats DCN on handoff wire time, then mesh
+        distance, then load).  If the seal-watch streamed deltas, drain
+        the tail, RECLAIM the acked pages on the source (they admit a
+        queued prefill during this very roundtrip), and ship only the
+        cursor remainder.  With no decode peer (or disaggregation
+        collapsed), the source itself is the target: detach-and-resume
+        locally through the same verb pair, so a parked sequence NEVER
+        decodes nowhere."""
         attempt._handed_off = True
         migrate = getattr(self.client, "migrate", None)
         if migrate is None:
             return
         src = attempt.replica
-        anchor = next((r for r in replicas if r.key == src), None)
-        cand: List[ReplicaInfo] = []
-        if self.disaggregation:
-            cand = [
-                r for r in replicas
-                if r.key != src
-                and getattr(r, "role", "flex") != "prefill"
-            ] or [r for r in replicas if r.key != src]
-
-        def rank(r: ReplicaInfo):
-            return (
-                0 if (
-                    anchor is not None and r.slice_id == anchor.slice_id
-                ) else 1,
-                _mesh_distance(r, anchor) if (
-                    anchor is not None and r.slice_id == anchor.slice_id
-                ) else 0,
-                self.outstanding.get(r.key, 0),
-                r.key,
-            )
-
-        target_key = min(cand, key=rank).key if cand else src
+        st = getattr(attempt, "_handoff_stream", None)
+        cursor = 0
+        target_key = None
+        if st is not None and not st["failed"]:
+            # final drain: everything sealed since the last poll goes
+            # now, so the critical-path hop carries only the cursor
+            self._stream_deltas(attempt, request, replicas, force=True)
+            if not st["failed"] and st["acked"] > 0:
+                cursor = st["acked"]
+                target_key = st["target"]
+                try:
+                    self.client.reclaim(attempt, request, cursor)
+                except Exception:  # noqa: BLE001 - reclaim best-effort
+                    log.exception("early reclaim failed")
+        if target_key is None:
+            target_key = self._pick_handoff_target(src, replicas) or src
+        attempt._handoff_mode = "streamed" if cursor else "oneshot"
         trace = getattr(request, "trace", None)
         if trace is not None:
-            trace.event("phase_handoff", source=src, target=target_key)
+            trace.event(
+                "phase_handoff", source=src, target=target_key,
+                mode=attempt._handoff_mode, cursor=cursor,
+            )
         t0 = time.monotonic()
         ok = False
         try:
-            ok = migrate(attempt, request, target_key, fallback=True)
+            ok = migrate(
+                attempt, request, target_key, fallback=True,
+                cursor=cursor,
+            )
         except Exception:  # noqa: BLE001 - handoff is best-effort
             log.exception("phase handoff failed")
         if not ok and target_key != src and not attempt.done:
             # the decode-side leg never started (export lost, target
-            # unresolvable): unpark locally instead
+            # unresolvable): unpark locally instead.  The cursor still
+            # applies — reclaimed pages re-resolve from the source's
+            # own prefix cache on the fallback import.
             try:
-                ok = migrate(attempt, request, src, fallback=True)
+                ok = migrate(
+                    attempt, request, src, fallback=True, cursor=cursor,
+                )
             except Exception:  # noqa: BLE001 - same contract
                 log.exception("local handoff fallback failed")
         if self.metrics:
             self.metrics.observe(
                 "gateway_phase_handoff_seconds", time.monotonic() - t0
             )
+            if cursor and st is not None:
+                self.metrics.observe(
+                    "gateway_phase_handoff_overlap_seconds",
+                    st["overlap_s"],
+                )
         if not ok:
             attempt.handoff_outcome = "failed"
 
@@ -313,7 +425,8 @@ class Dispatcher:
             wire = int(getattr(attempt, "handoff_wire_bytes", 0) or 0)
             if wire:
                 self.metrics.inc(
-                    "gateway_phase_handoff_wire_bytes_total", wire
+                    "gateway_phase_handoff_wire_bytes_total", wire,
+                    mode=getattr(attempt, "_handoff_mode", "oneshot"),
                 )
 
     def _settle(self, attempt: Attempt) -> None:
@@ -503,6 +616,14 @@ class Dispatcher:
                 n_attempts += 1
                 hedge_at = time.monotonic() + policy.hedge_after_s
                 continue
+
+            # seal-watch: ship pages sealed so far toward the handoff
+            # target while the rest of the prefill still computes —
+            # the streamed half of the disaggregation pipeline
+            if self.stream_handoff and self.disaggregation:
+                for a in list(attempts):
+                    if not a.done and not getattr(a, "_handed_off", False):
+                        self._stream_deltas(a, request, live)
 
             # post-prefill handoff: a sealed announcement means the
             # sequence is PARKED on a prefill-only replica — act on it

@@ -88,6 +88,27 @@ def _mesh_distance(a: ReplicaInfo, b: ReplicaInfo) -> int:
     return sum(abs(x - y) for x, y in zip(ao, bo))
 
 
+def handoff_rank_key(candidate: ReplicaInfo,
+                     anchor: Optional[ReplicaInfo],
+                     outstanding: Mapping[str, int]):
+    """Adjacency score for prefill→decode pair selection (grpalloc-style
+    locality from the registry's topology annotations): same slice as
+    the anchor first — handoff bytes ride ICI, not DCN — then mesh
+    distance within the slice, then load, then key for determinism.
+    Lower sorts first; shared between the dispatcher's pre-seal target
+    pick (the delta stream's destination) and the seal-time handoff
+    rank, so the streamed target and the final-hop target agree."""
+    same_slice = (
+        anchor is not None and candidate.slice_id == anchor.slice_id
+    )
+    return (
+        0 if same_slice else 1,
+        _mesh_distance(candidate, anchor) if same_slice else 0,
+        outstanding.get(candidate.key, 0),
+        candidate.key,
+    )
+
+
 class LeastOutstandingRouter(Router):
     def pick(self, request, replicas, outstanding, exclude=frozenset()):
         candidates = _phase_candidates(

@@ -504,6 +504,11 @@ class _Seq:
     # sealed with ZERO tokens emitted and the slot is excluded from the
     # decode candidate set — it waits for export (handoff) or unpark
     parked: bool = False
+    # streamed-handoff early reclaim: page indices [0, reclaimed_upto)
+    # were released back to the pool after the importer acked their
+    # deltas; the entries remain in `pages` so the final cursor export
+    # keeps absolute indexing, but teardown and accounting skip them
+    reclaimed_upto: int = 0
 
 
 @dataclass
@@ -1621,12 +1626,18 @@ class PagedContinuousBatcher(_TracedBatcher):
         return page
 
     def _release_pages(self, s: _Seq) -> None:
-        for p in s.pages:
+        # indices below reclaimed_upto were already handed back by
+        # reclaim_handoff_pages — releasing them twice would corrupt
+        # refcounts (shared) or double-free (private)
+        for j, p in enumerate(s.pages):
+            if j < s.reclaimed_upto:
+                continue
             if p in s.shared:
                 self.prefix_cache.release(p)
             else:
                 self.free_pages.add(p)
         s.pages, s.shared = [], set()
+        s.reclaimed_upto = 0
 
     def _zero_page_scales(self, phys) -> None:
         """Quantized pool only: reset the per-head scales of freshly
@@ -1807,7 +1818,12 @@ class PagedContinuousBatcher(_TracedBatcher):
         for s in self._seqs:
             if s.seq_id < 0:
                 continue
-            for p in s.pages:
+            for j, p in enumerate(s.pages):
+                if j < s.reclaimed_upto:
+                    # early-reclaimed handoff pages: already back in the
+                    # pool (idle-cached or free) — this slot no longer
+                    # holds them, even though `pages` keeps the index
+                    continue
                 if p in s.shared:
                     refs[p] = refs.get(p, 0) + 1
                 else:
@@ -2442,14 +2458,20 @@ class PagedContinuousBatcher(_TracedBatcher):
         """Flip prefill-only serving live (the controller's role
         actuator).  Disabling UNPARKS every sealed slot into the decode
         candidate set — collapse-to-colocated must never strand a
-        parked stream.  Single-driver like every mutating verb: call
-        on the serving thread (worker control op)."""
+        parked stream.  Exception: a slot whose handoff stream already
+        RECLAIMED pages (``reclaimed_upto > 0``) cannot resume locally
+        by unparking — its early pages left the pool and may be
+        reused — so it stays parked; its in-flight handoff completes
+        (or falls back through ``import_pages``, which re-acquires the
+        reclaimed content by chain key and refuses cleanly if evicted).
+        Single-driver like every mutating verb: call on the serving
+        thread (worker control op)."""
         flag = bool(flag)
         changed = flag != self.prefill_only
         self.prefill_only = flag
         if not flag:
             for i, s in enumerate(self._seqs):
-                if s.seq_id >= 0 and s.parked:
+                if s.seq_id >= 0 and s.parked and not s.reclaimed_upto:
                     s.parked = False
                     self._active_dev = self._active_dev.at[i].set(True)
             self._sealed_pending = []
@@ -2475,7 +2497,7 @@ class PagedContinuousBatcher(_TracedBatcher):
             "prompt_tokens": 0,
             "decode_pages_sealed": 0, "spec_steps": 0, "spec_tokens": 0,
             "draft_wraps": 0, "pages_exported": 0, "pages_imported": 0,
-            "imports": 0, "seal_requants": 0,
+            "imports": 0, "seal_requants": 0, "pages_reclaimed": 0,
         }
 
     # -- live KV-page migration (the EXPORT/IMPORT verb pair) ---------------
@@ -2655,7 +2677,7 @@ class PagedContinuousBatcher(_TracedBatcher):
             )
         return out
 
-    def export_pages(self, seq_id: int) -> dict:
+    def export_pages(self, seq_id: int, cursor: int = 0) -> dict:
         """Serialize a LIVE sequence for migration: its committed pages'
         K/V bytes, the prefix-chain keys + kinds that let the importer
         replay them into its ``PrefixPageCache``, and the decode cursor
@@ -2665,10 +2687,16 @@ class PagedContinuousBatcher(_TracedBatcher):
         once the importer acknowledged.  Drains the pipelined in-flight
         iteration first so the host mirrors reflect every committed
         token (the payload must never lag a token the device already
-        committed).  Raises ``KeyError`` for an unknown sequence,
-        ``ValueError`` for one that cannot migrate (mid-prefill:
-        nothing committed — cold-restart it on the target instead;
-        already finished: nothing left to decode)."""
+        committed).  ``cursor`` (streamed handoff): the first
+        ``cursor`` pages were already delivered as acked deltas, so the
+        payload carries chain KEYS for every page but K/V BYTES only
+        for pages >= cursor (``layer_base`` marks the offset) — the
+        importer resolves the early pages from its staged cache.
+        Raises ``KeyError`` for an unknown sequence, ``ValueError``
+        for one that cannot migrate (mid-prefill: nothing committed —
+        cold-restart it on the target instead; already finished:
+        nothing left to decode; cursor below this sequence's reclaim
+        watermark: those pages have left the pool)."""
         slot = next(
             (i for i, s in enumerate(self._seqs) if s.seq_id == seq_id),
             None,
@@ -2691,14 +2719,24 @@ class PagedContinuousBatcher(_TracedBatcher):
         n_pages = -(-committed // self.page) if committed else 0
         n_full = committed // self.page
         n_prompt = (s.plen - 1) // self.page
+        cursor = int(cursor)
+        if cursor < 0 or cursor > n_pages:
+            raise ValueError(
+                f"export cursor {cursor} outside [0, {n_pages}]"
+            )
+        if cursor < s.reclaimed_upto:
+            raise ValueError(
+                f"export cursor {cursor} below reclaim watermark "
+                f"{s.reclaimed_upto}: those pages left the pool"
+            )
         stream = np.concatenate([
             np.asarray(s.prompt, np.int32),
             np.asarray(s.tokens, np.int32),
         ])
         keys = self._chain_keys(stream, n_full)
-        idx = jnp.asarray(np.asarray(s.pages[:n_pages], np.int32))
+        idx = jnp.asarray(np.asarray(s.pages[cursor:n_pages], np.int32))
         layers, scales = self._export_layers(idx)
-        self.stats["pages_exported"] += n_pages
+        self.stats["pages_exported"] += n_pages - cursor
         payload = {
             "kind": "live",
             "geometry": self._transfer_geometry(),
@@ -2718,6 +2756,7 @@ class PagedContinuousBatcher(_TracedBatcher):
                 if j < n_full else None
                 for j in range(n_pages)
             ],
+            "layer_base": cursor,
             "layers": layers,
         }
         if scales is not None:
@@ -2759,8 +2798,16 @@ class PagedContinuousBatcher(_TracedBatcher):
         page_keys = list(payload.get("page_keys") or [None] * n_pages)
         page_kinds = list(payload.get("page_kinds") or [None] * n_pages)
         layers = payload["layers"]
+        # streamed handoff: the first layer_base pages shipped earlier
+        # as acked deltas — keys for ALL pages, bytes only from here on
+        layer_base = int(payload.get("layer_base") or 0)
+        if layer_base < 0 or layer_base > n_pages:
+            raise ValueError(
+                f"malformed payload: layer_base {layer_base} outside "
+                f"[0, {n_pages}]"
+            )
         hd = self.hidden // self.num_heads
-        want_shape = (n_pages, self.num_heads, self.page, hd)
+        want_shape = (n_pages - layer_base, self.num_heads, self.page, hd)
         if (len(layers) != self.num_layers or len(page_keys) != n_pages
                 or len(page_kinds) != n_pages):
             raise ValueError("malformed payload: layer/page counts drift")
@@ -2776,7 +2823,7 @@ class PagedContinuousBatcher(_TracedBatcher):
             # geometry already matched kv_dtype=int8, so the scales
             # section is mandatory and shape-checked BEFORE any
             # mutation (the refusal path moves zero refcounts)
-            self._validate_scales(scales, n_pages)
+            self._validate_scales(scales, n_pages - layer_base)
         slot = next(
             (i for i, s in enumerate(self._seqs) if s.seq_id < 0), None
         )
@@ -2800,6 +2847,17 @@ class PagedContinuousBatcher(_TracedBatcher):
                 page = self.prefix_cache.lookup(bytes.fromhex(key))
                 if page is not None:
                     hits[j] = page
+        # a page below layer_base has no bytes in this payload: it must
+        # resolve from the staged cache or the import cannot be served
+        # — refused BEFORE any mutation, so the handoff falls back
+        # (re-import into the source) instead of resuming with holes
+        for j in range(min(layer_base, n_pages)):
+            if j not in hits:
+                raise RuntimeError(
+                    f"import refused: page {j} below layer_base "
+                    f"{layer_base} is neither staged here nor shipped "
+                    "(delta evicted or never arrived)"
+                )
         if need - len(hits) > self._available_pages(set(hits.values())):
             raise RuntimeError(
                 f"import refused: needs {need - len(hits)} fresh pages, "
@@ -2847,8 +2905,11 @@ class PagedContinuousBatcher(_TracedBatcher):
                 )
                 shared.add(pages[j])
         if to_write:
+            # payload rows are offset by layer_base (delta-shipped
+            # pages carry no bytes here); to_write only ever holds
+            # j >= layer_base — everything below resolved as a hit
             self._scatter_imported(
-                np.asarray(to_write, np.intp),
+                np.asarray([j - layer_base for j in to_write], np.intp),
                 np.asarray([pages[j] for j in to_write], np.int32),
                 layers, scales,
             )
@@ -3029,6 +3090,207 @@ class PagedContinuousBatcher(_TracedBatcher):
             )
         self.stats["pages_imported"] += len(fresh)
         return len(fresh)
+
+    # -- streamed seal-time handoff (the DELTA verb trio) -------------------
+    # The pipelined flavor of export/import: chunked prefill seals
+    # sharable prompt pages incrementally, so the gateway ships them to
+    # the decode replica WHILE the remaining chunks compute — only the
+    # tail rides the post-seal critical path.  Deltas are READ-ONLY on
+    # the exporter; the importer STAGES them idle (cache-owned,
+    # refcount 0) under their chain keys, so the final cursor import
+    # (``import_pages`` with ``layer_base``) claims them as ordinary
+    # prefix hits — or, if the handoff dies first, they age out of the
+    # LRU like any sealed chain.  Once a delta is ACKED, the exporter
+    # may reclaim those pages early (``reclaim_handoff_pages``) — but
+    # only once PARKED: chunked prefill attends over every earlier
+    # page, so a page can leave the pool only when the sequence runs
+    # zero further compute.
+
+    def export_sealed_delta(self, seq_id: int,
+                            cursor: int) -> Optional[dict]:
+        """Pages of ``seq_id``'s prompt chain sealed since page index
+        ``cursor``, content-hash chain keys included — the streaming
+        twin of ``export_pages``.  Works MID-PREFILL: the sealed bound
+        is the fully-scattered sharable prefix, whose bytes are final
+        (later chunks only append rows in later pages; a quantized
+        station scatter writes tight scales, so the int8 bytes are
+        final too).  READ-ONLY; no in-flight drain needed — decode
+        iterations never touch a prefilling slot's pages.  Returns
+        None when nothing new sealed.  The payload's ``sealed`` flag
+        reports whether the sequence has parked (no further deltas
+        will appear).  Raises ``KeyError`` for an unknown sequence,
+        ``ValueError`` for one already decoding (the one-shot verb
+        owns that phase)."""
+        slot = next(
+            (i for i, s in enumerate(self._seqs) if s.seq_id == seq_id),
+            None,
+        )
+        if slot is None:
+            raise KeyError(f"unknown sequence {seq_id}")
+        s = self._seqs[slot]
+        if s.prefilling:
+            job = next(
+                (j for j in self._jobs.values() if j.seq_id == seq_id),
+                None,
+            )
+            if job is None:
+                return None   # between sweep and job open: nothing yet
+            sealed = min(job.next_scatter, len(job.keys))
+            keys = job.keys
+            parked = False
+        elif s.parked:
+            # parked at seal: every sharable prompt page is sealed
+            sealed = (s.plen - 1) // self.page
+            keys = self._chain_keys(np.asarray(s.prompt, np.int32),
+                                    sealed)
+            parked = True
+        else:
+            raise ValueError(
+                f"sequence {seq_id} is decoding: use export_pages"
+            )
+        cursor = int(cursor)
+        if cursor < 0 or cursor > sealed:
+            raise ValueError(
+                f"delta cursor {cursor} outside sealed bound {sealed}"
+            )
+        if cursor < s.reclaimed_upto:
+            raise ValueError(
+                f"delta cursor {cursor} below reclaim watermark "
+                f"{s.reclaimed_upto}"
+            )
+        if cursor == sealed:
+            return None
+        idx = jnp.asarray(np.asarray(s.pages[cursor:sealed], np.int32))
+        layers, scales = self._export_layers(idx)
+        self.stats["pages_exported"] += sealed - cursor
+        payload = {
+            "kind": "delta",
+            "geometry": self._transfer_geometry(),
+            "cursor": cursor,
+            "page_keys": [k.hex() for k in keys[cursor:sealed]],
+            "page_kinds": ["prompt"] * (sealed - cursor),
+            "prev_key": keys[cursor - 1].hex() if cursor else None,
+            "sealed": parked,
+            "layers": layers,
+        }
+        if scales is not None:
+            payload["scales"] = scales
+        return payload
+
+    def import_sealed_delta(self, payload: dict) -> int:
+        """Stage one streamed-handoff delta into the local
+        ``PrefixPageCache``: each page enters idle (refcount 0,
+        cache-owned) under its chain key — the final cursor import
+        claims it as a prefix hit.  ATOMIC per delta: dedup + pool
+        feasibility run BEFORE the first allocation, so a refusal
+        (``RuntimeError``) moves zero refcounts and leaves
+        previously-staged deltas — the last consistent prefix —
+        intact.  Returns the number of pages newly staged."""
+        if payload.get("kind") != "delta" or "geometry" not in payload:
+            raise ValueError("not a delta paged-KV payload")
+        self._check_geometry(payload["geometry"])
+        if self.prefix_cache is None:
+            raise RuntimeError(
+                "delta import refused: no prefix cache to stage into"
+            )
+        page_keys = list(payload.get("page_keys") or [])
+        page_kinds = list(
+            payload.get("page_kinds") or ["prompt"] * len(page_keys)
+        )
+        layers = payload["layers"]
+        scales = payload.get("scales")
+        hd = self.hidden // self.num_heads
+        want_shape = (len(page_keys), self.num_heads, self.page, hd)
+        if (len(layers) != self.num_layers
+                or len(page_kinds) != len(page_keys)):
+            raise ValueError("malformed payload: layer/page counts drift")
+        for k_np, v_np in layers:
+            if (tuple(np.shape(k_np)) != want_shape
+                    or tuple(np.shape(v_np)) != want_shape):
+                raise ValueError(
+                    f"malformed payload: page array shape "
+                    f"{np.shape(k_np)} != {want_shape}"
+                )
+        if self.kv_quant:
+            self._validate_scales(scales, len(page_keys))
+        prev_hex = payload.get("prev_key")
+        # the whole plan BEFORE the first allocation: the refusal path
+        # must stage nothing.  Staged pages enter most-recent in the
+        # LRU, so the allocations below can never evict a page staged
+        # in this same call; an EARLIER delta's idle pages can be
+        # evicted under pool pressure — the final import then refuses
+        # (layer_base hole) and the handoff falls back, counted.
+        fresh = [
+            j for j, keyhex in enumerate(page_keys)
+            if self.prefix_cache.lookup(bytes.fromhex(keyhex)) is None
+        ]
+        if len(fresh) > self._available_pages(set()):
+            raise RuntimeError(
+                f"delta import refused: needs {len(fresh)} pages, "
+                f"{self._available_pages(set())} available"
+            )
+        staged: List[tuple] = []      # (payload row, pool page)
+        for j in fresh:
+            page = self._alloc_page()
+            prev = page_keys[j - 1] if j else prev_hex
+            self.prefix_cache.insert(
+                bytes.fromhex(page_keys[j]), page, kind=page_kinds[j],
+                prev=bytes.fromhex(prev) if prev else None,
+            )
+            self.prefix_cache.release(page)  # staged idle: cache-owned
+            staged.append((j, page))
+        if staged:
+            self._scatter_imported(
+                np.asarray([j for j, _ in staged], np.intp),
+                np.asarray([p for _, p in staged], np.int32),
+                layers, scales,
+            )
+        self.stats["pages_imported"] += len(staged)
+        return len(staged)
+
+    def reclaim_handoff_pages(self, seq_id: int, upto: int) -> int:
+        """Release ``seq_id``'s first ``upto`` pages back to the pool —
+        the early-reclaim half of the streamed handoff, called once the
+        importer ACKED the deltas covering them.  Only a PARKED
+        sequence sheds pages (it runs zero further compute; a
+        prefilling one still attends over every earlier page, and a
+        decoding one writes new rows — reclaiming under either would
+        hand live KV to the allocator).  Shared pages decref to idle
+        (still resolvable by chain key — the fallback re-import path);
+        private pages (a twin sealed the content first) free outright,
+        their content resolving through the twin's cache entry.
+        Raises ``KeyError`` for an unknown sequence; returns the
+        number of pages freed (0 when not parked — callers treat
+        reclaim as best-effort)."""
+        slot = next(
+            (i for i, s in enumerate(self._seqs) if s.seq_id == seq_id),
+            None,
+        )
+        if slot is None:
+            raise KeyError(f"unknown sequence {seq_id}")
+        s = self._seqs[slot]
+        if not s.parked:
+            return 0
+        n_sharable = (s.plen - 1) // self.page
+        upto = min(int(upto), n_sharable)
+        freed = 0
+        for j in range(s.reclaimed_upto, upto):
+            p = s.pages[j]
+            if p in s.shared:
+                self.prefix_cache.release(p)
+                s.shared.discard(p)
+            else:
+                self.free_pages.add(p)
+            freed += 1
+        if upto > s.reclaimed_upto:
+            s.reclaimed_upto = upto
+        if freed:
+            self.stats["pages_reclaimed"] += freed
+            if self.metrics is not None:
+                self.metrics.inc(
+                    "serve_handoff_pages_reclaimed_total", freed
+                )
+        return freed
 
     def _sweep(self, finished: Dict[int, List[int]]) -> None:
         progress = True

@@ -522,7 +522,8 @@ class GatewaySoak:
                  migration: bool = False, gateways: int = 1,
                  store_chaos: bool = False, controller: bool = False,
                  prefix_tier: bool = False, prefix_page: int = 8,
-                 disaggregation: bool = False):
+                 disaggregation: bool = False,
+                 stream_handoff: bool = True):
         from kubegpu_tpu.gateway import (
             AdmissionQueue, FailoverPolicy, Gateway, GatewayTier,
             HttpReplicaClient, InMemoryReplicaClient, ReplicaServer,
@@ -675,6 +676,12 @@ class GatewaySoak:
             for rep in self.registry.live():
                 if getattr(rep, "role", "flex") == "prefill":
                     self.client.set_role(rep.key, "prefill")
+        # streamed seal-time handoff knob: False forces every handoff
+        # through the one-shot transfer — the comparison schedule that
+        # pins the delta pipeline's absence of side effects
+        self.stream_handoff = stream_handoff
+        for g in self._alive_gateways():
+            g.dispatcher.stream_handoff = stream_handoff
         self.controller = None
         if controller:
             if http:
@@ -1343,6 +1350,22 @@ class GatewaySoak:
             check = getattr(b, "assert_page_accounting", None)
             if check is not None:
                 check()
+        if self.disaggregation:
+            # streamed-handoff audit: with streaming off, not one delta
+            # may have crossed the wire; with it on, any handoff that
+            # recorded streamed wire bytes must have shipped deltas
+            deltas = self.metrics.get("gateway_phase_handoff_deltas_total")
+            if not self.stream_handoff:
+                assert deltas == 0, (
+                    f"one-shot schedule shipped {deltas} deltas\n{trace}"
+                )
+            elif self.metrics.get(
+                "gateway_phase_handoff_wire_bytes_total", mode="streamed"
+            ) > 0:
+                assert deltas >= 1, (
+                    f"streamed handoff recorded wire bytes but no "
+                    f"deltas\n{trace}"
+                )
         self.check_store_degradation(trace)
         self.check_prefix_tier_degradation(trace)
         self.check_traces(trace)

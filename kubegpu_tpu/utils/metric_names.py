@@ -88,9 +88,19 @@ CATALOG: Dict[str, MetricSpec] = {
     "gateway_phase_handoff_seconds": _h(
         (), "sealed announcement -> handoff dispatched (export + "
         "re-home + import kickoff wall time)"),
+    "gateway_phase_handoff_overlap_seconds": _h(
+        (), "per streamed handoff: delta-shipping wall time that ran "
+        "DURING prefill compute instead of on the critical path (large "
+        "overlap vs gateway_phase_handoff_seconds = handoff-bound TTFT "
+        "pressure; the ratio actuator reads the difference)"),
+    "gateway_phase_handoff_deltas_total": _c(
+        (), "sealed-page deltas acked by the decode side mid-prefill "
+        "(the seal-watch pipeline; 0 under stream_handoff=False)"),
     "gateway_phase_handoff_wire_bytes_total": _c(
-        (), "serialized KV payload bytes shipped by post-prefill "
-        "handoffs (int8 pools halve this per page vs bf16)"),
+        ("mode",), "serialized KV payload bytes shipped by post-prefill "
+        "handoffs, mode=streamed|oneshot (streamed = final cursor "
+        "export after >=1 acked delta; int8 pools halve this per page "
+        "vs bf16)"),
     "gateway_live_replicas": _g((), "replicas routable right now"),
     "gateway_deadline_exceeded_total": _c(
         (), "requests failed by the end-to-end deadline"),
@@ -330,6 +340,13 @@ CATALOG: Dict[str, MetricSpec] = {
         "handoff capacity was the bottleneck)"),
     "controller_prefill_replicas": _g(
         (), "replicas currently holding the prefill role"),
+    "controller_handoff_exposed_tax_s": _g(
+        (), "this tick's mean CRITICAL-PATH handoff seconds per "
+        "handoff — window diff of gateway_phase_handoff_seconds minus "
+        "gateway_phase_handoff_overlap_seconds.  Large while TTFT is "
+        "hot = handoff-bound pressure: the ratio actuator holds the "
+        "flex->prefill flip (more prefill bandwidth cannot shrink the "
+        "transfer tail)"),
 
     # -- serving data plane (models/serving.py, models/paging.py)
     "serve_ttft_seconds": _h((), "submit -> first generated token"),
@@ -351,6 +368,11 @@ CATALOG: Dict[str, MetricSpec] = {
     "serve_decode_pages_sealed_total": _c(
         (), "decode-produced pages sealed into the prefix cache at "
         "retirement"),
+    "serve_handoff_pages_reclaimed_total": _c(
+        (), "prompt pages freed EARLY on a parked prefill replica — "
+        "acked by the decode side's staged deltas, released before the "
+        "final handoff roundtrip (each reclaim raises prefill admission "
+        "headroom mid-schedule)"),
     "serve_spec_steps_total": _c((), "speculative verify iterations"),
     "serve_spec_tokens_per_step": _c(
         (), "tokens committed by speculative verifies (divide by "

@@ -25,7 +25,16 @@ Layers under test:
   ``/v1/role`` flips it live, the registry reads POD_ROLE;
 - GatewaySoak ``disaggregation=True`` — the kill/refuse/
   kill-mid-migration schedule lands on both ends of the handoff path
-  with I5 and both-end page accounting intact.
+  with I5 and both-end page accounting intact;
+- the streamed seal-time pipeline (ISSUE 18) — ``export_sealed_delta``
+  ships sealed prompt pages DURING prefill compute, the decode side
+  stages them content-addressed (a refused delta rolls back to the last
+  consistent prefix, atomically), the final handoff exports only layers
+  ≥ the acked cursor, acked pages are reclaimed on the prefill replica
+  at seal time (raising admission concurrency mid-schedule), parked
+  sequences leave the token-budget denominator, and streamed ≡ one-shot
+  ≡ co-located token identity holds across the same page-size × dtype ×
+  speculation grid.
 """
 
 import json
@@ -226,9 +235,16 @@ def _pools_balanced(client):
 # fp32 token identity: disaggregated == co-located
 # ---------------------------------------------------------------------------
 
-def _identity_case(params, prompt, budget, **paged_kw):
+def _identity_case(params, prompt, budget, streamed=True,
+                   expect_streamed=None, **paged_kw):
+    """``streamed`` flips the seal-watch knob; ``expect_streamed``
+    (default: follows the knob) is what the handoff should have DONE —
+    a sub-page prompt seals zero full pages before parking, so it
+    legitimately degrades to one-shot even with streaming on."""
     from kubegpu_tpu.gateway import GatewayRequest
 
+    if expect_streamed is None:
+        expect_streamed = streamed
     ref = make_paged(params, **paged_kw).run(
         [np.asarray(prompt, np.int32)], [budget]
     )[0]
@@ -237,6 +253,10 @@ def _identity_case(params, prompt, budget, **paged_kw):
         roles=("prefill", "flex"),
     )
     try:
+        if not streamed:
+            # the one-shot comparison lane: the seal-watch never ships
+            # deltas, the whole payload rides the critical-path hop
+            gw.dispatcher.stream_handoff = False
         p = gw.submit(GatewayRequest(
             prompt=list(prompt), max_new_tokens=budget, request_id="d0",
         ))
@@ -247,9 +267,24 @@ def _identity_case(params, prompt, budget, **paged_kw):
         assert gw.metrics.get(
             "gateway_phase_handoff_total", outcome="ok"
         ) == 1
+        mode = "streamed" if expect_streamed else "oneshot"
         assert gw.metrics.get(
-            "gateway_phase_handoff_wire_bytes_total"
+            "gateway_phase_handoff_wire_bytes_total", mode=mode
         ) > 0
+        if expect_streamed:
+            # at least the sealed full pages shipped as deltas before
+            # the final hop, and the source reclaimed them at seal
+            assert gw.metrics.get(
+                "gateway_phase_handoff_deltas_total"
+            ) >= 1
+            assert sum(
+                b.stats.get("pages_reclaimed", 0)
+                for b in _pools_balanced(client)
+            ) >= 1
+        else:
+            assert gw.metrics.get(
+                "gateway_phase_handoff_deltas_total"
+            ) == 0
         # the caller's stream is attributed to the disaggregated path
         assert gw.metrics.histogram_count(
             "gateway_ttft_seconds", role="disaggregated"
@@ -268,12 +303,23 @@ def test_disaggregated_identity_fp32(params):
     _identity_case(params, PROMPT, 10)
 
 
+def test_disaggregated_identity_fp32_oneshot(params):
+    # streamed ≡ one-shot ≡ co-located: the same case with the
+    # seal-watch forced off must emit the same tokens
+    _identity_case(params, PROMPT, 10, streamed=False)
+
+
 def test_disaggregated_identity_subpage_prompt(params):
-    _identity_case(params, SUBPAGE_PROMPT, 8)
+    _identity_case(params, SUBPAGE_PROMPT, 8, expect_streamed=False)
 
 
 def test_disaggregated_identity_int8_pool(params):
     _identity_case(params, PROMPT, 10, kv_dtype="int8",
+                   decode_page_cache="quantized")
+
+
+def test_disaggregated_identity_int8_oneshot(params):
+    _identity_case(params, PROMPT, 10, streamed=False, kv_dtype="int8",
                    decode_page_cache="quantized")
 
 
@@ -283,7 +329,15 @@ def test_disaggregated_identity_speculative(params):
 
 @pytest.mark.slow
 def test_disaggregated_identity_page8(params):
-    _identity_case(params, PROMPT, 12, page_size=8)
+    # a 12-token prompt at page 8 seals one full page pre-park: the
+    # streamed lane still applies at the wider page geometry
+    _identity_case(params, list(PROMPT) + [2, 7, 1, 8], 10, page_size=8)
+
+
+@pytest.mark.slow
+def test_disaggregated_identity_page8_oneshot(params):
+    _identity_case(params, list(PROMPT) + [2, 7, 1, 8], 10,
+                   streamed=False, page_size=8)
 
 
 @pytest.mark.slow
@@ -415,6 +469,221 @@ def test_collapse_unparks_locally(params):
 
 
 # ---------------------------------------------------------------------------
+# streamed seal-time handoff: the delta pipeline (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+PROMPT24 = [(i * 7 + 3) % 64 for i in range(24)]   # 6 pages at page_size=4
+PROMPT24B = [(i * 5 + 11) % 64 for i in range(24)]
+
+
+def test_delta_pipeline_batcher_identity(params):
+    """The batcher-level pipeline: pages export as deltas WHILE the
+    chunked prefill still runs, stage content-addressed on the decode
+    twin, the source reclaims the acked pages at park, and the final
+    cursor export ships only the remainder — token-identical to
+    co-located, page accounting balanced on both ends throughout."""
+    ref = make_paged(params, prompt_pad=32).run(
+        [np.asarray(PROMPT24, np.int32)], [6]
+    )[0]
+    src = make_paged(params, prompt_pad=32, prefill_only=True)
+    dst = make_paged(params, prompt_pad=32)
+    src.submit(1, np.asarray(PROMPT24, np.int32), 6)
+    cursor = 0
+    deltas = 0
+    deadline = time.monotonic() + 60
+    sealed = []
+    while not sealed:
+        assert time.monotonic() < deadline, "prefill never parked"
+        src.serve_step()
+        sealed = src.drain_sealed()
+        d = src.export_sealed_delta(1, cursor)
+        if d is not None and d["page_keys"]:
+            assert dst.import_sealed_delta(d) == len(d["page_keys"])
+            cursor += len(d["page_keys"])
+            deltas += 1
+            src.assert_page_accounting()     # every delta boundary
+            dst.assert_page_accounting()
+    assert deltas >= 2, "one-page chunks must yield multiple deltas"
+    freed = src.reclaim_handoff_pages(1, cursor)
+    assert freed >= 1, "acked pages must return to the source pool"
+    assert src.stats["pages_reclaimed"] == freed
+    src.assert_page_accounting()
+    payload = src.export_pages(1, cursor)
+    assert payload["layer_base"] == cursor
+    src.cancel(1)
+    src.assert_page_accounting()
+    dst.import_pages(11, payload)
+    out = {}
+    while dst.has_work():
+        out.update(dst.serve_step())
+    assert out[11] == ref
+    dst.assert_page_accounting()
+
+
+def test_delta_refusal_rolls_back_atomically(params):
+    """A refused delta moves ZERO refcounts: the feasibility check runs
+    before the first allocation, so the target's pool and cache are
+    untouched; a refusal AFTER earlier deltas staged leaves that
+    consistent prefix intact."""
+    src = make_paged(params, prompt_pad=32, prefill_only=True)
+    src.submit(1, np.asarray(PROMPT24, np.int32), 4)
+    while not src.drain_sealed():
+        src.serve_step()
+    payload = src.export_sealed_delta(1, 0)
+    assert len(payload["page_keys"]) == 5    # (24-1)//4 sealed pages
+
+    # pool too small for the delta: refused pre-mutation
+    tiny = make_paged(params, prompt_pad=32, pool_pages=4)
+    free_before = set(tiny.free_pages)
+    with pytest.raises(RuntimeError):
+        tiny.import_sealed_delta(payload)
+    assert set(tiny.free_pages) == free_before
+    for keyhex in payload["page_keys"]:
+        assert tiny.prefix_cache.lookup(bytes.fromhex(keyhex)) is None
+    assert tiny.stats["pages_imported"] == 0
+    tiny.assert_page_accounting()
+
+    # refusal after a successful stage: the staged prefix survives
+    dst = make_paged(params, prompt_pad=32)
+    assert dst.import_sealed_delta(payload) == 5
+    bad = dict(payload)
+    bad["geometry"] = dict(payload["geometry"], page=8)
+    with pytest.raises(ValueError):
+        dst.import_sealed_delta(bad)
+    for keyhex in payload["page_keys"]:
+        assert dst.prefix_cache.lookup(bytes.fromhex(keyhex)) is not None
+    dst.assert_page_accounting()
+    src.cancel(1)
+    src.assert_page_accounting()
+
+
+def test_early_reclaim_admits_queued_prefill(params):
+    """The satellite regression: a prefill DEFERRED on pool pressure
+    must admit the moment the parked sequence's acked pages return to
+    the pool — early reclaim raises prefill admission concurrency
+    DURING the handoff window, before the final export ever runs."""
+    src = make_paged(params, prompt_pad=24, pool_pages=10,
+                     prefill_only=True)
+    src.submit(1, np.asarray(PROMPT24, np.int32), 4)   # needs 7 pages
+    deadline = time.monotonic() + 60
+    while not src.drain_sealed():
+        assert time.monotonic() < deadline
+        src.serve_step()
+    # second prefill: 7 more pages against 3 free — deferred
+    src.submit(2, np.asarray(PROMPT24B, np.int32), 4)
+    for _ in range(10):
+        src.serve_step()
+    assert src.drain_sealed() == [], "admitted despite pool pressure"
+    # the importer acked the 5 sealed pages: reclaim frees them
+    assert src.reclaim_handoff_pages(1, 5) == 5
+    src.assert_page_accounting()
+    sealed = []
+    while not sealed:
+        assert time.monotonic() < deadline, (
+            "reclaimed pages never admitted the queued prefill"
+        )
+        src.serve_step()
+        sealed = src.drain_sealed()
+    assert sealed == [2]
+    src.assert_page_accounting()
+    src.cancel(1)
+    src.cancel(2)
+    src.assert_page_accounting()
+
+
+def test_kill_mid_delta_falls_back_to_decode_on_prefill(params):
+    """The decode target dies AFTER acking deltas — and after the
+    source already reclaimed the acked pages: the final handoff falls
+    back to decode-on-prefill, re-resolving the reclaimed pages from
+    the source's own prefix cache by chain key — same tokens, counted
+    fallback, source pool balanced at quiescence."""
+    from types import SimpleNamespace
+
+    from kubegpu_tpu.gateway import InMemoryReplicaClient
+
+    ref = make_paged(params, prompt_pad=32).run(
+        [np.asarray(PROMPT24, np.int32)], [6]
+    )[0]
+    client = InMemoryReplicaClient(step_delay_s=0.0)
+    client.add_replica(
+        "pre", make_paged(params, prompt_pad=32, prefill_only=True)
+    )
+    client.add_replica("dec", make_paged(params, prompt_pad=32))
+    try:
+        got = []
+        req = SimpleNamespace(
+            request_id="kmd0", prompt=list(PROMPT24), max_new_tokens=6,
+            temperature=0.0, session=None,
+            on_tokens=lambda a, toks: got.extend(toks),
+        )
+        attempt = client.submit("pre", req)
+        assert attempt.sealed.wait(60), "prompt never sealed"
+        payload = client.export_delta(attempt, req, 0)
+        assert payload is not None and payload["page_keys"]
+        n = len(payload["page_keys"])
+        assert client.import_delta("dec", payload) == n
+        assert client.reclaim(attempt, req, n) >= 1
+        # kill the target mid-window: BETWEEN the final export and its
+        # import, exactly like the dispatcher's _between chaos hook
+        ok = client.migrate(
+            attempt, req, "dec",
+            _between=lambda: client.fail_replica("dec"),
+            fallback=True, cursor=n,
+        )
+        assert ok, "fallback migrate refused"
+        assert attempt.wait(120)
+        res = attempt.result()
+        assert res.ok, res.error
+        assert list(res.tokens) == ref
+        assert got == ref                    # uninterrupted stream
+        assert attempt.handoff_outcome == "fallback"
+        with client._lock:
+            src = client._workers["pre"].batcher
+        deadline = time.monotonic() + 30
+        while src.has_work() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        src.assert_page_accounting()
+    finally:
+        client.stop()
+
+
+PROMPT24C = [(i * 11 + 7) % 64 for i in range(24)]
+
+
+def test_parked_sequences_excluded_from_token_budget(params):
+    """Satellite fix, pinned at the budget packer: a PARKED sequence
+    runs zero decode rows, so its budget share goes straight back to
+    prefill.  token_budget=9 net of ONE real decoder leaves two chunk
+    rows — two in-flight prefill jobs must BOTH advance each step;
+    counting the parked slot in the denominator would halve the
+    prefill rate to one chunk per step."""
+    b = make_paged(params, prompt_pad=32, prefill_only=True,
+                   token_budget=9)
+    b.submit(1, np.asarray(PROMPT24, np.int32), 4)
+    deadline = time.monotonic() + 60
+    while not b.drain_sealed():
+        assert time.monotonic() < deadline
+        b.serve_step()                       # seq 1 parks at seal
+    # an imported twin of the parked content DECODES here (the
+    # fallback-resume contract) — the one real budget consumer
+    b.import_pages(9, b.export_pages(1))
+    b.submit(2, np.asarray(PROMPT24B, np.int32), 4)
+    b.submit(3, np.asarray(PROMPT24C, np.int32), 4)
+    while len(b._jobs) < 2:
+        assert time.monotonic() < deadline, "prefill jobs never opened"
+        b.serve_step()
+    before = b.stats["prefill_chunks"]
+    b.serve_step()
+    assert b.stats["prefill_chunks"] - before == 2, (
+        "parked sequence still counted against the token budget: "
+        "only one prefill chunk advanced"
+    )
+    for seq in (1, 2, 3, 9):
+        b.cancel(seq)
+    b.assert_page_accounting()
+
+
+# ---------------------------------------------------------------------------
 # controller: the prefill:decode ratio actuator
 # ---------------------------------------------------------------------------
 
@@ -489,6 +758,49 @@ def test_ratio_reshape_under_ttft_pressure():
         assert metrics.get(
             "controller_role_reshapes_total", dir="decode"
         ) == 1
+    finally:
+        gw.stop()
+        client.stop()
+
+
+def test_ratio_holds_prefill_flip_when_handoff_bound():
+    """TTFT pressure with a large EXPOSED handoff tax (total handoff
+    time minus the streamed overlap, per handoff) is handoff-bound:
+    more prefill bandwidth cannot shrink a wire tail, so hot ticks do
+    not count toward the flex->prefill flip.  Once the pipeline
+    overlaps the transfer (tax below the threshold), the same TTFT
+    pressure flips a replica again."""
+    stack, client, gw, ctrl, metrics = _controller_stack()
+    try:
+        metrics.observe("gateway_ttft_seconds", 0.9)
+        ctrl.tick()                          # primes the windows
+        # hot TTFT, but the handoff's critical-path share is 0.35s per
+        # handoff >= handoff_tax_fraction(0.5) * ttft_target(0.5s)
+        for _ in range(4):
+            metrics.observe("gateway_ttft_seconds", 0.9)
+            metrics.observe("gateway_phase_handoff_seconds", 0.4)
+            metrics.observe(
+                "gateway_phase_handoff_overlap_seconds", 0.05
+            )
+            assert ctrl.tick().get("role_action") in ("", None)
+        assert "prefill" not in dict(_roles(stack)).values()
+        assert metrics.get(
+            "controller_role_reshapes_total", dir="prefill"
+        ) == 0
+        assert metrics.gauge("controller_handoff_exposed_tax_s") == (
+            pytest.approx(0.35)
+        )
+        # the pipeline now overlaps the transfer: tax 0.02s per
+        # handoff, same TTFT pressure -> compute-bound -> flip
+        actions = []
+        for _ in range(3):
+            metrics.observe("gateway_ttft_seconds", 0.9)
+            metrics.observe("gateway_phase_handoff_seconds", 0.4)
+            metrics.observe(
+                "gateway_phase_handoff_overlap_seconds", 0.38
+            )
+            actions.append(ctrl.tick().get("role_action"))
+        assert any(a and a.startswith("prefill") for a in actions), actions
     finally:
         gw.stop()
         client.stop()
@@ -620,6 +932,31 @@ def test_gateway_soak_disaggregation_http():
         seed=616, n_replicas=3, migration=True, http=True,
         disaggregation=True,
     ).run(40)
+
+
+def test_gateway_soak_streamed_handoff_kill_schedule():
+    """The streamed-handoff kill schedule: kills, importer refusals and
+    kill-mid-migration land while the seal-watch ships deltas — I5 and
+    page accounting hold on BOTH ends at quiescence (audited in
+    GatewaySoak.check), and the schedule demonstrably streamed."""
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    soak = GatewaySoak(
+        seed=818, n_replicas=4, migration=True, disaggregation=True,
+    )
+    soak.run(60)
+    assert soak.metrics.get("gateway_phase_handoff_deltas_total") >= 1
+
+
+def test_gateway_soak_oneshot_schedule_ships_no_deltas():
+    """stream_handoff=False forces every handoff through the one-shot
+    transfer: the quiescence audit pins zero deltas schedule-wide."""
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    GatewaySoak(
+        seed=919, n_replicas=3, migration=True, disaggregation=True,
+        stream_handoff=False,
+    ).run(30)
 
 
 @pytest.mark.slow
