@@ -384,12 +384,17 @@ class SimBatcher:
     def submit(self, seq_id: int, prompt, max_new: int,
                temperature: float = 0.0,
                session_id: Optional[str] = None, trace=None,
-               stream_seed: Optional[int] = None) -> None:
+               stream_seed: Optional[int] = None,
+               seed: Optional[int] = None) -> None:
         # session_id is the gateway's session/prefix key; the token mill
         # has no KV to reuse, so it only validates the widened contract.
         # stream_seed: the data planes pass sim_stream_seed(prompt) so
         # streams are REQUEST-deterministic (identical on any replica,
-        # like real greedy decode); None keeps the per-seq mill
+        # like real greedy decode); None keeps the per-seq mill.
+        # seed: the caller's sampling pin — mixed into the mill seed so
+        # a pinned sampled request mills the SAME stream on any replica
+        # (the real batchers' position-keyed determinism, scaled down)
+        # while different seeds mill different streams.
         if seq_id < 0:
             raise ValueError(f"seq_id must be >= 0, got {seq_id}")
         if trace is not None:
@@ -404,10 +409,12 @@ class SimBatcher:
             self._plen[seq_id] = len(prompt)
         except TypeError:
             self._plen[seq_id] = 0
-        self._pending.append((
-            seq_id, int(max_new),
-            seq_id if stream_seed is None else int(stream_seed),
-        ))
+        mill = seq_id if stream_seed is None else int(stream_seed)
+        if seed is not None:
+            # Knuth-mix the pin so (prompt, seed) fully determines the
+            # stream and seed=0 still perturbs the unpinned stream
+            mill = (mill * 31 + int(seed) * 2654435761 + 1) % (2 ** 31)
+        self._pending.append((seq_id, int(max_new), mill))
 
     def _trace_end(self, spans: dict, reason: str, **attrs) -> None:
         serve = spans.pop("serve")
@@ -741,6 +748,7 @@ class _ReplicaWorker:
         self._takes_stream_seed = _sniff_takes(
             batcher, "submit", "stream_seed"
         )
+        self._takes_seed = _sniff_takes(batcher, "submit", "seed")
         self.lock = threading.Lock()
         self.cond = threading.Condition(self.lock)
         self.inbox: deque = deque()          # (attempt, request)
@@ -816,6 +824,11 @@ class _ReplicaWorker:
                         # like real greedy decode (hedge dedup, tier
                         # retries and migrations all assume it)
                         kwargs["stream_seed"] = sim_stream_seed(req.prompt)
+                    if (
+                        self._takes_seed
+                        and getattr(req, "seed", None) is not None
+                    ):
+                        kwargs["seed"] = int(req.seed)
                     try:
                         self.batcher.submit(
                             seq, req.prompt, req.max_new_tokens,

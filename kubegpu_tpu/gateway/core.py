@@ -54,6 +54,11 @@ class GatewayRequest:
     tenant: str = ""
     session: Optional[str] = None
     temperature: float = 0.0
+    # seed-pinned sampling: replicas derive sample keys from
+    # (seed, absolute token position) — any replica/slot/batch/restart
+    # reproduces the same stream, so hedging, resume dedup, migration
+    # and disaggregated handoff stay sound for sampled traffic
+    seed: Optional[int] = None
     deadline_s: Optional[float] = None   # per-request override
     enqueued_at: float = 0.0             # stamped by submit()
     # runtime trace context (utils.tracing.SpanCtx), stamped by submit()
@@ -131,10 +136,13 @@ class StreamRelay:
     attempt that was fast-forwarded declares its start offset in
     ``attempt.stream_base``.
 
-    ``dedup=False`` is the sampled-traffic mode (temperature > 0:
-    replicas do NOT emit identical streams, so mixing them would be
-    incoherent): only the first attempt to deliver a delta may stream —
-    the pre-tier behavior — and the terminal result stays authoritative.
+    ``dedup=False`` is the UNPINNED-sampled mode (temperature > 0 with
+    no request seed: replicas do NOT emit identical streams, so mixing
+    them would be incoherent): only the first attempt to deliver a
+    delta may stream — the pre-tier behavior — and the terminal result
+    stays authoritative.  Seed-PINNED sampled requests keep
+    ``dedup=True``: position-keyed sample keys make every replica's
+    stream byte-identical, the same invariant greedy gets for free.
     """
 
     def __init__(self, metrics: Optional[Metrics] = None,

@@ -13,7 +13,7 @@ batcher's ``serve_step`` loop (the in-process ``_ReplicaWorker`` shape,
 behind sockets):
 
     POST /v1/submit   {"request_id", "prompt": [ints], "max_new_tokens",
-                       "temperature", "session"}
+                       "temperature", "session", "seed"?}
         → 200 text/event-stream (chunked): one ``tokens`` event per
           committed token batch — under the pipelined decode loop the
           host learns tokens at its one readback point, one step late,
@@ -262,6 +262,7 @@ class ReplicaServingLoop:
         self._takes_stream_seed = _sniff_takes(
             batcher, "submit", "stream_seed"
         )
+        self._takes_seed = _sniff_takes(batcher, "submit", "seed")
         # RLock: _finish mutates stream maps from both the serving
         # thread (already holding the condition's lock on the shutdown
         # path) and the flush path
@@ -683,6 +684,11 @@ class ReplicaServingLoop:
             # deterministically from the prompt; the mill must too, or
             # hedge dedup / sibling retries would mix unrelated streams)
             kwargs["stream_seed"] = sim_stream_seed(prompt)
+        if self._takes_seed and payload.get("seed") is not None:
+            # seed-pinned sampling: the batcher derives sample keys
+            # from (seed, absolute position), so this replica's stream
+            # matches any other replica's for the same request
+            kwargs["seed"] = int(payload["seed"])
         try:
             self.batcher.submit(
                 seq,
@@ -1823,6 +1829,8 @@ class HttpReplicaClient(ReplicaClient):
                     ),
                     "session": getattr(request, "session", None),
                 }
+                if getattr(request, "seed", None) is not None:
+                    payload["seed"] = int(request.seed)
                 wm = int(getattr(request, "resume_watermark", 0) or 0)
                 if wm > 0:
                     # hedge/retry fast-forward: the replica emits only

@@ -673,13 +673,16 @@ class Dispatcher:
                 and len(attempts) == 1
                 and hedge_at is not None
                 and now >= hedge_at
-                # no_hedge: SAMPLED streams (temperature > 0) never
-                # hedge — replicas do not emit identical sampled
-                # streams, so a twin's tokens could not be deduped
-                # coherently.  Greedy streams DO hedge: the StreamRelay
-                # dedups by token index and the resume watermark
-                # fast-forwards the twin, so tail latency is hedged
-                # exactly for the requests users watch token by token.
+                # no_hedge: UNPINNED sampled streams (temperature > 0,
+                # no request seed) never hedge — replicas do not emit
+                # identical unpinned sampled streams, so a twin's
+                # tokens could not be deduped coherently.  Greedy AND
+                # seed-pinned sampled streams DO hedge: position-keyed
+                # sample keys (or determinism) make every replica's
+                # stream byte-identical, the StreamRelay dedups by
+                # token index and the resume watermark fast-forwards
+                # the twin, so tail latency is hedged exactly for the
+                # requests users watch token by token.
                 and not getattr(request, "no_hedge", False)
             ):
                 target = routed_pick(frozenset(tried), hedge=True)
@@ -701,6 +704,12 @@ class Dispatcher:
                             self.metrics.inc(
                                 "gateway_stream_hedges_total"
                             )
+                        if float(getattr(request, "temperature", 0.0)) > 0:
+                            # a SAMPLED hedge can only be seed-pinned
+                            # (unpinned sampled sets no_hedge) — count
+                            # it so the determinism contract's payoff
+                            # is visible on dashboards
+                            self.metrics.inc("gateway_sampled_hedges_total")
                 else:
                     hedge_at = None  # budget denied; stop re-checking
 

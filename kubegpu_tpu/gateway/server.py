@@ -139,6 +139,10 @@ def make_handler(gateway: Gateway, registry: ReplicaRegistry):
                     tenant=str(body.get("tenant", "")),
                     session=body.get("session"),
                     temperature=float(body.get("temperature", 0.0)),
+                    seed=(
+                        int(body["seed"])
+                        if body.get("seed") is not None else None
+                    ),
                     deadline_s=(
                         float(body["deadline_s"])
                         if body.get("deadline_s") is not None else None
@@ -198,8 +202,12 @@ def make_handler(gateway: Gateway, registry: ReplicaRegistry):
             a hedge twin's stream is the primary's), and the relay's
             emitted watermark rides down to the twin so it fast-forwards
             past tokens the caller already has — each token arrives
-            exactly once whichever attempt supplies it.  SAMPLED streams
-            (temperature > 0) keep the one-attempt pin: replicas do not
+            exactly once whichever attempt supplies it.  SEED-PINNED
+            sampled streams (temperature > 0 with a request seed) get
+            the same treatment: position-keyed sample keys make every
+            replica's stream byte-identical, so they hedge, dedup and
+            resume exactly like greedy.  Only UNPINNED sampled streams
+            keep the one-attempt pin: without a seed, replicas do not
             emit identical sampled streams.  A vanished caller fails the
             next write, which sets the request's abort event: the
             dispatcher cancels every in-flight attempt wire-level, so
@@ -210,20 +218,27 @@ def make_handler(gateway: Gateway, registry: ReplicaRegistry):
             from kubegpu_tpu.gateway.dataplane import end_chunks, sse_event
 
             greedy = float(getattr(request, "temperature", 0.0)) == 0.0
+            # deterministic = every replica reproduces the same stream:
+            # greedy always; sampled when the request pins a seed
+            deterministic = (
+                greedy or getattr(request, "seed", None) is not None
+            )
             # ``resume``: tokens the CALLER already holds — a client
             # resuming a crashed sibling gateway's stream passes its
             # received count as "resume_watermark", the relay skips
             # that prefix, and the dispatcher ships it down the wire so
-            # the replica fast-forwards emission (greedy only; decode
-            # still runs from 0, determinism keeps it token-identical)
-            relay = StreamRelay(gateway.metrics, dedup=greedy,
-                                base=resume if greedy else 0)
+            # the replica fast-forwards emission (deterministic streams
+            # only; decode still runs from 0 and determinism keeps it
+            # token-identical)
+            relay = StreamRelay(gateway.metrics, dedup=deterministic,
+                                base=resume if deterministic else 0)
             request.on_tokens = relay.on_tokens
             request.stream_watermark = relay.emitted
             request.abort = threading.Event()
-            # sampled streams never hedge (incoherent twin streams);
-            # greedy streams hedge through the relay's dedup
-            request.no_hedge = not greedy
+            # unpinned sampled streams never hedge (incoherent twin
+            # streams); greedy and seed-pinned streams hedge through
+            # the relay's dedup
+            request.no_hedge = not deterministic
             gateway.metrics.inc("gateway_stream_requests_total")
             pending = gateway.submit(request)
             # ONLY a refusal short-circuits to plain JSON (429): any

@@ -282,6 +282,7 @@ class GatewayTier:
             tenant=request.tenant,
             session=request.session,
             temperature=request.temperature,
+            seed=request.seed,
             deadline_s=request.deadline_s,
         )
         clone.on_tokens = request.on_tokens

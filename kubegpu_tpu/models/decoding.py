@@ -328,6 +328,55 @@ def generate(
 
 NEG_INF_LOGIT = -1e9  # large-negative in f32; -inf breaks categorical's gumbel
 
+# Seed-pinned key derivation (the determinism contract sampled serving
+# rides): a request that pins a seed derives EVERY random draw as
+# fold_in(fold_in(PRNGKey(seed), absolute_token_position), tag) — a pure
+# function of (seed, position, draw kind), independent of batch
+# composition, slot assignment, replica, and restart.  The tags separate
+# the up-to-three independent draws speculative sampling needs per
+# position (the draft's proposal, the accept test's uniform, the
+# residual/bonus resample); plain sampled decode uses untagged
+# fold_in(base, position) (tag-free — the pre-existing dense stream
+# shape).  Absolute position of generated token n is prompt_len + n.
+KEY_TAG_DRAFT = 1    # draft proposal draw for this position
+KEY_TAG_ACCEPT = 2   # accept-test uniform for this position
+KEY_TAG_SAMPLE = 3   # residual resample / bonus / first-token draw
+
+
+def position_key(base_key, position, tag):
+    """The per-position, per-draw-kind PRNG key of a seed-pinned stream:
+    ``fold_in(fold_in(base_key, position), tag)``.  ``position`` is the
+    ABSOLUTE token position (prompt_len + sample index) so the stream is
+    invariant to everything but (seed, emitted prefix)."""
+    return jax.random.fold_in(jax.random.fold_in(base_key, position), tag)
+
+
+def block_keys(base_keys, start_pos, n: int, tag):
+    """(b, n, 2) keys for a contiguous block of ``n`` positions starting
+    at per-row ``start_pos`` — the speculative step derives its draft/
+    accept/resample key blocks with this."""
+    positions = start_pos[:, None] + jnp.arange(n)[None, :]     # (b, n)
+    return jax.vmap(
+        jax.vmap(position_key, in_axes=(None, 0, None)),
+        in_axes=(0, 0, None),
+    )(base_keys, positions, tag)
+
+
+def warp_logits(logits, temps, top_k: int = 0):
+    """Temperature-scale and (statically) top-k-truncate logits along the
+    last axis: the WARPED distribution is what sampled rows draw from,
+    and — load-bearing for rejection-sampled speculation — both the
+    target's p and the draft's q must be warped identically or the
+    accept ratio p/q compares different measures.  ``temps`` broadcasts
+    against the leading axes (0 entries are guarded; their rows take the
+    greedy path in the caller).  Rows keep f32 logits."""
+    safe_t = jnp.where(temps > 0.0, temps, 1.0)
+    scaled = logits / safe_t[..., None]
+    if top_k > 0:
+        kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+        scaled = jnp.where(scaled >= kth, scaled, NEG_INF_LOGIT)
+    return scaled
+
 
 def pick_tokens(logits, temps, keys, top_k: int = 0):
     """Per-SLOT token choice for the serving batchers: row i samples from
@@ -339,11 +388,7 @@ def pick_tokens(logits, temps, keys, top_k: int = 0):
     PRNG keys — each slot's stream is independent of its neighbors');
     ``top_k`` is static (0 = no truncation)."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    safe_t = jnp.where(temps > 0.0, temps, 1.0)
-    scaled = logits / safe_t[:, None]
-    if top_k > 0:
-        kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
-        scaled = jnp.where(scaled >= kth, scaled, NEG_INF_LOGIT)
+    scaled = warp_logits(logits, temps, top_k)
     sampled = jax.vmap(
         lambda key, row: jax.random.categorical(key, row)
     )(keys, scaled).astype(jnp.int32)

@@ -525,6 +525,7 @@ class _PrefillJob:
     pos: int                 # prompt rows already prefilled (or cached)
     next_scatter: int        # next page index to scatter from the station
     started: bool = False    # first chunk ran (prefill-wait observed)
+    seed: Optional[int] = None  # pinned sample seed (None = legacy keys)
 
 
 @dataclass
@@ -968,6 +969,11 @@ class PagedContinuousBatcher(_TracedBatcher):
         # device-resident, admission-updated (the dense batcher's pattern)
         self._temps = _repl_dev(jnp.zeros((slots,), jnp.float32))
         self._base_keys = _repl_dev(jnp.zeros((slots, 2), jnp.uint32))
+        # fold-index offset per slot: 0 legacy, prompt_len when the
+        # request pins a seed — keys become fold_in(PRNGKey(seed),
+        # absolute token position), invariant across replicas/slots/
+        # migrations (the offset rides the migration payload)
+        self._key_offsets = _repl_dev(jnp.zeros((slots,), jnp.int32))
         # in-program sharding PINS for the mesh case: every hot program
         # constrains its outputs to the layouts its inputs were placed
         # with (pools/station/ring head-sharded, loop state replicated).
@@ -1089,7 +1095,7 @@ class PagedContinuousBatcher(_TracedBatcher):
         from kubegpu_tpu.models.decoding import pick_tokens
 
         def step(params, pools, last_tokens, table, pos, active, remaining,
-                 counts, temps, base_keys):
+                 counts, temps, base_keys, key_offsets):
             # the WHOLE loop transition in one program: emit a token for
             # every slot, then advance last/pos/counts and retire
             # (budget/EOS) for active slots on DEVICE — consecutive
@@ -1107,7 +1113,9 @@ class PagedContinuousBatcher(_TracedBatcher):
                 {"params": params}, last_tokens[:, None], pools, table,
                 run_pos,
             )
-            keys = jax.vmap(jax.random.fold_in)(base_keys, counts)
+            keys = jax.vmap(jax.random.fold_in)(
+                base_keys, counts + key_offsets
+            )
             toks = pick_tokens(logits, temps, keys, self.top_k)
             act = active.astype(jnp.int32)
             new_rem = remaining - act
@@ -1992,7 +2000,8 @@ class PagedContinuousBatcher(_TracedBatcher):
     def _try_begin_admit(self, slot: int, seq_id: int, prompt: np.ndarray,
                          max_new: int, temperature: float,
                          submitted_at: float,
-                         keys: Optional[List[bytes]] = None) -> bool:
+                         keys: Optional[List[bytes]] = None,
+                         seed: Optional[int] = None) -> bool:
         """Reserve pages (prefix-cache hits first), gather hit pages into
         a free station slot, and open the prefill job.  Returns False to
         defer (pool pressure, or an in-flight admission is already
@@ -2114,7 +2123,7 @@ class PagedContinuousBatcher(_TracedBatcher):
         self._jobs[station] = _PrefillJob(
             slot=slot, station=station, seq_id=seq_id, prompt=prompt,
             plen=plen, temperature=temperature, keys=keys,
-            pos=hit_rows, next_scatter=len(hits),
+            pos=hit_rows, next_scatter=len(hits), seed=seed,
         )
         self.stats["admits"] += 1
         self.stats["peak_pages"] = max(
@@ -2165,9 +2174,19 @@ class PagedContinuousBatcher(_TracedBatcher):
         # attend <= plen-1), which emits the first generated token in
         # the same program every other slot decodes with
         slot, s = job.slot, self._seqs[job.slot]
-        base_key = jax.random.fold_in(self._root_key, job.seq_id)
+        if job.seed is not None:
+            # seed-pinned: sample keys fold (seed, absolute position) —
+            # counts start at 0 here, so offset = plen makes the step's
+            # fold index the token's absolute position, independent of
+            # slot, batch composition, replica, or migration history
+            base_key = jax.random.PRNGKey(int(job.seed))
+            offset = job.plen
+        else:
+            base_key = jax.random.fold_in(self._root_key, job.seq_id)
+            offset = 0
         self._temps = self._temps.at[slot].set(job.temperature)
         self._base_keys = self._base_keys.at[slot].set(base_key)
+        self._key_offsets = self._key_offsets.at[slot].set(offset)
         self.tables[slot, :] = s.pages[0]
         self.tables[slot, : len(s.pages)] = s.pages
         self.pos[slot] = job.plen - 1
@@ -2327,7 +2346,8 @@ class PagedContinuousBatcher(_TracedBatcher):
     def submit(self, seq_id: int, prompt: np.ndarray, max_new: int,
                temperature: float = 0.0,
                session_id: Optional[str] = None,
-               trace: Optional[SpanCtx] = None) -> None:
+               trace: Optional[SpanCtx] = None,
+               seed: Optional[int] = None) -> None:
         """Queue one request.  Validates shape and worst-case pool limits
         eagerly (a request that can never fit fails here, not mid-loop).
         ``session_id`` is advisory: prefix sharing is content-addressed.
@@ -2335,7 +2355,10 @@ class PagedContinuousBatcher(_TracedBatcher):
         dispatch span): the request's ``serve`` subtree — queue →
         prefix_gather/station_wait → prefill (chunks) → decode
         (spec_draft/spec_verify) → retire — nests under it; otherwise
-        the batcher's own ``tracer``, if any, roots a fresh trace."""
+        the batcher's own ``tracer``, if any, roots a fresh trace.
+        ``seed`` pins the request's sample stream to (seed, absolute
+        token position) — identical tokens on any replica/slot/batch/
+        restart, surviving migration (the dense batcher's contract)."""
         if seq_id < 0:
             raise ValueError(f"seq_id must be >= 0, got {seq_id}")
         if self.speculate_k is not None and temperature > 0.0:
@@ -2343,8 +2366,11 @@ class PagedContinuousBatcher(_TracedBatcher):
                 "speculative paged serving is greedy-only: lossless "
                 "speculative SAMPLING needs per-position rejection "
                 "sampling against the target distribution (a different "
-                "verify program and acceptance rule); submit with "
-                "temperature=0 or build the batcher without speculate_k"
+                "verify program and acceptance rule — the dense "
+                "SpeculativeContinuousBatcher serves it with "
+                "sampling=True; the paged verify program is a "
+                "documented residual); submit with temperature=0 or "
+                "build the batcher without speculate_k"
             )
         prompt = np.asarray(prompt, np.int32)
         plen = self._validate(prompt, max_new)
@@ -2368,7 +2394,8 @@ class PagedContinuousBatcher(_TracedBatcher):
                 keys.append(h.copy().digest())
         self._trace_begin(seq_id, plen, max_new, trace)
         self._pending.append(
-            (seq_id, prompt, max_new, temperature, time.monotonic(), keys)
+            (seq_id, prompt, max_new, temperature, time.monotonic(), keys,
+             seed)
         )
 
     def cancel(self, seq_id: int) -> bool:
@@ -2747,6 +2774,7 @@ class PagedContinuousBatcher(_TracedBatcher):
             "base_key": [
                 int(x) for x in np.asarray(self._base_keys)[slot]
             ],
+            "key_offset": int(np.asarray(self._key_offsets)[slot]),
             "page_keys": [
                 keys[j].hex() if j < n_full else None
                 for j in range(n_pages)
@@ -2937,6 +2965,12 @@ class PagedContinuousBatcher(_TracedBatcher):
         self._temps = self._temps.at[slot].set(temperature)
         self._base_keys = self._base_keys.at[slot].set(
             jnp.asarray(base_key)
+        )
+        # counts resume at len(tokens): with the exported offset the fold
+        # index stays the absolute position, so a pinned stream's tokens
+        # after migration match the un-migrated run bit-for-bit
+        self._key_offsets = self._key_offsets.at[slot].set(
+            int(payload.get("key_offset", 0))
         )
         self._tables_dev = self._tables_dev.at[slot].set(
             jnp.asarray(self.tables[slot])
@@ -3448,6 +3482,7 @@ class PagedContinuousBatcher(_TracedBatcher):
             self._step(
                 self.params, self.pools, last, table, pos, active,
                 remaining, counts, self._temps, self._base_keys,
+                self._key_offsets,
             )
         )
         self.stats["steps"] += 1
@@ -3591,7 +3626,9 @@ class PagedContinuousBatcher(_TracedBatcher):
             spec_emitted += len(emitted)
             self._last[i] = int(choices_h[i, e - 1])
             if self.metrics is not None:
-                self.metrics.observe("serve_spec_accept_rate", (e - 1) / k)
+                self.metrics.observe(
+                    "serve_spec_accept_rate", (e - 1) / k, mode="greedy"
+                )
             if s.remaining <= 0 or (
                 self.eos_id is not None
                 and emitted
@@ -3715,13 +3752,16 @@ class PagedContinuousBatcher(_TracedBatcher):
         prompts: List[np.ndarray],
         max_new_tokens: List[int],
         temperatures: Optional[List[float]] = None,
+        seeds: Optional[List[Optional[int]]] = None,
     ) -> Dict[int, List[int]]:
         assert len(prompts) == len(max_new_tokens)
         temps = temperatures or [0.0] * len(prompts)
         assert len(temps) == len(prompts)
+        pins = seeds or [None] * len(prompts)
+        assert len(pins) == len(prompts)
         self._reset_stats()
         for i, (p, m, t) in enumerate(zip(prompts, max_new_tokens, temps)):
-            self.submit(i, np.asarray(p), m, t)
+            self.submit(i, np.asarray(p), m, t, seed=pins[i])
         done: Dict[int, List[int]] = {}
         while self.has_work():
             done.update(self.serve_step())

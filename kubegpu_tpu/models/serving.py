@@ -150,6 +150,30 @@ def record_quant_quality(metrics: Optional[Metrics], *,
         metrics.set_gauge("serve_kv_quant_ppl_delta", float(ppl_delta))
 
 
+def record_sampling_quality(metrics: Optional[Metrics], *,
+                            accept_rate: float,
+                            nll_delta: Optional[float] = None,
+                            unigram_agreement: Optional[float] = None
+                            ) -> None:
+    """Publish rejection-sampled speculation's MEASURED quality gauges —
+    the statistical analogue of :func:`record_quant_quality` (sampled
+    spec is lossless in DISTRIBUTION, not token identity, so the gate is
+    aggregate statistics, never per-token match): mean per-position
+    acceptance, the teacher-forced NLL delta of sampled-spec output vs
+    unspeculated sampling under the target, and the unigram-frequency
+    agreement between the two output populations (bench.py
+    serving_sampled_spec measures all three)."""
+    if metrics is None:
+        return
+    metrics.set_gauge("serve_sampled_accept_rate", float(accept_rate))
+    if nll_delta is not None:
+        metrics.set_gauge("serve_sampled_nll_delta", float(nll_delta))
+    if unigram_agreement is not None:
+        metrics.set_gauge(
+            "serve_sampled_unigram_agreement", float(unigram_agreement)
+        )
+
+
 def load_draft_checkpoint(ckpt_dir: str, *, vocab_size: int,
                           num_layers: int, num_heads: int, hidden: int,
                           max_seq: int):
@@ -204,6 +228,7 @@ class _Slot:
     prompt: Optional[np.ndarray] = None
     prefill_pos: int = 0
     temperature: float = 0.0
+    seed: Optional[int] = None   # pinned sample-stream seed (None=legacy)
     submitted_at: float = 0.0
     last_emit_at: float = 0.0
     admit_seq: int = 0        # admission order (token-budget FIFO)
@@ -510,6 +535,9 @@ class ContinuousBatcher(_TracedBatcher):
         # must not re-upload unchanged sampling state every token
         self._temps = jnp.zeros((slots,), jnp.float32)
         self._base_keys = jnp.zeros((slots, 2), jnp.uint32)
+        # fold-index offset per slot: 0 legacy, prompt_len when the
+        # request pins a seed (keys become position-absolute; see step)
+        self._key_offsets = jnp.zeros((slots,), jnp.int32)
         cfg = dict(
             vocab_size=vocab_size, num_layers=num_layers,
             num_heads=num_heads, hidden=hidden, max_seq=max_seq,
@@ -530,20 +558,28 @@ class ContinuousBatcher(_TracedBatcher):
         from kubegpu_tpu.models.decoding import pick_tokens
 
         def step(params, caches, last_tokens, pos, active, counts, temps,
-                 base_keys):
+                 base_keys, key_offsets):
             # one decode step for EVERY slot at its own depth; inactive
             # slots compute garbage that the host never collects.  counts
             # = tokens already emitted per slot: a sequence's nth sample
-            # always draws from fold_in(its base key, n), so neighbors
-            # and slot scheduling never perturb its stream.  The loop
-            # state (last/pos/counts) advances IN-PROGRAM off the
-            # device-resident active mask — the hot loop re-uploads
-            # nothing per token (the paged batcher's discipline; the
-            # mask itself is pushed only when membership changes)
+            # always draws from fold_in(its base key, n + offset), so
+            # neighbors and slot scheduling never perturb its stream.
+            # key_offsets is 0 for legacy (unpinned) requests — their
+            # fold index is the bare sample count, as ever — and the
+            # PROMPT LENGTH for seed-pinned ones, making the fold index
+            # the absolute token position: a pure function of (seed,
+            # position) that survives migration, restart, and replica
+            # reassignment.  The loop state (last/pos/counts) advances
+            # IN-PROGRAM off the device-resident active mask — the hot
+            # loop re-uploads nothing per token (the paged batcher's
+            # discipline; the mask itself is pushed only when membership
+            # changes)
             logits, caches = self.model.apply(
                 {"params": params}, last_tokens[:, None], caches, pos
             )
-            keys = jax.vmap(jax.random.fold_in)(base_keys, counts)
+            keys = jax.vmap(jax.random.fold_in)(
+                base_keys, counts + key_offsets
+            )
             toks = pick_tokens(logits, temps, keys, self.top_k)
             act = active.astype(jnp.int32)
             new_last = jnp.where(active, toks, last_tokens)
@@ -644,9 +680,22 @@ class ContinuousBatcher(_TracedBatcher):
     def _reset_stats(self) -> None:
         self.stats = {"steps": 0, "admits": 0, "prefill_chunks": 0}
 
+    def _base_key_and_offset(self, seq_id: int, seed: Optional[int],
+                             plen: int):
+        """The (base_key, fold offset) pair of one request's sample
+        stream: pinned seeds derive PRNGKey(seed) with position-absolute
+        fold indices (offset = prompt length), so the same (request,
+        seed) replays identically on any replica/slot/batch; unpinned
+        requests keep the legacy (batcher root, seq_id) derivation with
+        count-based indices."""
+        if seed is not None:
+            return jax.random.PRNGKey(int(seed)), plen
+        return jax.random.fold_in(self._root_key, seq_id), 0
+
     def _admit_one(self, slot_idx: int, seq_id: int, prompt: np.ndarray,
                    max_new: int, temperature: float = 0.0,
-                   submitted_at: float = 0.0) -> None:
+                   submitted_at: float = 0.0,
+                   seed: Optional[int] = None) -> None:
         # monolithic admit (prefill_chunk=None): one padded b=1 prefill
         # spliced into the shared cache, first token included
         plen = self._validate(prompt, max_new)
@@ -664,13 +713,14 @@ class ContinuousBatcher(_TracedBatcher):
             self._trace_phase_start(tr, "prefill", t=t, monolithic=True)
         row = np.zeros((self.prompt_pad,), np.int32)
         row[:plen] = prompt
-        base_key = jax.random.fold_in(self._root_key, seq_id)
+        base_key, offset = self._base_key_and_offset(seq_id, seed, plen)
         self._temps = self._temps.at[slot_idx].set(temperature)
         self._base_keys = self._base_keys.at[slot_idx].set(base_key)
+        self._key_offsets = self._key_offsets.at[slot_idx].set(offset)
         first_tok, self.caches, self.pos = self._admit(
             self.params, self.caches, self.pos,
             jnp.asarray(row), jnp.int32(plen), jnp.int32(slot_idx),
-            jnp.float32(temperature), jax.random.fold_in(base_key, 0),
+            jnp.float32(temperature), jax.random.fold_in(base_key, offset),
         )
         s = self._slots[slot_idx]
         s.seq_id, s.active = seq_id, True
@@ -694,7 +744,8 @@ class ContinuousBatcher(_TracedBatcher):
 
     def _begin_prefill(self, slot_idx: int, seq_id: int, prompt: np.ndarray,
                        max_new: int, temperature: float,
-                       submitted_at: float) -> None:
+                       submitted_at: float,
+                       seed: Optional[int] = None) -> None:
         # chunked admit: reserve the slot, no device work yet — chunks
         # advance in serve_step, interleaved with decode
         self._validate(prompt, max_new)
@@ -713,6 +764,7 @@ class ContinuousBatcher(_TracedBatcher):
         s.tokens, s.remaining = [], max_new
         s.prompt, s.prefill_pos = prompt, 0
         s.temperature = temperature
+        s.seed = seed
         s.submitted_at = submitted_at
         s.admit_seq = self._admit_counter
         self._admit_counter += 1
@@ -730,9 +782,10 @@ class ContinuousBatcher(_TracedBatcher):
         # first generated token alongside every other active slot
         s = self._slots[slot_idx]
         plen = int(s.prompt.shape[0])
-        base_key = jax.random.fold_in(self._root_key, s.seq_id)
+        base_key, offset = self._base_key_and_offset(s.seq_id, s.seed, plen)
         self._temps = self._temps.at[slot_idx].set(s.temperature)
         self._base_keys = self._base_keys.at[slot_idx].set(base_key)
+        self._key_offsets = self._key_offsets.at[slot_idx].set(offset)
         self._last_tokens = self._last_tokens.at[slot_idx].set(
             int(s.prompt[plen - 1])
         )
@@ -817,7 +870,8 @@ class ContinuousBatcher(_TracedBatcher):
     def submit(self, seq_id: int, prompt: np.ndarray, max_new: int,
                temperature: float = 0.0,
                session_id: Optional[str] = None,
-               trace: Optional[SpanCtx] = None) -> None:
+               trace: Optional[SpanCtx] = None,
+               seed: Optional[int] = None) -> None:
         """Queue one request (seq_id must be a fresh non-negative int).
         Validates shape limits eagerly so a malformed request fails at
         submission, never mid-serve-loop where it would take down the
@@ -827,14 +881,19 @@ class ContinuousBatcher(_TracedBatcher):
         the key itself is advisory there too).  ``trace`` is an optional
         caller span context (the gateway's dispatch span): the request's
         ``serve`` subtree nests under it; otherwise the batcher's own
-        ``tracer``, if any, roots a fresh trace."""
+        ``tracer``, if any, roots a fresh trace.  ``seed`` pins the
+        request's sample stream: every draw becomes a pure function of
+        (seed, absolute token position) — same tokens on any replica,
+        slot, batch, or restart (the gateway's hedging/dedup/migration
+        contract for sampled traffic); None keeps the legacy
+        batcher-local derivation."""
         if seq_id < 0:
             raise ValueError(f"seq_id must be >= 0, got {seq_id}")
         prompt = np.asarray(prompt, np.int32)
         plen = self._validate(prompt, max_new)
         self._trace_begin(seq_id, plen, max_new, trace)
         self._pending.append(
-            (seq_id, prompt, max_new, temperature, time.monotonic())
+            (seq_id, prompt, max_new, temperature, time.monotonic(), seed)
         )
 
     def cancel(self, seq_id: int) -> bool:
@@ -882,14 +941,16 @@ class ContinuousBatcher(_TracedBatcher):
                     s.seq_id = -1
                     progress = True
                 if s.seq_id < 0 and self._pending:
-                    seq_id, prompt, max_new, temp, t0 = (
+                    seq_id, prompt, max_new, temp, t0, seed = (
                         self._pending.popleft()
                     )
                     if self.prefill_chunk is None:
-                        self._admit_one(i, seq_id, prompt, max_new, temp, t0)
+                        self._admit_one(
+                            i, seq_id, prompt, max_new, temp, t0, seed
+                        )
                     else:
                         self._begin_prefill(
-                            i, seq_id, prompt, max_new, temp, t0
+                            i, seq_id, prompt, max_new, temp, t0, seed
                         )
                     self.stats["admits"] += 1
                     progress = True
@@ -918,7 +979,7 @@ class ContinuousBatcher(_TracedBatcher):
              self._counts_dev) = self._step(
                 self.params, self.caches, self._last_tokens, self.pos,
                 self._active_dev, self._counts_dev, self._temps,
-                self._base_keys,
+                self._base_keys, self._key_offsets,
             )
             self.stats["steps"] += 1
             toks_host = np.asarray(toks)
@@ -944,19 +1005,22 @@ class ContinuousBatcher(_TracedBatcher):
         prompts: List[np.ndarray],
         max_new_tokens: List[int],
         temperatures: Optional[List[float]] = None,
+        seeds: Optional[List[Optional[int]]] = None,
     ) -> Dict[int, List[int]]:
         """Serve every prompt to completion; returns {seq_id: generated
         tokens}.  ``stats['steps']`` afterwards holds the number of step
         programs executed (the efficiency measure vs static batching).
         ``temperatures`` is per-request (0/None = greedy; >0 samples from
         softmax(logits/T), truncated to the batcher's ``top_k``) — mixed
-        greedy/sampled requests share the batch."""
+        greedy/sampled requests share the batch.  ``seeds`` optionally
+        pins per-request sample streams (see ``submit``)."""
         assert len(prompts) == len(max_new_tokens)
         temps = temperatures or [0.0] * len(prompts)
         assert len(temps) == len(prompts)
+        seeds = seeds or [None] * len(prompts)
         self._reset_stats()
         for i, (p, m, t) in enumerate(zip(prompts, max_new_tokens, temps)):
-            self.submit(i, np.asarray(p), m, t)
+            self.submit(i, np.asarray(p), m, t, seed=seeds[i])
         done: Dict[int, List[int]] = {}
         done.update(self.serve_step())
         while self.has_work():

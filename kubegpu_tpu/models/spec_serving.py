@@ -23,11 +23,22 @@ TPU-first structure: still exactly TWO compiled programs —
 - ``admit``: prefill one padded prompt through BOTH models on fresh b=1
   caches and splice both into the shared slot caches.
 
-Greedy-only by design: lossless speculative SAMPLING needs per-position
-rejection sampling against the target distribution (a different program
-and a different acceptance rule); greedy verification is exact prefix
-matching and keeps the batcher token-identical to `greedy_generate`.
-``run`` rejects non-zero temperatures rather than silently degrading.
+Sampling (``sampling=True``) adds per-position REJECTION SAMPLING to the
+same two programs: sampled slots draw proposals from the warped draft
+distribution, accept each with probability min(1, p/q) against the
+equally-warped target, and resample the first rejection from the
+normalized residual max(0, p-q) (`models/speculative.py
+rejection_sample_block`) — lossless in DISTRIBUTION against unspeculated
+sampling at the same temperature/top_k.  Mixed greedy/sampled batches
+share the ONE compiled step: temperature-0 slots keep the exact
+argmin-prefix greedy path via a per-row select, so greedy token-identity
+holds inside a mixed batch.  Every draw keys off
+``position_key(request_key, absolute_position, tag)`` — a request that
+pins a ``seed`` reproduces the identical token stream across batch
+composition, slot assignment, restart, and replica (the gateway's
+hedging/dedup/migration contract for sampled traffic).  A batcher built
+with ``sampling=False`` (default) compiles the pure greedy program and
+rejects non-zero temperatures rather than silently degrading.
 
 Losslessness is guaranteed PER NUMERICS CLASS, and that scoping is
 load-bearing (the root cause behind the r5 ``spec_serving_match_dense:
@@ -63,7 +74,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kubegpu_tpu.models.decoding import DecodeLM, init_caches
+from kubegpu_tpu.models.decoding import (
+    KEY_TAG_ACCEPT,
+    KEY_TAG_DRAFT,
+    KEY_TAG_SAMPLE,
+    DecodeLM,
+    block_keys,
+    init_caches,
+    pick_tokens,
+    position_key,
+    warp_logits,
+)
+from kubegpu_tpu.models.speculative import rejection_sample_block
+from kubegpu_tpu.utils.metrics import Metrics
 
 
 @dataclass
@@ -72,16 +95,21 @@ class _Slot:
     remaining: int = 0
     active: bool = False
     tokens: List[int] = field(default_factory=list)
+    temperature: float = 0.0
 
 
 class SpeculativeContinuousBatcher:
-    """Greedy continuous batching with per-slot speculative decoding.
+    """Continuous batching with per-slot speculative decoding.
 
     ``draft_*`` size the proposal model (its params are ``draft_params``);
-    ``k`` is the speculation depth.  Output is token-identical to
+    ``k`` is the speculation depth.  Greedy output is token-identical to
     ``ContinuousBatcher`` (and so to per-sequence ``greedy_generate``)
     for ANY draft — the draft only changes how many target calls that
-    output costs (``stats['steps']``)."""
+    output costs (``stats['steps']``).  With ``sampling=True``,
+    temperature>0 slots rejection-sample (lossless in distribution, see
+    module docstring); ``metrics`` observes
+    ``serve_spec_accept_rate{mode=greedy|sampled}`` per slot per
+    verify."""
 
     def __init__(
         self,
@@ -102,12 +130,20 @@ class SpeculativeContinuousBatcher:
         eos_id: Optional[int] = None,
         dtype=jnp.bfloat16,
         quant: bool = False,
+        sampling: bool = False,
+        top_k: int = 0,
+        seed: int = 0,
+        metrics: Optional[Metrics] = None,
     ) -> None:
         if k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
         if prompt_pad > max_seq:
             raise ValueError(
                 f"prompt_pad ({prompt_pad}) exceeds max_seq ({max_seq})"
+            )
+        if top_k > vocab_size:
+            raise ValueError(
+                f"top_k ({top_k}) exceeds vocab_size ({vocab_size})"
             )
         self.params = params
         self.draft_params = draft_params
@@ -116,6 +152,14 @@ class SpeculativeContinuousBatcher:
         self.prompt_pad = prompt_pad
         self.max_seq = max_seq
         self.eos_id = eos_id
+        self.sampling = sampling
+        self.top_k = top_k
+        self.metrics = metrics
+        self._root_key = jax.random.PRNGKey(seed)
+        # device-resident per-slot sampling state, updated only at
+        # admission (the dense batcher's discipline)
+        self._temps = jnp.zeros((slots,), jnp.float32)
+        self._base_keys = jnp.zeros((slots, 2), jnp.uint32)
         self.model = DecodeLM(
             vocab_size=vocab_size, num_layers=num_layers,
             num_heads=num_heads, hidden=hidden, max_seq=max_seq,
@@ -138,7 +182,8 @@ class SpeculativeContinuousBatcher:
         self._last_tokens = jnp.zeros((slots,), jnp.int32)
         row_ids = jnp.arange(slots)
 
-        def step(tparams, dparams, t_caches, d_caches, last, pos):
+        def step(tparams, dparams, t_caches, d_caches, last, pos, temps,
+                 base_keys):
             # Retired slots keep stepping at a frozen pos until their next
             # admission; clamp so even their junk writes (rows
             # [pos, pos+k]) stay in range — never rely on scatter index
@@ -158,11 +203,24 @@ class SpeculativeContinuousBatcher:
                     {"params": dparams}, tok[:, None], caches, p
                 )
                 # draft runs with all_logits=False: logits are (b, vocab)
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                return (caches, nxt, p + 1), nxt
+                if self.sampling:
+                    dkeys = jax.vmap(
+                        position_key, in_axes=(0, 0, None)
+                    )(base_keys, p + 1, KEY_TAG_DRAFT)
+                    nxt = pick_tokens(logits, temps, dkeys, self.top_k)
+                else:
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                # q logits stack only when sampling — the greedy program
+                # stays identical to the sampling=False batcher's
+                return (caches, nxt, p + 1), (
+                    (nxt, logits) if self.sampling else nxt
+                )
 
-            (d_caches, _, _), proposed = jax.lax.scan(
+            (d_caches, _, _), scanned = jax.lax.scan(
                 d_step, (d_caches, last, pos), None, length=self.k + 1
+            )
+            proposed, d_logits = (
+                scanned if self.sampling else (scanned, None)
             )
             proposals = proposed.T[:, : self.k]              # (b, k)
 
@@ -181,12 +239,41 @@ class SpeculativeContinuousBatcher:
                 ).astype(jnp.int32),
                 axis=1,
             )
+            block = choices
+            if self.sampling:
+                # sampled slots swap accept rule + emit block for the
+                # rejection sampler; greedy slots keep the exact path
+                # above (per-row select — one compiled step for mixed
+                # batches).  Keys fold the CACHE position pos+1+j, which
+                # equals absolute position plen + sample index — the
+                # seed-pinned invariance the gateway relies on.
+                wt = warp_logits(
+                    logits_all.astype(jnp.float32), temps[:, None],
+                    self.top_k,
+                )
+                wd = warp_logits(
+                    jnp.moveaxis(d_logits, 0, 1)[:, : self.k]
+                    .astype(jnp.float32),
+                    temps[:, None], self.top_k,
+                )
+                a_keys = block_keys(
+                    base_keys, pos + 1, self.k, KEY_TAG_ACCEPT
+                )
+                s_keys = block_keys(
+                    base_keys, pos + 1, self.k + 1, KEY_TAG_SAMPLE
+                )
+                s_block, s_accepted = rejection_sample_block(
+                    wt, wd, proposals, a_keys, s_keys
+                )
+                sampled_row = temps > 0.0
+                accepted = jnp.where(sampled_row, s_accepted, accepted)
+                block = jnp.where(sampled_row[:, None], s_block, block)
             emit_len = accepted + 1                           # (b,)
-            next_last = choices[row_ids, emit_len - 1]        # (b,)
-            return choices, emit_len, next_last, t_caches, d_caches
+            next_last = block[row_ids, emit_len - 1]          # (b,)
+            return block, emit_len, next_last, t_caches, d_caches
 
         def admit(tparams, dparams, t_caches, d_caches, pos, prompt_row,
-                  prompt_len, slot):
+                  prompt_len, slot, temp, key):
             # prefill BOTH models on the padded prompt with fresh b=1
             # caches, splice both into the shared slot caches; the first
             # token is the target's argmax at the REAL last prompt row
@@ -204,7 +291,15 @@ class SpeculativeContinuousBatcher:
                 {"params": tparams}, last_real[None, :], fresh_t,
                 (prompt_len - 1)[None],
             )
-            first_tok = jnp.argmax(logits[0, -1]).astype(jnp.int32)
+            if self.sampling:
+                # sample 0 at absolute position plen is a DIRECT target
+                # sample (SAMPLE tag — same as a bonus token); greedy
+                # admits (temp 0) still argmax inside pick_tokens
+                first_tok = pick_tokens(
+                    logits[:, -1], temp[None], key[None], self.top_k
+                )[0]
+            else:
+                first_tok = jnp.argmax(logits[0, -1]).astype(jnp.int32)
             fresh_d = init_caches(
                 1, draft_num_layers, draft_num_heads, draft_hidden, max_seq,
                 dtype,
@@ -236,8 +331,15 @@ class SpeculativeContinuousBatcher:
 
     # -- host-side orchestration -------------------------------------------
     def _admit_one(self, slot_idx: int, seq_id: int, prompt: np.ndarray,
-                   max_new: int) -> None:
+                   max_new: int, temperature: float = 0.0,
+                   seed: Optional[int] = None) -> None:
         plen = int(prompt.shape[0])
+        if temperature > 0.0 and not self.sampling:
+            raise ValueError(
+                "greedy-only batcher: temperature "
+                f"{temperature} needs rejection-sampled speculation — "
+                "construct with sampling=True"
+            )
         if plen > self.prompt_pad:
             raise ValueError(
                 f"prompt length {plen} exceeds prompt_pad {self.prompt_pad}"
@@ -262,12 +364,25 @@ class SpeculativeContinuousBatcher:
             )
         row = np.zeros((self.prompt_pad,), np.int32)
         row[:plen] = prompt
+        # pinned seed => keys are a pure function of (seed, position):
+        # identical streams across slots, batchers, and replicas.
+        # Unpinned sampled requests derive from (batcher seed, seq_id) —
+        # reproducible within this batcher only.
+        if seed is not None:
+            base_key = jax.random.PRNGKey(int(seed))
+        else:
+            base_key = jax.random.fold_in(self._root_key, seq_id)
+        self._temps = self._temps.at[slot_idx].set(float(temperature))
+        self._base_keys = self._base_keys.at[slot_idx].set(base_key)
         first_tok, self.caches, self.d_caches, self.pos = self._admit(
             self.params, self.draft_params, self.caches, self.d_caches,
             self.pos, jnp.asarray(row), jnp.int32(plen), jnp.int32(slot_idx),
+            jnp.float32(temperature),
+            position_key(base_key, plen, KEY_TAG_SAMPLE),
         )
         s = self._slots[slot_idx]
         s.seq_id, s.active = seq_id, True
+        s.temperature = float(temperature)
         s.tokens = [int(first_tok)]
         s.remaining = max_new - 1
         self._last_tokens = self._last_tokens.at[slot_idx].set(first_tok)
@@ -281,19 +396,25 @@ class SpeculativeContinuousBatcher:
         prompts: List[np.ndarray],
         max_new_tokens: List[int],
         temperatures: Optional[List[float]] = None,
+        seeds: Optional[List[Optional[int]]] = None,
     ) -> Dict[int, List[int]]:
-        """Serve every prompt to completion (greedy); returns {seq_id:
-        generated tokens}.  ``stats['steps']`` counts target verify
-        programs, ``stats['tokens']`` total emitted tokens — their ratio
-        is the speculative win over one-token stepping."""
-        if temperatures is not None and any(t for t in temperatures):
+        """Serve every prompt to completion; returns {seq_id: generated
+        tokens}.  ``stats['steps']`` counts target verify programs,
+        ``stats['tokens']`` total emitted tokens — their ratio is the
+        speculative win over one-token stepping.  ``temperatures`` is
+        per-request (0/None = greedy; >0 needs ``sampling=True`` and
+        rejection-samples, lossless in distribution); ``seeds`` pins a
+        request's sampled stream (see module docstring)."""
+        if (temperatures is not None and any(t for t in temperatures)
+                and not self.sampling):
             raise ValueError(
-                "SpeculativeContinuousBatcher is greedy-only: lossless "
-                "speculative sampling needs per-position rejection "
-                "sampling, a different verification rule (see module "
-                "docstring)"
+                "greedy-only batcher: lossless speculative sampling "
+                "needs per-position rejection sampling — construct "
+                "SpeculativeContinuousBatcher with sampling=True"
             )
         assert len(prompts) == len(max_new_tokens)
+        temps = temperatures or [0.0] * len(prompts)
+        seeds = seeds or [None] * len(prompts)
         queue = list(range(len(prompts)))
         done: Dict[int, List[int]] = {}
         self.stats = {"steps": 0, "admits": 0, "tokens": 0}
@@ -310,7 +431,8 @@ class SpeculativeContinuousBatcher:
                     if s.seq_id < 0 and queue:
                         nxt = queue.pop(0)
                         self._admit_one(
-                            i, nxt, prompts[nxt], max_new_tokens[nxt]
+                            i, nxt, prompts[nxt], max_new_tokens[nxt],
+                            temps[nxt], seeds[nxt],
                         )
                         self.stats["admits"] += 1
                         progress = True
@@ -321,6 +443,7 @@ class SpeculativeContinuousBatcher:
                 self._step(
                     self.params, self.draft_params, self.caches,
                     self.d_caches, self._last_tokens, self.pos,
+                    self._temps, self._base_keys,
                 )
             )
             self.stats["steps"] += 1
@@ -336,6 +459,12 @@ class SpeculativeContinuousBatcher:
             for i, s in enumerate(self._slots):
                 if not s.active:
                     continue
+                if self.metrics is not None:
+                    self.metrics.observe(
+                        "serve_spec_accept_rate",
+                        (int(emit_h[i]) - 1) / self.k,
+                        mode="sampled" if s.temperature > 0 else "greedy",
+                    )
                 emitted = list(block_h[i, : emit_h[i]])
                 # budget cap: the device may have emitted past the
                 # slot's remaining budget; the surplus is junk (the slot

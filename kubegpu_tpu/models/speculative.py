@@ -1,4 +1,4 @@
-"""Speculative decoding: draft-proposed, target-verified greedy generation.
+"""Speculative decoding: draft-proposed, target-verified generation.
 
 A small DRAFT model proposes ``k`` tokens autoregressively; the TARGET
 model scores all of them in ONE chunked forward against its KV cache and
@@ -10,6 +10,26 @@ exactly, for ANY draft — the draft only changes how many target forwards
 the sequence costs (``ceil(steps/(k+1))`` with a perfect draft, up to
 ``steps`` iterations with a useless one; every iteration emits at least
 one token, so termination is unconditional).
+
+SAMPLED rows (temperature > 0) ride the same block structure with a
+different acceptance rule — per-position rejection sampling
+(:func:`rejection_sample_block`): proposal x_i drawn from the WARPED
+draft distribution q is accepted with probability min(1, p(x_i)/q(x_i))
+against the equally-warped target p; on the first rejection the emitted
+token resamples from the normalized residual max(0, p - q), and after a
+fully-accepted block the bonus token samples directly from p (the
+residual with q := 0).  The marginal at every position is exactly
+min(p,q) + (1 - sum min(p,q)) * max(0,p-q)/Z = p — lossless IN
+DISTRIBUTION (not token-identical; the draft changes which sample you
+get, never its law).  Every draw derives from
+``position_key(request_key, absolute_position, tag)`` (decoding.py), so
+a seed-pinned sampled stream is a pure function of (seed, emitted
+prefix) — invariant to batch composition, slot assignment, replica, and
+restart, which is what lets the gateway hedge/dedup/migrate sampled
+traffic like greedy.  Mixed greedy/sampled batches share ONE compiled
+step: sampled rows select the rejection block, temperature-0 rows keep
+the exact argmin-prefix greedy path (and top_k=1 degenerates the
+sampled path to greedy too — the warped distribution is a point mass).
 
 TPU-first shape: ONE compiled program — a ``lax.while_loop`` whose body
 is (a ``scan`` of k draft steps) + (one target chunk forward of k+1
@@ -35,7 +55,77 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from kubegpu_tpu.models.decoding import DecodeLM, init_caches
+from kubegpu_tpu.models.decoding import (
+    KEY_TAG_ACCEPT,
+    KEY_TAG_DRAFT,
+    KEY_TAG_SAMPLE,
+    DecodeLM,
+    block_keys,
+    init_caches,
+    pick_tokens,
+    position_key,
+    warp_logits,
+)
+
+
+def rejection_sample_block(t_logits, d_logits, proposals, accept_keys,
+                           sample_keys):
+    """Per-position rejection sampling over one speculative block — the
+    sampled analogue of the greedy argmin-prefix accept, factored out so
+    its distribution is testable in isolation (chi-square against the
+    target softmax, both accept and residual paths).
+
+    ``t_logits`` (b, k+1, V): WARPED target logits (temperature/top-k
+    already applied — see :func:`warp_logits`; warping must match the
+    draft's or the accept ratio compares different measures).
+    ``d_logits`` (b, k, V): equally warped draft logits; ``proposals``
+    (b, k) were drawn from ``softmax(d_logits)``.  ``accept_keys``
+    (b, k, 2) feed the accept-test uniforms; ``sample_keys`` (b, k+1, 2)
+    feed the residual resample at each candidate emit slot (slot k is
+    the bonus token — its "residual" is the target distribution itself,
+    q zero-padded).
+
+    Returns ``(block, accepted)``: ``accepted`` (b,) is the number of
+    accepted proposals (argmin of the accept prefix); ``block`` (b, k+1)
+    holds the accepted proposals then the resample at the first
+    rejection (or the bonus sample) — rows past ``accepted`` are junk
+    exactly like the greedy block's tail.  Exactness per slot: emit(x) =
+    min(p,q) + (1 - sum_y min(p,q)) * max(0, p-q)/Z = p."""
+    b, kp1, _ = t_logits.shape
+    k = kp1 - 1
+    p = jax.nn.softmax(t_logits, axis=-1)               # (b, k+1, V)
+    q = jax.nn.softmax(d_logits, axis=-1)               # (b, k,   V)
+    p_prop = jnp.take_along_axis(
+        p[:, :k], proposals[..., None], axis=-1
+    )[..., 0]                                           # (b, k)
+    q_prop = jnp.take_along_axis(
+        q, proposals[..., None], axis=-1
+    )[..., 0]                                           # (b, k)
+    u = jax.vmap(jax.vmap(jax.random.uniform))(accept_keys)   # (b, k)
+    # accept x_i w.p. min(1, p/q): u <= p/q, cross-multiplied so q=0
+    # (top-k-truncated proposals can't occur, but guard the algebra)
+    accept = u * q_prop <= p_prop                       # (b, k)
+    accepted = jnp.argmin(
+        jnp.concatenate([accept, jnp.zeros((b, 1), bool)], axis=1)
+        .astype(jnp.int32),
+        axis=1,
+    )                                                   # (b,) in [0, k]
+    # residual at every candidate slot; slot k (bonus) pads q with 0 so
+    # its residual IS p — a direct target sample
+    q_pad = jnp.concatenate([q, jnp.zeros_like(p[:, :1])], axis=1)
+    resid = jnp.clip(p - q_pad, 0.0)
+    rsum = jnp.sum(resid, axis=-1, keepdims=True)
+    # rsum == 0 means p == q exactly (rejection prob ~0); fall back to p
+    dist = jnp.where(rsum > 0.0, resid / jnp.maximum(rsum, 1e-30), p)
+    resampled = jax.vmap(jax.vmap(
+        lambda key, row: jax.random.categorical(key, row)
+    ))(sample_keys, jnp.log(jnp.clip(dist, 1e-30))).astype(jnp.int32)
+    prop_pad = jnp.concatenate(
+        [proposals, jnp.zeros((b, 1), jnp.int32)], axis=1
+    )
+    cols = jnp.arange(k + 1)[None, :]
+    block = jnp.where(cols < accepted[:, None], prop_pad, resampled)
+    return block, accepted
 
 
 def speculative_generate(
@@ -55,14 +145,38 @@ def speculative_generate(
     draft_hidden: int,
     dtype=jnp.bfloat16,
     quant: bool = False,
+    temperatures=None,
+    seeds=None,
+    top_k: int = 0,
 ):
-    """Greedy speculative decode; returns ``(tokens, target_calls)``.
+    """Speculative decode; returns ``(tokens, target_calls)``.
 
-    ``tokens`` is ``(b, prompt_len + num_steps)`` — identical to
-    ``greedy_generate(target_params, ...)``.  ``target_calls`` counts
-    verify iterations, the cost measure a draft is judged by.  The draft
-    shares the target's vocab/max_seq with its own depth/width."""
+    Greedy (``temperatures=None``): ``tokens`` is ``(b, prompt_len +
+    num_steps)`` — identical to ``greedy_generate(target_params, ...)``.
+    ``target_calls`` counts verify iterations, the cost measure a draft
+    is judged by.  The draft shares the target's vocab/max_seq with its
+    own depth/width.
+
+    Sampled (``temperatures`` a (b,) vector, 0 entries greedy): sampled
+    rows use per-position rejection sampling — lossless in DISTRIBUTION
+    against plain sampling from the target at the same temperature/
+    ``top_k``; ``seeds`` (b,) pin each row's stream (defaults to the row
+    index) via the ``position_key`` contract, so the same (prompt, seed)
+    reproduces the same tokens for any draft quality, batch shape, or
+    restart."""
     b, prompt_len = prompt.shape
+    sampling = temperatures is not None
+    if sampling:
+        temps = jnp.asarray(temperatures, jnp.float32)
+        if temps.shape != (b,):
+            raise ValueError(
+                f"temperatures must be shape ({b},), got {temps.shape}"
+            )
+        if seeds is None:
+            seeds = list(range(b))
+        base_keys = jnp.stack(
+            [jax.random.PRNGKey(int(s)) for s in seeds]
+        )                                               # (b, 2) uint32
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
     if num_steps < 1:
@@ -102,7 +216,18 @@ def speculative_generate(
     zero = jnp.zeros((), jnp.int32)
     t_logits, t_caches = t_apply(prompt, t_caches, zero)
     _, d_caches = d_apply(prompt, d_caches, zero)
-    first_tok = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)  # (b,)
+    if sampling:
+        # sample 0 sits at absolute position prompt_len; it is a DIRECT
+        # target sample (no proposal precedes it), hence the SAMPLE tag —
+        # the same tag a bonus token carries
+        keys0 = jax.vmap(position_key, in_axes=(0, None, None))(
+            base_keys, prompt_len, KEY_TAG_SAMPLE
+        )
+        first_tok = pick_tokens(t_logits[:, -1], temps, keys0, top_k)
+    else:
+        first_tok = jnp.argmax(
+            t_logits[:, -1], axis=-1
+        ).astype(jnp.int32)                             # (b,)
 
     buf_len = num_steps + k + 1  # room for the final over-budget block
     out0 = jnp.zeros((b, buf_len), jnp.int32).at[:, 0].set(first_tok)
@@ -145,12 +270,23 @@ def speculative_generate(
         def d_step(carry, _):
             caches, tok, p = carry
             logits, caches = d_apply(tok[:, None], caches, p)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return (caches, nxt, p + 1), nxt
+            if sampling:
+                dkeys = jax.vmap(position_key, in_axes=(0, 0, None))(
+                    base_keys, p + 1, KEY_TAG_DRAFT
+                )
+                nxt = pick_tokens(logits, temps, dkeys, top_k)
+            else:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # the rejection rule needs q: stack the draft's logits only
+            # when sampling (the greedy program stays byte-identical)
+            return (caches, nxt, p + 1), (
+                (nxt, logits) if sampling else nxt
+            )
 
-        (d_caches, _, _), proposed = jax.lax.scan(
+        (d_caches, _, _), scanned = jax.lax.scan(
             d_step, (st["d_caches"], last, pos), None, length=k + 1
         )
+        proposed, d_logits = scanned if sampling else (scanned, None)
         proposals = proposed.T[:, :k]                 # (b, k)
 
         # ---- target: ONE chunk forward over [last, p_1..p_k] -----------
@@ -170,13 +306,31 @@ def speculative_generate(
             .astype(jnp.int32),
             axis=1,
         )
-        emit_len = accepted + 1
         # the emitted block IS `choices`: for i < accepted the proposal
         # matched choices[i] by the definition of `accepted`, and at the
         # divergence (or bonus) position the target's own choice is what
         # greedy emits; the tail past emit_len is junk the NEXT block's
         # write fully overwrites
         block = choices
+        if sampling:
+            # sampled rows swap accept rule and emit block for the
+            # rejection sampler; greedy rows keep the exact path above
+            wt = warp_logits(
+                logits_all.astype(jnp.float32), temps[:, None], top_k
+            )
+            wd = warp_logits(
+                jnp.moveaxis(d_logits, 0, 1)[:, :k].astype(jnp.float32),
+                temps[:, None], top_k,
+            )
+            a_keys = block_keys(base_keys, pos + 1, k, KEY_TAG_ACCEPT)
+            s_keys = block_keys(base_keys, pos + 1, k + 1, KEY_TAG_SAMPLE)
+            s_block, s_accepted = rejection_sample_block(
+                wt, wd, proposals, a_keys, s_keys
+            )
+            sampled_row = temps > 0.0
+            accepted = jnp.where(sampled_row, s_accepted, accepted)
+            block = jnp.where(sampled_row[:, None], s_block, block)
+        emit_len = accepted + 1
 
         out = jax.vmap(
             lambda row, blk, start: jax.lax.dynamic_update_slice(

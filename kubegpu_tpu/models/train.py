@@ -157,6 +157,75 @@ def make_lm_train_step(mesh: Mesh, donate: bool = True):
 
 
 # ---------------------------------------------------------------------------
+# Draft distillation (speculative decoding)
+# ---------------------------------------------------------------------------
+#
+# The speculative batcher's accept probability at one position is
+# sum_x min(p(x), q(x)) = 1 - TV(p, q): the draft's job is not to be a
+# good LM, it is to MATCH the target's conditionals on the traffic the
+# fleet actually decodes.  So the recipe distills on target ROLLOUTS
+# (prompts continued by the serving model — sampled or greedy, the
+# distribution acceptance is measured against) with the target's own
+# logits as the label, not corpus text: forward KL(p || q) at every
+# position directly minimizes an upper bound on the rejection rate
+# (Pinsker: TV <= sqrt(KL/2)).  A small hard-label term keeps the
+# draft's argmax pinned to the teacher's on high-confidence positions,
+# which is what the GREEDY lane's accept rate measures.
+
+def draft_distill_loss(state: TrainState, params, tokens, teacher_logits,
+                       temperature: float = 1.0,
+                       hard_weight: float = 0.1):
+    """Distillation loss for a draft on teacher rollouts.
+
+    ``tokens``: (b, L+1) rollout token ids (prompt + continuation);
+    ``teacher_logits``: (b, L, V) the target model's logits at each
+    next-token position, captured during the rollout (or recomputed by
+    the ``make_draft_distill_step`` wrapper).  Returns
+    ``KL(teacher || draft) * T^2 + hard_weight * CE(draft, tokens)``.
+    """
+    logits = state.apply_fn({"params": params}, tokens[:, :-1])
+    t = float(temperature)
+    t_logp = jax.nn.log_softmax(
+        jax.lax.stop_gradient(teacher_logits).astype(jnp.float32) / t,
+        axis=-1,
+    )
+    s_logp = jax.nn.log_softmax(logits.astype(jnp.float32) / t, axis=-1)
+    # forward KL: mass where the TEACHER puts it — exactly the measure
+    # the rejection sampler scores the draft against
+    kl = jnp.mean(
+        jnp.sum(jnp.exp(t_logp) * (t_logp - s_logp), axis=-1)
+    ) * t * t
+    return kl + hard_weight * cross_entropy(logits, tokens[:, 1:])
+
+
+def make_draft_distill_step(mesh: Mesh, teacher_apply_fn,
+                            temperature: float = 1.0,
+                            hard_weight: float = 0.1,
+                            donate: bool = True):
+    """Jitted distillation step: ``step(state, teacher_params, tokens)``
+    -> ``(state', loss)``.  The teacher forward runs inside the step
+    under ``stop_gradient`` (frozen), so callers feed raw rollout
+    tokens — no (b, L, V) teacher-logit tensors ride the host loop.
+    ``teacher_apply_fn`` is the target model's ``.apply``; the draft's
+    is already bound in ``state.apply_fn``."""
+
+    def step(state: TrainState, teacher_params, tokens):
+        with current_mesh(mesh):
+            t_logits = jax.lax.stop_gradient(
+                teacher_apply_fn({"params": teacher_params}, tokens[:, :-1])
+            )
+            loss, grads = jax.value_and_grad(
+                lambda p: draft_distill_loss(
+                    state, p, tokens, t_logits,
+                    temperature=temperature, hard_weight=hard_weight,
+                )
+            )(state.params)
+            return state.apply_gradients(grads), loss
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+# ---------------------------------------------------------------------------
 # MoE transformer LM (DP x EP)
 # ---------------------------------------------------------------------------
 

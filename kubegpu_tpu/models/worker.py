@@ -536,6 +536,11 @@ def _run_decode_batched(args, params, max_seq: int, t0: float) -> int:
             params, dparams, **common, quant=args.int8, k=args.spec_k,
             draft_num_layers=args.draft_layers, draft_num_heads=d_heads,
             draft_hidden=d_hidden,
+            # rejection-sampled lossless speculation only when asked:
+            # the sampling step program carries the rejection kernel,
+            # and the greedy-only program must stay untouched otherwise
+            sampling=args.sample_temperature > 0,
+            top_k=args.sample_top_k,
         )
     else:
         from kubegpu_tpu.models.paging import PagedContinuousBatcher
@@ -641,6 +646,15 @@ def _run_decode_batched(args, params, max_seq: int, t0: float) -> int:
     budgets = [
         max(args.steps * (1 + i % 4) // 4, 1) for i in range(n_req)
     ]
+    run_kw = {}
+    if args.sample_temperature > 0:
+        # sampled decode, seed-pinned: every request i derives its keys
+        # from (sample_seed + i, absolute position) — reruns and other
+        # replicas with the same knobs produce identical streams
+        run_kw = dict(
+            temperatures=[args.sample_temperature] * n_req,
+            seeds=[args.sample_seed + i for i in range(n_req)],
+        )
 
     def wave():
         prompts = [
@@ -651,7 +665,7 @@ def _run_decode_batched(args, params, max_seq: int, t0: float) -> int:
             for _ in range(n_req)
         ]
         tw = time.monotonic()
-        out = cb.run(prompts, budgets)
+        out = cb.run(prompts, budgets, **run_kw)
         dt = time.monotonic() - tw
         total = sum(len(v) for v in out.values())
         return total, dt
@@ -974,6 +988,20 @@ def main(argv=None) -> int:
                     "pad (128 when it divides).  Set it SMALLER to seal "
                     "multi-turn decode chains — a retired stream seals "
                     "only FULL pages")
+    ap.add_argument("--sample-temperature", type=float, default=0.0,
+                    help="decode: sample with this temperature instead "
+                    "of greedy argmax (0 = greedy).  With --serving "
+                    "speculative the batcher runs LOSSLESS rejection-"
+                    "sampled speculation: accepted drafts are exact "
+                    "target-distribution samples")
+    ap.add_argument("--sample-top-k", type=int, default=0,
+                    help="decode --sample-temperature: truncate sampling "
+                    "to the k most likely tokens (0 = full softmax)")
+    ap.add_argument("--sample-seed", type=int, default=0,
+                    help="decode --sample-temperature: base seed for "
+                    "seed-pinned sampling — request i pins seed+i, so "
+                    "sampled streams reproduce across reruns, replicas, "
+                    "slots, and batch compositions")
     ap.add_argument("--serve-fp32", action="store_true",
                     help="serve float32 weights instead of the bf16 "
                     "cast: exact cross-process greedy determinism (the "

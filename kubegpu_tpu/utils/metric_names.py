@@ -239,9 +239,14 @@ CATALOG: Dict[str, MetricSpec] = {
         "cancelled its in-flight attempts wire-level (replica pages "
         "freed)"),
     "gateway_stream_hedges_total": _c(
-        (), "hedged dispatches issued for STREAMING (greedy) requests "
-        "— safe because the StreamRelay dedups twin streams by token "
-        "index; sampled streams never hedge"),
+        (), "hedged dispatches issued for STREAMING requests — safe "
+        "because the StreamRelay dedups twin streams by token index; "
+        "greedy and seed-pinned sampled streams hedge, unpinned "
+        "sampled streams never do"),
+    "gateway_sampled_hedges_total": _c(
+        (), "hedged dispatches issued for SAMPLED (temperature > 0) "
+        "requests — possible only because the request pinned a seed, "
+        "making every replica's sampled stream byte-identical"),
     "gateway_stream_dedup_tokens_total": _c(
         (), "tokens a streaming attempt delivered that the caller "
         "already had (hedge twin / retry overlap) — dropped by the "
@@ -378,7 +383,9 @@ CATALOG: Dict[str, MetricSpec] = {
         (), "tokens committed by speculative verifies (divide by "
         "serve_spec_steps_total for the per-step mean)"),
     "serve_spec_accept_rate": _h(
-        (), "accepted-draft fraction per slot per verify (e-1)/k"),
+        ("mode",), "accepted-draft fraction per slot per verify "
+        "(e-1)/k; mode=greedy (exact-match verify) or mode=sampled "
+        "(rejection-sampled lossless speculation)"),
     "serve_spec_draft_seconds": _h((), "draft proposal program wall time"),
     "serve_spec_verify_seconds": _h((), "verify program wall time"),
     "serve_draft_cache_rows": _g(
@@ -434,6 +441,23 @@ CATALOG: Dict[str, MetricSpec] = {
         (), "teacher-forced eval NLL delta of the int8-pool stream vs "
         "the full-width pool's (the eval_ppl_delta_int8 discipline "
         "applied to the page pool)"),
+
+    # -- sampled-speculation quality (bench.py serving_sampled_spec;
+    #    models/serving.record_sampling_quality): the statistical gate
+    #    for LOSSLESS rejection-sampled speculation — per-position
+    #    acceptance plus distribution-agreement evidence that the
+    #    spec-sampled stream is the target model's own
+    "serve_sampled_accept_rate": _g(
+        (), "mean accepted-draft fraction of the sampled-speculation "
+        "bench lane ((emitted-1)/k averaged over verifies)"),
+    "serve_sampled_nll_delta": _g(
+        (), "teacher-forced target-model NLL of the spec-sampled "
+        "streams minus the plain-sampled streams' (same seeds; ~0 "
+        "within sampling noise when rejection sampling is lossless)"),
+    "serve_sampled_unigram_agreement": _g(
+        (), "L1 overlap of the unigram token histograms of the "
+        "spec-sampled vs plain-sampled streams (1.0 = identical "
+        "marginal distributions; a distribution-level lossless check)"),
 
     # -- tensor-parallel serving (models/paging.py with a mesh): the
     #    per-DEVICE half of the pool economy plus the collective traffic

@@ -452,15 +452,15 @@ def test_spec_metrics_exposition():
     ]
     out = cb.run(prompts, [6, 5])
     assert sum(len(v) for v in out.values()) == 11
-    assert m.histogram_count("serve_spec_accept_rate") > 0
+    assert m.histogram_count("serve_spec_accept_rate", mode="greedy") > 0
     assert m.histogram_count("serve_spec_draft_seconds") > 0
     assert m.histogram_count("serve_spec_verify_seconds") > 0
     assert m.get("serve_spec_tokens_per_step") == 11.0
     assert m.get("serve_spec_steps_total") == cb.stats["spec_steps"]
     # accept rate is a fraction of k: every sample within [0, 1]
-    assert 0.0 <= m.histogram_sum("serve_spec_accept_rate") <= (
-        m.histogram_count("serve_spec_accept_rate")
-    )
+    assert 0.0 <= m.histogram_sum(
+        "serve_spec_accept_rate", mode="greedy"
+    ) <= m.histogram_count("serve_spec_accept_rate", mode="greedy")
     text = m.render()
     for name in ("serve_spec_accept_rate", "serve_spec_draft_seconds",
                  "serve_spec_verify_seconds"):
