@@ -101,6 +101,10 @@ multichip-smoke:
 # dryrun_http_serving: spawn a REAL replica subprocess (worker
 # --serve-http), stream/cancel over loopback sockets, then SIGKILL it
 # mid-stream — the distributed-data-plane smoke
+# dryrun_sampled_spec_http: a --serving paged --speculate
+# --sample-temperature worker subprocess; one seed-pinned SAMPLED
+# stream rides rejection-verified speculation (wire-visible
+# spec_steps), replays byte-identical on the same seed
 # dryrun_kv_migration: TWO real replica subprocesses; a request streams
 # on A, migrates mid-stream to B over the export/import verbs, A is
 # SIGKILLed after the handoff — the stream must finish on B
@@ -143,7 +147,8 @@ dryrun:
 	  $(PY) -c "import __graft_entry__ as g; g.dryrun_gateway(); \
 	  g.dryrun_gateway_tier(); \
 	  g.dryrun_spec_serving(); g.dryrun_tracing(); \
-	  g.dryrun_http_serving(); g.dryrun_kv_migration(); \
+	  g.dryrun_http_serving(); g.dryrun_sampled_spec_http(); \
+	  g.dryrun_kv_migration(); \
 	  g.dryrun_quantized_serving(); \
 	  g.dryrun_gateway_pods(); g.dryrun_prefix_tier(); \
 	  g.dryrun_disaggregation(); \

@@ -1639,7 +1639,7 @@ def serving_sampled_spec(extra: dict, tiny: bool = False) -> None:
     )
     record_sampling_quality(
         sm, accept_rate=accept, nll_delta=nll_delta,
-        unigram_agreement=overlap,
+        unigram_agreement=overlap, lane="dense",
     )
     label = "tiny/CPU fp32" if tiny else "1.08B bf16"
     log(
@@ -1661,6 +1661,112 @@ def serving_sampled_spec(extra: dict, tiny: bool = False) -> None:
     extra["serve_sampled_deterministic"] = deterministic
     # gate on the RAW floats (rounding can tie a narrow win)
     extra["serve_sampled_strictly_better"] = bool(spec_tok_s > plain_tok_s)
+
+    # -- lane=paged: the same claim on the PRODUCTION page-pool batcher
+    # (ISSUE 20 acceptance): rejection-verify rides _dispatch_step's
+    # designated readback, so paged sampled-spec must beat paged
+    # unspeculated sampled decode at equal chips, with the same
+    # seed-pinned replay determinism the dense lane holds.
+    from kubegpu_tpu.models.paging import PagedContinuousBatcher
+
+    page = 8 if tiny else 16
+    pool = 4 * -(-max_seq // page) + 8  # 4 slots full-depth + headroom
+    # both paged lanes hold the decode loop at the synchronous baseline
+    # (pipeline_decode=False), the serving_spec_decode discipline: this
+    # gate isolates SPECULATION; the loop mode has its own gate
+    # (serving_decode_overhead) — one variable per gate
+    paged_common = dict(common, page_size=page, pool_pages=pool,
+                        pipeline_decode=False)
+    # the paged lane decodes LONGER than dense: spec admission pays a
+    # one-off b=1 first-token draw per request (dense phasing), so
+    # short budgets measure admission overhead, not the steady-state
+    # verify win the gate is about
+    pbudgets = [min(b * 3, max_seq - prompt_pad - k) for b in budgets]
+
+    def warm_paged(make):
+        m = Metrics()
+        cb = make(m)
+        cb.run([prompts[0][: prompt_pad // 3]], [2],
+               temperatures=[temps[0]], seeds=[7])
+        return cb, m
+
+    def timed_pass(cb):
+        t0 = time.perf_counter()
+        d = cb.run(prompts, pbudgets, temperatures=temps, seeds=seeds)
+        return d, time.perf_counter() - t0
+
+    pplain_cb, _ = warm_paged(lambda m: PagedContinuousBatcher(
+        params, metrics=m, **paged_common,
+    ))
+    pspec_cb, psm = warm_paged(
+        lambda m: PagedContinuousBatcher(
+            params, draft_params=params, speculate_k=k,
+            draft_num_layers=layers, draft_num_heads=heads,
+            draft_hidden=hidden, sampling=True, metrics=m, **paged_common,
+        )
+    )
+    # unlike the dense lanes above, the two paged lanes are judged on
+    # INTERLEAVED passes (plain, spec, plain, spec, ...) with the min
+    # per lane: the margin here is thinner than dense (the paged draft
+    # scan + rejection block ride every iteration), and back-to-back
+    # pass blocks let slow load drift on a shared box land on one lane
+    # only — interleaving cancels it, the serving_disaggregation
+    # per-pair discipline
+    pplain_out, pplain_wall = timed_pass(pplain_cb)
+    pspec_out, pspec_wall = timed_pass(pspec_cb)
+    for _ in range(4):
+        pplain_wall = min(pplain_wall, timed_pass(pplain_cb)[1])
+        pspec_wall = min(pspec_wall, timed_pass(pspec_cb)[1])
+    pplain_tok_s = sum(len(v) for v in pplain_out.values()) / pplain_wall
+    pspec_tok_s = sum(len(v) for v in pspec_out.values()) / pspec_wall
+    p_accept = psm.histogram_sum(
+        "serve_spec_accept_rate", mode="sampled"
+    ) / max(psm.histogram_count("serve_spec_accept_rate", mode="sampled"), 1)
+    # seed-pinned replay on a FRESH engine (another replica) over the
+    # same paged traffic must be byte-identical
+    pdet_cb, _ = warm_paged(
+        lambda m: PagedContinuousBatcher(
+            params, draft_params=params, speculate_k=k,
+            draft_num_layers=layers, draft_num_heads=heads,
+            draft_hidden=hidden, sampling=True, metrics=m, **paged_common,
+        )
+    )
+    p_deterministic = timed_pass(pdet_cb)[0] == pspec_out
+    p_nll_delta = lane_nll(pspec_out) - lane_nll(pplain_out)
+    ph_s = np.bincount(
+        np.concatenate([pspec_out[i] for i in pspec_out]), minlength=vocab
+    ).astype(np.float64)
+    ph_p = np.bincount(
+        np.concatenate([pplain_out[i] for i in pplain_out]), minlength=vocab
+    ).astype(np.float64)
+    p_overlap = 1.0 - 0.5 * float(
+        np.abs(ph_s / ph_s.sum() - ph_p / ph_p.sum()).sum()
+    )
+    record_sampling_quality(
+        psm, accept_rate=p_accept, nll_delta=p_nll_delta,
+        unigram_agreement=p_overlap, lane="paged",
+    )
+    log(
+        f"serving sampled spec paged ({label}, k={k}, page {page}): "
+        f"{pspec_tok_s:.0f} tok/s rejection-sampled spec vs "
+        f"{pplain_tok_s:.0f} plain sampled "
+        f"({pspec_tok_s / max(pplain_tok_s, 1e-9):.2f}x; accept "
+        f"{p_accept * 100:.0f}%); NLL delta {p_nll_delta:+.3f}, unigram "
+        f"overlap {p_overlap:.3f}, deterministic replay: "
+        f"{p_deterministic}"
+    )
+    extra["serve_sampled_paged_spec_tok_s"] = round(pspec_tok_s, 1)
+    extra["serve_sampled_paged_plain_tok_s"] = round(pplain_tok_s, 1)
+    extra["serve_sampled_paged_speedup"] = round(
+        pspec_tok_s / max(pplain_tok_s, 1e-9), 3
+    )
+    extra["serve_sampled_paged_accept_rate"] = round(p_accept, 4)
+    extra["serve_sampled_paged_nll_delta"] = round(p_nll_delta, 4)
+    extra["serve_sampled_paged_unigram_agreement"] = round(p_overlap, 4)
+    extra["serve_sampled_paged_deterministic"] = p_deterministic
+    extra["serve_sampled_paged_strictly_better"] = bool(
+        pspec_tok_s > pplain_tok_s
+    )
 
 
 def serving_decode_overhead(extra: dict, tiny: bool = False) -> None:
@@ -5685,6 +5791,10 @@ def main() -> None:
             # test in tests/test_sampled_spec.py)
             and extra["serve_sampled_strictly_better"]
             and extra["serve_sampled_deterministic"]
+            # ...and the same claim on the production paged batcher
+            # (rejection-verify inside the compiled paged step)
+            and extra["serve_sampled_paged_strictly_better"]
+            and extra["serve_sampled_paged_deterministic"]
             and extra["serve_pipeline_strictly_better"]
             and extra["serve_pipeline_token_identical"]
             and extra["serve_multiturn_strictly_better"]

@@ -92,7 +92,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kubegpu_tpu.models.decoding import DecodeLM, QuantDense, init_caches
+from kubegpu_tpu.models.decoding import (
+    DecodeLM,
+    KEY_TAG_SAMPLE,
+    QuantDense,
+    init_caches,
+    position_key,
+)
 from kubegpu_tpu.models.serving import (
     _observe_emit,
     _TracedBatcher,
@@ -483,6 +489,7 @@ class _Seq:
     remaining: int = 0
     active: bool = False
     prefilling: bool = False     # a _PrefillJob is feeding this slot
+    temperature: float = 0.0     # the accept-rate metric's mode label
     tokens: List[int] = field(default_factory=list)
     pages: List[int] = field(default_factory=list)  # reserved physical ids
     shared: Set[int] = field(default_factory=set)   # cache-owned subset
@@ -659,6 +666,7 @@ class PagedContinuousBatcher(_TracedBatcher):
         draft_hidden: Optional[int] = None,
         speculate_k: Optional[int] = None,
         draft_window: Optional[int] = None,
+        sampling: bool = False,
         mesh: Optional[Mesh] = None,
         prefill_only: bool = False,
     ) -> None:
@@ -792,6 +800,14 @@ class PagedContinuousBatcher(_TracedBatcher):
             )
         self.draft_window = draft_window
         self.speculate_k = speculate_k
+        # sampled speculation (the dense SpeculativeContinuousBatcher's
+        # sampling=True mode, on the paged pool): the spec programs
+        # return per-slot target logits alongside the greedy argmax and
+        # run the rejection sampler IN-PROGRAM, so accept/resample stays
+        # device-resident and the pipelined loop's one readback still
+        # ships only committed ids + accept counts.  Without speculate_k
+        # the flag is inert — plain paged decode already samples.
+        self.sampling = bool(sampling) and speculate_k is not None
         self.draft_params = draft_params
         self.metrics = metrics
         # request tracing (span trees) + the per-iteration ledger ring:
@@ -1052,9 +1068,14 @@ class PagedContinuousBatcher(_TracedBatcher):
                 self.tp,
                 2 * draft_num_layers * prompt_pad * draft_hidden * dsize,
             )
+            # the sampled admit's b=1 first-token forward
+            self._first_psum_bytes = tp_all_reduce_wire_bytes(
+                self.tp, 2 * num_layers * hidden * dsize
+            )
         else:
             self._spec_psum_bytes = 0
             self._admit_psum_bytes = 0
+            self._first_psum_bytes = 0
         self._chunk_psum_bytes = tp_all_reduce_wire_bytes(
             self.tp,
             2 * num_layers * station_slots * page_size * hidden * dsize,
@@ -1092,7 +1113,16 @@ class PagedContinuousBatcher(_TracedBatcher):
             self._set_pool_bytes_gauges()
             self._tp_gauges_set = True
 
-        from kubegpu_tpu.models.decoding import pick_tokens
+        from kubegpu_tpu.models.decoding import (
+            KEY_TAG_ACCEPT,
+            KEY_TAG_DRAFT,
+            KEY_TAG_SAMPLE,
+            block_keys,
+            pick_tokens,
+            position_key,
+            warp_logits,
+        )
+        from kubegpu_tpu.models.speculative import rejection_sample_block
 
         def step(params, pools, last_tokens, table, pos, active, remaining,
                  counts, temps, base_keys, key_offsets):
@@ -1144,6 +1174,9 @@ class PagedContinuousBatcher(_TracedBatcher):
             # k+1-step scan), _spec_verify (window forward + accept).
             k_spec = speculate_k
             ring = draft_window
+            self.draft_num_layers = draft_num_layers
+            self.draft_num_heads = draft_num_heads
+            self.draft_hidden = draft_hidden
             # the draft model is instantiated at the RING's row count:
             # DecodeAttention masks/attends over exactly the cache rows
             # it is built for, so the ring shrink is a pure shape change
@@ -1169,17 +1202,94 @@ class PagedContinuousBatcher(_TracedBatcher):
             # context (accept rate dips until it rebuilds), the TARGET
             # stream is untouched (greedy verification is lossless for
             # ANY draft)
-            self.d_caches = init_caches(
-                slots, draft_num_layers, draft_num_heads, draft_hidden,
-                ring, dtype,
+            # storage-dtype-polymorphic ring (the pool's PR-15
+            # discipline): an int8 replica rests an int8 draft ring —
+            # (slots, ring, h, hd) int8 rows + (slots, h) f32 per-slot
+            # per-head scales, half the resting bytes — and the draft
+            # scan dequantizes/requantizes around its dense compute.
+            # Grow-and-rescale scales (_quant_write_row's arithmetic)
+            # keep the requant DETERMINISTIC: an unchanged scale
+            # round-trips every row bit-identically.  Greedy output is
+            # untouched either way (verification is lossless for any
+            # draft); sampled accept rates shift with the quantized q.
+            quant_ring = self.kv_quant
+            d_hd = draft_hidden // draft_num_heads
+            if quant_ring:
+                def _ring_zeros():
+                    z = jnp.zeros(
+                        (slots, ring, draft_num_heads, d_hd), jnp.int8
+                    )
+                    s = jnp.zeros((slots, draft_num_heads), jnp.float32)
+                    if mesh is not None:
+                        z = jax.device_put(
+                            z, NamedSharding(mesh, dense_cache_spec())
+                        )
+                        s = jax.device_put(
+                            s, NamedSharding(mesh, P(None, MODEL_AXIS))
+                        )
+                    return (z, s)
+
+                self.d_caches = [
+                    (_ring_zeros(), _ring_zeros())
+                    for _ in range(draft_num_layers)
+                ]
+            else:
+                self.d_caches = init_caches(
+                    slots, draft_num_layers, draft_num_heads, draft_hidden,
+                    ring, dtype,
+                )
+                if mesh is not None:
+                    # the draft ring shards its heads dim like the pool
+                    d_sh = NamedSharding(mesh, dense_cache_spec())
+                    self.d_caches = [
+                        (jax.device_put(ck, d_sh), jax.device_put(cv, d_sh))
+                        for ck, cv in self.d_caches
+                    ]
+            # the ring's resting bytes by storage dtype — the byte
+            # column serve_draft_ring_bytes reports and the accounting
+            # invariant audits (rows stay serve_draft_cache_rows)
+            ring_item = 1 if quant_ring else jnp.dtype(dtype).itemsize
+            self._ring_kv_bytes = (
+                2 * draft_num_layers * slots * ring * draft_num_heads
+                * d_hd * ring_item
+            )
+            self._ring_scale_bytes = (
+                2 * draft_num_layers * slots * draft_num_heads * 4
+                if quant_ring else 0
             )
             if mesh is not None:
-                # the draft ring shards its heads dim like the pool
-                d_sh = NamedSharding(mesh, dense_cache_spec())
-                self.d_caches = [
-                    (jax.device_put(ck, d_sh), jax.device_put(cv, d_sh))
-                    for ck, cv in self.d_caches
-                ]
+                _ring_scale_sh = NamedSharding(mesh, P(None, MODEL_AXIS))
+
+                def _pin_ring(caches):
+                    # quantized ring entries are (data, scale) pairs:
+                    # pin both (the pool's _pin_kv discipline)
+                    if not quant_ring:
+                        return _pin_kv(caches, dense=True)
+                    out = []
+                    for (kd, ks_), (vd, vs_) in caches:
+                        out.append((
+                            (
+                                jax.lax.with_sharding_constraint(
+                                    kd, _dense_sh
+                                ),
+                                jax.lax.with_sharding_constraint(
+                                    ks_, _ring_scale_sh
+                                ),
+                            ),
+                            (
+                                jax.lax.with_sharding_constraint(
+                                    vd, _dense_sh
+                                ),
+                                jax.lax.with_sharding_constraint(
+                                    vs_, _ring_scale_sh
+                                ),
+                            ),
+                        ))
+                    return out
+            else:
+                def _pin_ring(caches):
+                    return caches
+            self._pin_ring = _pin_ring
             self._d_pos = np.zeros((slots,), np.int32)   # host mirror
             self._d_pos_dev = _repl_dev(jnp.zeros((slots,), jnp.int32))
             # the ring's memory shape (rows, not bytes) is a CONSTANT
@@ -1195,6 +1305,7 @@ class PagedContinuousBatcher(_TracedBatcher):
                     "serve_draft_cache_rows",
                     float(slots * draft_window),
                 )
+                self._set_draft_ring_bytes_gauges()
                 self._draft_gauge_set = True
 
             def _ring_params(dparams):
@@ -1209,7 +1320,49 @@ class PagedContinuousBatcher(_TracedBatcher):
                     },
                 }
 
-            def spec_draft(dparams, d_caches, last, d_pos, active):
+            def _ring_dequant(caches):
+                # int8 ring -> the draft's dense compute dtype: row *
+                # per-(slot, head) scale.  Shard-local under TP (the
+                # scale broadcast never crosses heads).
+                out = []
+                for (kd, ks_), (vd, vs_) in caches:
+                    out.append((
+                        (
+                            kd.astype(jnp.float32)
+                            * ks_[:, None, :, None]
+                        ).astype(dtype),
+                        (
+                            vd.astype(jnp.float32)
+                            * vs_[:, None, :, None]
+                        ).astype(dtype),
+                    ))
+                return out
+
+            def _ring_requant_one(full, cur_s):
+                # grow-and-rescale (_quant_write_row's arithmetic over
+                # the whole ring): the scale only ever GROWS, and an
+                # unchanged scale round-trips every unchanged row
+                # bit-identically — round(q*s/s) == q
+                f = full.astype(jnp.float32)
+                amax = jnp.max(jnp.abs(f), axis=(1, 3))      # (slots, h)
+                new_s = jnp.maximum(cur_s, amax / 127.0)
+                safe = jnp.where(new_s > 0.0, new_s, 1.0)
+                q = jnp.clip(
+                    jnp.round(f / safe[:, None, :, None]), -127, 127
+                ).astype(jnp.int8)
+                return q, new_s
+
+            def _ring_requant(deq, caches):
+                out = []
+                for (k_f, v_f), ((_, ks_), (_, vs_)) in zip(deq, caches):
+                    out.append((
+                        _ring_requant_one(k_f, ks_),
+                        _ring_requant_one(v_f, vs_),
+                    ))
+                return out
+
+            def spec_draft(dparams, d_caches, last, d_pos, active,
+                           *sampled_in):
                 dparams = _ring_params(dparams)
                 # ring wrap IN-PROGRAM: a slot whose next verify window
                 # would spill past the draft ring restarts its draft
@@ -1218,32 +1371,66 @@ class PagedContinuousBatcher(_TracedBatcher):
                 # wrap flags come back so the host mirror can replay it
                 wrap = active & (d_pos + (k_spec + 1) > ring)
                 d_pos_w = jnp.where(wrap, 0, d_pos)
+                run = _ring_dequant(d_caches) if quant_ring else d_caches
 
                 # k+1 scan steps: the extra step's proposal is discarded
                 # but its cache write consumes p_k (speculative.py's
                 # load-bearing extra step — a k-step scan would leave row
                 # pos+k a hole after a fully-accepted window)
-                def d_step(carry, _):
-                    caches, tok, p = carry
-                    logits, caches = self.draft_model.apply(
-                        {"params": dparams}, tok[:, None], caches, p
-                    )
-                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                    return (caches, nxt, p + 1), nxt
+                if self.sampling:
+                    # sampled proposals key off the ABSOLUTE position
+                    # (pos, the committed-row cursor — the dense spec
+                    # batcher's p, which survives ring wraps and
+                    # migration), and the q logits stack for the verify's
+                    # rejection sampler — a pure device-array handoff,
+                    # never a readback
+                    pos, temps, base_keys = sampled_in
 
-                (d_caches, _, _), proposed = jax.lax.scan(
-                    d_step, (d_caches, last, d_pos_w), None,
-                    length=k_spec + 1
+                    def d_step(carry, _):
+                        caches, tok, p, pa = carry
+                        logits, caches = self.draft_model.apply(
+                            {"params": dparams}, tok[:, None], caches, p
+                        )
+                        dkeys = jax.vmap(
+                            position_key, in_axes=(0, 0, None)
+                        )(base_keys, pa + 1, KEY_TAG_DRAFT)
+                        nxt = pick_tokens(logits, temps, dkeys, self.top_k)
+                        return (caches, nxt, p + 1, pa + 1), (nxt, logits)
+
+                    (run, _, _, _), (proposed, d_logits) = jax.lax.scan(
+                        d_step, (run, last, d_pos_w, pos), None,
+                        length=k_spec + 1
+                    )
+                else:
+                    def d_step(carry, _):
+                        caches, tok, p = carry
+                        logits, caches = self.draft_model.apply(
+                            {"params": dparams}, tok[:, None], caches, p
+                        )
+                        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                        return (caches, nxt, p + 1), nxt
+
+                    (run, _, _), proposed = jax.lax.scan(
+                        d_step, (run, last, d_pos_w), None,
+                        length=k_spec + 1
+                    )
+                    d_logits = None
+                d_caches = (
+                    _ring_requant(run, d_caches) if quant_ring else run
                 )
                 prop, d_pos_w, wrap = _pin_state(
                     proposed.T[:, :k_spec], d_pos_w, wrap
                 )
-                return prop, _pin_kv(d_caches, dense=True), d_pos_w, wrap
+                if self.sampling:
+                    d_logits = _pin_state(d_logits)
+                    return (prop, self._pin_ring(d_caches), d_pos_w, wrap,
+                            d_logits)
+                return prop, self._pin_ring(d_caches), d_pos_w, wrap
 
             self._spec_draft = jax.jit(spec_draft, donate_argnums=(1,))
 
             def spec_verify(params, pools, last, proposals, table, pos,
-                            d_pos, active, remaining):
+                            d_pos, active, remaining, *sampled_in):
                 # window = [last, p_1..p_k]: row j's K/V writes land at
                 # pool rows pos+j through the slot's table (private pages
                 # only — sharable pages end strictly below the first
@@ -1270,6 +1457,39 @@ class PagedContinuousBatcher(_TracedBatcher):
                     ).astype(jnp.int32),
                     axis=1,
                 )
+                block = choices
+                if self.sampling:
+                    # sampled slots swap accept rule + emit block for the
+                    # rejection sampler IN-PROGRAM (the dense batcher's
+                    # exact arithmetic: p and q warped identically, keys
+                    # folding the absolute position pos+1+j); greedy
+                    # slots keep the argmin-prefix path via a per-row
+                    # select — one compiled verify for mixed batches,
+                    # and the readback still ships only committed ids +
+                    # accept counts
+                    d_logits, temps, base_keys = sampled_in
+                    wt = warp_logits(
+                        logits_all.astype(jnp.float32), temps[:, None],
+                        self.top_k,
+                    )
+                    wd = warp_logits(
+                        jnp.moveaxis(d_logits, 0, 1)[:, :k_spec]
+                        .astype(jnp.float32),
+                        temps[:, None], self.top_k,
+                    )
+                    a_keys = block_keys(
+                        base_keys, pos + 1, k_spec, KEY_TAG_ACCEPT
+                    )
+                    s_keys = block_keys(
+                        base_keys, pos + 1, k_spec + 1, KEY_TAG_SAMPLE
+                    )
+                    s_block, s_accepted = rejection_sample_block(
+                        wt, wd, proposals, a_keys, s_keys
+                    )
+                    sampled_row = temps > 0.0
+                    accepted = jnp.where(sampled_row, s_accepted, accepted)
+                    block = jnp.where(sampled_row[:, None], s_block, block)
+                choices = block
                 emit_len = accepted + 1
                 next_last = choices[jnp.arange(slots), emit_len - 1]
                 # commit + termination on DEVICE, mirroring the host's
@@ -1308,7 +1528,8 @@ class PagedContinuousBatcher(_TracedBatcher):
 
             self._spec_verify = jax.jit(spec_verify, donate_argnums=(1,))
 
-            def draft_admit(dparams, d_caches, prompt_row, slot):
+            def draft_admit(dparams, d_caches, prompt_row, slot,
+                            *sampled_in):
                 # prefill the padded prompt on a fresh b=1 draft cache and
                 # splice the WHOLE cache in (zeros past prompt_pad): a
                 # reused slot's stale rows are gone wholesale.  Padding
@@ -1326,6 +1547,61 @@ class PagedContinuousBatcher(_TracedBatcher):
                     {"params": dparams}, prompt_row[None, :], fresh,
                     jnp.zeros((), jnp.int32),
                 )
+                if self.sampling:
+                    # the dense batcher's admit re-applies the REAL last
+                    # prompt token as a single-token forward (row plen-1
+                    # rewritten at the b=1 step's GEMM shapes): sampled
+                    # acceptance compares draft q bit-for-bit against the
+                    # dense reference, so the paged ring must rest the
+                    # identical bytes — greedy admits skip it (greedy
+                    # verification is lossless for any draft ring)
+                    (prompt_len,) = sampled_in
+                    last_real = jax.lax.dynamic_slice(
+                        prompt_row, (prompt_len - 1,), (1,)
+                    )
+                    _, fresh = self.draft_model.apply(
+                        {"params": dparams}, last_real[None, :], fresh,
+                        (prompt_len - 1)[None],
+                    )
+                if quant_ring:
+                    # quantize the fresh prefill at its own tight scale
+                    # (amax over the b=1 cache) and splice data + scale
+                    # into the slot's lane of the (data, scale) pairs
+                    def _q_fresh(full):
+                        f = full.astype(jnp.float32)
+                        s = jnp.max(jnp.abs(f), axis=(1, 3)) / 127.0
+                        safe = jnp.where(s > 0.0, s, 1.0)
+                        q = jnp.clip(
+                            jnp.round(f / safe[:, None, :, None]),
+                            -127, 127,
+                        ).astype(jnp.int8)
+                        return q, s
+
+                    out = []
+                    for ((ck, cs), (cv, vs_)), (fk, fv) in zip(
+                        d_caches, fresh
+                    ):
+                        qk, sk = _q_fresh(fk)
+                        qv, sv = _q_fresh(fv)
+                        out.append((
+                            (
+                                jax.lax.dynamic_update_slice(
+                                    ck, qk, (slot, 0, 0, 0)
+                                ),
+                                jax.lax.dynamic_update_slice(
+                                    cs, sk, (slot, 0)
+                                ),
+                            ),
+                            (
+                                jax.lax.dynamic_update_slice(
+                                    cv, qv, (slot, 0, 0, 0)
+                                ),
+                                jax.lax.dynamic_update_slice(
+                                    vs_, sv, (slot, 0)
+                                ),
+                            ),
+                        ))
+                    return self._pin_ring(out)
                 out = []
                 for (ck, cv), (fk, fv) in zip(d_caches, fresh):
                     out.append((
@@ -1339,6 +1615,30 @@ class PagedContinuousBatcher(_TracedBatcher):
                 return _pin_kv(out, dense=True)
 
             self._draft_admit = jax.jit(draft_admit, donate_argnums=(1,))
+
+            if self.sampling:
+                # first-token program for sampled admits: consume the
+                # REAL last prompt token at row plen-1 (writing exactly
+                # the row the classic first step would) and draw sample 0
+                # DIRECTLY from the warped target at absolute position
+                # plen with the SAMPLE tag — the dense admit's phasing,
+                # so the request's whole key schedule (draft/accept/
+                # resample blocks starting at plen+1) lines up with the
+                # dense reference.  Greedy admits never call it: their
+                # first token rides the first verify window unchanged.
+                def spec_first(params, pools, last_tok, table_row, pos,
+                               temp, key):
+                    logits, pools = self.model.apply(
+                        {"params": params}, last_tok[None, None], pools,
+                        table_row[None, :], pos[None],
+                    )
+                    tok = pick_tokens(
+                        logits, temp[None], key[None], self.top_k
+                    )[0]
+                    tok = _pin_state(tok)
+                    return tok, _pin_kv(pools)
+
+                self._spec_first = jax.jit(spec_first, donate_argnums=(1,))
 
         def chunk(params, station, rows, starts, mask):
             # one batched page-sized causal chunk across EVERY station
@@ -1403,6 +1703,26 @@ class PagedContinuousBatcher(_TracedBatcher):
         else:
             self.metrics.set_gauge(
                 "serve_pool_kv_bytes", float(self._pool_kv_bytes),
+                dtype=self.kv_dtype,
+            )
+
+    def _set_draft_ring_bytes_gauges(self) -> None:
+        """Resting draft-ring bytes by STORAGE dtype (mesh-wide, the
+        serve_pool_kv_bytes discipline): a quantized ring reports its
+        int8 row bytes and its float32 scale bytes as two series; a
+        full-width ring reports one series at the serving dtype."""
+        if self.kv_quant:
+            self.metrics.set_gauge(
+                "serve_draft_ring_bytes", float(self._ring_kv_bytes),
+                dtype="int8",
+            )
+            self.metrics.set_gauge(
+                "serve_draft_ring_bytes", float(self._ring_scale_bytes),
+                dtype="float32",
+            )
+        else:
+            self.metrics.set_gauge(
+                "serve_draft_ring_bytes", float(self._ring_kv_bytes),
                 dtype=self.kv_dtype,
             )
 
@@ -1922,11 +2242,47 @@ class PagedContinuousBatcher(_TracedBatcher):
                     self.station_slots * st_elems * dsize
                 ), f"station layer {li} {nm} bytes drifted"
         if self.speculate_k is not None:
-            for li, (ck, cv) in enumerate(self.d_caches):
-                for nm, arr in (("k", ck), ("v", cv)):
-                    assert arr.dtype == jnp.dtype(self.dtype), (
-                        f"draft ring layer {li} {nm} stores {arr.dtype}"
-                    )
+            # the draft ring is storage-dtype-polymorphic like the pool:
+            # an int8 replica must REST int8 ring rows + f32 scales at
+            # exactly the promised bytes — a full-width ring wearing the
+            # int8 label would silently rest double (the same imposter
+            # the pool leg above catches); a full-width ring rests the
+            # compute dtype
+            d_hd = self.draft_hidden // self.draft_num_heads
+            ring_elems = (
+                self.slots * self.draft_window * self.draft_num_heads
+                * d_hd
+            )
+            if self.kv_quant:
+                for li, (kent, vent) in enumerate(self.d_caches):
+                    for nm, (data, scale) in (("k", kent), ("v", vent)):
+                        assert data.dtype == jnp.dtype(jnp.int8), (
+                            f"draft ring layer {li} {nm} stores "
+                            f"{data.dtype}, declared kv_dtype int8"
+                        )
+                        assert scale.dtype == jnp.dtype(jnp.float32), (
+                            f"draft ring layer {li} {nm} scales are "
+                            f"{scale.dtype}"
+                        )
+                        assert data.nbytes == ring_elems, (
+                            f"draft ring layer {li} {nm} rests "
+                            f"{data.nbytes} B, int8 rows promise "
+                            f"{ring_elems}"
+                        )
+                        assert scale.nbytes == (
+                            self.slots * self.draft_num_heads * 4
+                        ), f"draft ring layer {li} {nm} scale bytes drifted"
+            else:
+                for li, (ck, cv) in enumerate(self.d_caches):
+                    for nm, arr in (("k", ck), ("v", cv)):
+                        assert arr.dtype == jnp.dtype(self.dtype), (
+                            f"draft ring layer {li} {nm} stores {arr.dtype}"
+                        )
+                        assert arr.nbytes == ring_elems * dsize, (
+                            f"draft ring layer {li} {nm} rests "
+                            f"{arr.nbytes} B, {jnp.dtype(self.dtype).name} "
+                            f"rows promise {ring_elems * dsize}"
+                        )
         if self.mesh is not None:
             # the sharded-pool leg: under TP the invariant above is
             # mesh-WIDE (tables replicate, every page spans all shards)
@@ -1960,10 +2316,21 @@ class PagedContinuousBatcher(_TracedBatcher):
                         f"head-sharding: {arr.sharding}"
                     )
             if self.speculate_k is not None:
-                for li, (ck, cv) in enumerate(self.d_caches):
-                    for nm, arr in (("k", ck), ("v", cv)):
+                ring_scale_want = NamedSharding(
+                    self.mesh, P(None, MODEL_AXIS)
+                )
+                for li, (kent, vent) in enumerate(self.d_caches):
+                    if self.kv_quant:
+                        arrs = [("k", kent[0], dense_want),
+                                ("k_scale", kent[1], ring_scale_want),
+                                ("v", vent[0], dense_want),
+                                ("v_scale", vent[1], ring_scale_want)]
+                    else:
+                        arrs = [("k", kent, dense_want),
+                                ("v", vent, dense_want)]
+                    for nm, arr, want in arrs:
                         assert arr.sharding.is_equivalent_to(
-                            dense_want, arr.ndim
+                            want, arr.ndim
                         ), (
                             f"draft ring layer {li} {nm} lost its "
                             f"head-sharding: {arr.sharding}"
@@ -2212,19 +2579,33 @@ class PagedContinuousBatcher(_TracedBatcher):
         # retirement sealing needs the committed stream's prompt half
         s.prompt = job.prompt[: job.plen]
         s.plen = job.plen
+        s.temperature = float(job.temperature)
         if self.speculate_k is not None:
             # the draft needs rows [0, plen-1) of ITS cache before the
             # first window's scan consumes `last` at row plen-1
             row = np.zeros((self.prompt_pad,), np.int32)
             row[: job.plen] = job.prompt[: job.plen]
+            admit_extra = (
+                (jnp.int32(job.plen),) if self.sampling else ()
+            )
             self.d_caches = self._draft_admit(
                 self.draft_params, self.d_caches, jnp.asarray(row),
-                jnp.int32(slot),
+                jnp.int32(slot), *admit_extra,
             )
             self._step_collective_bytes += self._admit_psum_bytes
             self._d_pos[slot] = job.plen - 1
             self._d_pos_dev = self._d_pos_dev.at[slot].set(job.plen - 1)
         s.prefilling, s.active = False, True
+        if (
+            self.sampling
+            and job.temperature > 0.0
+            and not park
+        ):
+            # sampled-spec admits follow the DENSE phasing: sample 0 is
+            # a direct target draw at absolute position plen, committed
+            # here; windows start at pos=plen with last=that token
+            self._spec_first_token(slot, s, base_key, job.plen,
+                                   job.temperature)
         if park:
             s.parked = True
             self._sealed_pending.append(s.seq_id)
@@ -2236,6 +2617,51 @@ class PagedContinuousBatcher(_TracedBatcher):
             self._trace_phase_end(tr, "station_wait", t=t)
             self._trace_phase_end(tr, "prefill", t=t)
             self._trace_phase_start(tr, "decode", t=t)
+
+    def _spec_first_token(self, slot: int, s: _Seq, base_key,
+                          plen: int, temperature: float) -> None:
+        """Sampled-speculation admit epilogue (the dense batcher's admit
+        phasing): consume the real last prompt token at row plen-1 and
+        commit a DIRECT target sample at absolute position plen under
+        the SAMPLE tag, so the request's whole seed-pinned key schedule
+        (draft/accept/resample blocks from plen+1) matches the dense
+        reference stream.  One b=1 program per sampled admission —
+        admission-time work, never on the per-iteration readback path."""
+        key = position_key(base_key, plen, KEY_TAG_SAMPLE)
+        tok_dev, self.pools = self._spec_first(
+            self.params, self.pools,
+            jnp.asarray(self._last[slot], jnp.int32),
+            self._tables_dev[slot],
+            jnp.asarray(plen - 1, jnp.int32),
+            jnp.asarray(temperature, jnp.float32),
+            key,
+        )
+        self._step_collective_bytes += self._first_psum_bytes
+        tok = int(tok_dev)
+        s.tokens = [tok]
+        s.remaining -= 1
+        # the device lane must see the first token's budget debit too,
+        # or its budget truncation would retire one window late
+        self._remaining_dev = self._remaining_dev.at[slot].set(
+            max(s.remaining, 0)
+        )
+        self.pos[slot] = plen
+        self._last[slot] = tok
+        self._pos_dev = self._pos_dev.at[slot].set(plen)
+        self._last_dev = self._last_dev.at[slot].set(tok)
+        self._counts_dev = self._counts_dev.at[slot].set(1)
+        self._d_pos[slot] = plen
+        self._d_pos_dev = self._d_pos_dev.at[slot].set(plen)
+        _observe_emit(self.metrics, s, first=True)
+        self._trace_first_token(s)
+        if s.remaining <= 0 or (
+            self.eos_id is not None and tok == self.eos_id
+        ):
+            # finished at admission (budget 1 or instant EOS): retire
+            # the device lane now; the next serve_step's sweep reaps it
+            s.active = False
+            self._active_dev = self._active_dev.at[slot].set(False)
+            self._remaining_dev = self._remaining_dev.at[slot].set(0)
 
     def _observe_prefill_wait(self, job: _PrefillJob) -> None:
         if self.metrics is not None:
@@ -2361,16 +2787,18 @@ class PagedContinuousBatcher(_TracedBatcher):
         restart, surviving migration (the dense batcher's contract)."""
         if seq_id < 0:
             raise ValueError(f"seq_id must be >= 0, got {seq_id}")
-        if self.speculate_k is not None and temperature > 0.0:
+        if (
+            self.speculate_k is not None
+            and temperature > 0.0
+            and not self.sampling
+        ):
             raise ValueError(
-                "speculative paged serving is greedy-only: lossless "
+                "greedy-only speculative paged batcher: lossless "
                 "speculative SAMPLING needs per-position rejection "
-                "sampling against the target distribution (a different "
-                "verify program and acceptance rule — the dense "
-                "SpeculativeContinuousBatcher serves it with "
-                "sampling=True; the paged verify program is a "
-                "documented residual); submit with temperature=0 or "
-                "build the batcher without speculate_k"
+                "sampling against the target distribution — construct "
+                "PagedContinuousBatcher with sampling=True (the paged "
+                "verify then runs rejection_sample_block in-program), "
+                "or submit with temperature=0"
             )
         prompt = np.asarray(prompt, np.int32)
         plen = self._validate(prompt, max_new)
@@ -2789,7 +3217,174 @@ class PagedContinuousBatcher(_TracedBatcher):
         }
         if scales is not None:
             payload["scales"] = scales
+        if (
+            self.speculate_k is not None
+            and self.sampling
+            and float(np.asarray(self._temps)[slot]) > 0.0
+        ):
+            # sampled speculation: the draft ring is no longer advisory
+            # — the importer's accept draws compare against the q the
+            # EXPORTER's ring produces, so bit-identical continuation
+            # ships the slot's resting ring lane alongside the pages
+            payload["draft"] = self._export_draft_ring(slot)
         return payload
+
+    def _export_draft_ring(self, slot: int) -> dict:
+        """The slot's WHOLE draft-ring lane (rows + scales when
+        quantized).  Every row ships, not just [0, d_pos): the int8
+        requant's grow-only amax runs over the full ring — junk rows
+        from rejected tails included — so the importer must rest the
+        exporter's exact bytes or scale evolution (and with it the
+        sampled stream) diverges.  Unlike pool pages, the lane is read
+        with a plain gather under TP: the ring is per-slot kilobytes,
+        and the payload stays layout-agnostic host bytes."""
+        d = {
+            "d_pos": int(self._d_pos[slot]),
+            "window": int(self.draft_window),
+            "layers": int(self.draft_num_layers),
+            "heads": int(self.draft_num_heads),
+            "head_dim": self.draft_hidden // self.draft_num_heads,
+            "dtype": (
+                "int8" if self.kv_quant else str(jnp.dtype(self.dtype))
+            ),
+        }
+        if self.kv_quant:
+            d["rows"] = [
+                (
+                    np.asarray(jax.device_get(kd[slot])),
+                    np.asarray(jax.device_get(vd[slot])),
+                )
+                for (kd, _), (vd, _) in self.d_caches
+            ]
+            d["scales"] = [
+                (
+                    np.asarray(jax.device_get(ks_[slot])),
+                    np.asarray(jax.device_get(vs_[slot])),
+                )
+                for (_, ks_), (_, vs_) in self.d_caches
+            ]
+        else:
+            d["rows"] = [
+                (
+                    np.asarray(jax.device_get(ck[slot])),
+                    np.asarray(jax.device_get(cv[slot])),
+                )
+                for ck, cv in self.d_caches
+            ]
+        return d
+
+    def _try_import_draft_ring(self, slot: int, draft) -> bool:
+        """Splice an exported draft-ring lane into ``slot``.  Returns
+        False (no mutation) when the section is absent or its geometry
+        does not match — the caller falls back to the legacy prompt
+        re-admit, which is always safe (rejection sampling is lossless
+        in distribution for any draft) just not bit-stable across the
+        migration.  Runs past import's commit line, so it must never
+        raise."""
+        if not isinstance(draft, dict):
+            return False
+        d_hd = self.draft_hidden // self.draft_num_heads
+        want_dtype = (
+            "int8" if self.kv_quant else str(jnp.dtype(self.dtype))
+        )
+        if (
+            draft.get("window") != self.draft_window
+            or draft.get("layers") != self.draft_num_layers
+            or draft.get("heads") != self.draft_num_heads
+            or draft.get("head_dim") != d_hd
+            or draft.get("dtype") != want_dtype
+        ):
+            return False
+        rows = draft.get("rows")
+        row_shape = (self.draft_window, self.draft_num_heads, d_hd)
+        if (
+            not isinstance(rows, list)
+            or len(rows) != self.draft_num_layers
+            or any(
+                tuple(np.shape(kr)) != row_shape
+                or tuple(np.shape(vr)) != row_shape
+                for kr, vr in rows
+            )
+        ):
+            return False
+        scales = draft.get("scales")
+        if self.kv_quant:
+            s_shape = (self.draft_num_heads,)
+            if (
+                not isinstance(scales, list)
+                or len(scales) != self.draft_num_layers
+                or any(
+                    tuple(np.shape(ks_)) != s_shape
+                    or tuple(np.shape(vs_)) != s_shape
+                    for ks_, vs_ in scales
+                )
+            ):
+                return False
+
+        def _place(arr, spec):
+            if self.mesh is not None:
+                return jax.device_put(arr, NamedSharding(self.mesh, spec))
+            return arr
+
+        if self.kv_quant:
+            new = []
+            for ((ck, cs), (cv, vs_d)), (kr, vr), (ks_np, vs_np) in zip(
+                self.d_caches, rows, scales
+            ):
+                new.append((
+                    (
+                        _place(
+                            ck.at[slot].set(
+                                jnp.asarray(np.asarray(kr), jnp.int8)
+                            ),
+                            dense_cache_spec(),
+                        ),
+                        _place(
+                            cs.at[slot].set(
+                                jnp.asarray(
+                                    np.asarray(ks_np), jnp.float32
+                                )
+                            ),
+                            P(None, MODEL_AXIS),
+                        ),
+                    ),
+                    (
+                        _place(
+                            cv.at[slot].set(
+                                jnp.asarray(np.asarray(vr), jnp.int8)
+                            ),
+                            dense_cache_spec(),
+                        ),
+                        _place(
+                            vs_d.at[slot].set(
+                                jnp.asarray(
+                                    np.asarray(vs_np), jnp.float32
+                                )
+                            ),
+                            P(None, MODEL_AXIS),
+                        ),
+                    ),
+                ))
+            self.d_caches = new
+        else:
+            self.d_caches = [
+                (
+                    _place(
+                        ck.at[slot].set(
+                            jnp.asarray(np.asarray(kr), self.dtype)
+                        ),
+                        dense_cache_spec(),
+                    ),
+                    _place(
+                        cv.at[slot].set(
+                            jnp.asarray(np.asarray(vr), self.dtype)
+                        ),
+                        dense_cache_spec(),
+                    ),
+                )
+                for (ck, cv), (kr, vr) in zip(self.d_caches, rows)
+            ]
+        return True
 
     def import_pages(self, seq_id: int, payload: dict,
                      trace: Optional[SpanCtx] = None) -> None:
@@ -2818,8 +3413,15 @@ class PagedContinuousBatcher(_TracedBatcher):
         if remaining <= 0:
             raise ValueError("nothing left to decode")
         temperature = float(payload.get("temperature", 0.0))
-        if self.speculate_k is not None and temperature > 0.0:
-            raise ValueError("speculative paged serving is greedy-only")
+        if (
+            self.speculate_k is not None
+            and temperature > 0.0
+            and not self.sampling
+        ):
+            raise ValueError(
+                "greedy-only speculative paged batcher: importing a "
+                "sampled sequence needs sampling=True"
+            )
         plen = self._validate(prompt, len(tokens) + remaining)
         committed = plen + len(tokens) - 1
         n_pages = -(-committed // self.page) if committed else 0
@@ -2980,22 +3582,53 @@ class PagedContinuousBatcher(_TracedBatcher):
         self._active_dev = self._active_dev.at[slot].set(True)
         self._remaining_dev = self._remaining_dev.at[slot].set(remaining)
         self._counts_dev = self._counts_dev.at[slot].set(len(tokens))
+        s.temperature = temperature
         if self.speculate_k is not None:
-            # the draft ring does NOT transfer (advisory state): re-admit
-            # the prompt so the draft has some context and park its
-            # cursor at the real position — ring rows the exporter's
-            # draft held are zeros here, so accept rate dips until the
-            # ring rebuilds (or wraps), but greedy verification is
-            # lossless for ANY draft, so the stream cannot change
-            row = np.zeros((self.prompt_pad,), np.int32)
-            row[:plen] = prompt[:plen]
-            self.d_caches = self._draft_admit(
-                self.draft_params, self.d_caches, jnp.asarray(row),
-                jnp.int32(slot),
+            spliced = (
+                self.sampling
+                and temperature > 0.0
+                and self._try_import_draft_ring(
+                    slot, payload.get("draft")
+                )
             )
-            self._step_collective_bytes += self._admit_psum_bytes
-            self._d_pos[slot] = committed
-            self._d_pos_dev = self._d_pos_dev.at[slot].set(committed)
+            if spliced:
+                # sampled speculation: the exporter's resting ring lane
+                # landed byte-for-byte, so the continuation's q (and
+                # with it every accept draw) matches the un-migrated
+                # stream exactly; the write head resumes where the
+                # exporter's stood
+                d_pos = int(payload["draft"]["d_pos"])
+                self._d_pos[slot] = d_pos
+                self._d_pos_dev = self._d_pos_dev.at[slot].set(d_pos)
+            else:
+                # greedy (or no draft section): the ring is advisory —
+                # re-admit the prompt so the draft has some context and
+                # park its cursor at the real position.  Ring rows the
+                # exporter's draft held are zeros here, so accept rate
+                # dips until the ring rebuilds (or wraps); greedy
+                # verification is lossless for ANY draft, so the greedy
+                # stream cannot change (a sampled fallback stays
+                # lossless in DISTRIBUTION, just not bit-stable)
+                row = np.zeros((self.prompt_pad,), np.int32)
+                row[:plen] = prompt[:plen]
+                admit_extra = (
+                    (jnp.int32(plen),) if self.sampling else ()
+                )
+                self.d_caches = self._draft_admit(
+                    self.draft_params, self.d_caches, jnp.asarray(row),
+                    jnp.int32(slot), *admit_extra,
+                )
+                self._step_collective_bytes += self._admit_psum_bytes
+                self._d_pos[slot] = committed
+                self._d_pos_dev = self._d_pos_dev.at[slot].set(committed)
+            if self.sampling and temperature > 0.0 and not tokens:
+                # a post-prefill handoff (prefill-only exporter, zero
+                # tokens): the importer owes the dense-phasing first
+                # token — the direct SAMPLE draw at absolute position
+                # plen — before windows start
+                self._spec_first_token(
+                    slot, s, jnp.asarray(base_key), plen, temperature
+                )
         # the imported sequence opens a FRESH serve subtree (the
         # exporter's closed at detach with its own retire) that goes
         # straight to the decode phase
@@ -3509,9 +4142,21 @@ class PagedContinuousBatcher(_TracedBatcher):
             draft_ctx = verify_ctx = _null_ctx()
         td0 = time.monotonic()
         with draft_ctx:
-            proposals, self.d_caches, d_pos_w, wrapped = self._spec_draft(
-                self.draft_params, self.d_caches, last, d_pos, active,
-            )
+            if self.sampling:
+                # the q logits ride device-to-device into the verify —
+                # the rejection sampler runs in the compiled step, so
+                # the ONE readback below still ships only committed
+                # token ids + accept counts
+                (proposals, self.d_caches, d_pos_w, wrapped,
+                 d_logits) = self._spec_draft(
+                    self.draft_params, self.d_caches, last, d_pos,
+                    active, pos, self._temps, self._base_keys,
+                )
+            else:
+                (proposals, self.d_caches, d_pos_w,
+                 wrapped) = self._spec_draft(
+                    self.draft_params, self.d_caches, last, d_pos, active,
+                )
             if self.metrics is not None and not self.pipeline_decode:
                 # the timer boundary is also the program boundary:
                 # without the fence the verify timer would absorb the
@@ -3521,11 +4166,15 @@ class PagedContinuousBatcher(_TracedBatcher):
                 proposals = jax.block_until_ready(proposals)
         tv0 = time.monotonic()
         with verify_ctx:
+            sampled_args = (
+                (d_logits, self._temps, self._base_keys)
+                if self.sampling else ()
+            )
             (choices, emit_len, self.pools, self._last_dev, self._pos_dev,
              self._d_pos_dev, self._active_dev, self._remaining_dev) = (
                 self._spec_verify(
                     self.params, self.pools, last, proposals,
-                    table, pos, d_pos_w, active, remaining,
+                    table, pos, d_pos_w, active, remaining, *sampled_args,
                 )
             )
             if self.metrics is not None and not self.pipeline_decode:
@@ -3627,7 +4276,8 @@ class PagedContinuousBatcher(_TracedBatcher):
             self._last[i] = int(choices_h[i, e - 1])
             if self.metrics is not None:
                 self.metrics.observe(
-                    "serve_spec_accept_rate", (e - 1) / k, mode="greedy"
+                    "serve_spec_accept_rate", (e - 1) / k,
+                    mode="sampled" if s.temperature > 0.0 else "greedy",
                 )
             if s.remaining <= 0 or (
                 self.eos_id is not None
@@ -3707,6 +4357,7 @@ class PagedContinuousBatcher(_TracedBatcher):
                     "serve_draft_cache_rows",
                     float(self.slots * self.draft_window),
                 )
+                self._set_draft_ring_bytes_gauges()
                 self._draft_gauge_set = True
             self.metrics.set_gauge("serve_step_host_ms", row["host_ms"])
             self.metrics.set_gauge(

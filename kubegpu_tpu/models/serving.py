@@ -153,8 +153,8 @@ def record_quant_quality(metrics: Optional[Metrics], *,
 def record_sampling_quality(metrics: Optional[Metrics], *,
                             accept_rate: float,
                             nll_delta: Optional[float] = None,
-                            unigram_agreement: Optional[float] = None
-                            ) -> None:
+                            unigram_agreement: Optional[float] = None,
+                            lane: str = "dense") -> None:
     """Publish rejection-sampled speculation's MEASURED quality gauges —
     the statistical analogue of :func:`record_quant_quality` (sampled
     spec is lossless in DISTRIBUTION, not token identity, so the gate is
@@ -162,15 +162,22 @@ def record_sampling_quality(metrics: Optional[Metrics], *,
     acceptance, the teacher-forced NLL delta of sampled-spec output vs
     unspeculated sampling under the target, and the unigram-frequency
     agreement between the two output populations (bench.py
-    serving_sampled_spec measures all three)."""
+    serving_sampled_spec measures all three, once per batcher lane —
+    ``lane="dense"`` for the slot batcher, ``lane="paged"`` for the
+    page-pool batcher; the two lanes are independent claims)."""
     if metrics is None:
         return
-    metrics.set_gauge("serve_sampled_accept_rate", float(accept_rate))
+    metrics.set_gauge(
+        "serve_sampled_accept_rate", float(accept_rate), lane=lane
+    )
     if nll_delta is not None:
-        metrics.set_gauge("serve_sampled_nll_delta", float(nll_delta))
+        metrics.set_gauge(
+            "serve_sampled_nll_delta", float(nll_delta), lane=lane
+        )
     if unigram_agreement is not None:
         metrics.set_gauge(
-            "serve_sampled_unigram_agreement", float(unigram_agreement)
+            "serve_sampled_unigram_agreement", float(unigram_agreement),
+            lane=lane,
         )
 
 

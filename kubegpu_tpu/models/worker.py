@@ -635,7 +635,14 @@ def _run_decode_batched(args, params, max_seq: int, t0: float) -> int:
         cb = PagedContinuousBatcher(
             params, **common, quant=args.int8, page_size=page,
             pool_pages=pool, decode_page_cache=args.decode_page_cache,
-            kv_dtype=args.kv_dtype, mesh=mesh, **spec_kw,
+            kv_dtype=args.kv_dtype, mesh=mesh,
+            # sampled traffic keeps speculation on paged replicas too:
+            # the verify runs the rejection sampler in-program (the
+            # dense batcher's sampling=True mode) — no silent
+            # sampled->unspeculated demotion
+            sampling=args.sample_temperature > 0,
+            top_k=args.sample_top_k,
+            **spec_kw,
         )
 
     if args.serve_http is not None:
@@ -1030,7 +1037,9 @@ def main(argv=None) -> int:
                     "batching (models/serving.py); paged = continuous "
                     "batching over a shared KV page pool (models/paging.py); "
                     "speculative = draft-verified continuous batching "
-                    "(models/spec_serving.py, greedy-only)")
+                    "(models/spec_serving.py); paged and speculative both "
+                    "support sampled (rejection-verified) speculation when "
+                    "--sample-temperature > 0")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="speculative: proposals per verify chunk")
     ap.add_argument("--draft-layers", type=int, default=1,

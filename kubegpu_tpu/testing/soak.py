@@ -523,7 +523,8 @@ class GatewaySoak:
                  store_chaos: bool = False, controller: bool = False,
                  prefix_tier: bool = False, prefix_page: int = 8,
                  disaggregation: bool = False,
-                 stream_handoff: bool = True):
+                 stream_handoff: bool = True,
+                 sampled: bool = False):
         from kubegpu_tpu.gateway import (
             AdmissionQueue, FailoverPolicy, Gateway, GatewayTier,
             HttpReplicaClient, InMemoryReplicaClient, ReplicaServer,
@@ -550,6 +551,7 @@ class GatewaySoak:
             ),
         )
         self.disaggregation = disaggregation
+        self.sampled = sampled
         self.api = stack.api
         self.slices = stack.slices
         self.advs = stack.advs
@@ -816,13 +818,21 @@ class GatewaySoak:
         for item, prompt in ready:
             self.n += 1
             follows += int(item.follow_of is not None)
-            self._submit(GatewayRequest(
+            req = GatewayRequest(
                 prompt=prompt,
                 max_new_tokens=item.max_new_tokens,
                 request_id=item.request_id,
                 tenant=item.tenant,
                 session=item.session,
-            ))
+            )
+            if self.sampled:
+                # the sampled lane: every request is temperature>0 with
+                # a request-deterministic seed pin — on speculative
+                # paged replicas this drives the rejection-verify path
+                # (and keeps retries/hedges replayable, which I5 rides)
+                req.temperature = 0.9
+                req.seed = self.n * 1_000_003 + 17
+            self._submit(req)
         return (
             f"{label} x{len(ready)} ({follows} follow turns, "
             f"clock {self._wl_clock:.2f}s, total {self.n})"

@@ -390,6 +390,13 @@ CATALOG: Dict[str, MetricSpec] = {
     "serve_spec_verify_seconds": _h((), "verify program wall time"),
     "serve_draft_cache_rows": _g(
         (), "draft ring-cache rows resident (slots x draft_window)"),
+    "serve_draft_ring_bytes": _g(
+        ("dtype",), "draft ring-cache bytes RESTING by storage dtype "
+        "(mesh-wide aggregate, like serve_pool_kv_bytes).  A quantized "
+        "ring (kv_dtype=\"int8\") reports two series — int8 row bytes "
+        "and float32 per-(slot, head) scale bytes; a full-width ring "
+        "one series at its compute dtype.  The freed difference is "
+        "admission headroom the page pool gets back"),
 
     # -- per-iteration serving ledger (PagedContinuousBatcher.serve_step;
     #    the gauge twin of the bounded ledger ring at /debug/trace)
@@ -448,16 +455,19 @@ CATALOG: Dict[str, MetricSpec] = {
     #    acceptance plus distribution-agreement evidence that the
     #    spec-sampled stream is the target model's own
     "serve_sampled_accept_rate": _g(
-        (), "mean accepted-draft fraction of the sampled-speculation "
-        "bench lane ((emitted-1)/k averaged over verifies)"),
+        ("lane",), "mean accepted-draft fraction of the sampled-"
+        "speculation bench lane ((emitted-1)/k averaged over verifies); "
+        "lane=dense (slot batcher) or lane=paged (page-pool batcher)"),
     "serve_sampled_nll_delta": _g(
-        (), "teacher-forced target-model NLL of the spec-sampled "
+        ("lane",), "teacher-forced target-model NLL of the spec-sampled "
         "streams minus the plain-sampled streams' (same seeds; ~0 "
-        "within sampling noise when rejection sampling is lossless)"),
+        "within sampling noise when rejection sampling is lossless); "
+        "lane=dense|paged"),
     "serve_sampled_unigram_agreement": _g(
-        (), "L1 overlap of the unigram token histograms of the "
+        ("lane",), "L1 overlap of the unigram token histograms of the "
         "spec-sampled vs plain-sampled streams (1.0 = identical "
-        "marginal distributions; a distribution-level lossless check)"),
+        "marginal distributions; a distribution-level lossless check); "
+        "lane=dense|paged"),
 
     # -- tensor-parallel serving (models/paging.py with a mesh): the
     #    per-DEVICE half of the pool economy plus the collective traffic

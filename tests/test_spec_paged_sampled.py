@@ -1,0 +1,403 @@
+"""Sampled speculation on the paged hot path (ISSUE 20).
+
+The contract under test: ``PagedContinuousBatcher(speculate_k=k,
+sampling=True)`` runs ``rejection_sample_block`` INSIDE the compiled
+verify step — the accept/resample decision stays device-resident, the
+pipelined loop's one designated readback ships committed ids + accept
+counts, and the greedy program stays byte-unchanged.
+
+Layers:
+
+1. fp32 token identity — the paged sampled-spec stream equals the DENSE
+   sampled-spec batcher's (PR 19's reference) across page sizes and TP
+   widths, with ``draft_window=max_seq`` and equal slots pinned (the
+   paged draft ring then replays the dense draft schedule exactly);
+2. the int8 draft ring — storage-dtype-polymorphic like the pool:
+   deterministic replay, migration bit-identity through the whole-ring
+   wire section, per-dtype accounting with a full-width-imposter
+   negative (the PR 15 pool discipline applied to the ring);
+3. mid-stream migration — a seed-pinned sampled-spec sequence exported
+   mid-decode continues bit-identical on the importer;
+4. the gateway regression ISSUE 20 exists to close — sampled+seeded
+   traffic on a speculative paged replica KEEPS speculation and
+   populates ``serve_spec_accept_rate{mode=sampled}`` (no silent
+   sampled->unspeculated demotion), plus the GatewaySoak kill schedule
+   over sampled speculative paged replicas holding page accounting;
+5. compile stability — the sampled batcher mints exactly one entry per
+   speculative program (the dense-phasing first-token program included)
+   and greedy traffic on it never traces the sampled-only programs.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubegpu_tpu.models import TransformerLM
+from kubegpu_tpu.models.paging import PagedContinuousBatcher
+from kubegpu_tpu.models.spec_serving import SpeculativeContinuousBatcher
+from kubegpu_tpu.parallel import device_mesh
+from kubegpu_tpu.utils.metrics import Metrics
+
+# vocab and heads divisible by the tested TP widths (lm_head is
+# column-parallel over the vocab; the ring shards whole heads)
+CFG = dict(vocab_size=64, num_layers=2, num_heads=4, hidden=32, max_seq=32)
+DRAFT = dict(draft_num_layers=1, draft_num_heads=2, draft_hidden=16)
+
+BUDGETS = [8, 6, 7, 5]
+TEMPS = [0.9, 0.0, 1.2, 0.8]          # a greedy row rides along
+SEEDS = [41, None, 42, 43]            # ...and an unpinned sampled row
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = TransformerLM(dtype=jnp.float32, **CFG)
+    return model.init(
+        jax.random.PRNGKey(0), jnp.ones((2, 8), jnp.int32)
+    )["params"]
+
+
+@pytest.fixture(scope="module")
+def dparams():
+    model = TransformerLM(
+        vocab_size=CFG["vocab_size"], max_seq=CFG["max_seq"],
+        num_layers=DRAFT["draft_num_layers"],
+        num_heads=DRAFT["draft_num_heads"], hidden=DRAFT["draft_hidden"],
+        dtype=jnp.float32,
+    )
+    return model.init(
+        jax.random.PRNGKey(7), jnp.ones((2, 8), jnp.int32)
+    )["params"]
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.RandomState(9)
+    return [
+        np.array(rng.randint(0, CFG["vocab_size"], size=n), np.int32)
+        for n in (3, 5, 7, 4)
+    ]
+
+
+def make_sampled_paged(params, dparams, tp=1, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("prompt_pad", 16)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("pool_pages", 44)
+    # draft_window=max_seq: the ring never wraps, so its draft context
+    # (and therefore the proposal schedule) matches the dense batcher's
+    # row-for-row — the precondition for the ≡-dense identity lanes
+    kw.setdefault("draft_window", CFG["max_seq"])
+    mesh = None
+    if tp > 1:
+        if jax.device_count() < tp:
+            pytest.skip(f"need {tp} devices, have {jax.device_count()}")
+        mesh = device_mesh({"model": tp}, devices=jax.devices()[:tp])
+    return PagedContinuousBatcher(
+        params, draft_params=dparams, speculate_k=2, sampling=True,
+        dtype=jnp.float32, mesh=mesh, **DRAFT, **CFG, **kw,
+    )
+
+
+def dense_ref(params, dparams, prompts):
+    """The dense sampled-spec stream — PR 19's seed-pinned reference
+    (equal slots, k, and draft geometry to the paged batchers here)."""
+    return SpeculativeContinuousBatcher(
+        params, dparams, k=2, slots=4, prompt_pad=16,
+        dtype=jnp.float32, sampling=True, **DRAFT, **CFG,
+    ).run(prompts, BUDGETS, temperatures=TEMPS, seeds=SEEDS)
+
+
+def drive_until(cb, seq_id, n_tokens, max_steps=200):
+    """Step until the sequence committed >= n_tokens (still live)."""
+    for _ in range(max_steps):
+        cb.serve_step()
+        s = next((s for s in cb._seqs if s.seq_id == seq_id), None)
+        if s is not None and s.active and len(s.tokens) >= n_tokens:
+            return
+    raise AssertionError(
+        f"seq {seq_id} never reached {n_tokens} live tokens"
+    )
+
+
+def drain(cb):
+    done = {}
+    while cb.has_work():
+        done.update(cb.serve_step())
+    return done
+
+
+# ---------------------------------------------------------------------------
+# 1. fp32 token identity: paged sampled-spec ≡ dense sampled-spec
+# ---------------------------------------------------------------------------
+
+def test_paged_sampled_spec_matches_dense(params, dparams, prompts):
+    """The core identity at page 4 / TP 1, plus replay determinism: a
+    fresh engine given the same seeds emits byte-identical streams (the
+    hedge/migration precondition)."""
+    ref = dense_ref(params, dparams, prompts)
+    m = Metrics()
+    cb = make_sampled_paged(params, dparams, metrics=m)
+    got = cb.run(prompts, BUDGETS, temperatures=TEMPS, seeds=SEEDS)
+    assert got == ref, {
+        i: (got[i], ref[i]) for i in ref if got[i] != ref[i]
+    }
+    cb.assert_page_accounting()
+    assert cb.stats["spec_steps"] > 0
+    # restart invariance (a fresh engine = another replica)
+    again = make_sampled_paged(params, dparams).run(
+        prompts, BUDGETS, temperatures=TEMPS, seeds=SEEDS
+    )
+    assert again == got
+    # both verify modes fed the labeled accept histogram
+    assert m.histogram_count("serve_spec_accept_rate", mode="sampled") > 0
+    assert m.histogram_count("serve_spec_accept_rate", mode="greedy") > 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("page,tp", [(8, 1), (4, 2), (8, 2)])
+def test_paged_sampled_spec_grid(params, dparams, prompts, page, tp):
+    """The page-size x TP grid: head-sharded pools, the sharded draft
+    ring, and the TP verify psums must not perturb the seed-pinned
+    stream (fp32: identity is exact per numerics class)."""
+    ref = dense_ref(params, dparams, prompts)
+    cb = make_sampled_paged(params, dparams, tp=tp, page_size=page,
+                            pool_pages=44 if page == 4 else 24)
+    got = cb.run(prompts, BUDGETS, temperatures=TEMPS, seeds=SEEDS)
+    assert got == ref, (page, tp, {
+        i: (got[i], ref[i]) for i in ref if got[i] != ref[i]
+    })
+    cb.assert_page_accounting()
+
+
+# ---------------------------------------------------------------------------
+# 2. mid-stream migration: seed-pinned continuation bit-identical
+# ---------------------------------------------------------------------------
+
+def test_sampled_spec_migration_mid_stream(params, dparams, prompts):
+    """Export a sampled-spec sequence mid-decode, import on a fresh
+    engine: the continuation must be BIT-identical to the un-migrated
+    stream — the seed pin plus the draft-ring wire section make the
+    importer's windows replay the exporter's schedule exactly."""
+    src = make_sampled_paged(params, dparams)
+    ref = src.run(
+        [prompts[0]], [BUDGETS[0]], temperatures=[0.9], seeds=[41]
+    )[0]
+    assert len(ref) == BUDGETS[0]
+    src.submit(1, prompts[0], BUDGETS[0], temperature=0.9, seed=41)
+    drive_until(src, 1, 3)
+    payload = src.export_pages(1)
+    assert payload["tokens"] == ref[: len(payload["tokens"])]
+    # the sampled exporter ships its draft ring on the wire
+    assert "draft" in payload
+    src.cancel(1)
+    src.assert_page_accounting()
+    dst = make_sampled_paged(params, dparams)
+    dst.import_pages(11, payload)
+    dst.assert_page_accounting()
+    out = drain(dst)
+    assert out[11] == ref
+    dst.assert_page_accounting()
+
+
+def test_sampled_import_needs_sampling_engine(params, dparams, prompts):
+    """Importing a sampled sequence into a greedy-only speculative
+    engine still refuses crisply (guard #2 relaxed only for
+    sampling=True targets)."""
+    src = make_sampled_paged(params, dparams)
+    src.submit(1, prompts[0], 6, temperature=0.9, seed=41)
+    drive_until(src, 1, 2)
+    payload = src.export_pages(1)
+    greedy = PagedContinuousBatcher(
+        params, draft_params=dparams, speculate_k=2, slots=4,
+        prompt_pad=16, page_size=4, pool_pages=44,
+        draft_window=CFG["max_seq"], dtype=jnp.float32, **DRAFT, **CFG,
+    )
+    with pytest.raises(ValueError, match="greedy-only"):
+        greedy.import_pages(11, payload)
+
+
+# ---------------------------------------------------------------------------
+# 3. the int8 draft ring: replay determinism + migration bit-identity
+# ---------------------------------------------------------------------------
+
+def test_int8_ring_replay_deterministic(params, dparams, prompts):
+    """The quantized ring shifts accept rates (quantized q), so the
+    int8 lane's claims are REPLAY determinism and in-mode consistency,
+    never ≡-dense identity."""
+    kw = dict(kv_dtype="int8")
+    a = make_sampled_paged(params, dparams, **kw).run(
+        prompts, BUDGETS, temperatures=TEMPS, seeds=SEEDS
+    )
+    b = make_sampled_paged(params, dparams, **kw).run(
+        prompts, BUDGETS, temperatures=TEMPS, seeds=SEEDS
+    )
+    assert a == b
+    assert all(len(a[i]) == BUDGETS[i] for i in a)
+
+
+@pytest.mark.slow
+def test_int8_ring_migration_bit_identity(params, dparams, prompts):
+    """int8 mid-stream migration: the importer rests the exporter's
+    EXACT ring bytes (whole-lane rows + scales on the wire — the
+    grow-and-rescale scale evolution depends on junk rows from rejected
+    tails, so a re-quantized reconstruction would diverge), making the
+    continuation bit-identical to the un-migrated int8 stream."""
+    kw = dict(kv_dtype="int8")
+    src = make_sampled_paged(params, dparams, **kw)
+    ref = src.run(
+        [prompts[2]], [BUDGETS[2]], temperatures=[1.2], seeds=[42]
+    )[0]
+    src.submit(1, prompts[2], BUDGETS[2], temperature=1.2, seed=42)
+    drive_until(src, 1, 3)
+    payload = src.export_pages(1)
+    assert payload["tokens"] == ref[: len(payload["tokens"])]
+    assert payload["draft"]["dtype"] == "int8"
+    src.cancel(1)
+    dst = make_sampled_paged(params, dparams, **kw)
+    dst.import_pages(11, payload)
+    out = drain(dst)
+    assert out[11] == ref
+    src.assert_page_accounting()
+    dst.assert_page_accounting()
+
+
+def test_accounting_catches_int8_ring_imposter(params, dparams):
+    """The per-dtype bytes leg on the RING (the PR 15 pool negative,
+    applied to the draft cache): a full-width allocation wearing the
+    int8 label must fail accounting loudly, and so must a quantized
+    pair smuggled into a declared-full-width ring."""
+    cb = make_sampled_paged(params, dparams, kv_dtype="int8")
+    cb.assert_page_accounting()
+    (kd, ks), vent = cb.d_caches[0]
+    cb.d_caches[0] = ((kd.astype(jnp.float32), ks), vent)
+    with pytest.raises(AssertionError):
+        cb.assert_page_accounting()
+    cb.d_caches[0] = ((kd, ks), vent)
+    cb.assert_page_accounting()
+    # the full-width twin: a half-width imposter in a full-width ring
+    full = make_sampled_paged(params, dparams)
+    ck, cv = full.d_caches[0]
+    full.d_caches[0] = (ck.astype(jnp.bfloat16), cv)
+    with pytest.raises(AssertionError):
+        full.assert_page_accounting()
+
+
+def test_draft_ring_bytes_gauge(params, dparams):
+    """serve_draft_ring_bytes reports the resting ring economy by
+    storage dtype: the int8 ring rests one byte per element plus f32
+    scales; the full-width ring one series at the compute dtype."""
+    m8 = Metrics()
+    make_sampled_paged(params, dparams, kv_dtype="int8", metrics=m8)
+    d_hd = DRAFT["draft_hidden"] // DRAFT["draft_num_heads"]
+    elems = (
+        2 * DRAFT["draft_num_layers"] * 4 * CFG["max_seq"]
+        * DRAFT["draft_num_heads"] * d_hd
+    )
+    assert m8.gauge("serve_draft_ring_bytes", dtype="int8") == elems
+    assert m8.gauge("serve_draft_ring_bytes", dtype="float32") == (
+        2 * DRAFT["draft_num_layers"] * 4 * DRAFT["draft_num_heads"] * 4
+    )
+    mf = Metrics()
+    make_sampled_paged(params, dparams, metrics=mf)
+    assert mf.gauge("serve_draft_ring_bytes", dtype="float32") == elems * 4
+
+
+# ---------------------------------------------------------------------------
+# 4. the gateway regression: sampled traffic KEEPS speculation
+# ---------------------------------------------------------------------------
+
+def test_sampled_paged_reports_sampled_spec_iterations(
+    params, dparams, prompts
+):
+    """The regression ISSUE 20 closes: a speculative paged replica
+    given sampled+seeded traffic (the worker's --sample-temperature
+    --sample-seed flags construct exactly this batcher) must KEEP
+    speculation — sampled-spec verify iterations run and
+    serve_spec_accept_rate{mode=sampled} populates — where it
+    previously refused at submit and the gateway demoted the request
+    to unspeculated decode."""
+    m = Metrics()
+    cb = make_sampled_paged(params, dparams, metrics=m)
+    out = cb.run(
+        prompts[:2], BUDGETS[:2], temperatures=[0.9, 0.8], seeds=[10, 11]
+    )
+    assert all(len(out[i]) == BUDGETS[i] for i in out)
+    assert cb.stats["spec_steps"] > 0
+    assert m.histogram_count("serve_spec_accept_rate", mode="sampled") > 0
+    assert 0.0 <= m.histogram_sum(
+        "serve_spec_accept_rate", mode="sampled"
+    ) <= m.histogram_count("serve_spec_accept_rate", mode="sampled")
+    # the greedy-only construction still refuses crisply (guard #1
+    # survives for engines built WITHOUT sampling=True)
+    greedy = PagedContinuousBatcher(
+        params, draft_params=dparams, speculate_k=2, slots=4,
+        prompt_pad=16, page_size=4, pool_pages=44, dtype=jnp.float32,
+        **DRAFT, **CFG,
+    )
+    with pytest.raises(ValueError, match="greedy-only"):
+        greedy.submit(0, prompts[0], 4, temperature=0.7)
+
+
+@pytest.mark.slow
+def test_gateway_soak_sampled_paged_kill_schedule(params):
+    """GatewaySoak's kill/revive/hedge schedule with EVERY request
+    sampled+seed-pinned over sampled speculative paged replicas:
+    invariant I5 (served exactly once or explicitly rejected) plus
+    page accounting at quiescence on every surviving replica —
+    rejected/resampled windows must never leak pool pages."""
+    from kubegpu_tpu.testing.soak import GatewaySoak
+
+    tiny = dict(vocab_size=61, num_layers=1, num_heads=2, hidden=16,
+                max_seq=24)
+    tparams = TransformerLM(dtype=jnp.float32, **tiny).init(
+        jax.random.PRNGKey(1), jnp.ones((1, 4), jnp.int32)
+    )["params"]
+    soak = GatewaySoak(
+        seed=23, n_replicas=2, follow_prompt_cap=4, sampled=True,
+        batcher_factory=lambda key: PagedContinuousBatcher(
+            tparams, slots=4, prompt_pad=4, page_size=4, pool_pages=24,
+            station_slots=2, token_budget=8, dtype=jnp.float32,
+            draft_params=tparams, speculate_k=2, sampling=True,
+            draft_num_layers=tiny["num_layers"],
+            draft_num_heads=tiny["num_heads"],
+            draft_hidden=tiny["hidden"], **tiny,
+        ),
+    )
+    soak.run(steps=18)
+
+
+# ---------------------------------------------------------------------------
+# 5. compile stability: one entry per program, greedy path untouched
+# ---------------------------------------------------------------------------
+
+def test_sampled_compile_stability(params, dparams, prompts):
+    """Mixed greedy/sampled churn through the sampled batcher leaves
+    exactly ONE compiled entry per speculative program — the
+    dense-phasing first-token program included — and never traces the
+    plain step; greedy-only traffic on the SAME engine never traces
+    the first-token program at all (the sampled machinery costs greedy
+    traffic nothing)."""
+    cb = make_sampled_paged(params, dparams)
+    greedy_only = cb.run(prompts[:2], BUDGETS[:2])    # greedy traffic
+    assert cb._spec_first._cache_size() == 0, (
+        "greedy traffic traced the sampled first-token program"
+    )
+    cb.run(prompts, BUDGETS, temperatures=TEMPS, seeds=SEEDS)
+    cb.run(prompts, BUDGETS, temperatures=[0.5] * 4, seeds=[9] * 4)
+    for name in ("_spec_draft", "_spec_verify", "_draft_admit",
+                 "_spec_first"):
+        assert getattr(cb, name)._cache_size() == 1, (
+            f"{name}: {getattr(cb, name)._cache_size()} compiled entries"
+        )
+    assert cb._step._cache_size() == 0, "plain step traced under spec"
+    cb.assert_page_accounting()
+    # ...and the greedy rows the mixed runs emitted match the pure
+    # greedy-only engine's (the greedy program is byte-unchanged)
+    pure = PagedContinuousBatcher(
+        params, draft_params=dparams, speculate_k=2, slots=4,
+        prompt_pad=16, page_size=4, pool_pages=44,
+        draft_window=CFG["max_seq"], dtype=jnp.float32, **DRAFT, **CFG,
+    ).run(prompts[:2], BUDGETS[:2])
+    assert pure == greedy_only
